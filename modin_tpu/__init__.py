@@ -1,0 +1,41 @@
+"""modin_tpu — a TPU-native distributed dataframe framework.
+
+A drop-in pandas replacement (``import modin_tpu.pandas as pd``) whose hot path
+executes as sharded ``jax.Array`` computations on a TPU mesh.  Architecture
+surveyed from modin-project/modin (see /root/repo/SURVEY.md): API layer ->
+query compiler -> operator algebra -> sharded columnar core frame -> JAX/XLA
+engine, with in-process pandas as the correctness backstop for object dtypes
+and the long tail of the API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__version__ = "0.1.0"
+
+
+def set_execution(engine: Optional[str] = None, storage_format: Optional[str] = None) -> Tuple[str, str]:
+    """Set the execution (engine, storage format) pair atomically.
+
+    Reference behavior: /root/reference/modin/__init__.py:37-66.
+    """
+    from modin_tpu.config import Engine, StorageFormat
+
+    old_engine, old_storage_format = None, None
+    if engine is not None:
+        old_engine = Engine.get()
+        Engine.put(engine)
+    if storage_format is not None:
+        old_storage_format = StorageFormat.get()
+        StorageFormat.put(storage_format)
+    return old_engine, old_storage_format
+
+
+def set_backend(backend: str) -> None:
+    """Switch the active backend by name ('Tpu', 'Pandas', ...)."""
+    from modin_tpu.config import Backend
+
+    execution = Backend.get_execution_for_backend(backend)
+    set_execution(engine=execution.engine, storage_format=execution.storage_format)
+    Backend.put(backend)

@@ -1,0 +1,42 @@
+"""Configuration system: typed env-var parameters with pubsub callbacks.
+
+Reference design: /root/reference/modin/config/__init__.py.
+"""
+
+from modin_tpu.config.envvars import (  # noqa: F401
+    AsvImplementation,
+    AutoSwitchBackend,
+    Backend,
+    BenchmarkMode,
+    CpuCount,
+    DeviceCount,
+    DevicePutChunkBytes,
+    DocModule,
+    DynamicPartitioning,
+    Engine,
+    EnvironmentVariable,
+    Float64Policy,
+    IsDebug,
+    LazyExecution,
+    LogFileSize,
+    LogMemoryInterval,
+    LogMode,
+    Memory,
+    MeshShape,
+    MetricsMode,
+    MinColumnPartitionSize,
+    MinRowPartitionSize,
+    NativePandasMaxRows,
+    NativePandasTransferThreshold,
+    NPartitions,
+    PersistentPickle,
+    ProgressBar,
+    RangePartitioning,
+    ReadSqlEngine,
+    StateId,
+    StorageFormat,
+    TestDatasetSize,
+    TpuNumpy,
+    TrackFileLeaks,
+)
+from modin_tpu.config.pubsub import Parameter, ValueSource  # noqa: F401

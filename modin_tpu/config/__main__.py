@@ -1,0 +1,22 @@
+"""Print help for every configuration parameter (``python -m modin_tpu.config``).
+
+Reference behavior: /root/reference/modin/config/__main__.py:53-64.
+"""
+
+import modin_tpu.config as cfg
+from modin_tpu.config.pubsub import Parameter
+
+
+def print_config_help() -> None:
+    for objname in sorted(dir(cfg)):
+        obj = getattr(cfg, objname)
+        if (
+            isinstance(obj, type)
+            and issubclass(obj, Parameter)
+            and not obj.is_abstract
+        ):
+            print(f"{obj.get_help()}\n\tCurrent value: {obj.get()}")  # noqa: T201
+
+
+if __name__ == "__main__":
+    print_config_help()

@@ -1,0 +1,485 @@
+"""Environment-variable backed configuration parameters.
+
+TPU-native analogue of /root/reference/modin/config/envvars.py:38-1475.  All
+variables use the ``MODIN_TPU_*`` prefix.  The execution-selection trio
+(``Engine``/``StorageFormat``/``Backend``) mirrors the reference's bimap design
+(envvars.py:401-473) with TPU-first defaults: the default execution is the
+sharded-jax.Array storage format on the JAX engine.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import warnings
+from textwrap import dedent
+from typing import Any, Optional
+
+from modin_tpu.config.pubsub import (
+    DeprecationDescriptor,
+    ExactStr,
+    Parameter,
+    ValueSource,
+    _TYPE_PARAMS,
+)
+
+
+class EnvironmentVariable(Parameter, type=str, abstract=True):
+    """A parameter sourced from an environment variable."""
+
+    varname: Optional[str] = None
+
+    @classmethod
+    def _get_raw_from_config(cls) -> str:
+        if cls.varname is None:
+            raise TypeError(f"{cls.__name__} does not have a varname")
+        return os.environ[cls.varname]
+
+    @classmethod
+    def get_help(cls) -> str:
+        help = f"{cls.varname}: {dedent(cls.__doc__ or 'Unknown').strip()}\n"
+        help += f"\tProvide {_TYPE_PARAMS[cls.type].help}"
+        if cls.choices:
+            help += f" (valid examples are: {', '.join(str(c) for c in cls.choices)})"
+        return help
+
+
+class IsDebug(EnvironmentVariable, type=bool):
+    """Force the serial in-process Python engine (debugging aid)."""
+
+    varname = "MODIN_TPU_DEBUG"
+    default = False
+
+
+class Engine(EnvironmentVariable, type=str):
+    """Task-execution engine: Jax (device), Python (serial, testing), Native (no-op)."""
+
+    varname = "MODIN_TPU_ENGINE"
+    choices = ("Jax", "Python", "Native")
+    NOINIT_ENGINES = {"Python", "Native"}
+    has_custom_engine = False
+
+    @classmethod
+    def _get_default(cls) -> str:
+        if IsDebug.get():
+            return "Python"
+        try:
+            import jax  # noqa: F401
+
+            return "Jax"
+        except ImportError:  # pragma: no cover - jax is a hard dep in practice
+            return "Python"
+
+    @classmethod
+    def add_option(cls, choice: Any) -> Any:
+        choice = super().add_option(choice)
+        cls.NOINIT_ENGINES.add(choice)
+        cls.has_custom_engine = True
+        return choice
+
+
+class StorageFormat(EnvironmentVariable, type=str):
+    """Storage format: Tpu (sharded jax.Array columns), Pandas (block pandas), Native."""
+
+    varname = "MODIN_TPU_STORAGE_FORMAT"
+    choices = ("Tpu", "Pandas", "Native")
+
+    @classmethod
+    def _get_default(cls) -> str:
+        return "Pandas" if Engine.get() in ("Python",) else "Tpu"
+
+
+class Backend(EnvironmentVariable, type=str):
+    """Shorthand for an (Engine, StorageFormat) pair, kept in sync both ways.
+
+    Reference design: envvars.py:401-473 Backend<->Execution bimap.
+    """
+
+    varname = "MODIN_TPU_BACKEND"
+    choices = ("Tpu", "Pandas", "Python_Test")
+    _BACKEND_TO_EXECUTION: dict = {}
+    _EXECUTION_TO_BACKEND: dict = {}
+
+    @classmethod
+    def register_backend(cls, name: str, execution) -> None:
+        name = cls.add_option(name)
+        if name in cls._BACKEND_TO_EXECUTION:
+            raise ValueError(f"Backend '{name}' is already registered")
+        cls._BACKEND_TO_EXECUTION[name] = execution
+        cls._EXECUTION_TO_BACKEND[execution] = name
+
+    @classmethod
+    def get_backend_for_execution(cls, execution):
+        return cls._EXECUTION_TO_BACKEND[execution]
+
+    @classmethod
+    def get_execution_for_backend(cls, backend: Optional[str] = None):
+        if backend is None:
+            backend = cls.get()
+        backend = _TYPE_PARAMS[cls.type].normalize(backend)
+        if backend not in cls._BACKEND_TO_EXECUTION:
+            raise ValueError(f"Unknown backend '{backend}'")
+        return cls._BACKEND_TO_EXECUTION[backend]
+
+    @classmethod
+    def _get_default(cls) -> str:
+        from modin_tpu.core.execution.utils import Execution
+
+        try:
+            return cls._EXECUTION_TO_BACKEND[
+                Execution(StorageFormat.get(), Engine.get())
+            ]
+        except KeyError:
+            return "Tpu"
+
+
+class CpuCount(EnvironmentVariable, type=int):
+    """How many CPU cores to use for host-side (pandas-fallback) work."""
+
+    varname = "MODIN_TPU_CPUS"
+
+    @classmethod
+    def _get_default(cls) -> int:
+        import multiprocessing
+
+        return multiprocessing.cpu_count()
+
+
+class DeviceCount(EnvironmentVariable, type=int):
+    """How many accelerator devices the mesh spans (defaults to all visible)."""
+
+    varname = "MODIN_TPU_DEVICES"
+
+    @classmethod
+    def _get_default(cls) -> int:
+        try:
+            import jax
+
+            return jax.device_count()
+        except Exception:
+            return 1
+
+
+class MeshShape(EnvironmentVariable, type=tuple):
+    """Logical device mesh shape as (rows, cols) shards, e.g. '8,1'.
+
+    The TPU-native analogue of the reference's 2-D partition grid
+    (NPartitions x column splits): the row axis shards dataframe rows over
+    ICI neighbors; the col axis (usually 1) shards very wide frames.
+    """
+
+    varname = "MODIN_TPU_MESH_SHAPE"
+
+    @classmethod
+    def _get_default(cls) -> tuple:
+        return (DeviceCount.get(), 1)
+
+
+class NPartitions(EnvironmentVariable, type=int):
+    """Number of row shards for the partitioned (non-device) storage formats."""
+
+    varname = "MODIN_TPU_NPARTITIONS"
+
+    @classmethod
+    def _get_default(cls) -> int:
+        return max(CpuCount.get(), DeviceCount.get())
+
+
+class Memory(EnvironmentVariable, type=int):
+    """How much host memory (bytes) the runtime may use for spill buffers."""
+
+    varname = "MODIN_TPU_MEMORY"
+    default = None
+
+    @classmethod
+    def get(cls):  # Memory may legitimately be unset
+        try:
+            return super().get()
+        except TypeError:
+            return None
+
+
+class BenchmarkMode(EnvironmentVariable, type=bool):
+    """Force synchronous execution (block_until_ready) after every operator."""
+
+    varname = "MODIN_TPU_BENCHMARK_MODE"
+    default = False
+
+
+class LogMode(EnvironmentVariable, type=str):
+    """Tracing mode: disable, enable (api only), enable_api_only."""
+
+    varname = "MODIN_TPU_LOG_MODE"
+    choices = ("Enable", "Disable", "Enable_Api_Only")
+    default = "Disable"
+
+    @classmethod
+    def enable(cls):
+        cls.put("Enable")
+
+    @classmethod
+    def disable(cls):
+        cls.put("Disable")
+
+    @classmethod
+    def enable_api_only(cls):
+        cls.put("Enable_Api_Only")
+
+
+class LogMemoryInterval(EnvironmentVariable, type=int):
+    """Seconds between memory-profile samples when logging is enabled."""
+
+    varname = "MODIN_TPU_LOG_MEMORY_INTERVAL"
+    default = 5
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(f"Log memory interval should be > 0, passed value {value}")
+        super().put(value)
+
+
+class LogFileSize(EnvironmentVariable, type=int):
+    """Max size (MB) of one log file before rotation."""
+
+    varname = "MODIN_TPU_LOG_FILE_SIZE"
+    default = 10
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(f"Log file size should be > 0 MB, passed value {value}")
+        super().put(value)
+
+
+class MetricsMode(EnvironmentVariable, type=str):
+    """Emit API timing metrics to registered handlers (enable/disable)."""
+
+    varname = "MODIN_TPU_METRICS_MODE"
+    choices = ("Enable", "Disable")
+    default = "Enable"
+
+    @classmethod
+    def enable(cls):
+        cls.put("Enable")
+
+    @classmethod
+    def disable(cls):
+        cls.put("Disable")
+
+
+class ProgressBar(EnvironmentVariable, type=bool):
+    """Show a tqdm progress bar over outstanding device computations."""
+
+    varname = "MODIN_TPU_PROGRESS_BAR"
+    default = False
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+    @classmethod
+    def _check_new_value_ok(cls, value) -> None:
+        if value and BenchmarkMode.get():
+            raise ValueError("ProgressBar isn't compatible with BenchmarkMode")
+
+
+class RangePartitioning(EnvironmentVariable, type=bool):
+    """Use range-partitioning (sample->pivots->all-to-all) impls for groupby/sort/merge."""
+
+    varname = "MODIN_TPU_RANGE_PARTITIONING"
+    default = False
+
+
+class LazyExecution(EnvironmentVariable, type=str):
+    """Deferred-execution mode: Auto (rely on async dispatch), On, Off."""
+
+    varname = "MODIN_TPU_LAZY_EXECUTION"
+    choices = ("Auto", "On", "Off")
+    default = "Auto"
+
+
+class DynamicPartitioning(EnvironmentVariable, type=bool):
+    """Fuse small partitions into axis-level computations dynamically."""
+
+    varname = "MODIN_TPU_DYNAMIC_PARTITIONING"
+    default = False
+
+
+class MinRowPartitionSize(EnvironmentVariable, type=int):
+    """Minimum rows per row shard (avoid tiny shards that waste device tiles)."""
+
+    varname = "MODIN_TPU_MIN_ROW_PARTITION_SIZE"
+    default = 32
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(f"Min row partition size should be > 0, passed value {value}")
+        super().put(value)
+
+
+class MinColumnPartitionSize(EnvironmentVariable, type=int):
+    """Minimum columns per column shard."""
+
+    varname = "MODIN_TPU_MIN_COLUMN_PARTITION_SIZE"
+    default = 8
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Min column partition size should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class TestDatasetSize(EnvironmentVariable, type=str):
+    """Dataset size profile for the benchmark suite."""
+
+    varname = "MODIN_TPU_TEST_DATASET_SIZE"
+    choices = ("Small", "Normal", "Big")
+    default = None
+
+
+class AsvImplementation(EnvironmentVariable, type=ExactStr):
+    """Which implementation the asv-style benchmarks should exercise."""
+
+    varname = "MODIN_TPU_ASV_USE_IMPL"
+    choices = ("modin_tpu", "pandas")
+    default = "modin_tpu"
+
+
+class TrackFileLeaks(EnvironmentVariable, type=bool):
+    """Test-only: check for leaked file descriptors after each test."""
+
+    varname = "MODIN_TPU_TEST_TRACK_FILE_LEAKS"
+    default = os.name != "nt"
+
+
+class PersistentPickle(EnvironmentVariable, type=bool):
+    """Pickle dataframes by value (portable) rather than by device reference."""
+
+    varname = "MODIN_TPU_PERSISTENT_PICKLE"
+    default = False
+
+
+class TpuNumpy(EnvironmentVariable, type=bool):
+    """Use the modin_tpu.numpy array type for numpy-returning APIs."""
+
+    varname = "MODIN_TPU_NUMPY"
+    default = False
+
+
+class AutoSwitchBackend(EnvironmentVariable, type=bool):
+    """Let the cost calculator auto-move frames between device and host backends."""
+
+    varname = "MODIN_TPU_AUTO_SWITCH_BACKENDS"
+    default = True
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+
+class NativePandasMaxRows(EnvironmentVariable, type=int):
+    """Frames at or below this many rows prefer the in-process pandas backend."""
+
+    varname = "MODIN_TPU_NATIVE_PANDAS_MAX_ROWS"
+    default = 10_000_000
+
+
+class NativePandasTransferThreshold(EnvironmentVariable, type=int):
+    """Max rows the cost model will transfer host->device without complaint."""
+
+    varname = "MODIN_TPU_NATIVE_PANDAS_TRANSFER_THRESHOLD"
+    default = 10_000_000
+
+
+class DevicePutChunkBytes(EnvironmentVariable, type=int):
+    """Chunk size (bytes) for host->device streaming of huge columns."""
+
+    varname = "MODIN_TPU_DEVICE_PUT_CHUNK_BYTES"
+    default = 1 << 30
+
+
+class Float64Policy(EnvironmentVariable, type=str):
+    """float64 handling on device: Native (x64), Downcast (f32 compute)."""
+
+    varname = "MODIN_TPU_FLOAT64_POLICY"
+    choices = ("Native", "Downcast")
+    default = "Native"
+
+
+class DocModule(EnvironmentVariable, type=ExactStr):
+    """Alternate module to source API docstrings from (reference: envvars.py:1338)."""
+
+    varname = "MODIN_TPU_DOC_MODULE"
+    default = "pandas"
+
+
+class ReadSqlEngine(EnvironmentVariable, type=str):
+    """Engine to use when reading SQL tables."""
+
+    varname = "MODIN_TPU_READ_SQL_ENGINE"
+    choices = ("Pandas", "Connectorx")
+    default = "Pandas"
+
+
+class StateId(EnvironmentVariable, type=ExactStr):
+    """Unique id of this session (used for log directories)."""
+
+    varname = "MODIN_TPU_STATE_ID"
+
+    @classmethod
+    def _get_default(cls) -> str:
+        return secrets.token_hex(8)
+
+
+def _register_builtin_backends() -> None:
+    """Wire the canonical Backend <-> (StorageFormat, Engine) bimap
+    (reference: envvars.py:401-473)."""
+    from modin_tpu.core.execution.utils import Execution
+
+    Backend._BACKEND_TO_EXECUTION.clear()
+    Backend._EXECUTION_TO_BACKEND.clear()
+    Backend._BACKEND_TO_EXECUTION["Tpu"] = Execution("Tpu", "Jax")
+    Backend._EXECUTION_TO_BACKEND[Execution("Tpu", "Jax")] = "Tpu"
+    Backend._BACKEND_TO_EXECUTION["Pandas"] = Execution("Native", "Native")
+    Backend._EXECUTION_TO_BACKEND[Execution("Native", "Native")] = "Pandas"
+    Backend._BACKEND_TO_EXECUTION["Python_Test"] = Execution("Pandas", "Python")
+    Backend._EXECUTION_TO_BACKEND[Execution("Pandas", "Python")] = "Python_Test"
+
+
+_register_builtin_backends()
+
+
+def _check_vars() -> None:
+    """Warn on MODIN_TPU_* env vars that don't match any known parameter."""
+    valid = {
+        obj.varname
+        for obj in globals().values()
+        if isinstance(obj, type)
+        and issubclass(obj, EnvironmentVariable)
+        and not obj.is_abstract
+        and obj.varname is not None
+    }
+    found = {name for name in os.environ if name.startswith("MODIN_TPU_")}
+    unknown = found - valid
+    if unknown:
+        warnings.warn(
+            f"Found unknown environment variable{'s' if len(unknown) > 1 else ''}, "
+            f"please check {'their' if len(unknown) > 1 else 'its'} spelling: "
+            + ", ".join(sorted(unknown))
+        )
+
+
+_check_vars()

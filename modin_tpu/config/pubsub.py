@@ -1,0 +1,263 @@
+"""Typed configuration parameters with publish/subscribe callbacks.
+
+TPU-native re-design of the reference's config core
+(/root/reference/modin/config/pubsub.py:195-520): a ``Parameter`` owns a typed
+value sourced from DEFAULT < CONFIG (env var) < SET (runtime), and notifies
+subscribers on change.  The subscription mechanism is what lets the factory
+dispatcher re-bind the execution backend when ``Engine``/``StorageFormat``
+change mid-session.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import warnings
+from enum import IntEnum
+from typing import Any, Callable, NamedTuple, Optional
+
+
+class ValueSource(IntEnum):
+    """Where a parameter's current value came from (priority order)."""
+
+    DEFAULT = 0
+    GOT_FROM_CFG_SOURCE = 1
+    SET_BY_USER = 2
+
+
+class DeprecationDescriptor(NamedTuple):
+    """Marks a parameter (or one of its values) deprecated in favor of another."""
+
+    parameter: type
+    new_parameter: Optional[type] = None
+    when_removed: Optional[str] = None
+
+    def deprecation_message(self, use_envvar_names: bool = False) -> str:
+        name = (
+            getattr(self.parameter, "varname", self.parameter.__name__)
+            if use_envvar_names
+            else self.parameter.__name__
+        )
+        msg = f"'{name}' is deprecated"
+        if self.when_removed:
+            msg += f" and will be removed in {self.when_removed}"
+        if self.new_parameter is not None:
+            new_name = (
+                getattr(self.new_parameter, "varname", self.new_parameter.__name__)
+                if use_envvar_names
+                else self.new_parameter.__name__
+            )
+            msg += f"; use '{new_name}' instead"
+        return msg + "."
+
+
+class TypeDescriptor(NamedTuple):
+    """How to decode/verify a raw (usually string) config value."""
+
+    decode: Callable[[str], Any]
+    normalize: Callable[[Any], Any]
+    verify: Callable[[Any], bool]
+    help: str
+
+
+def _bool_decode(value: str) -> bool:
+    return value.strip().lower() in {"true", "yes", "1", "on"}
+
+
+def _int_decode(value: str) -> int:
+    return int(value.strip())
+
+
+def _float_decode(value: str) -> float:
+    return float(value.strip())
+
+
+def _str_decode(value: str) -> str:
+    return value.strip()
+
+
+def _tuple_of_ints_decode(value: str) -> tuple:
+    return tuple(int(x) for x in value.replace("(", "").replace(")", "").split(",") if x.strip())
+
+
+class ExactStr(str):
+    """Marker type: a string that must not be title-cased/normalized."""
+
+
+_TYPE_PARAMS = {
+    bool: TypeDescriptor(
+        decode=_bool_decode,
+        normalize=bool,
+        verify=lambda v: isinstance(v, bool)
+        or (isinstance(v, str) and v.strip().lower() in {"true", "yes", "1", "on", "false", "no", "0", "off"}),
+        help="a boolean flag (any of 'true', 'yes', '1', 'on' in any case)",
+    ),
+    int: TypeDescriptor(
+        decode=_int_decode,
+        normalize=int,
+        verify=lambda v: isinstance(v, int)
+        or (isinstance(v, str) and v.strip().lstrip("+-").isdigit()),
+        help="an integer value",
+    ),
+    float: TypeDescriptor(
+        decode=_float_decode,
+        normalize=float,
+        verify=lambda v: isinstance(v, (int, float))
+        or (isinstance(v, str) and v.strip().replace(".", "", 1).replace("-", "", 1).isdigit()),
+        help="a float value",
+    ),
+    str: TypeDescriptor(
+        decode=_str_decode,
+        normalize=lambda v: str(v).strip().title(),
+        verify=lambda v: True,
+        help="a case-insensitive string value",
+    ),
+    ExactStr: TypeDescriptor(
+        decode=lambda v: v,
+        normalize=lambda v: v,
+        verify=lambda v: True,
+        help="a string value (case preserved)",
+    ),
+    tuple: TypeDescriptor(
+        decode=_tuple_of_ints_decode,
+        normalize=lambda v: tuple(int(x) for x in v),
+        verify=lambda v: isinstance(v, (tuple, list, str)),
+        help="a comma-separated tuple of integers, e.g. '4,2'",
+    ),
+}
+
+
+class Parameter:
+    """A typed, subscribable configuration parameter.
+
+    Subclasses define ``default``, ``choices`` and ``type``; concrete config
+    sources (environment variables) override ``_get_raw_from_config`` /
+    ``_check_callbacks``-time behavior.
+    """
+
+    choices: Optional[tuple] = None
+    type: type = str
+    default: Optional[Any] = None
+    is_abstract: bool = True
+    _deprecation_descriptor: Optional[DeprecationDescriptor] = None
+
+    _value: Any = None
+    _value_source: Optional[ValueSource] = None
+    _subs: list
+    _once: dict
+
+    @classmethod
+    def _get_raw_from_config(cls) -> str:
+        """Read the raw value from the backing config source; KeyError if unset."""
+        raise KeyError(cls.__name__)
+
+    @classmethod
+    def get_help(cls) -> str:
+        raise NotImplementedError
+
+    def __init_subclass__(cls, type: type = str, abstract: bool = False, **kw):
+        super().__init_subclass__(**kw)
+        cls.type = type
+        cls.is_abstract = abstract
+        cls._value = None
+        cls._value_source = None
+        cls._subs = []
+        cls._once = {}
+
+    @classmethod
+    def subscribe(cls, callback: Callable) -> None:
+        """Register ``callback(cls)``; fired immediately and on every change."""
+        cls._subs.append(callback)
+        callback(cls)
+
+    @classmethod
+    def once(cls, onvalue: Any, callback: Callable) -> None:
+        """Run ``callback(cls)`` exactly once, when the value becomes ``onvalue``."""
+        onvalue = _TYPE_PARAMS[cls.type].normalize(onvalue)
+        if onvalue == cls.get():
+            callback(cls)
+        else:
+            cls._once.setdefault(onvalue, []).append(callback)
+
+    @classmethod
+    def _notify(cls) -> None:
+        for callback in list(cls._subs):
+            callback(cls)
+        value = cls._value
+        if value in cls._once:
+            for callback in cls._once.pop(value):
+                callback(cls)
+
+    @classmethod
+    def _get_default(cls) -> Any:
+        return cls.default
+
+    @classmethod
+    def get_value_source(cls) -> ValueSource:
+        if cls._value_source is None:
+            cls.get()
+        return cls._value_source
+
+    @classmethod
+    def get(cls) -> Any:
+        """Get the current value, resolving from the config source on first access."""
+        if cls._deprecation_descriptor is not None:
+            warnings.warn(
+                cls._deprecation_descriptor.deprecation_message(), FutureWarning
+            )
+        if cls._value is None:
+            # None means "not yet resolved" — a parameter can't legally hold None
+            try:
+                raw = cls._get_raw_from_config()
+            except KeyError:
+                cls._value = cls._get_default()
+                cls._value_source = ValueSource.DEFAULT
+            else:
+                if not _TYPE_PARAMS[cls.type].verify(raw):
+                    raise ValueError(f"Unsupported raw value for {cls.__name__}: {raw}")
+                decoded = _TYPE_PARAMS[cls.type].decode(raw)
+                cls._value = cls._normalize_and_check(decoded)
+                cls._value_source = ValueSource.GOT_FROM_CFG_SOURCE
+        return cls._value
+
+    @classmethod
+    def _normalize_and_check(cls, value: Any) -> Any:
+        value = _TYPE_PARAMS[cls.type].normalize(value)
+        if cls.choices is not None and value not in cls.choices:
+            raise ValueError(
+                f"Unsupported value '{value}' for {cls.__name__}; "
+                f"choose one of {cls.choices}"
+            )
+        return value
+
+    @classmethod
+    def put(cls, value: Any) -> None:
+        """Set the value at runtime and notify subscribers."""
+        cls._check_new_value_ok(value)
+        cls._value = cls._normalize_and_check(value)
+        cls._value_source = ValueSource.SET_BY_USER
+        cls._notify()
+
+    @classmethod
+    def _check_new_value_ok(cls, value: Any) -> None:
+        """Hook for subclasses to veto a new value (e.g. engine already started)."""
+
+    @classmethod
+    @contextlib.contextmanager
+    def context(cls, value: Any):
+        """Temporarily set the value within a ``with`` block (reference: pubsub.py:466)."""
+        old_value, old_source = cls._value, cls._value_source
+        try:
+            cls.put(value)
+            yield cls
+        finally:
+            cls._value, cls._value_source = old_value, old_source
+            cls._notify()
+
+    @classmethod
+    def add_option(cls, choice: Any) -> Any:
+        """Extend ``choices`` at runtime (used by the backend registry)."""
+        if cls.choices is not None:
+            choice = _TYPE_PARAMS[cls.type].normalize(choice)
+            if choice not in cls.choices:
+                cls.choices = (*cls.choices, choice)
+        return choice

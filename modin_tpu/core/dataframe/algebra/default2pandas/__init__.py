@@ -1,0 +1,17 @@
+"""Default-to-pandas builders (reference: modin/core/dataframe/algebra/default2pandas/)."""
+
+from modin_tpu.core.dataframe.algebra.default2pandas.default import (  # noqa: F401
+    BinaryDefault,
+    CatDefault,
+    DataFrameDefault,
+    DateTimeDefault,
+    DefaultMethod,
+    ExpandingDefault,
+    GroupByDefault,
+    ListDefault,
+    ResampleDefault,
+    RollingDefault,
+    SeriesDefault,
+    StrDefault,
+    StructDefault,
+)

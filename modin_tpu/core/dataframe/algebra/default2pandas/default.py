@@ -1,0 +1,310 @@
+"""``DefaultMethod`` — build a QC method from any pandas callable by materializing.
+
+Reference design: /root/reference/modin/core/dataframe/algebra/default2pandas/default.py:56.
+This is the correctness backstop of the whole framework: every query-compiler
+operation has a default implementation that gathers the frame to host pandas,
+applies the pandas kernel, and re-wraps the result.  Device-native compilers
+override the hot subset; everything else stays correct from day one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import pandas
+
+from modin_tpu.error_message import ErrorMessage
+from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
+
+
+class ObjTypeDeterminer:
+    """Pass-through target: look the function up on the object itself."""
+
+    def __getattr__(self, key: str) -> Callable:
+        def func(df: Any, *args: Any, **kwargs: Any) -> Any:
+            return getattr(df, key)(*args, **kwargs)
+
+        return func
+
+
+class DefaultMethod:
+    """Builder of default-to-pandas query-compiler methods.
+
+    ``register(func)`` returns a ``caller(query_compiler, *args, **kwargs)``
+    that materializes, applies ``func`` against the (possibly accessor-wrapped)
+    pandas object, and wraps DataFrame/Series results back into a QC.
+    """
+
+    OBJECT_TYPE = "DataFrame"
+    # the pandas class the registered function is applied against
+    DEFAULT_OBJECT_TYPE = pandas.DataFrame
+
+    @classmethod
+    def frame_wrapper(cls, df: pandas.DataFrame) -> Any:
+        """Extract the object to apply the function against (df, series, accessor...)."""
+        return df
+
+    @classmethod
+    def get_func(cls, func: Union[str, property, Callable], obj_type: Any) -> Callable:
+        if isinstance(func, str):
+            fn = getattr(obj_type, func, None)
+            if fn is None:
+                fn = getattr(ObjTypeDeterminer(), func)
+            func = fn
+        if isinstance(func, property):
+            fget = func.fget
+
+            def applyier(df: Any, *args: Any, **kwargs: Any) -> Any:
+                return fget(df)
+
+            return applyier
+        if not callable(func):
+            raise TypeError(f"Cannot build a default method from {func!r}")
+        return func
+
+    @classmethod
+    def register(
+        cls,
+        func: Union[str, property, Callable],
+        obj_type: Optional[Any] = None,
+        inplace: Optional[bool] = None,
+        fn_name: Optional[str] = None,
+        squeeze_self: bool = False,
+    ) -> Callable:
+        """Build a QC-level default method applying ``func`` via host pandas."""
+        if obj_type is None:
+            obj_type = cls.DEFAULT_OBJECT_TYPE
+        fn = cls.get_func(func, obj_type)
+        fn_display_name = fn_name or getattr(
+            func, "__name__", getattr(fn, "__name__", str(func))
+        )
+
+        def caller(query_compiler: Any, *args: Any, **kwargs: Any) -> Any:
+            df = query_compiler.to_pandas()
+            if squeeze_self:
+                df = df.squeeze(axis=1)
+            target = cls.frame_wrapper(df)
+            ErrorMessage.default_to_pandas(
+                f"`{cls.OBJECT_TYPE}.{fn_display_name}`"
+            )
+            result = fn(target, *args, **kwargs)
+            if inplace or (inplace is None and result is None):
+                result = df
+            return cls.build_output(query_compiler, result)
+
+        caller.__name__ = fn_display_name
+        return caller
+
+    @classmethod
+    def build_output(cls, query_compiler: Any, result: Any) -> Any:
+        """Wrap a pandas result back into a query compiler when 2-D/1-D."""
+        if isinstance(result, pandas.Series):
+            name = result.name if result.name is not None else MODIN_UNNAMED_SERIES_LABEL
+            result = result.to_frame(name)
+        if isinstance(result, pandas.DataFrame):
+            return query_compiler.__constructor__.from_pandas(
+                result, type(query_compiler._modin_frame)
+                if hasattr(query_compiler, "_modin_frame")
+                else None
+            )
+        return result
+
+
+class DataFrameDefault(DefaultMethod):
+    OBJECT_TYPE = "DataFrame"
+    DEFAULT_OBJECT_TYPE = pandas.DataFrame
+
+
+class SeriesDefault(DefaultMethod):
+    OBJECT_TYPE = "Series"
+    DEFAULT_OBJECT_TYPE = pandas.Series
+
+    @classmethod
+    def frame_wrapper(cls, df: pandas.DataFrame) -> pandas.Series:
+        return df.squeeze(axis=1)
+
+
+class StrDefault(SeriesDefault):
+    OBJECT_TYPE = "Series.str"
+    DEFAULT_OBJECT_TYPE = pandas.core.strings.accessor.StringMethods
+
+    @classmethod
+    def frame_wrapper(cls, df: pandas.DataFrame) -> Any:
+        return df.squeeze(axis=1).str
+
+
+class DateTimeDefault(SeriesDefault):
+    OBJECT_TYPE = "Series.dt"
+    DEFAULT_OBJECT_TYPE = pandas.core.indexes.accessors.CombinedDatetimelikeProperties
+
+    @classmethod
+    def frame_wrapper(cls, df: pandas.DataFrame) -> Any:
+        return df.squeeze(axis=1).dt
+
+
+class CatDefault(SeriesDefault):
+    OBJECT_TYPE = "Series.cat"
+    DEFAULT_OBJECT_TYPE = pandas.core.arrays.categorical.CategoricalAccessor
+
+    @classmethod
+    def frame_wrapper(cls, df: pandas.DataFrame) -> Any:
+        return df.squeeze(axis=1).cat
+
+
+class ListDefault(SeriesDefault):
+    OBJECT_TYPE = "Series.list"
+
+    @classmethod
+    def frame_wrapper(cls, df: pandas.DataFrame) -> Any:
+        return df.squeeze(axis=1).list
+
+
+class StructDefault(SeriesDefault):
+    OBJECT_TYPE = "Series.struct"
+
+    @classmethod
+    def frame_wrapper(cls, df: pandas.DataFrame) -> Any:
+        return df.squeeze(axis=1).struct
+
+
+class RollingDefault(DefaultMethod):
+    """Defaults for rolling-window aggregations (fold-shaped ops)."""
+
+    OBJECT_TYPE = "Rolling"
+
+    @classmethod
+    def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
+        fn_name = func if isinstance(func, str) else getattr(func, "__name__", str(func))
+
+        def caller(
+            query_compiler: Any, rolling_kwargs: dict, *args: Any, **kwargs: Any
+        ) -> Any:
+            df = query_compiler.to_pandas()
+            if squeeze_self:
+                df = df.squeeze(axis=1)
+            ErrorMessage.default_to_pandas(f"`Rolling.{fn_name}`")
+            roller = df.rolling(**rolling_kwargs)
+            fn = getattr(type(roller), fn_name) if isinstance(func, str) else func
+            return cls.build_output(query_compiler, fn(roller, *args, **kwargs))
+
+        caller.__name__ = f"rolling_{fn_name}"
+        return caller
+
+
+class ExpandingDefault(DefaultMethod):
+    OBJECT_TYPE = "Expanding"
+
+    @classmethod
+    def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
+        fn_name = func if isinstance(func, str) else getattr(func, "__name__", str(func))
+
+        def caller(
+            query_compiler: Any, expanding_args: list, *args: Any, **kwargs: Any
+        ) -> Any:
+            df = query_compiler.to_pandas()
+            if squeeze_self:
+                df = df.squeeze(axis=1)
+            ErrorMessage.default_to_pandas(f"`Expanding.{fn_name}`")
+            roller = df.expanding(*expanding_args)
+            fn = getattr(type(roller), fn_name) if isinstance(func, str) else func
+            return cls.build_output(query_compiler, fn(roller, *args, **kwargs))
+
+        caller.__name__ = f"expanding_{fn_name}"
+        return caller
+
+
+class ResampleDefault(DefaultMethod):
+    OBJECT_TYPE = "Resampler"
+
+    @classmethod
+    def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
+        fn_name = func if isinstance(func, str) else getattr(func, "__name__", str(func))
+
+        def caller(
+            query_compiler: Any, resample_kwargs: dict, *args: Any, **kwargs: Any
+        ) -> Any:
+            df = query_compiler.to_pandas()
+            if squeeze_self:
+                df = df.squeeze(axis=1)
+            ErrorMessage.default_to_pandas(f"`Resampler.{fn_name}`")
+            resampler = df.resample(**resample_kwargs)
+            fn = getattr(type(resampler), fn_name) if isinstance(func, str) else func
+            return cls.build_output(query_compiler, fn(resampler, *args, **kwargs))
+
+        caller.__name__ = f"resample_{fn_name}"
+        return caller
+
+
+class GroupByDefault(DefaultMethod):
+    OBJECT_TYPE = "GroupBy"
+
+    @classmethod
+    def register(cls, func: Union[str, Callable], **kw: Any) -> Callable:
+        fn_name = func if isinstance(func, str) else getattr(func, "__name__", str(func))
+
+        def caller(
+            query_compiler: Any,
+            by: Any,
+            agg_args: tuple = (),
+            agg_kwargs: Optional[dict] = None,
+            groupby_kwargs: Optional[dict] = None,
+            drop: bool = False,
+            **kwargs: Any,
+        ) -> Any:
+            from modin_tpu.utils import try_cast_to_pandas
+
+            df = query_compiler.to_pandas()
+            by = try_cast_to_pandas(by, squeeze=True)
+            groupby_kwargs = dict(groupby_kwargs or {})
+            agg_kwargs = agg_kwargs or {}
+            ErrorMessage.default_to_pandas(f"`GroupBy.{fn_name}`")
+            grp = df.groupby(by=by, **groupby_kwargs)
+            if callable(func):
+                result = func(grp, *agg_args, **agg_kwargs)
+            else:
+                result = getattr(grp, fn_name)(*agg_args, **agg_kwargs)
+            return cls.build_output(query_compiler, result)
+
+        caller.__name__ = f"groupby_{fn_name}"
+        return caller
+
+
+class BinaryDefault(DefaultMethod):
+    """Defaults for binary operations: aligns the ``other`` QC to pandas first."""
+
+    @classmethod
+    def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
+        fn = cls.get_func(func, pandas.DataFrame)
+        fn_name = getattr(func, "__name__", str(func)) if not isinstance(func, str) else func
+
+        def caller(
+            query_compiler: Any, other: Any, *args: Any, **kwargs: Any
+        ) -> Any:
+            from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL, try_cast_to_pandas
+
+            squeeze_other = kwargs.pop("squeeze_other", False)
+            df = query_compiler.to_pandas()
+            do_squeeze = squeeze_self or query_compiler._shape_hint == "column"
+            if do_squeeze:
+                df = df.squeeze(axis=1)
+                if isinstance(df, pandas.Series) and df.name == MODIN_UNNAMED_SERIES_LABEL:
+                    df.name = None
+                if kwargs.get("axis") in ("columns", 1):
+                    kwargs["axis"] = 0
+            other = try_cast_to_pandas(other)
+            if isinstance(other, pandas.DataFrame) and squeeze_other:
+                other = other.squeeze(axis=1)
+            ErrorMessage.default_to_pandas(f"`{fn_name}`")
+            if isinstance(df, pandas.Series):
+                series_fn = getattr(pandas.Series, fn_name, None)
+                result = (
+                    series_fn(df, other, *args, **kwargs)
+                    if series_fn is not None
+                    else fn(df, other, *args, **kwargs)
+                )
+            else:
+                result = fn(df, other, *args, **kwargs)
+            return cls.build_output(query_compiler, result)
+
+        caller.__name__ = fn_name
+        return caller

@@ -1,0 +1,344 @@
+"""``TpuDataframe`` — the sharded columnar core frame.
+
+TPU-native re-design of the reference's core frame
+(modin/core/dataframe/pandas/dataframe/dataframe.py:82).  Instead of a 2-D
+grid of pandas-block partitions on worker processes, a frame is:
+
+- host metadata: column labels (pandas.Index), a lazy row index (LazyIndex),
+  per-column logical dtypes;
+- per column, either a **DeviceColumn** (1-D jax.Array sharded over the mesh
+  "rows" axis — row-partitioning is the sharding spec, SURVEY.md §7) or a
+  **HostColumn** (numpy/extension array for object/string dtypes — the
+  device/host split that replaces the reference's default-to-pandas partition
+  fallback).
+
+Datetimes/timedeltas live on device as int64 with a logical-dtype tag; NaT is
+the int64 min sentinel, exactly pandas' own representation, so the round-trip
+is a zero-cost view.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas
+
+from modin_tpu.core.dataframe.tpu.metadata import LazyIndex, ensure_index
+from modin_tpu.logging import ClassLogger
+
+_DEVICE_NUMPY_KINDS = "biuf"  # bool, int, uint, float
+
+
+def _is_device_dtype(dtype: Any) -> bool:
+    """Whether a pandas dtype can live on device."""
+    if not isinstance(dtype, np.dtype):
+        return False
+    if dtype.kind in _DEVICE_NUMPY_KINDS and dtype.itemsize <= 8:
+        return True
+    # naive datetime64[ns] / timedelta64[ns] as int64 + logical tag
+    return dtype in (np.dtype("datetime64[ns]"), np.dtype("timedelta64[ns]"))
+
+
+class DeviceColumn:
+    """One column as a 1-D jax.Array sharded over the mesh rows axis.
+
+    ``host_cache`` keeps the original host numpy array for columns that came
+    from the host unchanged: it makes device round-trips bit-exact even where
+    the accelerator emulates the dtype (TPU f64 is double-float: ~2^-49
+    relative precision with a float32 exponent range) and lets the
+    default-to-pandas path skip the device->host transfer entirely.  Any
+    computed column drops the cache.
+    """
+
+    __slots__ = ("data", "pandas_dtype", "host_cache")
+    is_device = True
+
+    def __init__(self, data: Any, pandas_dtype: np.dtype, host_cache: Optional[np.ndarray] = None):
+        self.data = data
+        self.pandas_dtype = pandas_dtype
+        self.host_cache = host_cache
+
+    @classmethod
+    def from_numpy(cls, values: np.ndarray, sharding: Any = None) -> "DeviceColumn":
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        pandas_dtype = values.dtype
+        device_values = values.view("int64") if values.dtype.kind in "mM" else values
+        if not device_values.flags.c_contiguous:
+            device_values = np.ascontiguousarray(device_values)
+        return cls(
+            JaxWrapper.put(device_values, sharding), pandas_dtype, host_cache=values
+        )
+
+    def to_numpy(self) -> np.ndarray:
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        if self.host_cache is not None:
+            return self.host_cache
+        values = np.asarray(JaxWrapper.materialize(self.data))
+        if self.pandas_dtype.kind in "mM":
+            values = values.view(self.pandas_dtype)
+        return values
+
+    def with_data(self, data: Any, pandas_dtype: Optional[np.dtype] = None) -> "DeviceColumn":
+        return DeviceColumn(data, pandas_dtype if pandas_dtype is not None else self.pandas_dtype)
+
+    def __len__(self) -> int:
+        return self.data.shape[0]
+
+
+class HostColumn:
+    """One column kept on host (object/string/categorical/extension dtypes)."""
+
+    __slots__ = ("data",)
+    is_device = False
+
+    def __init__(self, data: Any):
+        # data: 1-D numpy array or pandas ExtensionArray
+        self.data = data
+
+    @property
+    def pandas_dtype(self):
+        return self.data.dtype
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def to_pandas_array(self) -> Any:
+        return self.data
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+
+Column = Union[DeviceColumn, HostColumn]
+
+
+class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
+    """Columnar frame: host metadata + device/host column store."""
+
+    def __init__(
+        self,
+        columns: List[Column],
+        col_labels: pandas.Index,
+        index: Union[pandas.Index, LazyIndex, Callable],
+        nrows: Optional[int] = None,
+    ):
+        self._columns = columns
+        self._col_labels = ensure_index(col_labels)
+        if not isinstance(index, LazyIndex):
+            index = LazyIndex(index, nrows)
+        self._index = index
+
+    # ------------------------------------------------------------------ #
+    # Construction / materialization
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_pandas(cls, df: pandas.DataFrame) -> "TpuDataframe":
+        columns: List[Column] = []
+        for i in range(df.shape[1]):
+            series = df.iloc[:, i]
+            dtype = series.dtype
+            if isinstance(dtype, np.dtype) and _is_device_dtype(dtype):
+                values = series.to_numpy()
+                columns.append(DeviceColumn.from_numpy(values))
+            else:
+                columns.append(HostColumn(series.array.copy()))
+        return cls(columns, df.columns, df.index, nrows=len(df))
+
+    def to_pandas(self) -> pandas.DataFrame:
+        data = {}
+        for i, col in enumerate(self._columns):
+            if col.is_device:
+                data[i] = col.to_numpy()
+            else:
+                data[i] = col.to_pandas_array()
+        df = pandas.DataFrame(data, index=self.index, copy=False)
+        df.columns = self._col_labels
+        return df
+
+    def to_numpy(self, **kwargs: Any) -> np.ndarray:
+        return self.to_pandas().to_numpy(**kwargs)
+
+    # ------------------------------------------------------------------ #
+    # Metadata
+    # ------------------------------------------------------------------ #
+
+    @property
+    def index(self) -> pandas.Index:
+        return self._index.get()
+
+    @index.setter
+    def index(self, value: Any) -> None:
+        value = ensure_index(value)
+        assert len(value) == len(self), "Length mismatch"
+        self._index = LazyIndex(value)
+
+    @property
+    def columns(self) -> pandas.Index:
+        return self._col_labels
+
+    @columns.setter
+    def columns(self, value: Any) -> None:
+        value = ensure_index(value)
+        assert len(value) == len(self._columns), "Length mismatch"
+        self._col_labels = value
+
+    @property
+    def dtypes(self) -> pandas.Series:
+        return pandas.Series(
+            [col.pandas_dtype for col in self._columns], index=self._col_labels
+        )
+
+    def __len__(self) -> int:
+        if self._index.has_known_length():
+            return len(self._index)
+        if self._columns:
+            return len(self._columns[0])
+        return len(self.index)
+
+    @property
+    def num_cols(self) -> int:
+        return len(self._columns)
+
+    @property
+    def all_device(self) -> bool:
+        return all(col.is_device for col in self._columns)
+
+    def copy(self) -> "TpuDataframe":
+        return TpuDataframe(
+            list(self._columns), self._col_labels, self._index.copy()
+        )
+
+    def finalize(self) -> None:
+        """Block until device work for this frame completes."""
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        for col in self._columns:
+            if col.is_device:
+                JaxWrapper.wait(col.data)
+
+    def free(self) -> None:
+        self._columns = []
+
+    # ------------------------------------------------------------------ #
+    # Structural algebra (host-metadata ops are free; device ops dispatch
+    # one jit per frame, fused across columns)
+    # ------------------------------------------------------------------ #
+
+    def select_columns_by_position(self, positions: Sequence[int]) -> "TpuDataframe":
+        return TpuDataframe(
+            [self._columns[i] for i in positions],
+            self._col_labels[list(positions)],
+            self._index,
+        )
+
+    def rename_columns(self, new_labels: pandas.Index) -> "TpuDataframe":
+        return TpuDataframe(list(self._columns), new_labels, self._index)
+
+    def with_columns(
+        self,
+        columns: List[Column],
+        col_labels: Optional[pandas.Index] = None,
+        index: Optional[Union[pandas.Index, LazyIndex]] = None,
+        nrows: Optional[int] = None,
+    ) -> "TpuDataframe":
+        return TpuDataframe(
+            columns,
+            col_labels if col_labels is not None else self._col_labels,
+            index if index is not None else self._index,
+            nrows=nrows,
+        )
+
+    def take_rows_positional(self, positions: Any) -> "TpuDataframe":
+        """Gather rows by position: device gather for device columns."""
+        import jax.numpy as jnp
+
+        if isinstance(positions, slice):
+            n = len(self)
+            rng = range(*positions.indices(n))
+            new_len = len(rng)
+            new_columns = []
+            for col in self._columns:
+                if col.is_device:
+                    cache = (
+                        col.host_cache[positions]
+                        if col.host_cache is not None
+                        else None
+                    )
+                    new_columns.append(
+                        DeviceColumn(col.data[positions], col.pandas_dtype, cache)
+                    )
+                else:
+                    new_columns.append(HostColumn(col.data[positions]))
+            new_index = self._index.map_after(lambda idx: idx[positions], new_len)
+            return self.with_columns(new_columns, index=new_index, nrows=new_len)
+        pos_arr = np.asarray(positions, dtype=np.int64)
+        device_pos = None
+        new_columns = []
+        for col in self._columns:
+            if col.is_device:
+                if device_pos is None:
+                    device_pos = jnp.asarray(pos_arr)
+                cache = (
+                    col.host_cache.take(pos_arr) if col.host_cache is not None else None
+                )
+                new_columns.append(
+                    DeviceColumn(
+                        jnp.take(col.data, device_pos, axis=0), col.pandas_dtype, cache
+                    )
+                )
+            else:
+                new_columns.append(HostColumn(col.data.take(pos_arr)))
+        new_index = self._index.map_after(lambda idx: idx.take(pos_arr), len(pos_arr))
+        return self.with_columns(new_columns, index=new_index, nrows=len(pos_arr))
+
+    def filter_rows_mask(self, mask: Any) -> "TpuDataframe":
+        """Boolean-mask rows.  The mask may be a device array; the row count is
+        data-dependent, so this is an eager (synchronizing) operation — the
+        reference has the same property via lazy row-length caches
+        (dataframe.py:242-343)."""
+        mask_np = np.asarray(mask)
+        positions = np.nonzero(mask_np)[0]
+        return self.take_rows_positional(positions)
+
+    def concat_rows(self, others: List["TpuDataframe"]) -> "TpuDataframe":
+        """Row-wise concat when column labels/dtypes align exactly."""
+        import jax.numpy as jnp
+
+        frames = [self, *others]
+        new_columns: List[Column] = []
+        for ci in range(self.num_cols):
+            cols = [f._columns[ci] for f in frames]
+            if all(c.is_device for c in cols) and len(
+                {c.data.dtype for c in cols}
+            ) == 1:
+                data = jnp.concatenate([c.data for c in cols])
+                cache = None
+                if all(c.host_cache is not None for c in cols):
+                    cache = np.concatenate([c.host_cache for c in cols])
+                new_columns.append(
+                    DeviceColumn(data, cols[0].pandas_dtype, cache)
+                )
+            else:
+                values = np.concatenate([np.asarray(c.to_numpy()) for c in cols])
+                first_dtype = cols[0].pandas_dtype
+                if all(c.is_device for c in cols):
+                    new_columns.append(DeviceColumn.from_numpy(values.astype(first_dtype, copy=False)))
+                else:
+                    new_columns.append(HostColumn(pandas.array(values)))
+        total = sum(len(f) for f in frames)
+        lazies = [f._index for f in frames]
+
+        def build_index() -> pandas.Index:
+            return lazies[0].get().append([lz.get() for lz in lazies[1:]])
+
+        return self.with_columns(new_columns, index=LazyIndex(build_index, total), nrows=total)
+
+    def get_column(self, position: int) -> Column:
+        return self._columns[position]
+
+    def column_position(self, label: Any) -> List[int]:
+        return list(self._col_labels.get_indexer_for([label]))

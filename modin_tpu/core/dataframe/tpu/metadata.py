@@ -1,0 +1,63 @@
+"""Lazy axis metadata for the TPU frame.
+
+Reference design: modin/core/dataframe/pandas/metadata/index.py:24 (ModinIndex:
+value-or-callable with caching).  Device computations produce frames whose row
+labels are a deferred gather (e.g. after filter/sort); materializing the index
+eagerly would force a device sync, so it stays a thunk until someone asks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import pandas
+
+
+class LazyIndex:
+    """A pandas Index, or a thunk that computes one (cached)."""
+
+    def __init__(self, value: Union[pandas.Index, Callable[[], pandas.Index]], length: Optional[int] = None):
+        if callable(value):
+            self._value = None
+            self._thunk = value
+        else:
+            self._value = ensure_index(value)
+            self._thunk = None
+        self._length = length if length is not None else (
+            len(self._value) if self._value is not None else None
+        )
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._value is not None
+
+    def get(self) -> pandas.Index:
+        if self._value is None:
+            self._value = ensure_index(self._thunk())
+            self._thunk = None
+            if self._length is None:
+                self._length = len(self._value)
+        return self._value
+
+    def __len__(self) -> int:
+        if self._length is None:
+            self.get()
+        return self._length
+
+    def has_known_length(self) -> bool:
+        return self._length is not None
+
+    def copy(self) -> "LazyIndex":
+        if self._value is not None:
+            return LazyIndex(self._value, self._length)
+        return LazyIndex(self._thunk, self._length)
+
+    def map_after(self, fn: Callable[[pandas.Index], pandas.Index], length: Optional[int] = None) -> "LazyIndex":
+        """A new LazyIndex applying ``fn`` to this one when materialized."""
+        return LazyIndex(lambda: fn(self.get()), length)
+
+
+def ensure_index(value: Any) -> pandas.Index:
+    if isinstance(value, pandas.Index):
+        return value
+    return pandas.Index(value)

@@ -1,0 +1,109 @@
+"""``FactoryDispatcher`` — singleton routing IO/from_* calls to the current factory.
+
+Reference design: /root/reference/modin/core/execution/dispatching/factories/dispatcher.py:104.
+Subscribes to ``Engine``/``StorageFormat``/``Backend`` config changes and
+re-binds the active factory, lazily initializing the engine on first touch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from modin_tpu.config import Backend, Engine, StorageFormat
+from modin_tpu.core.execution.dispatching.factories import factories
+from modin_tpu.core.execution.utils import Execution
+from modin_tpu.error_message import ErrorMessage
+from modin_tpu.utils import get_current_execution
+
+
+class FactoryNotFoundError(AttributeError):
+    pass
+
+
+class FactoryDispatcher(object):
+    """Routes calls to the factory for the active (StorageFormat, Engine)."""
+
+    __factory: type = None
+    _initialized_engines: set = set()
+
+    @classmethod
+    def get_factory(cls) -> type:
+        if cls.__factory is None:
+            from modin_tpu.pandas import _initialize_engine
+
+            Engine.subscribe(_initialize_engine)
+            Engine.subscribe(cls._update_factory)
+            StorageFormat.subscribe(cls._update_factory)
+        return cls.__factory
+
+    @classmethod
+    def _update_factory(cls, *args: Any) -> None:
+        factory_name = get_current_execution() + "Factory"
+        experimental_factory_name = "Experimental" + factory_name
+        try:
+            cls.__factory = getattr(factories, factory_name, None) or getattr(
+                factories, experimental_factory_name
+            )
+        except AttributeError:
+            if not IsExperimental_ok():
+                msg = (
+                    f"Cannot find neither factory {factory_name} nor experimental "
+                    f"factory {experimental_factory_name}. "
+                    "Potential reason might be incorrect environment variable value for "
+                    f"{StorageFormat.varname} or {Engine.varname}"
+                )
+                cls.__factory = factories.StubFactory.set_failing_name(factory_name)
+                ErrorMessage.single_warning(msg)
+                return
+        try:
+            cls.__factory.prepare()
+        except ModuleNotFoundError as err:
+            raise ModuleNotFoundError(
+                f"Make sure all required packages are installed: {err}"
+            ) from err
+
+    @classmethod
+    def get_backend_for_compiler(cls, qc_type: type) -> str:
+        """Reverse-map a query-compiler class to its backend name."""
+        from modin_tpu.core.storage_formats.native.query_compiler import (
+            NativeQueryCompiler,
+        )
+
+        try:
+            from modin_tpu.core.storage_formats.tpu.query_compiler import (
+                TpuQueryCompiler,
+            )
+
+            if issubclass(qc_type, TpuQueryCompiler):
+                return "Tpu"
+        except ImportError:
+            pass
+        if issubclass(qc_type, NativeQueryCompiler):
+            return "Pandas"
+        return Backend.get()
+
+
+def IsExperimental_ok() -> bool:
+    return False
+
+
+def _make_dispatch(name: str):
+    @classmethod
+    def dispatch(cls, *args: Any, **kwargs: Any):
+        return getattr(cls.get_factory(), f"_{name}")(*args, **kwargs)
+
+    dispatch.__func__.__name__ = name
+    return dispatch
+
+
+for _name in (
+    "from_pandas", "from_arrow", "from_non_pandas", "from_interchange_dataframe",
+    "from_map",
+    "read_parquet", "read_csv", "read_pickle", "read_table", "read_fwf",
+    "read_clipboard", "read_excel", "read_hdf", "read_feather", "read_stata",
+    "read_sas", "read_html", "read_sql", "read_sql_query", "read_sql_table",
+    "read_json", "read_xml", "read_spss", "read_orc",
+    "to_csv", "to_parquet", "to_json", "to_xml", "to_excel", "to_hdf",
+    "to_feather", "to_stata", "to_pickle", "to_sql", "to_orc",
+):
+    setattr(FactoryDispatcher, _name, _make_dispatch(_name))

@@ -1,0 +1,164 @@
+"""Execution factories: map (StorageFormat, Engine) -> bound IO class.
+
+Reference design: /root/reference/modin/core/execution/dispatching/factories/factories.py:133-567.
+"""
+
+from __future__ import annotations
+
+import re
+import typing
+from typing import Any, NamedTuple
+
+from modin_tpu.core.execution.utils import Execution
+from modin_tpu.core.io.io import BaseIO
+from modin_tpu.utils import get_current_execution
+
+
+class FactoryInfo(NamedTuple):
+    """Structured info about a factory: engine name, partition format, experimental flag."""
+
+    engine: str
+    partition: str
+    experimental: bool
+
+
+class NotRealFactory(Exception):
+    pass
+
+
+class BaseFactory:
+    """Base class of all execution factories."""
+
+    io_cls: typing.Type[BaseIO] = None
+
+    @classmethod
+    def get_info(cls) -> FactoryInfo:
+        try:
+            experimental = "Experimental" in cls.__name__
+            partition, engine = re.match(
+                r"^(?:Experimental)?(.*)On(.*)Factory$", cls.__name__
+            ).groups()
+        except AttributeError:
+            raise NotRealFactory()
+        return FactoryInfo(engine=engine, partition=partition, experimental=experimental)
+
+    @classmethod
+    def prepare(cls) -> None:
+        """Initialize the factory: import and bind the IO class."""
+        raise NotImplementedError(
+            f"{cls.__name__} is intended to be used without instantiation"
+        )
+
+    # -- IO dispatch: every method forwards to the bound io_cls -------- #
+
+    @classmethod
+    def _from_pandas(cls, df):
+        return cls.io_cls.from_pandas(df)
+
+    @classmethod
+    def _from_arrow(cls, at):
+        return cls.io_cls.from_arrow(at)
+
+    @classmethod
+    def _from_non_pandas(cls, *args: Any, **kwargs: Any):
+        return cls.io_cls.from_non_pandas(*args, **kwargs)
+
+    @classmethod
+    def _from_interchange_dataframe(cls, df):
+        return cls.io_cls.from_interchange_dataframe(df)
+
+    @classmethod
+    def _from_map(cls, func, iterable, *args: Any, **kwargs: Any):
+        return cls.io_cls.from_map(func, iterable, *args, **kwargs)
+
+
+def _make_io_forwarder(name: str):
+    @classmethod
+    def forwarder(cls, **kwargs: Any):
+        return getattr(cls.io_cls, name)(**kwargs)
+
+    forwarder.__func__.__name__ = f"_{name}"
+    return forwarder
+
+
+for _name in (
+    "read_parquet", "read_csv", "read_pickle", "read_table", "read_fwf",
+    "read_clipboard", "read_excel", "read_hdf", "read_feather", "read_stata",
+    "read_sas", "read_html", "read_sql", "read_sql_query", "read_sql_table",
+    "read_json", "read_xml", "read_spss", "read_orc",
+):
+    setattr(BaseFactory, f"_{_name}", _make_io_forwarder(_name))
+
+
+def _make_writer_forwarder(name: str):
+    @classmethod
+    def forwarder(cls, qc, **kwargs: Any):
+        return getattr(cls.io_cls, name)(qc, **kwargs)
+
+    forwarder.__func__.__name__ = f"_{name}"
+    return forwarder
+
+
+for _name in (
+    "to_csv", "to_parquet", "to_json", "to_xml", "to_excel", "to_hdf",
+    "to_feather", "to_stata", "to_pickle", "to_sql", "to_orc",
+):
+    setattr(BaseFactory, f"_{_name}", _make_writer_forwarder(_name))
+
+
+class TpuOnJaxFactory(BaseFactory):
+    """The flagship execution: sharded jax.Array storage on the JAX/XLA engine."""
+
+    @classmethod
+    def prepare(cls) -> None:
+        from modin_tpu.core.execution.jax_engine.io import TpuOnJaxIO
+
+        cls.io_cls = TpuOnJaxIO
+
+
+class PandasOnPythonFactory(BaseFactory):
+    """Serial in-process block-partitioned execution (debugging/tests)."""
+
+    @classmethod
+    def prepare(cls) -> None:
+        from modin_tpu.core.execution.python_engine.io import PandasOnPythonIO
+
+        cls.io_cls = PandasOnPythonIO
+
+
+class NativeOnNativeFactory(BaseFactory):
+    """Plain in-process pandas, no partitioning at all."""
+
+    @classmethod
+    def prepare(cls) -> None:
+        from modin_tpu.core.execution.native.io import NativeIO
+
+        cls.io_cls = NativeIO
+
+
+class StubIoEngine:
+    """IO-class stand-in raising informative errors for unknown engines."""
+
+    def __init__(self, factory_name: str = ""):
+        self.factory_name = factory_name or "Unknown"
+
+    def __getattr__(self, name: str):
+        factory_name = self.factory_name
+
+        def stub(*args: Any, **kw: Any):
+            raise NotImplementedError(
+                f"Method {factory_name}.{name} is not implemented"
+            )
+
+        return stub
+
+
+class StubFactory(BaseFactory):
+    """Factory that does nothing more than raise NotImplementedError when called."""
+
+    io_cls = StubIoEngine()
+
+    @classmethod
+    def set_failing_name(cls, factory_name: str) -> "type[StubFactory]":
+        cls.io_cls = StubIoEngine(factory_name)
+        return cls

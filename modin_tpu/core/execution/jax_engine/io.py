@@ -1,0 +1,17 @@
+"""IO bound to the TPU (sharded jax.Array) storage format on the JAX engine."""
+
+from modin_tpu.core.dataframe.tpu.dataframe import TpuDataframe
+from modin_tpu.core.io.io import BaseIO
+from modin_tpu.core.storage_formats.tpu.query_compiler import TpuQueryCompiler
+
+
+class TpuOnJaxIO(BaseIO):
+    """IO producing device-backed TpuQueryCompiler frames.
+
+    read_csv/read_parquet get parallel host-parse + chunked device upload in
+    the dedicated dispatchers (modin_tpu/core/io/); everything else goes
+    through host pandas then ``device_put``.
+    """
+
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe

@@ -1,0 +1,1 @@
+"""Native (in-process pandas) execution."""

@@ -1,0 +1,11 @@
+"""IO bound to the in-process Native (plain pandas) backend."""
+
+from modin_tpu.core.io.io import BaseIO
+from modin_tpu.core.storage_formats.native.query_compiler import NativeQueryCompiler
+
+
+class NativeIO(BaseIO):
+    """Serial pandas IO producing NativeQueryCompiler frames."""
+
+    query_compiler_cls = NativeQueryCompiler
+    frame_cls = None

@@ -1,0 +1,1 @@
+"""Serial Python execution engine (debugging/tests)."""

@@ -1,0 +1,17 @@
+"""IO bound to the serial Python backend.
+
+The Python engine exists for debugging and for unit-testing the stack without
+devices (reference: modin/core/execution/python/).  It currently binds the
+in-process pandas query compiler; the block-partitioned pandas storage format
+replaces it when selected explicitly.
+"""
+
+from modin_tpu.core.io.io import BaseIO
+from modin_tpu.core.storage_formats.native.query_compiler import NativeQueryCompiler
+
+
+class PandasOnPythonIO(BaseIO):
+    """Serial pandas IO for the Python debugging engine."""
+
+    query_compiler_cls = NativeQueryCompiler
+    frame_cls = None

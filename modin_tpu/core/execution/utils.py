@@ -1,0 +1,10 @@
+"""Execution descriptor shared by config and factories."""
+
+from typing import NamedTuple
+
+
+class Execution(NamedTuple):
+    """A (storage_format, engine) pair naming one execution backend."""
+
+    storage_format: str
+    engine: str

@@ -1,0 +1,125 @@
+"""``BaseIO`` — default (serial pandas) implementation of every reader/writer.
+
+Reference design: /root/reference/modin/core/io/io.py:48 — each ``read_*`` /
+``to_*`` materializes through host pandas and wraps the result in the bound
+query-compiler class.  Parallel dispatchers (CSV byte-range, Parquet row-group)
+override the hot formats in engine-specific IO classes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pandas
+
+from modin_tpu.core.storage_formats.base.query_compiler import BaseQueryCompiler
+from modin_tpu.error_message import ErrorMessage
+from modin_tpu.logging import ClassLogger
+from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
+
+
+class BaseIO(ClassLogger, modin_layer="CORE-IO"):
+    """Class for basic utils and default implementation of IO functions."""
+
+    query_compiler_cls: type = None
+    frame_cls: type = None
+
+    @classmethod
+    def _wrap(cls, pandas_obj: Any) -> BaseQueryCompiler:
+        if isinstance(pandas_obj, pandas.Series):
+            name = (
+                pandas_obj.name
+                if pandas_obj.name is not None
+                else MODIN_UNNAMED_SERIES_LABEL
+            )
+            pandas_obj = pandas_obj.to_frame(name)
+        if isinstance(pandas_obj, pandas.DataFrame):
+            return cls.query_compiler_cls.from_pandas(pandas_obj, cls.frame_cls)
+        return pandas_obj
+
+    @classmethod
+    def from_non_pandas(cls, *args: Any, **kwargs: Any):
+        return None
+
+    @classmethod
+    def from_pandas(cls, df: pandas.DataFrame) -> BaseQueryCompiler:
+        return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
+
+    @classmethod
+    def from_arrow(cls, at: Any) -> BaseQueryCompiler:
+        return cls.query_compiler_cls.from_arrow(at, cls.frame_cls)
+
+    @classmethod
+    def from_interchange_dataframe(cls, df: Any) -> BaseQueryCompiler:
+        return cls.query_compiler_cls.from_interchange_dataframe(df, cls.frame_cls)
+
+    @classmethod
+    def from_ray(cls, ray_obj: Any) -> BaseQueryCompiler:
+        ErrorMessage.not_implemented("from_ray is not supported on this engine")
+
+    @classmethod
+    def from_dask(cls, dask_obj: Any) -> BaseQueryCompiler:
+        ErrorMessage.not_implemented("from_dask is not supported on this engine")
+
+    @classmethod
+    def from_map(cls, func: Any, iterable: Any, *args: Any, **kwargs: Any) -> BaseQueryCompiler:
+        ErrorMessage.default_to_pandas("from_map")
+        frames = [
+            pandas.DataFrame(func(obj, *args, **kwargs)) for obj in iterable
+        ]
+        return cls.from_pandas(pandas.concat(frames, ignore_index=True))
+
+    @classmethod
+    def from_dataframe(cls, df: Any) -> BaseQueryCompiler:
+        return cls.from_interchange_dataframe(df)
+
+
+def _make_default_reader(name: str):
+    pandas_fn = getattr(pandas, name)
+
+    @classmethod
+    def reader(cls, **kwargs: Any) -> Any:
+        ErrorMessage.default_to_pandas(f"`{name}`")
+        result = pandas_fn(**kwargs)
+        if isinstance(result, (pandas.DataFrame, pandas.Series)):
+            return cls._wrap(result)
+        if isinstance(result, dict):  # e.g. read_excel(sheet_name=None)
+            return {k: cls._wrap(v) for k, v in result.items()}
+        if isinstance(result, list):  # e.g. read_html
+            return [cls._wrap(v) for v in result]
+        return result
+
+    reader.__func__.__name__ = name
+    return reader
+
+
+for _name in (
+    "read_parquet", "read_csv", "read_pickle", "read_table", "read_fwf",
+    "read_clipboard", "read_excel", "read_hdf", "read_feather", "read_stata",
+    "read_sas", "read_html", "read_sql", "read_sql_query", "read_sql_table",
+    "read_json", "read_xml", "read_spss", "read_orc",
+):
+    if hasattr(pandas, _name):
+        setattr(BaseIO, _name, _make_default_reader(_name))
+
+
+def _make_default_writer(method_name: str):
+    @classmethod
+    def writer(cls, qc: BaseQueryCompiler, **kwargs: Any) -> Any:
+        ErrorMessage.default_to_pandas(f"`{method_name}`")
+        df = qc.to_pandas()
+        if qc._shape_hint == "column":
+            obj = df.squeeze(axis=1)
+            if hasattr(obj, method_name):
+                return getattr(obj, method_name)(**kwargs)
+        return getattr(df, method_name)(**kwargs)
+
+    writer.__func__.__name__ = method_name
+    return writer
+
+
+for _name in (
+    "to_csv", "to_parquet", "to_json", "to_xml", "to_excel", "to_hdf",
+    "to_feather", "to_stata", "to_pickle", "to_sql", "to_orc",
+):
+    setattr(BaseIO, _name, _make_default_writer(_name))

@@ -1,0 +1,1 @@
+"""modin_tpu subpackage."""

@@ -1,0 +1,101 @@
+"""``NativeQueryCompiler`` — zero-distribution, in-process pandas backend.
+
+Reference design: /root/reference/modin/core/storage_formats/pandas/native_query_compiler.py:93.
+Used as the small-data fast path (device dispatch overhead dominates under
+~10^5 rows) and as the host endpoint of device<->host backend switching.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import pandas
+
+from modin_tpu.config import NativePandasMaxRows, NativePandasTransferThreshold
+from modin_tpu.core.storage_formats.base.query_compiler import (
+    BaseQueryCompiler,
+    QCCoercionCost,
+)
+from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
+
+
+class NativeQueryCompiler(BaseQueryCompiler):
+    """A query compiler holding one plain ``pandas.DataFrame`` in-process."""
+
+    storage_format = property(lambda self: "Native")
+    engine = property(lambda self: "Native")
+
+    def __init__(self, pandas_frame: pandas.DataFrame, shape_hint: Optional[str] = None):
+        assert isinstance(pandas_frame, pandas.DataFrame), type(pandas_frame)
+        self._pandas_frame = pandas_frame
+        self._shape_hint = shape_hint
+        if shape_hint is None and len(pandas_frame.columns) == 1:
+            if pandas_frame.columns[0] == MODIN_UNNAMED_SERIES_LABEL:
+                self._shape_hint = "column"
+
+    # -- data exchange ------------------------------------------------- #
+
+    @classmethod
+    def from_pandas(cls, df: pandas.DataFrame, data_cls: Any = None) -> "NativeQueryCompiler":
+        return cls(df)
+
+    def to_pandas(self) -> pandas.DataFrame:
+        return self._pandas_frame.copy()
+
+    def copy(self) -> "NativeQueryCompiler":
+        return type(self)(self._pandas_frame, self._shape_hint)
+
+    def free(self) -> None:
+        self._pandas_frame = None
+
+    # -- metadata ------------------------------------------------------ #
+
+    def get_index(self) -> pandas.Index:
+        return self._pandas_frame.index
+
+    def get_columns(self) -> pandas.Index:
+        return self._pandas_frame.columns
+
+    def _set_index(self, idx: pandas.Index) -> None:
+        self._pandas_frame = self._pandas_frame.set_axis(idx, axis=0)
+
+    def _set_columns(self, cols: pandas.Index) -> None:
+        self._pandas_frame = self._pandas_frame.set_axis(cols, axis=1)
+
+    index = property(get_index, _set_index)
+    columns = property(get_columns, _set_columns)
+
+    @property
+    def dtypes(self) -> pandas.Series:
+        return self._pandas_frame.dtypes
+
+    def get_axis_len(self, axis: int) -> int:
+        return self._pandas_frame.shape[1 if axis else 0]
+
+    # -- cost model (reference: native_query_compiler.py:234-260) ------- #
+
+    def stay_cost(self, api_cls_name, operation, arguments) -> Optional[int]:
+        if len(self._pandas_frame) > NativePandasMaxRows.get():
+            return QCCoercionCost.COST_HIGH
+        return QCCoercionCost.COST_ZERO
+
+    def move_to_cost(self, other_qc_type, api_cls_name, operation, arguments) -> Optional[int]:
+        if type(self) is other_qc_type:
+            return QCCoercionCost.COST_ZERO
+        nrows = len(self._pandas_frame)
+        if nrows > NativePandasTransferThreshold.get():
+            return QCCoercionCost.COST_HIGH
+        if nrows > NativePandasMaxRows.get():
+            return QCCoercionCost.COST_MEDIUM
+        return QCCoercionCost.COST_LOW
+
+    @classmethod
+    def move_to_me_cost(cls, other_qc, api_cls_name, operation, arguments) -> Optional[int]:
+        if isinstance(other_qc, cls):
+            return QCCoercionCost.COST_ZERO
+        try:
+            if other_qc.get_axis_len(0) <= NativePandasMaxRows.get():
+                return QCCoercionCost.COST_LOW
+        except Exception:
+            pass
+        return QCCoercionCost.COST_MEDIUM

@@ -1,0 +1,228 @@
+"""``TpuQueryCompiler`` — the device-native query compiler.
+
+TPU-native counterpart of the reference's PandasQueryCompiler
+(modin/core/storage_formats/pandas/query_compiler.py:279): inherits the full
+default-to-pandas surface from BaseQueryCompiler (correctness floor) and
+overrides the hot subset with sharded jax.Array implementations:
+
+- elementwise maps and binary ops  -> one jit over all device columns (XLA
+  fuses across columns; the reference's ``map_partitions`` without task
+  overhead)
+- axis reductions                  -> jnp reduce; XLA emits psum over ICI
+  when the array is sharded (the reference's ``tree_reduce``)
+- groupby reductions               -> segment-sum on factorized keys (the
+  reference's ``groupby_reduce`` map+reduce pair collapses into one kernel)
+- sort/gather/filter/concat        -> device argsort/take/concatenate
+
+Operations it can't run on device (object dtypes, exotic kwargs) fall through
+to the inherited defaults, exactly the reference's incremental-optimization
+strategy (SURVEY.md §7 stage 2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Hashable, List, Optional
+
+import numpy as np
+import pandas
+
+from modin_tpu.config import BenchmarkMode
+from modin_tpu.core.dataframe.tpu.dataframe import (
+    DeviceColumn,
+    HostColumn,
+    TpuDataframe,
+)
+from modin_tpu.core.dataframe.tpu.metadata import LazyIndex
+from modin_tpu.core.storage_formats.base.query_compiler import (
+    BaseQueryCompiler,
+    QCCoercionCost,
+)
+from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
+
+
+class TpuQueryCompiler(BaseQueryCompiler):
+    """Query compiler over a TpuDataframe (sharded jax.Array columns)."""
+
+    storage_format = property(lambda self: "Tpu")
+    engine = property(lambda self: "Jax")
+
+    def __init__(self, frame: TpuDataframe, shape_hint: Optional[str] = None):
+        assert isinstance(frame, TpuDataframe), type(frame)
+        self._modin_frame = frame
+        self._shape_hint = shape_hint
+
+    # ------------------------------------------------------------------ #
+    # Data exchange
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_pandas(cls, df: pandas.DataFrame, data_cls: Any = None) -> "TpuQueryCompiler":
+        return cls(TpuDataframe.from_pandas(df))
+
+    def to_pandas(self) -> pandas.DataFrame:
+        result = self._modin_frame.to_pandas()
+        if BenchmarkMode.get():
+            pass  # to_pandas is inherently synchronous
+        return result
+
+    def to_numpy(self, **kwargs: Any) -> np.ndarray:
+        return self._modin_frame.to_numpy(**kwargs)
+
+    def copy(self) -> "TpuQueryCompiler":
+        return type(self)(self._modin_frame.copy(), self._shape_hint)
+
+    def free(self) -> None:
+        self._modin_frame.free()
+
+    def finalize(self) -> None:
+        self._modin_frame.finalize()
+
+    def execute(self) -> None:
+        self._modin_frame.finalize()
+
+    # ------------------------------------------------------------------ #
+    # Metadata
+    # ------------------------------------------------------------------ #
+
+    def get_index(self) -> pandas.Index:
+        return self._modin_frame.index
+
+    def get_columns(self) -> pandas.Index:
+        return self._modin_frame.columns
+
+    def _set_index(self, value: Any) -> None:
+        self._modin_frame = self._modin_frame.copy()
+        self._modin_frame.index = value
+
+    def _set_columns(self, value: Any) -> None:
+        self._modin_frame = self._modin_frame.copy()
+        self._modin_frame.columns = value
+
+    index = property(get_index, _set_index)
+    columns = property(get_columns, _set_columns)
+
+    @property
+    def dtypes(self) -> pandas.Series:
+        return self._modin_frame.dtypes
+
+    def get_axis_len(self, axis: int) -> int:
+        return self._modin_frame.num_cols if axis else len(self._modin_frame)
+
+    # ------------------------------------------------------------------ #
+    # Backend cost model: large frames want to stay on device
+    # ------------------------------------------------------------------ #
+
+    def stay_cost(self, api_cls_name, operation, arguments) -> Optional[int]:
+        return QCCoercionCost.COST_ZERO
+
+    def move_to_cost(self, other_qc_type, api_cls_name, operation, arguments) -> Optional[int]:
+        if type(self) is other_qc_type:
+            return QCCoercionCost.COST_ZERO
+        nrows = len(self._modin_frame)
+        if nrows > 10_000_000:
+            return QCCoercionCost.COST_HIGH
+        return QCCoercionCost.COST_LOW
+
+    # ------------------------------------------------------------------ #
+    # Structural fast paths (host metadata + device gather)
+    # ------------------------------------------------------------------ #
+
+    def getitem_column_array(self, key: Any, numeric: bool = False, ignore_order: bool = False) -> "TpuQueryCompiler":
+        frame = self._modin_frame
+        if numeric:
+            positions = [int(k) for k in key]
+        else:
+            positions = []
+            indexer = frame.columns.get_indexer_for(list(key))
+            if (np.asarray(indexer) == -1).any():
+                return super().getitem_column_array(key, numeric=numeric)
+            positions = [int(i) for i in indexer]
+        return type(self)(frame.select_columns_by_position(positions))
+
+    def getitem_row_array(self, key: Any) -> "TpuQueryCompiler":
+        return type(self)(
+            self._modin_frame.take_rows_positional(np.asarray(list(key), dtype=np.int64)),
+            self._shape_hint,
+        )
+
+    def row_slice(self, start: Optional[int], stop: Optional[int], step: Optional[int] = None) -> "TpuQueryCompiler":
+        return type(self)(
+            self._modin_frame.take_rows_positional(slice(start, stop, step)),
+            self._shape_hint,
+        )
+
+    def take_2d_positional(self, index: Any = None, columns: Any = None) -> "TpuQueryCompiler":
+        frame = self._modin_frame
+        if columns is not None:
+            if isinstance(columns, slice):
+                positions = list(range(*columns.indices(frame.num_cols)))
+            else:
+                positions = [int(c) for c in columns]
+            frame = frame.select_columns_by_position(positions)
+        if index is not None:
+            frame = frame.take_rows_positional(
+                index if isinstance(index, slice) else np.asarray(list(index), dtype=np.int64)
+            )
+        return type(self)(frame)
+
+    def getitem_array(self, key: Any) -> "TpuQueryCompiler":
+        if isinstance(key, TpuQueryCompiler):
+            mask_frame = key._modin_frame
+            if mask_frame.num_cols == 1 and mask_frame.get_column(0).is_device:
+                mask = mask_frame.get_column(0).to_numpy()
+                if mask.dtype == bool:
+                    return type(self)(self._modin_frame.filter_rows_mask(mask))
+            return super().getitem_array(key)
+        key_arr = np.asarray(key)
+        if key_arr.dtype == bool:
+            return type(self)(self._modin_frame.filter_rows_mask(key_arr))
+        return super().getitem_array(key)
+
+    def drop(self, index: Any = None, columns: Any = None, errors: str = "raise") -> "TpuQueryCompiler":
+        result = self
+        frame = self._modin_frame
+        if columns is not None:
+            cols_list = [columns] if isinstance(columns, (str, int, tuple)) or not hasattr(columns, "__iter__") else list(columns)
+            keep = [
+                i for i, label in enumerate(frame.columns)
+                if label not in set(cols_list)
+            ]
+            frame = frame.select_columns_by_position(keep)
+            result = type(self)(frame)
+        if index is not None:
+            idx_list = list(index) if hasattr(index, "__iter__") and not isinstance(index, (str, tuple)) else [index]
+            current = frame.index
+            mask = ~current.isin(idx_list)
+            frame = frame.filter_rows_mask(np.asarray(mask))
+            result = type(self)(frame)
+        return result
+
+    def concat(self, axis: int, other: Any, join: str = "outer", ignore_index: bool = False, sort: bool = False, **kwargs: Any) -> "TpuQueryCompiler":
+        if not isinstance(other, (list, tuple)):
+            other = [other]
+        if axis == 0 and all(isinstance(o, TpuQueryCompiler) for o in other):
+            frames = [o._modin_frame for o in other]
+            base = self._modin_frame
+            if all(
+                f.columns.equals(base.columns)
+                and list(f.dtypes) == list(base.dtypes)
+                for f in frames
+            ):
+                result = base.concat_rows(frames)
+                qc = type(self)(result)
+                if ignore_index:
+                    qc._modin_frame._index = LazyIndex(
+                        pandas.RangeIndex(len(result)), len(result)
+                    )
+                return qc
+        return super().concat(axis, other, join=join, ignore_index=ignore_index, sort=sort, **kwargs)
+
+    def columnarize(self) -> "TpuQueryCompiler":
+        result = super().columnarize()
+        return result
+
+    def repartition(self, axis: Any = None) -> "TpuQueryCompiler":
+        return self
+
+    def get_pandas_backend(self) -> Optional[str]:
+        return None

@@ -1,0 +1,72 @@
+"""Centralized user-facing warnings and defensive assertions.
+
+Reference design: /root/reference/modin/error_message.py:57,83.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import NoReturn
+
+
+class ErrorMessage:
+    printed_default_to_pandas = False
+    printed_warnings: set = set()
+
+    @classmethod
+    def not_implemented(cls, message: str = "") -> NoReturn:
+        if message == "":
+            message = "This functionality is not yet available in modin_tpu."
+        raise NotImplementedError(message)
+
+    @classmethod
+    def single_warning(cls, message: str) -> None:
+        message_hash = hash(message)
+        if message_hash in cls.printed_warnings:
+            return
+        warnings.warn(message)
+        cls.printed_warnings.add(message_hash)
+
+    @classmethod
+    def default_to_pandas(cls, message: str = "", reason: str = "") -> None:
+        if message != "":
+            message = f"{message} defaulting to in-process pandas implementation."
+        else:
+            message = "Defaulting to in-process pandas implementation."
+        if reason:
+            message += f" Reason: {reason}"
+        if not cls.printed_default_to_pandas:
+            message += (
+                "\nThis warning is shown once per session. The operation runs on the "
+                "host CPU instead of the TPU; results are identical but unsharded."
+            )
+            cls.printed_default_to_pandas = True
+        warnings.warn(message)
+
+    @classmethod
+    def catch_bugs_and_request_email(
+        cls, failure_condition: bool, extra_log: str = ""
+    ) -> None:
+        if failure_condition:
+            raise Exception(
+                "Internal modin_tpu error — please file an issue with this trace. "
+                + extra_log
+            )
+
+    @classmethod
+    def non_verified_udf(cls) -> None:
+        warnings.warn(
+            "User-defined function verification is still under development in "
+            "modin_tpu. The function provided is not verified."
+        )
+
+    @classmethod
+    def mismatch_with_pandas(cls, operation: str, message: str) -> None:
+        cls.single_warning(
+            f"`{operation}` implementation has mismatches with pandas:\n{message}."
+        )
+
+    @classmethod
+    def missmatch_with_pandas(cls, operation: str, message: str) -> None:
+        # Kept for reference-name compatibility (modin/error_message.py misspelling).
+        cls.mismatch_with_pandas(operation, message)

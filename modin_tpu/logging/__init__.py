@@ -1,0 +1,16 @@
+"""Tracing, metrics and class-level instrumentation.
+
+Reference design: /root/reference/modin/logging/__init__.py.
+"""
+
+from modin_tpu.logging.class_logger import ClassLogger  # noqa: F401
+from modin_tpu.logging.config import get_logger  # noqa: F401
+from modin_tpu.logging.logger_decorator import (  # noqa: F401
+    disable_logging,
+    enable_logging,
+)
+from modin_tpu.logging.metrics import (  # noqa: F401
+    add_metric_handler,
+    clear_metric_handler,
+    emit_metric,
+)

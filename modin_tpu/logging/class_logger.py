@@ -1,0 +1,35 @@
+"""``ClassLogger`` mixin — auto-wraps all methods of a subclass with tracing.
+
+Reference design: /root/reference/modin/logging/class_logger.py:26.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from modin_tpu.logging.logger_decorator import enable_logging
+
+
+class ClassLogger:
+    """Ensure all subclass methods are traced under a ``modin_layer`` tag.
+
+    Example::
+
+        class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
+            ...
+    """
+
+    _modin_logging_layer = "DEFAULT"
+
+    @classmethod
+    def __init_subclass__(
+        cls,
+        modin_layer: Optional[str] = None,
+        class_name: Optional[str] = None,
+        log_level: str = "info",
+        **kwargs: Dict,
+    ) -> None:
+        super().__init_subclass__(**kwargs)
+        modin_layer = modin_layer or cls._modin_logging_layer
+        cls._modin_logging_layer = modin_layer
+        enable_logging(modin_layer, class_name, log_level)(cls)

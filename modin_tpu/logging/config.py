@@ -1,0 +1,139 @@
+"""Log-file setup and the memory/TPU-memory profiling sampler thread.
+
+Reference design: /root/reference/modin/logging/config.py:112-220 — a rotating
+job-scoped trace log plus a daemon thread sampling process RSS.  The TPU build
+additionally samples live device memory from jax when available.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import logging
+import logging.handlers
+import pathlib
+import platform
+import threading
+import time
+import uuid
+
+import pandas
+import numpy
+
+import modin_tpu
+from modin_tpu.config import LogFileSize, LogMemoryInterval, LogMode
+
+__LOGGER_CONFIGURED__: bool = False
+
+
+class ModinFormatter(logging.Formatter):
+    """Microsecond-resolution UTC timestamps."""
+
+    def formatTime(self, record, datefmt=None):
+        ct = dt.datetime.fromtimestamp(record.created, dt.timezone.utc)
+        if datefmt:
+            return ct.strftime(datefmt)
+        return ct.strftime("%Y-%m-%d %H:%M:%S.%f")
+
+
+def bytes_int_to_str(num_bytes: int, suffix: str = "B") -> str:
+    factor = 1000
+    for unit in ["", "K", "M", "G", "T", "P"]:
+        if num_bytes < factor:
+            return f"{num_bytes:.2f}{unit}{suffix}"
+        num_bytes /= factor
+    return f"{num_bytes * factor:.2f}P{suffix}"
+
+
+def _create_logger(
+    namespace: str, job_id: str, log_name: str, log_level: int
+) -> logging.Logger:
+    logger = logging.getLogger(namespace)
+    logdir = pathlib.Path(".modin_tpu") / "logs" / f"job_{job_id}"
+    logdir.mkdir(parents=True, exist_ok=True)
+    log_filename = logdir / f"{log_name}.log"
+    handler = logging.handlers.RotatingFileHandler(
+        filename=log_filename,
+        backupCount=10,
+        maxBytes=LogFileSize.get() * int(1e6),
+    )
+    handler.setFormatter(
+        ModinFormatter(fmt="%(process)d, %(thread)d, %(asctime)s, %(message)s")
+    )
+    logger.addHandler(handler)
+    logger.setLevel(log_level)
+    return logger
+
+
+def configure_logging() -> None:
+    """Create the trace logger and start the memory sampler (idempotent)."""
+    global __LOGGER_CONFIGURED__
+    logger = logging.getLogger("modin_tpu.logger")
+    job_id = uuid.uuid4().hex
+    log_filename = f"trace__{platform.node()}"
+
+    log_level = logging.INFO if LogMode.get() == "Enable_Api_Only" else logging.DEBUG
+    logger = _create_logger("modin_tpu.logger", job_id, log_filename, log_level)
+
+    logger.info(f"OS Version: {platform.platform()}")
+    logger.info(f"Python Version: {platform.python_version()}")
+    logger.info(f"Modin-TPU Version: {modin_tpu.__version__}")
+    logger.info(f"Pandas Version: {pandas.__version__}")
+    logger.info(f"Numpy Version: {numpy.__version__}")
+    try:
+        import jax
+
+        logger.info(f"JAX Version: {jax.__version__}")
+        logger.info(f"Devices: {[str(d) for d in jax.devices()]}")
+    except Exception:
+        pass
+
+    if LogMode.get() != "Enable_Api_Only":
+        mem_sleep = LogMemoryInterval.get()
+        mem = _create_logger(
+            "modin_tpu_memory.logger", job_id, "memory", logging.DEBUG
+        )
+        mem_sampler = threading.Thread(
+            target=memory_thread, args=[mem, mem_sleep], daemon=True
+        )
+        mem_sampler.start()
+
+    __LOGGER_CONFIGURED__ = True
+
+
+def memory_thread(logger: logging.Logger, sleep_time: int) -> None:
+    """Sample host RSS and (if available) device HBM usage forever."""
+    while True:
+        rss = _process_rss_bytes()
+        if rss is not None:
+            logger.info(f"Host Memory RSS: {bytes_int_to_str(rss)}")
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                stats = getattr(d, "memory_stats", lambda: None)()
+                if stats and "bytes_in_use" in stats:
+                    logger.info(
+                        f"Device {d.id} HBM in use: "
+                        f"{bytes_int_to_str(stats['bytes_in_use'])}"
+                    )
+        except Exception:
+            pass
+        time.sleep(sleep_time)
+
+
+def _process_rss_bytes():
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import resource
+
+        return pages * resource.getpagesize()
+    except Exception:
+        return None
+
+
+def get_logger(namespace: str = "modin_tpu.logger") -> logging.Logger:
+    """Get the configured trace logger, configuring on first use."""
+    if not __LOGGER_CONFIGURED__ and LogMode.get() != "Disable":
+        configure_logging()
+    return logging.getLogger(namespace)

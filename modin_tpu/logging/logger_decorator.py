@@ -1,0 +1,108 @@
+"""``enable_logging`` — the START/STOP trace + metrics decorator.
+
+Reference design: /root/reference/modin/logging/logger_decorator.py:55-69 — every
+significant method logs ``START::<layer>::<name>`` / ``STOP::…`` when LogMode is
+enabled, and API-layer calls emit timing metrics.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from functools import wraps
+from types import FunctionType, MethodType
+from typing import Any, Callable, Optional, Union
+
+from modin_tpu.config import LogMode, MetricsMode
+from modin_tpu.logging.config import get_logger
+from modin_tpu.logging.metrics import emit_metric
+
+_MODIN_LOGGER_NOWRAP = "__modin_logging_nowrap__"
+
+
+def disable_logging(func: Callable) -> Callable:
+    """Mark a function to never be wrapped by ``enable_logging``."""
+    setattr(func, _MODIN_LOGGER_NOWRAP, True)
+    return func
+
+
+def enable_logging(
+    modin_layer: Union[str, Callable, classmethod, staticmethod] = "PANDAS-API",
+    name: Optional[str] = None,
+    log_level: str = "info",
+) -> Callable:
+    """Wrap a callable with START/STOP trace logging and timing metrics.
+
+    Usable both as ``@enable_logging`` and ``@enable_logging("LAYER")``.
+    """
+    if isinstance(modin_layer, (FunctionType, MethodType, classmethod, staticmethod)):
+        return enable_logging()(modin_layer)
+
+    def decorator(obj: Any) -> Any:
+        if isinstance(obj, classmethod):
+            return classmethod(decorator(obj.__func__))
+        if isinstance(obj, staticmethod):
+            return staticmethod(decorator(obj.__func__))
+        if isinstance(obj, type):
+            seen: dict = {}
+            for attr_name, attr_value in vars(obj).items():
+                if isinstance(
+                    attr_value, (FunctionType, MethodType, classmethod, staticmethod)
+                ) and not hasattr(attr_value, _MODIN_LOGGER_NOWRAP):
+                    try:
+                        wrapped = seen.setdefault(
+                            attr_value,
+                            enable_logging(modin_layer, f"{obj.__name__}.{attr_name}")(
+                                attr_value
+                            ),
+                        )
+                        setattr(obj, attr_name, wrapped)
+                    except (TypeError, AttributeError):
+                        pass
+            return obj
+
+        assert isinstance(modin_layer, str), "modin_layer is somehow not a string!"
+        log_name = name or getattr(obj, "__qualname__", repr(obj))
+        log_name = re.sub(r"[^a-zA-Z0-9\-_\.]", "_", log_name)
+        full_name = f"{modin_layer}::{log_name}"
+        is_api_layer = modin_layer.upper() in ("PANDAS-API", "NUMPY-API", "POLARS-API")
+
+        @wraps(obj)
+        def run_and_log(*args: Any, **kwargs: Any) -> Any:
+            mode = LogMode.get()
+            metrics_on = MetricsMode.get() == "Enable" and is_api_layer
+            if mode == "Disable" and not metrics_on:
+                return obj(*args, **kwargs)
+            if mode == "Enable_Api_Only" and not is_api_layer and not metrics_on:
+                return obj(*args, **kwargs)
+
+            logger = get_logger() if mode != "Disable" else None
+            if logger is not None and not (
+                mode == "Enable_Api_Only" and not is_api_layer
+            ):
+                getattr(logger, log_level)(f"START::{full_name}")
+            start = time.perf_counter()
+            try:
+                result = obj(*args, **kwargs)
+            except BaseException as err:
+                if logger is not None:
+                    get_logger("modin_tpu.logger.errors").exception(
+                        f"STOP::{full_name}", exc_info=err
+                    )
+                raise
+            finally:
+                elapsed = time.perf_counter() - start
+                if metrics_on:
+                    emit_metric(
+                        f"pandas-api.{log_name.lower().replace('.', '_', 1)}", elapsed
+                    )
+            if logger is not None and not (
+                mode == "Enable_Api_Only" and not is_api_layer
+            ):
+                getattr(logger, log_level)(f"STOP::{full_name}")
+            return result
+
+        setattr(run_and_log, _MODIN_LOGGER_NOWRAP, True)
+        return run_and_log
+
+    return decorator

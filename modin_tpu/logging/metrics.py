@@ -1,0 +1,37 @@
+"""API timing metrics: named-handler fan-out, isolated from handler failures.
+
+Reference design: /root/reference/modin/logging/metrics.py:33-70.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Union
+
+from modin_tpu.config import MetricsMode
+
+_metric_handlers: list = []
+_metric_name_pattern = re.compile(r"^[a-zA-Z0-9\-_\.]+$")
+
+
+def emit_metric(name: str, value: Union[int, float]) -> None:
+    """Send ``modin_tpu.<name> = value`` to every registered handler."""
+    if MetricsMode.get() == "Disable":
+        return
+    if not _metric_name_pattern.fullmatch(name):
+        raise KeyError(f"Metrics name is not in metric-name dot format, e.g. a.b.c : {name}")
+    for fn in list(_metric_handlers):
+        try:
+            fn(f"modin_tpu.{name}", value)
+        except Exception:
+            # a broken handler must never break the API call it instruments
+            _metric_handlers.remove(fn)
+
+
+def add_metric_handler(handler: Callable[[str, Union[int, float]], None]) -> None:
+    _metric_handlers.append(handler)
+
+
+def clear_metric_handler(handler: Callable[[str, Union[int, float]], None]) -> None:
+    if handler in _metric_handlers:
+        _metric_handlers.remove(handler)

@@ -1,0 +1,187 @@
+"""``modin_tpu.pandas`` — the drop-in pandas namespace.
+
+Reference design: /root/reference/modin/pandas/__init__.py:14-213 — re-export
+the full pandas namespace, substituting the distributed DataFrame/Series and
+factory-dispatched IO functions; pass everything else through to pandas.
+"""
+
+from __future__ import annotations
+
+import pandas
+
+__pandas_version__ = pandas.__version__
+
+# --- pass-through re-exports (types, dtypes, options, utilities) ---------- #
+from pandas import (  # noqa: F401
+    NA,
+    ArrowDtype,
+    BooleanDtype,
+    Categorical,
+    CategoricalDtype,
+    CategoricalIndex,
+    DateOffset,
+    DatetimeIndex,
+    DatetimeTZDtype,
+    Flags,
+    Float32Dtype,
+    Float64Dtype,
+    Grouper,
+    Index,
+    IndexSlice,
+    Int8Dtype,
+    Int16Dtype,
+    Int32Dtype,
+    Int64Dtype,
+    Interval,
+    IntervalDtype,
+    IntervalIndex,
+    MultiIndex,
+    NamedAgg,
+    NaT,
+    Period,
+    PeriodDtype,
+    PeriodIndex,
+    RangeIndex,
+    SparseDtype,
+    StringDtype,
+    Timedelta,
+    TimedeltaIndex,
+    Timestamp,
+    UInt8Dtype,
+    UInt16Dtype,
+    UInt32Dtype,
+    UInt64Dtype,
+    api,
+    array,
+    arrays,
+    describe_option,
+    eval,
+    get_option,
+    infer_freq,
+    option_context,
+    options,
+    reset_option,
+    set_eng_float_format,
+    set_option,
+    test,
+    testing,
+)
+
+import os
+
+from modin_tpu.config import Engine
+
+_is_first_update = {}
+
+
+def _initialize_engine(engine_cls) -> None:
+    """Lazy one-time engine startup on first factory touch.
+
+    Reference design: modin/pandas/__init__.py:121-151.
+    """
+    engine = engine_cls.get()
+    if engine in engine_cls.NOINIT_ENGINES:
+        return
+    if _is_first_update.get(engine, True):
+        _is_first_update[engine] = False
+        if engine == "Jax":
+            from modin_tpu.parallel.engine import initialize_jax
+
+            initialize_jax()
+        else:
+            raise ValueError(f"Unknown engine: {engine}")
+
+
+# --- the distributed API surface ----------------------------------------- #
+from modin_tpu.pandas.dataframe import DataFrame  # noqa: E402,F401
+from modin_tpu.pandas.series import Series  # noqa: E402,F401
+from modin_tpu.pandas.general import (  # noqa: E402,F401
+    bdate_range,
+    concat,
+    crosstab,
+    cut,
+    date_range,
+    factorize,
+    from_dummies,
+    get_dummies,
+    interval_range,
+    isna,
+    isnull,
+    json_normalize,
+    lreshape,
+    melt,
+    merge,
+    merge_asof,
+    merge_ordered,
+    notna,
+    notnull,
+    period_range,
+    pivot,
+    pivot_table,
+    qcut,
+    timedelta_range,
+    to_datetime,
+    to_numeric,
+    to_timedelta,
+    unique,
+    value_counts,
+    wide_to_long,
+)
+from modin_tpu.pandas.io import (  # noqa: E402,F401
+    ExcelFile,
+    HDFStore,
+    read_clipboard,
+    read_csv,
+    read_excel,
+    read_feather,
+    read_fwf,
+    read_hdf,
+    read_html,
+    read_json,
+    read_orc,
+    read_parquet,
+    read_pickle,
+    read_sas,
+    read_spss,
+    read_sql,
+    read_sql_query,
+    read_sql_table,
+    read_stata,
+    read_table,
+    read_xml,
+    to_pickle,
+)
+from modin_tpu.pandas.plotting import Plotting as plotting  # noqa: E402,F401
+
+__all__ = [  # noqa: F405
+    "DataFrame", "Series", "read_csv", "read_parquet", "read_json",
+    "read_html", "read_clipboard", "read_excel", "read_hdf", "read_feather",
+    "read_stata", "read_sas", "read_pickle", "read_sql", "read_fwf",
+    "read_sql_table", "read_sql_query", "read_spss", "read_orc", "read_xml",
+    "read_table", "to_pickle", "concat", "eval", "unique", "value_counts",
+    "cut", "to_numeric", "factorize", "qcut", "to_datetime", "get_dummies",
+    "isna", "isnull", "merge", "pivot_table", "date_range", "Index",
+    "MultiIndex", "Series", "bdate_range", "period_range", "DatetimeIndex",
+    "to_timedelta", "set_eng_float_format", "options", "set_option",
+    "get_option", "reset_option", "option_context", "CategoricalIndex",
+    "Timedelta", "Timestamp", "NaT", "PeriodIndex", "Categorical", "__version__",
+    "melt", "crosstab", "plotting", "Interval", "UInt8Dtype", "UInt16Dtype",
+    "UInt32Dtype", "UInt64Dtype", "SparseDtype", "Int8Dtype", "Int16Dtype",
+    "Int32Dtype", "Int64Dtype", "CategoricalDtype", "DatetimeTZDtype",
+    "IntervalDtype", "PeriodDtype", "BooleanDtype", "StringDtype", "NA",
+    "RangeIndex", "TimedeltaIndex", "IntervalIndex", "IndexSlice",
+    "Grouper", "array", "Period", "DateOffset", "timedelta_range",
+    "infer_freq", "interval_range", "ExcelFile", "describe_option",
+    "notnull", "notna", "pivot", "test", "api", "lreshape", "wide_to_long",
+    "merge_asof", "merge_ordered", "json_normalize", "NamedAgg", "from_dummies",
+]
+
+__version__ = pandas.__version__
+
+
+def __getattr__(name: str):
+    """Forward anything else to pandas (reference: extensions __getattr__)."""
+    try:
+        return getattr(pandas, name)
+    except AttributeError:
+        raise AttributeError(f"module 'modin_tpu.pandas' has no attribute '{name}'")

@@ -1,0 +1,66 @@
+"""``df.modin`` accessor: conversions and backend introspection.
+
+Reference design: /root/reference/modin/pandas/accessor.py (ModinAPI).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from modin_tpu.logging import ClassLogger
+
+
+class ModinAPI(ClassLogger, modin_layer="PANDAS-API"):
+    """Namespace of modin_tpu-specific functionality on DataFrame/Series."""
+
+    def __init__(self, data: Any) -> None:
+        self._data = data
+
+    def to_pandas(self):
+        """Materialize to a plain pandas object on the host."""
+        return self._data._to_pandas()
+
+    def get_backend(self) -> str:
+        """Name of the backend currently holding this object's data."""
+        return self._data._query_compiler.get_backend()
+
+    def set_backend(self, backend: str, inplace: bool = False):
+        """Move this object's data to another backend (e.g. 'Tpu' <-> 'Pandas')."""
+        from modin_tpu.config import Backend
+        from modin_tpu.core.execution.dispatching.factories import factories
+        from modin_tpu.utils import get_current_execution
+
+        execution = Backend.get_execution_for_backend(backend)
+        factory_name = f"{execution.storage_format}On{execution.engine}Factory"
+        factory = getattr(factories, factory_name)
+        factory.prepare()
+        new_qc = factory.io_cls.from_pandas(self._data._query_compiler.to_pandas())
+        new_qc._shape_hint = self._data._query_compiler._shape_hint
+        return self._data._create_or_update_from_compiler(new_qc, inplace)
+
+    def to_device(self, inplace: bool = False):
+        """Move to the TPU (sharded jax.Array) backend."""
+        return self.set_backend("Tpu", inplace=inplace)
+
+    def to_host(self, inplace: bool = False):
+        """Move to the in-process pandas backend."""
+        return self.set_backend("Pandas", inplace=inplace)
+
+    def repartition(self, axis: Any = None):
+        """Rebalance the on-device sharding (no-op for host backends)."""
+        return self._data._create_or_update_from_compiler(
+            self._data._query_compiler.repartition(axis=axis)
+        )
+
+
+class CachedAccessor:
+    """Custom property-like object for accessor namespaces."""
+
+    def __init__(self, name: str, accessor: type) -> None:
+        self._name = name
+        self._accessor = accessor
+
+    def __get__(self, obj: Any, cls: Any):
+        if obj is None:
+            return self._accessor
+        return self._accessor(obj)

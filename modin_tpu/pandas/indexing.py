@@ -1,0 +1,266 @@
+"""``loc``/``iloc``/``at``/``iat`` indexers.
+
+Reference design: /root/reference/modin/pandas/indexing.py (_LocationIndexerBase
+:283, _LocIndexer :698, _iLocIndexer :1059): label keys are converted to
+positions on the host (the index is host metadata), then a single
+``take_2d_positional`` runs on the storage format.  Exotic cases (MultiIndex
+partial keys, enlargement setitem) default to pandas.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import numpy as np
+import pandas
+from pandas.api.types import is_bool_dtype, is_list_like
+from pandas.core.dtypes.common import is_bool, is_integer
+
+from modin_tpu.logging import ClassLogger
+from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
+
+
+def is_boolean_array(x: Any) -> bool:
+    if isinstance(x, (np.ndarray, pandas.Series, pandas.Index)):
+        return is_bool_dtype(x.dtype)
+    from modin_tpu.pandas.series import Series
+
+    if isinstance(x, Series):
+        return is_bool_dtype(x.dtype)
+    return isinstance(x, (list, tuple)) and len(x) > 0 and all(is_bool(v) for v in x)
+
+
+def is_integer_array(x: Any) -> bool:
+    if isinstance(x, (np.ndarray, pandas.Series, pandas.Index)):
+        return x.dtype.kind in "iu"
+    return isinstance(x, (list, tuple)) and len(x) > 0 and all(is_integer(v) for v in x)
+
+
+class _LocationIndexerBase(ClassLogger, modin_layer="PANDAS-API"):
+    def __init__(self, modin_df: Any):
+        self.df = modin_df
+        self.qc = modin_df._query_compiler
+
+    def _fallback_get(self, key: Any, attr: str) -> Any:
+        return self.df._default_to_pandas(lambda obj: getattr(obj, attr)[key])
+
+    def _fallback_set(self, key: Any, value: Any, attr: str) -> None:
+        from modin_tpu.utils import try_cast_to_pandas
+
+        value = try_cast_to_pandas(value)
+
+        def setter(obj):
+            obj = obj.copy()
+            getattr(obj, attr)[key] = value
+            return obj
+
+        result = self.df._default_to_pandas(setter)
+        self.df._update_inplace(result._query_compiler)
+
+    def _wrap_row_series(self, row_qc: Any, label: Any) -> Any:
+        """One selected row -> Series indexed by columns."""
+        from modin_tpu.pandas.series import Series
+
+        pandas_df = row_qc.to_pandas()
+        row_series = pandas_df.iloc[0]
+        row_series.name = label
+        return self.df._wrap_pandas(row_series)
+
+
+class _iLocIndexer(_LocationIndexerBase):
+    def __getitem__(self, key: Any) -> Any:
+        from modin_tpu.pandas.dataframe import DataFrame
+        from modin_tpu.pandas.series import Series
+
+        if callable(key):
+            return self.__getitem__(key(self.df))
+        ndim = self.df.ndim
+        if isinstance(key, tuple) and ndim == 2:
+            if len(key) > 2:
+                raise pandas.errors.IndexingError("Too many indexers")
+            row_key = key[0]
+            col_key = key[1] if len(key) > 1 else slice(None)
+        else:
+            row_key, col_key = key, slice(None)
+            if isinstance(row_key, tuple) and ndim == 1:
+                if len(row_key) > 1:
+                    raise pandas.errors.IndexingError("Too many indexers")
+                row_key = row_key[0] if row_key else slice(None)
+
+        nrows = len(self.df.index)
+        row_scalar = is_integer(row_key)
+        col_scalar = is_integer(col_key)
+
+        row_pos = self._positions(row_key, nrows, "row")
+        if ndim == 1:
+            if row_scalar:
+                return self.df._to_pandas().iloc[row_key]
+            new_qc = self.qc.take_2d_positional(index=row_pos)
+            new_qc._shape_hint = "column"
+            return Series(query_compiler=new_qc)
+
+        ncols = len(self.df.columns)
+        col_pos = self._positions(col_key, ncols, "column")
+        if row_scalar and col_scalar:
+            sub = self.qc.take_2d_positional(index=row_pos, columns=col_pos)
+            return sub.to_pandas().iloc[0, 0]
+        new_qc = self.qc.take_2d_positional(index=row_pos, columns=col_pos)
+        if row_scalar:
+            return self._wrap_row_series(new_qc, self.df.index[row_key])
+        if col_scalar:
+            new_qc._shape_hint = "column"
+            return Series(query_compiler=new_qc)
+        return DataFrame(query_compiler=new_qc)
+
+    def _positions(self, axis_key: Any, length: int, axis_name: str) -> Any:
+        if isinstance(axis_key, slice):
+            return axis_key
+        if is_integer(axis_key):
+            if axis_key < -length or axis_key >= length:
+                raise IndexError(
+                    f"single positional indexer is out-of-bounds"
+                )
+            pos = axis_key if axis_key >= 0 else length + axis_key
+            return [pos]
+        if is_boolean_array(axis_key):
+            mask = np.asarray(axis_key)
+            if len(mask) != length:
+                raise IndexError(
+                    f"Boolean index has wrong length: {len(mask)} instead of {length}"
+                )
+            return list(np.nonzero(mask)[0])
+        if is_list_like(axis_key):
+            arr = np.asarray(axis_key, dtype=np.int64).ravel()
+            if len(arr) and (arr.max(initial=-1) >= length or arr.min(initial=0) < -length):
+                raise IndexError("positional indexers are out-of-bounds")
+            return [int(i) if i >= 0 else length + int(i) for i in arr]
+        raise TypeError(f"Cannot index by location index with a key of type {type(axis_key)}")
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._fallback_set(key, value, "iloc")
+
+
+class _LocIndexer(_LocationIndexerBase):
+    def __getitem__(self, key: Any) -> Any:
+        from modin_tpu.pandas.dataframe import DataFrame
+        from modin_tpu.pandas.series import Series
+
+        if callable(key):
+            return self.__getitem__(key(self.df))
+        ndim = self.df.ndim
+        index = self.df.index
+        if isinstance(index, pandas.MultiIndex):
+            return self._fallback_get(key, "loc")
+        if isinstance(key, tuple) and ndim == 2:
+            if len(key) > 2:
+                raise pandas.errors.IndexingError("Too many indexers")
+            row_key = key[0]
+            col_key = key[1] if len(key) > 1 else slice(None)
+        else:
+            row_key, col_key = key, slice(None)
+
+        if ndim == 2 and isinstance(self.df.columns, pandas.MultiIndex):
+            return self._fallback_get(key, "loc")
+        if isinstance(row_key, DataFrame) or (
+            ndim == 2 and isinstance(col_key, DataFrame)
+        ):
+            return self._fallback_get(key, "loc")
+
+        try:
+            row_pos, row_scalar, row_label = self._label_positions(row_key, index)
+        except _FallbackToPandas:
+            return self._fallback_get(key, "loc")
+
+        if ndim == 1:
+            if row_scalar:
+                sub = self.qc.take_2d_positional(index=row_pos)
+                return sub.to_pandas().iloc[0, 0]
+            new_qc = self.qc.take_2d_positional(index=row_pos)
+            new_qc._shape_hint = "column"
+            return Series(query_compiler=new_qc)
+
+        columns = self.df.columns
+        try:
+            col_pos, col_scalar, col_label = self._label_positions(col_key, columns)
+        except _FallbackToPandas:
+            return self._fallback_get(key, "loc")
+
+        new_qc = self.qc.take_2d_positional(index=row_pos, columns=col_pos)
+        if row_scalar and col_scalar:
+            return new_qc.to_pandas().iloc[0, 0]
+        if row_scalar:
+            return self._wrap_row_series(new_qc, row_label)
+        if col_scalar:
+            new_qc._shape_hint = "column"
+            return Series(query_compiler=new_qc)
+        return DataFrame(query_compiler=new_qc)
+
+    def _label_positions(self, axis_key: Any, labels: pandas.Index):
+        """Return (positions, is_scalar, scalar_label); raise _FallbackToPandas."""
+        from modin_tpu.pandas.series import Series
+
+        if isinstance(axis_key, slice):
+            if axis_key == slice(None):
+                return axis_key, False, None
+            try:
+                start, stop = labels.slice_locs(axis_key.start, axis_key.stop, axis_key.step)
+            except Exception:
+                raise _FallbackToPandas()
+            return slice(start, stop, axis_key.step), False, None
+        if isinstance(axis_key, Series):
+            if is_bool_dtype(axis_key.dtype):
+                axis_key = axis_key._to_pandas()
+            else:
+                axis_key = axis_key.to_numpy()
+        if isinstance(axis_key, pandas.Series):
+            if is_bool_dtype(axis_key.dtype):
+                axis_key = axis_key.reindex(labels).fillna(False).to_numpy()
+            else:
+                axis_key = axis_key.to_numpy()
+        if is_boolean_array(axis_key):
+            mask = np.asarray(axis_key)
+            if len(mask) != len(labels):
+                raise IndexError(
+                    f"Boolean index has wrong length: {len(mask)} instead of {len(labels)}"
+                )
+            return list(np.nonzero(mask)[0]), False, None
+        if is_list_like(axis_key) and not isinstance(axis_key, tuple):
+            keys = list(axis_key)
+            positions = labels.get_indexer_for(keys)
+            if (np.asarray(positions) == -1).any():
+                missing = [k for k, p in zip(keys, positions) if p == -1]
+                raise KeyError(f"{missing} not in index")
+            return list(positions), False, None
+        # scalar label
+        try:
+            loc = labels.get_loc(axis_key)
+        except (KeyError, TypeError):
+            raise KeyError(axis_key)
+        if isinstance(loc, slice):
+            return loc, False, None
+        if isinstance(loc, np.ndarray):
+            return list(np.nonzero(loc)[0]) if loc.dtype == bool else list(loc), False, None
+        return [int(loc)], True, axis_key
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._fallback_set(key, value, "loc")
+
+
+class _FallbackToPandas(Exception):
+    pass
+
+
+class _AtIndexer(_LocationIndexerBase):
+    def __getitem__(self, key: Any) -> Any:
+        return self.df.loc[key]
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._fallback_set(key, value, "at")
+
+
+class _iAtIndexer(_LocationIndexerBase):
+    def __getitem__(self, key: Any) -> Any:
+        return self.df.iloc[key]
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._fallback_set(key, value, "iat")

@@ -1,0 +1,134 @@
+"""All ``read_*`` entry points, dispatched through the factory system.
+
+Reference design: /root/reference/modin/pandas/io.py (1,272 LoC; the ``_read``
+indirection at io.py:106-134).
+"""
+
+from __future__ import annotations
+
+import inspect
+import pickle
+from typing import Any
+
+import pandas
+
+from modin_tpu.error_message import ErrorMessage
+from modin_tpu.logging import enable_logging
+from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL, expanduser_path_arg
+
+
+def _read(reader_name: str, **kwargs: Any) -> Any:
+    """Route a read_* call through the current factory and wrap the result."""
+    from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+        FactoryDispatcher,
+    )
+    from modin_tpu.pandas.dataframe import DataFrame
+    from modin_tpu.pandas.series import Series
+
+    result = getattr(FactoryDispatcher, reader_name)(**kwargs)
+
+    def wrap(qc: Any) -> Any:
+        if hasattr(qc, "to_pandas"):
+            if qc._shape_hint == "column":
+                return Series(query_compiler=qc)
+            return DataFrame(query_compiler=qc)
+        return qc
+
+    if isinstance(result, dict):
+        return {k: wrap(v) for k, v in result.items()}
+    if isinstance(result, list):
+        return [wrap(v) for v in result]
+    return wrap(result)
+
+
+def _make_reader(name: str):
+    pandas_fn = getattr(pandas, name)
+    sig = inspect.signature(pandas_fn)
+
+    def reader(*args: Any, **kwargs: Any) -> Any:
+        bound = sig.bind(*args, **kwargs)
+        bound.apply_defaults()
+        params: dict = {}
+        for arg_name, value in bound.arguments.items():
+            kind = sig.parameters[arg_name].kind
+            if kind == inspect.Parameter.VAR_KEYWORD:
+                params.update(value)
+            elif kind == inspect.Parameter.VAR_POSITIONAL:
+                raise TypeError(
+                    f"{name} does not support extra positional arguments in modin_tpu"
+                )
+            else:
+                params[arg_name] = value
+        return _read(name, **params)
+
+    reader.__name__ = name
+    reader.__qualname__ = name
+    reader.__doc__ = pandas_fn.__doc__
+    reader = enable_logging(reader)
+    try:
+        reader.__signature__ = sig
+    except (ValueError, TypeError):
+        pass
+    return reader
+
+
+read_csv = _make_reader("read_csv")
+read_table = _make_reader("read_table")
+read_parquet = _make_reader("read_parquet")
+read_json = _make_reader("read_json")
+read_fwf = _make_reader("read_fwf")
+read_excel = _make_reader("read_excel")
+read_feather = _make_reader("read_feather")
+read_stata = _make_reader("read_stata")
+read_sas = _make_reader("read_sas")
+read_pickle = _make_reader("read_pickle")
+read_sql = _make_reader("read_sql")
+read_sql_query = _make_reader("read_sql_query")
+read_sql_table = _make_reader("read_sql_table")
+read_html = _make_reader("read_html")
+read_xml = _make_reader("read_xml")
+read_clipboard = _make_reader("read_clipboard")
+read_hdf = _make_reader("read_hdf")
+read_spss = _make_reader("read_spss")
+read_orc = _make_reader("read_orc")
+
+
+@enable_logging
+def to_pickle(obj: Any, filepath_or_buffer: Any, **kwargs: Any) -> None:
+    from modin_tpu.pandas.base import BasePandasDataset
+
+    if isinstance(obj, BasePandasDataset):
+        obj.to_pickle(filepath_or_buffer, **kwargs)
+        return
+    pandas.to_pickle(obj, filepath_or_buffer, **kwargs)
+
+
+@enable_logging
+def json_normalize(*args: Any, **kwargs: Any):
+    from modin_tpu.pandas.general import json_normalize as _json_normalize
+
+    return _json_normalize(*args, **kwargs)
+
+
+class ExcelFile(pandas.ExcelFile):
+    """Wrapper of pandas.ExcelFile whose ``parse`` returns modin_tpu frames."""
+
+    def parse(self, *args: Any, **kwargs: Any):
+        from modin_tpu.pandas.dataframe import DataFrame
+
+        result = super().parse(*args, **kwargs)
+        if isinstance(result, dict):
+            return {k: DataFrame(v) for k, v in result.items()}
+        return DataFrame(result)
+
+
+class HDFStore(pandas.HDFStore):
+    """Wrapper of pandas.HDFStore returning modin_tpu frames from get/select."""
+
+    def __getitem__(self, key: Any):
+        from modin_tpu.pandas.dataframe import DataFrame
+
+        result = super().__getitem__(key)
+        if isinstance(result, pandas.DataFrame):
+            return DataFrame(result)
+        return result

@@ -1,0 +1,85 @@
+"""``Resampler`` — lazy resample handle.
+
+Reference design: /root/reference/modin/pandas/resample.py (409 LoC).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pandas
+
+from modin_tpu.logging import ClassLogger
+from modin_tpu.utils import _inherit_docstrings
+
+
+@_inherit_docstrings(pandas.core.resample.Resampler)
+class Resampler(ClassLogger, modin_layer="PANDAS-API"):
+    def __init__(self, dataframe: Any, rule: Any, **kwargs: Any) -> None:
+        self._dataframe = dataframe
+        self.resample_kwargs = {"rule": rule, **kwargs}
+
+    @property
+    def _query_compiler(self):
+        return self._dataframe._query_compiler
+
+    def _agg(self, name: str, *args: Any, **kwargs: Any):
+        qc_method = getattr(self._query_compiler, f"resample_{name}")
+        new_qc = qc_method(_clean_kwargs(self.resample_kwargs), *args, **kwargs)
+        return self._wrap(new_qc)
+
+    def _wrap(self, qc: Any):
+        if not hasattr(qc, "to_pandas"):
+            return qc
+        if self._dataframe.ndim == 1:
+            from modin_tpu.pandas.series import Series
+
+            qc._shape_hint = "column"
+            return Series(query_compiler=qc)
+        from modin_tpu.pandas.dataframe import DataFrame
+
+        return DataFrame(query_compiler=qc)
+
+    def __getitem__(self, key: Any):
+        subset = self._dataframe[key]
+        return Resampler(subset, **self.resample_kwargs)
+
+    @property
+    def groups(self):
+        return self._dataframe._default_to_pandas(
+            lambda obj: obj.resample(**_clean_kwargs(self.resample_kwargs)).groups
+        )
+
+    @property
+    def indices(self):
+        return self._dataframe._default_to_pandas(
+            lambda obj: obj.resample(**_clean_kwargs(self.resample_kwargs)).indices
+        )
+
+    def get_group(self, name: Any):
+        return self._dataframe._wrap_pandas(
+            self._dataframe._to_pandas()
+            .resample(**_clean_kwargs(self.resample_kwargs))
+            .get_group(name)
+        )
+
+
+def _clean_kwargs(kwargs: dict) -> dict:
+    return {k: v for k, v in kwargs.items() if v is not None or k in ("rule",)}
+
+
+for _name in [
+    "count", "sum", "mean", "median", "var", "std", "min", "max", "sem",
+    "first", "last", "ohlc", "prod", "size", "nunique", "quantile",
+    "agg", "aggregate", "apply", "transform", "ffill", "bfill", "nearest",
+    "asfreq", "interpolate",
+]:
+
+    def _make_resample(name):
+        def method(self, *args: Any, **kwargs: Any):
+            return self._agg(name, *args, **kwargs)
+
+        method.__name__ = name
+        return method
+
+    setattr(Resampler, _name, _make_resample(_name))

@@ -1,0 +1,109 @@
+"""API-layer helpers: wrapping pandas objects into modin_tpu objects and back.
+
+Reference design: /root/reference/modin/pandas/utils.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pandas
+from pandas.util._decorators import doc
+
+from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
+
+
+def from_pandas(df: pandas.DataFrame):
+    """Convert a pandas DataFrame to a modin_tpu DataFrame on the current backend."""
+    from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+        FactoryDispatcher,
+    )
+    from modin_tpu.pandas import DataFrame
+
+    return DataFrame(query_compiler=FactoryDispatcher.from_pandas(df))
+
+
+def from_arrow(at: Any):
+    """Convert a pyarrow Table to a modin_tpu DataFrame."""
+    from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+        FactoryDispatcher,
+    )
+    from modin_tpu.pandas import DataFrame
+
+    return DataFrame(query_compiler=FactoryDispatcher.from_arrow(at))
+
+
+def from_non_pandas(df: Any, index: Any, columns: Any, dtype: Any):
+    """Try converting an arbitrary object via the engine's from_non_pandas hook."""
+    from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+        FactoryDispatcher,
+    )
+
+    new_qc = FactoryDispatcher.from_non_pandas(df, index, columns, dtype)
+    if new_qc is not None:
+        from modin_tpu.pandas import DataFrame
+
+        return DataFrame(query_compiler=new_qc)
+    return new_qc
+
+
+def from_dataframe(df: Any):
+    """Convert an interchange-protocol object to a modin_tpu DataFrame."""
+    from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+        FactoryDispatcher,
+    )
+    from modin_tpu.pandas import DataFrame
+
+    return DataFrame(query_compiler=FactoryDispatcher.from_interchange_dataframe(df))
+
+
+def is_scalar(obj: Any) -> bool:
+    """Whether obj is a scalar (never true for modin_tpu objects)."""
+    from pandas.api.types import is_scalar as pandas_is_scalar
+
+    from modin_tpu.pandas.base import BasePandasDataset
+
+    return not isinstance(obj, BasePandasDataset) and pandas_is_scalar(obj)
+
+
+def is_full_grab_slice(slc: slice, sequence_len: Any = None) -> bool:
+    """Whether the slice grabs the whole axis."""
+    assert isinstance(slc, slice), "slice object required"
+    return (
+        slc.start in (None, 0)
+        and slc.step in (None, 1)
+        and (
+            slc.stop is None
+            or (isinstance(slc.stop, int) and sequence_len is not None and slc.stop >= sequence_len)
+        )
+    )
+
+
+def from_modin_frame_to_mi(df: Any, sortorder: Any = None, names: Any = None):
+    """Create a pandas MultiIndex from a modin_tpu DataFrame."""
+    from modin_tpu.pandas import DataFrame
+
+    if isinstance(df, DataFrame):
+        df = df._to_pandas()
+    return pandas.MultiIndex.from_frame(df, sortorder=sortorder, names=names)
+
+
+def cast_function_modin2pandas(func: Any) -> Any:
+    """Replace a modin_tpu method reference with its pandas counterpart."""
+    if callable(func):
+        module = getattr(func, "__module__", "") or ""
+        if module.startswith("modin_tpu.pandas"):
+            name = func.__name__
+            if module.endswith("series"):
+                return getattr(pandas.Series, name, func)
+            return getattr(pandas.DataFrame, name, func)
+    return func
+
+
+SET_DATAFRAME_ATTRIBUTE_WARNING = (
+    "modin_tpu doesn't allow columns to be created via a new attribute name - see "
+    "https://pandas.pydata.org/pandas-docs/stable/indexing.html#attribute-access"
+)
+
+GET_BACKEND_DOC = "Get the current backend name for this object."
+SET_BACKEND_DOC = "Move this object's data to the named backend."

@@ -1,0 +1,159 @@
+"""``Rolling`` / ``Expanding`` window objects.
+
+Reference design: /root/reference/modin/pandas/window.py (526 LoC): a lazy
+handle (object, window kwargs) dispatching to ``rolling_*``/``expanding_*``
+query-compiler methods.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import pandas
+
+from modin_tpu.logging import ClassLogger
+from modin_tpu.utils import _inherit_docstrings
+
+_ROLLING_AGGS = [
+    "count", "sum", "mean", "median", "var", "std", "min", "max", "skew",
+    "kurt", "sem", "quantile", "rank",
+]
+
+
+@_inherit_docstrings(pandas.core.window.rolling.Rolling)
+class Rolling(ClassLogger, modin_layer="PANDAS-API"):
+    def __init__(self, dataframe: Any, **rolling_kwargs: Any) -> None:
+        self._dataframe = dataframe
+        self.rolling_kwargs = rolling_kwargs
+
+    @property
+    def _query_compiler(self):
+        return self._dataframe._query_compiler
+
+    def _agg(self, name: str, *args: Any, **kwargs: Any):
+        qc_method = getattr(self._query_compiler, f"rolling_{name}")
+        new_qc = qc_method(self.rolling_kwargs, *args, **kwargs)
+        return self._wrap(new_qc)
+
+    def _wrap(self, qc: Any):
+        if not hasattr(qc, "to_pandas"):
+            return qc
+        if self._dataframe.ndim == 1:
+            from modin_tpu.pandas.series import Series
+
+            qc._shape_hint = "column"
+            return Series(query_compiler=qc)
+        from modin_tpu.pandas.dataframe import DataFrame
+
+        return DataFrame(query_compiler=qc)
+
+    def aggregate(self, func: Any, *args: Any, **kwargs: Any):
+        return self._wrap(
+            self._query_compiler.rolling_aggregate(0, self.rolling_kwargs, func, *args, **kwargs)
+        )
+
+    agg = aggregate
+
+    def apply(self, func: Any, raw: bool = False, engine: Any = None, engine_kwargs: Any = None, args: Any = None, kwargs: Any = None):
+        return self._agg("apply", func=func, raw=raw, args=args or (), kwargs=kwargs or {})
+
+    def corr(self, other: Any = None, pairwise: Any = None, ddof: int = 1, **kwargs: Any):
+        from modin_tpu.utils import try_cast_to_pandas
+
+        return self._agg("corr", other=try_cast_to_pandas(other, squeeze=True), pairwise=pairwise, ddof=ddof, **kwargs)
+
+    def cov(self, other: Any = None, pairwise: Any = None, ddof: int = 1, **kwargs: Any):
+        from modin_tpu.utils import try_cast_to_pandas
+
+        return self._agg("cov", other=try_cast_to_pandas(other, squeeze=True), pairwise=pairwise, ddof=ddof, **kwargs)
+
+
+for _name in _ROLLING_AGGS:
+    if _name in ("corr", "cov"):
+        continue
+
+    def _make(name):
+        def method(self, *args: Any, **kwargs: Any):
+            return self._agg(name, *args, **kwargs)
+
+        method.__name__ = name
+        return method
+
+    setattr(Rolling, _name, _make(_name))
+
+
+@_inherit_docstrings(pandas.core.window.expanding.Expanding)
+class Expanding(ClassLogger, modin_layer="PANDAS-API"):
+    def __init__(self, dataframe: Any, min_periods: int = 1, method: str = "single") -> None:
+        self._dataframe = dataframe
+        self.expanding_args = [min_periods]
+
+    @property
+    def _query_compiler(self):
+        return self._dataframe._query_compiler
+
+    def _agg(self, name: str, *args: Any, **kwargs: Any):
+        qc_method = getattr(self._query_compiler, f"expanding_{name}")
+        new_qc = qc_method(self.expanding_args, *args, **kwargs)
+        return self._wrap(new_qc)
+
+    _wrap = Rolling._wrap
+
+    def aggregate(self, func: Any, *args: Any, **kwargs: Any):
+        return self._wrap(
+            self._query_compiler.expanding_aggregate(0, self.expanding_args, func, *args, **kwargs)
+        )
+
+    agg = aggregate
+
+
+for _name in [
+    "count", "sum", "mean", "median", "var", "std", "min", "max", "skew",
+    "kurt", "sem", "quantile", "rank", "apply", "corr", "cov",
+]:
+
+    def _make_exp(name):
+        def method(self, *args: Any, **kwargs: Any):
+            return self._agg(name, *args, **kwargs)
+
+        method.__name__ = name
+        return method
+
+    setattr(Expanding, _name, _make_exp(_name))
+
+
+class GroupByRolling(ClassLogger, modin_layer="PANDAS-API"):
+    """Rolling over groupby groups (``df.groupby(...).rolling(...)``)."""
+
+    def __init__(self, groupby: Any, window: Any, *args: Any, **kwargs: Any) -> None:
+        self._groupby = groupby
+        self._rolling_kwargs = {"window": window, **kwargs}
+
+    def _agg(self, name: str, *args: Any, **kwargs: Any):
+        gb = self._groupby
+        by, drop = gb._resolve_by()
+        qc = gb._query_compiler.groupby_rolling(
+            by=by,
+            agg_func=name,
+            axis=0,
+            groupby_kwargs=gb._kwargs,
+            rolling_kwargs=self._rolling_kwargs,
+            agg_args=args,
+            agg_kwargs=kwargs,
+            drop=drop,
+        )
+        from modin_tpu.pandas.dataframe import DataFrame
+
+        return DataFrame(query_compiler=qc)
+
+
+for _name in ["count", "sum", "mean", "median", "var", "std", "min", "max"]:
+
+    def _make_gbr(name):
+        def method(self, *args: Any, **kwargs: Any):
+            return self._agg(name, *args, **kwargs)
+
+        method.__name__ = name
+        return method
+
+    setattr(GroupByRolling, _name, _make_gbr(_name))

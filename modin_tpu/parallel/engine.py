@@ -1,0 +1,84 @@
+"""The JAX engine wrapper — the four-function engine contract.
+
+Reference design: the reference's entire engine abstraction is
+``XYWrapper.{deploy,put,materialize,wait}`` (SURVEY.md §2.3; e.g. RayWrapper at
+modin/core/execution/ray/common/engine_wrapper.py:59).  The TPU-native
+equivalents (SURVEY.md §5 "Distributed communication backend"):
+
+- ``deploy``      -> dispatch a jit-compiled computation (async by default;
+                     XLA queues the work on the device stream)
+- ``put``         -> ``jax.device_put`` with a target sharding
+- ``materialize`` -> ``jax.device_get`` (device -> host numpy)
+- ``wait``        -> ``block_until_ready``
+
+Collectives (psum/all_gather/ppermute/all_to_all over ICI) are emitted by XLA
+from sharded jnp programs; the shuffle subsystem uses them explicitly via
+shard_map (modin_tpu/parallel/shuffle.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterable, Optional
+
+from modin_tpu.config import BenchmarkMode, DeviceCount
+from modin_tpu.logging import ClassLogger
+
+
+def initialize_jax() -> None:
+    """One-time engine startup: enable x64, warm the backend, build the mesh."""
+    import jax
+
+    # pandas semantics are 64-bit; TPUs prefer 32-bit.  We enable x64 so
+    # int64/float64 frames round-trip exactly; hot kernels can downcast
+    # explicitly where the Float64Policy config allows it.
+    jax.config.update("jax_enable_x64", True)
+    from modin_tpu.parallel.mesh import get_mesh
+
+    get_mesh()
+
+
+class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
+    """Uniform engine API over jax dispatch and device buffers."""
+
+    @classmethod
+    def deploy(cls, func: Callable, f_args: tuple = (), f_kwargs: Optional[dict] = None, num_returns: int = 1) -> Any:
+        """Run ``func`` (usually jit-compiled); returns device buffers (futures:
+        jax arrays are async until materialized)."""
+        result = func(*f_args, **(f_kwargs or {}))
+        if BenchmarkMode.get():
+            cls.wait(result)
+        return result
+
+    @classmethod
+    def put(cls, data: Any, sharding: Any = None) -> Any:
+        """Host -> device transfer with an optional target sharding."""
+        import jax
+
+        if sharding is None:
+            from modin_tpu.parallel.mesh import row_sharding
+
+            sharding = row_sharding()
+        return jax.device_put(data, sharding)
+
+    @classmethod
+    def materialize(cls, obj_refs: Any) -> Any:
+        """Device -> host (blocks until the value is computed and fetched)."""
+        import jax
+
+        return jax.device_get(obj_refs)
+
+    @classmethod
+    def wait(cls, obj_refs: Any) -> None:
+        """Block until all given device computations complete."""
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(obj_refs):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+
+    @classmethod
+    def is_future(cls, item: Any) -> bool:
+        import jax
+
+        return isinstance(item, jax.Array)

@@ -1,0 +1,75 @@
+"""Global device-mesh management.
+
+The TPU-native analogue of the reference's partition grid sizing
+(NPartitions/CpuCount, modin/config/envvars.py:767-884): instead of a 2-D grid
+of pandas-block partitions on worker processes, data lives in jax.Arrays
+sharded over a ``jax.sharding.Mesh`` whose "rows" axis spans devices connected
+by ICI.  Row-partitioning is a sharding spec, not a Python object
+(SURVEY.md §7 design translation).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from modin_tpu.config import MeshShape
+
+_MESH_AXES = ("rows", "cols")
+_lock = threading.Lock()
+_mesh = None
+_mesh_shape: Optional[tuple] = None
+
+
+def get_mesh():
+    """Get (building on first use) the global device mesh."""
+    global _mesh, _mesh_shape
+    import jax
+    from jax.sharding import Mesh
+
+    shape = tuple(MeshShape.get())
+    with _lock:
+        if _mesh is None or _mesh_shape != shape:
+            devices = jax.devices()
+            n = int(np.prod(shape))
+            if n > len(devices):
+                # fall back to all available devices on the row axis
+                shape = (len(devices), 1)
+            mesh_devices = np.asarray(devices[: int(np.prod(shape))]).reshape(shape)
+            _mesh = Mesh(mesh_devices, _MESH_AXES)
+            _mesh_shape = shape
+    return _mesh
+
+
+def set_mesh(mesh) -> None:
+    """Install an externally-constructed mesh (used by multi-chip dry runs)."""
+    global _mesh, _mesh_shape
+    with _lock:
+        _mesh = mesh
+        _mesh_shape = tuple(mesh.devices.shape)
+
+
+def reset_mesh() -> None:
+    global _mesh, _mesh_shape
+    with _lock:
+        _mesh = None
+        _mesh_shape = None
+
+
+def row_sharding():
+    """NamedSharding partitioning axis 0 over the mesh's "rows" axis."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(get_mesh(), PartitionSpec("rows"))
+
+
+def replicated_sharding():
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(get_mesh(), PartitionSpec())
+
+
+def num_row_shards() -> int:
+    return get_mesh().shape["rows"]

@@ -1,0 +1,402 @@
+"""Differential tests: DataFrame core operations vs pandas.
+
+Modeled on the reference suite (modin/tests/pandas/dataframe/*): same data in
+both implementations, same op, assert equality.
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import (
+    create_test_dfs,
+    df_equals,
+    eval_general,
+    test_data_keys,
+    test_data_values,
+)
+
+
+@pytest.fixture(params=test_data_values, ids=test_data_keys)
+def data(request):
+    return request.param
+
+
+class TestConstruction:
+    def test_from_dict(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md, pdf)
+
+    def test_from_ndarray(self):
+        arr = np.arange(12).reshape(3, 4)
+        md, pdf = create_test_dfs(arr, columns=list("abcd"))
+        df_equals(md, pdf)
+
+    def test_from_pandas(self):
+        pdf = pandas.DataFrame({"a": [1, 2], "b": [3.0, 4.0]})
+        df_equals(pd.DataFrame(pdf), pdf)
+
+    def test_empty(self):
+        md, pdf = create_test_dfs({})
+        df_equals(md, pdf)
+        assert md.empty
+
+    def test_shape_size_ndim(self, data):
+        md, pdf = create_test_dfs(data)
+        assert md.shape == pdf.shape
+        assert md.size == pdf.size
+        assert md.ndim == pdf.ndim
+        assert len(md) == len(pdf)
+
+    def test_with_index_and_columns(self):
+        md, pdf = create_test_dfs(
+            np.ones((4, 3)), index=list("wxyz"), columns=list("abc")
+        )
+        df_equals(md, pdf)
+
+
+class TestArithmetic:
+    @pytest.mark.parametrize(
+        "op",
+        ["add", "sub", "mul", "truediv", "floordiv", "mod", "pow"],
+    )
+    def test_binary_scalar(self, data, op):
+        md, pdf = create_test_dfs(data)
+        eval_general(md, pdf, lambda df: getattr(df, op)(3))
+
+    @pytest.mark.parametrize("op", ["add", "sub", "mul", "truediv"])
+    def test_binary_frame(self, data, op):
+        md, pdf = create_test_dfs(data)
+        eval_general(md, pdf, lambda df: getattr(df, op)(df))
+
+    def test_dunder_ops(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md + md, pdf + pdf)
+        df_equals(md - md, pdf - pdf)
+        df_equals(md * 2, pdf * 2)
+        df_equals(2 * md, 2 * pdf)
+        df_equals(md / 7, pdf / 7)
+        df_equals(-md, -pdf)
+        df_equals(abs(md), abs(pdf))
+
+    @pytest.mark.parametrize("op", ["eq", "ne", "lt", "le", "gt", "ge"])
+    def test_comparison(self, data, op):
+        md, pdf = create_test_dfs(data)
+        eval_general(md, pdf, lambda df: getattr(df, op)(50))
+
+    def test_mixed_frame_series_binary(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3], "b": [4, 5, 6]})
+        df_equals(md + md["a"], pdf + pdf["a"])
+        df_equals(md.add(md["a"], axis=0), pdf.add(pdf["a"], axis=0))
+
+
+class TestReductions:
+    @pytest.mark.parametrize(
+        "op", ["sum", "mean", "min", "max", "count", "prod", "var", "std", "median"]
+    )
+    @pytest.mark.parametrize("axis", [0, 1])
+    def test_stat(self, data, op, axis):
+        md, pdf = create_test_dfs(data)
+        eval_general(md, pdf, lambda df: getattr(df, op)(axis=axis))
+
+    @pytest.mark.parametrize("op", ["sum", "mean", "min", "max"])
+    def test_stat_skipna_false(self, data, op):
+        md, pdf = create_test_dfs(data)
+        eval_general(md, pdf, lambda df: getattr(df, op)(skipna=False))
+
+    def test_any_all(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals((md > 50).any(), (pdf > 50).any())
+        df_equals((md > 50).all(), (pdf > 50).all())
+
+    def test_idxmin_idxmax(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.idxmin(), pdf.idxmin())
+        df_equals(md.idxmax(), pdf.idxmax())
+
+    def test_nunique(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.nunique(), pdf.nunique())
+
+    def test_scalar_reduce_chain(self, data):
+        md, pdf = create_test_dfs(data)
+        np.testing.assert_allclose(md.sum().sum(), pdf.sum().sum())
+
+
+class TestMaps:
+    def test_abs_round(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals((md - 50).abs(), (pdf - 50).abs())
+        df_equals(md.round(2), pdf.round(2))
+
+    def test_isna_notna(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.isna(), pdf.isna())
+        df_equals(md.notna(), pdf.notna())
+
+    def test_fillna(self):
+        md, pdf = create_test_dfs({"a": [1.0, np.nan, 3.0], "b": [np.nan, 5.0, 6.0]})
+        df_equals(md.fillna(0), pdf.fillna(0))
+        df_equals(md.fillna(-1.5), pdf.fillna(-1.5))
+
+    def test_dropna(self):
+        md, pdf = create_test_dfs({"a": [1.0, np.nan, 3.0], "b": [np.nan, 5.0, 6.0]})
+        df_equals(md.dropna(), pdf.dropna())
+        df_equals(md.dropna(axis=1), pdf.dropna(axis=1))
+
+    def test_astype(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.astype("float32"), pdf.astype("float32"))
+        df_equals(md.astype("int64", errors="ignore"), pdf.astype("int64", errors="ignore"))
+
+    def test_clip(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.clip(10, 60), pdf.clip(10, 60))
+
+    def test_cumsum_cummax(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.cumsum(), pdf.cumsum())
+        df_equals(md.cummax(), pdf.cummax())
+
+    def test_diff_shift(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.diff(), pdf.diff())
+        df_equals(md.shift(2), pdf.shift(2))
+
+    def test_rank(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.rank(), pdf.rank())
+
+
+class TestIndexing:
+    def test_head_tail(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.head(), pdf.head())
+        df_equals(md.tail(3), pdf.tail(3))
+        df_equals(md.head(0), pdf.head(0))
+        df_equals(md.head(100000), pdf.head(100000))
+
+    def test_getitem_column(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md["col1"], pdf["col1"])
+        df_equals(md[["col1", "col3"]], pdf[["col1", "col3"]])
+
+    def test_getitem_bool_mask(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md[md["col0"] > 50], pdf[pdf["col0"] > 50])
+
+    def test_loc(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.loc[5], pdf.loc[5])
+        df_equals(md.loc[3:9], pdf.loc[3:9])
+        df_equals(md.loc[:, "col2"], pdf.loc[:, "col2"])
+        df_equals(md.loc[[1, 5, 7], ["col0", "col2"]], pdf.loc[[1, 5, 7], ["col0", "col2"]])
+        df_equals(md.loc[5, "col3"], pdf.loc[5, "col3"])
+
+    def test_iloc(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.iloc[5], pdf.iloc[5])
+        df_equals(md.iloc[2:7], pdf.iloc[2:7])
+        df_equals(md.iloc[:, 1], pdf.iloc[:, 1])
+        df_equals(md.iloc[[1, 3], [0, 2]], pdf.iloc[[1, 3], [0, 2]])
+        df_equals(md.iloc[5, 3], pdf.iloc[5, 3])
+        df_equals(md.iloc[-3:], pdf.iloc[-3:])
+
+    def test_at_iat(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.at[3, "col1"], pdf.at[3, "col1"])
+        df_equals(md.iat[3, 1], pdf.iat[3, 1])
+
+    def test_setitem_column(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3]})
+        md["b"] = [7, 8, 9]
+        pdf["b"] = [7, 8, 9]
+        df_equals(md, pdf)
+        md["a"] = md["b"] * 2
+        pdf["a"] = pdf["b"] * 2
+        df_equals(md, pdf)
+
+    def test_insert_pop_del(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3], "b": [4, 5, 6]})
+        md.insert(1, "c", [9, 9, 9])
+        pdf.insert(1, "c", [9, 9, 9])
+        df_equals(md, pdf)
+        df_equals(md.pop("c"), pdf.pop("c"))
+        df_equals(md, pdf)
+        del md["b"]
+        del pdf["b"]
+        df_equals(md, pdf)
+
+    def test_take(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.take([0, 3, 5]), pdf.take([0, 3, 5]))
+        df_equals(md.take([-1, -2], axis=1), pdf.take([-1, -2], axis=1))
+
+    def test_attr_access(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3]})
+        df_equals(md.a, pdf.a)
+
+
+class TestStructure:
+    def test_transpose(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.T, pdf.T)
+
+    def test_sort_values(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.sort_values("col0"), pdf.sort_values("col0"))
+        df_equals(
+            md.sort_values(["col0", "col1"], ascending=[False, True]),
+            pdf.sort_values(["col0", "col1"], ascending=[False, True]),
+        )
+
+    def test_sort_index(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(
+            md.sort_values("col0").sort_index(), pdf.sort_values("col0").sort_index()
+        )
+
+    def test_drop(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.drop(columns=["col0"]), pdf.drop(columns=["col0"]))
+        df_equals(md.drop(index=[1, 2]), pdf.drop(index=[1, 2]))
+        eval_general(md, pdf, lambda df: df.drop(columns=["nope"]))
+
+    def test_rename(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(
+            md.rename(columns={"col0": "X"}), pdf.rename(columns={"col0": "X"})
+        )
+
+    def test_reset_index(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.reset_index(), pdf.reset_index())
+        df_equals(md.reset_index(drop=True), pdf.reset_index(drop=True))
+
+    def test_set_index(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3], "b": [4, 5, 6]})
+        df_equals(md.set_index("a"), pdf.set_index("a"))
+
+    def test_reindex(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.reindex([5, 3, 1]), pdf.reindex([5, 3, 1]))
+
+    def test_concat_axis0(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(pd.concat([md, md]), pandas.concat([pdf, pdf]))
+        df_equals(
+            pd.concat([md, md], ignore_index=True),
+            pandas.concat([pdf, pdf], ignore_index=True),
+        )
+
+    def test_concat_axis1(self, data):
+        md, pdf = create_test_dfs(data)
+        md2 = md.rename(columns=lambda c: f"{c}_r")
+        pd2 = pdf.rename(columns=lambda c: f"{c}_r")
+        df_equals(pd.concat([md, md2], axis=1), pandas.concat([pdf, pd2], axis=1))
+
+    def test_duplicates(self):
+        md, pdf = create_test_dfs({"a": [1, 1, 2, 2, 3], "b": [1, 1, 2, 9, 3]})
+        df_equals(md.duplicated(), pdf.duplicated())
+        df_equals(md.drop_duplicates(), pdf.drop_duplicates())
+
+    def test_nlargest_nsmallest(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.nlargest(5, "col0"), pdf.nlargest(5, "col0"))
+        df_equals(md.nsmallest(5, "col0"), pdf.nsmallest(5, "col0"))
+
+    def test_melt(self):
+        md, pdf = create_test_dfs({"a": [1, 2], "b": [3, 4], "c": [5, 6]})
+        df_equals(
+            md.melt(id_vars=["a"]).sort_values(["variable", "value"]).reset_index(drop=True),
+            pdf.melt(id_vars=["a"]).sort_values(["variable", "value"]).reset_index(drop=True),
+        )
+
+
+class TestCombining:
+    def test_merge(self):
+        md1, pd1 = create_test_dfs({"k": [1, 2, 3, 4], "v1": list("abcd")})
+        md2, pd2 = create_test_dfs({"k": [2, 3, 5], "v2": list("xyz")})
+        for how in ("inner", "left", "right", "outer"):
+            df_equals(md1.merge(md2, on="k", how=how), pd1.merge(pd2, on="k", how=how))
+
+    def test_join(self):
+        md1, pd1 = create_test_dfs({"v1": [1, 2, 3]})
+        md2, pd2 = create_test_dfs({"v2": [4, 5]})
+        df_equals(md1.join(md2), pd1.join(pd2))
+
+    def test_where_mask(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.where(md > 50), pdf.where(pdf > 50))
+        df_equals(md.mask(md > 50), pdf.mask(pdf > 50))
+
+    def test_isin(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.isin([1, 2, 3]), pdf.isin([1, 2, 3]))
+
+    def test_update(self):
+        md1, pd1 = create_test_dfs({"a": [1.0, 2.0, 3.0]})
+        md2, pd2 = create_test_dfs({"a": [9.0, np.nan, 7.0]})
+        md1.update(md2)
+        pd1.update(pd2)
+        df_equals(md1, pd1)
+
+
+class TestMisc:
+    def test_repr(self, data):
+        md, pdf = create_test_dfs(data)
+        assert repr(md) == repr(pdf)
+
+    def test_repr_large(self):
+        md, pdf = create_test_dfs({"a": np.arange(200), "b": np.arange(200) * 1.5})
+        assert repr(md) == repr(pdf)
+
+    def test_to_numpy(self, data):
+        md, pdf = create_test_dfs(data)
+        np.testing.assert_array_equal(md.to_numpy(), pdf.to_numpy())
+
+    def test_copy_deep(self, data):
+        md, _ = create_test_dfs(data)
+        md2 = md.copy()
+        md2["col0"] = 0
+        assert not (md["col0"] == 0).all()
+
+    def test_apply(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.apply(lambda c: c + 1), pdf.apply(lambda c: c + 1))
+        df_equals(md.apply("sum"), pdf.apply("sum"))
+
+    def test_pickle_roundtrip(self, data):
+        import pickle
+
+        md, pdf = create_test_dfs(data)
+        md2 = pickle.loads(pickle.dumps(md))
+        df_equals(md2, pdf)
+
+    def test_dtypes(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.dtypes, pdf.dtypes)
+
+    def test_describe(self, data):
+        md, pdf = create_test_dfs(data)
+        df_equals(md.describe(), pdf.describe())
+
+    def test_fallback_long_tail(self, data):
+        """Methods with no explicit implementation go through generated fallbacks."""
+        md, pdf = create_test_dfs(data)
+        df_equals(md.kurtosis(), pdf.kurtosis())
+        df_equals(md.sem(), pdf.sem())
+        df_equals(md.pct_change().dropna(), pdf.pct_change().dropna())
+
+    def test_assign(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3]})
+        df_equals(md.assign(b=lambda d: d.a * 2), pdf.assign(b=lambda d: d.a * 2))
+
+    def test_iteration(self):
+        md, pdf = create_test_dfs({"a": [1, 2], "b": [3, 4]})
+        assert list(md) == list(pdf)
+        assert "a" in md
+        for (mk, mv), (pk, pv) in zip(md.items(), pdf.items()):
+            assert mk == pk
+            df_equals(mv, pv)
