@@ -1,0 +1,122 @@
+"""Benchmark: asv TimeArithmetic + TimeGroupByDefaultAggregations equivalents.
+
+Mirrors the reference's operative baseline (BASELINE.md: asv_bench
+benchmarks.py:42-113,383-433) at the driver's north-star scale: a 10^8-row
+float64 frame plus an int key column with 100 groups.  Each op runs under
+BenchmarkMode (synchronous execution) after a warm-up pass, and the identical
+ops run on in-process pandas as the CPU baseline (the reference's
+PandasOnRay headline is ~4x a 4-core laptop's pandas; this host is 1 core).
+
+Prints ONE json line: {"metric", "value" (modin_tpu wall-sec), "unit",
+"vs_baseline" (pandas_sec / modin_tpu_sec, higher is better)}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 100_000_000))
+COLS = 5
+NGROUPS = 100
+REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+
+
+def build_data():
+    rng = np.random.default_rng(0)
+    data = {f"c{i}": rng.uniform(0.0, 100.0, ROWS) for i in range(COLS)}
+    data["key"] = rng.integers(0, NGROUPS, ROWS)
+    return data
+
+
+ARITHMETIC_OPS = [
+    ("sum", lambda df: df.sum()),
+    ("mean", lambda df: df.mean()),
+    ("count", lambda df: df.count()),
+    ("add", lambda df: df + df),
+    ("mul", lambda df: df * 2.0),
+    ("abs", lambda df: df.abs()),
+    ("gt", lambda df: df > 50.0),
+]
+
+GROUPBY_OPS = [
+    ("gb_count", lambda df: df.groupby("key").count()),
+    ("gb_size", lambda df: df.groupby("key").size()),
+    ("gb_sum", lambda df: df.groupby("key").sum()),
+    ("gb_mean", lambda df: df.groupby("key").mean()),
+]
+
+
+def execute_modin(result):
+    qc = getattr(result, "_query_compiler", None)
+    if qc is not None:
+        qc.execute()
+    return result
+
+
+def execute_pandas(result):
+    return result
+
+
+def time_ops(df, ops, execute):
+    total = 0.0
+    per_op = {}
+    for name, fn in ops:
+        execute(fn(df))  # warm-up (jit compile + caches)
+        best = float("inf")
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            execute(fn(df))
+            dt = time.perf_counter() - t0
+            best = min(best, dt)
+        per_op[name] = best
+        total += best
+    return total, per_op
+
+
+def main() -> None:
+    data = build_data()
+
+    import pandas
+
+    pdf = pandas.DataFrame(data)
+
+    import modin_tpu.pandas as pd
+    from modin_tpu.config import BenchmarkMode
+
+    BenchmarkMode.put(True)
+    mdf = pd.DataFrame(data)
+    mdf._query_compiler.execute()
+
+    del data
+
+    ops = ARITHMETIC_OPS + GROUPBY_OPS
+    modin_total, modin_ops = time_ops(mdf, ops, execute_modin)
+    pandas_total, pandas_ops = time_ops(pdf, ops, execute_pandas)
+
+    detail = {
+        name: {
+            "modin_tpu_s": round(modin_ops[name], 4),
+            "pandas_s": round(pandas_ops[name], 4),
+            "speedup": round(pandas_ops[name] / max(modin_ops[name], 1e-9), 2),
+        }
+        for name, _ in ops
+    }
+    print(
+        json.dumps(
+            {
+                "metric": "TimeArithmetic+TimeGroupByDefaultAggregations wall-sec (1e8 rows float64)",
+                "value": round(modin_total, 4),
+                "unit": "seconds",
+                "vs_baseline": round(pandas_total / max(modin_total, 1e-9), 2),
+                "detail": detail,
+                "rows": ROWS,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,11 @@ grid of pandas-block partitions on worker processes, a frame is:
   device/host split that replaces the reference's default-to-pandas partition
   fallback).
 
+Device columns are **padded** to a multiple of the mesh row-shard count with
+the logical length tracked per column: XLA requires even shards for
+explicitly sharded arrays, and uneven results silently fall back to
+replication.  All device kernels (modin_tpu/ops/) are pad-aware.
+
 Datetimes/timedeltas live on device as int64 with a logical-dtype tag; NaT is
 the int64 min sentinel, exactly pandas' own representation, so the round-trip
 is a zero-cost view.
@@ -41,34 +46,49 @@ def _is_device_dtype(dtype: Any) -> bool:
 
 
 class DeviceColumn:
-    """One column as a 1-D jax.Array sharded over the mesh rows axis.
+    """One column as a padded 1-D jax.Array sharded over the mesh rows axis.
 
-    ``host_cache`` keeps the original host numpy array for columns that came
-    from the host unchanged: it makes device round-trips bit-exact even where
-    the accelerator emulates the dtype (TPU f64 is double-float: ~2^-49
-    relative precision with a float32 exponent range) and lets the
+    ``length`` is the logical row count (data.shape[0] is padded up to a
+    multiple of the shard count; pad rows are never read).
+
+    ``host_cache`` keeps the original (unpadded) host numpy array for columns
+    that came from the host unchanged: it makes device round-trips bit-exact
+    even where the accelerator emulates the dtype (TPU f64 is double-float:
+    ~2^-49 relative precision with a float32 exponent range) and lets the
     default-to-pandas path skip the device->host transfer entirely.  Any
     computed column drops the cache.
     """
 
-    __slots__ = ("data", "pandas_dtype", "host_cache")
+    __slots__ = ("data", "pandas_dtype", "length", "host_cache")
     is_device = True
 
-    def __init__(self, data: Any, pandas_dtype: np.dtype, host_cache: Optional[np.ndarray] = None):
+    def __init__(
+        self,
+        data: Any,
+        pandas_dtype: np.dtype,
+        length: Optional[int] = None,
+        host_cache: Optional[np.ndarray] = None,
+    ):
         self.data = data
-        self.pandas_dtype = pandas_dtype
+        self.pandas_dtype = np.dtype(pandas_dtype)
+        self.length = int(length) if length is not None else int(data.shape[0])
         self.host_cache = host_cache
 
     @classmethod
     def from_numpy(cls, values: np.ndarray, sharding: Any = None) -> "DeviceColumn":
+        from modin_tpu.ops.structural import pad_host
         from modin_tpu.parallel.engine import JaxWrapper
 
         pandas_dtype = values.dtype
         device_values = values.view("int64") if values.dtype.kind in "mM" else values
         if not device_values.flags.c_contiguous:
             device_values = np.ascontiguousarray(device_values)
+        padded = pad_host(device_values)
         return cls(
-            JaxWrapper.put(device_values, sharding), pandas_dtype, host_cache=values
+            JaxWrapper.put(padded, sharding),
+            pandas_dtype,
+            length=len(values),
+            host_cache=values,
         )
 
     def to_numpy(self) -> np.ndarray:
@@ -76,16 +96,25 @@ class DeviceColumn:
 
         if self.host_cache is not None:
             return self.host_cache
-        values = np.asarray(JaxWrapper.materialize(self.data))
+        values = np.asarray(JaxWrapper.materialize(self.data))[: self.length]
         if self.pandas_dtype.kind in "mM":
             values = values.view(self.pandas_dtype)
         return values
 
-    def with_data(self, data: Any, pandas_dtype: Optional[np.dtype] = None) -> "DeviceColumn":
-        return DeviceColumn(data, pandas_dtype if pandas_dtype is not None else self.pandas_dtype)
+    def with_data(
+        self,
+        data: Any,
+        pandas_dtype: Optional[np.dtype] = None,
+        length: Optional[int] = None,
+    ) -> "DeviceColumn":
+        return DeviceColumn(
+            data,
+            pandas_dtype if pandas_dtype is not None else self.pandas_dtype,
+            length if length is not None else self.length,
+        )
 
     def __len__(self) -> int:
-        return self.data.shape[0]
+        return self.length
 
 
 class HostColumn:
@@ -95,12 +124,16 @@ class HostColumn:
     is_device = False
 
     def __init__(self, data: Any):
-        # data: 1-D numpy array or pandas ExtensionArray
+        # data: 1-D numpy array or pandas ExtensionArray (unpadded)
         self.data = data
 
     @property
     def pandas_dtype(self):
         return self.data.dtype
+
+    @property
+    def length(self) -> int:
+        return len(self.data)
 
     def to_numpy(self) -> np.ndarray:
         return np.asarray(self.data)
@@ -193,10 +226,8 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
         )
 
     def __len__(self) -> int:
-        if self._index.has_known_length():
-            return len(self._index)
         if self._columns:
-            return len(self._columns[0])
+            return self._columns[0].length
         return len(self.index)
 
     @property
@@ -253,89 +284,91 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
         )
 
     def take_rows_positional(self, positions: Any) -> "TpuDataframe":
-        """Gather rows by position: device gather for device columns."""
-        import jax.numpy as jnp
-
+        """Gather rows by position (pad-aware device gather, one jit)."""
+        n = len(self)
         if isinstance(positions, slice):
-            n = len(self)
-            rng = range(*positions.indices(n))
-            new_len = len(rng)
-            new_columns = []
-            for col in self._columns:
-                if col.is_device:
-                    cache = (
-                        col.host_cache[positions]
-                        if col.host_cache is not None
-                        else None
-                    )
-                    new_columns.append(
-                        DeviceColumn(col.data[positions], col.pandas_dtype, cache)
-                    )
-                else:
-                    new_columns.append(HostColumn(col.data[positions]))
-            new_index = self._index.map_after(lambda idx: idx[positions], new_len)
-            return self.with_columns(new_columns, index=new_index, nrows=new_len)
-        pos_arr = np.asarray(positions, dtype=np.int64)
-        device_pos = None
-        new_columns = []
-        for col in self._columns:
-            if col.is_device:
-                if device_pos is None:
-                    device_pos = jnp.asarray(pos_arr)
+            positions = np.arange(*positions.indices(n), dtype=np.int64)
+        else:
+            positions = np.asarray(positions, dtype=np.int64)
+            positions = np.where(positions < 0, positions + n, positions)
+        return self._take_host_positions(positions)
+
+    def _take_host_positions(self, pos_arr: np.ndarray) -> "TpuDataframe":
+        from modin_tpu.ops.structural import gather_columns
+
+        device_idx = [i for i, c in enumerate(self._columns) if c.is_device]
+        new_columns: List[Column] = list(self._columns)
+        if device_idx:
+            datas, n_out = gather_columns(
+                [self._columns[i].data for i in device_idx], pos_arr
+            )
+            for i, d in zip(device_idx, datas):
+                col = self._columns[i]
                 cache = (
-                    col.host_cache.take(pos_arr) if col.host_cache is not None else None
+                    col.host_cache.take(pos_arr)
+                    if col.host_cache is not None
+                    else None
                 )
-                new_columns.append(
-                    DeviceColumn(
-                        jnp.take(col.data, device_pos, axis=0), col.pandas_dtype, cache
-                    )
+                new_columns[i] = DeviceColumn(
+                    d, col.pandas_dtype, length=len(pos_arr), host_cache=cache
                 )
-            else:
-                new_columns.append(HostColumn(col.data.take(pos_arr)))
+        for i, col in enumerate(self._columns):
+            if not col.is_device:
+                new_columns[i] = HostColumn(col.data.take(pos_arr))
         new_index = self._index.map_after(lambda idx: idx.take(pos_arr), len(pos_arr))
         return self.with_columns(new_columns, index=new_index, nrows=len(pos_arr))
 
     def filter_rows_mask(self, mask: Any) -> "TpuDataframe":
-        """Boolean-mask rows.  The mask may be a device array; the row count is
-        data-dependent, so this is an eager (synchronizing) operation — the
-        reference has the same property via lazy row-length caches
-        (dataframe.py:242-343)."""
-        mask_np = np.asarray(mask)
+        """Boolean-mask rows.  The row count is data-dependent, so this is an
+        eager (synchronizing) operation — the reference has the same property
+        via lazy row-length caches (dataframe.py:242-343)."""
+        mask_np = np.asarray(mask)[: len(self)]
         positions = np.nonzero(mask_np)[0]
-        return self.take_rows_positional(positions)
+        return self._take_host_positions(positions)
 
     def concat_rows(self, others: List["TpuDataframe"]) -> "TpuDataframe":
         """Row-wise concat when column labels/dtypes align exactly."""
-        import jax.numpy as jnp
+        from modin_tpu.ops.structural import concat_columns
 
         frames = [self, *others]
-        new_columns: List[Column] = []
-        for ci in range(self.num_cols):
-            cols = [f._columns[ci] for f in frames]
-            if all(c.is_device for c in cols) and len(
-                {c.data.dtype for c in cols}
-            ) == 1:
-                data = jnp.concatenate([c.data for c in cols])
+        lengths = [len(f) for f in frames]
+        total = sum(lengths)
+        device_ok = [
+            all(f._columns[ci].is_device for f in frames)
+            and len({f._columns[ci].data.dtype for f in frames}) == 1
+            for ci in range(self.num_cols)
+        ]
+        new_columns: List[Column] = [None] * self.num_cols
+        device_cis = [ci for ci in range(self.num_cols) if device_ok[ci]]
+        if device_cis:
+            parts = [[f._columns[ci].data for ci in device_cis] for f in frames]
+            datas, n_out = concat_columns(parts, lengths)
+            for ci, d in zip(device_cis, datas):
+                cols = [f._columns[ci] for f in frames]
                 cache = None
                 if all(c.host_cache is not None for c in cols):
                     cache = np.concatenate([c.host_cache for c in cols])
-                new_columns.append(
-                    DeviceColumn(data, cols[0].pandas_dtype, cache)
+                new_columns[ci] = DeviceColumn(
+                    d, cols[0].pandas_dtype, length=total, host_cache=cache
                 )
+        for ci in range(self.num_cols):
+            if device_ok[ci]:
+                continue
+            values = np.concatenate(
+                [np.asarray(f._columns[ci].to_numpy()) for f in frames]
+            )
+            if all(f._columns[ci].is_device for f in frames):
+                new_columns[ci] = DeviceColumn.from_numpy(values)
             else:
-                values = np.concatenate([np.asarray(c.to_numpy()) for c in cols])
-                first_dtype = cols[0].pandas_dtype
-                if all(c.is_device for c in cols):
-                    new_columns.append(DeviceColumn.from_numpy(values.astype(first_dtype, copy=False)))
-                else:
-                    new_columns.append(HostColumn(pandas.array(values)))
-        total = sum(len(f) for f in frames)
+                new_columns[ci] = HostColumn(pandas.array(values))
         lazies = [f._index for f in frames]
 
         def build_index() -> pandas.Index:
             return lazies[0].get().append([lz.get() for lz in lazies[1:]])
 
-        return self.with_columns(new_columns, index=LazyIndex(build_index, total), nrows=total)
+        return self.with_columns(
+            new_columns, index=LazyIndex(build_index, total), nrows=total
+        )
 
     def get_column(self, position: int) -> Column:
         return self._columns[position]

@@ -226,3 +226,766 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     def get_pandas_backend(self) -> Optional[str]:
         return None
+
+    # ================================================================== #
+    # Device hot paths.  Each op gates on dtypes/kwargs it can honor on
+    # device and falls through to the inherited default otherwise —
+    # the reference's incremental-optimization strategy.
+    # ================================================================== #
+
+    _ARITH_KINDS = frozenset("iuf")
+    _LOGICAL_OPS = frozenset(
+        ["__and__", "__or__", "__xor__", "__rand__", "__ror__", "__rxor__"]
+    )
+    _CMP_OPS = frozenset(["eq", "ne", "lt", "le", "gt", "ge"])
+
+    def _device_cols(self) -> Optional[list]:
+        """All columns as device arrays, or None if any column is host-only."""
+        cols = self._modin_frame._columns
+        if all(c.is_device for c in cols):
+            return [c.data for c in cols]
+        return None
+
+    def _fast_index_match(self, other: "TpuQueryCompiler") -> bool:
+        """Cheap index-alignment check that never materializes a lazy index."""
+        a, b = self._modin_frame._index, other._modin_frame._index
+        if a is b:
+            return True
+        if a.is_materialized and b.is_materialized:
+            ia, ib = a.get(), b.get()
+            if ia is ib:
+                return True
+            if isinstance(ia, pandas.RangeIndex) and isinstance(ib, pandas.RangeIndex):
+                return ia.equals(ib)
+            if len(ia) == len(ib) and len(ia) <= 100_000:
+                return ia.equals(ib)
+        return False
+
+    def _wrap_device_result(
+        self,
+        datas: list,
+        dtypes: Optional[list] = None,
+        col_labels: Optional[pandas.Index] = None,
+        index: Any = None,
+        nrows: Optional[int] = None,
+    ) -> "TpuQueryCompiler":
+        frame = self._modin_frame
+        length = nrows if nrows is not None else len(frame)
+        cols = [
+            DeviceColumn(
+                d,
+                np.dtype(dt) if dt is not None else np.dtype(d.dtype),
+                length=length,
+            )
+            for d, dt in zip(datas, dtypes or [None] * len(datas))
+        ]
+        return type(self)(
+            frame.with_columns(
+                cols,
+                col_labels if col_labels is not None else frame.columns,
+                index if index is not None else frame._index,
+                nrows=nrows,
+            ),
+            self._shape_hint,
+        )
+
+    # ------------------------------- binary --------------------------- #
+
+    def _try_device_binary(self, op: str, other: Any, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        from modin_tpu.ops import elementwise
+
+        if kwargs.get("level") is not None or kwargs.get("fill_value") is not None:
+            return None
+        frame = self._modin_frame
+        if frame.num_cols == 0 or len(frame) == 0:
+            return None
+        cols = self._device_cols()
+        if cols is None:
+            return None
+        kinds = [c.pandas_dtype.kind for c in frame._columns]
+        if op in self._LOGICAL_OPS:
+            if not all(k == "b" for k in kinds):
+                return None
+        elif op in self._CMP_OPS:
+            if not all(k in "biuf" for k in kinds):
+                return None
+        else:
+            if not all(k in self._ARITH_KINDS for k in kinds):
+                return None
+
+        # scalar other
+        if isinstance(other, (int, float, np.integer, np.floating)) and not isinstance(other, bool):
+            if (
+                op in ("pow", "rpow")
+                and all(k in "iu" for k in kinds)
+                and isinstance(other, (int, np.integer))
+            ):
+                # int ** negative-int raises in pandas; rpow exponent sign is
+                # data-dependent — fall back for the whole int/int pow family
+                return None
+            datas = elementwise.binary_op_columns(op, cols, other)
+            return self._wrap_device_result(datas)
+        if isinstance(other, (bool, np.bool_)) and op in (self._LOGICAL_OPS | self._CMP_OPS):
+            datas = elementwise.binary_op_columns(op, cols, bool(other))
+            return self._wrap_device_result(datas)
+
+        # frame/series other
+        if isinstance(other, TpuQueryCompiler):
+            oframe = other._modin_frame
+            ocols = other._device_cols()
+            if ocols is None or not self._fast_index_match(other):
+                return None
+            okinds = [c.pandas_dtype.kind for c in oframe._columns]
+            if op in self._LOGICAL_OPS:
+                if not all(k == "b" for k in okinds):
+                    return None
+            elif not all(k in "biuf" for k in okinds):
+                return None
+            if (
+                op in ("pow", "rpow")
+                and all(k in "iu" for k in kinds)
+                and all(k in "iu" for k in okinds)
+            ):
+                return None  # exponent sign is data-dependent; pandas may raise
+            axis = kwargs.get("axis", None)
+            self_is_col = self._shape_hint == "column"
+            other_is_col = other._shape_hint == "column"
+            if self_is_col and other_is_col:
+                # series <op> series
+                datas = elementwise.binary_op_columns(op, cols, ocols)
+                a, b = frame.columns[0], oframe.columns[0]
+                label = a if a == b else MODIN_UNNAMED_SERIES_LABEL
+                return self._wrap_device_result(datas, col_labels=pandas.Index([label]))
+            if not self_is_col and other_is_col and axis in (0, "index"):
+                # df <op> series broadcast down columns
+                datas = elementwise.binary_op_columns(op, cols, ocols * frame.num_cols)
+                return self._wrap_device_result(datas)
+            if not self_is_col and not other_is_col:
+                if not frame.columns.equals(oframe.columns):
+                    return None
+                datas = elementwise.binary_op_columns(op, cols, ocols)
+                return self._wrap_device_result(datas)
+            return None
+        return None
+
+    # ------------------------------- maps ----------------------------- #
+
+    def _map_device_host(
+        self,
+        device_fn,
+        host_fn,
+        result_dtype_fn=None,
+        require_kinds: Optional[str] = None,
+    ) -> Optional["TpuQueryCompiler"]:
+        """Apply a kernel to device columns and a pandas kernel to host
+        columns, preserving column positions (the hybrid device/host map)."""
+        from modin_tpu.ops import elementwise  # noqa: F401
+
+        frame = self._modin_frame
+        if len(frame) == 0:
+            return None
+        device_positions = []
+        device_arrays = []
+        for i, col in enumerate(frame._columns):
+            if col.is_device:
+                if require_kinds is not None and col.pandas_dtype.kind not in require_kinds:
+                    return None
+                device_positions.append(i)
+                device_arrays.append(col.data)
+        new_device = device_fn(device_arrays) if device_arrays else []
+        new_columns: list = list(frame._columns)
+        for pos, data in zip(device_positions, new_device):
+            new_columns[pos] = DeviceColumn(
+                data, np.dtype(data.dtype), length=len(frame)
+            )
+        for i, col in enumerate(frame._columns):
+            if not col.is_device:
+                result = host_fn(pandas.Series(col.data))
+                new_columns[i] = HostColumn(result.array)
+        return type(self)(
+            frame.with_columns(new_columns), self._shape_hint
+        )
+
+    def abs(self) -> "TpuQueryCompiler":
+        from modin_tpu.ops import elementwise
+
+        result = self._map_device_host(
+            lambda cols: elementwise.unary_op_columns("abs", cols),
+            lambda s: s.abs(),
+            require_kinds="iuf",
+        )
+        return result if result is not None else super().abs()
+
+    def negative(self) -> "TpuQueryCompiler":
+        from modin_tpu.ops import elementwise
+
+        result = self._map_device_host(
+            lambda cols: elementwise.unary_op_columns("negative", cols),
+            lambda s: -s,
+            require_kinds="iuf",
+        )
+        return result if result is not None else super().negative()
+
+    def invert(self) -> "TpuQueryCompiler":
+        from modin_tpu.ops import elementwise
+
+        result = self._map_device_host(
+            lambda cols: elementwise.unary_op_columns("invert", cols),
+            lambda s: ~s,
+            require_kinds="biu",
+        )
+        return result if result is not None else super().invert()
+
+    def _isna_like(self, negate: bool) -> Optional["TpuQueryCompiler"]:
+        from modin_tpu.ops import elementwise
+
+        frame = self._modin_frame
+        device_positions = [
+            i for i, c in enumerate(frame._columns) if c.is_device
+        ]
+        mM_flags = tuple(
+            frame._columns[i].pandas_dtype.kind in "mM" for i in device_positions
+        )
+
+        def device_fn(cols):
+            return elementwise.isna_columns(cols, mM_flags, negate)
+
+        return self._map_device_host(
+            device_fn,
+            (lambda s: s.notna()) if negate else (lambda s: s.isna()),
+        )
+
+    def isna(self) -> "TpuQueryCompiler":
+        result = self._isna_like(negate=False)
+        return result if result is not None else super().isna()
+
+    def notna(self) -> "TpuQueryCompiler":
+        result = self._isna_like(negate=True)
+        return result if result is not None else super().notna()
+
+    def round(self, decimals: int = 0, **kwargs: Any) -> "TpuQueryCompiler":
+        from modin_tpu.ops import elementwise
+
+        if not isinstance(decimals, (int, np.integer)):
+            return super().round(decimals=decimals, **kwargs)
+        result = self._map_device_host(
+            lambda cols: elementwise.round_columns(cols, int(decimals)),
+            lambda s: s.round(int(decimals)) if s.dtype.kind in "iuf" else s,
+        )
+        return result if result is not None else super().round(decimals=decimals, **kwargs)
+
+    def fillna(self, **kwargs: Any) -> "TpuQueryCompiler":
+        from modin_tpu.ops import elementwise
+
+        value = kwargs.get("value")
+        if (
+            isinstance(value, (int, float, np.integer, np.floating))
+            and not isinstance(value, bool)
+            and kwargs.get("limit") is None
+            and kwargs.get("axis") in (0, None)
+        ):
+            # note: pandas upcasts int fill into float col fine; int cols have
+            # no NaN so they pass through unchanged
+            result = self._map_device_host(
+                lambda cols: elementwise.fillna_columns(cols, value),
+                lambda s: s.fillna(value),
+            )
+            if result is not None:
+                return result
+        return super().fillna(**kwargs)
+
+    def clip(self, lower: Any, upper: Any, **kwargs: Any) -> "TpuQueryCompiler":
+        from modin_tpu.ops import elementwise
+
+        def is_num(v):
+            return v is None or (
+                isinstance(v, (int, float, np.integer, np.floating))
+                and not isinstance(v, bool)
+            )
+
+        if is_num(lower) and is_num(upper) and kwargs.get("axis") in (None, 0) and not kwargs.get("inplace"):
+            result = self._map_device_host(
+                lambda cols: elementwise.clip_columns(cols, lower, upper),
+                lambda s: s.clip(lower, upper),
+                require_kinds="iuf",
+            )
+            if result is not None:
+                return result
+        return super().clip(lower, upper, **kwargs)
+
+    def astype(self, col_dtypes: Any, errors: str = "raise") -> "TpuQueryCompiler":
+        from modin_tpu.ops import elementwise
+
+        frame = self._modin_frame
+        if not isinstance(col_dtypes, dict):
+            try:
+                target = np.dtype(col_dtypes)
+            except TypeError:
+                return super().astype(col_dtypes, errors=errors)
+            if target.kind in "iuf" and all(
+                c.is_device and c.pandas_dtype.kind in "biuf"
+                for c in frame._columns
+            ) and len(frame) > 0:
+                # int target with NaN present must raise like pandas
+                if target.kind in "iu" and any(
+                    c.pandas_dtype.kind == "f" for c in frame._columns
+                ):
+                    return super().astype(col_dtypes, errors=errors)
+                new_cols = [
+                    DeviceColumn(
+                        elementwise.astype_column(c.data, target), target,
+                        length=len(frame),
+                    )
+                    for c in frame._columns
+                ]
+                return type(self)(frame.with_columns(new_cols), self._shape_hint)
+        return super().astype(col_dtypes, errors=errors)
+
+    def _cum_op(self, name: str, axis: int, skipna: bool) -> Optional["TpuQueryCompiler"]:
+        from modin_tpu.ops import elementwise
+
+        if axis != 0:
+            return None
+        # NaN-skipping cumulative ops need masked variants; ints are exact
+        frame = self._modin_frame
+        if not all(
+            c.is_device and c.pandas_dtype.kind in "iu" for c in frame._columns
+        ) or len(frame) == 0:
+            return None
+        return self._map_device_host(
+            lambda cols: elementwise.unary_op_columns(name, cols),
+            lambda s: s,
+        )
+
+    def cumsum(self, axis: int = 0, skipna: bool = True, **kwargs: Any) -> "TpuQueryCompiler":
+        result = self._cum_op("cumsum", axis, skipna)
+        return result if result is not None else super().cumsum(axis=axis, skipna=skipna, **kwargs)
+
+    def cumprod(self, axis: int = 0, skipna: bool = True, **kwargs: Any) -> "TpuQueryCompiler":
+        result = self._cum_op("cumprod", axis, skipna)
+        return result if result is not None else super().cumprod(axis=axis, skipna=skipna, **kwargs)
+
+    def cummax(self, axis: int = 0, skipna: bool = True, **kwargs: Any) -> "TpuQueryCompiler":
+        result = self._cum_op("cummax", axis, skipna)
+        return result if result is not None else super().cummax(axis=axis, skipna=skipna, **kwargs)
+
+    def cummin(self, axis: int = 0, skipna: bool = True, **kwargs: Any) -> "TpuQueryCompiler":
+        result = self._cum_op("cummin", axis, skipna)
+        return result if result is not None else super().cummin(axis=axis, skipna=skipna, **kwargs)
+
+    # ----------------------------- reductions ------------------------- #
+
+    _DEVICE_REDUCTIONS = frozenset(
+        ["sum", "prod", "mean", "median", "min", "max", "count", "var", "std",
+         "sem", "skew", "kurt", "any", "all"]
+    )
+
+    def _try_device_reduce(
+        self, op: str, axis: Any, skipna: bool, numeric_only: bool, kwargs: dict
+    ) -> Optional["TpuQueryCompiler"]:
+        from modin_tpu.ops import reductions
+
+        if kwargs.get("min_count", 0) not in (0, -1):
+            return None
+        if kwargs.get("bool_only"):
+            return None
+        ddof = int(kwargs.get("ddof", 1))
+        frame = self._modin_frame
+        if len(frame) == 0 or frame.num_cols == 0:
+            return None
+        # column selection
+        allowed = "biuf"
+        positions = []
+        for i, col in enumerate(frame._columns):
+            ok = col.is_device and col.pandas_dtype.kind in allowed
+            if numeric_only:
+                if ok:
+                    positions.append(i)
+                elif col.pandas_dtype.kind not in "biufc":
+                    continue  # excluded by numeric_only
+                else:
+                    return None  # numeric column we can't run on device
+            else:
+                if not ok:
+                    return None
+                positions.append(i)
+        if not positions:
+            return None
+        sel_cols = [frame._columns[i] for i in positions]
+        labels = frame.columns[positions]
+        arrays = [c.data for c in sel_cols]
+        # bool columns: pandas computes sum/mean over ints
+        if op in ("sum", "prod", "mean", "median", "var", "std", "sem", "skew", "kurt"):
+            import jax.numpy as jnp
+
+            arrays = [
+                a.astype(jnp.int64) if a.dtype == jnp.bool_ else a for a in arrays
+            ]
+        if axis in (1,):
+            if op not in ("sum", "mean", "min", "max", "count", "var", "std", "median"):
+                return None
+            data = reductions.reduce_axis1(op, arrays, skipna=skipna, ddof=ddof)
+            result_col = DeviceColumn(data, np.dtype(data.dtype), length=len(frame))
+            result_frame = TpuDataframe(
+                [result_col],
+                pandas.Index([MODIN_UNNAMED_SERIES_LABEL]),
+                frame._index,
+            )
+            qc = type(self)(result_frame)
+            qc._shape_hint = "column"
+            return qc
+        if axis not in (0, None):
+            return None
+        values = reductions.reduce_columns(op, arrays, len(frame), skipna=skipna, ddof=ddof)
+        result = pandas.Series(
+            [v.item() if v.ndim == 0 else v for v in values], index=labels
+        )
+        if op in ("any", "all"):
+            result = result.astype(bool)
+        elif op == "count":
+            result = result.astype(np.int64)
+        name = MODIN_UNNAMED_SERIES_LABEL
+        return type(self).from_pandas(result.to_frame(name))
+
+    def idxmin(self, axis: int = 0, skipna: bool = True, numeric_only: bool = False, **kwargs: Any):
+        from modin_tpu.ops import reductions
+
+        frame = self._modin_frame
+        if (
+            axis == 0
+            and skipna
+            and len(frame) > 0
+            and all(c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns)
+        ):
+            positions = reductions.idx_minmax(
+                "idxmin", [c.data for c in frame._columns], len(frame)
+            )
+            labels = frame.index.take(positions)
+            result = pandas.Series(labels, index=frame.columns)
+            return type(self).from_pandas(result.to_frame(MODIN_UNNAMED_SERIES_LABEL))
+        return super().idxmin(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs)
+
+    def idxmax(self, axis: int = 0, skipna: bool = True, numeric_only: bool = False, **kwargs: Any):
+        from modin_tpu.ops import reductions
+
+        frame = self._modin_frame
+        if (
+            axis == 0
+            and skipna
+            and len(frame) > 0
+            and all(c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns)
+        ):
+            positions = reductions.idx_minmax(
+                "idxmax", [c.data for c in frame._columns], len(frame)
+            )
+            labels = frame.index.take(positions)
+            result = pandas.Series(labels, index=frame.columns)
+            return type(self).from_pandas(result.to_frame(MODIN_UNNAMED_SERIES_LABEL))
+        return super().idxmax(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs)
+
+    # ----------------------------- groupby ---------------------------- #
+
+    def groupby_agg(
+        self,
+        by: Any,
+        agg_func: Any,
+        axis: int = 0,
+        groupby_kwargs: Optional[dict] = None,
+        agg_args: tuple = (),
+        agg_kwargs: Optional[dict] = None,
+        how: str = "axis_wise",
+        drop: bool = False,
+        series_groupby: bool = False,
+        selection: Any = None,
+    ) -> "TpuQueryCompiler":
+        result = self._try_device_groupby(
+            by, agg_func, axis, groupby_kwargs or {}, agg_args, agg_kwargs or {},
+            drop, series_groupby, selection,
+        )
+        if result is not None:
+            return result
+        return super().groupby_agg(
+            by, agg_func, axis=axis, groupby_kwargs=groupby_kwargs,
+            agg_args=agg_args, agg_kwargs=agg_kwargs, how=how, drop=drop,
+            series_groupby=series_groupby, selection=selection,
+        )
+
+    def _try_device_groupby(
+        self, by, agg_func, axis, groupby_kwargs, agg_args, agg_kwargs, drop,
+        series_groupby, selection,
+    ) -> Optional["TpuQueryCompiler"]:
+        from modin_tpu.ops import groupby as gb_ops
+
+        if axis != 0 or agg_args:
+            return None
+        if not isinstance(agg_func, str) or agg_func not in gb_ops.SEGMENT_AGGS:
+            return None
+        if groupby_kwargs.get("level") is not None:
+            return None
+        if not groupby_kwargs.get("sort", True):
+            return None
+        if not groupby_kwargs.get("as_index", True) and agg_func == "size":
+            return None
+        dropna = groupby_kwargs.get("dropna", True)
+        # gate agg kwargs
+        numeric_only = bool(agg_kwargs.get("numeric_only", False))
+        if agg_kwargs.get("min_count", 0) not in (0, -1):
+            return None
+        if agg_kwargs.get("skipna", True) is not True:
+            return None
+        ddof = int(agg_kwargs.get("ddof", 1))
+        extra = set(agg_kwargs) - {"numeric_only", "min_count", "ddof", "skipna", "engine", "engine_kwargs"}
+        if extra:
+            return None
+        if agg_kwargs.get("engine") not in (None, "cython"):
+            return None
+
+        frame = self._modin_frame
+
+        # resolve key columns
+        key_positions: List[int] = []
+        key_labels: List[Any] = []
+        external_key = None
+        if isinstance(by, list) and drop and all(not hasattr(b, "to_pandas") for b in by):
+            for label in by:
+                pos = frame.column_position(label)
+                if len(pos) != 1 or pos[0] < 0:
+                    return None
+                key_positions.append(pos[0])
+                key_labels.append(label)
+            key_cols = [frame._columns[p] for p in key_positions]
+        elif isinstance(by, TpuQueryCompiler) or (
+            isinstance(by, list) and len(by) == 1 and isinstance(by[0], TpuQueryCompiler)
+        ):
+            ext = by if isinstance(by, TpuQueryCompiler) else by[0]
+            eframe = ext._modin_frame
+            if eframe.num_cols != 1 or not eframe.get_column(0).is_device:
+                return None
+            if len(eframe) != len(frame) or not self._fast_index_match(ext):
+                return None
+            external_key = eframe.get_column(0)
+            label = eframe.columns[0]
+            key_labels.append(None if label == MODIN_UNNAMED_SERIES_LABEL else label)
+            key_cols = [external_key]
+        else:
+            return None
+        if not all(
+            c.is_device and c.pandas_dtype.kind in "biuf" for c in key_cols
+        ):
+            return None
+        if len(frame) == 0:
+            return None
+
+        # resolve value columns
+        if selection is not None:
+            sel_list = [selection] if not isinstance(selection, list) else list(selection)
+            value_positions = []
+            for label in sel_list:
+                pos = frame.column_position(label)
+                if len(pos) != 1 or pos[0] < 0:
+                    return None
+                value_positions.append(pos[0])
+        else:
+            value_positions = [
+                i for i in range(frame.num_cols) if i not in key_positions
+            ]
+        value_cols = []
+        value_labels = []
+        for i in value_positions:
+            col = frame._columns[i]
+            # NOTE: datetime device columns are excluded — NaT is the int64-min
+            # sentinel and would aggregate as a regular value
+            if col.is_device and col.pandas_dtype.kind in "biuf":
+                value_cols.append(col)
+                value_labels.append(frame.columns[i])
+                continue
+            if numeric_only:
+                from pandas.api.types import is_numeric_dtype
+
+                if is_numeric_dtype(col.pandas_dtype):
+                    return None  # numeric but not device-computable: fall back
+                continue  # genuinely non-numeric: pandas would drop it too
+            if agg_func == "size":
+                continue
+            return None
+        if agg_func != "size" and not value_cols:
+            return None
+
+        try:
+            codes, n_groups, group_keys = gb_ops.factorize_keys(
+                [c.data for c in key_cols], len(frame), dropna=dropna
+            )
+        except gb_ops._TooManyGroups:
+            return None
+        if n_groups == 0:
+            return None
+
+        # bool value columns aggregate as ints for sum/mean/... like pandas
+        import jax.numpy as jnp
+
+        arrays = []
+        out_dtypes = []
+        for c in value_cols:
+            a = c.data
+            if a.dtype == jnp.bool_ and agg_func in ("sum", "prod", "mean", "var", "std", "sem"):
+                a = a.astype(jnp.int64)
+            arrays.append(a)
+        if agg_func == "size":
+            datas = gb_ops.groupby_reduce("size", [], codes, n_groups, len(frame))
+            value_labels = [MODIN_UNNAMED_SERIES_LABEL]
+            out_dtypes = [np.dtype(np.int64)]
+        else:
+            datas = gb_ops.groupby_reduce(
+                agg_func, arrays, codes, n_groups, len(frame), ddof=ddof
+            )
+            for c, d in zip(value_cols, datas):
+                if c.pandas_dtype.kind in "mM" and agg_func in ("min", "max"):
+                    out_dtypes.append(c.pandas_dtype)
+                else:
+                    out_dtypes.append(np.dtype(d.dtype))
+
+        # build result index from group keys
+        if len(key_labels) == 1:
+            result_index = pandas.Index(group_keys[0], name=key_labels[0])
+        else:
+            result_index = pandas.MultiIndex.from_arrays(group_keys, names=key_labels)
+
+        new_cols = [
+            DeviceColumn(d, dt, length=n_groups) for d, dt in zip(datas, out_dtypes)
+        ]
+        result_frame = TpuDataframe(
+            new_cols, pandas.Index(value_labels), result_index, nrows=n_groups
+        )
+        qc = type(self)(result_frame)
+        if not groupby_kwargs.get("as_index", True):
+            # keys become regular columns with a RangeIndex
+            qc = qc.reset_index(drop=False)
+        if series_groupby or agg_func == "size":
+            qc._shape_hint = "column"
+        return qc
+
+    # ------------------------------- sort ----------------------------- #
+
+    def sort_rows_by_column_values(self, columns: Any, ascending: Any = True, **kwargs: Any) -> "TpuQueryCompiler":
+        from modin_tpu.ops import sort as sort_ops
+
+        if (
+            kwargs.get("na_position", "last") == "last"
+            and kwargs.get("key") is None
+        ):
+            frame = self._modin_frame
+            col_list = [columns] if not isinstance(columns, list) else list(columns)
+            asc = ascending if isinstance(ascending, list) else [ascending] * len(col_list)
+            positions = []
+            for label in col_list:
+                pos = frame.column_position(label)
+                if len(pos) != 1 or pos[0] < 0:
+                    positions = None
+                    break
+                positions.append(pos[0])
+            if (
+                positions is not None
+                and len(frame) > 0
+                and all(
+                    frame._columns[p].is_device
+                    and frame._columns[p].pandas_dtype.kind in "biuf"
+                    for p in positions
+                )
+                and all(c.is_device for c in frame._columns)
+            ):
+                from modin_tpu.ops.structural import gather_columns_device
+
+                n = len(frame)
+                keys = [frame._columns[p].data for p in positions]
+                perm = sort_ops.lexsort_permutation(keys, n, [bool(a) for a in asc])
+                datas = gather_columns_device(
+                    [c.data for c in frame._columns], perm
+                )
+                new_cols = [
+                    DeviceColumn(d, c.pandas_dtype, length=n)
+                    for d, c in zip(datas, frame._columns)
+                ]
+                if kwargs.get("ignore_index", False):
+                    new_index = LazyIndex(pandas.RangeIndex(n), n)
+                else:
+                    lazy = frame._index
+                    new_index = LazyIndex(
+                        lambda: lazy.get().take(np.asarray(perm)[:n]), n
+                    )
+                return type(self)(
+                    TpuDataframe(new_cols, frame.columns, new_index, nrows=n)
+                )
+        return super().sort_rows_by_column_values(columns, ascending=ascending, **kwargs)
+
+
+# ---------------------------------------------------------------------- #
+# Generated overrides: binary ops and reductions try the device path and
+# fall back to the inherited defaults.
+# ---------------------------------------------------------------------- #
+
+def _make_binary_override(op: str):
+    base_method = getattr(BaseQueryCompiler, op)
+
+    def method(self: TpuQueryCompiler, other: Any, **kwargs: Any):
+        result = self._try_device_binary(op, other, kwargs)
+        if result is not None:
+            return result
+        return base_method(self, other, **kwargs)
+
+    method.__name__ = op
+    return method
+
+
+for _op in [
+    "add", "radd", "sub", "rsub", "mul", "rmul", "truediv", "rtruediv",
+    "floordiv", "rfloordiv", "mod", "rmod", "pow", "rpow",
+    "eq", "ne", "lt", "le", "gt", "ge",
+    "__and__", "__or__", "__xor__", "__rand__", "__ror__", "__rxor__",
+]:
+    setattr(TpuQueryCompiler, _op, _make_binary_override(_op))
+
+
+def _make_reduce_override(op: str):
+    base_method = getattr(BaseQueryCompiler, op)
+
+    def method(
+        self: TpuQueryCompiler,
+        axis: Any = 0,
+        skipna: bool = True,
+        numeric_only: bool = False,
+        **kwargs: Any,
+    ):
+        result = self._try_device_reduce(op, axis, skipna, numeric_only, kwargs)
+        if result is not None:
+            return result
+        return base_method(
+            self, axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs
+        )
+
+    method.__name__ = op
+    return method
+
+
+for _op in ["sum", "prod", "mean", "median", "min", "max", "var", "std", "sem", "skew", "kurt"]:
+    setattr(TpuQueryCompiler, _op, _make_reduce_override(_op))
+
+
+def _make_nonskipna_reduce_override(op: str):
+    base_method = getattr(BaseQueryCompiler, op)
+
+    def method(self: TpuQueryCompiler, axis: Any = 0, **kwargs: Any):
+        skipna = kwargs.pop("skipna", True)
+        numeric_only = kwargs.pop("numeric_only", False)
+        result = self._try_device_reduce(op, axis, skipna, numeric_only, kwargs)
+        if result is not None:
+            return result
+        if op == "count":
+            return base_method(self, axis=axis, numeric_only=numeric_only, **kwargs)
+        return base_method(self, axis=axis, skipna=skipna, **kwargs)
+
+    method.__name__ = op
+    return method
+
+
+for _op in ["count", "any", "all"]:
+    setattr(TpuQueryCompiler, _op, _make_nonskipna_reduce_override(_op))

@@ -1,0 +1,362 @@
+"""Groupby reductions as device segment operations (pad-aware).
+
+TPU-native replacement for the reference's GroupByReduce map+reduce pair
+(modin/core/dataframe/algebra/groupby.py:33, partition_manager.py:303): the
+per-block local groupby + cross-block regroup collapses into factorize (code
+assignment) + ``jax.ops.segment_*`` in one compiled program.  On a sharded
+array XLA emits per-shard segment partials + a psum over ICI — exactly the
+map/tree-reduce structure of the reference, compiled instead of scheduled.
+
+Key factorization strategies:
+- int-like keys with a small value range     -> direct offset codes (no sort)
+- anything else                              -> jnp.unique (device sort, one
+                                                host sync for the group count)
+
+Pad rows (positions >= n) are always routed to the overflow bucket
+``num_groups`` and sliced off after aggregation; NaN keys share that bucket
+when ``dropna=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+# aggregations expressible as segment reductions
+SEGMENT_AGGS = {
+    "sum", "count", "mean", "min", "max", "prod", "size", "var", "std",
+    "any", "all", "sem",
+}
+
+_RANGE_LIMIT = 1 << 22  # max direct-range width before falling back to unique
+
+
+class _TooManyGroups(Exception):
+    pass
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_key_minmax(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(k):
+        valid = jnp.arange(k.shape[0]) < n
+        kmin = jnp.min(jnp.where(valid, k, np.iinfo(np.int64).max))
+        kmax = jnp.max(jnp.where(valid, k, np.iinfo(np.int64).min))
+        return kmin, kmax
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_range_counts(n: int, kmin: int, width: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(k):
+        valid = jnp.arange(k.shape[0]) < n
+        ids = jnp.where(valid, k - kmin, width)
+        return jnp.zeros(width + 1, jnp.int64).at[ids].add(1)[:width]
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_range_codes(n: int, kmin: int, n_groups: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(k, remap):
+        valid = jnp.arange(k.shape[0]) < n
+        safe = jnp.where(valid, k - kmin, 0)
+        return jnp.where(valid, jnp.take(remap, safe), n_groups)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_float_prep(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(k):
+        valid = jnp.arange(k.shape[0]) < n
+        has_nan = jnp.any(jnp.isnan(k) & valid)
+        return jnp.where(valid, k, jnp.nan), has_nan
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_int_prep(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(k):
+        valid = jnp.arange(k.shape[0]) < n
+        return jnp.where(valid, k, k[0])
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_mask_codes(n: int, overflow: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(codes):
+        valid = jnp.arange(codes.shape[0]) < n
+        return jnp.where(valid, codes, overflow)
+
+    return jax.jit(fn)
+
+
+def factorize_keys(
+    key_cols: List[Any], n: int, dropna: bool = True
+) -> Tuple[Any, int, List[np.ndarray]]:
+    """Device factorization of one or more padded key columns (logical len n).
+
+    Returns (codes, num_groups, group_key_arrays_host): ``codes`` maps each
+    row to [0, num_groups), with pads (and NaN keys when dropna) mapped to
+    ``num_groups``.  Group key values are host-side, sorted ascending (pandas
+    sort=True order); a NaN group, when kept, is last.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if len(key_cols) == 1:
+        k = key_cols[0]
+        kdt = k.dtype
+        if jnp.issubdtype(kdt, jnp.integer) or kdt == jnp.bool_:
+            k64 = k.astype(jnp.int64)
+            kmin, kmax = (int(v) for v in jax.device_get(_jit_key_minmax(n)(k64)))
+            width = kmax - kmin + 1
+            if width <= _RANGE_LIMIT:
+                counts = np.asarray(
+                    jax.device_get(_jit_range_counts(n, kmin, width)(k64))
+                )
+                present = np.nonzero(counts)[0]
+                remap = np.full(width, len(present), dtype=np.int64)
+                remap[present] = np.arange(len(present))
+                codes = _jit_range_codes(n, kmin, len(present))(
+                    k64, jnp.asarray(remap)
+                )
+                uniques = (present + kmin).astype(np.int64)
+                if kdt == jnp.bool_:
+                    uniques = uniques.astype(bool)
+                else:
+                    uniques = uniques.astype(np.dtype(str(kdt)))
+                return codes, len(present), [uniques]
+            # large-range ints: unique path with pads mapped to k[0]
+            k_prepped = _jit_int_prep(n)(k64)
+            uniques, codes = jnp.unique(k_prepped, return_inverse=True)
+            n_groups = int(uniques.shape[0])
+            codes = _jit_mask_codes(n, n_groups)(codes)
+            uniques_host = np.asarray(jax.device_get(uniques)).astype(np.dtype(str(kdt)))
+            return codes, n_groups, [uniques_host]
+        if jnp.issubdtype(kdt, jnp.floating):
+            k_prepped, has_nan = _jit_float_prep(n)(k)
+            has_nan = bool(has_nan)
+            uniques, codes = jnp.unique(k_prepped, return_inverse=True)
+            uniques_host = np.asarray(jax.device_get(uniques))
+            n_valid = int(np.sum(~np.isnan(uniques_host)))
+            # jnp.unique sorts NaN last; every NaN row (and pad) got a code
+            # >= n_valid — clamp them to one bucket
+            if dropna or not has_nan:
+                codes = _jit_clamp_codes(n, n_valid)(codes)
+                return codes, n_valid, [uniques_host[:n_valid]]
+            # keep the NaN group (real NaNs), pads -> overflow
+            codes = _jit_nan_group_codes(n, n_valid)(codes, k)
+            return codes, n_valid + 1, [
+                np.concatenate([uniques_host[:n_valid], [np.nan]])
+            ]
+        raise _TooManyGroups()
+
+    # multi-key: combine per-level codes into one composite code
+    level_codes = []
+    level_uniques = []
+    n_groups_each = []
+    for k in key_cols:
+        codes_i, n_i, uniques_i = factorize_keys([k], n, dropna=dropna)
+        level_codes.append(codes_i)
+        level_uniques.append(uniques_i[0])
+        n_groups_each.append(n_i)
+    total = int(np.prod(n_groups_each))
+    if total > _RANGE_LIMIT * 4:
+        raise _TooManyGroups()
+    composite = _jit_composite(tuple(n_groups_each), n, total)(tuple(level_codes))
+    counts = np.asarray(jax.device_get(_jit_bincount(total)(composite)))
+    present = np.nonzero(counts)[0]
+    remap = np.full(total + 1, len(present), dtype=np.int64)
+    remap[present] = np.arange(len(present))
+    import jax.numpy as jnp2
+
+    codes = _jit_remap(len(present))(composite, jnp2.asarray(remap))
+    keys_out: List[np.ndarray] = []
+    rem = present.copy()
+    for uniques_i, n_i in zip(reversed(level_uniques), reversed(n_groups_each)):
+        keys_out.append(np.asarray(uniques_i)[rem % n_i])
+        rem = rem // n_i
+    keys_out.reverse()
+    return codes, len(present), keys_out
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_clamp_codes(n: int, n_valid: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(codes):
+        valid = jnp.arange(codes.shape[0]) < n
+        return jnp.where(valid, jnp.minimum(codes, n_valid), n_valid)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_nan_group_codes(n: int, n_valid: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(codes, k):
+        valid = jnp.arange(codes.shape[0]) < n
+        is_nan = jnp.isnan(k) & valid
+        clamped = jnp.minimum(codes, n_valid + 1)
+        out = jnp.where(is_nan, n_valid, clamped)
+        return jnp.where(valid, out, n_valid + 1)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_composite(n_groups_each: Tuple[int, ...], n: int, total: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(level_codes: Tuple):
+        valid = jnp.arange(level_codes[0].shape[0]) < n
+        # a row is valid only if every level code is in range
+        in_range = valid
+        for codes_i, n_i in zip(level_codes, n_groups_each):
+            in_range = in_range & (codes_i < n_i)
+        composite = jnp.zeros(level_codes[0].shape, jnp.int64)
+        for codes_i, n_i in zip(level_codes, n_groups_each):
+            composite = composite * n_i + jnp.minimum(codes_i, n_i - 1)
+        return jnp.where(in_range, composite, total)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_bincount(total: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(composite):
+        return jnp.zeros(total + 1, jnp.int64).at[composite].add(1)[:total]
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_remap(n_present: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(composite, remap):
+        return jnp.take(remap, composite)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int):
+    """One jit computing the aggregation for every value column."""
+    import jax
+    import jax.numpy as jnp
+
+    def seg(c, codes):
+        is_f = jnp.issubdtype(c.dtype, jnp.floating)
+        ns = num_segments
+        if agg in ("sum", "mean", "var", "std", "sem"):
+            x = jnp.where(jnp.isnan(c), 0, c) if is_f else c
+            s = jax.ops.segment_sum(x, codes, num_segments=ns)
+            if agg == "sum":
+                return s
+            valid = (~jnp.isnan(c)).astype(jnp.int64) if is_f else jnp.ones(c.shape, jnp.int64)
+            ncnt = jax.ops.segment_sum(valid, codes, num_segments=ns)
+            mean = s / ncnt
+            if agg == "mean":
+                return mean
+            # two-pass centered variance: gathering the group mean back per row
+            # avoids the catastrophic cancellation of E[x^2]-E[x]^2
+            d = x.astype(jnp.float64) - jnp.take(mean, codes)
+            d = jnp.where(valid.astype(bool), d, 0.0)
+            s2 = jax.ops.segment_sum(d * d, codes, num_segments=ns)
+            var = s2 / jnp.maximum(ncnt - ddof, 1)
+            var = jnp.where(ncnt - ddof > 0, var, jnp.nan)
+            if agg == "var":
+                return var
+            if agg == "std":
+                return jnp.sqrt(var)
+            return jnp.sqrt(var / ncnt)  # sem
+        if agg == "count":
+            valid = (~jnp.isnan(c)).astype(jnp.int64) if is_f else jnp.ones(c.shape, jnp.int64)
+            return jax.ops.segment_sum(valid, codes, num_segments=ns)
+        if agg == "prod":
+            x = jnp.where(jnp.isnan(c), 1, c) if is_f else c
+            return jax.ops.segment_prod(x, codes, num_segments=ns)
+        if agg == "min":
+            x = jnp.where(jnp.isnan(c), jnp.inf, c) if is_f else c
+            r = jax.ops.segment_min(x, codes, num_segments=ns)
+            return jnp.where(jnp.isposinf(r), jnp.nan, r) if is_f else r
+        if agg == "max":
+            x = jnp.where(jnp.isnan(c), -jnp.inf, c) if is_f else c
+            r = jax.ops.segment_max(x, codes, num_segments=ns)
+            return jnp.where(jnp.isneginf(r), jnp.nan, r) if is_f else r
+        if agg == "any":
+            x = jnp.where(jnp.isnan(c), False, c != 0) if is_f else (c != 0 if c.dtype != jnp.bool_ else c)
+            return jax.ops.segment_max(x.astype(jnp.int32), codes, num_segments=ns).astype(bool)
+        if agg == "all":
+            x = jnp.where(jnp.isnan(c), True, c != 0) if is_f else (c != 0 if c.dtype != jnp.bool_ else c)
+            return jax.ops.segment_min(x.astype(jnp.int32), codes, num_segments=ns).astype(bool)
+        raise ValueError(agg)
+
+    def fn(cols: Tuple, codes):
+        return tuple(seg(c, codes) for c in cols)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_segment_size(num_segments: int, n: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(codes):
+        return jax.ops.segment_sum(
+            jnp.ones(codes.shape, jnp.int64), codes, num_segments=num_segments
+        )
+
+    return jax.jit(fn)
+
+
+def groupby_reduce(
+    agg: str,
+    value_cols: List[Any],
+    codes: Any,
+    num_groups: int,
+    n: int,
+    ddof: int = 1,
+) -> List[Any]:
+    """Aggregate value columns by group codes; returns device arrays of length
+    num_groups (the overflow pad/NaN bucket is sliced off)."""
+    ns = num_groups + 1
+    if agg == "size":
+        return [_jit_segment_size(ns, n)(codes)[:num_groups]]
+    fn = _jit_segment_agg(agg, len(value_cols), ns, int(ddof))
+    results = fn(tuple(value_cols), codes)
+    return [r[:num_groups] for r in results]

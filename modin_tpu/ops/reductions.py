@@ -1,0 +1,223 @@
+"""Column reductions with pandas NaN semantics, pad-aware.
+
+TPU-native replacement for the reference's Reduce/TreeReduce operators
+(modin/core/dataframe/algebra/tree_reduce.py:29): on a sharded jax.Array a
+``jnp.sum`` lowers to per-shard partial reduction + an XLA ``psum`` over ICI —
+the map/axis-reduce task pair of the reference collapses into one compiled
+collective program.
+
+All per-column reductions of a frame run in ONE jit so a ``df.sum()`` costs
+one dispatch + one small fetch regardless of column count.  Columns are
+padded to the shard count; every kernel masks rows >= n (the logical length,
+passed statically).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Tuple
+
+import numpy as np
+
+
+def _masked(c, n, neutral):
+    import jax.numpy as jnp
+
+    if c.shape[0] == n:
+        return c
+    valid = jnp.arange(c.shape[0]) < n
+    return jnp.where(valid, c, neutral)
+
+
+def _valid_mask(c, n):
+    import jax.numpy as jnp
+
+    return jnp.arange(c.shape[0]) < n
+
+
+def _reduce_one(op: str, c, n: int, skipna: bool, ddof: int):
+    """Reduce one padded column with logical length n."""
+    import jax.numpy as jnp
+
+    is_f = jnp.issubdtype(c.dtype, jnp.floating)
+    valid = _valid_mask(c, n)
+    nan_mask = jnp.isnan(c) & valid if is_f else jnp.zeros(c.shape, bool)
+    use = valid & ~nan_mask if (skipna and is_f) else valid
+    n_use = jnp.sum(use)
+
+    if op == "count":
+        return jnp.sum(valid & ~nan_mask).astype(jnp.int64)
+    if op == "sum":
+        return jnp.sum(jnp.where(use, c, 0))
+    if op == "prod":
+        return jnp.prod(jnp.where(use, c, 1))
+    if op == "min":
+        if is_f:
+            r = jnp.min(jnp.where(use, c, jnp.inf))
+            any_nan = jnp.any(nan_mask & valid) & (not skipna)
+            return jnp.where(jnp.isinf(r) & (n_use == 0), jnp.nan, jnp.where(any_nan, jnp.nan, r))
+        return jnp.min(jnp.where(use, c, _int_max(c.dtype)))
+    if op == "max":
+        if is_f:
+            r = jnp.max(jnp.where(use, c, -jnp.inf))
+            any_nan = jnp.any(nan_mask & valid) & (not skipna)
+            return jnp.where(jnp.isinf(-r) & (n_use == 0), jnp.nan, jnp.where(any_nan, jnp.nan, r))
+        return jnp.max(jnp.where(use, c, _int_min(c.dtype)))
+    if op in ("mean", "var", "std", "sem", "skew", "kurt"):
+        x = jnp.where(use, c, 0).astype(jnp.float64)
+        s = jnp.sum(x)
+        mean = s / n_use
+        if op == "mean":
+            if is_f and not skipna:
+                return jnp.where(jnp.any(nan_mask), jnp.nan, mean)
+            return jnp.where(n_use == 0, jnp.nan, mean)
+        d = jnp.where(use, x - mean, 0.0)
+        m2s = jnp.sum(d**2)
+        if op in ("var", "std", "sem"):
+            var = m2s / jnp.maximum(n_use - ddof, 1)
+            var = jnp.where(n_use - ddof > 0, var, jnp.nan)
+            if is_f and not skipna:
+                var = jnp.where(jnp.any(nan_mask), jnp.nan, var)
+            if op == "var":
+                return var
+            if op == "std":
+                return jnp.sqrt(var)
+            return jnp.sqrt(var / n_use)
+        nf = n_use.astype(jnp.float64)
+        m2 = m2s / nf
+        if op == "skew":
+            m3 = jnp.sum(d**3) / nf
+            g1 = m3 / jnp.where(m2 > 0, m2, 1.0) ** 1.5
+            res = jnp.sqrt(nf * (nf - 1.0)) / (nf - 2.0) * g1
+            res = jnp.where((nf < 3) | (m2 == 0), jnp.nan, res)
+        else:  # kurt — sample excess kurtosis G2, pandas' nankurt
+            m4 = jnp.sum(d**4) / nf
+            g2 = m4 / jnp.where(m2 > 0, m2, 1.0) ** 2 - 3.0
+            res = ((nf + 1.0) * g2 + 6.0) * (nf - 1.0) / ((nf - 2.0) * (nf - 3.0))
+            res = jnp.where((nf < 4) | (m2 == 0), jnp.nan, res)
+        if is_f and not skipna:
+            res = jnp.where(jnp.any(nan_mask), jnp.nan, res)
+        return res
+    if op == "median":
+        x = jnp.where(use, c, jnp.nan).astype(jnp.float64)
+        return jnp.nanmedian(x)
+    if op == "any":
+        truthy = jnp.where(nan_mask, not skipna, c != 0) if is_f else (c != 0 if c.dtype != jnp.bool_ else c)
+        return jnp.any(truthy & valid)
+    if op == "all":
+        truthy = jnp.where(nan_mask, True, c != 0) if is_f else (c != 0 if c.dtype != jnp.bool_ else c)
+        return jnp.all(truthy | ~valid)
+    raise ValueError(op)
+
+
+def _int_max(dtype):
+    import jax.numpy as jnp
+
+    if dtype == jnp.bool_:
+        return True
+    return np.iinfo(np.dtype(str(dtype))).max
+
+
+def _int_min(dtype):
+    import jax.numpy as jnp
+
+    if dtype == jnp.bool_:
+        return False
+    return np.iinfo(np.dtype(str(dtype))).min
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_reduce(op_name: str, n_cols: int, n: int, skipna: bool, ddof: int):
+    import jax
+
+    def fn(cols: Tuple) -> Tuple:
+        return tuple(_reduce_one(op_name, c, n, skipna, ddof) for c in cols)
+
+    return jax.jit(fn)
+
+
+def reduce_columns(op_name: str, cols: List[Any], n: int, skipna: bool = True, ddof: int = 1) -> list:
+    """Reduce each padded column (logical length n) to a scalar; one fetch."""
+    import jax
+
+    fn = _jit_reduce(op_name, len(cols), int(n), bool(skipna), int(ddof))
+    results = fn(tuple(cols))
+    return [np.asarray(r) for r in jax.device_get(results)]
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_reduce_axis1(op_name: str, n_cols: int, skipna: bool, ddof: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(cols: Tuple):
+        # pad rows produce garbage values that are sliced off logically
+        common = jnp.result_type(*[c.dtype for c in cols])
+        x = jnp.stack([c.astype(common) for c in cols], axis=0)
+        is_f = jnp.issubdtype(x.dtype, jnp.floating)
+        if op_name == "count":
+            if is_f:
+                return jnp.sum(~jnp.isnan(x), axis=0).astype(jnp.int64)
+            return jnp.full((x.shape[1],), n_cols, jnp.int64)
+        if not is_f or not skipna:
+            reducer = {
+                "sum": jnp.sum, "mean": jnp.mean, "min": jnp.min, "max": jnp.max,
+                "median": jnp.median,
+            }.get(op_name)
+            if reducer is not None:
+                return reducer(x, axis=0)
+            if op_name == "var":
+                return jnp.var(x, axis=0, ddof=ddof)
+            if op_name == "std":
+                return jnp.std(x, axis=0, ddof=ddof)
+        reducer = {
+            "sum": jnp.nansum, "mean": jnp.nanmean, "min": jnp.nanmin,
+            "max": jnp.nanmax, "median": jnp.nanmedian,
+        }.get(op_name)
+        if reducer is not None:
+            return reducer(x, axis=0)
+        if op_name == "var":
+            return jnp.nanvar(x, axis=0, ddof=ddof)
+        if op_name == "std":
+            return jnp.nanstd(x, axis=0, ddof=ddof)
+        raise ValueError(op_name)
+
+    return jax.jit(fn)
+
+
+def reduce_axis1(op_name: str, cols: List[Any], skipna: bool = True, ddof: int = 1) -> Any:
+    """Row-wise reduction across columns; returns a padded device 1-D array."""
+    fn = _jit_reduce_axis1(op_name, len(cols), bool(skipna), int(ddof))
+    return fn(tuple(cols))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_idx_minmax(op_name: str, n_cols: int, n: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(cs: Tuple) -> Tuple:
+        out = []
+        for c in cs:
+            is_f = jnp.issubdtype(c.dtype, jnp.floating)
+            if op_name == "idxmin":
+                neutral = jnp.inf if is_f else _int_max(c.dtype)
+                x = _masked(c, n, neutral)
+                x = jnp.where(jnp.isnan(x), jnp.inf, x) if is_f else x
+                out.append(jnp.argmin(x))
+            else:
+                neutral = -jnp.inf if is_f else _int_min(c.dtype)
+                x = _masked(c, n, neutral)
+                x = jnp.where(jnp.isnan(x), -jnp.inf, x) if is_f else x
+                out.append(jnp.argmax(x))
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
+def idx_minmax(op_name: str, cols: List[Any], n: int, skipna: bool = True) -> List[int]:
+    """argmin/argmax position per padded column with NaN skipping; one fetch."""
+    import jax
+
+    results = _jit_idx_minmax(op_name, len(cols), int(n))(tuple(cols))
+    return [int(r) for r in jax.device_get(results)]

@@ -1,0 +1,82 @@
+"""Device sort kernels: stable multi-key argsort + permutation apply (pad-aware).
+
+TPU-native replacement for the reference's range-partitioning sort
+(modin/core/dataframe/pandas/dataframe/dataframe.py:2565 sample->pivot->
+shuffle->local-sort): on a device mesh a global ``jnp.argsort`` over a sharded
+array already lowers to XLA's distributed sort (bitonic/radix over ICI), so
+the four-stage shuffle collapses into one compiled op.
+
+Pad rows are forced to sort after every valid row (stability keeps valid rows,
+whose positions are < n, ahead on ties), so sorted frames keep their trailing
+pads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Tuple
+
+import numpy as np
+
+
+def _pad_sentinel(dtype, ascending: bool):
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if ascending else -jnp.inf
+    if dtype == jnp.bool_:
+        return True if ascending else False
+    info = np.iinfo(np.dtype(str(dtype)))
+    return info.max if ascending else info.min
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_lexsort(n_keys: int, n: int, n_asc: Tuple[bool, ...], na_last: bool):
+    import jax
+    import jax.numpy as jnp
+
+    def order_one(k_masked, ascending, perm):
+        kk = jnp.take(k_masked, perm)
+        if jnp.issubdtype(kk.dtype, jnp.floating):
+            if ascending:
+                key = (
+                    jnp.where(jnp.isnan(kk), jnp.inf, kk)
+                    if na_last
+                    else jnp.where(jnp.isnan(kk), -jnp.inf, kk)
+                )
+                o = jnp.argsort(key, stable=True)
+            else:
+                key = (
+                    jnp.where(jnp.isnan(kk), -jnp.inf, kk)
+                    if na_last
+                    else jnp.where(jnp.isnan(kk), jnp.inf, kk)
+                )
+                o = jnp.argsort(key, stable=True, descending=True)
+        else:
+            o = jnp.argsort(kk, stable=True, descending=not ascending)
+        return jnp.take(perm, o)
+
+    def fn(keys: Tuple):
+        p = keys[0].shape[0]
+        valid = jnp.arange(p) < n
+        masked = [
+            jnp.where(valid, k, _pad_sentinel(k.dtype, asc))
+            for k, asc in zip(keys, n_asc)
+        ]
+        perm = jnp.arange(p, dtype=jnp.int64)
+        # least-significant key first; stable sorts preserve prior order
+        for i in range(n_keys - 1, -1, -1):
+            perm = order_one(masked[i], n_asc[i], perm)
+        return perm
+
+    return jax.jit(fn)
+
+
+def lexsort_permutation(
+    keys: List[Any], n: int, ascending: List[bool], na_position: str = "last"
+) -> Any:
+    """Stable permutation ordering rows by the given padded keys."""
+    fn = _jit_lexsort(
+        len(keys), int(n), tuple(bool(a) for a in ascending), na_position == "last"
+    )
+    return fn(tuple(keys))

@@ -1,0 +1,101 @@
+"""Structural device kernels: pad-aware gather, slice, and concat.
+
+Device columns are padded to a multiple of the mesh row-shard count so
+``device_put``/jit keep the rows axis sharded (XLA requires even shards for
+explicitly laid-out arrays; uneven results fall back to replication).  Every
+kernel here receives the **logical** lengths statically and never reads pad
+rows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Tuple
+
+import numpy as np
+
+
+def pad_len(n: int) -> int:
+    """Smallest multiple of the mesh row-shard count >= n (and >= 1 shard)."""
+    from modin_tpu.parallel.mesh import num_row_shards
+
+    s = num_row_shards()
+    return max(((n + s - 1) // s) * s, s)
+
+
+def pad_host(values: np.ndarray, n: int | None = None) -> np.ndarray:
+    """Pad a host array with zeros to the sharded length."""
+    n = len(values) if n is None else n
+    p = pad_len(n)
+    if len(values) == p:
+        return values
+    pad_block = np.zeros(p - len(values), dtype=values.dtype)
+    return np.concatenate([values, pad_block])
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_gather(n_cols: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(cols: Tuple, positions):
+        return tuple(jnp.take(c, positions, axis=0) for c in cols)
+
+    return jax.jit(fn)
+
+
+def gather_columns(cols: List[Any], positions: np.ndarray) -> Tuple[List[Any], int]:
+    """Gather logical positions from padded columns.
+
+    Returns (new padded device arrays, logical length).  The positions array
+    is itself padded with 0 so the gather output stays evenly sharded.
+    """
+    from modin_tpu.parallel.engine import JaxWrapper
+
+    n_out = len(positions)
+    padded = pad_host(np.asarray(positions, dtype=np.int64), n_out)
+    device_positions = JaxWrapper.put(padded)
+    return list(_jit_gather(len(cols))(tuple(cols), device_positions)), n_out
+
+
+def gather_columns_device(cols: List[Any], device_positions: Any) -> List[Any]:
+    """Gather with an already-padded device positions array."""
+    return list(_jit_gather(len(cols))(tuple(cols), device_positions))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_concat(n_parts: int, n_cols: int, lengths: Tuple[int, ...], p_out: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(parts: Tuple[Tuple, ...]):
+        # parts[i] is the tuple of columns of part i (all padded)
+        offsets = []
+        off = 0
+        for i in range(n_parts):
+            offsets.append(off)
+            off += parts[i][0].shape[0]
+        # positions into the naive concatenation that skip the pads
+        pos_list = [
+            jnp.arange(lengths[i], dtype=jnp.int64) + offsets[i]
+            for i in range(n_parts)
+        ]
+        total = sum(lengths)
+        pos = jnp.concatenate(pos_list + [jnp.zeros(p_out - total, jnp.int64)])
+        out = []
+        for ci in range(n_cols):
+            big = jnp.concatenate([parts[i][ci] for i in range(n_parts)])
+            out.append(jnp.take(big, pos, axis=0))
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
+def concat_columns(parts: List[List[Any]], lengths: List[int]) -> Tuple[List[Any], int]:
+    """Row-concat column sets (each padded), producing padded outputs."""
+    n_out = sum(lengths)
+    p_out = pad_len(n_out)
+    fn = _jit_concat(len(parts), len(parts[0]), tuple(lengths), p_out)
+    return list(fn(tuple(tuple(p) for p in parts))), n_out
+
+

@@ -17,6 +17,12 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+import jax  # noqa: E402
+
+# The axon TPU plugin in this image overrides JAX_PLATFORMS from the
+# environment; the explicit config update wins.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

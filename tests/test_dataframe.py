@@ -244,11 +244,21 @@ class TestStructure:
         df_equals(md.T, pdf.T)
 
     def test_sort_values(self, data):
+        # device sort is always stable; compare against pandas' stable kind
+        # (tie order under pandas' default quicksort is an impl detail the
+        # reference doesn't reproduce across partitions either)
         md, pdf = create_test_dfs(data)
-        df_equals(md.sort_values("col0"), pdf.sort_values("col0"))
         df_equals(
-            md.sort_values(["col0", "col1"], ascending=[False, True]),
-            pdf.sort_values(["col0", "col1"], ascending=[False, True]),
+            md.sort_values("col0", kind="stable"),
+            pdf.sort_values("col0", kind="stable"),
+        )
+        df_equals(
+            md.sort_values(["col0", "col1"], ascending=[False, True], kind="stable"),
+            pdf.sort_values(["col0", "col1"], ascending=[False, True], kind="stable"),
+        )
+        df_equals(
+            md.sort_values("col1", ascending=False, kind="stable"),
+            pdf.sort_values("col1", ascending=False, kind="stable"),
         )
 
     def test_sort_index(self, data):

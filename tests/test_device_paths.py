@@ -1,0 +1,123 @@
+"""White-box tests: the hot ops must run on DEVICE (no pandas fallback).
+
+Counterpart of the reference's internals tests
+(modin/tests/core/storage_formats/pandas/test_internals.py): asserts the
+device fast paths actually engage and stay sharded.
+"""
+
+import warnings
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.core.storage_formats.tpu.query_compiler import TpuQueryCompiler
+from tests.utils import df_equals
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu_backend():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("device-path tests require the TpuOnJax execution")
+
+
+def make_df(n=1000, cols=3, seed=0):
+    rng = np.random.default_rng(seed)
+    data = {f"c{i}": rng.uniform(-10, 10, n) for i in range(cols)}
+    data["k"] = rng.integers(0, 5, n)
+    return pd.DataFrame(data)
+
+
+def assert_no_fallback(fn):
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        return fn()
+
+
+def test_frame_is_device_backed():
+    df = make_df()
+    qc = df._query_compiler
+    assert isinstance(qc, TpuQueryCompiler)
+    assert all(c.is_device for c in qc._modin_frame._columns)
+
+
+def test_columns_are_padded_and_sharded():
+    from modin_tpu.parallel.mesh import num_row_shards
+
+    df = make_df(n=1001)
+    col = df._query_compiler._modin_frame.get_column(0)
+    assert col.length == 1001
+    assert col.data.shape[0] % num_row_shards() == 0
+    assert col.data.shape[0] >= 1001
+
+
+def test_binary_no_fallback():
+    df = make_df()
+    result = assert_no_fallback(lambda: df + df)
+    assert all(c.is_device for c in result._query_compiler._modin_frame._columns)
+    result2 = assert_no_fallback(lambda: df * 2.5)
+    df_equals(result2, df._to_pandas() * 2.5)
+
+
+def test_reduce_no_fallback():
+    df = make_df()
+    s = assert_no_fallback(lambda: df.sum())
+    df_equals(s, df._to_pandas().sum())
+    assert_no_fallback(lambda: df.mean())
+    assert_no_fallback(lambda: df.max(axis=1))
+
+
+def test_groupby_sum_no_fallback():
+    df = make_df()
+    result = assert_no_fallback(lambda: df.groupby("k").sum())
+    df_equals(result, df._to_pandas().groupby("k").sum())
+    # the aggregation result itself stays on device
+    assert all(
+        c.is_device for c in result._query_compiler._modin_frame._columns
+    )
+
+
+def test_sort_no_fallback():
+    df = make_df()
+    result = assert_no_fallback(lambda: df.sort_values("c0"))
+    df_equals(result, df._to_pandas().sort_values("c0", kind="stable"))
+
+
+def test_filter_no_fallback():
+    df = make_df()
+    result = assert_no_fallback(lambda: df[df["c0"] > 0])
+    df_equals(result, (lambda p: p[p["c0"] > 0])(df._to_pandas()))
+
+
+def test_computed_column_drops_host_cache():
+    df = make_df()
+    out = df + 1
+    col = out._query_compiler._modin_frame.get_column(0)
+    assert col.host_cache is None
+    src = df._query_compiler._modin_frame.get_column(0)
+    assert src.host_cache is not None
+
+
+def test_fallback_roundtrips_to_device():
+    # a defaulted op must return a Tpu-backed compiler again
+    df = make_df()
+    result = df.rank()
+    assert isinstance(result._query_compiler, TpuQueryCompiler)
+
+
+def test_sharding_spans_mesh():
+    from modin_tpu.parallel.mesh import get_mesh, num_row_shards
+
+    if num_row_shards() < 2:
+        pytest.skip("needs a multi-device mesh")
+    df = make_df(n=4096)
+    col = df._query_compiler._modin_frame.get_column(0)
+    assert len(col.data.sharding.device_set) == num_row_shards()
+
+
+def test_reduction_over_sharded_matches(enable_benchmark_mode):
+    df = make_df(n=4096)
+    df_equals(df.sum(), df._to_pandas().sum())

@@ -1,0 +1,90 @@
+"""Regression tests for dtype edge cases caught in review: NaT sentinels,
+int-pow semantics, large-mean variance stability, nullable extension dtypes."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals
+
+DT_DATA = {
+    "ts": pandas.to_datetime(
+        ["2020-01-01", None, "2021-06-15", "2019-03-02", None]
+    ),
+    "k": [1, 1, 2, 2, 2],
+    "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+}
+
+
+def test_isna_with_nat():
+    md, pdf = create_test_dfs(DT_DATA)
+    df_equals(md.isna(), pdf.isna())
+    df_equals(md.notna(), pdf.notna())
+    df_equals(md["ts"].isna(), pdf["ts"].isna())
+
+
+def test_groupby_datetime_min_max_count():
+    md, pdf = create_test_dfs(DT_DATA)
+    df_equals(md.groupby("k")["ts"].min(), pdf.groupby("k")["ts"].min())
+    df_equals(md.groupby("k")["ts"].max(), pdf.groupby("k")["ts"].max())
+    df_equals(md.groupby("k")["ts"].count(), pdf.groupby("k")["ts"].count())
+
+
+def test_sort_datetime_nat_last():
+    md, pdf = create_test_dfs(DT_DATA)
+    df_equals(
+        md.sort_values("ts", kind="stable"), pdf.sort_values("ts", kind="stable")
+    )
+
+
+def test_datetime_reductions():
+    md, pdf = create_test_dfs(DT_DATA)
+    df_equals(md["ts"].min(), pdf["ts"].min())
+    df_equals(md.count(), pdf.count())
+    df_equals(md.dropna(), pdf.dropna())
+
+
+def test_int_negative_pow_matches_pandas():
+    md, pdf = create_test_dfs({"a": [2, 3], "b": [-1, 2]})
+    with pytest.raises(ValueError):
+        pdf["a"] ** pdf["b"]
+    with pytest.raises(ValueError):
+        md["a"] ** md["b"]
+    with pytest.raises(ValueError):
+        2 ** pdf["b"]
+    with pytest.raises(ValueError):
+        2 ** md["b"]
+    df_equals(md["a"] ** 3, pdf["a"] ** 3)
+    df_equals(md["a"] ** -1.0, pdf["a"] ** -1.0)
+
+
+def test_groupby_var_large_mean():
+    base = 1e8
+    md, pdf = create_test_dfs(
+        {"k": [1, 1, 1, 1], "v": [base + 1, base + 2, base + 3, base + 4]}
+    )
+    df_equals(md.groupby("k")["v"].var(), pdf.groupby("k")["v"].var())
+    df_equals(md.groupby("k")["v"].std(), pdf.groupby("k")["v"].std())
+
+
+def test_groupby_numeric_only_nullable_ext():
+    md, pdf = create_test_dfs(
+        {
+            "k": [1, 1, 2],
+            "a": pandas.array([1, 2, 3], dtype="Int64"),
+            "b": [1.0, 2.0, 3.0],
+        }
+    )
+    df_equals(
+        md.groupby("k").sum(numeric_only=True),
+        pdf.groupby("k").sum(numeric_only=True),
+    )
+
+
+def test_timedelta_roundtrip_and_ops():
+    md, pdf = create_test_dfs(
+        {"td": pandas.to_timedelta(["1 days", None, "3 days"])}
+    )
+    df_equals(md, pdf)
+    df_equals(md.isna(), pdf.isna())

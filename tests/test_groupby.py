@@ -1,0 +1,164 @@
+"""Differential groupby tests (modeled on modin/tests/pandas/test_groupby.py)."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals
+
+_rng = np.random.default_rng(7)
+N = 200
+
+GB_DATA = {
+    "int_key": _rng.integers(0, 10, N),
+    "sparse_key": _rng.choice([3, 70, 1000, -5], N),
+    "float_key": _rng.choice([0.5, 1.25, np.nan, 7.0], N),
+    "val_int": _rng.integers(-50, 50, N),
+    "val_float": np.where(_rng.random(N) < 0.2, np.nan, _rng.uniform(-1, 1, N)),
+    "val_bool": _rng.random(N) < 0.5,
+}
+
+AGGS = ["sum", "count", "mean", "min", "max", "prod", "var", "std", "sem", "any", "all"]
+
+
+@pytest.fixture
+def dfs():
+    return create_test_dfs(GB_DATA)
+
+
+@pytest.mark.parametrize("agg", AGGS)
+@pytest.mark.parametrize("key", ["int_key", "sparse_key", "float_key"])
+def test_groupby_agg(dfs, agg, key):
+    md, pdf = dfs
+    df_equals(
+        getattr(md.groupby(key), agg)(),
+        getattr(pdf.groupby(key), agg)(),
+    )
+
+
+@pytest.mark.parametrize("agg", ["sum", "mean", "count"])
+def test_groupby_multikey(dfs, agg):
+    md, pdf = dfs
+    df_equals(
+        getattr(md.groupby(["int_key", "sparse_key"]), agg)(),
+        getattr(pdf.groupby(["int_key", "sparse_key"]), agg)(),
+    )
+
+
+def test_groupby_size(dfs):
+    md, pdf = dfs
+    df_equals(md.groupby("int_key").size(), pdf.groupby("int_key").size())
+
+
+def test_groupby_selection(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key")["val_float"].sum(),
+        pdf.groupby("int_key")["val_float"].sum(),
+    )
+    df_equals(
+        md.groupby("int_key")[["val_int", "val_float"]].mean(),
+        pdf.groupby("int_key")[["val_int", "val_float"]].mean(),
+    )
+
+
+def test_groupby_as_index_false(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key", as_index=False).sum(),
+        pdf.groupby("int_key", as_index=False).sum(),
+    )
+
+
+def test_groupby_dropna_false(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("float_key", dropna=False).sum(),
+        pdf.groupby("float_key", dropna=False).sum(),
+    )
+
+
+def test_groupby_external_series(dfs):
+    md, pdf = dfs
+    df_equals(
+        md["val_float"].groupby(md["int_key"]).sum(),
+        pdf["val_float"].groupby(pdf["int_key"]).sum(),
+    )
+
+
+def test_groupby_numeric_only_with_strings():
+    md, pdf = create_test_dfs(
+        {"k": [1, 1, 2], "v": [1.0, 2.0, 3.0], "s": ["a", "b", "c"]}
+    )
+    df_equals(
+        md.groupby("k").sum(numeric_only=True),
+        pdf.groupby("k").sum(numeric_only=True),
+    )
+    # numeric_only=False concatenates strings — host fallback path
+    df_equals(md.groupby("k").sum(), pdf.groupby("k").sum())
+
+
+def test_groupby_min_count(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key").sum(min_count=15),
+        pdf.groupby("int_key").sum(min_count=15),
+    )
+
+
+def test_groupby_median_quantile(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key")[["val_int", "val_float"]].median(),
+        pdf.groupby("int_key")[["val_int", "val_float"]].median(),
+    )
+    df_equals(
+        md.groupby("int_key")[["val_int", "val_float"]].quantile(0.25),
+        pdf.groupby("int_key")[["val_int", "val_float"]].quantile(0.25),
+    )
+
+
+def test_groupby_apply_transform(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key")["val_int"].transform("mean"),
+        pdf.groupby("int_key")["val_int"].transform("mean"),
+    )
+
+
+def test_groupby_agg_dict(dfs):
+    md, pdf = dfs
+    spec = {"val_int": "sum", "val_float": "mean"}
+    df_equals(md.groupby("int_key").agg(spec), pdf.groupby("int_key").agg(spec))
+
+
+def test_groupby_iteration(dfs):
+    md, pdf = dfs
+    for (mk, mg), (pk, pg) in zip(md.groupby("int_key"), pdf.groupby("int_key")):
+        assert mk == pk
+        df_equals(mg, pg)
+
+
+def test_groupby_sort_false(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key", sort=False).sum().sort_index(),
+        pdf.groupby("int_key", sort=False).sum().sort_index(),
+    )
+
+
+def test_groupby_bool_key(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("val_bool").sum(),
+        pdf.groupby("val_bool").sum(),
+    )
+
+
+def test_groupby_cumulative(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key")["val_int"].cumsum(),
+        pdf.groupby("int_key")["val_int"].cumsum(),
+    )
