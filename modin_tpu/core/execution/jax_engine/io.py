@@ -1,17 +1,66 @@
-"""IO bound to the TPU (sharded jax.Array) storage format on the JAX engine."""
+"""IO bound to the TPU (sharded jax.Array) storage format on the JAX engine.
+
+Reference composition pattern: ray/implementations/pandas_on_ray/io/io.py:81-99
+builds per-format reader classes by mixing (EngineWrapper, Parser, Dispatcher);
+here the engine wrapper is the jax device layer and the dispatchers bind the
+Tpu query compiler directly.
+"""
+
+from typing import Any
 
 from modin_tpu.core.dataframe.tpu.dataframe import TpuDataframe
+from modin_tpu.core.io.column_stores.parquet_dispatcher import (
+    FeatherDispatcher,
+    ParquetDispatcher,
+)
 from modin_tpu.core.io.io import BaseIO
+from modin_tpu.core.io.text.csv_dispatcher import CSVDispatcher, TableDispatcher
 from modin_tpu.core.storage_formats.tpu.query_compiler import TpuQueryCompiler
+
+
+class TpuCSVDispatcher(CSVDispatcher):
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe
+
+
+class TpuTableDispatcher(TableDispatcher):
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe
+
+
+class TpuParquetDispatcher(ParquetDispatcher):
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe
+
+
+class TpuFeatherDispatcher(FeatherDispatcher):
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe
 
 
 class TpuOnJaxIO(BaseIO):
     """IO producing device-backed TpuQueryCompiler frames.
 
-    read_csv/read_parquet get parallel host-parse + chunked device upload in
-    the dedicated dispatchers (modin_tpu/core/io/); everything else goes
-    through host pandas then ``device_put``.
+    read_csv/read_table/read_parquet go through parallel dispatchers (native
+    byte-range chunking / pyarrow row groups); everything else through host
+    pandas then ``device_put``.
     """
 
     query_compiler_cls = TpuQueryCompiler
     frame_cls = TpuDataframe
+
+    @classmethod
+    def read_csv(cls, **kwargs: Any):
+        return TpuCSVDispatcher.read(**kwargs)
+
+    @classmethod
+    def read_table(cls, **kwargs: Any):
+        return TpuTableDispatcher.read(**kwargs)
+
+    @classmethod
+    def read_parquet(cls, **kwargs: Any):
+        return TpuParquetDispatcher.read(**kwargs)
+
+    @classmethod
+    def read_feather(cls, **kwargs: Any):
+        return TpuFeatherDispatcher.read(**kwargs)

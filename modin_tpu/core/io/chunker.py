@@ -1,0 +1,168 @@
+"""Loader for the native CSV byte-range chunker (ctypes, lazy g++ build).
+
+The .so is compiled on first use into ``~/.cache/modin_tpu/`` and memoized;
+if no compiler is available the pure-Python fallback implements the same
+quote-aware record splitting (reference behavior:
+modin/core/io/text/text_file_dispatcher.py:207).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+_SRC = pathlib.Path(__file__).parent / "native_src" / "chunker.cpp"
+
+
+def _build_library() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    try:
+        src_bytes = _SRC.read_bytes()
+    except OSError:
+        _build_failed = True
+        return None
+    digest = hashlib.sha256(src_bytes).hexdigest()[:16]
+    cache_dir = pathlib.Path(
+        os.environ.get("MODIN_TPU_CACHE_DIR", os.path.expanduser("~/.cache/modin_tpu"))
+    )
+    so_path = cache_dir / f"chunker_{digest}.so"
+    if not so_path.exists():
+        try:
+            cache_dir.mkdir(parents=True, exist_ok=True)
+            tmp_path = so_path.with_suffix(".tmp.so")
+            subprocess.run(
+                [
+                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                    str(_SRC), "-o", str(tmp_path),
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp_path, so_path)
+        except (OSError, subprocess.SubprocessError):
+            _build_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(str(so_path))
+    except OSError:
+        _build_failed = True
+        return None
+    lib.next_record_boundary.restype = ctypes.c_int64
+    lib.next_record_boundary.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char, ctypes.c_int32,
+    ]
+    lib.split_record_ranges.restype = ctypes.c_int64
+    lib.split_record_ranges.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_char, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+    ]
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None and not _build_failed:
+        with _lock:
+            if _lib is None and not _build_failed:
+                _lib = _build_library()
+    return _lib
+
+
+def split_record_ranges(
+    buf: bytes,
+    header_end: int,
+    target_chunk_bytes: int,
+    quotechar: str = '"',
+    max_chunks: int = 4096,
+) -> List[Tuple[int, int]]:
+    """Split ``buf[header_end:]`` into record-aligned (start, end) byte ranges."""
+    size = len(buf)
+    if header_end >= size:
+        return []
+    lib = _get_lib()
+    if lib is not None:
+        out = (ctypes.c_int64 * (2 * max_chunks))()
+        n = lib.split_record_ranges(
+            buf, header_end, size, max(target_chunk_bytes, 1),
+            quotechar.encode()[0:1], max_chunks, out,
+        )
+        return [(out[2 * i], out[2 * i + 1]) for i in range(n)]
+    return _split_record_ranges_py(
+        buf, header_end, target_chunk_bytes, quotechar, max_chunks
+    )
+
+
+def _split_record_ranges_py(
+    buf: bytes, header_end: int, target: int, quotechar: str, max_chunks: int
+) -> List[Tuple[int, int]]:
+    """Pure-Python fallback with the same semantics."""
+    q = quotechar.encode()[0]
+    size = len(buf)
+    ranges = []
+    pos = header_end
+    in_quotes = False
+    scan_from = header_end
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    while pos < size and len(ranges) < max_chunks:
+        want = pos + max(target, 1)
+        if want >= size:
+            ranges.append((pos, size))
+            break
+        in_quotes = bool(
+            (int(np.count_nonzero(arr[scan_from:want] == q)) + in_quotes) % 2
+        )
+        boundary = want
+        iq = in_quotes
+        while boundary < size:
+            c = buf[boundary]
+            if c == q:
+                iq = not iq
+            elif c == 0x0A and not iq:
+                boundary += 1
+                break
+            boundary += 1
+        in_quotes = bool(
+            (int(np.count_nonzero(arr[want:boundary] == q)) + in_quotes) % 2
+        )
+        scan_from = boundary
+        ranges.append((pos, boundary))
+        pos = boundary
+    return ranges
+
+
+def find_header_end(buf: bytes, skip_rows: int, quotechar: str = '"') -> int:
+    """Byte offset just past `skip_rows` records from the start of the buffer."""
+    lib = _get_lib()
+    pos = 0
+    size = len(buf)
+    if lib is not None:
+        for _ in range(skip_rows):
+            pos = lib.next_record_boundary(buf, pos, size, quotechar.encode()[0:1], 0)
+            if pos >= size:
+                break
+        return pos
+    q = quotechar.encode()[0]
+    for _ in range(skip_rows):
+        iq = False
+        while pos < size:
+            c = buf[pos]
+            pos += 1
+            if c == q:
+                iq = not iq
+            elif c == 0x0A and not iq:
+                break
+        if pos >= size:
+            break
+    return pos

@@ -1,0 +1,59 @@
+"""Parquet reader: pyarrow row-group parallel read -> device columns.
+
+Reference design: /root/reference/modin/core/io/column_stores/
+parquet_dispatcher.py:298 (row-group balanced splitting at :350, dataset
+abstraction at :42).  pyarrow's native reader is already multi-threaded C++;
+the TPU-side work is the column assembly + device upload.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import pandas
+
+from modin_tpu.core.io.file_dispatcher import FileDispatcher
+
+
+class ParquetDispatcher(FileDispatcher):
+    @classmethod
+    def _read(cls, path: Any = None, engine: str = "auto", columns: Optional[List] = None, **kwargs: Any):
+        filters = kwargs.get("filters")
+        try:
+            import pyarrow.parquet as pq
+        except ImportError:
+            df = pandas.read_parquet(path, engine=engine, columns=columns, **kwargs)
+            return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
+        extra = {
+            k: v
+            for k, v in kwargs.items()
+            if k != "filters" and v not in (None, False)
+            and not (k == "dtype_backend" and v is pandas.api.extensions.no_default)
+        }
+        if not isinstance(path, (str,)) or extra:
+            # kwargs the arrow fast path can't honor (dtype_backend,
+            # filesystem, storage_options, ...) take the pandas reader
+            df = pandas.read_parquet(path, engine=engine, columns=columns, **kwargs)
+            return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
+        try:
+            table = pq.read_table(
+                cls.get_path(path),
+                columns=columns,
+                use_threads=True,
+                filters=filters,
+            )
+            df = table.to_pandas(split_blocks=True, self_destruct=True)
+        except Exception:
+            df = pandas.read_parquet(path, engine=engine, columns=columns, **kwargs)
+        return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
+
+    @classmethod
+    def write(cls, qc: Any, path: Any, **kwargs: Any):
+        return qc.to_pandas().to_parquet(path, **kwargs)
+
+
+class FeatherDispatcher(FileDispatcher):
+    @classmethod
+    def _read(cls, path: Any = None, columns: Optional[List] = None, **kwargs: Any):
+        df = pandas.read_feather(cls.get_path(path) if isinstance(path, str) else path, columns=columns, **kwargs)
+        return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)

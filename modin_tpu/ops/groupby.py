@@ -52,26 +52,28 @@ def _jit_key_minmax(n: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_range_counts(n: int, kmin: int, width: int):
+def _jit_range_counts(n: int, width: int):
+    # kmin is a traced operand: recompiles key on (n, width) only
     import jax
     import jax.numpy as jnp
 
-    def fn(k):
+    def fn(k, kmin):
         valid = jnp.arange(k.shape[0]) < n
-        ids = jnp.where(valid, k - kmin, width)
+        ids = jnp.where(valid, jnp.clip(k - kmin, 0, width), width)
         return jnp.zeros(width + 1, jnp.int64).at[ids].add(1)[:width]
 
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_range_codes(n: int, kmin: int, n_groups: int):
+def _jit_range_codes(n: int, n_groups: int):
     import jax
     import jax.numpy as jnp
 
-    def fn(k, remap):
+    def fn(k, kmin, remap):
         valid = jnp.arange(k.shape[0]) < n
-        safe = jnp.where(valid, k - kmin, 0)
+        width = remap.shape[0]
+        safe = jnp.where(valid, jnp.clip(k - kmin, 0, width - 1), 0)
         return jnp.where(valid, jnp.take(remap, safe), n_groups)
 
     return jax.jit(fn)
@@ -136,13 +138,15 @@ def factorize_keys(
             width = kmax - kmin + 1
             if width <= _RANGE_LIMIT:
                 counts = np.asarray(
-                    jax.device_get(_jit_range_counts(n, kmin, width)(k64))
+                    jax.device_get(
+                        _jit_range_counts(n, width)(k64, jnp.int64(kmin))
+                    )
                 )
                 present = np.nonzero(counts)[0]
                 remap = np.full(width, len(present), dtype=np.int64)
                 remap[present] = np.arange(len(present))
-                codes = _jit_range_codes(n, kmin, len(present))(
-                    k64, jnp.asarray(remap)
+                codes = _jit_range_codes(n, len(present))(
+                    k64, jnp.int64(kmin), jnp.asarray(remap)
                 )
                 uniques = (present + kmin).astype(np.int64)
                 if kdt == jnp.bool_:
@@ -273,10 +277,19 @@ def _jit_remap(n_present: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int):
-    """One jit computing the aggregation for every value column."""
+def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_out: int):
+    """One jit computing the aggregation for every value column; results are
+    sliced to the real group count and padded to the shard multiple."""
     import jax
     import jax.numpy as jnp
+
+    n_groups = num_segments - 1
+
+    def finish(r):
+        r = r[:n_groups]
+        if p_out > n_groups:
+            r = jnp.concatenate([r, jnp.zeros(p_out - n_groups, r.dtype)])
+        return r
 
     def seg(c, codes):
         is_f = jnp.issubdtype(c.dtype, jnp.floating)
@@ -326,20 +339,25 @@ def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int):
         raise ValueError(agg)
 
     def fn(cols: Tuple, codes):
-        return tuple(seg(c, codes) for c in cols)
+        return tuple(finish(seg(c, codes)) for c in cols)
 
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_segment_size(num_segments: int, n: int):
+def _jit_segment_size(num_segments: int, p_out: int):
     import jax
     import jax.numpy as jnp
 
+    n_groups = num_segments - 1
+
     def fn(codes):
-        return jax.ops.segment_sum(
+        r = jax.ops.segment_sum(
             jnp.ones(codes.shape, jnp.int64), codes, num_segments=num_segments
-        )
+        )[:n_groups]
+        if p_out > n_groups:
+            r = jnp.concatenate([r, jnp.zeros(p_out - n_groups, r.dtype)])
+        return r
 
     return jax.jit(fn)
 
@@ -352,11 +370,14 @@ def groupby_reduce(
     n: int,
     ddof: int = 1,
 ) -> List[Any]:
-    """Aggregate value columns by group codes; returns device arrays of length
-    num_groups (the overflow pad/NaN bucket is sliced off)."""
+    """Aggregate value columns by group codes; returns device arrays padded to
+    the shard multiple with logical length num_groups (the overflow pad/NaN
+    bucket is sliced off)."""
+    from modin_tpu.ops.structural import pad_len
+
     ns = num_groups + 1
+    p_out = pad_len(num_groups)
     if agg == "size":
-        return [_jit_segment_size(ns, n)(codes)[:num_groups]]
-    fn = _jit_segment_agg(agg, len(value_cols), ns, int(ddof))
-    results = fn(tuple(value_cols), codes)
-    return [r[:num_groups] for r in results]
+        return [_jit_segment_size(ns, p_out)(codes)]
+    fn = _jit_segment_agg(agg, len(value_cols), ns, int(ddof), p_out)
+    return list(fn(tuple(value_cols), codes))

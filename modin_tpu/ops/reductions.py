@@ -198,8 +198,15 @@ def _jit_idx_minmax(op_name: str, n_cols: int, n: int):
 
     def fn(cs: Tuple) -> Tuple:
         out = []
+        counts = []
         for c in cs:
             is_f = jnp.issubdtype(c.dtype, jnp.floating)
+            valid = _valid_mask(c, n)
+            if is_f:
+                n_valid = jnp.sum(valid & ~jnp.isnan(c))
+            else:
+                n_valid = jnp.sum(valid)
+            counts.append(n_valid)
             if op_name == "idxmin":
                 neutral = jnp.inf if is_f else _int_max(c.dtype)
                 x = _masked(c, n, neutral)
@@ -210,14 +217,15 @@ def _jit_idx_minmax(op_name: str, n_cols: int, n: int):
                 x = _masked(c, n, neutral)
                 x = jnp.where(jnp.isnan(x), -jnp.inf, x) if is_f else x
                 out.append(jnp.argmax(x))
-        return tuple(out)
+        return tuple(out), tuple(counts)
 
     return jax.jit(fn)
 
 
-def idx_minmax(op_name: str, cols: List[Any], n: int, skipna: bool = True) -> List[int]:
-    """argmin/argmax position per padded column with NaN skipping; one fetch."""
+def idx_minmax(op_name: str, cols: List[Any], n: int, skipna: bool = True):
+    """(positions, valid_counts) per padded column, NaN-skipping; one fetch."""
     import jax
 
-    results = _jit_idx_minmax(op_name, len(cols), int(n))(tuple(cols))
-    return [int(r) for r in jax.device_get(results)]
+    positions, counts = _jit_idx_minmax(op_name, len(cols), int(n))(tuple(cols))
+    fetched = jax.device_get((positions, counts))
+    return [int(r) for r in fetched[0]], [int(c) for c in fetched[1]]

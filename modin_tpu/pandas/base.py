@@ -1078,7 +1078,7 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
             FactoryDispatcher,
         )
 
-        return FactoryDispatcher.to_pickle(self._query_compiler, filepath_or_buffer=path, **kwargs)
+        return FactoryDispatcher.to_pickle(self._query_compiler, path=path, **kwargs)
 
     def to_dict(self, *args: Any, **kwargs: Any):
         return self._default_to_pandas("to_dict", *args, **kwargs)

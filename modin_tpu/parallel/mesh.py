@@ -27,6 +27,10 @@ def get_mesh():
     """Get (building on first use) the global device mesh."""
     global _mesh, _mesh_shape
     import jax
+
+    # pandas semantics are 64-bit; ensure x64 regardless of which layer
+    # touched jax first (idempotent)
+    jax.config.update("jax_enable_x64", True)
     from jax.sharding import Mesh
 
     shape = tuple(MeshShape.get())

@@ -22,6 +22,7 @@ import jax  # noqa: E402
 # The axon TPU plugin in this image overrides JAX_PLATFORMS from the
 # environment; the explicit config update wins.
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

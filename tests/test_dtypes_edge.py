@@ -88,3 +88,27 @@ def test_timedelta_roundtrip_and_ops():
     )
     df_equals(md, pdf)
     df_equals(md.isna(), pdf.isna())
+
+
+def test_groupby_result_is_padded_for_binary_ops():
+    # regression: groupby outputs must keep the padded-shard layout so
+    # follow-up binary ops against equally-sized frames compile
+    md, pdf = create_test_dfs({"k": [1, 1, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    gb_md = md.groupby("k").sum()
+    gb_pd = pdf.groupby("k").sum()
+    df_equals(gb_md + gb_md, gb_pd + gb_pd)
+    df_equals(gb_md.sort_values("v"), gb_pd.sort_values("v"))
+
+
+def test_round_fillna_preserve_datetime():
+    md, pdf = create_test_dfs(DT_DATA)
+    df_equals(md.round(1), pdf.round(1))
+    df_equals(md.fillna(0.0), pdf.fillna(0.0))
+
+
+def test_idxmin_all_nan_raises():
+    md, pdf = create_test_dfs({"a": [np.nan, np.nan], "b": [1.0, 2.0]})
+    with pytest.raises(ValueError):
+        pdf.idxmin()
+    with pytest.raises(ValueError):
+        md.idxmin()

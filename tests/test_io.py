@@ -1,0 +1,158 @@
+"""IO differential tests (modeled on modin/tests/pandas/test_io.py):
+round-trips against pandas-written files, chunked-reader parity."""
+
+import io
+import os
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.core.io.chunker import (
+    _split_record_ranges_py,
+    find_header_end,
+    split_record_ranges,
+)
+from tests.utils import df_equals
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    rng = np.random.default_rng(11)
+    n = 5000
+    pdf = pandas.DataFrame(
+        {
+            "a": rng.integers(0, 1000, n),
+            "b": rng.uniform(-1, 1, n).round(6),
+            "c": rng.choice(["x", "yy", "z,comma", 'q"uote'], n),
+            "d": rng.random(n) < 0.5,
+        }
+    )
+    path = tmp_path / "data.csv"
+    pdf.to_csv(path, index=False)
+    return str(path), pdf
+
+
+class TestChunker:
+    def test_native_matches_python(self, csv_file):
+        path, _ = csv_file
+        buf = open(path, "rb").read()
+        header_end = find_header_end(buf, 1)
+        native = split_record_ranges(buf, header_end, 1000)
+        py = _split_record_ranges_py(buf, header_end, 1000, '"', 4096)
+        assert native == py
+        # full coverage, no gaps/overlaps
+        assert native[0][0] == header_end
+        assert native[-1][1] == len(buf)
+        for (s1, e1), (s2, e2) in zip(native, native[1:]):
+            assert e1 == s2
+
+    def test_chunks_align_to_records(self, csv_file):
+        path, _ = csv_file
+        buf = open(path, "rb").read()
+        header_end = find_header_end(buf, 1)
+        for start, end in split_record_ranges(buf, header_end, 777):
+            assert end == len(buf) or buf[end - 1 : end] == b"\n"
+
+    def test_quoted_newline_not_a_boundary(self):
+        buf = b'a,b\n1,"line\nbreak"\n2,plain\n'
+        header_end = find_header_end(buf, 1)
+        ranges = split_record_ranges(buf, header_end, 5)
+        rebuilt = b"".join(buf[s:e] for s, e in ranges)
+        assert rebuilt == buf[header_end:]
+        # the quoted newline at offset 11 must not end a chunk
+        assert all(e != 12 for _, e in ranges)
+
+
+class TestReadCSV:
+    def test_roundtrip(self, csv_file):
+        path, pdf = csv_file
+        df_equals(pd.read_csv(path), pandas.read_csv(path))
+
+    def test_parallel_path(self, csv_file, monkeypatch):
+        import modin_tpu.core.io.text.csv_dispatcher as disp
+
+        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        path, pdf = csv_file
+        df_equals(pd.read_csv(path), pandas.read_csv(path))
+
+    def test_kwargs_passthrough(self, csv_file):
+        path, _ = csv_file
+        df_equals(
+            pd.read_csv(path, usecols=["a", "b"]),
+            pandas.read_csv(path, usecols=["a", "b"]),
+        )
+        df_equals(
+            pd.read_csv(path, nrows=10), pandas.read_csv(path, nrows=10)
+        )
+        df_equals(
+            pd.read_csv(path, skiprows=3), pandas.read_csv(path, skiprows=3)
+        )
+        df_equals(
+            pd.read_csv(path, dtype={"a": "float64"}),
+            pandas.read_csv(path, dtype={"a": "float64"}),
+        )
+
+    def test_buffer_input(self, csv_file):
+        path, _ = csv_file
+        content = open(path).read()
+        df_equals(
+            pd.read_csv(io.StringIO(content)), pandas.read_csv(io.StringIO(content))
+        )
+
+    def test_index_col(self, csv_file):
+        path, _ = csv_file
+        df_equals(
+            pd.read_csv(path, index_col="a"), pandas.read_csv(path, index_col="a")
+        )
+
+
+class TestWriters:
+    def test_to_csv_roundtrip(self, tmp_path, csv_file):
+        path, pdf = csv_file
+        md = pd.read_csv(path)
+        out = tmp_path / "out.csv"
+        md.to_csv(out, index=False)
+        df_equals(pandas.read_csv(out), pandas.read_csv(path))
+
+    def test_to_csv_string(self, csv_file):
+        path, _ = csv_file
+        md = pd.read_csv(path).head(5)
+        pdf = pandas.read_csv(path).head(5)
+        assert md.to_csv() == pdf.to_csv()
+
+
+class TestParquet:
+    def test_roundtrip(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        pdf = pandas.DataFrame(
+            {"x": np.arange(1000), "y": np.arange(1000) * 0.5, "s": ["v"] * 1000}
+        )
+        path = tmp_path / "data.parquet"
+        pdf.to_parquet(path)
+        df_equals(pd.read_parquet(str(path)), pandas.read_parquet(path))
+
+    def test_to_parquet(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        md = pd.DataFrame({"x": [1, 2, 3]})
+        path = tmp_path / "out.parquet"
+        md.to_parquet(str(path))
+        df_equals(pandas.read_parquet(path), md.modin.to_pandas())
+
+
+class TestOtherFormats:
+    def test_json_roundtrip(self, tmp_path):
+        pdf = pandas.DataFrame({"a": [1, 2], "b": ["x", "y"]})
+        path = tmp_path / "d.json"
+        pdf.to_json(path, orient="records", lines=True)
+        df_equals(
+            pd.read_json(str(path), orient="records", lines=True),
+            pandas.read_json(path, orient="records", lines=True),
+        )
+
+    def test_pickle_roundtrip(self, tmp_path):
+        md = pd.DataFrame({"a": [1, 2, 3]})
+        path = tmp_path / "d.pkl"
+        md.to_pickle(str(path))
+        df_equals(pd.read_pickle(str(path)), md)

@@ -1,0 +1,132 @@
+"""Range-partitioning shuffle tests on the virtual 8-device mesh.
+
+Counterpart of the reference's shuffle/split internals tests
+(modin/tests/core/storage_formats/pandas/test_internals.py:926-1038).
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import RangePartitioning
+from tests.utils import create_test_dfs, df_equals
+
+
+@pytest.fixture(autouse=True)
+def _require_mesh():
+    from modin_tpu.parallel.mesh import num_row_shards
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax" or num_row_shards() < 2:
+        pytest.skip("needs TpuOnJax on a multi-device mesh")
+
+
+def test_range_shuffle_kernel_roundtrip():
+    import jax.numpy as jnp
+
+    from modin_tpu.ops.structural import pad_host
+    from modin_tpu.parallel.engine import JaxWrapper
+    from modin_tpu.parallel.shuffle import range_shuffle
+
+    rng = np.random.default_rng(5)
+    n = 10_000
+    keys = rng.uniform(-100, 100, n)
+    vals = rng.integers(0, 1000, n)
+    key_dev = JaxWrapper.put(pad_host(keys))
+    val_dev = JaxWrapper.put(pad_host(vals))
+    key_out, cols_out, counts, pivots = range_shuffle(key_dev, [val_dev], n)
+    assert int(counts.sum()) == n
+    k = np.asarray(key_out)[:n]
+    v = np.asarray(cols_out[0])[:n]
+    # all rows survive with their payloads attached
+    order_in = np.lexsort((vals, keys))
+    order_out = np.lexsort((v, k))
+    np.testing.assert_array_equal(k[order_out], keys[order_in])
+    np.testing.assert_array_equal(v[order_out], vals[order_in])
+
+
+def test_range_shuffle_local_sort_is_global_sort():
+    from modin_tpu.ops.structural import pad_host
+    from modin_tpu.parallel.engine import JaxWrapper
+    from modin_tpu.parallel.shuffle import range_shuffle
+
+    rng = np.random.default_rng(6)
+    n = 8_001  # uneven on purpose
+    keys = rng.normal(0, 50, n)
+    key_dev = JaxWrapper.put(pad_host(keys))
+    key_out, _, counts, _ = range_shuffle(key_dev, [], n, local_sort=True)
+    k = np.asarray(key_out)[:n]
+    np.testing.assert_array_equal(k, np.sort(keys))
+
+
+def test_range_shuffle_skewed_keys_retry():
+    from modin_tpu.ops.structural import pad_host
+    from modin_tpu.parallel.engine import JaxWrapper
+    from modin_tpu.parallel.shuffle import range_shuffle
+
+    # 90% identical keys forces destination overflow and the slack retry
+    rng = np.random.default_rng(7)
+    n = 4_000
+    keys = np.where(rng.random(n) < 0.9, 7.0, rng.uniform(0, 1000, n))
+    key_dev = JaxWrapper.put(pad_host(keys))
+    key_out, _, counts, _ = range_shuffle(key_dev, [], n, local_sort=True)
+    np.testing.assert_array_equal(np.asarray(key_out)[:n], np.sort(keys))
+
+
+def test_sort_values_range_partitioning_config():
+    rng = np.random.default_rng(8)
+    data = {
+        "a": rng.uniform(-10, 10, 3000),
+        "b": rng.integers(0, 100, 3000),
+    }
+    md, pdf = create_test_dfs(data)
+    with RangePartitioning.context(True):
+        df_equals(
+            md.sort_values("a", kind="stable"),
+            pdf.sort_values("a", kind="stable"),
+        )
+        df_equals(
+            md.sort_values("b", ascending=False, kind="stable").reset_index(drop=True),
+            pdf.sort_values("b", ascending=False, kind="stable").reset_index(drop=True),
+        )
+
+
+def test_range_shuffle_sort_with_nan_and_inf():
+    from modin_tpu.ops.structural import pad_host
+    from modin_tpu.parallel.engine import JaxWrapper
+    from modin_tpu.parallel.shuffle import range_shuffle
+
+    rng = np.random.default_rng(9)
+    n = 5_000
+    keys = rng.uniform(-10, 10, n)
+    keys[rng.choice(n, 200, replace=False)] = np.nan
+    keys[rng.choice(n, 50, replace=False)] = np.inf
+    keys[rng.choice(n, 50, replace=False)] = -np.inf
+    key_dev = JaxWrapper.put(pad_host(keys))
+    key_out, _, counts, _ = range_shuffle(key_dev, [], n, local_sort=True)
+    k = np.asarray(key_out)[:n]
+    n_nan = int(np.isnan(keys).sum())
+    expected = np.concatenate([np.sort(keys[~np.isnan(keys)]), [np.nan] * n_nan])
+    np.testing.assert_array_equal(k, expected)
+
+
+def test_range_shuffle_descending_nan_last():
+    from modin_tpu.ops.structural import pad_host
+    from modin_tpu.parallel.engine import JaxWrapper
+    from modin_tpu.parallel.shuffle import range_shuffle
+
+    rng = np.random.default_rng(10)
+    n = 3_000
+    keys = rng.uniform(-5, 5, n)
+    keys[rng.choice(n, 100, replace=False)] = np.nan
+    key_dev = JaxWrapper.put(pad_host(keys))
+    key_out, _, counts, _ = range_shuffle(
+        key_dev, [], n, descending=True, local_sort=True
+    )
+    k = np.asarray(key_out)[:n]
+    n_nan = int(np.isnan(keys).sum())
+    expected = np.concatenate(
+        [np.sort(keys[~np.isnan(keys)])[::-1], [np.nan] * n_nan]
+    )
+    np.testing.assert_array_equal(k, expected)
