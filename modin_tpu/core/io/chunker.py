@@ -61,14 +61,20 @@ def _build_library() -> Optional[ctypes.CDLL]:
         return None
     lib.next_record_boundary.restype = ctypes.c_int64
     lib.next_record_boundary.argtypes = [
-        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char, ctypes.c_int32,
     ]
     lib.split_record_ranges.restype = ctypes.c_int64
     lib.split_record_ranges.argtypes = [
-        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_char, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
     ]
     return lib
+
+
+def _buf_address(buf) -> tuple:
+    """(pointer, keepalive) for bytes or (read-only) mmap buffers, zero-copy."""
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    return arr.ctypes.data, arr
 
 
 def _get_lib() -> Optional[ctypes.CDLL]:
@@ -91,16 +97,23 @@ def split_record_ranges(
     size = len(buf)
     if header_end >= size:
         return []
+    # never truncate: enough chunk slots for the whole body (finding: files
+    # larger than max_chunks*target silently lost their tail)
+    target = max(target_chunk_bytes, 1)
+    needed = (size - header_end) // target + 2
+    max_chunks = max(max_chunks, min(int(needed), 4_000_000))
     lib = _get_lib()
     if lib is not None:
         out = (ctypes.c_int64 * (2 * max_chunks))()
+        ptr, keepalive = _buf_address(buf)
         n = lib.split_record_ranges(
-            buf, header_end, size, max(target_chunk_bytes, 1),
+            ptr, header_end, size, target,
             quotechar.encode()[0:1], max_chunks, out,
         )
+        del keepalive
         return [(out[2 * i], out[2 * i + 1]) for i in range(n)]
     return _split_record_ranges_py(
-        buf, header_end, target_chunk_bytes, quotechar, max_chunks
+        buf, header_end, target, quotechar, max_chunks
     )
 
 
@@ -148,10 +161,12 @@ def find_header_end(buf: bytes, skip_rows: int, quotechar: str = '"') -> int:
     pos = 0
     size = len(buf)
     if lib is not None:
+        ptr, keepalive = _buf_address(buf)
         for _ in range(skip_rows):
-            pos = lib.next_record_boundary(buf, pos, size, quotechar.encode()[0:1], 0)
+            pos = lib.next_record_boundary(ptr, pos, size, quotechar.encode()[0:1], 0)
             if pos >= size:
                 break
+        del keepalive
         return pos
     q = quotechar.encode()[0]
     for _ in range(skip_rows):

@@ -53,9 +53,14 @@ class CSVDispatcher(FileDispatcher):
             "quoting": 0,
             "memory_map": False,
             "on_bad_lines": "error",
+            "escapechar": None,  # escaped quotes break the parity scan
+            "skip_blank_lines": True,
         }
+        no_default = pandas.api.extensions.no_default
         for key, default in unsupported_nondefault.items():
             value = kwargs.get(key, default)
+            if value is no_default:
+                continue  # pandas sentinel for "use the default"
             if key == "compression" and value == "infer":
                 path = kwargs.get("filepath_or_buffer", "")
                 if isinstance(path, (str,)) and path.endswith(

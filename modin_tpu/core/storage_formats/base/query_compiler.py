@@ -660,6 +660,16 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
     def dt_nanoseconds(self) -> "BaseQueryCompiler":
         return DateTimeDefault.register(property(lambda dt: dt.nanoseconds), fn_name="nanoseconds")(self)
 
+    def unary_math(self, op_name: str) -> "BaseQueryCompiler":
+        """Elementwise numpy-style math (sqrt/exp/log/...) over the frame."""
+        ufunc = getattr(np, op_name)
+        return DataFrameDefault.register(
+            lambda df: pandas.DataFrame(
+                ufunc(df.to_numpy()), index=df.index, columns=df.columns
+            ),
+            fn_name=op_name,
+        )(self)
+
     def describe(self, percentiles: Any = None, include: Any = None, exclude: Any = None) -> "BaseQueryCompiler":
         return DataFrameDefault.register(pandas.DataFrame.describe)(
             self, percentiles=percentiles, include=include, exclude=exclude

@@ -178,6 +178,68 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return type(self)(self._modin_frame.filter_rows_mask(key_arr))
         return super().getitem_array(key)
 
+    def _column_from_value(self, value: Any) -> Optional[Any]:
+        """Build a column for setitem/insert from a compatible value, or None."""
+        import jax.numpy as jnp
+
+        from modin_tpu.ops.structural import pad_len
+
+        frame = self._modin_frame
+        n = len(frame)
+        if isinstance(value, TpuQueryCompiler):
+            vframe = value._modin_frame
+            if (
+                vframe.num_cols == 1
+                and len(vframe) == n
+                and self._fast_index_match(value)
+            ):
+                return vframe.get_column(0)
+            return None
+        if isinstance(value, (int, float, np.integer, np.floating)) and not isinstance(value, bool):
+            data = jnp.full(pad_len(n), value)
+            return DeviceColumn(data, np.dtype(data.dtype), length=n)
+        if isinstance(value, (bool, np.bool_)):
+            return DeviceColumn(
+                jnp.full(pad_len(n), bool(value)), np.dtype(bool), length=n
+            )
+        if isinstance(value, (np.ndarray, list, tuple, range)):
+            arr = np.asarray(value)
+            if arr.ndim == 1 and len(arr) == n and arr.dtype.kind in "biufmM":
+                return DeviceColumn.from_numpy(arr)
+            if arr.ndim == 1 and len(arr) == n:
+                return HostColumn(pandas.array(arr))
+        return None
+
+    def setitem(self, axis: int, key: Any, value: Any) -> "TpuQueryCompiler":
+        if axis == 0:
+            frame = self._modin_frame
+            col = self._column_from_value(value)
+            if col is not None and len(frame) > 0:
+                positions = (
+                    [int(p) for p in frame.columns.get_indexer_for([key])]
+                    if key in frame.columns
+                    else []
+                )
+                new_cols = list(frame._columns)
+                if len(positions) == 1 and positions[0] >= 0:
+                    new_cols[positions[0]] = col
+                    return type(self)(frame.with_columns(new_cols))
+                if not positions:
+                    new_cols.append(col)
+                    new_labels = frame.columns.append(pandas.Index([key]))
+                    return type(self)(frame.with_columns(new_cols, new_labels))
+        return super().setitem(axis, key, value)
+
+    def insert(self, loc: int, column: Any, value: Any) -> "TpuQueryCompiler":
+        frame = self._modin_frame
+        col = self._column_from_value(value)
+        if col is not None and len(frame) > 0:
+            new_cols = list(frame._columns)
+            new_cols.insert(loc, col)
+            new_labels = frame.columns.insert(loc, column)
+            return type(self)(frame.with_columns(new_cols, new_labels))
+        return super().insert(loc, column, value)
+
     def drop(self, index: Any = None, columns: Any = None, errors: str = "raise") -> "TpuQueryCompiler":
         result = self
         frame = self._modin_frame
@@ -409,6 +471,26 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return type(self)(
             frame.with_columns(new_columns), self._shape_hint
         )
+
+    _MATH_UNARY = frozenset(
+        ["sqrt", "exp", "log", "log2", "log10", "sin", "cos", "tan", "tanh",
+         "floor", "ceil", "sign"]
+    )
+
+    def unary_math(self, op_name: str) -> "TpuQueryCompiler":
+        from modin_tpu.ops import elementwise
+
+        if op_name in self._MATH_UNARY:
+            result = self._map_device_host(
+                lambda cols: elementwise.unary_op_columns(op_name, cols),
+                lambda s: pandas.Series(
+                    getattr(np, op_name)(s.to_numpy()), index=s.index
+                ),
+                require_kinds="iuf",
+            )
+            if result is not None:
+                return result
+        return super().unary_math(op_name)
 
     def abs(self) -> "TpuQueryCompiler":
         from modin_tpu.ops import elementwise

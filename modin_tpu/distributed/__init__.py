@@ -1,0 +1,1 @@
+"""Distributed public API (reference: modin/distributed/)."""

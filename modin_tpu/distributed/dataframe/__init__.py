@@ -1,0 +1,1 @@
+"""Distributed dataframe exchange API."""

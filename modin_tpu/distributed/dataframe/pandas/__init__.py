@@ -1,0 +1,6 @@
+"""Raw-buffer export/import (reference: modin/distributed/dataframe/pandas/)."""
+
+from modin_tpu.distributed.dataframe.pandas.partitions import (  # noqa: F401
+    from_partitions,
+    unwrap_partitions,
+)

@@ -1,0 +1,112 @@
+"""Zero-copy export/import of the underlying device buffers.
+
+Reference design: modin/distributed/dataframe/pandas/partitions.py:58,154
+(``unwrap_partitions``/``from_partitions`` expose raw partition futures for
+third-party integrations like xgboost).  The TPU-native equivalent exposes the
+sharded jax.Arrays themselves: a consumer can feed them straight into its own
+jit-compiled computation with no host round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+import pandas
+
+
+def unwrap_partitions(api_layer_object: Any, axis: Optional[int] = None, get_ip: bool = False) -> List:
+    """Expose the frame's underlying buffers.
+
+    For the Tpu backend returns ``[(label, jax.Array | host_array), ...]`` —
+    the live (possibly sharded) device columns, zero-copy.  For host backends
+    returns the column arrays.
+    """
+    qc = api_layer_object._query_compiler
+    frame = getattr(qc, "_modin_frame", None)
+    result = []
+    if frame is not None and hasattr(frame, "_columns"):
+        for label, col in zip(frame.columns, frame._columns):
+            if col.is_device:
+                result.append((label, col.data))
+            else:
+                result.append((label, col.data))
+        return result
+    pandas_df = qc.to_pandas()
+    return [(label, pandas_df[label].to_numpy()) for label in pandas_df.columns]
+
+
+def from_partitions(
+    partitions: List,
+    axis: Optional[int] = None,
+    index: Any = None,
+    columns: Any = None,
+    row_lengths: Any = None,
+    column_widths: Any = None,
+) -> Any:
+    """Build a DataFrame from raw per-column buffers (jax.Arrays or numpy).
+
+    The inverse of :func:`unwrap_partitions`: device arrays are adopted
+    without a host round-trip.
+    """
+    from modin_tpu.core.dataframe.tpu.dataframe import (
+        DeviceColumn,
+        HostColumn,
+        TpuDataframe,
+    )
+    from modin_tpu.core.dataframe.tpu.metadata import LazyIndex
+    from modin_tpu.core.storage_formats.tpu.query_compiler import TpuQueryCompiler
+    from modin_tpu.ops.structural import pad_host, pad_len
+    from modin_tpu.pandas.dataframe import DataFrame
+
+    try:
+        import jax
+
+        jax_array_type = jax.Array
+    except ImportError:  # pragma: no cover
+        jax_array_type = ()
+
+    pairs = [
+        item if isinstance(item, tuple) and len(item) == 2 else (i, item)
+        for i, item in enumerate(partitions)
+    ]
+    # the logical length: the index wins; otherwise the first host buffer;
+    # otherwise a raw device buffer is taken as exactly-logical
+    if index is not None:
+        n = len(index)
+    else:
+        n = None
+        for _, data in pairs:
+            if not isinstance(data, jax_array_type):
+                n = len(np.asarray(data))
+                break
+        if n is None and pairs:
+            n = int(pairs[0][1].shape[0])
+    if n is None:
+        n = 0
+
+    labels = []
+    cols = []
+    for label, data in pairs:
+        labels.append(label)
+        if isinstance(data, jax_array_type):
+            if data.shape[0] == pad_len(n):
+                # already in the padded shard layout: adopt zero-copy
+                cols.append(DeviceColumn(data, np.dtype(str(data.dtype)), length=n))
+            else:
+                cols.append(DeviceColumn.from_numpy(np.asarray(data)[:n]))
+        else:
+            arr = np.asarray(data)
+            if arr.dtype.kind in "biufmM":
+                cols.append(DeviceColumn.from_numpy(arr))
+            else:
+                cols.append(HostColumn(pandas.array(arr)))
+    if index is None:
+        index = pandas.RangeIndex(n)
+    frame = TpuDataframe(
+        cols,
+        pandas.Index(columns if columns is not None else labels),
+        LazyIndex(pandas.Index(index) if not isinstance(index, pandas.Index) else index, n),
+        nrows=n,
+    )
+    return DataFrame(query_compiler=TpuQueryCompiler(frame))

@@ -1,1 +1,1 @@
-"""modin_tpu subpackage."""
+"""Experimental integrations (reference: modin/experimental/)."""

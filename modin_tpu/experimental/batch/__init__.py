@@ -1,0 +1,8 @@
+"""Batch pipeline (reference: modin/experimental/batch/)."""
+
+from modin_tpu.experimental.batch.pipeline import (  # noqa: F401
+    PandasQuery,
+    PandasQueryPipeline,
+    TpuQuery,
+    TpuQueryPipeline,
+)

@@ -1,0 +1,109 @@
+"""Batch query pipeline — a user-composed DAG executed stage-by-stage.
+
+Reference design: modin/experimental/batch/pipeline.py:30,88
+(PandasQuery/PandasQueryPipeline): the user registers a chain of frame->frame
+functions; the pipeline fuses and executes them batch-wise with optional
+repartitioning and per-stage output handlers.  On the TPU backend consecutive
+queries execute back-to-back on device without host round-trips (jax's async
+dispatch pipelines the stages).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from modin_tpu.logging import ClassLogger
+
+
+class TpuQuery:
+    """One node of the pipeline: a DataFrame -> DataFrame function."""
+
+    def __init__(
+        self,
+        func: Callable,
+        is_output: bool = False,
+        repartition_after: bool = False,
+        fan_out: bool = False,
+        pass_partition_id: bool = False,
+        reduce_fn: Optional[Callable] = None,
+        output_id: Optional[int] = None,
+    ):
+        self.func = func
+        self.is_output = is_output
+        self.repartition_after = repartition_after
+        self.fan_out = fan_out
+        self.pass_partition_id = pass_partition_id
+        self.reduce_fn = reduce_fn
+        self.output_id = output_id
+
+
+class TpuQueryPipeline(ClassLogger, modin_layer="BATCH-PIPELINE"):
+    """Batch pipeline over a modin_tpu DataFrame."""
+
+    def __init__(self, df: Any, num_partitions: Optional[int] = None):
+        self.df = df
+        self.num_partitions = num_partitions
+        self.queries: List[TpuQuery] = []
+        self.outputs: List[TpuQuery] = []
+
+    def add_query(
+        self,
+        func: Callable,
+        is_output: bool = False,
+        repartition_after: bool = False,
+        fan_out: bool = False,
+        pass_partition_id: bool = False,
+        reduce_fn: Optional[Callable] = None,
+        output_id: Optional[int] = None,
+    ) -> None:
+        query = TpuQuery(
+            func, is_output, repartition_after, fan_out, pass_partition_id,
+            reduce_fn, output_id,
+        )
+        self.queries.append(query)
+        if is_output:
+            self.outputs.append(query)
+
+    def compute_batch(
+        self,
+        postprocessor: Optional[Callable] = None,
+        pass_partition_id: bool = False,
+        pass_output_id: bool = False,
+    ) -> Any:
+        """Run the pipeline; returns outputs (dict by output_id or list)."""
+        current = self.df
+        results: List[Any] = []
+        output_ids: List[Optional[int]] = []
+        for query in self.queries:
+            if query.fan_out:
+                partials = [
+                    query.func(current, pid) if query.pass_partition_id else query.func(current)
+                    for pid in range(self.num_partitions or 1)
+                ]
+                if query.reduce_fn is not None:
+                    current = query.reduce_fn(partials)
+                else:
+                    current = partials[-1]
+            else:
+                current = query.func(current)
+            if query.repartition_after and hasattr(current, "_query_compiler"):
+                current = current._create_or_update_from_compiler(
+                    current._query_compiler.repartition()
+                )
+            if query.is_output:
+                out = current
+                if postprocessor is not None:
+                    args = []
+                    if pass_output_id:
+                        args.append(query.output_id)
+                    out = postprocessor(out, *args)
+                results.append(out)
+                output_ids.append(query.output_id)
+        if any(oid is not None for oid in output_ids):
+            return {oid: res for oid, res in zip(output_ids, results)}
+        return results
+
+
+# reference-compatible aliases
+PandasQuery = TpuQuery
+PandasQueryPipeline = TpuQueryPipeline

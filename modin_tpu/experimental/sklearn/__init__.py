@@ -1,0 +1,1 @@
+"""sklearn helpers (reference: modin/experimental/sklearn/)."""

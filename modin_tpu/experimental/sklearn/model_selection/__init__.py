@@ -1,0 +1,5 @@
+"""Model-selection helpers."""
+
+from modin_tpu.experimental.sklearn.model_selection.train_test_split import (  # noqa: F401
+    train_test_split,
+)

@@ -1,0 +1,37 @@
+"""train_test_split for modin_tpu frames.
+
+Reference design: modin/experimental/sklearn/model_selection/train_test_split.py:18.
+The split is a device gather per side (no host materialization of the data).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def train_test_split(
+    df: Any,
+    *others: Any,
+    test_size: Any = None,
+    train_size: Any = None,
+    random_state: Any = None,
+    shuffle: bool = True,
+    **kwargs: Any,
+):
+    n = len(df)
+    if test_size is None and train_size is None:
+        test_size = 0.25
+    if test_size is None:
+        test_size = 1.0 - (train_size if train_size <= 1 else train_size / n)
+    n_test = int(round(test_size * n)) if test_size <= 1 else int(test_size)
+    rng = np.random.default_rng(random_state)
+    positions = rng.permutation(n) if shuffle else np.arange(n)
+    test_positions = np.sort(positions[:n_test]) if not shuffle else positions[:n_test]
+    train_positions = positions[n_test:]
+    results = []
+    for obj in (df, *others):
+        results.append(obj.take(train_positions))
+        results.append(obj.take(test_positions))
+    return results if len(results) > 2 else (results[0], results[1])

@@ -1,0 +1,23 @@
+"""Spreadsheet bridge (reference: modin/experimental/spreadsheet/).
+
+modin_spreadsheet is not available in this environment; provided for API
+parity, raising a clear error on use.
+"""
+
+from typing import Any
+
+
+def from_dataframe(dataframe: Any, **kwargs: Any):
+    try:
+        import modin_spreadsheet  # noqa: F401
+    except ImportError as err:
+        raise ImportError(
+            "modin_tpu.experimental.spreadsheet requires 'modin_spreadsheet'"
+        ) from err
+    return modin_spreadsheet.show_grid(dataframe._to_pandas(), **kwargs)
+
+
+def to_dataframe(grid: Any):
+    import modin_tpu.pandas as pd
+
+    return pd.DataFrame(grid.get_changed_df())

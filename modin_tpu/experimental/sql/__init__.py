@@ -1,0 +1,27 @@
+"""SQL-on-dataframe entry point (reference: modin/experimental/sql/).
+
+``query(sql, **frames)`` evaluates a SQL query against modin_tpu frames.
+Uses duckdb when available; otherwise raises with guidance.
+"""
+
+from typing import Any
+
+
+def query(sql: str, **frames: Any):
+    """Run a SQL query over named modin_tpu DataFrames."""
+    from modin_tpu.utils import try_cast_to_pandas
+
+    try:
+        import duckdb
+    except ImportError as err:
+        raise ImportError(
+            "modin_tpu.experimental.sql requires 'duckdb' (not bundled in this "
+            "environment)"
+        ) from err
+    con = duckdb.connect()
+    for name, frame in frames.items():
+        con.register(name, try_cast_to_pandas(frame))
+    result = con.execute(sql).df()
+    import modin_tpu.pandas as pd
+
+    return pd.DataFrame(result)

@@ -1,0 +1,7 @@
+"""Torch integration (reference: modin/experimental/torch/)."""
+
+from modin_tpu.experimental.torch.datasets import (  # noqa: F401
+    ModinDataLoader,
+    ModinTpuDataset,
+    to_dataloader,
+)

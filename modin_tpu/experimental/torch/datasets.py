@@ -1,0 +1,58 @@
+"""PyTorch DataLoader over a modin_tpu frame.
+
+Reference design: modin/experimental/torch/datasets.py:24 (ModinDataLoader).
+Batches are sliced from the device-backed frame (a padded device gather per
+batch) and converted to torch tensors on the host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class ModinTpuDataset:
+    """torch-style Dataset over a modin_tpu DataFrame."""
+
+    def __init__(self, df: Any, features: Optional[List] = None, labels: Optional[List] = None):
+        self._df = df
+        self._features = list(features) if features is not None else list(df.columns)
+        self._labels = list(labels) if labels is not None else []
+
+    def __len__(self) -> int:
+        return len(self._df)
+
+    def __getitem__(self, index: int):
+        import torch
+
+        row = self._df.iloc[index]
+        x = torch.tensor(
+            row[self._features].to_numpy(dtype="float32")
+            if hasattr(row[self._features], "to_numpy")
+            else row[self._features]
+        )
+        if self._labels:
+            y = torch.tensor(row[self._labels].to_numpy(dtype="float32"))
+            return x, y
+        return x
+
+
+def to_dataloader(
+    df: Any,
+    batch_size: int = 32,
+    features: Optional[List] = None,
+    labels: Optional[List] = None,
+    shuffle: bool = False,
+    **kwargs: Any,
+):
+    """Build a ``torch.utils.data.DataLoader`` over a modin_tpu DataFrame."""
+    from torch.utils.data import DataLoader
+
+    return DataLoader(
+        ModinTpuDataset(df, features=features, labels=labels),
+        batch_size=batch_size,
+        shuffle=shuffle,
+        **kwargs,
+    )
+
+
+ModinDataLoader = to_dataloader

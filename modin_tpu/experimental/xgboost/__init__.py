@@ -1,0 +1,58 @@
+"""XGBoost integration (reference: modin/experimental/xgboost/, 1,219 LoC).
+
+xgboost is not available in this environment; the API surface is provided and
+raises a clear error on use.  With xgboost installed, DMatrix feeds the
+device-backed columns through the exported raw buffers
+(modin_tpu.distributed.dataframe.pandas.unwrap_partitions).
+"""
+
+from typing import Any
+
+
+def _require_xgboost():
+    try:
+        import xgboost  # noqa: F401
+
+        return xgboost
+    except ImportError as err:
+        raise ImportError(
+            "modin_tpu.experimental.xgboost requires the 'xgboost' package"
+        ) from err
+
+
+class DMatrix:
+    """xgboost.DMatrix built from a modin_tpu DataFrame."""
+
+    def __init__(self, data: Any, label: Any = None, **kwargs: Any):
+        xgb = _require_xgboost()
+        from modin_tpu.utils import try_cast_to_pandas
+
+        self._dmatrix = xgb.DMatrix(
+            try_cast_to_pandas(data), label=try_cast_to_pandas(label), **kwargs
+        )
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._dmatrix, item)
+
+
+def train(params: dict, dtrain: "DMatrix", *args: Any, **kwargs: Any):
+    """xgboost.train over a modin_tpu-backed DMatrix."""
+    xgb = _require_xgboost()
+    inner = dtrain._dmatrix if isinstance(dtrain, DMatrix) else dtrain
+    return xgb.train(params, inner, *args, **kwargs)
+
+
+class Booster:
+    def __init__(self, *args: Any, **kwargs: Any):
+        xgb = _require_xgboost()
+        self._booster = xgb.Booster(*args, **kwargs)
+
+    def predict(self, data: Any, **kwargs: Any):
+        from modin_tpu.utils import try_cast_to_pandas
+
+        xgb = _require_xgboost()
+        inner = data._dmatrix if isinstance(data, DMatrix) else xgb.DMatrix(try_cast_to_pandas(data))
+        return self._booster.predict(inner, **kwargs)
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._booster, item)

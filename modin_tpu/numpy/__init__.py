@@ -1,1 +1,186 @@
-"""modin_tpu subpackage."""
+"""``modin_tpu.numpy`` — distributed numpy API over query compilers.
+
+Reference design: modin/numpy/ (3,902 LoC; array at arr.py:141, function
+modules math.py/logic.py/linalg.py).  The function surface below delegates to
+the array's device fast paths; unlisted numpy attributes pass through to
+numpy itself (operating on materialized data).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as _np
+
+from modin_tpu.numpy.arr import array  # noqa: F401
+
+
+def _as_modin_array(a: Any) -> array:
+    return a if isinstance(a, array) else array(a)
+
+
+# --- elementwise math (device unary kernels) ------------------------------ #
+
+def _make_unary(name: str):
+    def fn(a: Any, *args: Any, **kwargs: Any):
+        if isinstance(a, array):
+            return a._math(name)
+        return getattr(_np, name)(a, *args, **kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+sqrt = _make_unary("sqrt")
+exp = _make_unary("exp")
+log = _make_unary("log")
+log2 = _make_unary("log2")
+log10 = _make_unary("log10")
+sin = _make_unary("sin")
+cos = _make_unary("cos")
+tan = _make_unary("tan")
+tanh = _make_unary("tanh")
+floor = _make_unary("floor")
+ceil = _make_unary("ceil")
+sign = _make_unary("sign")
+
+
+def absolute(a: Any, *args: Any, **kwargs: Any):
+    if isinstance(a, array):
+        return abs(a)
+    return _np.absolute(a, *args, **kwargs)
+
+
+# --- elementwise binary --------------------------------------------------- #
+
+_REFLECTED = {
+    # arithmetic: r-variants exist on the QC; comparisons: swap the operator
+    "add": "radd", "sub": "rsub", "mul": "rmul", "truediv": "rtruediv",
+    "floordiv": "rfloordiv", "mod": "rmod", "pow": "rpow",
+    "eq": "eq", "ne": "ne", "lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+    "__and__": "__rand__", "__or__": "__ror__", "__xor__": "__rxor__",
+}
+
+
+def _make_binary(name: str, op: str):
+    def fn(a: Any, b: Any, *args: Any, **kwargs: Any):
+        if isinstance(a, array):
+            return a._binary(op, b)
+        if isinstance(b, array):
+            return b._binary(_REFLECTED[op], a)
+        return getattr(_np, name)(a, b, *args, **kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+add = _make_binary("add", "add")
+subtract = _make_binary("subtract", "sub")
+multiply = _make_binary("multiply", "mul")
+divide = _make_binary("divide", "truediv")
+true_divide = divide
+floor_divide = _make_binary("floor_divide", "floordiv")
+power = _make_binary("power", "pow")
+mod = _make_binary("mod", "mod")
+remainder = mod
+equal = _make_binary("equal", "eq")
+not_equal = _make_binary("not_equal", "ne")
+less = _make_binary("less", "lt")
+less_equal = _make_binary("less_equal", "le")
+greater = _make_binary("greater", "gt")
+greater_equal = _make_binary("greater_equal", "ge")
+logical_and = _make_binary("logical_and", "__and__")
+logical_or = _make_binary("logical_or", "__or__")
+logical_xor = _make_binary("logical_xor", "__xor__")
+
+
+def where(condition: Any, x: Any = None, y: Any = None):
+    if x is None and y is None:
+        return _np.where(_np.asarray(condition))
+    return array(_np.where(_np.asarray(condition), _np.asarray(x), _np.asarray(y)))
+
+
+def maximum(a: Any, b: Any):
+    if isinstance(a, array) or isinstance(b, array):
+        return array(_np.maximum(_np.asarray(a), _np.asarray(b)))
+    return _np.maximum(a, b)
+
+
+def minimum(a: Any, b: Any):
+    if isinstance(a, array) or isinstance(b, array):
+        return array(_np.minimum(_np.asarray(a), _np.asarray(b)))
+    return _np.minimum(a, b)
+
+
+# --- reductions ----------------------------------------------------------- #
+
+def _make_reduction(name: str):
+    def fn(a: Any, axis: Optional[int] = None, *args: Any, **kwargs: Any):
+        if isinstance(a, array):
+            return getattr(a, name)(axis=axis)
+        return getattr(_np, name)(a, axis=axis, *args, **kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+sum = _make_reduction("sum")  # noqa: A001
+mean = _make_reduction("mean")
+prod = _make_reduction("prod")
+amin = _make_reduction("min")
+amax = _make_reduction("max")
+all = _make_reduction("all")  # noqa: A001
+any = _make_reduction("any")  # noqa: A001
+
+
+def std(a: Any, axis: Optional[int] = None, ddof: int = 0, **kwargs: Any):
+    if isinstance(a, array):
+        return a.std(axis=axis, ddof=ddof)
+    return _np.std(a, axis=axis, ddof=ddof, **kwargs)
+
+
+def var(a: Any, axis: Optional[int] = None, ddof: int = 0, **kwargs: Any):
+    if isinstance(a, array):
+        return a.var(axis=axis, ddof=ddof)
+    return _np.var(a, axis=axis, ddof=ddof, **kwargs)
+
+
+def dot(a: Any, b: Any):
+    if isinstance(a, array):
+        return a.dot(b)
+    return _np.dot(a, _np.asarray(b))
+
+
+# --- creation ------------------------------------------------------------- #
+
+def zeros(shape: Any, dtype: Any = float) -> array:
+    return array(_np.zeros(shape, dtype))
+
+
+def ones(shape: Any, dtype: Any = float) -> array:
+    return array(_np.ones(shape, dtype))
+
+
+def zeros_like(a: Any, dtype: Any = None) -> array:
+    return array(_np.zeros_like(_np.asarray(a), dtype=dtype))
+
+
+def ones_like(a: Any, dtype: Any = None) -> array:
+    return array(_np.ones_like(_np.asarray(a), dtype=dtype))
+
+
+def arange(*args: Any, **kwargs: Any) -> array:
+    return array(_np.arange(*args, **kwargs))
+
+
+def linspace(*args: Any, **kwargs: Any) -> array:
+    return array(_np.linspace(*args, **kwargs))
+
+
+def asarray(a: Any, dtype: Any = None) -> array:
+    return _as_modin_array(a) if dtype is None else array(a, dtype=dtype)
+
+
+def __getattr__(name: str) -> Any:
+    """Anything else passes through to numpy (reference: modin.numpy fallback)."""
+    return getattr(_np, name)

@@ -1,0 +1,282 @@
+"""``modin_tpu.numpy.array`` — a distributed numpy-compatible array over a QC.
+
+Reference design: modin/numpy/arr.py:141 (the ``array`` class backed by a
+query compiler) + the function modules (math.py/logic.py/...).  The TPU build
+represents a 1-D or 2-D array as a query compiler whose device columns are the
+array columns; elementwise math and reductions run through the same device
+fast paths the dataframe API uses.
+
+This is the numpy *API subset* the reference implements natively; anything
+outside it materializes (``modin_tpu.numpy`` is opt-in via the TpuNumpy
+config, like the reference's ModinNumpy flag).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import numpy
+import pandas
+
+from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
+
+
+class array:
+    """A 1-D or 2-D distributed array backed by a query compiler."""
+
+    def __init__(
+        self,
+        object: Any = None,
+        dtype: Any = None,
+        *,
+        copy: bool = True,
+        ndmin: int = 0,
+        _query_compiler: Any = None,
+        _ndim: Optional[int] = None,
+    ):
+        from modin_tpu.pandas.dataframe import DataFrame
+        from modin_tpu.pandas.series import Series
+
+        if _query_compiler is not None:
+            self._query_compiler = _query_compiler
+            self._ndim = _ndim if _ndim is not None else 2
+            return
+        if isinstance(object, array):
+            self._query_compiler = object._query_compiler.copy()
+            self._ndim = object._ndim
+            if dtype is not None:
+                self._query_compiler = self._query_compiler.astype(dtype)
+            return
+        if isinstance(object, Series):
+            self._query_compiler = object._query_compiler.copy()
+            self._ndim = 1
+            return
+        if isinstance(object, DataFrame):
+            self._query_compiler = object._query_compiler.copy()
+            self._ndim = 2
+            return
+        np_arr = numpy.asarray(object, dtype=dtype)
+        if np_arr.ndim > 2:
+            raise ValueError("modin_tpu.numpy only supports 1-D and 2-D arrays")
+        self._ndim = max(np_arr.ndim, ndmin) if np_arr.ndim else 1
+        if np_arr.ndim <= 1:
+            frame = pandas.DataFrame({MODIN_UNNAMED_SERIES_LABEL: numpy.atleast_1d(np_arr)})
+        else:
+            frame = pandas.DataFrame(np_arr)
+        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+            FactoryDispatcher,
+        )
+
+        self._query_compiler = FactoryDispatcher.from_pandas(frame)
+
+    # ------------------------------------------------------------------ #
+    # Introspection
+    # ------------------------------------------------------------------ #
+
+    @property
+    def shape(self) -> tuple:
+        nrows = self._query_compiler.get_axis_len(0)
+        if self._ndim == 1:
+            return (nrows,)
+        return (nrows, self._query_compiler.get_axis_len(1))
+
+    @property
+    def ndim(self) -> int:
+        return self._ndim
+
+    @property
+    def size(self) -> int:
+        return int(numpy.prod(self.shape))
+
+    @property
+    def dtype(self):
+        dtypes = self._query_compiler.dtypes
+        return numpy.result_type(*dtypes.tolist()) if len(dtypes) else numpy.dtype("float64")
+
+    @property
+    def T(self) -> "array":
+        if self._ndim == 1:
+            return self
+        return array(_query_compiler=self._query_compiler.transpose(), _ndim=2)
+
+    def _to_numpy(self) -> numpy.ndarray:
+        values = self._query_compiler.to_numpy()
+        if self._ndim == 1:
+            return values.ravel()
+        return values
+
+    __array_priority__ = 100
+
+    def __array__(self, dtype: Any = None, copy: Optional[bool] = None) -> numpy.ndarray:
+        result = self._to_numpy()
+        return result.astype(dtype) if dtype is not None else result
+
+    def __repr__(self) -> str:
+        return repr(self._to_numpy()).replace("array", "array", 1)
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def tolist(self) -> list:
+        return self._to_numpy().tolist()
+
+    # ------------------------------------------------------------------ #
+    # Arithmetic (device fast paths via the QC binary ops)
+    # ------------------------------------------------------------------ #
+
+    def _binary(self, op: str, other: Any) -> "array":
+        if isinstance(other, array):
+            other_arg = other._query_compiler
+            ndim = max(self._ndim, other._ndim)
+        else:
+            other_arg = other
+            ndim = self._ndim
+        result = getattr(self._query_compiler, op)(other_arg, axis=0)
+        return array(_query_compiler=result, _ndim=ndim)
+
+    def __add__(self, other):
+        return self._binary("add", other)
+
+    def __radd__(self, other):
+        return self._binary("radd", other)
+
+    def __sub__(self, other):
+        return self._binary("sub", other)
+
+    def __rsub__(self, other):
+        return self._binary("rsub", other)
+
+    def __mul__(self, other):
+        return self._binary("mul", other)
+
+    def __rmul__(self, other):
+        return self._binary("rmul", other)
+
+    def __truediv__(self, other):
+        return self._binary("truediv", other)
+
+    def __rtruediv__(self, other):
+        return self._binary("rtruediv", other)
+
+    def __floordiv__(self, other):
+        return self._binary("floordiv", other)
+
+    def __mod__(self, other):
+        return self._binary("mod", other)
+
+    def __pow__(self, other):
+        return self._binary("pow", other)
+
+    def __eq__(self, other):  # type: ignore[override]
+        return self._binary("eq", other)
+
+    def __ne__(self, other):  # type: ignore[override]
+        return self._binary("ne", other)
+
+    def __lt__(self, other):
+        return self._binary("lt", other)
+
+    def __le__(self, other):
+        return self._binary("le", other)
+
+    def __gt__(self, other):
+        return self._binary("gt", other)
+
+    def __ge__(self, other):
+        return self._binary("ge", other)
+
+    def __neg__(self):
+        return array(_query_compiler=self._query_compiler.negative(), _ndim=self._ndim)
+
+    def __abs__(self):
+        return array(_query_compiler=self._query_compiler.abs(), _ndim=self._ndim)
+
+    def __invert__(self):
+        return array(_query_compiler=self._query_compiler.invert(), _ndim=self._ndim)
+
+    def __and__(self, other):
+        return self._binary("__and__", other)
+
+    def __or__(self, other):
+        return self._binary("__or__", other)
+
+    def __xor__(self, other):
+        return self._binary("__xor__", other)
+
+    def __getitem__(self, key: Any):
+        result = self._to_numpy()[key]
+        if isinstance(result, numpy.ndarray):
+            return array(result)
+        return result
+
+    # ------------------------------------------------------------------ #
+    # Reductions
+    # ------------------------------------------------------------------ #
+
+    def _reduce(self, op: str, axis: Optional[int] = None, **kwargs: Any):
+        qc = self._query_compiler
+        if self._ndim == 1:
+            result = getattr(qc, op)(axis=0, **kwargs)
+            if hasattr(result, "to_pandas"):
+                return result.to_pandas().squeeze()
+            return result
+        if axis is None:
+            first = getattr(qc, op)(axis=0, **kwargs)
+            if hasattr(first, "to_pandas"):
+                second = getattr(first.columnarize(), op)(axis=0, **kwargs)
+                if hasattr(second, "to_pandas"):
+                    return second.to_pandas().squeeze()
+                return second
+            return first
+        result = getattr(qc, op)(axis=axis, **kwargs)
+        return array(_query_compiler=result.columnarize(), _ndim=1)
+
+    def sum(self, axis: Optional[int] = None, **kwargs: Any):
+        return self._reduce("sum", axis, skipna=True)
+
+    def mean(self, axis: Optional[int] = None, **kwargs: Any):
+        return self._reduce("mean", axis, skipna=True)
+
+    def prod(self, axis: Optional[int] = None, **kwargs: Any):
+        return self._reduce("prod", axis, skipna=True)
+
+    def min(self, axis: Optional[int] = None, **kwargs: Any):
+        return self._reduce("min", axis, skipna=True)
+
+    def max(self, axis: Optional[int] = None, **kwargs: Any):
+        return self._reduce("max", axis, skipna=True)
+
+    def std(self, axis: Optional[int] = None, ddof: int = 0, **kwargs: Any):
+        return self._reduce("std", axis, skipna=True, ddof=ddof)
+
+    def var(self, axis: Optional[int] = None, ddof: int = 0, **kwargs: Any):
+        return self._reduce("var", axis, skipna=True, ddof=ddof)
+
+    def all(self, axis: Optional[int] = None, **kwargs: Any):
+        return self._reduce("all", axis)
+
+    def any(self, axis: Optional[int] = None, **kwargs: Any):
+        return self._reduce("any", axis)
+
+    def astype(self, dtype: Any, copy: bool = True) -> "array":
+        return array(
+            _query_compiler=self._query_compiler.astype(dtype), _ndim=self._ndim
+        )
+
+    def flatten(self, order: str = "C") -> "array":
+        return array(self._to_numpy().ravel(order))
+
+    def reshape(self, *shape: Any) -> "array":
+        return array(self._to_numpy().reshape(*shape))
+
+    def transpose(self) -> "array":
+        return self.T
+
+    def dot(self, other: Any):
+        return array(numpy.dot(self._to_numpy(), numpy.asarray(other)))
+
+    def _math(self, op_name: str) -> "array":
+        return array(
+            _query_compiler=self._query_compiler.unary_math(op_name),
+            _ndim=self._ndim,
+        )

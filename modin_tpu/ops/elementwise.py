@@ -105,6 +105,18 @@ def _build_ops() -> dict:
         "invert": lambda x: ~x,
         "isna": lambda x: jnp.isnan(x) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.zeros(x.shape, bool),
         "notna": lambda x: ~jnp.isnan(x) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.ones(x.shape, bool),
+        "sqrt": lambda x: jnp.sqrt(x),
+        "exp": lambda x: jnp.exp(x),
+        "log": lambda x: jnp.log(x),
+        "log2": lambda x: jnp.log2(x),
+        "log10": lambda x: jnp.log10(x),
+        "sin": lambda x: jnp.sin(x),
+        "cos": lambda x: jnp.cos(x),
+        "tan": lambda x: jnp.tan(x),
+        "tanh": lambda x: jnp.tanh(x),
+        "floor": lambda x: jnp.floor(x),
+        "ceil": lambda x: jnp.ceil(x),
+        "sign": lambda x: jnp.sign(x),
         "cumsum": lambda x: jnp.cumsum(x),
         "cumprod": lambda x: jnp.cumprod(x),
         "cummax": lambda x: jax_lax_cummax(x),

@@ -362,6 +362,173 @@ def _jit_segment_size(num_segments: int, p_out: int):
     return jax.jit(fn)
 
 
+# Above this many groups the masked-scan kernel's O(n*G) work loses to the
+# scatter-based segment ops; below it, the scan avoids TPU's slow scatters
+# (measured: segment_sum ~1s vs masked reduce ~50ms at 1e7 rows, G=101).
+_MASKED_SCAN_MAX_GROUPS = 1024
+_SCAN_CHUNK = 65536
+_FORCE_KERNEL = None  # test hook: "masked_scan" | "segment" | None
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_masked_scan_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_out: int, chunk: int):
+    """Chunked masked-reduce aggregation: one lax.scan over row chunks, each
+    step reducing a [chunk, G+1] one-hot mask on the VPU (no scatters)."""
+    import jax
+    import jax.numpy as jnp
+
+    G = num_segments  # includes the overflow bucket
+    n_groups = num_segments - 1
+
+    def fn(cols: Tuple, codes):
+        P = codes.shape[0]
+        steps = -(-P // chunk)
+        pad = steps * chunk - P
+        cpad = jnp.concatenate(
+            [codes, jnp.full(pad, n_groups, codes.dtype)]
+        ).reshape(steps, chunk)
+        xpads = tuple(
+            jnp.concatenate([c, jnp.zeros(pad, c.dtype)]).reshape(steps, chunk)
+            for c in cols
+        )
+        group_ids = jnp.arange(G)
+
+        def body(carry, inp):
+            cc = inp[0]
+            oh = cc[:, None] == group_ids[None, :]  # [chunk, G] bool
+            new_carry = []
+            ci = 0
+            for i in range(n_cols):
+                xc = inp[1 + i]
+                is_f = jnp.issubdtype(xc.dtype, jnp.floating)
+                nanm = jnp.isnan(xc) if is_f else None
+                if agg in ("sum", "mean"):
+                    xz = jnp.where(nanm, 0, xc) if is_f else xc
+                    s = carry[ci] + jnp.sum(
+                        jnp.where(oh, xz[:, None], 0), axis=0
+                    )
+                    new_carry.append(s)
+                    ci += 1
+                    if agg != "sum":
+                        v = (~nanm if is_f else jnp.ones(xc.shape, bool))
+                        cnt = carry[ci] + jnp.sum(oh & v[:, None], axis=0)
+                        new_carry.append(cnt)
+                        ci += 1
+                elif agg == "count":
+                    v = (~nanm if is_f else jnp.ones(xc.shape, bool))
+                    cnt = carry[ci] + jnp.sum(oh & v[:, None], axis=0)
+                    new_carry.append(cnt)
+                    ci += 1
+                elif agg == "prod":
+                    xz = jnp.where(nanm, 1, xc) if is_f else xc
+                    pr = carry[ci] * jnp.prod(
+                        jnp.where(oh, xz[:, None], 1), axis=0
+                    )
+                    new_carry.append(pr)
+                    ci += 1
+                elif agg == "min":
+                    xz = jnp.where(nanm, jnp.inf, xc) if is_f else xc
+                    neutral = jnp.inf if is_f else _INT_MAXES[str(xc.dtype)]
+                    m = jnp.minimum(
+                        carry[ci],
+                        jnp.min(jnp.where(oh, xz[:, None], neutral), axis=0),
+                    )
+                    new_carry.append(m)
+                    ci += 1
+                elif agg == "max":
+                    xz = jnp.where(nanm, -jnp.inf, xc) if is_f else xc
+                    neutral = -jnp.inf if is_f else _INT_MINS[str(xc.dtype)]
+                    m = jnp.maximum(
+                        carry[ci],
+                        jnp.max(jnp.where(oh, xz[:, None], neutral), axis=0),
+                    )
+                    new_carry.append(m)
+                    ci += 1
+                elif agg in ("any", "all"):
+                    if is_f:
+                        t = jnp.where(nanm, agg == "all", xc != 0)
+                    else:
+                        t = xc != 0 if xc.dtype != jnp.bool_ else xc
+                    if agg == "any":
+                        r = carry[ci] | jnp.any(oh & t[:, None], axis=0)
+                    else:
+                        r = carry[ci] & jnp.all((~oh) | t[:, None], axis=0)
+                    new_carry.append(r)
+                    ci += 1
+                else:
+                    raise ValueError(agg)
+            return tuple(new_carry), None
+
+        # build initial carry matching the body's layout
+        init = []
+        for c in cols:
+            is_f = jnp.issubdtype(c.dtype, jnp.floating)
+            if agg in ("sum", "mean"):
+                init.append(jnp.zeros(G, c.dtype))
+                if agg != "sum":
+                    init.append(jnp.zeros(G, jnp.int64))
+            elif agg == "count":
+                init.append(jnp.zeros(G, jnp.int64))
+            elif agg == "prod":
+                init.append(jnp.ones(G, c.dtype))
+            elif agg == "min":
+                init.append(
+                    jnp.full(G, jnp.inf if is_f else _INT_MAXES[str(c.dtype)], c.dtype)
+                )
+            elif agg == "max":
+                init.append(
+                    jnp.full(G, -jnp.inf if is_f else _INT_MINS[str(c.dtype)], c.dtype)
+                )
+            elif agg == "any":
+                init.append(jnp.zeros(G, bool))
+            elif agg == "all":
+                init.append(jnp.ones(G, bool))
+        carry, _ = jax.lax.scan(body, tuple(init), (cpad, *xpads))
+
+        # finalize per column
+        def finish(r):
+            r = r[:n_groups]
+            if p_out > n_groups:
+                r = jnp.concatenate([r, jnp.zeros(p_out - n_groups, r.dtype)])
+            return r
+
+        out = []
+        ci = 0
+        for c in cols:
+            is_f = jnp.issubdtype(c.dtype, jnp.floating)
+            if agg == "sum":
+                out.append(finish(carry[ci])); ci += 1
+            elif agg == "mean":
+                s = carry[ci]; ci += 1
+                cnt = carry[ci]; ci += 1
+                out.append(finish(s / cnt))
+            elif agg == "count":
+                out.append(finish(carry[ci])); ci += 1
+            elif agg == "min":
+                r = carry[ci]; ci += 1
+                out.append(finish(jnp.where(jnp.isposinf(r), jnp.nan, r) if is_f else r))
+            elif agg == "max":
+                r = carry[ci]; ci += 1
+                out.append(finish(jnp.where(jnp.isneginf(r), jnp.nan, r) if is_f else r))
+            else:
+                out.append(finish(carry[ci])); ci += 1
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
+_INT_MAXES = {
+    k: np.iinfo(k).max
+    for k in ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
+}
+_INT_MAXES["bool"] = True
+_INT_MINS = {
+    k: np.iinfo(k).min
+    for k in ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
+}
+_INT_MINS["bool"] = False
+
+
 def groupby_reduce(
     agg: str,
     value_cols: List[Any],
@@ -373,11 +540,28 @@ def groupby_reduce(
     """Aggregate value columns by group codes; returns device arrays padded to
     the shard multiple with logical length num_groups (the overflow pad/NaN
     bucket is sliced off)."""
+    import jax
+
     from modin_tpu.ops.structural import pad_len
 
     ns = num_groups + 1
     p_out = pad_len(num_groups)
     if agg == "size":
         return [_jit_segment_size(ns, p_out)(codes)]
+    on_tpu = next(iter(codes.devices())).platform == "tpu"
+    if _FORCE_KERNEL == "masked_scan":
+        on_tpu = True
+    elif _FORCE_KERNEL == "segment":
+        on_tpu = False
+    use_masked_scan = (
+        on_tpu
+        and num_groups <= _MASKED_SCAN_MAX_GROUPS
+        # var/std/sem need the two-pass centered form -> segment path
+        and agg in ("sum", "count", "mean", "min", "max", "prod", "any", "all")
+    )
+    if use_masked_scan:
+        # TPU scatters serialize badly; the masked scan keeps the work on the VPU
+        fn = _jit_masked_scan_agg(agg, len(value_cols), ns, int(ddof), p_out, _SCAN_CHUNK)
+        return list(fn(tuple(value_cols), codes))
     fn = _jit_segment_agg(agg, len(value_cols), ns, int(ddof), p_out)
     return list(fn(tuple(value_cols), codes))

@@ -1,0 +1,3 @@
+"""Public extension APIs (reference: modin/pandas/api/)."""
+
+from modin_tpu.pandas.api import extensions  # noqa: F401

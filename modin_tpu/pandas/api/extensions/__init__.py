@@ -1,0 +1,10 @@
+"""Accessor registration (reference: modin/pandas/api/extensions/)."""
+
+from modin_tpu.pandas.api.extensions.extensions import (  # noqa: F401
+    register_base_accessor,
+    register_dataframe_accessor,
+    register_dataframe_groupby_accessor,
+    register_pd_accessor,
+    register_series_accessor,
+    register_series_groupby_accessor,
+)

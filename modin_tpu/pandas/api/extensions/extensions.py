@@ -1,0 +1,70 @@
+"""Accessor/method registration API.
+
+Reference design: modin/pandas/api/extensions/extensions.py:135-371
+(register_dataframe_accessor / register_series_accessor /
+register_base_accessor / register_pd_accessor).  Registered accessors are
+cached-per-instance like pandas' own extension machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from modin_tpu.pandas.accessor import CachedAccessor
+
+
+def _register_accessor(name: str, cls: type) -> Callable:
+    def decorator(accessor: Any) -> Any:
+        if callable(accessor) and not isinstance(accessor, type):
+            # function accessor: expose directly as a method
+            setattr(cls, name, accessor)
+        else:
+            setattr(cls, name, CachedAccessor(name, accessor))
+        return accessor
+
+    return decorator
+
+
+def register_dataframe_accessor(name: str, backend: Optional[str] = None) -> Callable:
+    """Register a custom accessor/method on modin_tpu DataFrame."""
+    from modin_tpu.pandas.dataframe import DataFrame
+
+    return _register_accessor(name, DataFrame)
+
+
+def register_series_accessor(name: str, backend: Optional[str] = None) -> Callable:
+    """Register a custom accessor/method on modin_tpu Series."""
+    from modin_tpu.pandas.series import Series
+
+    return _register_accessor(name, Series)
+
+
+def register_base_accessor(name: str, backend: Optional[str] = None) -> Callable:
+    """Register a custom accessor on the shared DataFrame/Series base."""
+    from modin_tpu.pandas.base import BasePandasDataset
+
+    return _register_accessor(name, BasePandasDataset)
+
+
+def register_dataframe_groupby_accessor(name: str, backend: Optional[str] = None) -> Callable:
+    from modin_tpu.pandas.groupby import DataFrameGroupBy
+
+    return _register_accessor(name, DataFrameGroupBy)
+
+
+def register_series_groupby_accessor(name: str, backend: Optional[str] = None) -> Callable:
+    from modin_tpu.pandas.groupby import SeriesGroupBy
+
+    return _register_accessor(name, SeriesGroupBy)
+
+
+def register_pd_accessor(name: str, backend: Optional[str] = None) -> Callable:
+    """Register a custom function/object on the modin_tpu.pandas module."""
+
+    def decorator(obj: Any) -> Any:
+        import modin_tpu.pandas as pd_module
+
+        setattr(pd_module, name, obj)
+        return obj
+
+    return decorator

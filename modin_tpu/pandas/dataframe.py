@@ -900,6 +900,22 @@ class DataFrame(BasePandasDataset):
         )
 
     def eval(self, expr: str, inplace: bool = False, **kwargs: Any):
+        from modin_tpu.core.computation.eval import try_eval
+
+        if not kwargs:
+            native = try_eval(self, expr)
+            if native is not None:
+                result, assigned = native
+                if assigned is not None:
+                    out = self.copy()
+                    out[assigned] = result
+                    if inplace:
+                        self._update_inplace(out._query_compiler)
+                        return None
+                    return out
+                if not inplace:
+                    return result
+                raise ValueError("Cannot operate inplace if there is no assignment")
         result = self._default_to_pandas("eval", expr, **kwargs)
         if inplace:
             if isinstance(result, DataFrame):
@@ -909,6 +925,15 @@ class DataFrame(BasePandasDataset):
         return result
 
     def query(self, expr: str, *, inplace: bool = False, **kwargs: Any):
+        from modin_tpu.core.computation.eval import try_query
+
+        if not kwargs:
+            native = try_query(self, expr)
+            if native is not None:
+                if inplace:
+                    self._update_inplace(native._query_compiler)
+                    return None
+                return native
         result = self._default_to_pandas("query", expr, **kwargs)
         if inplace:
             self._update_inplace(result._query_compiler)

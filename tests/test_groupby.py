@@ -162,3 +162,19 @@ def test_groupby_cumulative(dfs):
         md.groupby("int_key")["val_int"].cumsum(),
         pdf.groupby("int_key")["val_int"].cumsum(),
     )
+
+
+@pytest.mark.parametrize("agg", ["sum", "count", "mean", "min", "max", "prod", "any", "all"])
+def test_groupby_masked_scan_kernel_matches(agg, monkeypatch):
+    """The TPU masked-scan kernel must match the segment kernel numerics."""
+    from modin_tpu.ops import groupby as gb_ops
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("device kernels")
+    md, pdf = create_test_dfs(GB_DATA)
+    monkeypatch.setattr(gb_ops, "_FORCE_KERNEL", "masked_scan")
+    df_equals(
+        getattr(md.groupby("int_key"), agg)(),
+        getattr(pdf.groupby("int_key"), agg)(),
+    )

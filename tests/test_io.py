@@ -156,3 +156,37 @@ class TestOtherFormats:
         path = tmp_path / "d.pkl"
         md.to_pickle(str(path))
         df_equals(pd.read_pickle(str(path)), md)
+
+
+class TestParallelPathEngages:
+    def test_public_read_uses_parallel_path(self, tmp_path, monkeypatch):
+        """Regression: default-bound kwargs (no_default sentinels) must not
+        disqualify the chunked path, and the native chunker must accept the
+        mmap buffer."""
+        import modin_tpu.core.io.text.csv_dispatcher as disp
+
+        rng = np.random.default_rng(3)
+        n = 400_000
+        pandas.DataFrame(
+            {"a": rng.integers(0, 9, n), "b": rng.uniform(0, 1, n)}
+        ).to_csv(tmp_path / "big.csv", index=False)
+
+        calls = {"parallel": 0}
+        orig = disp.CSVDispatcher._read_parallel.__func__
+
+        def spy(cls, path, kwargs):
+            calls["parallel"] += 1
+            return orig(cls, path, kwargs)
+
+        monkeypatch.setattr(disp.CSVDispatcher, "_read_parallel", classmethod(spy))
+        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        md = pd.read_csv(str(tmp_path / "big.csv"))
+        assert calls["parallel"] == 1
+        df_equals(md, pandas.read_csv(tmp_path / "big.csv"))
+
+    def test_chunker_no_truncation_many_chunks(self):
+        """Regression: bodies larger than max_chunks*target must not lose rows."""
+        body = b"x\n" + b"1\n" * 100_000
+        ranges = split_record_ranges(bytes(body), 2, 8, max_chunks=16)
+        assert ranges[-1][1] == len(body)
+        assert sum(e - s for s, e in ranges) == len(body) - 2

@@ -1,0 +1,100 @@
+"""Differential tests for modin_tpu.numpy (modeled on modin/tests/numpy/)."""
+
+import numpy
+import pytest
+
+import modin_tpu.numpy as mnp
+from tests.utils import df_equals
+
+_rng = numpy.random.default_rng(33)
+VEC = _rng.uniform(-10, 10, 100)
+MAT = _rng.uniform(-10, 10, (40, 5))
+
+
+def arr_equals(modin_res, numpy_res, rtol=1e-12):
+    modin_np = numpy.asarray(modin_res)
+    numpy.testing.assert_allclose(modin_np, numpy_res, rtol=rtol)
+
+
+def test_construction_shapes():
+    a = mnp.array(VEC)
+    assert a.shape == VEC.shape and a.ndim == 1
+    m = mnp.array(MAT)
+    assert m.shape == MAT.shape and m.ndim == 2
+    assert m.size == MAT.size
+    arr_equals(a, VEC)
+    arr_equals(m, MAT)
+
+
+@pytest.mark.parametrize("op", ["__add__", "__sub__", "__mul__", "__truediv__", "__pow__"])
+def test_arith_scalar(op):
+    a = mnp.array(VEC)
+    arr_equals(getattr(a, op)(2.5), getattr(VEC, op)(2.5))
+
+
+def test_arith_array():
+    a, b = mnp.array(VEC), mnp.array(VEC * 2)
+    arr_equals(a + b, VEC + VEC * 2)
+    arr_equals(a * b, VEC * (VEC * 2))
+
+
+def test_comparisons_and_logic():
+    a = mnp.array(VEC)
+    arr_equals(numpy.asarray(a > 0), VEC > 0)
+    arr_equals(numpy.asarray(mnp.logical_and(a > 0, a < 5)), (VEC > 0) & (VEC < 5))
+
+
+@pytest.mark.parametrize("fn", ["sqrt", "exp", "log", "sin", "cos", "tanh", "floor", "ceil"])
+def test_unary_math(fn):
+    data = numpy.abs(VEC) + 1.0
+    a = mnp.array(data)
+    arr_equals(getattr(mnp, fn)(a), getattr(numpy, fn)(data), rtol=1e-12)
+
+
+@pytest.mark.parametrize("red", ["sum", "mean", "prod", "amin", "amax"])
+def test_reductions_vec(red):
+    a = mnp.array(numpy.abs(VEC) * 0.1)
+    got = getattr(mnp, red)(a)
+    want = getattr(numpy, red)(numpy.abs(VEC) * 0.1)
+    numpy.testing.assert_allclose(float(got), want, rtol=1e-12)
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_reductions_mat(axis):
+    m = mnp.array(MAT)
+    got = mnp.sum(m, axis=axis)
+    want = numpy.sum(MAT, axis=axis)
+    if axis is None:
+        numpy.testing.assert_allclose(float(got), want, rtol=1e-12)
+    else:
+        arr_equals(got, want)
+
+
+def test_transpose_and_T():
+    m = mnp.array(MAT)
+    arr_equals(m.T, MAT.T)
+
+
+def test_creation_helpers():
+    arr_equals(mnp.zeros(7), numpy.zeros(7))
+    arr_equals(mnp.ones((3, 2)), numpy.ones((3, 2)))
+    arr_equals(mnp.arange(10), numpy.arange(10))
+
+
+def test_astype():
+    a = mnp.array(VEC)
+    assert numpy.asarray(a.astype("float32")).dtype == numpy.float32
+
+
+def test_numpy_passthrough():
+    assert mnp.pi == numpy.pi
+    assert mnp.float64 is numpy.float64
+
+
+def test_interop_with_dataframe():
+    import modin_tpu.pandas as pd
+
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    m = mnp.array(df)
+    assert m.shape == (2, 2)
+    arr_equals(m.sum(axis=0), numpy.array([3.0, 7.0]))

@@ -1,0 +1,136 @@
+"""Tests for the peripheral public APIs: distributed buffers, extensions,
+experimental integrations (reference: modin/tests/pandas/extensions/,
+modin/tests/experimental/)."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals
+
+
+class TestDistributedAPI:
+    def test_unwrap_and_from_partitions_roundtrip(self):
+        from modin_tpu.distributed.dataframe.pandas import (
+            from_partitions,
+            unwrap_partitions,
+        )
+
+        md, pdf = create_test_dfs({"a": np.arange(100.0), "b": np.arange(100)})
+        parts = unwrap_partitions(md)
+        assert len(parts) == 2
+        rebuilt = from_partitions(parts, index=md.index)
+        df_equals(rebuilt, pdf)
+
+    def test_unwrap_exposes_device_arrays(self):
+        from modin_tpu.distributed.dataframe.pandas import unwrap_partitions
+        from modin_tpu.utils import get_current_execution
+
+        if get_current_execution() != "TpuOnJax":
+            pytest.skip("device backend only")
+        import jax
+
+        md, _ = create_test_dfs({"a": np.arange(64.0)})
+        (label, buf), = unwrap_partitions(md)
+        assert isinstance(buf, jax.Array)
+        # consumer can run jit computations directly on the exported buffer
+        assert float(jax.numpy.sum(buf[:64])) == float(np.arange(64.0).sum())
+
+    def test_from_partitions_numpy(self):
+        from modin_tpu.distributed.dataframe.pandas import from_partitions
+
+        df = from_partitions([("x", np.arange(10)), ("y", np.arange(10) * 2.0)])
+        assert list(df.columns) == ["x", "y"]
+        assert df["y"].sum() == 90.0
+
+
+class TestExtensions:
+    def test_register_dataframe_accessor(self):
+        from modin_tpu.pandas.api.extensions import register_dataframe_accessor
+
+        @register_dataframe_accessor("testing_acc")
+        class MyAccessor:
+            def __init__(self, df):
+                self._df = df
+
+            def double_sum(self):
+                return (self._df * 2).sum()
+
+        md, pdf = create_test_dfs({"a": [1, 2, 3]})
+        df_equals(md.testing_acc.double_sum(), (pdf * 2).sum())
+
+    def test_register_series_method(self):
+        from modin_tpu.pandas.api.extensions import register_series_accessor
+
+        @register_series_accessor("plus_one")
+        def plus_one(self):
+            return self + 1
+
+        md, pdf = create_test_dfs({"a": [1, 2, 3]})
+        df_equals(md["a"].plus_one(), pdf["a"] + 1)
+
+    def test_register_pd_accessor(self):
+        from modin_tpu.pandas.api.extensions import register_pd_accessor
+
+        @register_pd_accessor("my_fn")
+        def my_fn():
+            return 42
+
+        assert pd.my_fn() == 42
+
+
+class TestExperimental:
+    def test_train_test_split(self):
+        from modin_tpu.experimental.sklearn.model_selection import train_test_split
+
+        md, _ = create_test_dfs({"a": np.arange(100), "b": np.arange(100) * 2})
+        train, test = train_test_split(md, test_size=0.3, random_state=0)
+        assert len(train) == 70 and len(test) == 30
+        combined = pd.concat([train, test]).sort_index()
+        df_equals(combined, md)
+
+    def test_torch_dataloader(self):
+        torch = pytest.importorskip("torch")
+        from modin_tpu.experimental.torch import to_dataloader
+
+        md, _ = create_test_dfs({"x1": np.arange(16.0), "x2": np.arange(16.0) * 2})
+        loader = to_dataloader(md, batch_size=4)
+        batches = list(loader)
+        assert len(batches) == 4
+        assert batches[0].shape == (4, 2)
+
+    def test_batch_pipeline(self):
+        from modin_tpu.experimental.batch import PandasQueryPipeline
+
+        md, pdf = create_test_dfs({"a": np.arange(50.0)})
+        pipeline = PandasQueryPipeline(md)
+        pipeline.add_query(lambda df: df + 1)
+        pipeline.add_query(lambda df: df * 2, is_output=True)
+        pipeline.add_query(lambda df: df.sum(), is_output=True)
+        out1, out2 = pipeline.compute_batch()
+        df_equals(out1, (pdf + 1) * 2)
+        df_equals(out2, ((pdf + 1) * 2).sum())
+
+    def test_xgboost_raises_cleanly(self):
+        from modin_tpu.experimental import xgboost as mxgb
+
+        md, _ = create_test_dfs({"a": [1.0]})
+        with pytest.raises(ImportError, match="xgboost"):
+            mxgb.DMatrix(md)
+
+
+class TestInterchange:
+    def test_dataframe_protocol(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3], "b": [1.5, 2.5, 3.5]})
+        proto = md.__dataframe__()
+        from pandas.api.interchange import from_dataframe
+
+        df_equals(pd.DataFrame(from_dataframe(proto)), pdf)
+
+    def test_from_dataframe_helper(self):
+        from modin_tpu.pandas.utils import from_dataframe as modin_from_dataframe
+
+        pdf = pandas.DataFrame({"a": [1, 2]})
+        md = modin_from_dataframe(pdf.__dataframe__())
+        df_equals(md, pdf)

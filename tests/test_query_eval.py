@@ -1,0 +1,108 @@
+"""Differential tests for the device-native query/eval expression engine."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals
+
+_rng = np.random.default_rng(21)
+N = 500
+
+QE_DATA = {
+    "a": _rng.uniform(-50, 50, N),
+    "b": _rng.integers(0, 10, N),
+    "c d": _rng.uniform(0, 1, N),  # space -> needs backticks
+    "s": _rng.choice(["x", "y", "z"], N),
+}
+
+
+@pytest.fixture
+def dfs():
+    return create_test_dfs(QE_DATA)
+
+
+@pytest.mark.parametrize(
+    "expr",
+    [
+        "a > 0",
+        "a > 0 and b < 5",
+        "a > 0 & (b == 3)",
+        "(a + b) * 2 >= 10",
+        "b in [1, 2, 3]",
+        "b not in [1, 2, 3]",
+        "not a > 0",
+        "0 < a < 20",
+        "a ** 2 > 100",
+        "`c d` > 0.5",
+        "b % 2 == 0",
+        "-a > 5",
+        "a > 3 or b < 2",
+        "s == 'x'",
+    ],
+)
+def test_query(dfs, expr):
+    md, pdf = dfs
+    df_equals(md.query(expr), pdf.query(expr))
+
+
+def test_query_local_variable(dfs):
+    md, pdf = dfs
+    threshold = 10
+    df_equals(md.query("a > @threshold"), pdf.query("a > @threshold"))
+
+
+def test_query_runs_on_device(dfs):
+    md, _ = dfs
+    numeric = md[["a", "b"]]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # no pandas fallback
+        result = numeric.query("a > 0 & b < 5")
+    assert len(result) > 0
+
+
+@pytest.mark.parametrize(
+    "expr",
+    [
+        "a + b",
+        "a * 2 - b",
+        "e = a + b",
+        "`c d` * 10",
+    ],
+)
+def test_eval(dfs, expr):
+    md, pdf = dfs
+    df_equals(md.eval(expr), pdf.eval(expr))
+
+
+def test_eval_inplace(dfs):
+    md, pdf = dfs
+    md.eval("f = a - b", inplace=True)
+    pdf.eval("f = a - b", inplace=True)
+    df_equals(md, pdf)
+
+
+def test_query_inplace(dfs):
+    md, pdf = dfs
+    md.query("a > 0", inplace=True)
+    pdf.query("a > 0", inplace=True)
+    df_equals(md, pdf)
+
+
+def test_query_fallback_exotic(dfs):
+    md, pdf = dfs
+    # .str accessor forces the pandas fallback but stays correct
+    df_equals(
+        md.query("s.str.contains('x')", engine="python"),
+        pdf.query("s.str.contains('x')", engine="python"),
+    )
+
+
+def test_query_undefined_name_raises(dfs):
+    md, pdf = dfs
+    with pytest.raises(Exception):
+        pdf.query("nope > 1")
+    with pytest.raises(Exception):
+        md.query("nope > 1")
