@@ -14,6 +14,7 @@ from modin_tpu.core.io.column_stores.parquet_dispatcher import (
     ParquetDispatcher,
 )
 from modin_tpu.core.io.io import BaseIO
+from modin_tpu.core.io.sql.sql_dispatcher import SQLDispatcher
 from modin_tpu.core.io.text.csv_dispatcher import CSVDispatcher, TableDispatcher
 from modin_tpu.core.storage_formats.tpu.query_compiler import TpuQueryCompiler
 
@@ -34,6 +35,11 @@ class TpuParquetDispatcher(ParquetDispatcher):
 
 
 class TpuFeatherDispatcher(FeatherDispatcher):
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe
+
+
+class TpuSQLDispatcher(SQLDispatcher):
     query_compiler_cls = TpuQueryCompiler
     frame_cls = TpuDataframe
 
@@ -64,3 +70,11 @@ class TpuOnJaxIO(BaseIO):
     @classmethod
     def read_feather(cls, **kwargs: Any):
         return TpuFeatherDispatcher.read(**kwargs)
+
+    @classmethod
+    def read_sql(cls, **kwargs: Any):
+        return TpuSQLDispatcher.read(**kwargs)
+
+    @classmethod
+    def to_sql(cls, qc: Any, **kwargs: Any):
+        return TpuSQLDispatcher.write(qc, **kwargs)

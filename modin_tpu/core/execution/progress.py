@@ -1,0 +1,73 @@
+"""Adaptive query progress (AQP): live progress for API-layer operations.
+
+Reference design: modin/core/execution/modin_aqp.py:32 — a tqdm bar tracking
+outstanding partition futures per line of user code.  On the device engine
+there is one fused computation instead of N partition tasks, so progress is
+reported per operation: a bar appears for calls that outlive a threshold and
+completes when the device result is ready.  Gated by the ProgressBar config.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+_LONG_OP_SECONDS = 0.5
+_reentrancy = threading.local()
+
+
+class _OpProgress:
+    """Displays a spinner/bar for one long-running operation."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self) -> "_OpProgress":
+        _reentrancy.active = True
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _reentrancy.active = False
+        self._done.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    def _run(self) -> None:
+        # wait before showing anything: short ops stay silent
+        if self._done.wait(_LONG_OP_SECONDS):
+            return
+        try:
+            from tqdm.auto import tqdm
+
+            bar = tqdm(desc=f"modin_tpu::{self.name}", total=None, leave=False)
+            while not self._done.wait(0.25):
+                bar.update(1)
+            bar.close()
+        except ImportError:
+            start = time.time()
+            while not self._done.wait(1.0):
+                elapsed = time.time() - start
+                print(  # noqa: T201
+                    f"\rmodin_tpu::{self.name} running {elapsed:.0f}s", end=""
+                )
+            print("\r", end="")  # noqa: T201
+
+
+def call_progress_bar(name: str) -> Any:
+    """Context manager showing progress for ``name`` when ProgressBar is on.
+
+    Only the OUTERMOST API call gets a bar: nested API-layer calls inside an
+    active operation are no-ops (re-entrancy guard).
+    """
+    import contextlib
+
+    from modin_tpu.config import ProgressBar
+
+    if not ProgressBar.get() or getattr(_reentrancy, "active", False):
+        return contextlib.nullcontext()
+    return _OpProgress(name)

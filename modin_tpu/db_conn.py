@@ -1,0 +1,71 @@
+"""Picklable database-connection descriptor.
+
+Reference design: modin/db_conn.py — a connection is described (module +
+args) rather than held, so parallel readers can each open their own.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+
+class UnsupportedDatabaseException(Exception):
+    pass
+
+
+_PSYCOPG_LIB_NAME = "psycopg2"
+_SQLALCHEMY_LIB_NAME = "sqlalchemy"
+_SQLITE3_LIB_NAME = "sqlite3"
+
+
+class ModinDatabaseConnection:
+    """Distributable descriptor of how to open a DB connection."""
+
+    def __init__(self, lib: str, *args: Any, **kwargs: Any):
+        lib = lib.lower()
+        if lib not in (_PSYCOPG_LIB_NAME, _SQLALCHEMY_LIB_NAME, _SQLITE3_LIB_NAME):
+            raise UnsupportedDatabaseException(f"Unsupported database library {lib}")
+        self.lib = lib
+        self.args = args
+        self.kwargs = kwargs
+        self._dialect_is_microsoft_sql_cache: Optional[bool] = None
+
+    def _dialect_is_microsoft_sql(self) -> bool:
+        if self._dialect_is_microsoft_sql_cache is None:
+            self._dialect_is_microsoft_sql_cache = False
+            if self.lib == _SQLALCHEMY_LIB_NAME:
+                from sqlalchemy import create_engine
+
+                self._dialect_is_microsoft_sql_cache = create_engine(
+                    *self.args, **self.kwargs
+                ).driver in ("pymssql", "pyodbc")
+        return self._dialect_is_microsoft_sql_cache
+
+    def get_connection(self) -> Any:
+        """Open a fresh connection from the descriptor."""
+        if self.lib == _PSYCOPG_LIB_NAME:
+            import psycopg2
+
+            return psycopg2.connect(*self.args, **self.kwargs)
+        if self.lib == _SQLALCHEMY_LIB_NAME:
+            from sqlalchemy import create_engine
+
+            return create_engine(*self.args, **self.kwargs).connect()
+        import sqlite3
+
+        return sqlite3.connect(*self.args, **self.kwargs)
+
+    def column_names_query(self, query: str) -> str:
+        return f"SELECT * FROM ({query}) AS _MODIN_COUNT_QUERY LIMIT 0"
+
+    def row_count_query(self, query: str) -> str:
+        return f"SELECT COUNT(*) FROM ({query}) AS _MODIN_COUNT_QUERY"
+
+    def partition_query(self, query: str, limit: int, offset: int) -> str:
+        """A query fetching rows [offset, offset+limit) of ``query``."""
+        if self._dialect_is_microsoft_sql():
+            return (
+                f"SELECT * FROM ({query}) AS _MODIN_QUERY ORDER BY(SELECT NULL) "
+                f"OFFSET {offset} ROWS FETCH NEXT {limit} ROWS ONLY"
+            )
+        return f"SELECT * FROM ({query}) AS _MODIN_QUERY LIMIT {limit} OFFSET {offset}"

@@ -1,0 +1,27 @@
+"""``modin_tpu.experimental.pandas`` — pandas namespace + experimental IO.
+
+Reference design: modin/experimental/pandas/__init__.py (re-export the whole
+pandas namespace plus glob readers).
+"""
+
+from modin_tpu.pandas import *  # noqa: F401,F403
+from modin_tpu.pandas import __all__ as _base_all
+from modin_tpu.experimental.pandas.io import (  # noqa: F401
+    read_csv_glob,
+    read_custom_text,
+    read_json_glob,
+    read_parquet_glob,
+    read_pickle_glob,
+    read_sql,
+    read_xml_glob,
+    to_csv_glob,
+    to_json_glob,
+    to_parquet_glob,
+    to_pickle_glob,
+)
+
+__all__ = _base_all + [
+    "read_csv_glob", "read_custom_text", "read_json_glob",
+    "read_parquet_glob", "read_pickle_glob", "read_sql", "read_xml_glob",
+    "to_csv_glob", "to_json_glob", "to_parquet_glob", "to_pickle_glob",
+]

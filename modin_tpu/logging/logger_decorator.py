@@ -71,6 +71,17 @@ def enable_logging(
         def run_and_log(*args: Any, **kwargs: Any) -> Any:
             mode = LogMode.get()
             metrics_on = MetricsMode.get() == "Enable" and is_api_layer
+            if is_api_layer:
+                from modin_tpu.config import ProgressBar
+
+                if ProgressBar.get():
+                    from modin_tpu.core.execution.progress import call_progress_bar
+
+                    with call_progress_bar(log_name):
+                        return _run_inner(mode, metrics_on, *args, **kwargs)
+            return _run_inner(mode, metrics_on, *args, **kwargs)
+
+        def _run_inner(mode: str, metrics_on: bool, *args: Any, **kwargs: Any) -> Any:
             if mode == "Disable" and not metrics_on:
                 return obj(*args, **kwargs)
             if mode == "Enable_Api_Only" and not is_api_layer and not metrics_on:

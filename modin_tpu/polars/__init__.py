@@ -1,0 +1,28 @@
+"""``modin_tpu.polars`` — polars-flavored API over the device query compilers.
+
+Reference design: modin/polars/ (4,555 LoC).
+"""
+
+from modin_tpu.polars.dataframe import DataFrame, Expr, GroupBy, Series, col, lit  # noqa: F401
+from modin_tpu.polars.lazyframe import LazyFrame  # noqa: F401
+
+
+def from_pandas(df):
+    """Build a polars-flavored frame from a pandas or modin_tpu frame."""
+    return DataFrame(df)
+
+
+def read_csv(path, **kwargs):
+    """Polars-flavored read_csv through the parallel dispatcher."""
+    import modin_tpu.pandas as mpd
+
+    return DataFrame(mpd.read_csv(path, **kwargs))
+
+
+def concat(items, how: str = "vertical"):
+    import modin_tpu.pandas as mpd
+
+    axis = 0 if how in ("vertical", "diagonal") else 1
+    return DataFrame(
+        mpd.concat([i._md for i in items], axis=axis, ignore_index=axis == 0)
+    )

@@ -1,0 +1,91 @@
+"""Polars-flavored LazyFrame: recorded verb chain, executed on collect().
+
+Reference design: modin/polars/lazyframe.py:17 (trivially-eager LazyFrame).
+The TPU build records the plan and replays it on ``collect()``; because the
+underlying device dispatch is already asynchronous, consecutive device verbs
+pipeline without host synchronization between them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+
+class LazyFrame:
+    """A recorded chain of DataFrame verbs."""
+
+    def __init__(self, data: Any = None, *, _source: Any = None, _plan: Any = None):
+        from modin_tpu.polars.dataframe import DataFrame
+
+        if _source is not None:
+            self._source = _source
+        else:
+            self._source = DataFrame(data)
+        self._plan: List[Callable] = list(_plan or [])
+
+    @classmethod
+    def _from_eager(cls, df: Any) -> "LazyFrame":
+        return cls(_source=df)
+
+    def _chain(self, step: Callable) -> "LazyFrame":
+        return LazyFrame(_source=self._source, _plan=self._plan + [step])
+
+    def collect(self) -> Any:
+        result = self._source
+        for step in self._plan:
+            result = step(result)
+        return result
+
+    def fetch(self, n_rows: int = 500) -> Any:
+        return self._chain(lambda df: df.head(n_rows)).collect()
+
+    @property
+    def columns(self) -> list:
+        # resolving the schema requires replaying column-changing steps
+        return self.collect().columns
+
+    def lazy(self) -> "LazyFrame":
+        return self
+
+
+def _make_lazy_verb(name: str):
+    def verb(self: LazyFrame, *args: Any, **kwargs: Any) -> LazyFrame:
+        return self._chain(lambda df: getattr(df, name)(*args, **kwargs))
+
+    verb.__name__ = name
+    return verb
+
+
+for _name in [
+    "select", "drop", "rename", "with_columns", "filter", "sort", "head",
+    "tail", "limit", "slice", "unique", "join", "vstack", "drop_nulls",
+    "fill_null",
+]:
+    setattr(LazyFrame, _name, _make_lazy_verb(_name))
+
+
+def _lazy_group_by(self: LazyFrame, *by: Any) -> "LazyGroupBy":
+    return LazyGroupBy(self, by)
+
+
+LazyFrame.group_by = _lazy_group_by
+
+
+class LazyGroupBy:
+    def __init__(self, lf: LazyFrame, by: tuple):
+        self._lf = lf
+        self._by = by
+
+    def agg(self, *exprs: Any) -> LazyFrame:
+        by = self._by
+        return self._lf._chain(lambda df: df.group_by(*by).agg(*exprs))
+
+    def __getattr__(self, name: str):
+        if name in ("sum", "mean", "min", "max", "count", "len"):
+            by = self._by
+
+            def verb() -> LazyFrame:
+                return self._lf._chain(lambda df: getattr(df.group_by(*by), name)())
+
+            return verb
+        raise AttributeError(name)

@@ -1,0 +1,147 @@
+"""Differential tests for modin_tpu.polars (vs pandas ground truth;
+modeled on modin/tests/polars/)."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.polars as pl
+
+_rng = np.random.default_rng(17)
+N = 300
+
+DATA = {
+    "grp": _rng.integers(0, 5, N),
+    "val": _rng.uniform(-10, 10, N),
+    "qty": _rng.integers(1, 100, N),
+}
+PDF = pandas.DataFrame(DATA)
+
+
+@pytest.fixture
+def df():
+    return pl.DataFrame(DATA)
+
+
+def eq(pl_df, pandas_df):
+    pandas.testing.assert_frame_equal(
+        pl_df.to_pandas(), pandas_df.reset_index(drop=True), check_dtype=False
+    )
+
+
+def test_shape_schema(df):
+    assert df.shape == PDF.shape
+    assert df.columns == list(PDF.columns)
+    assert df.height == len(PDF) and df.width == PDF.shape[1]
+
+
+def test_select_exprs(df):
+    eq(df.select("val"), PDF[["val"]])
+    eq(
+        df.select((pl.col("val") * 2).alias("v2")),
+        (PDF[["val"]] * 2).rename(columns={"val": "v2"}),
+    )
+    out = df.select(pl.col("val").sum().alias("total"))
+    np.testing.assert_allclose(out.item(), PDF["val"].sum())
+
+
+def test_with_columns_filter_sort(df):
+    got = (
+        df.with_columns((pl.col("val") * pl.col("qty")).alias("rev"))
+        .filter(pl.col("rev") > 0)
+        .sort("rev", descending=True)
+    )
+    want = PDF.assign(rev=PDF.val * PDF.qty)
+    want = want[want.rev > 0].sort_values("rev", ascending=False, kind="stable")
+    eq(got, want)
+
+
+def test_group_by(df):
+    eq(df.group_by("grp").sum(), PDF.groupby("grp").sum().reset_index())
+    got = df.group_by("grp").agg(
+        pl.col("val").mean().alias("val"), pl.col("qty").sum().alias("qty")
+    )
+    want = PDF.groupby("grp").agg(val=("val", "mean"), qty=("qty", "sum")).reset_index()
+    eq(got, want)
+    eq(df.group_by("grp").len(), PDF.groupby("grp").size().to_frame("len").reset_index())
+
+
+def test_join(df):
+    other = pl.DataFrame({"grp": [0, 1, 2], "label": ["a", "b", "c"]})
+    got = df.join(other, on="grp", how="inner").sort(["grp", "val"])
+    want = PDF.merge(
+        pandas.DataFrame({"grp": [0, 1, 2], "label": ["a", "b", "c"]}),
+        on="grp", how="inner",
+    ).sort_values(["grp", "val"], kind="stable")
+    eq(got, want)
+
+
+def test_head_slice_unique(df):
+    eq(df.head(7), PDF.head(7))
+    eq(df.slice(10, 5), PDF.iloc[10:15])
+    small = pl.DataFrame({"a": [1, 1, 2], "b": [3, 3, 4]})
+    eq(small.unique(), pandas.DataFrame({"a": [1, 2], "b": [3, 4]}))
+
+
+def test_vstack_hstack(df):
+    eq(df.vstack(df), pandas.concat([PDF, PDF], ignore_index=True))
+
+
+def test_series_ops(df):
+    s = df["val"]
+    np.testing.assert_allclose(s.sum(), PDF.val.sum())
+    np.testing.assert_allclose((s * 2).sum(), (PDF.val * 2).sum())
+    assert s.name == "val"
+
+
+def test_lazyframe(df):
+    lf = df.lazy().filter(pl.col("val") > 0).with_columns(
+        (pl.col("val") * 2).alias("v2")
+    ).sort("v2")
+    got = lf.collect()
+    want = PDF[PDF.val > 0].assign(v2=lambda d: d.val * 2).sort_values("v2", kind="stable")
+    eq(got, want)
+    # group_by on the lazy chain
+    got2 = df.lazy().group_by("grp").agg(pl.col("val").sum().alias("val")).collect()
+    want2 = PDF.groupby("grp")["val"].sum().reset_index()
+    eq(got2, want2)
+
+
+def test_read_csv(tmp_path):
+    PDF.to_csv(tmp_path / "x.csv", index=False)
+    got = pl.read_csv(str(tmp_path / "x.csv"))
+    eq(got, PDF)
+
+
+def test_fill_drop_nulls():
+    df = pl.DataFrame({"a": [1.0, None, 3.0]})
+    eq(df.fill_null(0.0), pandas.DataFrame({"a": [1.0, 0.0, 3.0]}))
+    eq(df.drop_nulls(), pandas.DataFrame({"a": [1.0, 3.0]}))
+
+
+def test_group_by_agg_alias_and_computed(df):
+    # regression: aliased and computed aggregation expressions
+    got = df.group_by("grp").agg(
+        pl.col("val").sum().alias("total"),
+        (pl.col("val") * 2).mean().alias("dbl_mean"),
+    )
+    want = (
+        PDF.assign(_d=PDF.val * 2)
+        .groupby("grp")
+        .agg(total=("val", "sum"), dbl_mean=("_d", "mean"))
+        .reset_index()
+    )
+    eq(got, want)
+
+
+def test_select_broadcast_scalar(df):
+    # regression: polars broadcasts aggregates alongside full columns
+    got = df.select(pl.col("val"), pl.col("qty").sum().alias("qty_total"))
+    want = PDF[["val"]].assign(qty_total=PDF.qty.sum())
+    eq(got, want)
+
+
+def test_unique_keep_none():
+    small = pl.DataFrame({"a": [1, 1, 2, 3, 3, 4]})
+    got = small.unique(keep="none").sort("a")
+    eq(got, pandas.DataFrame({"a": [2, 4]}))

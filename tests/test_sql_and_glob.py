@@ -1,0 +1,129 @@
+"""Tests: partitioned SQL reader (sqlite), glob IO, custom text."""
+
+import sqlite3
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.db_conn import ModinDatabaseConnection, UnsupportedDatabaseException
+from tests.utils import df_equals
+
+
+@pytest.fixture
+def sqlite_db(tmp_path):
+    path = str(tmp_path / "test.db")
+    conn = sqlite3.connect(path)
+    rng = np.random.default_rng(4)
+    pdf = pandas.DataFrame(
+        {"id": np.arange(5000), "v": rng.uniform(0, 1, 5000).round(6)}
+    )
+    pdf.to_sql("items", conn, index=False)
+    conn.close()
+    return path, pdf
+
+
+class TestSQL:
+    def test_read_sql_plain_connection(self, sqlite_db):
+        path, pdf = sqlite_db
+        conn = sqlite3.connect(path)
+        df_equals(pd.read_sql("SELECT * FROM items", conn), pdf)
+        conn.close()
+
+    def test_read_sql_modin_connection(self, sqlite_db):
+        path, pdf = sqlite_db
+        con = ModinDatabaseConnection("sqlite3", path)
+        df_equals(pd.read_sql("SELECT * FROM items", con), pdf)
+
+    def test_read_sql_partitioned(self, sqlite_db, monkeypatch):
+        import modin_tpu.core.io.sql.sql_dispatcher as disp
+
+        monkeypatch.setattr(disp, "_MIN_PARALLEL_ROWS", 10)
+        path, pdf = sqlite_db
+        con = ModinDatabaseConnection("sqlite3", path)
+        got = pd.read_sql("SELECT * FROM items", con)
+        # LIMIT/OFFSET partitions concatenate in order for sqlite
+        df_equals(got.sort_values("id", ignore_index=True), pdf)
+
+    def test_partition_query_shape(self):
+        con = ModinDatabaseConnection("sqlite3", ":memory:")
+        q = con.partition_query("SELECT * FROM t", 10, 20)
+        assert "LIMIT 10 OFFSET 20" in q
+
+    def test_unsupported_lib(self):
+        with pytest.raises(UnsupportedDatabaseException):
+            ModinDatabaseConnection("mongodb")
+
+    def test_to_sql_roundtrip(self, tmp_path):
+        path = str(tmp_path / "w.db")
+        md = pd.DataFrame({"a": [1, 2, 3]})
+        conn = sqlite3.connect(path)
+        md.to_sql("t", conn, index=False)
+        back = pandas.read_sql("SELECT * FROM t", conn)
+        df_equals(md, back)
+        conn.close()
+
+
+class TestGlobIO:
+    def test_read_csv_glob(self, tmp_path):
+        import modin_tpu.experimental.pandas as xpd
+
+        rng = np.random.default_rng(5)
+        parts = []
+        for i in range(3):
+            part = pandas.DataFrame({"x": rng.integers(0, 9, 100), "part": i})
+            part.to_csv(tmp_path / f"part{i}.csv", index=False)
+            parts.append(part)
+        got = xpd.read_csv_glob(str(tmp_path / "part*.csv"))
+        want = pandas.concat(parts, ignore_index=True)
+        df_equals(got, want)
+
+    def test_to_pickle_glob_roundtrip(self, tmp_path):
+        import modin_tpu.experimental.pandas as xpd
+
+        md = xpd.DataFrame({"a": np.arange(100)})
+        xpd.to_pickle_glob(md, str(tmp_path / "out*.pkl"))
+        back = xpd.read_pickle_glob(str(tmp_path / "out*.pkl"))
+        df_equals(back, md)
+
+    def test_read_custom_text(self, tmp_path):
+        import modin_tpu.experimental.pandas as xpd
+
+        path = tmp_path / "data.txt"
+        path.write_text("1|a\n2|b\n3|c\n")
+
+        def parser(handle):
+            return [line.strip().split("|") for line in handle]
+
+        got = xpd.read_custom_text(str(path), columns=["num", "ch"], custom_parser=parser)
+        df_equals(
+            got,
+            pandas.DataFrame({"num": ["1", "2", "3"], "ch": ["a", "b", "c"]}),
+        )
+
+
+class TestSQLRegressions:
+    def test_index_col_with_modin_connection(self, sqlite_db):
+        path, pdf = sqlite_db
+        con = ModinDatabaseConnection("sqlite3", path)
+        got = pd.read_sql("SELECT * FROM items", con, index_col="id")
+        df_equals(got, pdf.set_index("id"))
+
+    def test_chunksize_returns_iterator(self, sqlite_db):
+        path, pdf = sqlite_db
+        con = ModinDatabaseConnection("sqlite3", path)
+        chunks = list(pd.read_sql("SELECT * FROM items", con, chunksize=1000))
+        assert len(chunks) == 5
+        assert sum(len(c) for c in chunks) == len(pdf)
+
+    def test_experimental_partition_bounds(self, sqlite_db):
+        import modin_tpu.experimental.pandas as xpd
+
+        path, pdf = sqlite_db
+        con = ModinDatabaseConnection("sqlite3", path)
+        got = xpd.read_sql(
+            "SELECT * FROM items", con,
+            partition_column="id", lower_bound=0, upper_bound=5000,
+        )
+        df_equals(got.sort_values("id", ignore_index=True), pdf)

@@ -1,0 +1,115 @@
+"""End-to-end workflow stress tests.
+
+Counterpart of the reference's stress suite (stress_tests/test_kaggle_ipynb.py
+— real notebook pipelines run against both implementations) and the fuzzydata
+random-workflow harness (modin/experimental/fuzzydata).
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import df_equals
+
+
+def make_taxi_like(tmp_path, n=20_000):
+    """A mixed-dtype dataset shaped like the NYC-taxi workload."""
+    rng = np.random.default_rng(99)
+    pdf = pandas.DataFrame(
+        {
+            "vendor": rng.choice(["A", "B", "C"], n),
+            "passengers": rng.integers(1, 7, n),
+            "distance": rng.gamma(2.0, 2.0, n).round(2),
+            "fare": rng.gamma(3.0, 5.0, n).round(2),
+            "tip": rng.uniform(0, 20, n).round(2),
+            "pickup": pandas.to_datetime("2024-01-01")
+            + pandas.to_timedelta(rng.integers(0, 86400 * 30, n), unit="s"),
+            "payment": rng.choice(["card", "cash"], n),
+        }
+    )
+    path = tmp_path / "taxi.csv"
+    pdf.to_csv(path, index=False)
+    return str(path)
+
+
+class TestTaxiWorkflow:
+    """read_csv -> derive -> filter -> groupby -> merge -> sort, both impls."""
+
+    def test_full_pipeline(self, tmp_path):
+        path = make_taxi_like(tmp_path)
+
+        def pipeline(lib, read_csv):
+            df = read_csv(path, parse_dates=["pickup"])
+            df["total"] = df["fare"] + df["tip"]
+            df["tip_pct"] = df["tip"] / df["fare"].clip(lower=0.01)
+            busy = df[df["passengers"] >= 2]
+            by_vendor = busy.groupby("vendor", as_index=False).agg(
+                {"total": "sum", "distance": "mean", "tip_pct": "mean"}
+            )
+            lookup = lib.DataFrame(
+                {"vendor": ["A", "B", "C"], "fleet": [120, 80, 45]}
+            )
+            joined = by_vendor.merge(lookup, on="vendor")
+            joined["per_cab"] = joined["total"] / joined["fleet"]
+            return joined.sort_values("per_cab", ascending=False, kind="stable")
+
+        got = pipeline(pd, pd.read_csv)
+        want = pipeline(pandas, pandas.read_csv)
+        df_equals(got, want)
+
+    def test_datetime_features(self, tmp_path):
+        path = make_taxi_like(tmp_path)
+        md = pd.read_csv(path, parse_dates=["pickup"])
+        pdf = pandas.read_csv(path, parse_dates=["pickup"])
+        md["hour"] = md["pickup"].dt.hour
+        pdf["hour"] = pdf["pickup"].dt.hour
+        df_equals(
+            md.groupby("hour")["fare"].mean(), pdf.groupby("hour")["fare"].mean()
+        )
+
+    def test_value_counts_and_describe(self, tmp_path):
+        path = make_taxi_like(tmp_path)
+        md = pd.read_csv(path)
+        pdf = pandas.read_csv(path)
+        df_equals(md["payment"].value_counts(), pdf["payment"].value_counts())
+        df_equals(md.describe(), pdf.describe())
+
+
+OPS = [
+    ("head", lambda df, rng: df.head(max(1, len(df) // 2))),
+    ("filter", lambda df, rng: df[df[df.columns[0]] > df[df.columns[0]].mean()]
+        if df.dtypes.iloc[0].kind in "if" and len(df) else df),
+    ("sort", lambda df, rng: df.sort_values(df.columns[-1], kind="stable")),
+    ("fillna", lambda df, rng: df.fillna(0)),
+    ("add", lambda df, rng: df + 1 if all(d.kind in "if" for d in df.dtypes) else df),
+    ("abs", lambda df, rng: df.abs() if all(d.kind in "if" for d in df.dtypes) else df),
+    ("reset", lambda df, rng: df.reset_index(drop=True)),
+    ("sample_cols", lambda df, rng: df[list(rng.choice(df.columns, size=max(1, len(df.columns) - 1), replace=False))]),
+    ("cumsum", lambda df, rng: df.cumsum() if all(d.kind == "i" for d in df.dtypes) else df),
+    ("rename", lambda df, rng: df.rename(columns={df.columns[0]: "renamed0"})),
+]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_random_workflow(seed):
+    """fuzzydata-style: a random op chain must match pandas step by step."""
+    rng = np.random.default_rng(seed)
+    data = {
+        "i0": rng.integers(-100, 100, 120),
+        "f0": np.where(rng.random(120) < 0.15, np.nan, rng.uniform(-5, 5, 120)),
+        "f1": rng.uniform(0, 1, 120),
+    }
+    md = pd.DataFrame(data)
+    pdf = pandas.DataFrame(data)
+    trace = []
+    for step in range(8):
+        name, op = OPS[int(rng.integers(0, len(OPS)))]
+        trace.append(name)
+        op_seed = int(rng.integers(0, 2**32))
+        md = op(md, np.random.default_rng(op_seed))
+        pdf = op(pdf, np.random.default_rng(op_seed))
+        try:
+            df_equals(md, pdf)
+        except AssertionError as err:
+            raise AssertionError(f"diverged after {trace}: {err}") from err
