@@ -244,12 +244,12 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
         )
 
     def finalize(self) -> None:
-        """Block until device work for this frame completes."""
+        """Block until device work for this frame completes (one sync)."""
         from modin_tpu.parallel.engine import JaxWrapper
 
-        for col in self._columns:
-            if col.is_device:
-                JaxWrapper.wait(col.data)
+        device_data = [col.data for col in self._columns if col.is_device]
+        if device_data:
+            JaxWrapper.wait(device_data)
 
     def free(self) -> None:
         self._columns = []

@@ -29,8 +29,17 @@ class SQLDispatcher(FileDispatcher):
             return pandas.read_sql(sql, conn, index_col=index_col, **kwargs)
         if not isinstance(con, ModinDatabaseConnection) or index_col is not None:
             # plain connections aren't distributable descriptors; read serially
-            conn = con.get_connection() if isinstance(con, ModinDatabaseConnection) else con
-            df = pandas.read_sql(sql, conn, index_col=index_col, **kwargs)
+            if isinstance(con, ModinDatabaseConnection):
+                conn = con.get_connection()
+                try:
+                    df = pandas.read_sql(sql, conn, index_col=index_col, **kwargs)
+                finally:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+            else:
+                df = pandas.read_sql(sql, con, index_col=index_col, **kwargs)
             return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
         query = sql if isinstance(sql, str) else str(sql)
         if not query.lstrip().lower().startswith("select"):

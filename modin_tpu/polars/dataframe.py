@@ -58,6 +58,15 @@ class Expr:
     def __rmul__(self, other):
         return self._binary(other, lambda a, b: b * a)
 
+    def __rsub__(self, other):
+        return self._binary(other, lambda a, b: b - a)
+
+    def __rtruediv__(self, other):
+        return self._binary(other, lambda a, b: b / a)
+
+    def __neg__(self):
+        return Expr(lambda df: -self._fn(df), self._name, self._agg)
+
     def __lt__(self, other):
         return self._binary(other, lambda a, b: a < b)
 
@@ -258,11 +267,12 @@ class DataFrame:
         flat: List[Any] = []
         for e in exprs:
             flat.extend(e) if isinstance(e, (list, tuple)) else flat.append(e)
-        md = self._md.copy()
+        base = self._md  # polars evaluates every expr against the INPUT frame
+        md = base.copy()
         for e in self._resolve_exprs(flat):
-            md[e._name] = e._evaluate(md)
+            md[e._name] = e._evaluate(base)
         for name, e in named.items():
-            value = e._evaluate(md) if isinstance(e, Expr) else e
+            value = e._evaluate(base) if isinstance(e, Expr) else e
             md[name] = value
         return self._from_md(md)
 
@@ -319,7 +329,31 @@ class DataFrame:
         return GroupBy(self, keys)
 
     def join(self, other: "DataFrame", on: Any = None, how: str = "inner", left_on: Any = None, right_on: Any = None, suffix: str = "_right") -> "DataFrame":
-        how_map = {"inner": "inner", "left": "left", "outer": "outer", "full": "outer", "cross": "cross", "semi": "inner"}
+        if how in ("semi", "anti"):
+            keys = on if on is not None else left_on
+            key_list = [keys] if isinstance(keys, str) else list(keys)
+            right_keys = (
+                other._md[key_list]
+                if right_on is None
+                else other._md[[right_on] if isinstance(right_on, str) else list(right_on)]
+            ).drop_duplicates()
+            merged = self._md.merge(
+                right_keys.rename(
+                    columns=dict(
+                        zip(
+                            right_keys.columns,
+                            key_list,
+                        )
+                    )
+                ),
+                on=key_list,
+                how="left",
+                indicator=True,
+            )
+            keep = "both" if how == "semi" else "left_only"
+            md = merged[merged["_merge"] == keep].drop(columns=["_merge"])
+            return self._from_md(md.reset_index(drop=True))
+        how_map = {"inner": "inner", "left": "left", "outer": "outer", "full": "outer", "cross": "cross"}
         md = self._md.merge(
             other._md,
             on=on,

@@ -134,3 +134,53 @@ class TestInterchange:
         pdf = pandas.DataFrame({"a": [1, 2]})
         md = modin_from_dataframe(pdf.__dataframe__())
         df_equals(md, pdf)
+
+
+class TestBackendSwitching:
+    def test_mixed_backend_binary_coerces(self):
+        import modin_tpu
+        from modin_tpu.core.storage_formats.native.query_compiler import (
+            NativeQueryCompiler,
+        )
+        from modin_tpu.utils import get_current_execution
+
+        if get_current_execution() != "TpuOnJax":
+            pytest.skip("needs the device default backend")
+        md_device = pd.DataFrame({"a": np.arange(20.0)})
+        modin_tpu.set_backend("Pandas")
+        try:
+            md_host = pd.DataFrame({"a": np.ones(20)})
+            assert isinstance(md_host._query_compiler, NativeQueryCompiler)
+        finally:
+            modin_tpu.set_backend("Tpu")
+        result = md_device + md_host  # mixed backends -> coerced, not crash
+        df_equals(
+            result,
+            pandas.DataFrame({"a": np.arange(20.0) + 1}),
+        )
+
+    def test_cost_calculator_prefers_device_for_big(self):
+        from modin_tpu.core.storage_formats.base.query_compiler_calculator import (
+            BackendCostCalculator,
+        )
+        from modin_tpu.core.storage_formats.native.query_compiler import (
+            NativeQueryCompiler,
+        )
+        from modin_tpu.core.storage_formats.tpu.query_compiler import (
+            TpuQueryCompiler,
+        )
+
+        big_device = pd.DataFrame({"a": np.arange(1000.0)})._query_compiler
+        small_host = NativeQueryCompiler(pandas.DataFrame({"a": [1.0] * 10}))
+        calc = BackendCostCalculator("add")
+        calc.add_query_compiler(big_device)
+        calc.add_query_compiler(small_host)
+        assert calc.calculate() is type(big_device)
+
+
+class TestFuzzydata:
+    def test_run_workflow(self):
+        from modin_tpu.experimental.fuzzydata import run_workflow
+
+        trace = run_workflow(seed=123, steps=6)
+        assert len(trace) == 6
