@@ -56,7 +56,7 @@ class SQLDispatcher(FileDispatcher):
             except Exception:
                 pass
         row_count = int(row_count)
-        if row_count < _MIN_PARALLEL_ROWS:
+        if row_count < _MIN_PARALLEL_ROWS or not con.supports_stable_offset_partitioning():
             conn = con.get_connection()
             try:
                 df = pandas.read_sql(query, conn, **kwargs)

@@ -632,12 +632,15 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
         if axis != 0:
             return None
-        # NaN-skipping cumulative ops need masked variants; ints are exact
         frame = self._modin_frame
-        if not all(
-            c.is_device and c.pandas_dtype.kind in "iu" for c in frame._columns
-        ) or len(frame) == 0:
+        kinds = [c.pandas_dtype.kind for c in frame._columns]
+        # floats use the NaN-skipping kernels (skipna=True only); ints exact
+        if not all(c.is_device for c in frame._columns) or len(frame) == 0:
             return None
+        if not all(k in "iuf" for k in kinds):
+            return None
+        if not skipna and any(k == "f" for k in kinds):
+            return None  # NaN-propagating variant not implemented on device
         return self._map_device_host(
             lambda cols: elementwise.unary_op_columns(name, cols),
             lambda s: s,
@@ -766,6 +769,55 @@ class TpuQueryCompiler(BaseQueryCompiler):
         if result is not None:
             return result
         return super().idxmax(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs)
+
+    # ----------------------------- rolling ---------------------------- #
+
+    def _try_device_rolling(self, op: str, rolling_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        from modin_tpu.ops.window import rolling_reduce
+
+        window = rolling_kwargs.get("window")
+        if not isinstance(window, (int, np.integer)) or window <= 0:
+            return None
+        for key in ("center", "win_type", "on", "closed", "step"):
+            if rolling_kwargs.get(key) not in (None, False):
+                return None
+        if rolling_kwargs.get("method", "single") != "single" or kwargs:
+            return None
+        min_periods = rolling_kwargs.get("min_periods")
+        if min_periods is None:
+            min_periods = int(window)  # pandas >= 2: count defaults like the rest
+        elif not isinstance(min_periods, (int, np.integer)) or not (
+            0 <= min_periods <= window
+        ):
+            return None  # pandas raises the proper ValueError on the fallback
+        frame = self._modin_frame
+        if len(frame) == 0 or not all(
+            c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns
+        ):
+            return None
+        datas = rolling_reduce(
+            op, [c.data for c in frame._columns], len(frame), int(window),
+            int(min_periods),
+        )
+        return self._wrap_device_result(datas)
+
+    def rolling_sum(self, rolling_kwargs: dict, *args: Any, **kwargs: Any):
+        result = self._try_device_rolling("sum", rolling_kwargs, kwargs) if not args else None
+        if result is not None:
+            return result
+        return super().rolling_sum(rolling_kwargs, *args, **kwargs)
+
+    def rolling_mean(self, rolling_kwargs: dict, *args: Any, **kwargs: Any):
+        result = self._try_device_rolling("mean", rolling_kwargs, kwargs) if not args else None
+        if result is not None:
+            return result
+        return super().rolling_mean(rolling_kwargs, *args, **kwargs)
+
+    def rolling_count(self, rolling_kwargs: dict, *args: Any, **kwargs: Any):
+        result = self._try_device_rolling("count", rolling_kwargs, kwargs) if not args else None
+        if result is not None:
+            return result
+        return super().rolling_count(rolling_kwargs, *args, **kwargs)
 
     # ----------------------------- groupby ---------------------------- #
 

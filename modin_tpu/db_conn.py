@@ -61,20 +61,21 @@ class ModinDatabaseConnection:
     def row_count_query(self, query: str) -> str:
         return f"SELECT COUNT(*) FROM ({query}) AS _MODIN_COUNT_QUERY"
 
-    def partition_query(self, query: str, limit: int, offset: int) -> str:
-        """A query fetching rows [offset, offset+limit) of ``query``.
+    def supports_stable_offset_partitioning(self) -> bool:
+        """Whether LIMIT/OFFSET windows over independent connections are
+        repeatable.  sqlite scans in rowid order; most server engines give no
+        stable order without a total ORDER BY, so they read serially (use the
+        bounds-based ``experimental.pandas.read_sql`` for parallel reads)."""
+        return self.lib == _SQLITE3_LIB_NAME
 
-        Non-sqlite engines get an ORDER BY 1 so LIMIT/OFFSET windows are
-        stable across the independent per-partition connections (PostgreSQL
-        gives no repeatable scan order otherwise).
-        """
+    def partition_query(self, query: str, limit: int, offset: int) -> str:
+        """A query fetching rows [offset, offset+limit) of ``query``."""
         if self._dialect_is_microsoft_sql():
             return (
                 f"SELECT * FROM ({query}) AS _MODIN_QUERY ORDER BY(SELECT NULL) "
                 f"OFFSET {offset} ROWS FETCH NEXT {limit} ROWS ONLY"
             )
-        order = "" if self.lib == _SQLITE3_LIB_NAME else " ORDER BY 1"
         return (
-            f"SELECT * FROM ({query}) AS _MODIN_QUERY{order} "
+            f"SELECT * FROM ({query}) AS _MODIN_QUERY "
             f"LIMIT {limit} OFFSET {offset}"
         )

@@ -117,12 +117,24 @@ def _build_ops() -> dict:
         "floor": lambda x: jnp.floor(x),
         "ceil": lambda x: jnp.ceil(x),
         "sign": lambda x: jnp.sign(x),
-        "cumsum": lambda x: jnp.cumsum(x),
-        "cumprod": lambda x: jnp.cumprod(x),
-        "cummax": lambda x: jax_lax_cummax(x),
-        "cummin": lambda x: jax_lax_cummin(x),
+        # cumulative ops with pandas skipna semantics: NaN keeps its position
+        # but does not poison later entries
+        "cumsum": lambda x: _nan_skipping_cum(x, jnp.cumsum, 0),
+        "cumprod": lambda x: _nan_skipping_cum(x, jnp.cumprod, 1),
+        "cummax": lambda x: _nan_skipping_cum(x, jax_lax_cummax, -jnp.inf),
+        "cummin": lambda x: _nan_skipping_cum(x, jax_lax_cummin, jnp.inf),
         "round": None,  # handled specially (decimals arg)
     }
+
+
+def _nan_skipping_cum(x, cum_fn, neutral):
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return cum_fn(x)
+    nanm = jnp.isnan(x)
+    filled = cum_fn(jnp.where(nanm, neutral, x))
+    return jnp.where(nanm, jnp.nan, filled)
 
 
 def jax_lax_cummax(x):

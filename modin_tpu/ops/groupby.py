@@ -52,17 +52,43 @@ def _jit_key_minmax(n: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_range_counts(n: int, width: int):
+def _jit_range_ids(n: int, width: int):
     # kmin is a traced operand: recompiles key on (n, width) only
     import jax
     import jax.numpy as jnp
 
     def fn(k, kmin):
         valid = jnp.arange(k.shape[0]) < n
-        ids = jnp.where(valid, jnp.clip(k - kmin, 0, width), width)
+        return jnp.where(valid, jnp.clip(k - kmin, 0, width), width)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_scatter_counts(width: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(ids):
         return jnp.zeros(width + 1, jnp.int64).at[ids].add(1)[:width]
 
     return jax.jit(fn)
+
+
+def _count_ids(ids, width: int):
+    """Histogram of ids in [0, width); overflow id == width is dropped.
+
+    On TPU uses the pallas VPU kernel (XLA's scatter-add serializes there);
+    elsewhere the scatter path.
+    """
+    from modin_tpu.ops.pallas.groupby_kernels import (
+        bincount_supported,
+        pallas_bincount,
+    )
+
+    if bincount_supported(ids, width):
+        return pallas_bincount(ids, width)
+    return _jit_scatter_counts(width)(ids)
 
 
 @functools.lru_cache(maxsize=None)
@@ -137,11 +163,8 @@ def factorize_keys(
             kmin, kmax = (int(v) for v in jax.device_get(_jit_key_minmax(n)(k64)))
             width = kmax - kmin + 1
             if width <= _RANGE_LIMIT:
-                counts = np.asarray(
-                    jax.device_get(
-                        _jit_range_counts(n, width)(k64, jnp.int64(kmin))
-                    )
-                )
+                ids = _jit_range_ids(n, width)(k64, jnp.int64(kmin))
+                counts = np.asarray(jax.device_get(_count_ids(ids, width)))
                 present = np.nonzero(counts)[0]
                 remap = np.full(width, len(present), dtype=np.int64)
                 remap[present] = np.arange(len(present))
@@ -340,6 +363,19 @@ def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_out:
 
     def fn(cols: Tuple, codes):
         return tuple(finish(seg(c, codes)) for c in cols)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_pad_to(p_out: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(r):
+        if r.shape[0] < p_out:
+            return jnp.concatenate([r, jnp.zeros(p_out - r.shape[0], r.dtype)])
+        return r
 
     return jax.jit(fn)
 
@@ -547,6 +583,14 @@ def groupby_reduce(
     ns = num_groups + 1
     p_out = pad_len(num_groups)
     if agg == "size":
+        from modin_tpu.ops.pallas.groupby_kernels import (
+            bincount_supported,
+            pallas_bincount,
+        )
+
+        if bincount_supported(codes, num_groups):
+            sizes = pallas_bincount(codes, num_groups)
+            return [_jit_pad_to(p_out)(sizes)]
         return [_jit_segment_size(ns, p_out)(codes)]
     on_tpu = next(iter(codes.devices())).platform == "tpu"
     if _FORCE_KERNEL == "masked_scan":

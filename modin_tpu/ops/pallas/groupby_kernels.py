@@ -1,0 +1,114 @@
+"""Pallas TPU kernels for the groupby hot path.
+
+``bincount``: the histogram that backs factorize's direct-range coding and the
+``size``/``count`` aggregations.  XLA lowers ``zeros().at[codes].add(1)`` to a
+scatter-add, which serializes badly on TPU (measured ~1s for 1e7 rows); this
+kernel instead streams code blocks through VMEM and accumulates a one-hot
+compare on the VPU — O(n*G) elementwise work with no scatter, exact int32
+arithmetic.
+
+Used on the TPU backend for group widths <= ``MAX_GROUPS``; everywhere else
+the XLA scatter path stays (CPU scatters are fine).  Interpret mode makes the
+kernel testable on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+# block of codes processed per grid step: BR sublanes x 128 lanes
+_BR = 32
+_LANES = 128
+MAX_GROUPS = 512  # one-hot block is BR*128*ceil(G/128)*128 ints in VMEM
+
+
+@functools.lru_cache(maxsize=None)
+def _build_bincount(n_blocks: int, g_padded: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        vmem = pltpu.VMEM
+    except ImportError:  # pragma: no cover
+        vmem = None
+
+    def kernel(codes_ref, out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        codes_block = codes_ref[:]  # [_BR, _LANES] int32
+        group_ids = jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, g_padded), dimension=2
+        )
+        onehot = (codes_block[:, :, None] == group_ids).astype(jnp.int32)
+        partial = jnp.sum(onehot, axis=(0, 1))  # [g_padded]
+        out_ref[0, :] += partial
+
+    block_spec_kwargs = {"memory_space": vmem} if vmem is not None else {}
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, g_padded), jnp.int32),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((_BR, _LANES), lambda i: (i, 0), **block_spec_kwargs)
+        ],
+        out_specs=pl.BlockSpec((1, g_padded), lambda i: (0, 0), **block_spec_kwargs),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_bincount_wrapper(p_len: int, num_groups: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+
+    # slots for every real group + the overflow bucket, padded to lanes
+    g_padded = max(-(-(num_groups + 1) // _LANES) * _LANES, _LANES)
+    block_elems = _BR * _LANES
+    n_blocks = -(-p_len // block_elems)
+    padded_len = n_blocks * block_elems
+    call = _build_bincount(n_blocks, g_padded, interpret)
+
+    def fn(codes):
+        c = codes.astype(jnp.int32)
+        if padded_len > p_len:
+            # overflow bucket: padded tail must not count toward any group
+            c = jnp.concatenate(
+                [c, jnp.full(padded_len - p_len, num_groups, jnp.int32)]
+            )
+        counts = call(c.reshape(n_blocks * _BR, _LANES))
+        return counts[0, :num_groups].astype(jnp.int64)
+
+    return jax.jit(fn)
+
+
+def pallas_bincount(codes: Any, num_groups: int, interpret: bool = False) -> Any:
+    """Counts per group code; codes >= num_groups (pads/overflow) are dropped.
+
+    Returns an int64 device array of length ``num_groups``.
+    """
+    if num_groups > MAX_GROUPS:
+        raise ValueError(f"pallas_bincount supports <= {MAX_GROUPS} groups")
+    return _jit_bincount_wrapper(int(codes.shape[0]), int(num_groups), bool(interpret))(
+        codes
+    )
+
+
+def bincount_supported(codes: Any, num_groups: int) -> bool:
+    """Whether the pallas histogram should be used for this input."""
+    if num_groups > MAX_GROUPS or num_groups < 1:
+        return False
+    try:
+        platform = next(iter(codes.devices())).platform
+    except Exception:
+        return False
+    return platform == "tpu"

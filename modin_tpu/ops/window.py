@@ -1,0 +1,61 @@
+"""Rolling-window device kernels (the reference's Fold operator family).
+
+Reference design: modin/core/dataframe/algebra/fold.py:28 + window.py — the
+reference ships whole row blocks to workers and runs pandas.rolling per
+partition.  Here a rolling sum/count is two cumulative sums and a shifted
+difference — O(n) bandwidth-bound work that XLA fuses into one kernel, with
+pandas' min_periods/NaN semantics applied via the non-NaN count.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Tuple
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_rolling(op: str, n_cols: int, n: int, window: int, min_periods: int):
+    import jax
+    import jax.numpy as jnp
+
+    def one(c):
+        is_f = jnp.issubdtype(c.dtype, jnp.floating)
+        valid = jnp.arange(c.shape[0]) < n
+        nanm = (jnp.isnan(c) | ~valid) if is_f else ~valid
+        x = jnp.where(nanm, 0, c).astype(jnp.float64)
+        cnt = (~nanm).astype(jnp.int64)
+        cs = jnp.cumsum(x)
+        cc = jnp.cumsum(cnt)
+        # windowed sums: cs[i] - cs[i-window]
+        shifted = jnp.concatenate([jnp.zeros(window, cs.dtype), cs[:-window]]) if window <= cs.shape[0] else jnp.zeros_like(cs)
+        shifted_c = jnp.concatenate([jnp.zeros(window, cc.dtype), cc[:-window]]) if window <= cc.shape[0] else jnp.zeros_like(cc)
+        wsum = cs - shifted
+        wcnt = cc - shifted_c
+        if op == "count":
+            return jnp.where(wcnt >= min_periods, wcnt.astype(jnp.float64), jnp.nan)
+        if op == "sum":
+            # pandas: min_periods=0 makes an all-NaN/empty window sum 0.0
+            return jnp.where(wcnt >= min_periods, wsum, jnp.nan)
+        if op == "mean":
+            res = wsum / jnp.maximum(wcnt, 1)
+            return jnp.where((wcnt >= min_periods) & (wcnt > 0), res, jnp.nan)
+        raise ValueError(op)
+
+    def fn(cols: Tuple):
+        return tuple(one(c) for c in cols)
+
+    return jax.jit(fn)
+
+
+def rolling_reduce(
+    op: str,
+    cols: List[Any],
+    n: int,
+    window: int,
+    min_periods: int,
+) -> List[Any]:
+    """Rolling sum/mean/count over padded columns; one jit for the frame."""
+    fn = _jit_rolling(op, len(cols), int(n), int(window), int(min_periods))
+    return list(fn(tuple(cols)))

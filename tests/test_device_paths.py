@@ -121,3 +121,60 @@ def test_sharding_spans_mesh():
 def test_reduction_over_sharded_matches(enable_benchmark_mode):
     df = make_df(n=4096)
     df_equals(df.sum(), df._to_pandas().sum())
+
+
+def test_rolling_device_path():
+    import warnings
+
+    df = make_df(n=500)
+    num = df[["c0", "c1", "c2"]]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        r_sum = num.rolling(7).sum()
+        r_mean = num.rolling(7, min_periods=3).mean()
+        r_count = num.rolling(7).count()
+    p = num._to_pandas()
+    df_equals(r_sum, p.rolling(7).sum())
+    df_equals(r_mean, p.rolling(7, min_periods=3).mean())
+    df_equals(r_count, p.rolling(7).count())
+
+
+def test_rolling_with_nan():
+    import pandas as real_pandas
+
+    data = {"a": [1.0, np.nan, 3.0, 4.0, np.nan, 6.0, 7.0, 8.0]}
+    md = pd.DataFrame(data)
+    p = real_pandas.DataFrame(data)
+    df_equals(md.rolling(3).sum(), p.rolling(3).sum())
+    df_equals(md.rolling(3, min_periods=1).mean(), p.rolling(3, min_periods=1).mean())
+
+
+def test_float_cumulative_device():
+    import warnings
+
+    data = {"a": [1.0, np.nan, 3.0, -2.0], "b": [0.5, 1.5, np.nan, 2.5]}
+    md = pd.DataFrame(data)
+    p = md._to_pandas()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        got_sum = md.cumsum()
+        got_max = md.cummax()
+        got_min = md.cummin()
+        got_prod = md.cumprod()
+    df_equals(got_sum, p.cumsum())
+    df_equals(got_max, p.cummax())
+    df_equals(got_min, p.cummin())
+    df_equals(got_prod, p.cumprod())
+
+
+def test_rolling_min_periods_zero_and_invalid():
+    import pandas as real_pandas
+
+    data = {"a": [np.nan, 1.0, np.nan, np.nan, 2.0]}
+    md = pd.DataFrame(data)
+    p = real_pandas.DataFrame(data)
+    df_equals(md.rolling(2, min_periods=0).sum(), p.rolling(2, min_periods=0).sum())
+    with pytest.raises(ValueError):
+        p.rolling(2, min_periods=5).sum()
+    with pytest.raises(ValueError):
+        md.rolling(2, min_periods=5).sum()

@@ -178,3 +178,20 @@ def test_groupby_masked_scan_kernel_matches(agg, monkeypatch):
         getattr(md.groupby("int_key"), agg)(),
         getattr(pdf.groupby("int_key"), agg)(),
     )
+
+
+def test_pallas_bincount_matches_scatter(monkeypatch):
+    """The pallas histogram must agree with the XLA scatter path (interpret
+    mode exercises the kernel on CPU)."""
+    import jax.numpy as jnp
+
+    from modin_tpu.ops.pallas.groupby_kernels import pallas_bincount
+    from modin_tpu.ops.groupby import _jit_scatter_counts
+
+    rng = np.random.default_rng(1)
+    for n, width in [(777, 3), (50_000, 100), (12_345, 512)]:
+        ids_np = rng.integers(0, width + 1, n)
+        ids = jnp.asarray(ids_np)
+        got = np.asarray(pallas_bincount(ids, width, interpret=True))
+        want = np.asarray(_jit_scatter_counts(width)(ids))
+        np.testing.assert_array_equal(got, want)
