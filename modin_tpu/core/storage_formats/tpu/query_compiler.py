@@ -770,6 +770,144 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return result
         return super().idxmax(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs)
 
+    # ------------------------------ merge ----------------------------- #
+
+    def merge(self, right: Any, **kwargs: Any) -> "TpuQueryCompiler":
+        result = self._try_device_merge(right, kwargs)
+        if result is not None:
+            return result
+        return super().merge(right, **kwargs)
+
+    def _try_device_merge(self, right: Any, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        from modin_tpu.ops.join import gather_right_columns, sort_merge_positions
+        from modin_tpu.ops.structural import gather_columns_device
+        from modin_tpu.utils import hashable
+
+        how = kwargs.get("how", "inner")
+        if how not in ("inner", "left"):
+            return None
+        if (
+            kwargs.get("left_index")
+            or kwargs.get("right_index")
+            or kwargs.get("sort")
+            or kwargs.get("indicator")
+            or kwargs.get("validate") is not None
+            or not isinstance(right, TpuQueryCompiler)
+        ):
+            return None
+        on = kwargs.get("on")
+        left_on = kwargs.get("left_on")
+        right_on = kwargs.get("right_on")
+        if on is not None:
+            if isinstance(on, list):
+                if len(on) != 1:
+                    return None
+                on = on[0]
+            left_label = right_label = on
+        elif left_on is not None and right_on is not None:
+            left_label = left_on[0] if isinstance(left_on, list) and len(left_on) == 1 else left_on
+            right_label = right_on[0] if isinstance(right_on, list) and len(right_on) == 1 else right_on
+            if isinstance(left_label, list) or isinstance(right_label, list):
+                return None
+            if not hashable(left_label) or not hashable(right_label):
+                return None  # array-like keys take the pandas fallback
+            if left_label == right_label:
+                # pandas collapses identical left_on/right_on to one column
+                on = left_label
+        else:
+            return None
+        if not hashable(left_label) or not hashable(right_label):
+            return None
+
+        lframe, rframe = self._modin_frame, right._modin_frame
+        if not lframe.columns.is_unique or not rframe.columns.is_unique:
+            return None
+        lpos = lframe.column_position(left_label)
+        rpos = rframe.column_position(right_label)
+        if len(lpos) != 1 or lpos[0] < 0 or len(rpos) != 1 or rpos[0] < 0:
+            return None
+        lkey_col = lframe.get_column(lpos[0])
+        rkey_col = rframe.get_column(rpos[0])
+        if not (
+            lkey_col.is_device
+            and rkey_col.is_device
+            and lkey_col.pandas_dtype.kind in "biuf"
+            and rkey_col.pandas_dtype.kind in "biuf"
+            and lkey_col.pandas_dtype.kind == rkey_col.pandas_dtype.kind
+        ):
+            return None
+        if len(lframe) == 0 or len(rframe) == 0:
+            return None
+        if not all(c.is_device for c in lframe._columns) or not all(
+            c.is_device for c in rframe._columns
+        ):
+            return None
+        # left-join misses turn right bool columns into object dtype — fallback
+        right_value_positions = [
+            i for i in range(rframe.num_cols)
+            if not (on is not None and i == rpos[0])
+        ]
+        if how == "left" and any(
+            rframe.get_column(i).pandas_dtype.kind == "b"
+            for i in right_value_positions
+        ):
+            return None
+
+        left_pos, right_pos, n_out, has_miss = sort_merge_positions(
+            lkey_col.data, rkey_col.data, len(lframe), len(rframe), how=how
+        )
+
+        import jax.numpy as jnp
+
+        # gather left columns
+        left_datas = gather_columns_device(
+            [c.data for c in lframe._columns], left_pos
+        )
+        suffixes = kwargs.get("suffixes") or ("_x", "_y")
+        if (
+            not isinstance(suffixes, (tuple, list))
+            or len(suffixes) != 2
+            or not all(isinstance(sfx, str) and sfx for sfx in suffixes)
+        ):
+            return None  # None/empty suffixes have pandas-specific semantics
+        suffix_l, suffix_r = suffixes
+        right_labels_set = {rframe.columns[i] for i in right_value_positions}
+        new_cols: list = []
+        new_labels: list = []
+        for i, (col, data) in enumerate(zip(lframe._columns, left_datas)):
+            label = lframe.columns[i]
+            if label in right_labels_set and not (on is not None and i == lpos[0]):
+                label = f"{label}{suffix_l}"
+            new_cols.append(DeviceColumn(data, col.pandas_dtype, length=n_out))
+            new_labels.append(label)
+        # gather right columns (null sentinel on misses)
+        right_datas = gather_right_columns(
+            [rframe.get_column(i).data for i in right_value_positions], right_pos
+        )
+        left_labels_set = set(lframe.columns)
+        for i, data in zip(right_value_positions, right_datas):
+            col = rframe.get_column(i)
+            label = rframe.columns[i]
+            if label in left_labels_set and not (on is not None and label == on):
+                label = f"{label}{suffix_r}"
+            dtype = col.pandas_dtype
+            if has_miss and dtype.kind in "iu":
+                # pandas promotes int columns with missing matches to float64
+                data = jnp.where(right_pos < 0, jnp.nan, data.astype(jnp.float64))
+                dtype = np.dtype(np.float64)
+            new_cols.append(DeviceColumn(data, dtype, length=n_out))
+            new_labels.append(label)
+
+        if not pandas.Index(new_labels).is_unique:
+            return None  # colliding suffixed labels: pandas raises MergeError
+        result_frame = TpuDataframe(
+            new_cols,
+            pandas.Index(new_labels),
+            LazyIndex(pandas.RangeIndex(n_out), n_out),
+            nrows=n_out,
+        )
+        return type(self)(result_frame)
+
     # ----------------------------- rolling ---------------------------- #
 
     def _try_device_rolling(self, op: str, rolling_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:

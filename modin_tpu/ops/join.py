@@ -1,0 +1,180 @@
+"""Device sort-merge join.
+
+TPU-native replacement for the reference's merge implementations
+(modin/core/storage_formats/pandas/merge.py:39 range_partitioning_merge /
+:104 row_axis_merge): instead of broadcasting the right frame to every left
+partition or shuffling both frames through the object store, the join runs as
+one device program family:
+
+1. stable-sort the right keys (keeps pandas' original-order-within-ties);
+2. binary-search every left key against the sorted right keys (lo/hi bounds);
+3. one host sync for the output row count (data-dependent shape);
+4. expand matches with a searchsorted-over-offsets trick and gather both
+   sides' columns by position.
+
+Matches pandas ``merge`` row order for ``sort=False``: left order, and
+right-side ties in right's original order.  Float keys use an IEEE
+total-order int mapping so pandas' merge equality holds exactly
+(-0.0 == 0.0; every NaN key matches every other NaN key).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_match_bounds(n_left: int, n_right: int):
+    import jax
+    import jax.numpy as jnp
+
+    def _total_order(x):
+        """Monotone float64 -> int64 mapping: pandas merge equality semantics
+        (-0.0 == 0.0, every NaN matches every NaN, NaN sorts last)."""
+        # canonicalize: XLA folds x+0.0 to x, so -0.0 needs an explicit where
+        x = jnp.where(x == 0, 0.0, x)
+        x = jnp.where(jnp.isnan(x), jnp.nan, x)
+        bits = jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
+        return jnp.where(bits >= 0, bits, (~bits) ^ np.int64(-(2**63)))
+
+    def fn(left_key, right_key):
+        if jnp.issubdtype(right_key.dtype, jnp.floating):
+            left_key = _total_order(left_key)
+            right_key = _total_order(right_key)
+        # pads must sort to the tail and never match
+        r_bad = jnp.arange(right_key.shape[0]) >= n_right
+        perm0 = jnp.argsort(right_key, stable=True)
+        bad_sorted = jnp.take(r_bad, perm0)
+        perm = jnp.take(perm0, jnp.argsort(bad_sorted, stable=True))
+        n_valid = jnp.sum(~r_bad)
+        # the search array must stay monotone through the tail: pads get the
+        # dtype's maximum (clipping hi/lo to n_valid excludes boundary ties)
+        tail = jnp.arange(right_key.shape[0]) >= n_valid
+        if right_key.dtype == jnp.bool_:
+            tail_value = True
+        else:
+            tail_value = np.iinfo(np.dtype(str(right_key.dtype))).max
+        rs = jnp.where(tail, tail_value, jnp.take(right_key, perm))
+
+        lo = jnp.searchsorted(rs, left_key, side="left")
+        hi = jnp.searchsorted(rs, left_key, side="right")
+        lo = jnp.minimum(lo, n_valid)
+        hi = jnp.minimum(hi, n_valid)
+        counts = hi - lo
+        l_valid = jnp.arange(left_key.shape[0]) < n_left
+        counts = jnp.where(l_valid, counts, 0)
+        total_inner = jnp.sum(counts)
+        total_left = jnp.sum(jnp.where(l_valid, jnp.maximum(counts, 1), 0))
+        return perm, lo, counts, total_inner, total_left
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_expand(p_out: int, n_left: int, how_left: bool):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(perm, lo, counts):
+        l_valid = jnp.arange(counts.shape[0]) < n_left
+        if how_left:
+            emit = jnp.where(l_valid, jnp.maximum(counts, 1), 0)
+        else:
+            emit = counts
+        ends = jnp.cumsum(emit)
+        out_pos = jnp.arange(p_out, dtype=jnp.int64)
+        # which left row produced output row j (output pads land on the last
+        # left row and are sliced off logically)
+        left_pos = jnp.searchsorted(ends, out_pos, side="right")
+        left_pos = jnp.minimum(left_pos, counts.shape[0] - 1)
+        starts = ends - emit
+        within = out_pos - jnp.take(starts, left_pos)
+        sorted_right_pos = jnp.take(lo, left_pos) + within
+        sorted_right_pos = jnp.clip(sorted_right_pos, 0, perm.shape[0] - 1)
+        right_pos = jnp.take(perm, sorted_right_pos)
+        if how_left:
+            has_match = jnp.take(counts, left_pos) > 0
+            right_pos = jnp.where(has_match, right_pos, -1)
+        return left_pos, right_pos
+
+    return jax.jit(fn)
+
+
+def sort_merge_positions(
+    left_key: Any,
+    right_key: Any,
+    n_left: int,
+    n_right: int,
+    how: str = "inner",
+) -> Tuple[Any, Any, int]:
+    """(left_positions, right_positions, n_out, has_miss) for the joined rows.
+
+    Positions are padded device arrays; ``right_positions == -1`` marks a
+    left-join miss.  Exactly one host sync (the inner/left output counts,
+    from which ``has_miss`` is derived).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from modin_tpu.ops.structural import pad_len
+
+    perm, lo, counts, total_inner, total_left = _jit_match_bounds(
+        int(n_left), int(n_right)
+    )(left_key, right_key)
+    inner_count, left_count = (
+        int(v) for v in jax.device_get((total_inner, total_left))
+    )
+    n_out = left_count if how == "left" else inner_count
+    # a left-join miss exists iff some left row matched nothing
+    has_miss = how == "left" and left_count > inner_count
+    p_out = pad_len(max(n_out, 1))
+    if n_out == 0:
+        zeros = jnp.zeros(p_out, jnp.int64)
+        return zeros, jnp.full(p_out, -1, jnp.int64), 0, False
+    left_pos, right_pos = _jit_expand(p_out, int(n_left), how == "left")(
+        perm, lo, counts
+    )
+    return left_pos, right_pos, n_out, has_miss
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_gather_with_null(n_cols: int):
+    """Gather right-side columns by position; position -1 becomes NaN/NaT."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(cols: Tuple, positions):
+        safe = jnp.where(positions >= 0, positions, 0)
+        out = []
+        for c in cols:
+            vals = jnp.take(c, safe, axis=0)
+            if jnp.issubdtype(c.dtype, jnp.floating):
+                vals = jnp.where(positions >= 0, vals, jnp.nan)
+            else:
+                # int/bool/datetime columns get the int64-min NaT sentinel;
+                # the caller promotes dtypes when misses exist
+                vals = jnp.where(
+                    positions >= 0, vals, _null_sentinel(c.dtype)
+                )
+            out.append(vals)
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
+def _null_sentinel(dtype):
+    import jax.numpy as jnp
+
+    if dtype == jnp.bool_:
+        return False
+    return np.iinfo(np.dtype(str(dtype))).min
+
+
+def gather_right_columns(cols, positions) -> list:
+    """Gather right columns for the join output (missing -> null sentinel)."""
+    if not cols:
+        return []
+    return list(_jit_gather_with_null(len(cols))(tuple(cols), positions))

@@ -1,0 +1,149 @@
+"""Device sort-merge join tests (differential vs pandas)."""
+
+import warnings
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu_backend():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("device merge tests need TpuOnJax")
+
+
+def assert_no_fallback(fn):
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        return fn()
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+@pytest.mark.parametrize("key_dtype", ["int64", "float64"])
+def test_merge_device_path(how, key_dtype):
+    rng = np.random.default_rng(41)
+    left = {
+        "k": rng.integers(0, 50, 500).astype(key_dtype),
+        "lv": rng.uniform(-1, 1, 500),
+    }
+    right = {
+        "k": rng.integers(0, 60, 200).astype(key_dtype),
+        "rv": rng.integers(0, 1000, 200),
+    }
+    ml, pl_ = create_test_dfs(left)
+    mr, pr = create_test_dfs(right)
+    got = assert_no_fallback(lambda: ml.merge(mr, on="k", how=how))
+    want = pl_.merge(pr, on="k", how=how)
+    df_equals(got, want)
+
+
+def test_merge_duplicate_right_keys_order():
+    ml, pl_ = create_test_dfs({"k": [3, 1, 3, 2], "lv": [10, 20, 30, 40]})
+    mr, pr = create_test_dfs({"k": [3, 2, 3, 3], "rv": [100, 200, 300, 400]})
+    got = assert_no_fallback(lambda: ml.merge(mr, on="k"))
+    df_equals(got, pl_.merge(pr, on="k"))
+
+
+def test_merge_nan_keys_never_match():
+    ml, pl_ = create_test_dfs({"k": [1.0, np.nan, 2.0], "lv": [1, 2, 3]})
+    mr, pr = create_test_dfs({"k": [np.nan, 2.0], "rv": [9, 8]})
+    for how in ("inner", "left"):
+        got = assert_no_fallback(lambda: ml.merge(mr, on="k", how=how))
+        df_equals(got, pl_.merge(pr, on="k", how=how))
+
+
+def test_merge_left_promotes_int_on_miss():
+    ml, pl_ = create_test_dfs({"k": [1, 2, 3]})
+    mr, pr = create_test_dfs({"k": [1], "rv": [7]})
+    got = assert_no_fallback(lambda: ml.merge(mr, on="k", how="left"))
+    want = pl_.merge(pr, on="k", how="left")
+    df_equals(got, want)
+    assert got["rv"].dtype == np.float64
+
+
+def test_merge_suffixes():
+    ml, pl_ = create_test_dfs({"k": [1, 2], "v": [10, 20]})
+    mr, pr = create_test_dfs({"k": [1, 2], "v": [30, 40]})
+    got = assert_no_fallback(lambda: ml.merge(mr, on="k"))
+    df_equals(got, pl_.merge(pr, on="k"))
+    got2 = assert_no_fallback(lambda: ml.merge(mr, on="k", suffixes=("_l", "_r")))
+    df_equals(got2, pl_.merge(pr, on="k", suffixes=("_l", "_r")))
+
+
+def test_merge_left_on_right_on():
+    ml, pl_ = create_test_dfs({"ka": [1, 2, 3], "lv": [1.0, 2.0, 3.0]})
+    mr, pr = create_test_dfs({"kb": [2, 3, 4], "rv": [20.0, 30.0, 40.0]})
+    got = assert_no_fallback(lambda: ml.merge(mr, left_on="ka", right_on="kb"))
+    df_equals(got, pl_.merge(pr, left_on="ka", right_on="kb"))
+
+
+def test_merge_empty_result():
+    ml, pl_ = create_test_dfs({"k": [1, 2], "lv": [1.0, 2.0]})
+    mr, pr = create_test_dfs({"k": [5, 6], "rv": [9.0, 9.0]})
+    got = ml.merge(mr, on="k")
+    df_equals(got, pl_.merge(pr, on="k"))
+
+
+def test_merge_fallback_paths_still_work():
+    # multi-key and outer joins route through the pandas default
+    ml, pl_ = create_test_dfs({"a": [1, 1, 2], "b": [1, 2, 2], "v": [1, 2, 3]})
+    mr, pr = create_test_dfs({"a": [1, 2], "b": [2, 2], "w": [10, 20]})
+    df_equals(
+        ml.merge(mr, on=["a", "b"], how="outer").sort_values(["a", "b", "v"]).reset_index(drop=True),
+        pl_.merge(pr, on=["a", "b"], how="outer").sort_values(["a", "b", "v"]).reset_index(drop=True),
+    )
+
+
+def test_merge_large_random():
+    rng = np.random.default_rng(77)
+    ml, pl_ = create_test_dfs(
+        {"k": rng.integers(0, 300, 5000), "x": rng.uniform(0, 1, 5000)}
+    )
+    mr, pr = create_test_dfs(
+        {"k": rng.integers(0, 300, 2000), "y": rng.uniform(0, 1, 2000)}
+    )
+    for how in ("inner", "left"):
+        got = assert_no_fallback(lambda: ml.merge(mr, on="k", how=how))
+        df_equals(got, pl_.merge(pr, on="k", how=how))
+
+
+def test_merge_negative_zero_key():
+    # regression: XLA folds x+0.0 to x; -0.0 must still equal 0.0 as a key
+    ml, pl_ = create_test_dfs({"k": [0.0, -0.0, np.nan], "a": [1, 2, 3]})
+    mr, pr = create_test_dfs({"k": [0.0, np.nan], "b": [10, 20]})
+    got = assert_no_fallback(lambda: ml.merge(mr, on="k"))
+    df_equals(got, pl_.merge(pr, on="k"))
+
+
+def test_merge_same_left_on_right_on_collapses():
+    ml, pl_ = create_test_dfs({"a": [1, 2], "v": [1.0, 2.0]})
+    mr, pr = create_test_dfs({"a": [2, 3], "w": [9.0, 8.0]})
+    df_equals(
+        ml.merge(mr, left_on="a", right_on="a"),
+        pl_.merge(pr, left_on="a", right_on="a"),
+    )
+
+
+def test_merge_arraylike_key_falls_back():
+    ml, pl_ = create_test_dfs({"a": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    mr, pr = create_test_dfs({"kb": [1, 2], "w": [10.0, 20.0]})
+    key = np.array([1, 2, 9])
+    df_equals(
+        ml.merge(mr, left_on=key, right_on="kb"),
+        pl_.merge(pr, left_on=key, right_on="kb"),
+    )
+
+
+def test_merge_colliding_suffixes_raise_like_pandas():
+    ml, pl_ = create_test_dfs({"k": [1], "v": [1.0], "v_s": [2.0]})
+    mr, pr = create_test_dfs({"k": [1], "v": [3.0]})
+    with pytest.raises(Exception):
+        pl_.merge(pr, on="k", suffixes=("_s", "_r"))
+    with pytest.raises(Exception):
+        ml.merge(mr, on="k", suffixes=("_s", "_r"))
