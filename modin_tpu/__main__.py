@@ -1,0 +1,16 @@
+"""``python -m modin_tpu`` — print versions (reference: modin/__main__.py:19)."""
+
+import sys
+
+
+def main() -> None:
+    if "--versions" in sys.argv or len(sys.argv) == 1:
+        from modin_tpu.utils import show_versions
+
+        show_versions()
+        return
+    print("usage: python -m modin_tpu [--versions]")  # noqa: T201
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,9 @@ def _is_device_dtype(dtype: Any) -> bool:
         return False
     if dtype.kind in _DEVICE_NUMPY_KINDS and dtype.itemsize <= 8:
         return True
-    # naive datetime64[ns] / timedelta64[ns] as int64 + logical tag
-    return dtype in (np.dtype("datetime64[ns]"), np.dtype("timedelta64[ns]"))
+    # naive datetime64/timedelta64 (any unit) as int64 + logical tag; the NaT
+    # sentinel (int64 min) is unit-independent
+    return dtype.kind in "mM" and dtype.itemsize == 8
 
 
 class DeviceColumn:

@@ -571,10 +571,12 @@ class TpuQueryCompiler(BaseQueryCompiler):
             and kwargs.get("axis") in (0, None)
         ):
             # note: pandas upcasts int fill into float col fine; int cols have
-            # no NaN so they pass through unchanged
+            # no NaN so they pass through unchanged.  Datetime columns are
+            # excluded: pandas coerces them to object when filled with a number
             result = self._map_device_host(
                 lambda cols: elementwise.fillna_columns(cols, value),
                 lambda s: s.fillna(value),
+                require_kinds="biuf",
             )
             if result is not None:
                 return result
@@ -769,6 +771,112 @@ class TpuQueryCompiler(BaseQueryCompiler):
         if result is not None:
             return result
         return super().idxmax(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs)
+
+    # ------------------------------ dropna ---------------------------- #
+
+    def dropna(self, **kwargs: Any) -> "TpuQueryCompiler":
+        axis = kwargs.get("axis", 0)
+        how = kwargs.get("how", "any")
+        thresh = kwargs.get("thresh")
+        subset = kwargs.get("subset")
+        frame = self._modin_frame
+        if (
+            axis == 0
+            and how in ("any", "all")
+            and thresh is None
+            and not kwargs.get("ignore_index", False)
+            and len(frame) > 0
+            and all(c.is_device for c in frame._columns)
+        ):
+            if subset is not None:
+                from pandas.api.types import is_list_like
+
+                subset_list = list(subset) if is_list_like(subset) else [subset]
+                positions = []
+                for label in subset_list:
+                    pos = frame.column_position(label)
+                    if len(pos) != 1 or pos[0] < 0:
+                        return super().dropna(**kwargs)
+                    positions.append(pos[0])
+            else:
+                positions = list(range(frame.num_cols))
+            from modin_tpu.ops.elementwise import isna_columns
+
+            cols = [frame.get_column(i) for i in positions]
+            flags = tuple(c.pandas_dtype.kind in "mM" for c in cols)
+            nas = isna_columns([c.data for c in cols], flags, negate=False)
+            import jax.numpy as jnp
+
+            if nas:
+                stacked = jnp.stack(nas, axis=0)
+                bad = (
+                    jnp.any(stacked, axis=0) if how == "any" else jnp.all(stacked, axis=0)
+                )
+                keep_mask = np.asarray(~bad)
+            else:
+                keep_mask = np.ones(len(frame), bool)
+            return type(self)(frame.filter_rows_mask(keep_mask), self._shape_hint)
+        return super().dropna(**kwargs)
+
+    # --------------------------- value_counts -------------------------- #
+
+    def series_value_counts(self, **kwargs: Any) -> "TpuQueryCompiler":
+        normalize = kwargs.get("normalize", False)
+        sort = kwargs.get("sort", True)
+        ascending = kwargs.get("ascending", False)
+        bins = kwargs.get("bins")
+        dropna = kwargs.get("dropna", True)
+        frame = self._modin_frame
+        col = frame.get_column(0) if frame.num_cols == 1 else None
+        if (
+            bins is None
+            and col is not None
+            and col.is_device
+            and col.pandas_dtype.kind in "biuf"
+            and len(frame) > 0
+        ):
+            from modin_tpu.ops import groupby as gb_ops
+
+            try:
+                codes, n_groups, group_keys = gb_ops.factorize_keys(
+                    [col.data], len(frame), dropna=dropna
+                )
+            except gb_ops._TooManyGroups:
+                return super().series_value_counts(**kwargs)
+            if n_groups == 0:
+                return super().series_value_counts(**kwargs)
+            import jax
+
+            counts_dev = gb_ops.groupby_reduce("size", [], codes, n_groups, len(frame))[0]
+            first_dev = gb_ops.groupby_first_position(codes, n_groups)
+            counts, first_pos = (
+                np.asarray(v)
+                for v in jax.device_get((counts_dev, first_dev))
+            )
+            counts = counts[:n_groups]
+            keys = np.asarray(group_keys[0])
+            values = counts / counts.sum() if normalize else counts
+            name = frame.columns[0]
+            result = pandas.Series(
+                values,
+                index=pandas.Index(
+                    keys, name=None if name == MODIN_UNNAMED_SERIES_LABEL else name
+                ),
+            )
+            if sort:
+                # pandas orders by count with ties in first-appearance order
+                order = np.lexsort(
+                    (first_pos, counts if ascending else -counts)
+                )
+            else:
+                # sort=False preserves the data's first-appearance order
+                order = np.argsort(first_pos, kind="stable")
+            result = result.iloc[order]
+            result.name = "proportion" if normalize else "count"
+            qc = type(self).from_pandas(result.to_frame())
+            qc._shape_hint = "column"
+            return qc
+        return super().series_value_counts(**kwargs)
 
     # ------------------------------ merge ----------------------------- #
 

@@ -565,6 +565,26 @@ _INT_MINS = {
 _INT_MINS["bool"] = False
 
 
+@functools.lru_cache(maxsize=None)
+def _jit_first_position(num_segments: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(codes):
+        positions = jnp.arange(codes.shape[0], dtype=jnp.int64)
+        return jax.ops.segment_min(positions, codes, num_segments=num_segments)
+
+    return jax.jit(fn)
+
+
+def groupby_first_position(codes: Any, num_groups: int) -> Any:
+    """First row position of each group (pandas' tie order for value_counts).
+
+    Pad rows carry the overflow code, so they land in the sliced-off bucket.
+    """
+    return _jit_first_position(num_groups + 1)(codes)[:num_groups]
+
+
 def groupby_reduce(
     agg: str,
     value_cols: List[Any],

@@ -251,13 +251,39 @@ def show_versions(as_json: Union[str, bool] = False) -> None:
             deps[mod] = importlib.import_module(mod).__version__
         except Exception:
             deps[mod] = None
+    import queue
+    import threading
+
     try:
         import jax
+    except ImportError:
+        jax = None
+    if jax is not None:
+        # device discovery can hang if a remote accelerator tunnel is down;
+        # bound it with a daemon thread (NOT ThreadPoolExecutor: its atexit
+        # hook would join a wedged worker and hang interpreter shutdown)
+        result_queue: "queue.Queue" = queue.Queue()
 
-        deps["jax.devices"] = ", ".join(str(d) for d in jax.devices())
-        deps["jax.default_backend"] = jax.default_backend()
-    except Exception:
-        pass
+        def probe() -> None:
+            try:
+                result_queue.put([str(d) for d in jax.devices()])
+            except Exception as err:  # pragma: no cover
+                result_queue.put(err)
+
+        thread = threading.Thread(target=probe, daemon=True)
+        thread.start()
+        try:
+            devices = result_queue.get(timeout=10)
+        except queue.Empty:
+            deps["jax.devices"] = "unavailable (device discovery timed out)"
+        else:
+            if isinstance(devices, Exception):
+                deps["jax.devices"] = (
+                    f"unavailable ({type(devices).__name__}: {devices})"
+                )
+            else:
+                deps["jax.devices"] = ", ".join(devices)
+                deps["jax.default_backend"] = jax.default_backend()
 
     if as_json:
         if as_json is True:

@@ -178,3 +178,60 @@ def test_rolling_min_periods_zero_and_invalid():
         p.rolling(2, min_periods=5).sum()
     with pytest.raises(ValueError):
         md.rolling(2, min_periods=5).sum()
+
+
+def test_dropna_device_path():
+    import warnings
+
+    data = {
+        "a": [1.0, np.nan, 3.0, 4.0],
+        "b": [np.nan, np.nan, 30.0, 40.0],
+        "t": pandas.to_datetime(["2020-01-01", None, None, "2020-01-04"]),
+    }
+    md = pd.DataFrame(data)
+    p = md._to_pandas()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        got_any = md.dropna()
+        got_all = md.dropna(how="all")
+        got_sub = md.dropna(subset=["a"])
+    df_equals(got_any, p.dropna())
+    df_equals(got_all, p.dropna(how="all"))
+    df_equals(got_sub, p.dropna(subset=["a"]))
+
+
+def test_value_counts_device_path():
+    import warnings
+
+    rng = np.random.default_rng(3)
+    s = pd.Series(rng.integers(0, 7, 500), name="v")
+    p = s._to_pandas()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        got = s.value_counts()
+        got_norm = s.value_counts(normalize=True)
+        got_asc = s.value_counts(ascending=True)
+    df_equals(got, p.value_counts())
+    df_equals(got_norm, p.value_counts(normalize=True))
+    df_equals(got_asc, p.value_counts(ascending=True))
+
+
+def test_value_counts_float_with_nan():
+    vals = [1.5, 1.5, np.nan, 2.5, np.nan, np.nan]
+    md = pd.Series(vals)
+    p = md._to_pandas()
+    df_equals(md.value_counts(), p.value_counts())
+    df_equals(md.value_counts(dropna=False), p.value_counts(dropna=False))
+
+
+def test_value_counts_sort_false_first_appearance():
+    md = pd.Series([3, 1, 1, 2, 3, 3])
+    p = md._to_pandas()
+    df_equals(md.value_counts(sort=False), p.value_counts(sort=False))
+
+
+def test_dropna_arraylike_subset():
+    md = pd.DataFrame({"a": [1.0, np.nan], "b": [np.nan, 2.0]})
+    p = md._to_pandas()
+    df_equals(md.dropna(subset=np.array(["a"])), p.dropna(subset=np.array(["a"])))
+    df_equals(md.dropna(subset=pandas.Index(["b"])), p.dropna(subset=pandas.Index(["b"])))
