@@ -1,0 +1,1 @@
+"""asv benchmark suite (reference: modin/asv_bench/benchmarks/)."""

@@ -1,0 +1,145 @@
+"""Core benchmarks mirroring the reference suite
+(asv_bench/benchmarks/benchmarks.py:42-433): TimeArithmetic,
+TimeGroupByDefaultAggregations, TimeGroupByMultiColumn, TimeBinaryOp,
+TimeMerge, TimeConcat, TimeSortValues, TimeQuery."""
+
+import numpy as np
+
+from .utils import (
+    BINARY_SHAPES,
+    GROUPBY_NGROUPS,
+    UNARY_SHAPES,
+    execute,
+    make_frame,
+    pd,
+)
+
+
+class TimeArithmetic:
+    params = [UNARY_SHAPES, [0, 1]]
+    param_names = ["shape", "axis"]
+
+    def setup(self, shape, axis):
+        self.df = make_frame(shape)
+        execute(self.df)
+
+    def time_sum(self, shape, axis):
+        execute(self.df.sum(axis=axis))
+
+    def time_count(self, shape, axis):
+        execute(self.df.count(axis=axis))
+
+    def time_mean(self, shape, axis):
+        execute(self.df.mean(axis=axis))
+
+    def time_median(self, shape, axis):
+        execute(self.df.median(axis=axis))
+
+    def time_add(self, shape, axis):
+        execute(self.df + self.df)
+
+    def time_abs(self, shape, axis):
+        execute(self.df.abs())
+
+
+class TimeGroupByDefaultAggregations:
+    params = [UNARY_SHAPES, GROUPBY_NGROUPS]
+    param_names = ["shape", "ngroups"]
+
+    def setup(self, shape, ngroups):
+        self.df = make_frame(shape, ngroups=ngroups)
+        execute(self.df)
+
+    def time_groupby_count(self, shape, ngroups):
+        execute(self.df.groupby("groupby_col").count())
+
+    def time_groupby_size(self, shape, ngroups):
+        execute(self.df.groupby("groupby_col").size())
+
+    def time_groupby_sum(self, shape, ngroups):
+        execute(self.df.groupby("groupby_col").sum())
+
+    def time_groupby_mean(self, shape, ngroups):
+        execute(self.df.groupby("groupby_col").mean())
+
+
+class TimeGroupByMultiColumn:
+    params = [UNARY_SHAPES]
+    param_names = ["shape"]
+
+    def setup(self, shape):
+        self.df = make_frame(shape, ngroups=20)
+        self.df["groupby_col2"] = self.df["col0"] % 5
+        execute(self.df)
+
+    def time_groupby_multi_sum(self, shape):
+        execute(self.df.groupby(["groupby_col", "groupby_col2"]).sum())
+
+
+class TimeBinaryOp:
+    params = [BINARY_SHAPES]
+    param_names = ["shapes"]
+
+    def setup(self, shapes):
+        self.df1 = make_frame(shapes[0], seed=1)
+        self.df2 = make_frame(shapes[0], seed=2)
+        execute(self.df1), execute(self.df2)
+
+    def time_add(self, shapes):
+        execute(self.df1 + self.df2)
+
+    def time_mul(self, shapes):
+        execute(self.df1 * self.df2)
+
+
+class TimeMerge:
+    params = [BINARY_SHAPES]
+    param_names = ["shapes"]
+
+    def setup(self, shapes):
+        self.left = make_frame(shapes[0], seed=3)
+        self.right = make_frame((shapes[0][0] // 2, 3), seed=4)
+        execute(self.left), execute(self.right)
+
+    def time_merge_inner(self, shapes):
+        execute(self.left.merge(self.right, on="col0", how="inner"))
+
+    def time_merge_left(self, shapes):
+        execute(self.left.merge(self.right, on="col0", how="left"))
+
+
+class TimeConcat:
+    params = [UNARY_SHAPES]
+    param_names = ["shape"]
+
+    def setup(self, shape):
+        self.df1 = make_frame(shape, seed=5)
+        self.df2 = make_frame(shape, seed=6)
+        execute(self.df1), execute(self.df2)
+
+    def time_concat_axis0(self, shape):
+        execute(pd.concat([self.df1, self.df2]))
+
+
+class TimeSortValues:
+    params = [UNARY_SHAPES]
+    param_names = ["shape"]
+
+    def setup(self, shape):
+        self.df = make_frame(shape, seed=7)
+        execute(self.df)
+
+    def time_sort_values(self, shape):
+        execute(self.df.sort_values("col0", kind="stable"))
+
+
+class TimeQuery:
+    params = [UNARY_SHAPES]
+    param_names = ["shape"]
+
+    def setup(self, shape):
+        self.df = make_frame(shape, seed=8)
+        execute(self.df)
+
+    def time_query(self, shape):
+        execute(self.df.query("col0 > 50 & col1 < 30"))

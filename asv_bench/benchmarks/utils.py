@@ -1,0 +1,50 @@
+"""Benchmark data shapes and the impl switch.
+
+Reference design: asv_bench/benchmarks/utils/ — MODIN_TPU_ASV_USE_IMPL
+selects the implementation under test; MODIN_TPU_TEST_DATASET_SIZE picks the
+shape profile; every benchmark calls execute() to force materialization.
+"""
+
+import os
+
+import numpy as np
+
+USE_IMPL = os.environ.get("MODIN_TPU_ASV_USE_IMPL", "modin_tpu")
+DATASET_SIZE = os.environ.get("MODIN_TPU_TEST_DATASET_SIZE", "Small")
+
+if USE_IMPL == "pandas":
+    import pandas as pd
+else:
+    import modin_tpu.pandas as pd
+    from modin_tpu.config import BenchmarkMode
+
+    BenchmarkMode.put(True)
+
+# (rows, cols) profiles mirroring the reference (data_shapes.py:33-59)
+UNARY_SHAPES = {
+    "Small": [(2_000, 10), (100, 100)],
+    "Big": [(5_000, 5_000), (1_000_000, 10)],
+}[DATASET_SIZE]
+BINARY_SHAPES = {
+    "Small": [((2_000, 10), (2_000, 10))],
+    "Big": [((5_000, 5_000), (5_000, 5_000)), ((500_000, 20), (1_000_000, 10))],
+}[DATASET_SIZE]
+GROUPBY_NGROUPS = {"Small": [10, 100], "Big": [100, 10_000]}[DATASET_SIZE]
+
+
+def make_frame(shape, seed=0, ngroups=None):
+    rng = np.random.default_rng(seed)
+    rows, cols = shape
+    data = {f"col{i}": rng.integers(0, 100, rows) for i in range(cols)}
+    if ngroups is not None:
+        data["groupby_col"] = rng.integers(0, ngroups, rows)
+    return pd.DataFrame(data)
+
+
+def execute(obj):
+    """Force materialization (reference: utils/common.py execute)."""
+    qc = getattr(obj, "_query_compiler", None)
+    if qc is not None:
+        qc.execute()
+        return obj
+    return obj

@@ -1,0 +1,40 @@
+"""Run the asv benchmark classes without asv (timeit-style).
+
+Usage: python asv_bench/run_standalone.py [pattern]
+"""
+
+import inspect
+import itertools
+import sys
+import time
+
+from benchmarks import benchmarks
+
+
+def run(pattern: str = "") -> None:
+    for name, cls in inspect.getmembers(benchmarks, inspect.isclass):
+        if not name.startswith("Time") or pattern not in name:
+            continue
+        params = getattr(cls, "params", [[None]])
+        if params and not isinstance(params[0], list):
+            params = [params]
+        for combo in itertools.product(*params):
+            instance = cls()
+            try:
+                instance.setup(*combo)
+            except NotImplementedError:
+                continue
+            for method_name, method in inspect.getmembers(instance, inspect.ismethod):
+                if not method_name.startswith("time_"):
+                    continue
+                method(*combo)  # warm-up
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    method(*combo)
+                    best = min(best, time.perf_counter() - t0)
+                print(f"{name}.{method_name}{combo}: {best*1000:.2f} ms")
+
+
+if __name__ == "__main__":
+    run(sys.argv[1] if len(sys.argv) > 1 else "")

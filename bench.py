@@ -18,6 +18,30 @@ import time
 
 import numpy as np
 
+
+def _probe_devices(timeout_s: float = 60.0) -> str:
+    """Platform of the default jax backend, probed in a SUBPROCESS: a wedged
+    accelerator tunnel holds jax's backend-init lock forever, so an in-process
+    probe would poison this process too."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+        if out.returncode != 0:
+            return "error"
+        platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        return platform or "error"
+    except subprocess.TimeoutExpired:
+        return "timeout"
+    except Exception:
+        return "error"
+
+
 ROWS = int(os.environ.get("BENCH_ROWS", 100_000_000))
 COLS = 5
 NGROUPS = 100
@@ -77,6 +101,15 @@ def time_ops(df, ops, execute):
 
 
 def main() -> None:
+    platform = _probe_devices()
+    if platform in ("timeout", "error"):
+        # the accelerator tunnel is down: restart jax on CPU in this process
+        # so the bench still emits a (CPU-vs-CPU) line instead of hanging
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu (accelerator unavailable)"
+
     data = build_data()
 
     import pandas
@@ -113,6 +146,7 @@ def main() -> None:
                 "vs_baseline": round(pandas_total / max(modin_total, 1e-9), 2),
                 "detail": detail,
                 "rows": ROWS,
+                "platform": platform,
             }
         )
     )
