@@ -361,7 +361,15 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
             if all(f._columns[ci].is_device for f in frames):
                 new_columns[ci] = DeviceColumn.from_numpy(values)
             else:
-                new_columns[ci] = HostColumn(pandas.array(values))
+                dtypes = {f._columns[ci].pandas_dtype for f in frames}
+                if len(dtypes) == 1:
+                    # keep the exact dtype: re-inference would e.g. turn the
+                    # pandas-3 'str' dtype into the 'string' extension dtype
+                    new_columns[ci] = HostColumn(
+                        pandas.array(values, dtype=next(iter(dtypes)))
+                    )
+                else:
+                    new_columns[ci] = HostColumn(pandas.array(values))
         lazies = [f._index for f in frames]
 
         def build_index() -> pandas.Index:

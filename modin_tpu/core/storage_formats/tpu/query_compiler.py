@@ -772,6 +772,41 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return result
         return super().idxmax(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs)
 
+    # ---------------------------- shift/diff --------------------------- #
+
+    def _try_shift_like(self, kernel, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        periods = kwargs.get("periods", 1)
+        if (
+            kwargs.get("axis", 0) not in (0, None)
+            or kwargs.get("freq") is not None
+            or "fill_value" in kwargs
+            or not isinstance(periods, (int, np.integer))
+        ):
+            return None
+        frame = self._modin_frame
+        if len(frame) == 0 or not all(
+            c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns
+        ):
+            return None
+        datas = kernel([c.data for c in frame._columns], len(frame), int(periods))
+        return self._wrap_device_result(datas)
+
+    def shift(self, **kwargs: Any) -> "TpuQueryCompiler":
+        from modin_tpu.ops.elementwise import shift_columns
+
+        result = self._try_shift_like(shift_columns, kwargs)
+        if result is not None:
+            return result
+        return super().shift(**kwargs)
+
+    def diff(self, **kwargs: Any) -> "TpuQueryCompiler":
+        from modin_tpu.ops.elementwise import diff_columns
+
+        result = self._try_shift_like(diff_columns, kwargs)
+        if result is not None:
+            return result
+        return super().diff(**kwargs)
+
     # ------------------------------ dropna ---------------------------- #
 
     def dropna(self, **kwargs: Any) -> "TpuQueryCompiler":

@@ -265,6 +265,53 @@ def clip_columns(cols: List[Any], lower: Any, upper: Any) -> List[Any]:
     return list(fn(tuple(cols), 0 if lower is None else lower, 0 if upper is None else upper))
 
 
+@functools.lru_cache(maxsize=None)
+def _jit_shift(n_cols: int, n: int, periods: int, as_diff: bool):
+    import jax
+    import jax.numpy as jnp
+
+    def one(c):
+        k = abs(periods)
+        if k == 0:
+            if as_diff:
+                # pandas diff(0) still promotes ints to float64
+                return (c - c).astype(jnp.float64)
+            return c  # shift(0) preserves the dtype
+        if k >= n:
+            # pandas: shifting past the frame is all-NaN (diff likewise)
+            return jnp.full(c.shape, jnp.nan, jnp.float64)
+        is_f = jnp.issubdtype(c.dtype, jnp.floating)
+        x = c.astype(jnp.float64) if not is_f else c
+        if periods >= 0:
+            shifted = jnp.concatenate(
+                [jnp.full(k, jnp.nan, x.dtype), x[: x.shape[0] - k]]
+            )
+        else:
+            shifted = jnp.concatenate([x[k:], jnp.full(k, jnp.nan, x.dtype)])
+            # mask the region beyond the logical length: rows shifted in from
+            # pads must read as missing
+            valid_src = jnp.arange(x.shape[0]) + k < n
+            shifted = jnp.where(valid_src, shifted, jnp.nan)
+        if as_diff:
+            return x - shifted
+        return shifted
+
+    def fn(cols: Tuple) -> Tuple:
+        return tuple(one(c) for c in cols)
+
+    return jax.jit(fn)
+
+
+def shift_columns(cols: List[Any], n: int, periods: int) -> List[Any]:
+    """pandas shift: rows move by ``periods`` with NaN fill (float64 result)."""
+    return list(_jit_shift(len(cols), int(n), int(periods), False)(tuple(cols)))
+
+
+def diff_columns(cols: List[Any], n: int, periods: int) -> List[Any]:
+    """pandas diff: x - x.shift(periods) (float64 result)."""
+    return list(_jit_shift(len(cols), int(n), int(periods), True)(tuple(cols)))
+
+
 def astype_column(col: Any, target: np.dtype) -> Any:
     import jax.numpy as jnp
 

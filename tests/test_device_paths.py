@@ -235,3 +235,32 @@ def test_dropna_arraylike_subset():
     p = md._to_pandas()
     df_equals(md.dropna(subset=np.array(["a"])), p.dropna(subset=np.array(["a"])))
     df_equals(md.dropna(subset=pandas.Index(["b"])), p.dropna(subset=pandas.Index(["b"])))
+
+
+def test_shift_diff_device():
+    import warnings
+
+    data = {"a": [1.0, 2.0, np.nan, 4.0, 5.0], "b": [10, 20, 30, 40, 50]}
+    md = pd.DataFrame(data)
+    p = md._to_pandas()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        got_shift = md.shift(2)
+        got_nshift = md.shift(-1)
+        got_diff = md.diff()
+        got_ndiff = md.diff(-2)
+    df_equals(got_shift, p.shift(2))
+    df_equals(got_nshift, p.shift(-1))
+    df_equals(got_diff, p.diff())
+    df_equals(got_ndiff, p.diff(-2))
+
+
+def test_shift_diff_edge_periods():
+    data = {"a": [1.0, 2.0, 3.0], "b": [10, 20, 30]}
+    md = pd.DataFrame(data)
+    p = md._to_pandas()
+    df_equals(md.shift(0), p.shift(0))           # dtype preserved
+    df_equals(md.diff(0), p.diff(0))
+    df_equals(md.shift(50), p.shift(50))         # beyond length -> all NaN
+    df_equals(md.shift(-50), p.shift(-50))
+    df_equals(md.diff(-50), p.diff(-50))

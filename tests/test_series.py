@@ -1,0 +1,218 @@
+"""Series differential tests (modeled on modin/tests/pandas/test_series.py,
+the reference's largest suite)."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_series, df_equals
+
+_rng = np.random.default_rng(55)
+
+SERIES_DATA = {
+    "int": _rng.integers(-100, 100, 64),
+    "float_nan": np.where(_rng.random(64) < 0.2, np.nan, _rng.uniform(-5, 5, 64)),
+    "bool": _rng.random(64) < 0.5,
+    "str": _rng.choice(["alpha", "Beta", "g_amma", ""], 64),
+}
+
+
+@pytest.fixture(params=list(SERIES_DATA), ids=list(SERIES_DATA))
+def kind(request):
+    return request.param
+
+
+@pytest.fixture
+def pair(kind):
+    return create_test_series(SERIES_DATA[kind], name="s")
+
+
+class TestSeriesCore:
+    def test_construction(self, pair):
+        ms, ps = pair
+        df_equals(ms, ps)
+        assert ms.name == ps.name
+        assert ms.dtype == ps.dtype
+        assert ms.shape == ps.shape
+
+    def test_repr(self, pair):
+        ms, ps = pair
+        assert repr(ms) == repr(ps)
+
+    def test_rename_and_name(self, pair):
+        ms, ps = pair
+        df_equals(ms.rename("other"), ps.rename("other"))
+        ms2 = ms.copy()
+        ms2.name = "zzz"
+        ps2 = ps.copy()
+        ps2.name = "zzz"
+        df_equals(ms2, ps2)
+
+    def test_head_tail_take(self, pair):
+        ms, ps = pair
+        df_equals(ms.head(3), ps.head(3))
+        df_equals(ms.tail(3), ps.tail(3))
+        df_equals(ms.take([0, 5, 9]), ps.take([0, 5, 9]))
+
+    def test_getitem(self, pair):
+        ms, ps = pair
+        df_equals(ms[3:9], ps[3:9])
+        df_equals(ms.iloc[[1, 2, 5]], ps.iloc[[1, 2, 5]])
+        df_equals(ms.loc[4], ps.loc[4])
+
+
+class TestSeriesNumeric:
+    @pytest.fixture
+    def num(self):
+        return create_test_series(SERIES_DATA["float_nan"], name="x")
+
+    @pytest.mark.parametrize("op", ["sum", "mean", "min", "max", "std", "var",
+                                     "median", "count", "prod", "skew", "kurt", "sem"])
+    def test_reductions(self, num, op):
+        ms, ps = num
+        got, want = getattr(ms, op)(), getattr(ps, op)()
+        np.testing.assert_allclose(got, want, rtol=1e-12, equal_nan=True)
+
+    def test_arith(self, num):
+        ms, ps = num
+        df_equals(ms * 2 + 1, ps * 2 + 1)
+        df_equals(ms / ms, ps / ps)
+        df_equals(ms ** 2, ps ** 2)
+        df_equals(-ms, -ps)
+        df_equals(ms.abs(), ps.abs())
+
+    def test_comparisons_and_filtering(self, num):
+        ms, ps = num
+        df_equals(ms[ms > 0], ps[ps > 0])
+        df_equals(ms.between(-1, 1), ps.between(-1, 1))
+        df_equals(ms.clip(-1, 1), ps.clip(-1, 1))
+
+    def test_cumulative(self, num):
+        ms, ps = num
+        df_equals(ms.cumsum(), ps.cumsum())
+        df_equals(ms.cummax(), ps.cummax())
+
+    def test_sort_and_rank(self, num):
+        ms, ps = num
+        df_equals(ms.sort_values(kind="stable"), ps.sort_values(kind="stable"))
+        df_equals(ms.rank(), ps.rank())
+
+    def test_fill_missing(self, num):
+        ms, ps = num
+        df_equals(ms.fillna(0.0), ps.fillna(0.0))
+        df_equals(ms.dropna(), ps.dropna())
+        df_equals(ms.isna(), ps.isna())
+        df_equals(ms.ffill(), ps.ffill())
+
+    def test_unique_nunique(self, num):
+        ms, ps = num
+        np.testing.assert_array_equal(np.sort(ms.unique()), np.sort(ps.unique()))
+        assert ms.nunique() == ps.nunique()
+
+    def test_idxmin_idxmax(self, num):
+        ms, ps = num
+        assert ms.idxmin() == ps.idxmin()
+        assert ms.idxmax() == ps.idxmax()
+
+    def test_round_astype(self, num):
+        ms, ps = num
+        df_equals(ms.round(2), ps.round(2))
+        df_equals(ms.astype("float32"), ps.astype("float32"))
+
+    def test_shift_diff(self, num):
+        ms, ps = num
+        df_equals(ms.shift(1), ps.shift(1))
+        df_equals(ms.diff(), ps.diff())
+
+    def test_rolling(self, num):
+        ms, ps = num
+        df_equals(ms.rolling(4).sum(), ps.rolling(4).sum())
+        df_equals(ms.rolling(4).mean(), ps.rolling(4).mean())
+
+
+class TestSeriesString:
+    @pytest.fixture
+    def strs(self):
+        return create_test_series(SERIES_DATA["str"], name="t")
+
+    @pytest.mark.parametrize("op", ["upper", "lower", "len", "title", "strip", "capitalize"])
+    def test_str_unary(self, strs, op):
+        ms, ps = strs
+        df_equals(getattr(ms.str, op)(), getattr(ps.str, op)())
+
+    def test_str_contains_startswith(self, strs):
+        ms, ps = strs
+        df_equals(ms.str.contains("a"), ps.str.contains("a"))
+        df_equals(ms.str.startswith("B"), ps.str.startswith("B"))
+        df_equals(ms.str.replace("a", "@"), ps.str.replace("a", "@"))
+        df_equals(ms.str.split("_"), ps.str.split("_"))
+
+    def test_value_counts_str(self, strs):
+        ms, ps = strs
+        df_equals(ms.value_counts(), ps.value_counts())
+
+    def test_str_getitem(self, strs):
+        ms, ps = strs
+        df_equals(ms.str[0:2], ps.str[0:2])
+
+
+class TestSeriesDatetime:
+    @pytest.fixture
+    def dt(self):
+        base = pandas.to_datetime("2023-05-01 10:00:00")
+        vals = base + pandas.to_timedelta(_rng.integers(0, 10**6, 40), unit="s")
+        return create_test_series(vals, name="ts")
+
+    @pytest.mark.parametrize("prop", ["year", "month", "day", "hour", "dayofweek", "quarter"])
+    def test_dt_props(self, dt, prop):
+        ms, ps = dt
+        df_equals(getattr(ms.dt, prop), getattr(ps.dt, prop))
+
+    def test_dt_methods(self, dt):
+        ms, ps = dt
+        df_equals(ms.dt.floor("h"), ps.dt.floor("h"))
+        df_equals(ms.dt.day_name(), ps.dt.day_name())
+
+    def test_dt_arithmetic(self, dt):
+        ms, ps = dt
+        df_equals(ms.min(), ps.min())
+        df_equals(ms.max(), ps.max())
+
+
+class TestSeriesMisc:
+    def test_map_apply(self):
+        ms, ps = create_test_series([1, 2, 3], name="m")
+        df_equals(ms.map({1: "a", 2: "b", 3: "c"}), ps.map({1: "a", 2: "b", 3: "c"}))
+        df_equals(ms.apply(lambda x: x * 10), ps.apply(lambda x: x * 10))
+
+    def test_isin(self):
+        ms, ps = create_test_series([1, 2, 3, 4], name="m")
+        df_equals(ms.isin([2, 4]), ps.isin([2, 4]))
+
+    def test_concat_series(self):
+        ms, ps = create_test_series([1, 2], name="m")
+        df_equals(pd.concat([ms, ms]), pandas.concat([ps, ps]))
+        df_equals(
+            pd.concat([ms, ms], axis=1), pandas.concat([ps, ps], axis=1)
+        )
+
+    def test_to_frame_roundtrip(self):
+        ms, ps = create_test_series([1.5, 2.5], name="m")
+        df_equals(ms.to_frame(), ps.to_frame())
+        df_equals(ms.to_frame("renamed"), ps.to_frame("renamed"))
+
+    def test_where_mask(self):
+        ms, ps = create_test_series([1.0, -2.0, 3.0], name="m")
+        df_equals(ms.where(ms > 0), ps.where(ps > 0))
+        df_equals(ms.mask(ms > 0, 0.0), ps.mask(ps > 0, 0.0))
+
+    def test_index_alignment_binary(self):
+        ms1, ps1 = create_test_series([1, 2, 3], name="a")
+        ms2 = pd.Series([10, 20, 30], index=[2, 1, 0])
+        ps2 = pandas.Series([10, 20, 30], index=[2, 1, 0])
+        df_equals(ms1 + ms2, ps1 + ps2)
+
+    def test_string_cat_with_plus(self):
+        ms, ps = create_test_series(["a", "b"], name="s")
+        df_equals(ms + "_suffix", ps + "_suffix")
