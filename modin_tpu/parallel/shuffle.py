@@ -63,7 +63,7 @@ def _jit_shuffle(n_cols: int, capacity: int, n: int, descending: bool, local_sor
     """shard_map kernel: local bucketize+pack, all_to_all, local compaction."""
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from modin_tpu.parallel.mesh import get_mesh
@@ -149,7 +149,7 @@ def _jit_shuffle(n_cols: int, capacity: int, n: int, descending: bool, local_sor
             + tuple(P("rows") for _ in range(n_cols)),
             out_specs=(P("rows"), P("rows"))
             + tuple(P("rows") for _ in range(n_cols + 1)),
-            check_rep=False,
+            check_vma=False,
         )
     )
 
