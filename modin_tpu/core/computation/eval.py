@@ -166,8 +166,17 @@ def _scalar_binop(method: str, left: Any, right: Any) -> Any:
     return _MIRROR[method](left, right)
 
 
-def _caller_namespace() -> Dict[str, Any]:
-    """Locals/globals of the first frame outside modin_tpu (for @locals)."""
+def caller_namespace() -> Dict[str, Any]:
+    """Namespace of the frame that invoked ``DataFrame.query``/``eval``.
+
+    Captured at the API call site and passed down explicitly.  Resolution
+    walks outward past modin_tpu-internal frames (logging wrappers, fallback
+    installers sit between the public method and the user), landing on the
+    user's direct calling frame — the same frame pandas' level-based lookup
+    resolves for a direct ``df.query(...)`` call.  A caller-supplied
+    ``level=`` kwarg routes to the pandas fallback untouched, so explicit
+    level overrides keep exact pandas semantics.
+    """
     import sys
 
     frame = sys._getframe(1)
@@ -206,12 +215,14 @@ def _rewrite_bitwise_as_boolean(expr: str) -> str:
         return expr
 
 
-def _prepare(expr: str, df: Any, level: int = 3) -> tuple[Optional[ast.AST], Dict[str, str], Dict[str, Any]]:
+def _prepare(
+    expr: str, df: Any, namespace: Optional[Dict[str, Any]] = None
+) -> tuple[Optional[ast.AST], Dict[str, str], Dict[str, Any]]:
     expr = _rewrite_bitwise_as_boolean(expr.strip())
     sanitized, backtick_map = _sanitize_backticks(expr, df.columns)
-    # resolve @locals from the caller's frame
+    # resolve @locals from the caller-provided namespace
     local_dict: Dict[str, Any] = {}
-    caller_locals = _caller_namespace() if "@" in sanitized else {}
+    caller_locals = namespace if namespace is not None else {}
 
     def at_repl(match: "re.Match[str]") -> str:
         name = match.group(1)
@@ -225,10 +236,12 @@ def _prepare(expr: str, df: Any, level: int = 3) -> tuple[Optional[ast.AST], Dic
     return sanitized, backtick_map, local_dict
 
 
-def try_query(df: Any, expr: str, frame_level: int = 3) -> Optional[Any]:
+def try_query(
+    df: Any, expr: str, namespace: Optional[Dict[str, Any]] = None
+) -> Optional[Any]:
     """Evaluate a query expression natively; None means 'use the fallback'."""
     try:
-        sanitized, backtick_map, local_dict = _prepare(expr, df, frame_level)
+        sanitized, backtick_map, local_dict = _prepare(expr, df, namespace)
         tree = ast.parse(sanitized, mode="eval")
         mask = _Evaluator(df, backtick_map, local_dict).visit(tree)
     except (UnsupportedExpression, SyntaxError):
@@ -240,14 +253,16 @@ def try_query(df: Any, expr: str, frame_level: int = 3) -> Optional[Any]:
     return df[mask]
 
 
-def try_eval(df: Any, expr: str, frame_level: int = 3) -> Optional[tuple]:
+def try_eval(
+    df: Any, expr: str, namespace: Optional[Dict[str, Any]] = None
+) -> Optional[tuple]:
     """Evaluate an eval expression natively.
 
     Returns (result, assigned_name) or None for fallback.  ``assigned_name``
     is set for 'target = expression' forms.
     """
     try:
-        sanitized, backtick_map, local_dict = _prepare(expr, df, frame_level)
+        sanitized, backtick_map, local_dict = _prepare(expr, df, namespace)
         assigned = None
         body = sanitized
         # an assignment '=' is one not preceded by <>=! and not followed by =

@@ -323,7 +323,17 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
         """Boolean-mask rows.  The row count is data-dependent, so this is an
         eager (synchronizing) operation — the reference has the same property
         via lazy row-length caches (dataframe.py:242-343)."""
-        mask_np = np.asarray(mask)[: len(self)]
+        from modin_tpu.ops.structural import pad_len
+
+        mask_np = np.asarray(mask)
+        n = len(self)
+        if len(mask_np) == pad_len(n):
+            # Device-produced masks carry shard padding; padded tail is dead.
+            mask_np = mask_np[:n]
+        elif len(mask_np) != n:
+            raise ValueError(
+                f"Item wrong length {len(mask_np)} instead of {n}."
+            )
         positions = np.nonzero(mask_np)[0]
         return self._take_host_positions(positions)
 

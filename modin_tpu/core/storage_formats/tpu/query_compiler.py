@@ -168,13 +168,26 @@ class TpuQueryCompiler(BaseQueryCompiler):
     def getitem_array(self, key: Any) -> "TpuQueryCompiler":
         if isinstance(key, TpuQueryCompiler):
             mask_frame = key._modin_frame
-            if mask_frame.num_cols == 1 and mask_frame.get_column(0).is_device:
+            if (
+                mask_frame.num_cols == 1
+                and mask_frame.get_column(0).is_device
+                and len(mask_frame) == len(self._modin_frame)
+                # pandas aligns a boolean-Series mask to the frame's index;
+                # the positional fast path is only valid when the indexes
+                # already match (ref: pandas check_bool_indexer).
+                and self._fast_index_match(key)
+            ):
                 mask = mask_frame.get_column(0).to_numpy()
                 if mask.dtype == bool:
                     return type(self)(self._modin_frame.filter_rows_mask(mask))
             return super().getitem_array(key)
         key_arr = np.asarray(key)
         if key_arr.dtype == bool:
+            if len(key_arr) != len(self._modin_frame):
+                raise ValueError(
+                    f"Item wrong length {len(key_arr)} instead of "
+                    f"{len(self._modin_frame)}."
+                )
             return type(self)(self._modin_frame.filter_rows_mask(key_arr))
         return super().getitem_array(key)
 
@@ -1292,7 +1305,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         """
         from modin_tpu.config import RangePartitioning
         from modin_tpu.parallel.mesh import num_row_shards
-        from modin_tpu.parallel.shuffle import range_shuffle
+        from modin_tpu.parallel.shuffle import ShuffleSkewError, range_shuffle
 
         if not RangePartitioning.get() or num_row_shards() < 2:
             return None
@@ -1316,9 +1329,15 @@ class TpuQueryCompiler(BaseQueryCompiler):
         n = len(frame)
         iota = jnp.arange(key_col.data.shape[0], dtype=jnp.int64)
         other_cols = [c.data for i, c in enumerate(frame._columns) if i != pos[0]]
-        key_out, cols_out, counts, _ = range_shuffle(
-            key_col.data, [iota] + other_cols, n, descending=not asc, local_sort=True
-        )
+        try:
+            key_out, cols_out, counts, _ = range_shuffle(
+                key_col.data, [iota] + other_cols, n, descending=not asc, local_sort=True
+            )
+        except ShuffleSkewError:
+            # Pathological key skew exhausted the capacity-slack retries (all
+            # rows landing on one shard); the global argsort path below
+            # handles any distribution.
+            return None
         perm_out = cols_out[0]
         rest = cols_out[1:]
         new_cols: list = [None] * frame.num_cols

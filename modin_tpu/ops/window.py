@@ -34,7 +34,10 @@ def _jit_rolling(op: str, n_cols: int, n: int, window: int, min_periods: int):
         wsum = cs - shifted
         wcnt = cc - shifted_c
         if op == "count":
-            return jnp.where(wcnt >= min_periods, wcnt.astype(jnp.float64), jnp.nan)
+            # pandas gates count on the number of ROWS in the window (NaNs
+            # included), unlike other aggs which gate on non-NaN observations.
+            wrows = jnp.minimum(jnp.arange(c.shape[0]) + 1, window)
+            return jnp.where(wrows >= min_periods, wcnt.astype(jnp.float64), jnp.nan)
         if op == "sum":
             # pandas: min_periods=0 makes an all-NaN/empty window sum 0.0
             return jnp.where(wcnt >= min_periods, wsum, jnp.nan)

@@ -900,10 +900,11 @@ class DataFrame(BasePandasDataset):
         )
 
     def eval(self, expr: str, inplace: bool = False, **kwargs: Any):
-        from modin_tpu.core.computation.eval import try_eval
+        from modin_tpu.core.computation.eval import caller_namespace, try_eval
 
         if not kwargs:
-            native = try_eval(self, expr)
+            ns = caller_namespace() if "@" in expr else None
+            native = try_eval(self, expr, ns)
             if native is not None:
                 result, assigned = native
                 if assigned is not None:
@@ -925,10 +926,11 @@ class DataFrame(BasePandasDataset):
         return result
 
     def query(self, expr: str, *, inplace: bool = False, **kwargs: Any):
-        from modin_tpu.core.computation.eval import try_query
+        from modin_tpu.core.computation.eval import caller_namespace, try_query
 
         if not kwargs:
-            native = try_query(self, expr)
+            ns = caller_namespace() if "@" in expr else None
+            native = try_query(self, expr, ns)
             if native is not None:
                 if inplace:
                     self._update_inplace(native._query_compiler)

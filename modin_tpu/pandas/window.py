@@ -86,7 +86,7 @@ for _name in _ROLLING_AGGS:
 class Expanding(ClassLogger, modin_layer="PANDAS-API"):
     def __init__(self, dataframe: Any, min_periods: int = 1, method: str = "single") -> None:
         self._dataframe = dataframe
-        self.expanding_args = [min_periods]
+        self.expanding_args = [min_periods, method]
 
     @property
     def _query_compiler(self):

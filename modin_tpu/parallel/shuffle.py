@@ -31,6 +31,15 @@ from typing import Any, List, Tuple
 import numpy as np
 
 
+class ShuffleSkewError(RuntimeError):
+    """Capacity-slack retries exhausted by pathologically skewed keys.
+
+    Callers catch this specifically (not bare RuntimeError, which would also
+    swallow jax XlaRuntimeError device failures) and fall back to a
+    non-shuffle path.
+    """
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_sample(step: int):
     import jax
@@ -193,7 +202,7 @@ def range_shuffle(
             break
         slack *= 2.0
         if slack > 64:
-            raise RuntimeError("range_shuffle: pathological key skew")
+            raise ShuffleSkewError("range_shuffle: pathological key skew")
 
     assert int(counts.sum()) == n, (counts, n)
     # positions of each shard's valid prefix within the [S * S*capacity] layout

@@ -186,6 +186,17 @@ class TestIndexing:
         md, pdf = create_test_dfs(data)
         df_equals(md[md["col0"] > 50], pdf[pdf["col0"] > 50])
 
+    def test_getitem_bool_mask_misaligned_index(self, data):
+        # pandas aligns a boolean-Series mask by index, not position
+        md, pdf = create_test_dfs(data)
+        md_mask = (md["col0"] > 50).iloc[::-1]
+        pd_mask = (pdf["col0"] > 50).iloc[::-1]
+        df_equals(md[md_mask], pdf[pd_mask])
+
+    def test_getitem_bool_mask_wrong_length_raises(self, data):
+        md, pdf = create_test_dfs(data)
+        eval_general(md, pdf, lambda df: df[np.asarray([True, False])])
+
     def test_loc(self, data):
         md, pdf = create_test_dfs(data)
         df_equals(md.loc[5], pdf.loc[5])

@@ -54,6 +54,18 @@ def test_query_local_variable(dfs):
     df_equals(md.query("a > @threshold"), pdf.query("a > @threshold"))
 
 
+def test_query_local_resolved_in_direct_caller(dfs):
+    # @locals must resolve in the frame that calls .query (pandas level
+    # semantics), including when that frame is a user helper function.
+    md, pdf = dfs
+
+    def helper(frame):
+        lim = 20
+        return frame.query("a > @lim")
+
+    df_equals(helper(md), helper(pdf))
+
+
 def test_query_runs_on_device(dfs):
     md, _ = dfs
     numeric = md[["a", "b"]]

@@ -92,6 +92,32 @@ def test_sort_values_range_partitioning_config():
         )
 
 
+def test_sort_values_all_equal_keys_completes():
+    # All-equal keys make every row target one shard; the slack retry loop
+    # must still converge on this mesh and produce a correct sort.
+    md, pdf = create_test_dfs({"a": np.full(2048, 3.0), "b": np.arange(2048.0)})
+    with RangePartitioning.context(True):
+        df_equals(
+            md.sort_values("a", kind="stable"), pdf.sort_values("a", kind="stable")
+        )
+
+
+def test_sort_values_skew_overflow_falls_back(monkeypatch):
+    # On wide meshes the slack retry can exhaust (RuntimeError); sort_values
+    # must fall back to the global argsort path instead of surfacing it.
+    import modin_tpu.parallel.shuffle as shuffle_mod
+
+    def boom(*args, **kwargs):
+        raise shuffle_mod.ShuffleSkewError("range_shuffle: pathological key skew")
+
+    monkeypatch.setattr(shuffle_mod, "range_shuffle", boom)
+    md, pdf = create_test_dfs({"a": np.full(512, 3.0), "b": np.arange(512.0)})
+    with RangePartitioning.context(True):
+        df_equals(
+            md.sort_values("a", kind="stable"), pdf.sort_values("a", kind="stable")
+        )
+
+
 def test_range_shuffle_sort_with_nan_and_inf():
     from modin_tpu.ops.structural import pad_host
     from modin_tpu.parallel.engine import JaxWrapper
