@@ -60,7 +60,7 @@ class DeviceColumn:
     computed column drops the cache.
     """
 
-    __slots__ = ("data", "pandas_dtype", "length", "host_cache")
+    __slots__ = ("_data", "pandas_dtype", "length", "host_cache")
     is_device = True
 
     def __init__(
@@ -70,10 +70,32 @@ class DeviceColumn:
         length: Optional[int] = None,
         host_cache: Optional[np.ndarray] = None,
     ):
-        self.data = data
+        # data: concrete jax.Array OR a deferred LazyExpr (ops/lazy.py);
+        # lazy columns materialize on .data access — fusion-aware consumers
+        # read .raw instead to keep chains deferred.
+        self._data = data
         self.pandas_dtype = np.dtype(pandas_dtype)
         self.length = int(length) if length is not None else int(data.shape[0])
         self.host_cache = host_cache
+
+    @property
+    def data(self) -> Any:
+        from modin_tpu.ops.lazy import LazyExpr, materialize
+
+        if isinstance(self._data, LazyExpr):
+            self._data = materialize(self._data)
+        return self._data
+
+    @property
+    def raw(self) -> Any:
+        """The underlying array or deferred expression, unmaterialized."""
+        return self._data
+
+    @property
+    def is_lazy(self) -> bool:
+        from modin_tpu.ops.lazy import is_lazy
+
+        return is_lazy(self._data)
 
     @classmethod
     def from_numpy(cls, values: np.ndarray, sharding: Any = None) -> "DeviceColumn":
@@ -183,6 +205,7 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
         return cls(columns, df.columns, df.index, nrows=len(df))
 
     def to_pandas(self) -> pandas.DataFrame:
+        self.materialize_device()
         data = {}
         for i, col in enumerate(self._columns):
             if col.is_device:
@@ -244,10 +267,27 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
             list(self._columns), self._col_labels, self._index.copy()
         )
 
+    def materialize_device(self) -> None:
+        """Batch-materialize all deferred device columns in ONE fused jit.
+
+        Multi-column consumers call this before touching ``.data`` so a frame
+        of N lazy columns costs one dispatch, not N (the one-jit-per-operator
+        invariant, extended to the fusion layer).
+        """
+        from modin_tpu.ops.lazy import materialize_exprs
+
+        lazy_cols = [c for c in self._columns if c.is_device and c.is_lazy]
+        if not lazy_cols:
+            return
+        results = materialize_exprs([c.raw for c in lazy_cols])
+        for col, value in zip(lazy_cols, results):
+            col._data = value
+
     def finalize(self) -> None:
         """Block until device work for this frame completes (one sync)."""
         from modin_tpu.parallel.engine import JaxWrapper
 
+        self.materialize_device()
         device_data = [col.data for col in self._columns if col.is_device]
         if device_data:
             JaxWrapper.wait(device_data)
@@ -297,6 +337,7 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
     def _take_host_positions(self, pos_arr: np.ndarray) -> "TpuDataframe":
         from modin_tpu.ops.structural import gather_columns
 
+        self.materialize_device()
         device_idx = [i for i, c in enumerate(self._columns) if c.is_device]
         new_columns: List[Column] = list(self._columns)
         if device_idx:
@@ -342,6 +383,8 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
         from modin_tpu.ops.structural import concat_columns
 
         frames = [self, *others]
+        for f in frames:
+            f.materialize_device()
         lengths = [len(f) for f in frames]
         total = sum(lengths)
         device_ok = [

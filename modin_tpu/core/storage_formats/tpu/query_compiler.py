@@ -315,10 +315,21 @@ class TpuQueryCompiler(BaseQueryCompiler):
     _CMP_OPS = frozenset(["eq", "ne", "lt", "le", "gt", "ge"])
 
     def _device_cols(self) -> Optional[list]:
-        """All columns as device arrays, or None if any column is host-only."""
+        """All columns as concrete device arrays (batch-materializing any
+        deferred expressions in one jit), or None if any column is host-only."""
         cols = self._modin_frame._columns
         if all(c.is_device for c in cols):
+            self._modin_frame.materialize_device()
             return [c.data for c in cols]
+        return None
+
+    def _device_raw(self) -> Optional[list]:
+        """All columns as device arrays OR deferred expressions — the
+        fusion-aware variant of _device_cols for elementwise/reduction paths
+        that extend the lazy chain instead of forcing it."""
+        cols = self._modin_frame._columns
+        if all(c.is_device for c in cols):
+            return [c.raw for c in cols]
         return None
 
     def _fast_index_match(self, other: "TpuQueryCompiler") -> bool:
@@ -374,7 +385,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         frame = self._modin_frame
         if frame.num_cols == 0 or len(frame) == 0:
             return None
-        cols = self._device_cols()
+        cols = self._device_raw()
         if cols is None:
             return None
         kinds = [c.pandas_dtype.kind for c in frame._columns]
@@ -407,7 +418,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         # frame/series other
         if isinstance(other, TpuQueryCompiler):
             oframe = other._modin_frame
-            ocols = other._device_cols()
+            ocols = other._device_raw()
             if ocols is None or not self._fast_index_match(other):
                 return None
             okinds = [c.pandas_dtype.kind for c in oframe._columns]
@@ -466,12 +477,12 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 if require_kinds is not None and col.pandas_dtype.kind not in require_kinds:
                     return None
                 device_positions.append(i)
-                device_arrays.append(col.data)
+                device_arrays.append(col.raw)
         new_device = device_fn(device_arrays) if device_arrays else []
         new_columns: list = list(frame._columns)
         for pos, data in zip(device_positions, new_device):
             old = frame._columns[pos]
-            keep_logical = data.dtype == old.data.dtype
+            keep_logical = data.dtype == old.raw.dtype
             new_columns[pos] = DeviceColumn(
                 data,
                 old.pandas_dtype if keep_logical else np.dtype(data.dtype),
@@ -717,18 +728,16 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         sel_cols = [frame._columns[i] for i in positions]
         labels = frame.columns[positions]
-        arrays = [c.data for c in sel_cols]
-        # bool columns: pandas computes sum/mean over ints
-        if op in ("sum", "prod", "mean", "median", "var", "std", "sem", "skew", "kurt"):
-            import jax.numpy as jnp
-
-            arrays = [
-                a.astype(jnp.int64) if a.dtype == jnp.bool_ else a for a in arrays
-            ]
+        # raw: lazy elementwise producers fuse into the reduction tail
+        arrays = [c.raw for c in sel_cols]
+        # bool columns: pandas computes sum/mean over ints (cast in-fusion)
+        cast_bool = op in ("sum", "prod", "mean", "median", "var", "std", "sem", "skew", "kurt")
         if axis in (1,):
             if op not in ("sum", "mean", "min", "max", "count", "var", "std", "median"):
                 return None
-            data = reductions.reduce_axis1(op, arrays, skipna=skipna, ddof=ddof)
+            data = reductions.reduce_axis1(
+                op, arrays, skipna=skipna, ddof=ddof, cast_bool=cast_bool
+            )
             result_col = DeviceColumn(data, np.dtype(data.dtype), length=len(frame))
             result_frame = TpuDataframe(
                 [result_col],
@@ -740,7 +749,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return qc
         if axis not in (0, None):
             return None
-        values = reductions.reduce_columns(op, arrays, len(frame), skipna=skipna, ddof=ddof)
+        values = reductions.reduce_columns(
+            op, arrays, len(frame), skipna=skipna, ddof=ddof, cast_bool=cast_bool
+        )
         result = pandas.Series(
             [v.item() if v.ndim == 0 else v for v in values], index=labels
         )
@@ -761,6 +772,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             and len(frame) > 0
             and all(c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns)
         ):
+            frame.materialize_device()
             positions, valid_counts = reductions.idx_minmax(
                 op, [c.data for c in frame._columns], len(frame)
             )
@@ -801,6 +813,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns
         ):
             return None
+        frame.materialize_device()
         datas = kernel([c.data for c in frame._columns], len(frame), int(periods))
         return self._wrap_device_result(datas)
 
@@ -852,15 +865,25 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
             cols = [frame.get_column(i) for i in positions]
             flags = tuple(c.pandas_dtype.kind in "mM" for c in cols)
-            nas = isna_columns([c.data for c in cols], flags, negate=False)
-            import jax.numpy as jnp
+            nas = isna_columns([c.raw for c in cols], flags, negate=False)
 
             if nas:
-                stacked = jnp.stack(nas, axis=0)
-                bad = (
-                    jnp.any(stacked, axis=0) if how == "any" else jnp.all(stacked, axis=0)
+                from modin_tpu.ops.lazy import run_fused
+
+                def keep_tail(arrs):
+                    import jax.numpy as jnp
+
+                    stacked = jnp.stack(arrs, axis=0)
+                    bad = (
+                        jnp.any(stacked, axis=0)
+                        if how == "any"
+                        else jnp.all(stacked, axis=0)
+                    )
+                    return ~bad
+
+                keep_mask = np.asarray(
+                    run_fused(nas, tail_key=("dropna_keep", how), tail_builder=keep_tail)
                 )
-                keep_mask = np.asarray(~bad)
             else:
                 keep_mask = np.ones(len(frame), bool)
             return type(self)(frame.filter_rows_mask(keep_mask), self._shape_hint)
@@ -1016,6 +1039,8 @@ class TpuQueryCompiler(BaseQueryCompiler):
         import jax.numpy as jnp
 
         # gather left columns
+        lframe.materialize_device()
+        rframe.materialize_device()
         left_datas = gather_columns_device(
             [c.data for c in lframe._columns], left_pos
         )
@@ -1089,6 +1114,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns
         ):
             return None
+        frame.materialize_device()
         datas = rolling_reduce(
             op, [c.data for c in frame._columns], len(frame), int(window),
             int(min_periods),
@@ -1241,6 +1267,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         if agg_func != "size" and not value_cols:
             return None
 
+        frame.materialize_device()
         try:
             codes, n_groups, group_keys = gb_ops.factorize_keys(
                 [c.data for c in key_cols], len(frame), dropna=dropna
@@ -1326,6 +1353,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         import jax.numpy as jnp
 
+        frame.materialize_device()
         n = len(frame)
         iota = jnp.arange(key_col.data.shape[0], dtype=jnp.int64)
         other_cols = [c.data for i, c in enumerate(frame._columns) if i != pos[0]]
@@ -1393,6 +1421,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 from modin_tpu.ops.structural import gather_columns_device
 
                 n = len(frame)
+                frame.materialize_device()
                 keys = [frame._columns[p].data for p in positions]
                 perm = sort_ops.lexsort_permutation(keys, n, [bool(a) for a in asc])
                 datas = gather_columns_device(

@@ -21,27 +21,6 @@ from typing import Any, Callable, List, Optional, Tuple
 import numpy as np
 
 
-@functools.lru_cache(maxsize=None)
-def _jit_tree_map(op_name: str, n_cols: int, with_other_tree: bool, with_scalar: bool):
-    """Build (and cache) a jitted function applying ``op_name`` columnwise."""
-    import jax
-    import jax.numpy as jnp
-
-    op = _OPS[op_name]
-
-    if with_other_tree:
-        def fn(cols: Tuple, others: Tuple) -> Tuple:
-            return tuple(op(c, o) for c, o in zip(cols, others))
-    elif with_scalar:
-        def fn(cols: Tuple, scalar: Any) -> Tuple:
-            return tuple(op(c, scalar) for c in cols)
-    else:
-        def fn(cols: Tuple) -> Tuple:
-            return tuple(op(c) for c in cols)
-
-    return jax.jit(fn)
-
-
 def _floordiv(x, y):
     import jax.numpy as jnp
 
@@ -123,7 +102,17 @@ def _build_ops() -> dict:
         "cumprod": lambda x: _nan_skipping_cum(x, jnp.cumprod, 1),
         "cummax": lambda x: _nan_skipping_cum(x, jax_lax_cummax, -jnp.inf),
         "cummin": lambda x: _nan_skipping_cum(x, jax_lax_cummin, jnp.inf),
-        "round": None,  # handled specially (decimals arg)
+        "round": lambda x, decimals: (
+            jnp.round(x, decimals) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        ),
+        "astype": lambda x, dtype: x.astype(dtype),
+        "isna_nat": lambda x: x == _NAT_SENTINEL,
+        "notna_nat": lambda x: x != _NAT_SENTINEL,
+        "fillna": lambda x, v: (
+            jnp.where(jnp.isnan(x), v, x) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        ),
+        "clip_lower": lambda x, lo: jnp.where(x < lo, lo, x),
+        "clip_upper": lambda x, hi: jnp.where(x > hi, hi, x),
     }
 
 
@@ -158,111 +147,80 @@ def _ensure_ops() -> None:
         _OPS.update(_build_ops())
 
 
+def get_op(op_name: str) -> Callable:
+    """Elementwise op registry accessor (used by the lazy fusion layer)."""
+    _ensure_ops()
+    return _OPS[op_name]
+
+
 def binary_op_columns(op_name: str, cols: List[Any], other: Any) -> List[Any]:
-    """Apply a binary op to device columns against a scalar or matching columns."""
+    """Deferred binary op on device columns vs a scalar or matching columns.
+
+    Returns :class:`~modin_tpu.ops.lazy.LazyExpr` nodes: nothing dispatches
+    until a consumer needs concrete data, at which point the whole
+    accumulated chain compiles as one fused jit (ops/lazy.py).
+    """
+    from modin_tpu.ops.lazy import lazy_op
+
     _ensure_ops()
     if isinstance(other, (list, tuple)):
-        fn = _jit_tree_map(op_name, len(cols), True, False)
-        return list(fn(tuple(cols), tuple(other)))
-    fn = _jit_tree_map(op_name, len(cols), False, True)
-    return list(fn(tuple(cols), other))
+        return [lazy_op(op_name, c, o) for c, o in zip(cols, other)]
+    return [lazy_op(op_name, c, other) for c in cols]
 
 
 def unary_op_columns(op_name: str, cols: List[Any]) -> List[Any]:
+    """Deferred unary op on device columns (see binary_op_columns)."""
+    from modin_tpu.ops.lazy import lazy_op
+
     _ensure_ops()
-    fn = _jit_tree_map(op_name, len(cols), False, False)
-    return list(fn(tuple(cols)))
+    return [lazy_op(op_name, c) for c in cols]
 
 
 _NAT_SENTINEL = np.iinfo(np.int64).min
 
 
-@functools.lru_cache(maxsize=None)
-def _jit_isna(n_cols: int, mM_flags: Tuple[bool, ...], negate: bool):
-    import jax
-    import jax.numpy as jnp
-
-    def fn(cols: Tuple) -> Tuple:
-        out = []
-        for c, is_dt in zip(cols, mM_flags):
-            if is_dt:
-                na = c == _NAT_SENTINEL
-            elif jnp.issubdtype(c.dtype, jnp.floating):
-                na = jnp.isnan(c)
-            else:
-                na = jnp.zeros(c.shape, bool)
-            out.append(~na if negate else na)
-        return tuple(out)
-
-    return jax.jit(fn)
-
-
 def isna_columns(cols: List[Any], mM_flags: Tuple[bool, ...], negate: bool) -> List[Any]:
-    """isna/notna with NaT-sentinel awareness for datetime-backed columns."""
-    return list(_jit_isna(len(cols), tuple(mM_flags), bool(negate))(tuple(cols)))
+    """Deferred isna/notna, NaT-sentinel-aware for datetime-backed columns."""
+    from modin_tpu.ops.lazy import lazy_op
 
-
-@functools.lru_cache(maxsize=None)
-def _jit_round(n_cols: int):
-    import jax
-    import jax.numpy as jnp
-
-    def fn(cols: Tuple, decimals: int) -> Tuple:
-        return tuple(
-            jnp.round(c, decimals) if jnp.issubdtype(c.dtype, jnp.floating) else c
-            for c in cols
-        )
-
-    return jax.jit(fn, static_argnums=1)
+    _ensure_ops()
+    out = []
+    for c, is_dt in zip(cols, mM_flags):
+        if is_dt:
+            out.append(lazy_op("notna_nat" if negate else "isna_nat", c))
+        else:
+            out.append(lazy_op("notna" if negate else "isna", c))
+    return out
 
 
 def round_columns(cols: List[Any], decimals: int) -> List[Any]:
-    return list(_jit_round(len(cols))(tuple(cols), int(decimals)))
+    from modin_tpu.ops.lazy import lazy_op
 
-
-@functools.lru_cache(maxsize=None)
-def _jit_fillna(n_cols: int):
-    import jax
-    import jax.numpy as jnp
-
-    def fn(cols: Tuple, value: Any) -> Tuple:
-        out = []
-        for c in cols:
-            if jnp.issubdtype(c.dtype, jnp.floating):
-                out.append(jnp.where(jnp.isnan(c), value, c))
-            else:
-                out.append(c)
-        return tuple(out)
-
-    return jax.jit(fn)
+    _ensure_ops()
+    static = (("decimals", int(decimals)),)
+    return [lazy_op("round", c, static=static) for c in cols]
 
 
 def fillna_columns(cols: List[Any], value: Any) -> List[Any]:
-    return list(_jit_fillna(len(cols))(tuple(cols), value))
+    from modin_tpu.ops.lazy import lazy_op
 
-
-@functools.lru_cache(maxsize=None)
-def _jit_clip(n_cols: int, has_lower: bool, has_upper: bool):
-    import jax
-    import jax.numpy as jnp
-
-    def fn(cols: Tuple, lower: Any, upper: Any) -> Tuple:
-        out = []
-        for c in cols:
-            r = c
-            if has_lower:
-                r = jnp.where(r < lower, lower, r)
-            if has_upper:
-                r = jnp.where(r > upper, upper, r)
-            out.append(r)
-        return tuple(out)
-
-    return jax.jit(fn)
+    _ensure_ops()
+    return [lazy_op("fillna", c, value) for c in cols]
 
 
 def clip_columns(cols: List[Any], lower: Any, upper: Any) -> List[Any]:
-    fn = _jit_clip(len(cols), lower is not None, upper is not None)
-    return list(fn(tuple(cols), 0 if lower is None else lower, 0 if upper is None else upper))
+    from modin_tpu.ops.lazy import lazy_op
+
+    _ensure_ops()
+    out = []
+    for c in cols:
+        r = c
+        if lower is not None:
+            r = lazy_op("clip_lower", r, lower)
+        if upper is not None:
+            r = lazy_op("clip_upper", r, upper)
+        out.append(r)
+    return out
 
 
 @functools.lru_cache(maxsize=None)

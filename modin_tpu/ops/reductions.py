@@ -126,28 +126,44 @@ def _int_min(dtype):
     return np.iinfo(np.dtype(str(dtype))).min
 
 
-@functools.lru_cache(maxsize=None)
-def _jit_reduce(op_name: str, n_cols: int, n: int, skipna: bool, ddof: int):
+def reduce_columns(
+    op_name: str,
+    cols: List[Any],
+    n: int,
+    skipna: bool = True,
+    ddof: int = 1,
+    cast_bool: bool = False,
+) -> list:
+    """Reduce each padded column (logical length n) to a scalar; one fetch.
+
+    ``cols`` may mix concrete arrays and deferred LazyExprs — the reduction
+    traces as a *tail* of the fused program (ops/lazy.py), so a chain like
+    ``(a * b + c).sum()`` compiles to one kernel.  ``cast_bool`` applies the
+    pandas bool->int promotion for arithmetic aggregations inside the fusion.
+    """
     import jax
 
-    def fn(cols: Tuple) -> Tuple:
-        return tuple(_reduce_one(op_name, c, n, skipna, ddof) for c in cols)
+    from modin_tpu.ops.lazy import run_fused
 
-    return jax.jit(fn)
+    n, skipna, ddof = int(n), bool(skipna), int(ddof)
 
+    def tail(arrs):
+        import jax.numpy as jnp
 
-def reduce_columns(op_name: str, cols: List[Any], n: int, skipna: bool = True, ddof: int = 1) -> list:
-    """Reduce each padded column (logical length n) to a scalar; one fetch."""
-    import jax
+        if cast_bool:
+            arrs = [a.astype(jnp.int64) if a.dtype == jnp.bool_ else a for a in arrs]
+        return tuple(_reduce_one(op_name, c, n, skipna, ddof) for c in arrs)
 
-    fn = _jit_reduce(op_name, len(cols), int(n), bool(skipna), int(ddof))
-    results = fn(tuple(cols))
+    results = run_fused(
+        cols,
+        tail_key=("reduce", op_name, n, skipna, ddof, bool(cast_bool)),
+        tail_builder=tail,
+    )
     return [np.asarray(r) for r in jax.device_get(results)]
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_reduce_axis1(op_name: str, n_cols: int, skipna: bool, ddof: int):
-    import jax
+def _make_axis1_fn(op_name: str, n_cols: int, skipna: bool, ddof: int):
     import jax.numpy as jnp
 
     def fn(cols: Tuple):
@@ -182,13 +198,37 @@ def _jit_reduce_axis1(op_name: str, n_cols: int, skipna: bool, ddof: int):
             return jnp.nanstd(x, axis=0, ddof=ddof)
         raise ValueError(op_name)
 
-    return jax.jit(fn)
+    return fn
 
 
-def reduce_axis1(op_name: str, cols: List[Any], skipna: bool = True, ddof: int = 1) -> Any:
-    """Row-wise reduction across columns; returns a padded device 1-D array."""
-    fn = _jit_reduce_axis1(op_name, len(cols), bool(skipna), int(ddof))
-    return fn(tuple(cols))
+def reduce_axis1(
+    op_name: str,
+    cols: List[Any],
+    skipna: bool = True,
+    ddof: int = 1,
+    cast_bool: bool = False,
+) -> Any:
+    """Row-wise reduction across columns; returns a padded device 1-D array.
+
+    Accepts deferred LazyExprs like :func:`reduce_columns` (fused tail).
+    """
+    from modin_tpu.ops.lazy import run_fused
+
+    skipna, ddof = bool(skipna), int(ddof)
+    inner = _make_axis1_fn(op_name, len(cols), skipna, ddof)
+
+    def tail(arrs):
+        import jax.numpy as jnp
+
+        if cast_bool:
+            arrs = [a.astype(jnp.int64) if a.dtype == jnp.bool_ else a for a in arrs]
+        return inner(tuple(arrs))
+
+    return run_fused(
+        cols,
+        tail_key=("reduce_axis1", op_name, skipna, ddof, bool(cast_bool)),
+        tail_builder=tail,
+    )
 
 
 @functools.lru_cache(maxsize=None)
