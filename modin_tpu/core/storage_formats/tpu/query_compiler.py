@@ -1174,7 +1174,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
         if axis != 0 or agg_args:
             return None
-        if not isinstance(agg_func, str) or agg_func not in gb_ops.SEGMENT_AGGS:
+        if not isinstance(agg_func, str) or agg_func not in (
+            gb_ops.SEGMENT_AGGS | gb_ops.ORDER_AGGS
+        ):
             return None
         if groupby_kwargs.get("level") is not None:
             return None
@@ -1190,11 +1192,34 @@ class TpuQueryCompiler(BaseQueryCompiler):
         if agg_kwargs.get("skipna", True) is not True:
             return None
         ddof = int(agg_kwargs.get("ddof", 1))
-        extra = set(agg_kwargs) - {"numeric_only", "min_count", "ddof", "skipna", "engine", "engine_kwargs"}
+        extra = set(agg_kwargs) - {
+            "numeric_only", "min_count", "ddof", "skipna", "engine",
+            "engine_kwargs", "q", "interpolation", "dropna",
+        }
         if extra:
             return None
         if agg_kwargs.get("engine") not in (None, "cython"):
             return None
+        # order-statistic agg parameters
+        if agg_func == "quantile":
+            qval = agg_kwargs.get("q", 0.5)
+            if not isinstance(qval, (int, float, np.integer, np.floating)):
+                return None  # list-of-q builds a MultiIndex result: fall back
+            if not (0 <= float(qval) <= 1):
+                return None  # pandas raises "Each 'q' must be between 0 and 1"
+            interp = agg_kwargs.get("interpolation", "linear")
+            if interp not in ("linear", "lower", "higher", "midpoint", "nearest"):
+                return None
+        elif "q" in agg_kwargs or "interpolation" in agg_kwargs:
+            return None
+        else:
+            qval, interp = 0.5, "linear"
+        if agg_func == "nunique":
+            values_dropna = bool(agg_kwargs.get("dropna", True))
+        elif "dropna" in agg_kwargs:
+            return None
+        else:
+            values_dropna = True
 
         frame = self._modin_frame
 
@@ -1284,13 +1309,35 @@ class TpuQueryCompiler(BaseQueryCompiler):
         out_dtypes = []
         for c in value_cols:
             a = c.data
-            if a.dtype == jnp.bool_ and agg_func in ("sum", "prod", "mean", "var", "std", "sem"):
-                a = a.astype(jnp.int64)
+            if a.dtype == jnp.bool_:
+                if agg_func == "quantile":
+                    return None  # pandas: "Cannot use quantile with bool dtype"
+                if agg_func in (
+                    "sum", "prod", "mean", "var", "std", "sem", "median"
+                ):
+                    a = a.astype(jnp.int64)
             arrays.append(a)
         if agg_func == "size":
             datas = gb_ops.groupby_reduce("size", [], codes, n_groups, len(frame))
             value_labels = [MODIN_UNNAMED_SERIES_LABEL]
             out_dtypes = [np.dtype(np.int64)]
+        elif agg_func in ("median", "quantile"):
+            datas = gb_ops.groupby_quantile(
+                arrays, codes, n_groups, len(frame),
+                q=float(qval), interpolation=interp,
+            )
+            # lower/higher/nearest keep the integer dtype (pandas semantics)
+            out_dtypes = [np.dtype(d.dtype) for d in datas]
+        elif agg_func == "nunique":
+            datas = gb_ops.groupby_nunique(
+                arrays, codes, n_groups, len(frame), dropna=values_dropna
+            )
+            out_dtypes = [np.dtype(np.int64)] * len(datas)
+        elif agg_func in ("first", "last"):
+            datas = gb_ops.groupby_first_last(
+                agg_func, arrays, codes, n_groups, len(frame)
+            )
+            out_dtypes = [np.dtype(d.dtype) for d in datas]
         else:
             datas = gb_ops.groupby_reduce(
                 agg_func, arrays, codes, n_groups, len(frame), ddof=ddof

@@ -30,11 +30,27 @@ SEGMENT_AGGS = {
     "any", "all", "sem",
 }
 
+# order-statistic aggregations: device sort within groups (the reference
+# routes these through range-partitioning + per-shard pandas,
+# modin/core/dataframe/pandas/dataframe/dataframe.py:4163; on TPU a
+# lexsort + gather keeps the whole thing on device)
+ORDER_AGGS = {"median", "quantile", "nunique", "first", "last"}
+
 _RANGE_LIMIT = 1 << 22  # max direct-range width before falling back to unique
 
 
 class _TooManyGroups(Exception):
     pass
+
+
+def _slice_pad(r, n_groups: int, p_out: int):
+    """Slice off the overflow bucket and pad the result to the shard multiple."""
+    import jax.numpy as jnp
+
+    r = r[:n_groups]
+    if p_out > n_groups:
+        r = jnp.concatenate([r, jnp.zeros(p_out - n_groups, r.dtype)])
+    return r
 
 
 @functools.lru_cache(maxsize=None)
@@ -309,10 +325,7 @@ def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_out:
     n_groups = num_segments - 1
 
     def finish(r):
-        r = r[:n_groups]
-        if p_out > n_groups:
-            r = jnp.concatenate([r, jnp.zeros(p_out - n_groups, r.dtype)])
-        return r
+        return _slice_pad(r, n_groups, p_out)
 
     def seg(c, codes):
         is_f = jnp.issubdtype(c.dtype, jnp.floating)
@@ -523,10 +536,7 @@ def _jit_masked_scan_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_
 
         # finalize per column
         def finish(r):
-            r = r[:n_groups]
-            if p_out > n_groups:
-                r = jnp.concatenate([r, jnp.zeros(p_out - n_groups, r.dtype)])
-            return r
+            return _slice_pad(r, n_groups, p_out)
 
         out = []
         ci = 0
@@ -628,4 +638,186 @@ def groupby_reduce(
         fn = _jit_masked_scan_agg(agg, len(value_cols), ns, int(ddof), p_out, _SCAN_CHUNK)
         return list(fn(tuple(value_cols), codes))
     fn = _jit_segment_agg(agg, len(value_cols), ns, int(ddof), p_out)
+    return list(fn(tuple(value_cols), codes))
+
+
+# ---------------------------------------------------------------------- #
+# Order-statistic aggregations (median / quantile / nunique / first / last)
+# ---------------------------------------------------------------------- #
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_group_quantile(
+    n_cols: int, num_segments: int, p_out: int, q: float, interpolation: str
+):
+    """Grouped quantile: lexsort by (code, value), gather at quantile ranks.
+
+    NaNs sort to each group's tail (jnp sort order), so the non-NaN prefix of
+    a group is its valid sample; ranks index into that prefix.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_groups = num_segments - 1
+
+    def finish(r):
+        return _slice_pad(r, n_groups, p_out)
+
+    def one(c, codes, starts):
+        # pandas keeps the integer dtype for the non-interpolating kinds
+        keep_int = (
+            interpolation in ("lower", "higher", "nearest")
+            and not jnp.issubdtype(c.dtype, jnp.floating)
+        )
+        x = c if keep_int else c.astype(jnp.float64)
+        nanm = (
+            jnp.isnan(x) if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.zeros(x.shape, bool)
+        )
+        order = jnp.lexsort((x, codes))
+        xs = jnp.take(x, order)
+        vcnt = jax.ops.segment_sum(
+            (~nanm).astype(jnp.int64), codes, num_segments=num_segments
+        )[:n_groups]
+        g_start = starts[:n_groups]
+        target = q * (vcnt.astype(jnp.float64) - 1.0)
+        lo = jnp.floor(target).astype(jnp.int64)
+        hi = jnp.ceil(target).astype(jnp.int64)
+        max_pos = xs.shape[0] - 1
+        v_lo = jnp.take(xs, jnp.clip(g_start + lo, 0, max_pos))
+        v_hi = jnp.take(xs, jnp.clip(g_start + hi, 0, max_pos))
+        frac = target - lo.astype(jnp.float64)
+        if interpolation == "linear":
+            r = v_lo + (v_hi - v_lo) * frac
+        elif interpolation == "lower":
+            r = v_lo
+        elif interpolation == "higher":
+            r = v_hi
+        elif interpolation == "midpoint":
+            r = (v_lo + v_hi) * 0.5
+        else:  # nearest — numpy rounds the virtual rank half-to-even
+            pos = jnp.round(target).astype(jnp.int64)
+            r = jnp.take(xs, jnp.clip(g_start + pos, 0, max_pos))
+        if not keep_int:
+            r = jnp.where(vcnt == 0, jnp.nan, r)
+        return finish(r)
+
+    def fn(cols: Tuple, codes):
+        total = jax.ops.segment_sum(
+            jnp.ones(codes.shape, jnp.int64), codes, num_segments=num_segments
+        )
+        starts = jnp.cumsum(total) - total
+        return tuple(one(c, codes, starts) for c in cols)
+
+    return jax.jit(fn)
+
+
+def groupby_quantile(
+    value_cols: List[Any],
+    codes: Any,
+    num_groups: int,
+    n: int,
+    q: float = 0.5,
+    interpolation: str = "linear",
+) -> List[Any]:
+    """Per-group quantile of each value column (device lexsort + gather)."""
+    from modin_tpu.ops.structural import pad_len
+
+    fn = _jit_group_quantile(
+        len(value_cols), num_groups + 1, pad_len(num_groups), float(q), str(interpolation)
+    )
+    return list(fn(tuple(value_cols), codes))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_group_nunique(n_cols: int, num_segments: int, p_out: int, dropna: bool):
+    """Grouped distinct-count: lexsort by (code, value), count run heads."""
+    import jax
+    import jax.numpy as jnp
+
+    n_groups = num_segments - 1
+
+    def finish(r):
+        return _slice_pad(r, n_groups, p_out)
+
+    def one(c, codes):
+        is_f = jnp.issubdtype(c.dtype, jnp.floating)
+        nanm = jnp.isnan(c) if is_f else jnp.zeros(c.shape, bool)
+        order = jnp.lexsort((c, codes))
+        xs = jnp.take(c, order)
+        cs = jnp.take(codes, order)
+        nm = jnp.take(nanm, order)
+        newgrp = jnp.concatenate([jnp.ones(1, bool), cs[1:] != cs[:-1]])
+        newval = jnp.concatenate([jnp.ones(1, bool), xs[1:] != xs[:-1]])
+        head = (newgrp | newval) & ~nm
+        cnt = jax.ops.segment_sum(
+            head.astype(jnp.int64), cs, num_segments=num_segments
+        )
+        if not dropna:
+            has_nan = jax.ops.segment_max(
+                nanm.astype(jnp.int64), codes, num_segments=num_segments
+            )
+            cnt = cnt + has_nan
+        return finish(cnt)
+
+    def fn(cols: Tuple, codes):
+        return tuple(one(c, codes) for c in cols)
+
+    return jax.jit(fn)
+
+
+def groupby_nunique(
+    value_cols: List[Any], codes: Any, num_groups: int, n: int, dropna: bool = True
+) -> List[Any]:
+    from modin_tpu.ops.structural import pad_len
+
+    fn = _jit_group_nunique(
+        len(value_cols), num_groups + 1, pad_len(num_groups), bool(dropna)
+    )
+    return list(fn(tuple(value_cols), codes))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_group_first_last(last: bool, n_cols: int, num_segments: int, p_out: int):
+    """Grouped first/last non-NaN value in row order (segment arg-extremum)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_groups = num_segments - 1
+
+    def finish(r):
+        return _slice_pad(r, n_groups, p_out)
+
+    def one(c, codes):
+        is_f = jnp.issubdtype(c.dtype, jnp.floating)
+        P = c.shape[0]
+        valid = ~jnp.isnan(c) if is_f else jnp.ones(c.shape, bool)
+        iota = jnp.arange(P, dtype=jnp.int64)
+        if last:
+            key = jnp.where(valid, iota, -1)
+            idx = jax.ops.segment_max(key, codes, num_segments=num_segments)
+            has = idx >= 0
+        else:
+            key = jnp.where(valid, iota, P)
+            idx = jax.ops.segment_min(key, codes, num_segments=num_segments)
+            has = idx < P
+        vals = jnp.take(c, jnp.clip(idx, 0, P - 1))
+        if is_f:
+            vals = jnp.where(has, vals, jnp.nan)
+        return finish(vals)
+
+    def fn(cols: Tuple, codes):
+        return tuple(one(c, codes) for c in cols)
+
+    return jax.jit(fn)
+
+
+def groupby_first_last(
+    agg: str, value_cols: List[Any], codes: Any, num_groups: int, n: int
+) -> List[Any]:
+    from modin_tpu.ops.structural import pad_len
+
+    fn = _jit_group_first_last(
+        agg == "last", len(value_cols), num_groups + 1, pad_len(num_groups)
+    )
     return list(fn(tuple(value_cols), codes))

@@ -35,43 +35,74 @@ def _valid_mask(c, n):
     return jnp.arange(c.shape[0]) < n
 
 
-def _reduce_one(op: str, c, n: int, skipna: bool, ddof: int):
-    """Reduce one padded column with logical length n."""
+def _reduce_one(op: str, c, n: int, skipna: bool, ddof: int, adaptive: bool = False):
+    """Reduce one padded column with logical length n.
+
+    When the column is unpadded (shape == n, the common case: lengths that
+    divide the shard count evenly), the validity iota-mask is skipped — on
+    clean data that leaves a single fused pass over the column.
+
+    ``adaptive`` additionally enables the NaN-adaptive lax.cond fast path;
+    only valid on single-shard meshes (SPMD partitioning of lax.cond over
+    sharded operands produces wrong values — observed on the virtual CPU
+    mesh), which is exactly the single-chip bench topology where it matters.
+    """
     import jax.numpy as jnp
 
     is_f = jnp.issubdtype(c.dtype, jnp.floating)
-    valid = _valid_mask(c, n)
-    nan_mask = jnp.isnan(c) & valid if is_f else jnp.zeros(c.shape, bool)
-    use = valid & ~nan_mask if (skipna and is_f) else valid
-    n_use = jnp.sum(use)
+    unpadded = c.shape[0] == n
+    if adaptive and unpadded and is_f and skipna and n > 0:
+        fast = _reduce_clean_adaptive(op, c, n, ddof)
+        if fast is not None:
+            return fast
+    # unpadded columns (lengths dividing the shard count) elide the iota
+    # validity mask — clean int/float reductions become a single fused pass
+    if unpadded:
+        valid = None
+        nan_mask = jnp.isnan(c) if is_f else None
+        use = ~nan_mask if (skipna and is_f) else None
+        n_use = jnp.sum(use) if use is not None else jnp.asarray(n, jnp.int64)
+    else:
+        valid = _valid_mask(c, n)
+        nan_mask = jnp.isnan(c) & valid if is_f else None
+        use = valid & ~nan_mask if (skipna and is_f) else valid
+        n_use = jnp.sum(use)
+
+    def sel(x, neutral):
+        return x if use is None else jnp.where(use, x, neutral)
+
+    def sel_valid(x, neutral):
+        return x if valid is None else jnp.where(valid, x, neutral)
 
     if op == "count":
-        return jnp.sum(valid & ~nan_mask).astype(jnp.int64)
+        if nan_mask is None:
+            return jnp.asarray(n, jnp.int64)
+        return jnp.sum(sel_valid(~nan_mask, False)).astype(jnp.int64)
     if op == "sum":
-        return jnp.sum(jnp.where(use, c, 0))
+        return jnp.sum(sel(c, 0))
     if op == "prod":
-        return jnp.prod(jnp.where(use, c, 1))
+        return jnp.prod(sel(c, 1))
     if op == "min":
         if is_f:
-            r = jnp.min(jnp.where(use, c, jnp.inf))
-            any_nan = jnp.any(nan_mask & valid) & (not skipna)
+            r = jnp.min(sel(c, jnp.inf))
+            any_nan = jnp.any(nan_mask) & (not skipna)
             return jnp.where(jnp.isinf(r) & (n_use == 0), jnp.nan, jnp.where(any_nan, jnp.nan, r))
-        return jnp.min(jnp.where(use, c, _int_max(c.dtype)))
+        return jnp.min(sel(c, _int_max(c.dtype)))
     if op == "max":
         if is_f:
-            r = jnp.max(jnp.where(use, c, -jnp.inf))
-            any_nan = jnp.any(nan_mask & valid) & (not skipna)
+            r = jnp.max(sel(c, -jnp.inf))
+            any_nan = jnp.any(nan_mask) & (not skipna)
             return jnp.where(jnp.isinf(-r) & (n_use == 0), jnp.nan, jnp.where(any_nan, jnp.nan, r))
-        return jnp.max(jnp.where(use, c, _int_min(c.dtype)))
+        return jnp.max(sel(c, _int_min(c.dtype)))
     if op in ("mean", "var", "std", "sem", "skew", "kurt"):
-        x = jnp.where(use, c, 0).astype(jnp.float64)
+        x = sel(c, 0).astype(jnp.float64)
         s = jnp.sum(x)
         mean = s / n_use
         if op == "mean":
             if is_f and not skipna:
                 return jnp.where(jnp.any(nan_mask), jnp.nan, mean)
             return jnp.where(n_use == 0, jnp.nan, mean)
-        d = jnp.where(use, x - mean, 0.0)
+        d = sel(x - mean, 0.0)
         m2s = jnp.sum(d**2)
         if op in ("var", "std", "sem"):
             var = m2s / jnp.maximum(n_use - ddof, 1)
@@ -99,15 +130,94 @@ def _reduce_one(op: str, c, n: int, skipna: bool, ddof: int):
             res = jnp.where(jnp.any(nan_mask), jnp.nan, res)
         return res
     if op == "median":
-        x = jnp.where(use, c, jnp.nan).astype(jnp.float64)
+        x = sel(c, jnp.nan).astype(jnp.float64)
         return jnp.nanmedian(x)
     if op == "any":
         truthy = jnp.where(nan_mask, not skipna, c != 0) if is_f else (c != 0 if c.dtype != jnp.bool_ else c)
-        return jnp.any(truthy & valid)
+        return jnp.any(sel_valid(truthy, False))
     if op == "all":
         truthy = jnp.where(nan_mask, True, c != 0) if is_f else (c != 0 if c.dtype != jnp.bool_ else c)
-        return jnp.all(truthy | ~valid)
+        return jnp.all(sel_valid(truthy, True))
     raise ValueError(op)
+
+
+def _reduce_clean_adaptive(op: str, c, n: int, ddof: int):
+    """NaN-adaptive float reduction: run the unmasked single-pass kernel and
+    fall into the masked path (via lax.cond) only when the result shows a NaN
+    actually occurred.  On clean data — the common case — the select/masking
+    passes are skipped entirely (measured ~4x on XLA CPU, where jnp.sum
+    beats pandas but where+sum does not).  Returns None for ops without an
+    adaptive form.
+    """
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    def masked(neutral):
+        return jnp.where(jnp.isnan(c), neutral, c)
+
+    def n_use():
+        return n - jnp.sum(jnp.isnan(c))
+
+    if op == "sum":
+        s = jnp.sum(c)
+        return lax.cond(jnp.isnan(s), lambda: jnp.sum(masked(0.0)), lambda: s)
+    if op == "prod":
+        p = jnp.prod(c)
+        return lax.cond(jnp.isnan(p), lambda: jnp.prod(masked(1.0)), lambda: p)
+    if op == "count":
+        return (n - jnp.sum(jnp.isnan(c))).astype(jnp.int64)
+    if op in ("min", "max"):
+        reducer = jnp.min if op == "min" else jnp.max
+        r = reducer(c)
+
+        def dirty():
+            neutral = jnp.inf if op == "min" else -jnp.inf
+            m = reducer(masked(neutral))
+            # all-NaN group: masked reduce returns the neutral infinity
+            return jnp.where(n_use() == 0, jnp.nan, m)
+
+        return lax.cond(jnp.isnan(r), dirty, lambda: r)
+    # mean/var family accumulates in float64, matching the masked path
+    x64 = c.astype(jnp.float64)
+    if op == "mean":
+        s = jnp.sum(x64)
+
+        def dirty():
+            k = n_use()
+            return jnp.where(
+                k == 0, jnp.nan, jnp.sum(jnp.where(jnp.isnan(x64), 0.0, x64)) / k
+            )
+
+        return lax.cond(jnp.isnan(s), dirty, lambda: s / n)
+    if op in ("var", "std", "sem"):
+        s = jnp.sum(x64)
+
+        def clean():
+            mean = s / n
+            d = x64 - mean
+            var = jnp.sum(d * d) / max(n - ddof, 1)
+            return var if n - ddof > 0 else jnp.full((), jnp.nan)
+
+        def dirty():
+            nanm = jnp.isnan(x64)
+            k = n_use()
+            x = jnp.where(nanm, 0.0, x64)
+            mean = jnp.sum(x) / k
+            d = jnp.where(nanm, 0.0, x - mean)
+            var = jnp.sum(d * d) / jnp.maximum(k - ddof, 1)
+            return jnp.where(k - ddof > 0, var, jnp.nan)
+
+        var = lax.cond(jnp.isnan(s), dirty, clean)
+        if op == "var":
+            return var
+        if op == "std":
+            return jnp.sqrt(var)
+        k = lax.cond(
+            jnp.isnan(s), lambda: n_use().astype(jnp.int64),
+            lambda: jnp.asarray(n, jnp.int64),
+        )
+        return jnp.sqrt(var / k)
+    return None
 
 
 def _int_max(dtype):
@@ -144,19 +254,21 @@ def reduce_columns(
     import jax
 
     from modin_tpu.ops.lazy import run_fused
+    from modin_tpu.parallel.mesh import num_row_shards
 
     n, skipna, ddof = int(n), bool(skipna), int(ddof)
+    adaptive = num_row_shards() == 1
 
     def tail(arrs):
         import jax.numpy as jnp
 
         if cast_bool:
             arrs = [a.astype(jnp.int64) if a.dtype == jnp.bool_ else a for a in arrs]
-        return tuple(_reduce_one(op_name, c, n, skipna, ddof) for c in arrs)
+        return tuple(_reduce_one(op_name, c, n, skipna, ddof, adaptive) for c in arrs)
 
     results = run_fused(
         cols,
-        tail_key=("reduce", op_name, n, skipna, ddof, bool(cast_bool)),
+        tail_key=("reduce", op_name, n, skipna, ddof, bool(cast_bool), adaptive),
         tail_builder=tail,
     )
     return [np.asarray(r) for r in jax.device_get(results)]
