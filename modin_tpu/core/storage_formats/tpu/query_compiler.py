@@ -958,12 +958,17 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return super().merge(right, **kwargs)
 
     def _try_device_merge(self, right: Any, kwargs: dict) -> Optional["TpuQueryCompiler"]:
-        from modin_tpu.ops.join import gather_right_columns, sort_merge_positions
+        from modin_tpu.ops.join import (
+            composite_key_codes,
+            gather_right_columns,
+            right_only_positions,
+            sort_merge_positions,
+        )
         from modin_tpu.ops.structural import gather_columns_device
         from modin_tpu.utils import hashable
 
         how = kwargs.get("how", "inner")
-        if how not in ("inner", "left"):
+        if how not in ("inner", "left", "right", "outer"):
             return None
         if (
             kwargs.get("left_index")
@@ -974,76 +979,56 @@ class TpuQueryCompiler(BaseQueryCompiler):
             or not isinstance(right, TpuQueryCompiler)
         ):
             return None
+
+        # ---- resolve key label pairs (multi-key capable) ---------------- #
         on = kwargs.get("on")
         left_on = kwargs.get("left_on")
         right_on = kwargs.get("right_on")
+
+        def as_list(x):
+            return list(x) if isinstance(x, list) else [x]
+
         if on is not None:
-            if isinstance(on, list):
-                if len(on) != 1:
-                    return None
-                on = on[0]
-            left_label = right_label = on
+            l_labels = r_labels = as_list(on)
         elif left_on is not None and right_on is not None:
-            left_label = left_on[0] if isinstance(left_on, list) and len(left_on) == 1 else left_on
-            right_label = right_on[0] if isinstance(right_on, list) and len(right_on) == 1 else right_on
-            if isinstance(left_label, list) or isinstance(right_label, list):
+            l_labels, r_labels = as_list(left_on), as_list(right_on)
+            if len(l_labels) != len(r_labels):
                 return None
-            if not hashable(left_label) or not hashable(right_label):
-                return None  # array-like keys take the pandas fallback
-            if left_label == right_label:
-                # pandas collapses identical left_on/right_on to one column
-                on = left_label
         else:
             return None
-        if not hashable(left_label) or not hashable(right_label):
-            return None
+        if not all(hashable(x) for x in l_labels + r_labels):
+            return None  # array-like keys take the pandas fallback
+        # pandas collapses a key pair with identical labels into one column
+        coalesce = [ll == rl for ll, rl in zip(l_labels, r_labels)]
 
         lframe, rframe = self._modin_frame, right._modin_frame
         if not lframe.columns.is_unique or not rframe.columns.is_unique:
             return None
-        lpos = lframe.column_position(left_label)
-        rpos = rframe.column_position(right_label)
-        if len(lpos) != 1 or lpos[0] < 0 or len(rpos) != 1 or rpos[0] < 0:
-            return None
-        lkey_col = lframe.get_column(lpos[0])
-        rkey_col = rframe.get_column(rpos[0])
-        if not (
-            lkey_col.is_device
-            and rkey_col.is_device
-            and lkey_col.pandas_dtype.kind in "biuf"
-            and rkey_col.pandas_dtype.kind in "biuf"
-            and lkey_col.pandas_dtype.kind == rkey_col.pandas_dtype.kind
-        ):
-            return None
+        lkey_positions, rkey_positions = [], []
+        for ll, rl in zip(l_labels, r_labels):
+            lp = lframe.column_position(ll)
+            rp = rframe.column_position(rl)
+            if len(lp) != 1 or lp[0] < 0 or len(rp) != 1 or rp[0] < 0:
+                return None
+            lkey_positions.append(lp[0])
+            rkey_positions.append(rp[0])
+        for lp, rp in zip(lkey_positions, rkey_positions):
+            lc, rc = lframe.get_column(lp), rframe.get_column(rp)
+            if not (
+                lc.is_device and rc.is_device
+                and lc.pandas_dtype.kind in "biuf"
+                # exact dtype match: same-kind different-width keys (int32 vs
+                # int64) would mix sides' data under one declared dtype in the
+                # coalesced right/outer paths — pandas promotes, so fall back
+                and lc.pandas_dtype == rc.pandas_dtype
+            ):
+                return None
         if len(lframe) == 0 or len(rframe) == 0:
             return None
         if not all(c.is_device for c in lframe._columns) or not all(
             c.is_device for c in rframe._columns
         ):
             return None
-        # left-join misses turn right bool columns into object dtype — fallback
-        right_value_positions = [
-            i for i in range(rframe.num_cols)
-            if not (on is not None and i == rpos[0])
-        ]
-        if how == "left" and any(
-            rframe.get_column(i).pandas_dtype.kind == "b"
-            for i in right_value_positions
-        ):
-            return None
-
-        left_pos, right_pos, n_out, has_miss = sort_merge_positions(
-            lkey_col.data, rkey_col.data, len(lframe), len(rframe), how=how
-        )
-
-        import jax.numpy as jnp
-
-        # gather left columns
-        lframe.materialize_device()
-        rframe.materialize_device()
-        left_datas = gather_columns_device(
-            [c.data for c in lframe._columns], left_pos
-        )
         suffixes = kwargs.get("suffixes") or ("_x", "_y")
         if (
             not isinstance(suffixes, (tuple, list))
@@ -1051,41 +1036,197 @@ class TpuQueryCompiler(BaseQueryCompiler):
             or not all(isinstance(sfx, str) and sfx for sfx in suffixes)
         ):
             return None  # None/empty suffixes have pandas-specific semantics
+
+        # the right key column disappears from the output for coalesced pairs
+        coalesced_rkeys = {
+            rp for rp, co in zip(rkey_positions, coalesce) if co
+        }
+        coalesced_lkeys = {
+            lp for lp, co in zip(lkey_positions, coalesce) if co
+        }
+        lkey_to_rkey = {
+            lp: rp for lp, rp, co in zip(lkey_positions, rkey_positions, coalesce) if co
+        }
+        if how == "outer" and not all(coalesce):
+            # pandas sorts an outer result by the join key tuple; with
+            # distinct left_on/right_on labels the key lives in two columns —
+            # keep that shape on the pandas fallback
+            return None
+        right_value_positions = [
+            i for i in range(rframe.num_cols) if i not in coalesced_rkeys
+        ]
+        # null-side bool columns become object dtype in pandas — fallback
+        if how in ("left", "outer") and any(
+            rframe.get_column(i).pandas_dtype.kind == "b"
+            for i in right_value_positions
+        ):
+            return None
+        if how in ("right", "outer") and any(
+            lframe.get_column(i).pandas_dtype.kind == "b"
+            for i in range(lframe.num_cols)
+            if i not in coalesced_lkeys
+        ):
+            return None
+
+        lframe.materialize_device()
+        rframe.materialize_device()
+
+        # ---- key codes -------------------------------------------------- #
+        if len(lkey_positions) == 1:
+            lkey = lframe.get_column(lkey_positions[0]).data
+            rkey = rframe.get_column(rkey_positions[0]).data
+        else:
+            lkey, rkey = composite_key_codes(
+                [lframe.get_column(p).data for p in lkey_positions],
+                [rframe.get_column(p).data for p in rkey_positions],
+            )
+
+        # ---- match positions -------------------------------------------- #
+        if how == "right":
+            # probe from the right side: output rows follow right order and
+            # the left side is the nullable one
+            rprobe_left, rprobe_right, n_out, has_miss = sort_merge_positions(
+                rkey, lkey, len(rframe), len(lframe), how="left"
+            )
+            left_pos, right_pos = rprobe_right, rprobe_left
+        else:
+            probe_how = "left" if how in ("left", "outer") else "inner"
+            left_pos, right_pos, n_out, has_miss = sort_merge_positions(
+                lkey, rkey, len(lframe), len(rframe), how=probe_how
+            )
+
+        import jax.numpy as jnp
+
+        # outer: right rows the left join missed get appended
+        appendix_positions, n_appendix = None, 0
+        if how == "outer":
+            appendix_positions, n_appendix = right_only_positions(
+                right_pos, rframe.get_column(0).data.shape[0], len(rframe),
+                n_out,
+            )
+        left_has_nulls = (how == "right" and has_miss) or n_appendix > 0
+        right_has_nulls = how in ("left", "outer") and has_miss
+        n_total = n_out + n_appendix
+
+        # ---- gather + assemble ------------------------------------------ #
+        if how == "right":
+            left_datas = gather_right_columns(
+                [c.data for c in lframe._columns], left_pos
+            )
+        else:
+            left_datas = gather_columns_device(
+                [c.data for c in lframe._columns], left_pos
+            )
         suffix_l, suffix_r = suffixes
         right_labels_set = {rframe.columns[i] for i in right_value_positions}
         new_cols: list = []
         new_labels: list = []
+        key_appendix: dict = {}
+        if n_appendix > 0:
+            # appendix values for coalesced key columns come from the right key
+            for lp, rp, co in zip(lkey_positions, rkey_positions, coalesce):
+                if co:
+                    key_appendix[lp] = rframe.get_column(rp).data
         for i, (col, data) in enumerate(zip(lframe._columns, left_datas)):
             label = lframe.columns[i]
-            if label in right_labels_set and not (on is not None and i == lpos[0]):
+            if label in right_labels_set and i not in coalesced_lkeys:
                 label = f"{label}{suffix_l}"
-            new_cols.append(DeviceColumn(data, col.pandas_dtype, length=n_out))
+            dtype = col.pandas_dtype
+            if how == "right" and i in lkey_to_rkey:
+                # coalesced key: every output row is a right row, so the key
+                # value comes from the (always-valid) right side
+                data = gather_columns_device(
+                    [rframe.get_column(lkey_to_rkey[i]).data], right_pos
+                )[0]
+            if left_has_nulls and i not in coalesced_lkeys and dtype.kind in "iu":
+                # pandas promotes int columns with missing matches to float64
+                data = data.astype(jnp.float64)
+                if how == "right":
+                    data = jnp.where(left_pos < 0, jnp.nan, data)
+                dtype = np.dtype(np.float64)
+            new_cols.append((data, dtype, i, "left"))
             new_labels.append(label)
-        # gather right columns (null sentinel on misses)
         right_datas = gather_right_columns(
             [rframe.get_column(i).data for i in right_value_positions], right_pos
         )
         left_labels_set = set(lframe.columns)
+        coalesced_label_set = {
+            lframe.columns[lp] for lp in coalesced_lkeys
+        }
         for i, data in zip(right_value_positions, right_datas):
             col = rframe.get_column(i)
             label = rframe.columns[i]
-            if label in left_labels_set and not (on is not None and label == on):
+            if label in left_labels_set and label not in coalesced_label_set:
                 label = f"{label}{suffix_r}"
             dtype = col.pandas_dtype
-            if has_miss and dtype.kind in "iu":
-                # pandas promotes int columns with missing matches to float64
+            if right_has_nulls and dtype.kind in "iu":
                 data = jnp.where(right_pos < 0, jnp.nan, data.astype(jnp.float64))
                 dtype = np.dtype(np.float64)
-            new_cols.append(DeviceColumn(data, dtype, length=n_out))
+            new_cols.append((data, dtype, i, "right"))
             new_labels.append(label)
 
         if not pandas.Index(new_labels).is_unique:
             return None  # colliding suffixed labels: pandas raises MergeError
+
+        # ---- outer appendix: right-only rows ----------------------------- #
+        final_cols: list = []
+        if n_appendix > 0:
+            from modin_tpu.ops.join import _null_sentinel
+            from modin_tpu.ops.structural import concat_columns
+
+            appendix_datas = []
+            for data, dtype, src_i, side in new_cols:
+                if side == "right":
+                    app = gather_columns_device(
+                        [rframe.get_column(src_i).data], appendix_positions
+                    )[0]
+                elif src_i in key_appendix:
+                    app = gather_columns_device(
+                        [key_appendix[src_i]], appendix_positions
+                    )[0]
+                elif dtype.kind == "f":
+                    app = jnp.full(appendix_positions.shape, jnp.nan, data.dtype)
+                else:
+                    app = jnp.full(
+                        appendix_positions.shape,
+                        _null_sentinel(data.dtype),
+                        data.dtype,
+                    )
+                if app.dtype != data.dtype:
+                    app = app.astype(data.dtype)
+                appendix_datas.append(app)
+            datas, _ = concat_columns(
+                [[d for d, _, _, _ in new_cols], appendix_datas],
+                [n_out, n_appendix],
+            )
+            for (data, dtype, _, _), merged in zip(new_cols, datas):
+                final_cols.append(DeviceColumn(merged, dtype, length=n_total))
+        else:
+            for data, dtype, _, _ in new_cols:
+                final_cols.append(DeviceColumn(data, dtype, length=n_total))
+
+        if how == "outer" and n_total > 0:
+            # pandas always sorts an outer merge by the join keys (stable, so
+            # within equal keys the left-join expansion order is kept)
+            from modin_tpu.ops import sort as sort_ops
+
+            key_arrays = [final_cols[lp].data for lp in lkey_positions]
+            perm = sort_ops.lexsort_permutation(
+                key_arrays, n_total, [True] * len(key_arrays)
+            )
+            sorted_datas = gather_columns_device(
+                [c.data for c in final_cols], perm
+            )
+            final_cols = [
+                DeviceColumn(d, c.pandas_dtype, length=n_total)
+                for d, c in zip(sorted_datas, final_cols)
+            ]
+
         result_frame = TpuDataframe(
-            new_cols,
+            final_cols,
             pandas.Index(new_labels),
-            LazyIndex(pandas.RangeIndex(n_out), n_out),
-            nrows=n_out,
+            LazyIndex(pandas.RangeIndex(n_total), n_total),
+            nrows=n_total,
         )
         return type(self)(result_frame)
 
@@ -1325,6 +1466,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             datas = gb_ops.groupby_quantile(
                 arrays, codes, n_groups, len(frame),
                 q=float(qval), interpolation=interp,
+                preserve_float_dtype=(agg_func == "median"),
             )
             # lower/higher/nearest keep the integer dtype (pandas semantics)
             out_dtypes = [np.dtype(d.dtype) for d in datas]

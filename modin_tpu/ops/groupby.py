@@ -648,7 +648,12 @@ def groupby_reduce(
 
 @functools.lru_cache(maxsize=None)
 def _jit_group_quantile(
-    n_cols: int, num_segments: int, p_out: int, q: float, interpolation: str
+    n_cols: int,
+    num_segments: int,
+    p_out: int,
+    q: float,
+    interpolation: str,
+    preserve_float_dtype: bool = False,
 ):
     """Grouped quantile: lexsort by (code, value), gather at quantile ranks.
 
@@ -700,6 +705,9 @@ def _jit_group_quantile(
             r = jnp.take(xs, jnp.clip(g_start + pos, 0, max_pos))
         if not keep_int:
             r = jnp.where(vcnt == 0, jnp.nan, r)
+            if preserve_float_dtype and jnp.issubdtype(c.dtype, jnp.floating):
+                # pandas groupby median keeps float32; quantile widens to f64
+                r = r.astype(c.dtype)
         return finish(r)
 
     def fn(cols: Tuple, codes):
@@ -719,12 +727,14 @@ def groupby_quantile(
     n: int,
     q: float = 0.5,
     interpolation: str = "linear",
+    preserve_float_dtype: bool = False,
 ) -> List[Any]:
     """Per-group quantile of each value column (device lexsort + gather)."""
     from modin_tpu.ops.structural import pad_len
 
     fn = _jit_group_quantile(
-        len(value_cols), num_groups + 1, pad_len(num_groups), float(q), str(interpolation)
+        len(value_cols), num_groups + 1, pad_len(num_groups), float(q),
+        str(interpolation), bool(preserve_float_dtype),
     )
     return list(fn(tuple(value_cols), codes))
 

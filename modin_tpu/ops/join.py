@@ -26,19 +26,68 @@ from typing import Any, Tuple
 import numpy as np
 
 
+def _total_order(x):
+    """Monotone float64 -> int64 mapping: pandas merge equality semantics
+    (-0.0 == 0.0, every NaN matches every NaN, NaN sorts last)."""
+    from modin_tpu.ops.structural import float_total_order
+
+    return float_total_order(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_composite_codes(n_levels: int, float_flags: Tuple[bool, ...]):
+    """Fold multi-column join keys into one int64 code per side.
+
+    Per level, both sides' keys rank against the sorted concatenation of the
+    two sides (equal values get equal ranks, order is preserved), then the
+    running composite re-ranks after each fold so the code stays < |L|+|R|
+    and the product never overflows int64.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def rank_pair(lv, rv):
+        allv = jnp.concatenate([lv, rv])
+        s = jnp.sort(allv)
+        return (
+            jnp.searchsorted(s, lv, side="left"),
+            jnp.searchsorted(s, rv, side="left"),
+        )
+
+    def fn(lkeys: Tuple, rkeys: Tuple):
+        total = lkeys[0].shape[0] + rkeys[0].shape[0]
+        lc = rc = None
+        for lv, rv, is_f in zip(lkeys, rkeys, float_flags):
+            if is_f:
+                lv, rv = _total_order(lv), _total_order(rv)
+            else:
+                lv, rv = lv.astype(jnp.int64), rv.astype(jnp.int64)
+            l_i, r_i = rank_pair(lv, rv)
+            if lc is None:
+                lc, rc = l_i, r_i
+            else:
+                lc, rc = rank_pair(lc * total + l_i, rc * total + r_i)
+        return lc, rc
+
+    return jax.jit(fn)
+
+
+def composite_key_codes(left_keys: list, right_keys: list) -> Tuple[Any, Any]:
+    """(left_code, right_code): int64 arrays that compare equal exactly when
+    the key tuples compare equal under pandas merge semantics."""
+    import jax.numpy as jnp
+
+    float_flags = tuple(
+        bool(jnp.issubdtype(k.dtype, jnp.floating)) for k in left_keys
+    )
+    fn = _jit_composite_codes(len(left_keys), float_flags)
+    return fn(tuple(left_keys), tuple(right_keys))
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_match_bounds(n_left: int, n_right: int):
     import jax
     import jax.numpy as jnp
-
-    def _total_order(x):
-        """Monotone float64 -> int64 mapping: pandas merge equality semantics
-        (-0.0 == 0.0, every NaN matches every NaN, NaN sorts last)."""
-        # canonicalize: XLA folds x+0.0 to x, so -0.0 needs an explicit where
-        x = jnp.where(x == 0, 0.0, x)
-        x = jnp.where(jnp.isnan(x), jnp.nan, x)
-        bits = jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
-        return jnp.where(bits >= 0, bits, (~bits) ^ np.int64(-(2**63)))
 
     def fn(left_key, right_key):
         if jnp.issubdtype(right_key.dtype, jnp.floating):
@@ -138,6 +187,40 @@ def sort_merge_positions(
         perm, lo, counts
     )
     return left_pos, right_pos, n_out, has_miss
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_right_only(p_right: int, n_right: int, n_out: int):
+    """Right rows untouched by a left join: (order, count).
+
+    ``order`` sorts unmatched valid right positions first, in original right
+    order (pandas outer-merge appendix order); ``count`` is how many.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def fn(right_pos):
+        valid_out = jnp.arange(right_pos.shape[0]) < n_out
+        hit = valid_out & (right_pos >= 0)
+        safe = jnp.where(hit, right_pos, 0)
+        flags = jnp.zeros(p_right, bool).at[safe].set(True)
+        # row 0 may have been set by masked-out pads pointing at 0
+        flags = flags.at[0].set(jnp.any(hit & (right_pos == 0)))
+        valid_r = jnp.arange(p_right) < n_right
+        unmatched = (~flags) & valid_r
+        m = jnp.sum(unmatched)
+        order = jnp.argsort(~unmatched, stable=True)
+        return order, m
+
+    return jax.jit(fn)
+
+
+def right_only_positions(right_pos, p_right: int, n_right: int, n_out: int):
+    """(positions, count) of right rows missing from the left-join output."""
+    import jax
+
+    order, m = _jit_right_only(int(p_right), int(n_right), int(n_out))(right_pos)
+    return order, int(jax.device_get(m))
 
 
 @functools.lru_cache(maxsize=None)

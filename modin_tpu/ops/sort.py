@@ -36,21 +36,26 @@ def _jit_lexsort(n_keys: int, n: int, n_asc: Tuple[bool, ...], na_last: bool):
     import jax.numpy as jnp
 
     def order_one(k_masked, ascending, perm):
+        from modin_tpu.ops.structural import float_total_order
+
         kk = jnp.take(k_masked, perm)
         if jnp.issubdtype(kk.dtype, jnp.floating):
+            # total-order int keys: NaN sorts STRICTLY beyond +inf instead of
+            # tying with it (a where(nan, inf) mapping misorders inf vs NaN),
+            # and pads sort strictly beyond NaN (perm values are original
+            # positions, so padness survives earlier rounds)
+            t = float_total_order(kk)
+            i64 = np.iinfo(np.int64)
+            nanm = jnp.isnan(kk)
+            is_pad = perm >= n
             if ascending:
-                key = (
-                    jnp.where(jnp.isnan(kk), jnp.inf, kk)
-                    if na_last
-                    else jnp.where(jnp.isnan(kk), -jnp.inf, kk)
-                )
+                nan_key = np.int64(i64.min + 1) if not na_last else None
+                key = t if na_last else jnp.where(nanm, nan_key, t)
+                key = jnp.where(is_pad, np.int64(i64.max), key)
                 o = jnp.argsort(key, stable=True)
             else:
-                key = (
-                    jnp.where(jnp.isnan(kk), -jnp.inf, kk)
-                    if na_last
-                    else jnp.where(jnp.isnan(kk), jnp.inf, kk)
-                )
+                key = jnp.where(nanm, np.int64(i64.min + 1), t) if na_last else t
+                key = jnp.where(is_pad, np.int64(i64.min), key)
                 o = jnp.argsort(key, stable=True, descending=True)
         else:
             o = jnp.argsort(kk, stable=True, descending=not ascending)

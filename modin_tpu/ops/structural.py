@@ -15,6 +15,23 @@ from typing import Any, List, Tuple
 import numpy as np
 
 
+def float_total_order(x):
+    """Monotone float -> int64 mapping with a strict IEEE total order.
+
+    -0.0 == 0.0, every NaN maps to one key ABOVE +inf (so NaN sorts strictly
+    after inf instead of tying with it), and ordering elsewhere matches <.
+    Shared by the sort and join kernels.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # canonicalize: XLA folds x+0.0 to x, so -0.0 needs an explicit where
+    x = jnp.where(x == 0, 0.0, x)
+    x = jnp.where(jnp.isnan(x), jnp.nan, x)
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
+    return jnp.where(bits >= 0, bits, (~bits) ^ np.int64(-(2**63)))
+
+
 def pad_len(n: int) -> int:
     """Smallest multiple of the mesh row-shard count >= n (and >= 1 shard)."""
     from modin_tpu.parallel.mesh import num_row_shards

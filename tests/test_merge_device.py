@@ -147,3 +147,84 @@ def test_merge_colliding_suffixes_raise_like_pandas():
         pl_.merge(pr, on="k", suffixes=("_s", "_r"))
     with pytest.raises(Exception):
         ml.merge(mr, on="k", suffixes=("_s", "_r"))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+@pytest.mark.parametrize("on", ["k", ["k", "k2"]])
+def test_merge_how_keys_matrix(how, on):
+    rng = np.random.default_rng(17)
+    nl, nr = 400, 250
+    left = {
+        "k": rng.integers(0, 40, nl),
+        "k2": rng.integers(0, 4, nl),
+        "x": rng.normal(size=nl),
+    }
+    right = {
+        "k": rng.integers(0, 40, nr),
+        "k2": rng.integers(0, 4, nr),
+        "y": rng.normal(size=nr),
+    }
+    ml, pl_ = create_test_dfs(left)
+    mr, pr = create_test_dfs(right)
+    got = assert_no_fallback(lambda: ml.merge(mr, on=on, how=how))
+    df_equals(got, pl_.merge(pr, on=on, how=how))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right"])
+def test_merge_left_on_right_on_matrix(how):
+    rng = np.random.default_rng(19)
+    ml, pl_ = create_test_dfs({"a": rng.integers(0, 15, 300), "x": rng.normal(size=300)})
+    mr, pr = create_test_dfs({"b": rng.integers(0, 15, 120), "y": rng.normal(size=120)})
+    got = assert_no_fallback(lambda: ml.merge(mr, left_on="a", right_on="b", how=how))
+    df_equals(got, pl_.merge(pr, left_on="a", right_on="b", how=how))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_merge_nan_float_keys_matrix(how):
+    ml, pl_ = create_test_dfs({"k": [1.0, np.nan, 2.0, np.nan, 5.0], "x": np.arange(5.0)})
+    mr, pr = create_test_dfs({"k": [np.nan, 2.0, 7.0], "y": np.arange(3.0)})
+    got = assert_no_fallback(lambda: ml.merge(mr, on="k", how=how))
+    df_equals(got, pl_.merge(pr, on="k", how=how))
+
+
+@pytest.mark.parametrize("how", ["right", "outer"])
+def test_merge_promotes_left_int_on_miss(how):
+    ml, pl_ = create_test_dfs({"k": [1, 2], "lv": [10, 20]})
+    mr, pr = create_test_dfs({"k": [2, 9], "rv": [7, 8]})
+    got = assert_no_fallback(lambda: ml.merge(mr, on="k", how=how))
+    df_equals(got, pl_.merge(pr, on="k", how=how))
+
+
+def test_merge_multikey_mixed_dtypes():
+    rng = np.random.default_rng(23)
+    n = 300
+    left = {
+        "ki": rng.integers(0, 10, n),
+        "kf": rng.choice([0.5, 1.5, np.nan, 2.5], n),
+        "x": rng.normal(size=n),
+    }
+    right = {
+        "ki": rng.integers(0, 10, 100),
+        "kf": rng.choice([0.5, 1.5, np.nan], 100),
+        "y": rng.normal(size=100),
+    }
+    ml, pl_ = create_test_dfs(left)
+    mr, pr = create_test_dfs(right)
+    for how in ("inner", "left", "right", "outer"):
+        got = assert_no_fallback(lambda: ml.merge(mr, on=["ki", "kf"], how=how))
+        df_equals(got, pl_.merge(pr, on=["ki", "kf"], how=how))
+
+
+def test_merge_three_keys():
+    rng = np.random.default_rng(29)
+    n = 500
+    cols = lambda n: {
+        "a": rng.integers(0, 6, n),
+        "b": rng.integers(0, 6, n),
+        "c": rng.integers(0, 6, n),
+    }
+    ml, pl_ = create_test_dfs({**cols(n), "x": rng.normal(size=n)})
+    mr, pr = create_test_dfs({**cols(200), "y": rng.normal(size=200)})
+    for how in ("inner", "left", "right", "outer"):
+        got = assert_no_fallback(lambda: ml.merge(mr, on=["a", "b", "c"], how=how))
+        df_equals(got, pl_.merge(pr, on=["a", "b", "c"], how=how))
