@@ -376,10 +376,15 @@ class TpuNumpy(EnvironmentVariable, type=bool):
 
 
 class AutoSwitchBackend(EnvironmentVariable, type=bool):
-    """Let the cost calculator auto-move frames between device and host backends."""
+    """Let the cost calculator auto-move frames between device and host backends.
+
+    Off by default (matching the reference's MODIN_AUTO_SWITCH_BACKENDS):
+    implicit relocation changes result backend types across the API, so the
+    user opts in.
+    """
 
     varname = "MODIN_TPU_AUTO_SWITCH_BACKENDS"
-    default = True
+    default = False
 
     @classmethod
     def enable(cls):

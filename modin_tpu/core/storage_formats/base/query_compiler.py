@@ -71,6 +71,19 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
     _modin_frame: Any = None
     _shape_hint: Optional[str] = None
 
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        # every concrete storage format gets the per-method backend caster
+        # (mixed-argument coercion + cost-driven auto-switch) and joins the
+        # candidate-backend registry (reference: query_compiler_caster.py:527)
+        from modin_tpu.core.storage_formats.base.query_compiler_caster import (
+            register_backend_qc,
+            wrap_query_compiler_methods,
+        )
+
+        wrap_query_compiler_methods(cls)
+        register_backend_qc(cls)
+
     # --- lazy-evaluation capability flags (reference: query_compiler.py:259-303) ---
     lazy_row_labels = False
     lazy_row_count = False

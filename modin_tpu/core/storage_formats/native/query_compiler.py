@@ -94,8 +94,9 @@ class NativeQueryCompiler(BaseQueryCompiler):
         if isinstance(other_qc, cls):
             return QCCoercionCost.COST_ZERO
         try:
+            # small frames are exactly what in-process pandas is best at
             if other_qc.get_axis_len(0) <= NativePandasMaxRows.get():
-                return QCCoercionCost.COST_LOW
+                return QCCoercionCost.COST_ZERO
         except Exception:
             pass
         return QCCoercionCost.COST_MEDIUM

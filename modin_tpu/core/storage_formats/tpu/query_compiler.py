@@ -113,6 +113,21 @@ class TpuQueryCompiler(BaseQueryCompiler):
     # ------------------------------------------------------------------ #
 
     def stay_cost(self, api_cls_name, operation, arguments) -> Optional[int]:
+        if operation:
+            import inspect
+
+            own = getattr(type(self), operation, None)
+            base = getattr(BaseQueryCompiler, operation, None)
+            own_fn = inspect.unwrap(own) if own is not None else None
+            base_fn = inspect.unwrap(base) if base is not None else None
+            if (
+                own_fn is not None
+                and own_fn is base_fn
+                and len(self._modin_frame) <= 1_000_000
+            ):
+                # no device kernel for this op: it will round-trip through
+                # host pandas anyway, so a small frame is cheaper off-device
+                return QCCoercionCost.COST_MEDIUM
         return QCCoercionCost.COST_ZERO
 
     def move_to_cost(self, other_qc_type, api_cls_name, operation, arguments) -> Optional[int]:
@@ -761,6 +776,64 @@ class TpuQueryCompiler(BaseQueryCompiler):
             result = result.astype(np.int64)
         name = MODIN_UNNAMED_SERIES_LABEL
         return type(self).from_pandas(result.to_frame(name))
+
+    def _try_device_corr_cov(
+        self, method: str, min_periods: int, ddof: int, numeric_only: bool
+    ) -> Optional["TpuQueryCompiler"]:
+        """Pairwise corr/cov as masked MXU matmuls (ops/stats.py; ref
+        aggregations.py:31 computes the same sums-of-products per block)."""
+        from modin_tpu.ops.stats import corr_cov_matrix
+
+        frame = self._modin_frame
+        if len(frame) == 0 or frame.num_cols == 0:
+            return None
+        positions = []
+        for i, col in enumerate(frame._columns):
+            ok = col.is_device and col.pandas_dtype.kind in "biuf"
+            if ok:
+                positions.append(i)
+            elif numeric_only and col.pandas_dtype.kind not in "biufc":
+                continue
+            else:
+                return None
+        if not positions:
+            return None
+        frame.materialize_device()
+        arrays = [frame._columns[i].data for i in positions]
+        labels = frame.columns[positions]
+        mat, _ = corr_cov_matrix(
+            arrays, len(frame), method=method, ddof=ddof,
+            min_periods=min_periods,
+        )
+        return type(self).from_pandas(
+            pandas.DataFrame(mat, index=labels, columns=labels)
+        )
+
+    def corr(self, method: Any = "pearson", min_periods: Any = 1, numeric_only: bool = False, **kwargs: Any) -> "TpuQueryCompiler":
+        if method == "pearson" and not kwargs:
+            result = self._try_device_corr_cov(
+                "corr", int(min_periods) if min_periods is not None else 1,
+                1, bool(numeric_only),
+            )
+            if result is not None:
+                return result
+        return super().corr(
+            method=method, min_periods=min_periods, numeric_only=numeric_only,
+            **kwargs,
+        )
+
+    def cov(self, min_periods: Any = None, ddof: int = 1, numeric_only: bool = False, **kwargs: Any) -> "TpuQueryCompiler":
+        if not kwargs and isinstance(ddof, (int, np.integer)):
+            result = self._try_device_corr_cov(
+                "cov", int(min_periods) if min_periods is not None else 1,
+                int(ddof), bool(numeric_only),
+            )
+            if result is not None:
+                return result
+        return super().cov(
+            min_periods=min_periods, ddof=ddof, numeric_only=numeric_only,
+            **kwargs,
+        )
 
     def _device_idx_minmax(self, op: str, axis: int, skipna: bool, numeric_only: bool, kwargs: dict):
         from modin_tpu.ops import reductions
@@ -1704,3 +1777,11 @@ def _make_nonskipna_reduce_override(op: str):
 
 for _op in ["count", "any", "all"]:
     setattr(TpuQueryCompiler, _op, _make_nonskipna_reduce_override(_op))
+
+# the generated overrides above were installed after __init_subclass__ ran,
+# so they need the backend-caster wrap applied explicitly
+from modin_tpu.core.storage_formats.base.query_compiler_caster import (  # noqa: E402
+    wrap_query_compiler_methods as _wrap_qc_methods,
+)
+
+_wrap_qc_methods(TpuQueryCompiler)

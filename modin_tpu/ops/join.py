@@ -26,12 +26,7 @@ from typing import Any, Tuple
 import numpy as np
 
 
-def _total_order(x):
-    """Monotone float64 -> int64 mapping: pandas merge equality semantics
-    (-0.0 == 0.0, every NaN matches every NaN, NaN sorts last)."""
-    from modin_tpu.ops.structural import float_total_order
-
-    return float_total_order(x)
+from modin_tpu.ops.structural import float_total_order as _total_order
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,111 @@
+"""Backend caster + cost-driven auto-switch tests.
+
+Mirrors the reference suite's shape (modin/tests/pandas/test_backend.py):
+mixed-backend arguments coerce to the cheapest common backend through the
+per-method QC caster, and AutoSwitchBackend relocates frames around
+operations when the cost model says so.
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import AutoSwitchBackend
+from modin_tpu.core.storage_formats.native.query_compiler import (
+    NativeQueryCompiler,
+)
+from modin_tpu.core.storage_formats.tpu.query_compiler import TpuQueryCompiler
+from tests.utils import df_equals
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu_backend():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("backend switch tests need the TpuOnJax default")
+
+
+def _native_df(data):
+    qc = NativeQueryCompiler.from_pandas(pandas.DataFrame(data))
+    return pd.DataFrame(query_compiler=qc)
+
+
+def _backend(df):
+    return type(df._query_compiler).__name__
+
+
+def test_mixed_backend_binary_op_coerces():
+    big = pd.DataFrame({"a": np.arange(50_000.0)})
+    small = _native_df({"a": np.ones(50_000)})
+    assert _backend(big) == "TpuQueryCompiler"
+    assert _backend(small) == "NativeQueryCompiler"
+    out = big + small
+    # the device operand is cheaper to keep: the native one moves to it
+    assert _backend(out) == "TpuQueryCompiler"
+    df_equals(out, pandas.DataFrame({"a": np.arange(50_000.0) + 1.0}))
+
+
+def test_mixed_backend_merge_coerces():
+    left = pd.DataFrame({"k": np.arange(1000) % 7, "x": np.arange(1000.0)})
+    right = _native_df({"k": np.arange(7), "y": np.arange(7.0)})
+    out = left.merge(right, on="k")
+    assert _backend(out) == "TpuQueryCompiler"
+    pl_ = pandas.DataFrame({"k": np.arange(1000) % 7, "x": np.arange(1000.0)})
+    pr = pandas.DataFrame({"k": np.arange(7), "y": np.arange(7.0)})
+    df_equals(out, pl_.merge(pr, on="k"))
+
+
+def test_mixed_backend_concat_coerces():
+    a = pd.DataFrame({"a": np.arange(100.0)})
+    b = _native_df({"a": np.arange(100.0)})
+    out = pd.concat([a, b], ignore_index=True)
+    df_equals(
+        out,
+        pandas.concat(
+            [pandas.DataFrame({"a": np.arange(100.0)})] * 2, ignore_index=True
+        ),
+    )
+
+
+def test_auto_switch_moves_fallback_op_to_native():
+    # a small device frame running an op with no device kernel should
+    # relocate to the Native backend when AutoSwitchBackend is on
+    md = pd.DataFrame({"a": [3.0, 1.0, 2.0, 1.0]})
+    assert _backend(md) == "TpuQueryCompiler"
+    with AutoSwitchBackend.context(True):
+        out = md.mode()
+    assert _backend(out) == "NativeQueryCompiler"
+    df_equals(out, pandas.DataFrame({"a": [3.0, 1.0, 2.0, 1.0]}).mode())
+
+
+def test_no_auto_switch_when_disabled():
+    md = pd.DataFrame({"a": [3.0, 1.0, 2.0, 1.0]})
+    with AutoSwitchBackend.context(False):
+        out = md.mode()
+    assert _backend(out) == "TpuQueryCompiler"
+
+
+def test_auto_switch_keeps_device_ops_on_device():
+    md = pd.DataFrame({"a": np.arange(1000.0)})
+    with AutoSwitchBackend.context(True):
+        out = md * 2.0
+    assert _backend(out) == "TpuQueryCompiler"
+
+
+def test_set_backend_round_trip():
+    md = pd.DataFrame({"a": np.arange(16.0)})
+    native = md.modin.set_backend("Pandas")
+    assert _backend(native) == "NativeQueryCompiler"
+    back = native.modin.set_backend("Tpu")
+    assert _backend(back) == "TpuQueryCompiler"
+    df_equals(back, pandas.DataFrame({"a": np.arange(16.0)}))
+
+
+def test_mixed_backend_getitem_mask():
+    big = pd.DataFrame({"a": np.arange(200.0)})
+    mask_native = _native_df({"m": np.arange(200) % 2 == 0})["m"]
+    out = big[mask_native]
+    pdf = pandas.DataFrame({"a": np.arange(200.0)})
+    df_equals(out, pdf[np.arange(200) % 2 == 0])

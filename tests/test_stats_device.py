@@ -1,0 +1,73 @@
+"""Device corr/cov tests (masked-matmul kernels, differential vs pandas)."""
+
+import warnings
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals
+
+_rng = np.random.default_rng(13)
+N = 2000
+
+
+@pytest.fixture
+def dfs():
+    data = {
+        "a": _rng.normal(size=N),
+        "b": np.where(_rng.random(N) < 0.25, np.nan, _rng.normal(size=N)),
+        "i": _rng.integers(-5, 5, N),
+        "flag": _rng.random(N) < 0.4,
+    }
+    return create_test_dfs(data)
+
+
+def _no_fallback(fn):
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        return fn()
+
+
+def test_corr_device(dfs):
+    md, pdf = dfs
+    df_equals(_no_fallback(lambda: md.corr()), pdf.corr())
+
+
+def test_cov_device(dfs):
+    md, pdf = dfs
+    df_equals(_no_fallback(lambda: md.cov()), pdf.cov())
+
+
+@pytest.mark.parametrize("ddof", [0, 1, 2])
+def test_cov_ddof(dfs, ddof):
+    md, pdf = dfs
+    # pandas ignores ddof when NaNs force the pairwise path — both cases
+    df_equals(_no_fallback(lambda: md.cov(ddof=ddof)), pdf.cov(ddof=ddof))
+    md2, pdf2 = create_test_dfs({"x": _rng.normal(size=64), "y": _rng.normal(size=64)})
+    df_equals(_no_fallback(lambda: md2.cov(ddof=ddof)), pdf2.cov(ddof=ddof))
+
+
+def test_corr_min_periods(dfs):
+    md, pdf = dfs
+    df_equals(
+        _no_fallback(lambda: md.corr(min_periods=1800)),
+        pdf.corr(min_periods=1800),
+    )
+
+
+def test_corr_constant_column():
+    md, pdf = create_test_dfs({"a": np.arange(32.0), "const": np.ones(32)})
+    df_equals(_no_fallback(lambda: md.corr()), pdf.corr())
+
+
+def test_corr_non_pearson_falls_back(dfs):
+    md, pdf = dfs
+    df_equals(md[["a", "b"]].corr(method="spearman"), pdf[["a", "b"]].corr(method="spearman"))
+
+
+def test_series_corr_cov(dfs):
+    md, pdf = dfs
+    np.testing.assert_allclose(md["a"].corr(md["b"]), pdf["a"].corr(pdf["b"]))
+    np.testing.assert_allclose(md["a"].cov(md["b"]), pdf["a"].cov(pdf["b"]))
