@@ -99,11 +99,21 @@ class DeviceColumn:
 
     @classmethod
     def from_numpy(cls, values: np.ndarray, sharding: Any = None) -> "DeviceColumn":
+        from modin_tpu.config import Float64Policy
         from modin_tpu.ops.structural import pad_host
         from modin_tpu.parallel.engine import JaxWrapper
 
         pandas_dtype = values.dtype
         device_values = values.view("int64") if values.dtype.kind in "mM" else values
+        if (
+            device_values.dtype == np.float64
+            and Float64Policy.get() == "Downcast"
+        ):
+            # f64 on TPU is double-float emulated (~2x the FLOPs, half the
+            # VPU/MXU rate); the Downcast policy stores f32 on device while
+            # the logical dtype and host_cache keep exact float64 — the user
+            # opts into f32 compute precision for device kernels.
+            device_values = device_values.astype(np.float32)
         if not device_values.flags.c_contiguous:
             device_values = np.ascontiguousarray(device_values)
         padded = pad_host(device_values)
@@ -122,6 +132,9 @@ class DeviceColumn:
         values = np.asarray(JaxWrapper.materialize(self.data))[: self.length]
         if self.pandas_dtype.kind in "mM":
             values = values.view(self.pandas_dtype)
+        elif values.dtype != self.pandas_dtype:
+            # Float64Policy=Downcast stores f32 on device for a logical f64
+            values = values.astype(self.pandas_dtype)
         return values
 
     def with_data(
@@ -377,6 +390,50 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
             )
         positions = np.nonzero(mask_np)[0]
         return self._take_host_positions(positions)
+
+    def filter_rows_mask_device(self, mask_raw: Any) -> "TpuDataframe":
+        """Boolean-filter rows entirely on device (mask may be deferred).
+
+        The mask computation fuses into the compaction kernel and the only
+        host sync is the scalar kept-count; positions never round-trip
+        through the host for device columns (the reference keeps lazy row
+        counts for the same reason, ref dataframe.py:242-343).  Host columns
+        and the row index resolve through one lazy positions fetch.
+        """
+        from modin_tpu.ops.structural import compact_rows
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        from modin_tpu.ops.structural import pad_len, trim_columns
+
+        device_idx = [i for i, c in enumerate(self._columns) if c.is_device]
+        datas, count, perm = compact_rows(
+            [self._columns[i].raw for i in device_idx], mask_raw, len(self)
+        )
+        n_out = int(JaxWrapper.materialize(count))
+        # restore the padded-column invariant (physical size = pad_len(n)):
+        # compaction kept the input's physical size, so trim to the output's
+        datas = trim_columns(datas, pad_len(n_out))
+        new_columns: List[Column] = list(self._columns)
+        for i, d in zip(device_idx, datas):
+            col = self._columns[i]
+            new_columns[i] = DeviceColumn(d, col.pandas_dtype, length=n_out)
+
+        host_positions_cache: dict = {}
+
+        def host_positions() -> np.ndarray:
+            if "pos" not in host_positions_cache:
+                host_positions_cache["pos"] = np.asarray(
+                    JaxWrapper.materialize(perm)
+                )[:n_out]
+            return host_positions_cache["pos"]
+
+        for i, col in enumerate(self._columns):
+            if not col.is_device:
+                new_columns[i] = HostColumn(col.data.take(host_positions()))
+        new_index = self._index.map_after(
+            lambda idx: idx.take(host_positions()), n_out
+        )
+        return self.with_columns(new_columns, index=new_index, nrows=n_out)
 
     def concat_rows(self, others: List["TpuDataframe"]) -> "TpuDataframe":
         """Row-wise concat when column labels/dtypes align exactly."""

@@ -136,13 +136,13 @@ def _wrap_method(name: str, fn: Callable) -> Callable:
             from modin_tpu.config import AutoSwitchBackend
 
             if AutoSwitchBackend.get() and len(_BACKEND_REGISTRY) > 1:
-                candidates = list(_BACKEND_REGISTRY)
-                if self_type not in candidates:
-                    candidates.append(self_type)
-                totals = _backend_costs(name, [self, *others], candidates)
-                best = min(totals, key=lambda t: totals[t])
-                # relocate only when STRICTLY cheaper than staying put
-                if best is not self_type and totals[best] < totals[self_type]:
+                # self first: _cheapest_backend breaks ties toward the first
+                # candidate, so staying put wins unless strictly cheaper
+                candidates = [self_type] + [
+                    t for t in _BACKEND_REGISTRY if t is not self_type
+                ]
+                best = _cheapest_backend(name, [self, *others], candidates)
+                if best is not None and best is not self_type:
                     target = best
 
         if target is not None and (

@@ -133,9 +133,15 @@ class TpuQueryCompiler(BaseQueryCompiler):
     def move_to_cost(self, other_qc_type, api_cls_name, operation, arguments) -> Optional[int]:
         if type(self) is other_qc_type:
             return QCCoercionCost.COST_ZERO
+        # transfer-size aware: the PCIe/tunnel cost of leaving the device
+        # scales with the frame, so a mid-size device frame outprices a
+        # small host frame's move in the calculator regardless of which
+        # operand is self
         nrows = len(self._modin_frame)
         if nrows > 10_000_000:
             return QCCoercionCost.COST_HIGH
+        if nrows > 64_000:
+            return QCCoercionCost.COST_MEDIUM
         return QCCoercionCost.COST_LOW
 
     # ------------------------------------------------------------------ #
@@ -192,9 +198,22 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 # already match (ref: pandas check_bool_indexer).
                 and self._fast_index_match(key)
             ):
-                mask = mask_frame.get_column(0).to_numpy()
-                if mask.dtype == bool:
-                    return type(self)(self._modin_frame.filter_rows_mask(mask))
+                mcol = mask_frame.get_column(0)
+                if mcol.pandas_dtype == np.dtype(bool):
+                    frame = self._modin_frame
+                    cached = mcol.host_cache is not None and all(
+                        (not c.is_device) or c.host_cache is not None
+                        for c in frame._columns
+                    )
+                    if cached:
+                        # everything already has bit-exact host copies: the
+                        # host-positions path is free and keeps the caches
+                        return type(self)(
+                            frame.filter_rows_mask(mcol.to_numpy())
+                        )
+                    # computed data: compact on device — the (possibly
+                    # deferred) mask fuses into the kernel; one scalar sync
+                    return type(self)(frame.filter_rows_mask_device(mcol.raw))
             return super().getitem_array(key)
         key_arr = np.asarray(key)
         if key_arr.dtype == bool:
@@ -954,12 +973,26 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     )
                     return ~bad
 
-                keep_mask = np.asarray(
-                    run_fused(nas, tail_key=("dropna_keep", how), tail_builder=keep_tail)
+                keep_dev = run_fused(
+                    nas, tail_key=("dropna_keep", how), tail_builder=keep_tail
                 )
-            else:
-                keep_mask = np.ones(len(frame), bool)
-            return type(self)(frame.filter_rows_mask(keep_mask), self._shape_hint)
+                if all(
+                    (not c.is_device) or c.host_cache is not None
+                    for c in frame._columns
+                ):
+                    # cached columns: host-positions path keeps the bit-exact
+                    # host copies through the row drop
+                    return type(self)(
+                        frame.filter_rows_mask(np.asarray(keep_dev)),
+                        self._shape_hint,
+                    )
+                return type(self)(
+                    frame.filter_rows_mask_device(keep_dev), self._shape_hint
+                )
+            return type(self)(
+                frame.filter_rows_mask(np.ones(len(frame), bool)),
+                self._shape_hint,
+            )
         return super().dropna(**kwargs)
 
     # --------------------------- value_counts -------------------------- #
@@ -982,7 +1015,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             from modin_tpu.ops import groupby as gb_ops
 
             try:
-                codes, n_groups, group_keys = gb_ops.factorize_keys(
+                codes, n_groups, group_keys = gb_ops.factorize_keys_cached(
                     [col.data], len(frame), dropna=dropna
                 )
             except gb_ops._TooManyGroups:
@@ -1508,7 +1541,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
         frame.materialize_device()
         try:
-            codes, n_groups, group_keys = gb_ops.factorize_keys(
+            codes, n_groups, group_keys = gb_ops.factorize_keys_cached(
                 [c.data for c in key_cols], len(frame), dropna=dropna
             )
         except gb_ops._TooManyGroups:

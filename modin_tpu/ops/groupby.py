@@ -158,6 +158,30 @@ def _jit_mask_codes(n: int, overflow: int):
     return jax.jit(fn)
 
 
+# Bounded memo of key factorizations.  Grouping by the same key columns
+# repeatedly (df.groupby(k).sum() then .mean() ...) re-derives identical
+# codes; the cache keys on the device arrays' identity so any new/modified
+# column misses.  Strong refs to the key arrays keep ids stable; the size
+# bound caps pinned device memory.
+_FACTORIZE_CACHE: List[Tuple[Tuple, List[Any], Tuple[Any, int, List[np.ndarray]]]] = []
+_FACTORIZE_CACHE_MAX = 8
+
+
+def factorize_keys_cached(
+    key_cols: List[Any], n: int, dropna: bool = True
+) -> Tuple[Any, int, List[np.ndarray]]:
+    """Memoized :func:`factorize_keys` (same-identity key columns hit)."""
+    cache_key = (tuple(id(k) for k in key_cols), int(n), bool(dropna))
+    for entry_key, _refs, result in _FACTORIZE_CACHE:
+        if entry_key == cache_key:
+            return result
+    result = factorize_keys(key_cols, n, dropna)
+    _FACTORIZE_CACHE.append((cache_key, list(key_cols), result))
+    if len(_FACTORIZE_CACHE) > _FACTORIZE_CACHE_MAX:
+        _FACTORIZE_CACHE.pop(0)
+    return result
+
+
 def factorize_keys(
     key_cols: List[Any], n: int, dropna: bool = True
 ) -> Tuple[Any, int, List[np.ndarray]]:

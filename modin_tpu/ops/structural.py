@@ -61,6 +61,59 @@ def _jit_gather(n_cols: int):
     return jax.jit(fn)
 
 
+def compact_rows(cols: List[Any], mask: Any, n: int) -> Tuple[List[Any], Any, Any]:
+    """Device-side boolean-filter: kept rows compacted to the front.
+
+    ``cols``/``mask`` may be deferred LazyExprs — the mask computation (e.g.
+    ``df.a > 0``) fuses into the compaction program.  Returns (gathered
+    columns, kept-count scalar, kept-positions array), all still on device:
+    the only host sync a filter needs is the scalar count (one RTT over a
+    remote tunnel, versus shipping an O(n) mask to host and positions back).
+    Outputs keep the input padded size; pad rows land at the tail.
+    """
+    from modin_tpu.ops.lazy import run_fused
+
+    def tail(arrs):
+        import jax.numpy as jnp
+
+        *col_arrs, m = arrs
+        valid = jnp.arange(m.shape[0]) < n
+        keep = m & valid
+        # stable argsort of "dropped" puts kept rows first, original order
+        perm = jnp.argsort(~keep, stable=True)
+        count = jnp.sum(keep)
+        return tuple(jnp.take(c, perm, axis=0) for c in col_arrs), count, perm
+
+    return run_fused(
+        [*cols, mask],
+        tail_key=("compact_rows", len(cols), int(n)),
+        tail_builder=tail,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_trim(n_cols: int, p_out: int):
+    """Slice padded columns down to a smaller padded size, keeping the rows
+    axis sharded (a bare slice can come back replicated)."""
+    import jax
+
+    from modin_tpu.parallel.mesh import row_sharding
+
+    def fn(cols: Tuple):
+        sh = row_sharding()
+        return tuple(
+            jax.lax.with_sharding_constraint(c[:p_out], sh) for c in cols
+        )
+
+    return jax.jit(fn)
+
+
+def trim_columns(cols: List[Any], p_out: int) -> List[Any]:
+    if not cols or cols[0].shape[0] == p_out:
+        return list(cols)
+    return list(_jit_trim(len(cols), int(p_out))(tuple(cols)))
+
+
 def gather_columns(cols: List[Any], positions: np.ndarray) -> Tuple[List[Any], int]:
     """Gather logical positions from padded columns.
 

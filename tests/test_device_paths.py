@@ -264,3 +264,22 @@ def test_shift_diff_edge_periods():
     df_equals(md.shift(50), p.shift(50))         # beyond length -> all NaN
     df_equals(md.shift(-50), p.shift(-50))
     df_equals(md.diff(-50), p.diff(-50))
+
+
+def test_float64_policy_downcast():
+    """Float64Policy=Downcast: f32 device storage, exact host round-trip."""
+    import numpy as np
+
+    from modin_tpu.config import Float64Policy
+
+    x = np.random.default_rng(0).normal(size=800)
+    with Float64Policy.context("Downcast"):
+        md = pd.DataFrame({"a": x})
+        col = md._query_compiler._modin_frame.get_column(0)
+        assert str(col.data.dtype) == "float32"
+        assert col.pandas_dtype == np.float64
+        # untouched column round-trips bit-exact via host_cache
+        np.testing.assert_array_equal(md["a"].to_numpy(), x)
+        # computed results carry f32 precision (the policy's tradeoff)
+        got = float((md["a"] * 2.0).sum())
+        np.testing.assert_allclose(got, (x.astype(np.float32) * 2).sum(), rtol=1e-5)
