@@ -16,6 +16,8 @@ from modin_tpu.core.io.column_stores.parquet_dispatcher import (
 from modin_tpu.core.io.io import BaseIO
 from modin_tpu.core.io.sql.sql_dispatcher import SQLDispatcher
 from modin_tpu.core.io.text.csv_dispatcher import CSVDispatcher, TableDispatcher
+from modin_tpu.core.io.text.fwf_dispatcher import FWFDispatcher
+from modin_tpu.core.io.text.json_dispatcher import JSONDispatcher
 from modin_tpu.core.storage_formats.tpu.query_compiler import TpuQueryCompiler
 
 
@@ -25,6 +27,16 @@ class TpuCSVDispatcher(CSVDispatcher):
 
 
 class TpuTableDispatcher(TableDispatcher):
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe
+
+
+class TpuJSONDispatcher(JSONDispatcher):
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe
+
+
+class TpuFWFDispatcher(FWFDispatcher):
     query_compiler_cls = TpuQueryCompiler
     frame_cls = TpuDataframe
 
@@ -62,6 +74,14 @@ class TpuOnJaxIO(BaseIO):
     @classmethod
     def read_table(cls, **kwargs: Any):
         return TpuTableDispatcher.read(**kwargs)
+
+    @classmethod
+    def read_json(cls, **kwargs: Any):
+        return TpuJSONDispatcher.read(**kwargs)
+
+    @classmethod
+    def read_fwf(cls, **kwargs: Any):
+        return TpuFWFDispatcher.read(**kwargs)
 
     @classmethod
     def read_parquet(cls, **kwargs: Any):

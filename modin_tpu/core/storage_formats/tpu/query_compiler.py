@@ -68,6 +68,18 @@ class TpuQueryCompiler(BaseQueryCompiler):
     def to_numpy(self, **kwargs: Any) -> np.ndarray:
         return self._modin_frame.to_numpy(**kwargs)
 
+    def to_interchange_dataframe(self, nan_as_null: bool = False, allow_copy: bool = True):
+        """Native-buffer protocol producer: per-column, zero-copy over
+        host caches, one device fetch per requested computed column — no
+        intermediate pandas frame (ref: pandas/interchange/, 2,228 LoC)."""
+        from modin_tpu.core.dataframe.tpu.interchange.dataframe import (
+            TpuDataFrameXchg,
+        )
+
+        return TpuDataFrameXchg(
+            self._modin_frame, nan_as_null=nan_as_null, allow_copy=allow_copy
+        )
+
     def copy(self) -> "TpuQueryCompiler":
         return type(self)(self._modin_frame.copy(), self._shape_hint)
 

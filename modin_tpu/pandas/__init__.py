@@ -51,7 +51,6 @@ from pandas import (  # noqa: F401
     UInt16Dtype,
     UInt32Dtype,
     UInt64Dtype,
-    api,
     array,
     arrays,
     describe_option,
@@ -151,6 +150,7 @@ from modin_tpu.pandas.io import (  # noqa: E402,F401
     read_xml,
     to_pickle,
 )
+from modin_tpu.pandas import api  # noqa: E402,F401
 from modin_tpu.pandas.plotting import Plotting as plotting  # noqa: E402,F401
 
 __all__ = [  # noqa: F405

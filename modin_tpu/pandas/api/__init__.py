@@ -1,3 +1,9 @@
-"""Public extension APIs (reference: modin/pandas/api/)."""
+"""Public extension APIs (reference: modin/pandas/api/).
 
-from modin_tpu.pandas.api import extensions  # noqa: F401
+``interchange`` is the modin_tpu consumer; the pandas utility namespaces
+(``types``, ``indexers``, ``typing``, ``executors``) pass through unchanged.
+"""
+
+from pandas.api import executors, indexers, types, typing  # noqa: F401
+
+from modin_tpu.pandas.api import extensions, interchange  # noqa: F401

@@ -1,4 +1,12 @@
-"""Accessor registration (reference: modin/pandas/api/extensions/)."""
+"""Accessor registration (reference: modin/pandas/api/extensions/).
+
+pandas.api.extensions contents (no_default, ExtensionDtype, take, the
+extension-dtype registrars, ...) pass through so this namespace is a
+drop-in superset of pandas'.
+"""
+
+from pandas.api.extensions import *  # noqa: F401,F403
+from pandas.api.extensions import no_default  # noqa: F401  (not in __all__)
 
 from modin_tpu.pandas.api.extensions.extensions import (  # noqa: F401
     register_base_accessor,

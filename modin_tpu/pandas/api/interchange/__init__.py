@@ -1,0 +1,20 @@
+"""Interchange-protocol consumer entry point (mirrors pandas.api.interchange)."""
+
+from typing import Any
+
+
+def from_dataframe(df: Any, allow_copy: bool = True):
+    """Build a modin_tpu DataFrame from any __dataframe__ protocol object."""
+    from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+        FactoryDispatcher,
+    )
+    from modin_tpu.pandas.dataframe import DataFrame
+
+    if hasattr(df, "__dataframe__"):
+        df = df.__dataframe__(allow_copy=allow_copy)
+    return DataFrame(
+        query_compiler=FactoryDispatcher.from_interchange_dataframe(df)
+    )
+
+
+__all__ = ["from_dataframe"]

@@ -1,0 +1,87 @@
+"""DataFrame interchange protocol tests (native-buffer producer)."""
+
+import numpy as np
+import pandas
+import pytest
+from pandas.api.interchange import from_dataframe
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs
+
+_rng = np.random.default_rng(21)
+N = 500
+
+
+@pytest.fixture
+def frames():
+    data = {
+        "f": np.where(_rng.random(N) < 0.1, np.nan, _rng.normal(size=N)),
+        "i": _rng.integers(-100, 100, N),
+        "u": _rng.integers(0, 100, N).astype(np.uint32),
+        "b": _rng.random(N) < 0.5,
+        "dt": np.datetime64("2024-01-01", "ns")
+        + _rng.integers(0, 10**9, N).astype("timedelta64[ns]"),
+        "s": np.array([f"name_{i % 7}" for i in range(N)]),
+    }
+    return create_test_dfs(data)
+
+
+def test_round_trip_matches_pandas_producer(frames):
+    md, pdf = frames
+    got = from_dataframe(md.__dataframe__())
+    want = from_dataframe(pdf.__dataframe__())
+    pandas.testing.assert_frame_equal(got, want)
+
+
+def test_zero_copy_over_host_cache(frames):
+    md, _ = frames
+    dfx = md.__dataframe__()
+    buf, _dtype = dfx.get_column_by_name("i").get_buffers()["data"]
+    cache = md._query_compiler._modin_frame.get_column(1).host_cache
+    assert buf.ptr == cache.__array_interface__["data"][0]
+
+
+def test_no_full_frame_materialization(frames):
+    # consuming one column must not call to_pandas on the whole frame
+    md, _ = frames
+    qc = md._query_compiler
+    called = {"n": 0}
+    original = type(qc._modin_frame).to_pandas
+
+    def spy(self):
+        called["n"] += 1
+        return original(self)
+
+    type(qc._modin_frame).to_pandas = spy
+    try:
+        col = md.__dataframe__().get_column_by_name("f")
+        _ = col.get_buffers()
+    finally:
+        type(qc._modin_frame).to_pandas = original
+    assert called["n"] == 0
+
+
+def test_computed_columns_interchange(frames):
+    md, pdf = frames
+    derived_md = md[["f"]] * 2.0
+    got = from_dataframe(derived_md.__dataframe__())
+    np.testing.assert_allclose(
+        got["f"].to_numpy(), (pdf[["f"]] * 2.0)["f"].to_numpy()
+    )
+
+
+def test_select_columns(frames):
+    md, pdf = frames
+    sub = md.__dataframe__().select_columns_by_name(["i", "b"])
+    got = from_dataframe(sub)
+    want = from_dataframe(pdf[["i", "b"]].__dataframe__())
+    pandas.testing.assert_frame_equal(got, want)
+
+
+def test_from_interchange_consumer(frames):
+    # our side as CONSUMER of a foreign protocol object
+    _, pdf = frames
+    md = pd.api.interchange.from_dataframe(pdf.__dataframe__())
+    pandas.testing.assert_frame_equal(
+        md.modin.to_pandas(), from_dataframe(pdf.__dataframe__())
+    )

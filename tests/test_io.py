@@ -190,3 +190,84 @@ class TestParallelPathEngages:
         ranges = split_record_ranges(bytes(body), 2, 8, max_chunks=16)
         assert ranges[-1][1] == len(body)
         assert sum(e - s for s, e in ranges) == len(body) - 2
+
+
+class TestParallelJSONFWF:
+    def test_read_json_lines_parallel(self, tmp_path, monkeypatch):
+        import modin_tpu.core.io.text.json_dispatcher as disp
+
+        rng = np.random.default_rng(5)
+        n = 30_000
+        pdf = pandas.DataFrame(
+            {
+                "a": rng.normal(size=n),
+                "b": rng.integers(0, 100, n),
+                "s": np.array([f'v_{i % 40}"x' for i in range(n)]),
+            }
+        )
+        path = tmp_path / "data.jsonl"
+        pdf.to_json(path, orient="records", lines=True)
+
+        calls = {"parallel": 0}
+        orig = disp.JSONDispatcher._read_parallel.__func__
+
+        def spy(cls, p, kwargs):
+            calls["parallel"] += 1
+            return orig(cls, p, kwargs)
+
+        monkeypatch.setattr(disp.JSONDispatcher, "_read_parallel", classmethod(spy))
+        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        md = pd.read_json(str(path), lines=True)
+        assert calls["parallel"] == 1
+        df_equals(md, pandas.read_json(path, lines=True))
+
+    def test_read_json_non_lines_falls_back(self, tmp_path):
+        pdf = pandas.DataFrame({"a": [1, 2, 3]})
+        path = tmp_path / "plain.json"
+        pdf.to_json(path)
+        df_equals(pd.read_json(str(path)), pandas.read_json(path))
+
+    @pytest.mark.parametrize("colspec_mode", ["infer", "explicit", "widths"])
+    def test_read_fwf_parallel(self, tmp_path, monkeypatch, colspec_mode):
+        import modin_tpu.core.io.text.fwf_dispatcher as disp
+
+        n = 20_000
+        path = tmp_path / "data.fwf"
+        with open(path, "w") as f:
+            f.write("%-12s%-10s%-14s\n" % ("alpha", "beta", "gamma"))
+            for i in range(n):
+                f.write("%-12d%-10.3f%-14s\n" % (i, i * 0.5, f"tag{i % 9}"))
+
+        kwargs = {}
+        if colspec_mode == "explicit":
+            kwargs["colspecs"] = [(0, 12), (12, 22), (22, 36)]
+        elif colspec_mode == "widths":
+            kwargs["widths"] = [12, 10, 14]
+
+        calls = {"parallel": 0}
+        orig = disp.FWFDispatcher._read_parallel.__func__
+
+        def spy(cls, p, kw):
+            calls["parallel"] += 1
+            return orig(cls, p, kw)
+
+        monkeypatch.setattr(disp.FWFDispatcher, "_read_parallel", classmethod(spy))
+        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        md = pd.read_fwf(str(path), **kwargs)
+        assert calls["parallel"] == 1
+        df_equals(md, pandas.read_fwf(path, **kwargs))
+
+    def test_read_fwf_skiprows(self, tmp_path, monkeypatch):
+        import modin_tpu.core.io.text.fwf_dispatcher as disp
+
+        path = tmp_path / "skip.fwf"
+        with open(path, "w") as f:
+            f.write("junk line\n")
+            f.write("%-8s%-8s\n" % ("x", "y"))
+            for i in range(5_000):
+                f.write("%-8d%-8d\n" % (i, i * 2))
+        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        df_equals(
+            pd.read_fwf(str(path), skiprows=1),
+            pandas.read_fwf(path, skiprows=1),
+        )
