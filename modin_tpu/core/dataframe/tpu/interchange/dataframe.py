@@ -232,4 +232,15 @@ class TpuDataFrameXchg:
         return self.select_columns(positions)
 
     def get_chunks(self, n_chunks: Optional[int] = None) -> Iterable["TpuDataFrameXchg"]:
-        yield self
+        if not n_chunks or n_chunks <= 1:
+            yield self
+            return
+        # the spec requires subdividing when the consumer asks for chunks
+        n = len(self._frame)
+        step = -(-n // n_chunks)
+        for start in range(0, max(n, 1), max(step, 1)):
+            yield TpuDataFrameXchg(
+                self._frame.take_rows_positional(slice(start, min(start + step, n))),
+                self._nan_as_null,
+                self._allow_copy,
+            )

@@ -29,6 +29,42 @@ class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
     def _read(cls, *args: Any, **kwargs: Any):
         raise NotImplementedError(NOT_IMPLEMENTED_MESSAGE)
 
+    # ---- shared parallel-read template (text dispatchers) ------------- #
+
+    MIN_PARALLEL_BYTES = 8 << 20  # below this a single parse wins
+
+    @classmethod
+    def _read_gated(cls, raw_path: Any, path_key: str, kwargs: dict):
+        """Route to _read_parallel when the chunked path applies, else the
+        serial fallback; any parallel-path error degrades to the fallback
+        (correct, just serial)."""
+        path = cls.get_path(raw_path) if isinstance(raw_path, str) else raw_path
+        if (
+            not cls.is_local_plain_file(path)
+            or not cls._can_parallelize({**kwargs, path_key: path})
+            or cls.file_size(path) < cls.MIN_PARALLEL_BYTES
+        ):
+            return cls._read_fallback(path, kwargs)
+        try:
+            return cls._read_parallel(path, kwargs)
+        except Exception:
+            return cls._read_fallback(path, kwargs)
+
+    @classmethod
+    def _parse_ranges_threaded(cls, ranges: list, parse) -> list:
+        """Parse record-aligned byte ranges on a thread pool (the pandas C
+        parsers release the GIL)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from modin_tpu.config import CpuCount
+
+        if len(ranges) == 1:
+            return [parse(ranges[0])]
+        with ThreadPoolExecutor(
+            max_workers=min(CpuCount.get(), len(ranges))
+        ) as pool:
+            return list(pool.map(parse, ranges))
+
     @classmethod
     def get_path(cls, file_path: str) -> str:
         if isinstance(file_path, str) and file_path.startswith("~"):

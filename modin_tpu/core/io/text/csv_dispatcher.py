@@ -17,18 +17,14 @@ single pandas parse — correct, just serial.
 from __future__ import annotations
 
 import io
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional
 
 import numpy as np
 import pandas
 
-from modin_tpu.config import CpuCount, NPartitions
+from modin_tpu.config import CpuCount
 from modin_tpu.core.io.chunker import find_header_end, split_record_ranges
 from modin_tpu.core.io.file_dispatcher import FileDispatcher
-
-_MIN_PARALLEL_BYTES = 8 << 20  # below this a single parse wins
-
 
 class CSVDispatcher(FileDispatcher):
     """read_csv with record-aligned byte-range parallelism."""
@@ -83,17 +79,7 @@ class CSVDispatcher(FileDispatcher):
 
     @classmethod
     def _read(cls, filepath_or_buffer: Any = None, **kwargs: Any):
-        path = cls.get_path(filepath_or_buffer) if isinstance(filepath_or_buffer, str) else filepath_or_buffer
-        if (
-            not cls.is_local_plain_file(path)
-            or not cls._can_parallelize({**kwargs, "filepath_or_buffer": path})
-            or cls.file_size(path) < _MIN_PARALLEL_BYTES
-        ):
-            return cls._read_fallback(path, kwargs)
-        try:
-            return cls._read_parallel(path, kwargs)
-        except Exception:
-            return cls._read_fallback(path, kwargs)
+        return cls._read_gated(filepath_or_buffer, "filepath_or_buffer", kwargs)
 
     @classmethod
     def _read_fallback(cls, path: Any, kwargs: dict):
@@ -146,11 +132,7 @@ class CSVDispatcher(FileDispatcher):
             start, end = rng
             return cls.read_fn(io.BytesIO(bytes(buf[start:end])), **body_kwargs)
 
-        if len(ranges) == 1:
-            frames = [parse(ranges[0])]
-        else:
-            with ThreadPoolExecutor(max_workers=min(CpuCount.get(), len(ranges))) as pool:
-                frames = list(pool.map(parse, ranges))
+        frames = cls._parse_ranges_threaded(ranges, parse)
 
         # 5. assemble and hand to the storage format (device upload happens in
         # from_pandas; column-wise concat keeps peak memory bounded)

@@ -14,7 +14,6 @@ Fixed-width files have no quoting, so record boundaries are plain newlines
 from __future__ import annotations
 
 import io
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import pandas
@@ -23,7 +22,6 @@ from modin_tpu.config import CpuCount
 from modin_tpu.core.io.chunker import find_header_end, split_record_ranges
 from modin_tpu.core.io.file_dispatcher import FileDispatcher
 
-_MIN_PARALLEL_BYTES = 8 << 20
 _NO_QUOTE = "\x00"  # disables quote-parity in the newline scan
 
 
@@ -70,21 +68,7 @@ class FWFDispatcher(FileDispatcher):
 
     @classmethod
     def _read(cls, filepath_or_buffer: Any = None, **kwargs: Any):
-        path = (
-            cls.get_path(filepath_or_buffer)
-            if isinstance(filepath_or_buffer, str)
-            else filepath_or_buffer
-        )
-        if (
-            not cls.is_local_plain_file(path)
-            or not cls._can_parallelize({**kwargs, "filepath_or_buffer": path})
-            or cls.file_size(path) < _MIN_PARALLEL_BYTES
-        ):
-            return cls._read_fallback(path, kwargs)
-        try:
-            return cls._read_parallel(path, kwargs)
-        except Exception:
-            return cls._read_fallback(path, kwargs)
+        return cls._read_gated(filepath_or_buffer, "filepath_or_buffer", kwargs)
 
     @classmethod
     def _read_fallback(cls, path: Any, kwargs: dict):
@@ -158,12 +142,6 @@ class FWFDispatcher(FileDispatcher):
             start, end = rng
             return cls.read_fn(io.BytesIO(bytes(buf[start:end])), **body_kwargs)
 
-        if len(ranges) == 1:
-            frames = [parse(ranges[0])]
-        else:
-            with ThreadPoolExecutor(
-                max_workers=min(CpuCount.get(), len(ranges))
-            ) as pool:
-                frames = list(pool.map(parse, ranges))
+        frames = cls._parse_ranges_threaded(ranges, parse)
         result = pandas.concat(frames, ignore_index=True, copy=False)
         return cls.query_compiler_cls.from_pandas(result, cls.frame_cls)

@@ -12,7 +12,6 @@ back to a single pandas parse.
 from __future__ import annotations
 
 import io
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import pandas
@@ -21,7 +20,6 @@ from modin_tpu.config import CpuCount
 from modin_tpu.core.io.chunker import split_record_ranges
 from modin_tpu.core.io.file_dispatcher import FileDispatcher
 
-_MIN_PARALLEL_BYTES = 8 << 20
 
 
 class JSONDispatcher(FileDispatcher):
@@ -65,17 +63,7 @@ class JSONDispatcher(FileDispatcher):
 
     @classmethod
     def _read(cls, path_or_buf: Any = None, **kwargs: Any):
-        path = cls.get_path(path_or_buf) if isinstance(path_or_buf, str) else path_or_buf
-        if (
-            not cls.is_local_plain_file(path)
-            or not cls._can_parallelize({**kwargs, "path_or_buf": path})
-            or cls.file_size(path) < _MIN_PARALLEL_BYTES
-        ):
-            return cls._read_fallback(path, kwargs)
-        try:
-            return cls._read_parallel(path, kwargs)
-        except Exception:
-            return cls._read_fallback(path, kwargs)
+        return cls._read_gated(path_or_buf, "path_or_buf", kwargs)
 
     @classmethod
     def _read_fallback(cls, path: Any, kwargs: dict):
@@ -109,12 +97,6 @@ class JSONDispatcher(FileDispatcher):
             start, end = rng
             return cls.read_fn(io.BytesIO(bytes(buf[start:end])), **kwargs)
 
-        if len(ranges) == 1:
-            frames = [parse(ranges[0])]
-        else:
-            with ThreadPoolExecutor(
-                max_workers=min(CpuCount.get(), len(ranges))
-            ) as pool:
-                frames = list(pool.map(parse, ranges))
+        frames = cls._parse_ranges_threaded(ranges, parse)
         result = pandas.concat(frames, ignore_index=True, copy=False)
         return cls.query_compiler_cls.from_pandas(result, cls.frame_cls)

@@ -1359,8 +1359,14 @@ class TpuQueryCompiler(BaseQueryCompiler):
         for key in ("center", "win_type", "on", "closed", "step"):
             if rolling_kwargs.get(key) not in (None, False):
                 return None
-        if rolling_kwargs.get("method", "single") != "single" or kwargs:
+        if rolling_kwargs.get("method", "single") != "single":
             return None
+        extra = dict(kwargs)
+        ddof = extra.pop("ddof", 1) if op in ("var", "std", "sem") else 1
+        if extra.pop("numeric_only", False):
+            return None  # changes column selection: pandas fallback
+        if extra or not isinstance(ddof, (int, np.integer)):
+            return None  # unknown kwargs (incl. ddof on sum/...): pandas raises
         min_periods = rolling_kwargs.get("min_periods")
         if min_periods is None:
             min_periods = int(window)  # pandas >= 2: count defaults like the rest
@@ -1376,27 +1382,36 @@ class TpuQueryCompiler(BaseQueryCompiler):
         frame.materialize_device()
         datas = rolling_reduce(
             op, [c.data for c in frame._columns], len(frame), int(window),
-            int(min_periods),
+            int(min_periods), int(ddof),
         )
         return self._wrap_device_result(datas)
 
-    def rolling_sum(self, rolling_kwargs: dict, *args: Any, **kwargs: Any):
-        result = self._try_device_rolling("sum", rolling_kwargs, kwargs) if not args else None
-        if result is not None:
-            return result
-        return super().rolling_sum(rolling_kwargs, *args, **kwargs)
+    def _try_device_expanding(self, op: str, expanding_args: list, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        from modin_tpu.ops.window import expanding_reduce
 
-    def rolling_mean(self, rolling_kwargs: dict, *args: Any, **kwargs: Any):
-        result = self._try_device_rolling("mean", rolling_kwargs, kwargs) if not args else None
-        if result is not None:
-            return result
-        return super().rolling_mean(rolling_kwargs, *args, **kwargs)
-
-    def rolling_count(self, rolling_kwargs: dict, *args: Any, **kwargs: Any):
-        result = self._try_device_rolling("count", rolling_kwargs, kwargs) if not args else None
-        if result is not None:
-            return result
-        return super().rolling_count(rolling_kwargs, *args, **kwargs)
+        min_periods = expanding_args[0] if expanding_args else 1
+        method = expanding_args[1] if len(expanding_args) > 1 else "single"
+        if method != "single":
+            return None
+        if not isinstance(min_periods, (int, np.integer)) or min_periods < 0:
+            return None
+        extra = dict(kwargs)
+        ddof = extra.pop("ddof", 1) if op in ("var", "std", "sem") else 1
+        if extra.pop("numeric_only", False):
+            return None
+        if extra or not isinstance(ddof, (int, np.integer)):
+            return None  # unknown kwargs (incl. ddof on sum/...): pandas raises
+        frame = self._modin_frame
+        if len(frame) == 0 or not all(
+            c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns
+        ):
+            return None
+        frame.materialize_device()
+        datas = expanding_reduce(
+            op, [c.data for c in frame._columns], len(frame),
+            int(min_periods), int(ddof),
+        )
+        return self._wrap_device_result(datas)
 
     # ----------------------------- groupby ---------------------------- #
 
@@ -1822,6 +1837,50 @@ def _make_nonskipna_reduce_override(op: str):
 
 for _op in ["count", "any", "all"]:
     setattr(TpuQueryCompiler, _op, _make_nonskipna_reduce_override(_op))
+
+def _make_rolling_override(op: str):
+    def method(self, rolling_kwargs: dict, *args: Any, **kwargs: Any):
+        result = (
+            self._try_device_rolling(op, rolling_kwargs, dict(kwargs))
+            if not args
+            else None
+        )
+        if result is not None:
+            return result
+        return getattr(super(TpuQueryCompiler, self), f"rolling_{op}")(
+            rolling_kwargs, *args, **kwargs
+        )
+
+    method.__name__ = f"rolling_{op}"
+    return method
+
+
+def _make_expanding_override(op: str):
+    def method(self, expanding_args: list, *args: Any, **kwargs: Any):
+        result = (
+            self._try_device_expanding(op, list(expanding_args), dict(kwargs))
+            if not args
+            else None
+        )
+        if result is not None:
+            return result
+        return getattr(super(TpuQueryCompiler, self), f"expanding_{op}")(
+            expanding_args, *args, **kwargs
+        )
+
+    method.__name__ = f"expanding_{op}"
+    return method
+
+
+from modin_tpu.ops.window import (  # noqa: E402
+    EXPANDING_DEVICE_OPS as _EXP_OPS,
+    ROLLING_DEVICE_OPS as _ROLL_OPS,
+)
+
+for _op in _ROLL_OPS:
+    setattr(TpuQueryCompiler, f"rolling_{_op}", _make_rolling_override(_op))
+for _op in _EXP_OPS:
+    setattr(TpuQueryCompiler, f"expanding_{_op}", _make_expanding_override(_op))
 
 # the generated overrides above were installed after __init_subclass__ ran,
 # so they need the backend-caster wrap applied explicitly

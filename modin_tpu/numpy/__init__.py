@@ -8,6 +8,7 @@ numpy itself (operating on materialized data).
 
 from __future__ import annotations
 
+from builtins import any as _builtins_any
 from typing import Any, Optional
 
 import numpy as _np
@@ -47,7 +48,7 @@ sign = _make_unary("sign")
 
 def absolute(a: Any, *args: Any, **kwargs: Any):
     if isinstance(a, array):
-        return abs(a)
+        return a.__abs__()  # module-level ``abs`` aliases this function
     return _np.absolute(a, *args, **kwargs)
 
 
@@ -179,6 +180,131 @@ def linspace(*args: Any, **kwargs: Any) -> array:
 
 def asarray(a: Any, dtype: Any = None) -> array:
     return _as_modin_array(a) if dtype is None else array(a, dtype=dtype)
+
+
+# --- logic / predicates ---------------------------------------------------- #
+
+def _make_predicate(name: str):
+    def fn(a: Any, *args: Any, **kwargs: Any):
+        if isinstance(a, array):
+            return array(getattr(_np, name)(_np.asarray(a), *args, **kwargs))
+        return getattr(_np, name)(a, *args, **kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+isfinite = _make_predicate("isfinite")
+isinf = _make_predicate("isinf")
+isnan = _make_predicate("isnan")
+isnat = _make_predicate("isnat")
+isneginf = _make_predicate("isneginf")
+isposinf = _make_predicate("isposinf")
+iscomplex = _make_predicate("iscomplex")
+isreal = _make_predicate("isreal")
+logical_not = _make_predicate("logical_not")
+
+
+def isscalar(element: Any) -> bool:
+    if isinstance(element, array):
+        return False
+    return _np.isscalar(element)
+
+
+# --- shaping --------------------------------------------------------------- #
+
+def ravel(a: Any, order: str = "C"):
+    if isinstance(a, array):
+        return array(_np.ravel(_np.asarray(a), order=order))
+    return _np.ravel(a, order=order)
+
+
+def shape(a: Any) -> tuple:
+    if isinstance(a, array):
+        return a.shape
+    return _np.shape(a)
+
+
+def transpose(a: Any, axes: Any = None):
+    if isinstance(a, array):
+        return a.T if axes is None else array(_np.transpose(_np.asarray(a), axes))
+    return _np.transpose(a, axes)
+
+
+def split(a: Any, indices_or_sections: Any, axis: int = 0) -> list:
+    parts = _np.split(_np.asarray(a), indices_or_sections, axis=axis)
+    if isinstance(a, array):
+        return [array(p) for p in parts]
+    return parts
+
+
+def hstack(tup: Any, dtype: Any = None, casting: str = "same_kind"):
+    arrays_np = [_np.asarray(t) for t in tup]
+    out = _np.hstack(arrays_np, dtype=dtype, casting=casting)
+    if _builtins_any(isinstance(t, array) for t in tup):
+        return array(out)
+    return out
+
+
+def append(arr: Any, values: Any, axis: Optional[int] = None):
+    out = _np.append(_np.asarray(arr), _np.asarray(values), axis=axis)
+    if isinstance(arr, array):
+        return array(out)
+    return out
+
+
+def tri(N: int, M: Optional[int] = None, k: int = 0, dtype: Any = float) -> array:
+    return array(_np.tri(N, M=M, k=k, dtype=dtype))
+
+
+# --- arg-reductions -------------------------------------------------------- #
+
+def _make_arg_reduction(name: str):
+    def fn(a: Any, axis: Optional[int] = None, out: Any = None, *, keepdims: Any = None):
+        kw = {} if keepdims is None else {"keepdims": keepdims}
+        result = getattr(_np, name)(_np.asarray(a), axis=axis, out=out, **kw)
+        if isinstance(a, array) and getattr(result, "ndim", 0) > 0:
+            return array(result)
+        return result
+
+    fn.__name__ = name
+    return fn
+
+
+argmax = _make_arg_reduction("argmax")
+argmin = _make_arg_reduction("argmin")
+
+
+float_power = _make_binary("float_power", "pow")
+abs = absolute  # noqa: A001
+max = amax  # noqa: A001
+min = amin  # noqa: A001
+
+# --- constants ------------------------------------------------------------- #
+
+e = _np.e
+euler_gamma = _np.euler_gamma
+inf = _np.inf
+nan = _np.nan
+newaxis = _np.newaxis
+pi = _np.pi
+
+from modin_tpu.numpy import linalg  # noqa: E402,F401
+
+__all__ = [  # noqa: F405
+    "linalg", "array", "zeros_like", "ones_like", "ravel", "shape",
+    "transpose", "all", "any", "isfinite", "isinf", "isnan", "isnat",
+    "isneginf", "isposinf", "iscomplex", "isreal", "isscalar",
+    "logical_not", "logical_and", "logical_or", "logical_xor", "greater",
+    "greater_equal", "less", "less_equal", "equal", "not_equal", "absolute",
+    "abs", "add", "divide", "dot", "float_power", "floor_divide", "power",
+    "prod", "multiply", "remainder", "mod", "subtract", "sum",
+    "true_divide", "mean", "maximum", "amax", "max", "minimum", "amin",
+    "min", "where", "e", "euler_gamma", "inf", "nan", "newaxis", "pi",
+    "sqrt", "tanh", "exp", "argmax", "argmin", "var", "std", "split",
+    "hstack", "append", "tri", "zeros", "ones", "arange", "linspace",
+    "asarray",
+]
 
 
 def __getattr__(name: str) -> Any:

@@ -1,0 +1,20 @@
+"""``modin_tpu.numpy.linalg`` (reference: modin/numpy/linalg.py — norm)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as _np
+
+from modin_tpu.numpy.arr import array
+
+
+def norm(x: Any, ord: Any = None, axis: Optional[int] = None, keepdims: bool = False):
+    values = _np.asarray(x)
+    result = _np.linalg.norm(values, ord=ord, axis=axis, keepdims=keepdims)
+    if isinstance(x, array) and getattr(result, "ndim", 0) > 0:
+        return array(result)
+    return result
+
+
+__all__ = ["norm"]

@@ -1,10 +1,18 @@
-"""Rolling-window device kernels (the reference's Fold operator family).
+"""Rolling/expanding-window device kernels (the reference's Fold operators).
 
 Reference design: modin/core/dataframe/algebra/fold.py:28 + window.py — the
 reference ships whole row blocks to workers and runs pandas.rolling per
-partition.  Here a rolling sum/count is two cumulative sums and a shifted
-difference — O(n) bandwidth-bound work that XLA fuses into one kernel, with
-pandas' min_periods/NaN semantics applied via the non-NaN count.
+partition.  Here every windowed aggregation is O(n) compiled work:
+
+- sum/mean/count/var/std: cumulative sums and shifted differences (var uses
+  windowed Σx and Σx² over a globally centered column, which removes the
+  E[x²]−E[x]² cancellation);
+- min/max: the van Herk/Gil-Werman two-pass — block prefix/suffix extrema
+  give any window extremum as max(suffix[i−w+1], prefix[i]) in O(n),
+  independent of window size;
+- expanding_*: the same formulas with the prefix itself as the window.
+
+pandas' min_periods/NaN semantics apply via the windowed non-NaN count.
 """
 
 from __future__ import annotations
@@ -14,40 +22,104 @@ from typing import Any, List, Tuple
 
 import numpy as np
 
+ROLLING_DEVICE_OPS = ("sum", "mean", "count", "min", "max", "var", "std", "sem")
+EXPANDING_DEVICE_OPS = ("sum", "mean", "count", "min", "max", "var", "std", "sem")
 
-@functools.lru_cache(maxsize=None)
-def _jit_rolling(op: str, n_cols: int, n: int, window: int, min_periods: int):
-    import jax
+
+def _windowed(arr, window: int):
+    """arr[i] - arr[i-window] (prefix-sum difference), pad-agnostic."""
     import jax.numpy as jnp
 
-    def one(c):
-        is_f = jnp.issubdtype(c.dtype, jnp.floating)
-        valid = jnp.arange(c.shape[0]) < n
-        nanm = (jnp.isnan(c) | ~valid) if is_f else ~valid
-        x = jnp.where(nanm, 0, c).astype(jnp.float64)
-        cnt = (~nanm).astype(jnp.int64)
-        cs = jnp.cumsum(x)
-        cc = jnp.cumsum(cnt)
-        # windowed sums: cs[i] - cs[i-window]
-        shifted = jnp.concatenate([jnp.zeros(window, cs.dtype), cs[:-window]]) if window <= cs.shape[0] else jnp.zeros_like(cs)
-        shifted_c = jnp.concatenate([jnp.zeros(window, cc.dtype), cc[:-window]]) if window <= cc.shape[0] else jnp.zeros_like(cc)
-        wsum = cs - shifted
-        wcnt = cc - shifted_c
-        if op == "count":
-            # pandas gates count on the number of ROWS in the window (NaNs
-            # included), unlike other aggs which gate on non-NaN observations.
-            wrows = jnp.minimum(jnp.arange(c.shape[0]) + 1, window)
-            return jnp.where(wrows >= min_periods, wcnt.astype(jnp.float64), jnp.nan)
-        if op == "sum":
-            # pandas: min_periods=0 makes an all-NaN/empty window sum 0.0
-            return jnp.where(wcnt >= min_periods, wsum, jnp.nan)
-        if op == "mean":
-            res = wsum / jnp.maximum(wcnt, 1)
-            return jnp.where((wcnt >= min_periods) & (wcnt > 0), res, jnp.nan)
-        raise ValueError(op)
+    if window > arr.shape[0]:
+        return arr
+    shifted = jnp.concatenate([jnp.zeros(window, arr.dtype), arr[:-window]])
+    return arr - shifted
+
+
+def _van_herk(x, window: int, op: str):
+    """Windowed min/max in O(n): block prefix/suffix extrema.
+
+    For window [s, i] (s = i-w+1) spanning blocks b-1 and b of width w,
+    suffix[s] covers [s, end of b-1] and prefix[i] covers [start of b, i];
+    their cum is exactly the window.  Leading incomplete windows (i < w-1)
+    are prefix[i] alone — suffix[0] would leak future rows into them.
+    """
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    P = x.shape[0]
+    w = min(window, P)
+    nb = (P + w - 1) // w
+    pad = nb * w - P
+    neutral = jnp.inf if op == "min" else -jnp.inf
+    xp = jnp.concatenate([x, jnp.full(pad, neutral, x.dtype)]) if pad else x
+    blocks = xp.reshape(nb, w)
+    cum = jnp.minimum if op == "min" else jnp.maximum
+    prefix = lax.associative_scan(cum, blocks, axis=1).reshape(-1)[:P]
+    suffix = lax.associative_scan(cum, blocks, axis=1, reverse=True).reshape(-1)[:P]
+    idx = jnp.arange(P)
+    start = jnp.maximum(idx - w + 1, 0)
+    out = cum(jnp.take(suffix, start), prefix)
+    return jnp.where(idx < w - 1, prefix, out)
+
+
+def _one_windowed(op: str, c, n: int, window: int, min_periods: int, ddof: int):
+    import jax.numpy as jnp
+
+    is_f = jnp.issubdtype(c.dtype, jnp.floating)
+    valid = jnp.arange(c.shape[0]) < n
+    # pandas _prep_values treats +/-inf as missing in every window agg
+    nanm = ((jnp.isnan(c) | jnp.isinf(c)) | ~valid) if is_f else ~valid
+    cnt = (~nanm).astype(jnp.int64)
+    wcnt = _windowed(jnp.cumsum(cnt), window)
+
+    if op == "count":
+        # pandas gates count on ROWS in the window (NaNs included)
+        wrows = jnp.minimum(jnp.arange(c.shape[0]) + 1, window)
+        return jnp.where(wrows >= min_periods, wcnt.astype(jnp.float64), jnp.nan)
+
+    if op in ("min", "max"):
+        neutral = jnp.inf if op == "min" else -jnp.inf
+        x = jnp.where(nanm, neutral, c).astype(jnp.float64)
+        r = _van_herk(x, window, op)
+        return jnp.where(wcnt >= jnp.maximum(min_periods, 1), r, jnp.nan)
+
+    x = jnp.where(nanm, 0, c).astype(jnp.float64)
+    if op in ("var", "std", "sem"):
+        # center globally first: windowed variance is shift-invariant and
+        # Σx² − (Σx)²/n over centered values avoids catastrophic cancellation
+        total_cnt = jnp.maximum(jnp.sum(cnt), 1)
+        gmean = jnp.sum(x) / total_cnt
+        x = jnp.where(nanm, 0.0, x - gmean)
+    wsum = _windowed(jnp.cumsum(x), window)
+
+    if op == "sum":
+        return jnp.where(wcnt >= min_periods, wsum, jnp.nan)
+    if op == "mean":
+        res = wsum / jnp.maximum(wcnt, 1)
+        return jnp.where((wcnt >= min_periods) & (wcnt > 0), res, jnp.nan)
+    # var/std/sem
+    wsum2 = _windowed(jnp.cumsum(x * x), window)
+    cntf = jnp.maximum(wcnt, 1).astype(jnp.float64)
+    var = (wsum2 - wsum * wsum / cntf) / jnp.maximum(wcnt - ddof, 1)
+    var = jnp.maximum(var, 0.0)  # guard tiny negative rounding
+    gate = (wcnt >= jnp.maximum(min_periods, 1)) & (wcnt - ddof > 0)
+    var = jnp.where(gate, var, jnp.nan)
+    if op == "var":
+        return var
+    if op == "std":
+        return jnp.sqrt(var)
+    return jnp.sqrt(var / cntf)  # sem
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_rolling(op: str, n_cols: int, n: int, window: int, min_periods: int, ddof: int):
+    import jax
 
     def fn(cols: Tuple):
-        return tuple(one(c) for c in cols)
+        return tuple(
+            _one_windowed(op, c, n, window, min_periods, ddof) for c in cols
+        )
 
     return jax.jit(fn)
 
@@ -58,7 +130,17 @@ def rolling_reduce(
     n: int,
     window: int,
     min_periods: int,
+    ddof: int = 1,
 ) -> List[Any]:
-    """Rolling sum/mean/count over padded columns; one jit for the frame."""
-    fn = _jit_rolling(op, len(cols), int(n), int(window), int(min_periods))
+    """Rolling aggregation over padded columns; one jit for the frame."""
+    fn = _jit_rolling(op, len(cols), int(n), int(window), int(min_periods), int(ddof))
     return list(fn(tuple(cols)))
+
+
+def expanding_reduce(
+    op: str, cols: List[Any], n: int, min_periods: int, ddof: int = 1
+) -> List[Any]:
+    """Expanding aggregation: exactly rolling with the full length as window
+    (the prefix-sum differences, van Herk blocks, and gating all degenerate
+    to the expanding forms when window >= n)."""
+    return rolling_reduce(op, cols, int(n), max(int(n), 1), int(min_periods), int(ddof))

@@ -406,6 +406,193 @@ class DataFrame:
     def min(self) -> "DataFrame":
         return self._from_md(self._md.min().to_frame().T)
 
+    def median(self) -> "DataFrame":
+        return self._from_md(self._md.median().to_frame().T)
+
+    def std(self, ddof: int = 1) -> "DataFrame":
+        return self._from_md(self._md.std(ddof=ddof).to_frame().T)
+
+    def var(self, ddof: int = 1) -> "DataFrame":
+        return self._from_md(self._md.var(ddof=ddof).to_frame().T)
+
+    def product(self) -> "DataFrame":
+        return self._from_md(self._md.prod().to_frame().T)
+
+    def quantile(self, quantile: float, interpolation: str = "nearest") -> "DataFrame":
+        return self._from_md(
+            self._md.quantile(quantile, interpolation=interpolation).to_frame().T
+        )
+
+    def n_unique(self) -> "DataFrame":
+        return self._from_md(self._md.nunique().to_frame().T)
+
+    def null_count(self) -> "DataFrame":
+        return self._from_md(self._md.isna().sum().to_frame().T)
+
+    def corr(self, **kwargs: Any) -> "DataFrame":
+        return self._from_md(self._md.corr(**kwargs).reset_index(drop=True))
+
+    # -- horizontal aggregations ---------------------------------------- #
+
+    def sum_horizontal(self) -> "Series":
+        return Series(_md=self._md.sum(axis=1).rename("sum"))
+
+    def mean_horizontal(self) -> "Series":
+        return Series(_md=self._md.mean(axis=1).rename("mean"))
+
+    def min_horizontal(self) -> "Series":
+        return Series(_md=self._md.min(axis=1).rename("min"))
+
+    def max_horizontal(self) -> "Series":
+        return Series(_md=self._md.max(axis=1).rename("max"))
+
+    # -- reshaping ------------------------------------------------------- #
+
+    def unpivot(
+        self,
+        on: Any = None,
+        *,
+        index: Any = None,
+        variable_name: str = "variable",
+        value_name: str = "value",
+    ) -> "DataFrame":
+        md = self._md.melt(
+            id_vars=index, value_vars=on,
+            var_name=variable_name, value_name=value_name,
+        )
+        return self._from_md(md)
+
+    melt = unpivot
+
+    def pivot(
+        self, on: str, *, index: Any = None, values: Any = None,
+        aggregate_function: str = "first",
+    ) -> "DataFrame":
+        md = self._md.pivot_table(
+            index=index, columns=on, values=values,
+            aggfunc=aggregate_function, sort=False,
+        )
+        return self._from_md(md.reset_index())
+
+    def transpose(self, include_header: bool = False) -> "DataFrame":
+        pdf = self.to_pandas().T.reset_index(drop=not include_header)
+        if include_header:
+            pdf = pdf.rename(columns={"index": "column"})
+        offset = 1 if include_header else 0  # data columns start at column_0
+        pdf.columns = [
+            c if isinstance(c, str) else f"column_{i - offset}"
+            for i, c in enumerate(pdf.columns)
+        ]
+        return DataFrame(pdf)
+
+    def reverse(self) -> "DataFrame":
+        return self._from_md(self._md.iloc[::-1].reset_index(drop=True))
+
+    def partition_by(self, by: Any, *more_by: str, as_dict: bool = False):
+        keys = ([by] if isinstance(by, str) else list(by)) + list(more_by)
+        pdf = self.to_pandas()
+        groups = list(pdf.groupby(keys, sort=False))
+        frames = [DataFrame(g.reset_index(drop=True)) for _, g in groups]
+        if as_dict:
+            return {k: f for (k, _), f in zip(groups, frames)}
+        return frames
+
+    # -- rows / export ---------------------------------------------------- #
+
+    def row(self, index: int, *, named: bool = False):
+        values = self.to_pandas().iloc[index]
+        if named:
+            return dict(values)
+        return tuple(values)
+
+    def rows(self, *, named: bool = False) -> list:
+        pdf = self.to_pandas()
+        if named:
+            return [dict(zip(pdf.columns, r)) for r in pdf.itertuples(index=False)]
+        return [tuple(r) for r in pdf.itertuples(index=False)]
+
+    def iter_rows(self, *, named: bool = False):
+        return iter(self.rows(named=named))
+
+    def iter_columns(self):
+        for c in self.columns:
+            yield self[c]
+
+    def to_dict(self, *, as_series: bool = True) -> dict:
+        if as_series:
+            return {c: self[c] for c in self.columns}
+        pdf = self.to_pandas()
+        return {c: pdf[c].tolist() for c in pdf.columns}
+
+    def to_dicts(self) -> list:
+        return self.rows(named=True)
+
+    def to_series(self, index: int = 0) -> "Series":
+        return self[self.columns[index]]
+
+    def to_struct(self, name: str = "") -> "Series":
+        return Series(_md=pandas_series_from(self.rows(named=True), name))
+
+    # -- column surgery --------------------------------------------------- #
+
+    def get_column_index(self, name: str) -> int:
+        return list(self.columns).index(name)
+
+    def insert_column(self, index: int, column: "Series") -> "DataFrame":
+        md = self._md.copy()
+        md.insert(index, column.name, column._md_series)
+        return self._from_md(md)
+
+    def replace_column(self, index: int, column: "Series") -> "DataFrame":
+        md = self._md.copy()
+        label = md.columns[index]
+        md[label] = column._md_series
+        return self._from_md(md.rename(columns={label: column.name}))
+
+    def drop_in_place(self, name: str) -> "Series":
+        series = self[name]
+        self._query_compiler = self._md.drop(columns=[name])._query_compiler
+        return series
+
+    def clear(self, n: int = 0) -> "DataFrame":
+        empty = self.to_pandas().iloc[:0]
+        if n == 0:
+            return DataFrame(empty)
+        # n null rows, keeping the original schema (polars semantics; int
+        # columns use pandas' nullable Int64 to hold nulls)
+        data = {}
+        for c in empty.columns:
+            dt = empty[c].dtype
+            if dt.kind in "iu":
+                data[c] = pandas.array([None] * n, dtype="Int64")
+            elif dt.kind == "f":
+                data[c] = pandas.array([np.nan] * n, dtype=dt)
+            elif dt.kind == "b":
+                data[c] = pandas.array([None] * n, dtype="boolean")
+            else:
+                data[c] = pandas.array([None] * n, dtype=dt)
+        return DataFrame(pandas.DataFrame(data))
+
+    def estimated_size(self, unit: str = "b") -> float:
+        nbytes = float(self.to_pandas().memory_usage(index=False, deep=True).sum())
+        scale = {"b": 1, "kb": 1024, "mb": 1024**2, "gb": 1024**3, "tb": 1024**4}
+        return nbytes / scale[unit]
+
+    def pipe(self, function, *args: Any, **kwargs: Any):
+        return function(self, *args, **kwargs)
+
+    def fold(self, operation):
+        acc = self.to_series(0)
+        for i in range(1, len(self.columns)):
+            acc = operation(acc, self.to_series(i))
+        return acc
+
+
+def pandas_series_from(values: list, name: str):
+    import modin_tpu.pandas as mpd
+
+    return mpd.Series(values, name=name or None)
+
 
 class GroupBy:
     """Deferred polars group_by."""

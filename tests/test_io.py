@@ -73,7 +73,7 @@ class TestReadCSV:
     def test_parallel_path(self, csv_file, monkeypatch):
         import modin_tpu.core.io.text.csv_dispatcher as disp
 
-        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        monkeypatch.setattr(disp.CSVDispatcher, "MIN_PARALLEL_BYTES", 1)
         path, pdf = csv_file
         df_equals(pd.read_csv(path), pandas.read_csv(path))
 
@@ -179,7 +179,7 @@ class TestParallelPathEngages:
             return orig(cls, path, kwargs)
 
         monkeypatch.setattr(disp.CSVDispatcher, "_read_parallel", classmethod(spy))
-        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        monkeypatch.setattr(disp.CSVDispatcher, "MIN_PARALLEL_BYTES", 1)
         md = pd.read_csv(str(tmp_path / "big.csv"))
         assert calls["parallel"] == 1
         df_equals(md, pandas.read_csv(tmp_path / "big.csv"))
@@ -216,7 +216,7 @@ class TestParallelJSONFWF:
             return orig(cls, p, kwargs)
 
         monkeypatch.setattr(disp.JSONDispatcher, "_read_parallel", classmethod(spy))
-        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        monkeypatch.setattr(disp.JSONDispatcher, "MIN_PARALLEL_BYTES", 1)
         md = pd.read_json(str(path), lines=True)
         assert calls["parallel"] == 1
         df_equals(md, pandas.read_json(path, lines=True))
@@ -252,7 +252,7 @@ class TestParallelJSONFWF:
             return orig(cls, p, kw)
 
         monkeypatch.setattr(disp.FWFDispatcher, "_read_parallel", classmethod(spy))
-        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        monkeypatch.setattr(disp.FWFDispatcher, "MIN_PARALLEL_BYTES", 1)
         md = pd.read_fwf(str(path), **kwargs)
         assert calls["parallel"] == 1
         df_equals(md, pandas.read_fwf(path, **kwargs))
@@ -266,7 +266,7 @@ class TestParallelJSONFWF:
             f.write("%-8s%-8s\n" % ("x", "y"))
             for i in range(5_000):
                 f.write("%-8d%-8d\n" % (i, i * 2))
-        monkeypatch.setattr(disp, "_MIN_PARALLEL_BYTES", 1)
+        monkeypatch.setattr(disp.FWFDispatcher, "MIN_PARALLEL_BYTES", 1)
         df_equals(
             pd.read_fwf(str(path), skiprows=1),
             pandas.read_fwf(path, skiprows=1),

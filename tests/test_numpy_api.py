@@ -1,6 +1,7 @@
 """Differential tests for modin_tpu.numpy (modeled on modin/tests/numpy/)."""
 
 import numpy
+import numpy as np
 import pytest
 
 import modin_tpu.numpy as mnp
@@ -98,3 +99,47 @@ def test_interop_with_dataframe():
     m = mnp.array(df)
     assert m.shape == (2, 2)
     arr_equals(m.sum(axis=0), numpy.array([3.0, 7.0]))
+
+
+class TestExpandedSurface:
+    def test_predicates(self):
+        a = mnp.array([1.0, np.nan, -np.inf, 4.0])
+        np.testing.assert_array_equal(np.asarray(mnp.isnan(a)), [False, True, False, False])
+        np.testing.assert_array_equal(np.asarray(mnp.isinf(a)), [False, False, True, False])
+        np.testing.assert_array_equal(np.asarray(mnp.isfinite(a)), [True, False, False, True])
+        np.testing.assert_array_equal(
+            np.asarray(mnp.logical_not(mnp.array([True, False]))), [False, True]
+        )
+        assert mnp.isscalar(3.0) and not mnp.isscalar(a)
+
+    def test_shaping(self):
+        a = mnp.arange(6)
+        assert mnp.shape(a) == (6,)
+        assert mnp.ravel(a).tolist() == list(range(6))
+        parts = mnp.split(a, 3)
+        assert [p.tolist() for p in parts] == [[0, 1], [2, 3], [4, 5]]
+        assert mnp.hstack([mnp.ones(2), mnp.zeros(2)]).tolist() == [1, 1, 0, 0]
+        assert mnp.append(mnp.ones(2), [5.0]).tolist() == [1.0, 1.0, 5.0]
+
+    def test_arg_reductions(self):
+        assert int(mnp.argmax(mnp.array([1, 9, 2]))) == 1
+        assert int(mnp.argmin(mnp.array([1, 9, -2]))) == 2
+
+    def test_linalg_norm(self):
+        assert float(mnp.linalg.norm(mnp.array([3.0, 4.0]))) == 5.0
+
+    def test_constants_and_aliases(self):
+        assert mnp.pi == np.pi and mnp.e == np.e and np.isnan(mnp.nan)
+        np.testing.assert_array_equal(
+            np.asarray(mnp.abs(mnp.array([-1.0, 2.0]))), [1.0, 2.0]
+        )
+        assert float(mnp.max(mnp.array([1.0, 5.0]))) == 5.0
+        assert float(mnp.min(mnp.array([1.0, 5.0]))) == 1.0
+
+    def test_tri(self):
+        np.testing.assert_array_equal(np.asarray(mnp.tri(3)), np.tri(3))
+
+    def test_float_power(self):
+        np.testing.assert_allclose(
+            np.asarray(mnp.float_power(mnp.array([2.0, 3.0]), 2.0)), [4.0, 9.0]
+        )

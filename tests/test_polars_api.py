@@ -145,3 +145,74 @@ def test_unique_keep_none():
     small = pl.DataFrame({"a": [1, 1, 2, 3, 3, 4]})
     got = small.unique(keep="none").sort("a")
     eq(got, pandas.DataFrame({"a": [2, 4]}))
+
+
+class TestExpandedVerbs:
+    def test_vertical_aggs(self, df):
+        eq(df.median(), PDF.median().to_frame().T)
+        eq(df.product(), PDF.prod().to_frame().T)
+        eq(df.n_unique(), PDF.nunique().to_frame().T)
+        eq(df.null_count(), PDF.isna().sum().to_frame().T)
+        eq(df.std(), PDF.std().to_frame().T)
+        eq(df.var(ddof=0), PDF.var(ddof=0).to_frame().T)
+
+    def test_horizontal_aggs(self, df):
+        np.testing.assert_allclose(
+            df.sum_horizontal().to_numpy(), PDF.sum(axis=1).to_numpy()
+        )
+        np.testing.assert_allclose(
+            df.max_horizontal().to_numpy(), PDF.max(axis=1).to_numpy()
+        )
+
+    def test_unpivot_pivot(self, df):
+        eq(
+            df.unpivot(on=["val", "qty"], index="grp"),
+            PDF.melt(id_vars="grp", value_vars=["val", "qty"]),
+        )
+        got = df.pivot(on="grp", values="val", aggregate_function="mean")
+        want = PDF.pivot_table(columns="grp", values="val", aggfunc="mean")
+        got_pdf = got.to_pandas()
+        # polars keeps first-appearance column order; compare by label
+        for grp_val in want.columns:
+            np.testing.assert_allclose(
+                float(got_pdf[grp_val].iloc[0]), float(want[grp_val].iloc[0])
+            )
+
+    def test_reverse_and_rows(self, df):
+        eq(df.reverse(), PDF.iloc[::-1])
+        assert df.row(3) == tuple(PDF.iloc[3])
+        assert df.rows()[:2] == [tuple(r) for r in PDF.head(2).itertuples(index=False)]
+        assert df.to_dicts()[0] == dict(PDF.iloc[0])
+
+    def test_to_dict_series(self, df):
+        d = df.to_dict()
+        assert set(d) == set(PDF.columns)
+        np.testing.assert_allclose(d["val"].to_numpy(), PDF["val"].to_numpy())
+        assert df.to_series(1).name == "val"
+
+    def test_column_surgery(self, df):
+        s = pl.Series("extra", np.arange(N))
+        out = df.insert_column(1, s)
+        assert out.columns == ["grp", "extra", "val", "qty"]
+        rep = df.replace_column(0, pl.Series("g2", np.arange(N)))
+        assert rep.columns[0] == "g2"
+        d2 = pl.DataFrame(DATA)
+        dropped = d2.drop_in_place("qty")
+        assert dropped.name == "qty" and d2.columns == ["grp", "val"]
+        assert df.get_column_index("qty") == 2
+
+    def test_partition_by(self, df):
+        parts = df.partition_by("grp")
+        assert sum(len(p) for p in parts) == N
+        as_dict = df.partition_by("grp", as_dict=True)
+        assert len(as_dict) == PDF["grp"].nunique()
+
+    def test_misc(self, df):
+        assert df.estimated_size("kb") > 0
+        assert df.pipe(lambda d: d.height) == N
+        acc = df.select(["val", "qty"]).fold(lambda a, b: a + b)
+        np.testing.assert_allclose(
+            acc.to_numpy(), (PDF["val"] + PDF["qty"]).to_numpy()
+        )
+        assert df.clear().height == 0
+        eq(df.corr(), PDF.corr())

@@ -62,3 +62,69 @@ def test_rolling_series(dfs):
     md, pdf = dfs
     df_equals(md["a"].rolling(5).mean(), pdf["a"].rolling(5).mean())
     df_equals(md["a"].expanding().sum(), pdf["a"].expanding().sum())
+
+
+def _no_fallback(fn):
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        return fn()
+
+
+@pytest.mark.parametrize("agg", ["sum", "mean", "count", "min", "max", "std", "var", "sem"])
+def test_rolling_device_no_fallback(dfs, agg):
+    md, pdf = dfs
+    got = _no_fallback(lambda: getattr(md.rolling(9, min_periods=2), agg)())
+    df_equals(got, getattr(pdf.rolling(9, min_periods=2), agg)())
+
+
+@pytest.mark.parametrize("agg", ["sum", "mean", "count", "min", "max", "std", "var", "sem"])
+def test_expanding_device_no_fallback(dfs, agg):
+    md, pdf = dfs
+    got = _no_fallback(lambda: getattr(md.expanding(min_periods=3), agg)())
+    df_equals(got, getattr(pdf.expanding(min_periods=3), agg)())
+
+
+@pytest.mark.parametrize("window", [2, 7, 64, 150, 500])
+def test_rolling_minmax_window_sizes(window):
+    # exercises the van Herk block algorithm across window/block alignments
+    rng = np.random.default_rng(5)
+    n = 300
+    data = {"a": np.where(rng.random(n) < 0.3, np.nan, rng.normal(size=n))}
+    md, pdf = create_test_dfs(data)
+    for agg in ("min", "max"):
+        df_equals(
+            getattr(md.rolling(window, min_periods=1), agg)(),
+            getattr(pdf.rolling(window, min_periods=1), agg)(),
+        )
+
+
+def test_rolling_var_large_offset():
+    # global centering must keep windowed variance accurate at large offsets
+    rng = np.random.default_rng(6)
+    x = 1e9 + rng.normal(size=256)
+    md, pdf = create_test_dfs({"a": x})
+    df_equals(md.rolling(16).var(), pdf.rolling(16).var())
+
+
+@pytest.mark.parametrize("ddof", [0, 1, 2])
+def test_rolling_expanding_ddof(dfs, ddof):
+    md, pdf = dfs
+    df_equals(md.rolling(10).var(ddof=ddof), pdf.rolling(10).var(ddof=ddof))
+    df_equals(md.expanding().std(ddof=ddof), pdf.expanding().std(ddof=ddof))
+
+
+def test_rolling_inf_treated_as_missing():
+    # pandas _prep_values converts +/-inf to NaN in every window agg
+    md, pdf = create_test_dfs({"a": [1.0, -np.inf, np.nan, 5.0, np.inf, 2.0]})
+    for agg in ("min", "max", "sum", "mean", "var"):
+        df_equals(
+            getattr(md.rolling(2, min_periods=1), agg)(),
+            getattr(pdf.rolling(2, min_periods=1), agg)(),
+        )
+
+
+def test_rolling_ddof_on_non_var_raises():
+    md, pdf = create_test_dfs({"a": [1.0, 2.0, 3.0, 4.0]})
+    eval_general(md, pdf, lambda df: df.rolling(2).sum(ddof=2))
