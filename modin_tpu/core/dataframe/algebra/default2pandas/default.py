@@ -121,7 +121,15 @@ class SeriesDefault(DefaultMethod):
 
     @classmethod
     def frame_wrapper(cls, df: pandas.DataFrame) -> pandas.Series:
-        return df.squeeze(axis=1)
+        series = df.squeeze(axis=1)
+        if (
+            isinstance(series, pandas.Series)
+            and series.name == MODIN_UNNAMED_SERIES_LABEL
+        ):
+            # the internal placeholder must not leak into results that carry
+            # the series name (e.g. value_counts' index name)
+            series = series.rename(None)
+        return series
 
 
 class StrDefault(SeriesDefault):
@@ -295,6 +303,13 @@ class BinaryDefault(DefaultMethod):
             if isinstance(other, pandas.DataFrame) and squeeze_other:
                 other = other.squeeze(axis=1)
             ErrorMessage.default_to_pandas(f"`{fn_name}`")
+            if fn_name.startswith("__"):
+                # dunder binary ops take only `other`; the API layer's
+                # axis/level hints don't apply (Series dunders align by index)
+                kwargs = {
+                    k: v for k, v in kwargs.items()
+                    if k not in ("axis", "level", "fill_value")
+                }
             if isinstance(df, pandas.Series):
                 series_fn = getattr(pandas.Series, fn_name, None)
                 result = (

@@ -235,10 +235,13 @@ class TpuDataFrameXchg:
         if not n_chunks or n_chunks <= 1:
             yield self
             return
-        # the spec requires subdividing when the consumer asks for chunks
+        # the spec requires EXACTLY n_chunks chunks (trailing ones may be
+        # short or empty), matching the pandas producer's stepping
         n = len(self._frame)
-        step = -(-n // n_chunks)
-        for start in range(0, max(n, 1), max(step, 1)):
+        step = n // n_chunks
+        if n % n_chunks:
+            step += 1
+        for start in range(0, max(step, 1) * n_chunks, max(step, 1)):
             yield TpuDataFrameXchg(
                 self._frame.take_rows_positional(slice(start, min(start + step, n))),
                 self._nan_as_null,

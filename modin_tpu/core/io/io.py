@@ -80,6 +80,10 @@ def _make_default_reader(name: str):
     @classmethod
     def reader(cls, **kwargs: Any) -> Any:
         ErrorMessage.default_to_pandas(f"`{name}`")
+        con = kwargs.get("con")
+        if con is not None and hasattr(con, "get_connection") and hasattr(con, "partition_query"):
+            # ModinDatabaseConnection descriptor: pandas needs the real handle
+            kwargs = {**kwargs, "con": con.get_connection()}
         result = pandas_fn(**kwargs)
         if isinstance(result, (pandas.DataFrame, pandas.Series)):
             return cls._wrap(result)

@@ -455,6 +455,13 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 # int ** negative-int raises in pandas; rpow exponent sign is
                 # data-dependent — fall back for the whole int/int pow family
                 return None
+            if all(k in "iub" for k in kinds) and isinstance(other, (int, np.integer)):
+                # pandas 3 promotes int floordiv/mod to float64 (inf/nan)
+                # when any divisor is zero — data-dependent result dtype
+                if op in ("floordiv", "mod") and int(other) == 0:
+                    return None
+                if op in ("rfloordiv", "rmod"):
+                    return None  # the divisor is the (data) column
             datas = elementwise.binary_op_columns(op, cols, other)
             return self._wrap_device_result(datas)
         if isinstance(other, (bool, np.bool_)) and op in (self._LOGICAL_OPS | self._CMP_OPS):
@@ -479,6 +486,14 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 and all(k in "iu" for k in okinds)
             ):
                 return None  # exponent sign is data-dependent; pandas may raise
+            if (
+                op in ("floordiv", "rfloordiv", "mod", "rmod")
+                and all(k in "iub" for k in kinds)
+                and all(k in "iub" for k in okinds)
+            ):
+                # pandas 3: any zero divisor promotes the int result to
+                # float64 (inf/nan) — data-dependent dtype, so fall back
+                return None
             axis = kwargs.get("axis", None)
             self_is_col = self._shape_hint == "column"
             other_is_col = other._shape_hint == "column"

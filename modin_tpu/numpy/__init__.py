@@ -275,7 +275,12 @@ argmax = _make_arg_reduction("argmax")
 argmin = _make_arg_reduction("argmin")
 
 
-float_power = _make_binary("float_power", "pow")
+def float_power(a: Any, b: Any):
+    # numpy guarantees float64 output (and e.g. int ** -1 == 0.5)
+    out = _np.float_power(_np.asarray(a), _np.asarray(b))
+    if isinstance(a, array) or isinstance(b, array):
+        return array(out)
+    return out
 abs = absolute  # noqa: A001
 max = amax  # noqa: A001
 min = amin  # noqa: A001

@@ -9,8 +9,10 @@ paid once (the tunnel RTT floor dominates per-call cost on remote TPUs).
 Pandas semantic deltas handled here:
 - int / int true-division promotes to float64 and yields +/-inf on zero
   division (numpy raises/warns; jnp matches IEEE, which is what pandas does);
-- int floordiv/mod by zero: pandas returns 0 (numpy semantics) — jnp returns
-  implementation-defined values, so zero divisors are masked explicitly.
+- int floordiv/mod with a zero divisor promotes to float64 (inf/nan) in
+  pandas 3 — a data-dependent dtype, so the QC gates those cases to the
+  pandas fallback; the kernels' zero-masking only backstops traced scalar
+  divisors that are known nonzero at dispatch.
 """
 
 from __future__ import annotations

@@ -465,11 +465,33 @@ class DataFrame:
     melt = unpivot
 
     def pivot(
-        self, on: str, *, index: Any = None, values: Any = None,
+        self, on: Any, *, index: Any = None, values: Any = None,
         aggregate_function: str = "first",
     ) -> "DataFrame":
+        on_list = [on] if isinstance(on, str) else list(on)
+        values_list = (
+            None if values is None
+            else [values] if isinstance(values, str) else list(values)
+        )
+        index_list = (
+            None if index is None
+            else [index] if isinstance(index, str) else list(index)
+        )
+        # polars defaults: the unnamed role takes all remaining columns
+        if index_list is None and values_list is None:
+            raise ValueError("pivot requires at least one of `index`/`values`")
+        if values_list is None:
+            values_list = [
+                c for c in self.columns if c not in on_list and c not in index_list
+            ]
+        if index_list is None:
+            index_list = [
+                c for c in self.columns if c not in on_list and c not in values_list
+            ]
         md = self._md.pivot_table(
-            index=index, columns=on, values=values,
+            index=index_list,
+            columns=on_list[0] if len(on_list) == 1 else on_list,
+            values=values_list[0] if len(values_list) == 1 else values_list,
             aggfunc=aggregate_function, sort=False,
         )
         return self._from_md(md.reset_index())
@@ -555,14 +577,16 @@ class DataFrame:
         return series
 
     def clear(self, n: int = 0) -> "DataFrame":
-        empty = self.to_pandas().iloc[:0]
+        # schema only — no device->host transfer of the data
+        schema = dict(zip(self.columns, self._query_compiler.dtypes))
         if n == 0:
-            return DataFrame(empty)
+            return DataFrame(
+                pandas.DataFrame({c: pandas.array([], dtype=d) for c, d in schema.items()})
+            )
         # n null rows, keeping the original schema (polars semantics; int
         # columns use pandas' nullable Int64 to hold nulls)
         data = {}
-        for c in empty.columns:
-            dt = empty[c].dtype
+        for c, dt in schema.items():
             if dt.kind in "iu":
                 data[c] = pandas.array([None] * n, dtype="Int64")
             elif dt.kind == "f":

@@ -1,0 +1,180 @@
+"""Dtype-breadth suite (ported shapes from modin/tests/pandas: categorical,
+extension, datetime/timedelta, string, and mixed-dtype behavior)."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals, eval_general
+
+_rng = np.random.default_rng(77)
+N = 48
+
+
+@pytest.fixture
+def mixed():
+    data = {
+        "f64": _rng.normal(size=N),
+        "f32": _rng.normal(size=N).astype(np.float32),
+        "i64": _rng.integers(-100, 100, N),
+        "i8": _rng.integers(-100, 100, N).astype(np.int8),
+        "u32": _rng.integers(0, 100, N).astype(np.uint32),
+        "b": _rng.random(N) < 0.5,
+        "dt": np.datetime64("2023-06-01", "ns")
+        + _rng.integers(0, 10**10, N).astype("timedelta64[ns]"),
+        "td": _rng.integers(0, 10**9, N).astype("timedelta64[ns]"),
+        "s": np.array([f"word{i % 11}" for i in range(N)]),
+    }
+    return create_test_dfs(data)
+
+
+def test_dtypes_property(mixed):
+    md, pdf = mixed
+    pandas.testing.assert_series_equal(md.dtypes, pdf.dtypes)
+
+
+@pytest.mark.parametrize(
+    "target",
+    ["float64", "float32", "int64", "int32", "bool"],
+)
+def test_astype_numeric(mixed, target):
+    md, pdf = mixed
+    cols = ["f64", "i64", "u32"]
+    eval_general(md[cols], pdf[cols], lambda df: df.astype(target))
+
+
+def test_astype_per_column(mixed):
+    md, pdf = mixed
+    spec = {"f64": "float32", "i64": "float64"}
+    df_equals(md.astype(spec), pdf.astype(spec))
+
+
+def test_astype_string_and_category(mixed):
+    md, pdf = mixed
+    df_equals(md["s"].astype("category"), pdf["s"].astype("category"))
+    df_equals(md["i64"].astype(str), pdf["i64"].astype(str))
+
+
+def test_categorical_roundtrip():
+    cats = pandas.Categorical(
+        ["lo", "hi", "mid", "lo", "hi"], categories=["lo", "mid", "hi"], ordered=True
+    )
+    md, pdf = create_test_dfs({"c": cats, "v": np.arange(5.0)})
+    df_equals(md, pdf)
+    df_equals(md["c"].cat.codes, pdf["c"].cat.codes)
+    df_equals(md.sort_values("c"), pdf.sort_values("c"))
+
+
+def test_categorical_groupby():
+    cats = pandas.Categorical(["a", "b", "a", "c", "b", "a"])
+    md, pdf = create_test_dfs({"k": cats, "v": np.arange(6.0)})
+    eval_general(
+        md, pdf, lambda df: df.groupby("k", observed=True)["v"].sum()
+    )
+
+
+def test_datetime_accessors(mixed):
+    md, pdf = mixed
+    for attr in ("year", "month", "day", "hour", "dayofweek"):
+        df_equals(getattr(md["dt"].dt, attr), getattr(pdf["dt"].dt, attr))
+
+
+def test_datetime_minmax_roundtrip(mixed):
+    md, pdf = mixed
+    df_equals(md["dt"].min(), pdf["dt"].min())
+    df_equals(md["dt"].max(), pdf["dt"].max())
+    df_equals(md[["dt"]].sort_values("dt"), pdf[["dt"]].sort_values("dt"))
+
+
+def test_datetime_nat_handling():
+    values = pandas.to_datetime(
+        ["2024-01-01", None, "2024-03-01", None, "2024-02-01"]
+    )
+    md, pdf = create_test_dfs({"dt": values})
+    df_equals(md["dt"].isna(), pdf["dt"].isna())
+    df_equals(md.dropna(), pdf.dropna())
+    df_equals(md["dt"].min(), pdf["dt"].min())
+
+
+def test_timedelta_ops(mixed):
+    md, pdf = mixed
+    df_equals(md["td"].sum(), pdf["td"].sum())
+    df_equals(md["td"].max(), pdf["td"].max())
+
+
+def test_string_methods(mixed):
+    md, pdf = mixed
+    df_equals(md["s"].str.upper(), pdf["s"].str.upper())
+    df_equals(md["s"].str.len(), pdf["s"].str.len())
+    df_equals(md["s"].str.contains("word1"), pdf["s"].str.contains("word1"))
+    df_equals(md["s"].str.replace("word", "W"), pdf["s"].str.replace("word", "W"))
+    df_equals(md["s"].str[0:4], pdf["s"].str[0:4])
+
+
+def test_nullable_extension_dtypes():
+    md, pdf = create_test_dfs(
+        {
+            "ni": pandas.array([1, None, 3], dtype="Int64"),
+            "nb": pandas.array([True, None, False], dtype="boolean"),
+            "nf": pandas.array([1.5, None, 2.5], dtype="Float64"),
+        }
+    )
+    df_equals(md, pdf)
+    df_equals(md.isna(), pdf.isna())
+    eval_general(md, pdf, lambda df: df["ni"].sum())
+
+
+def test_mixed_arithmetic_promotions(mixed):
+    md, pdf = mixed
+    num_md = md[["f64", "f32", "i64", "i8", "u32"]]
+    num_pd = pdf[["f64", "f32", "i64", "i8", "u32"]]
+    df_equals(num_md + 1, num_pd + 1)
+    df_equals(num_md * 2.5, num_pd * 2.5)
+    df_equals(num_md["i8"] + num_md["i64"], num_pd["i8"] + num_pd["i64"])
+    df_equals(num_md["f32"] * num_md["f64"], num_pd["f32"] * num_pd["f64"])
+
+
+def test_int_division_semantics(mixed):
+    md, pdf = mixed
+    df_equals(md["i64"] / 0, pdf["i64"] / 0)
+    df_equals(md["i64"] // 7, pdf["i64"] // 7)
+    df_equals(md["i64"] % 7, pdf["i64"] % 7)
+    df_equals(md["i64"] // 0, pdf["i64"] // 0)
+
+
+def test_bool_aggregation_promotion(mixed):
+    md, pdf = mixed
+    df_equals(md["b"].sum(), pdf["b"].sum())
+    df_equals(md["b"].mean(), pdf["b"].mean())
+    df_equals(md[["b"]].var(), pdf[["b"]].var())
+
+
+def test_convert_dtypes_infer_objects():
+    md, pdf = create_test_dfs({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    df_equals(md.convert_dtypes(), pdf.convert_dtypes())
+    df_equals(md.infer_objects(), pdf.infer_objects())
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_merge_on_datetime_keys(how):
+    base = np.datetime64("2024-01-01", "ns")
+    keys = base + np.array([0, 1, 2, 1, 0]).astype("timedelta64[D]")
+    rkeys = base + np.array([1, 2, 9]).astype("timedelta64[D]")
+    ml, pl_ = create_test_dfs({"k": keys, "x": np.arange(5.0)})
+    mr, pr = create_test_dfs({"k": rkeys, "y": np.arange(3.0)})
+    df_equals(ml.merge(mr, on="k", how=how), pl_.merge(pr, on="k", how=how))
+
+
+def test_value_counts_dtypes(mixed):
+    md, pdf = mixed
+    df_equals(md["i8"].value_counts(), pdf["i8"].value_counts())
+    df_equals(md["s"].value_counts(), pdf["s"].value_counts())
+    df_equals(md["b"].value_counts(), pdf["b"].value_counts())
+
+
+def test_memory_usage_and_info(mixed):
+    md, pdf = mixed
+    # values differ by backing store; shape/labels must match
+    assert list(md.memory_usage().index) == list(pdf.memory_usage().index)
+    assert md[["f64", "i64"]].memory_usage(index=False).sum() > 0

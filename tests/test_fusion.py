@@ -15,6 +15,14 @@ import modin_tpu.pandas as pd
 from modin_tpu.ops import lazy
 from tests.utils import create_test_dfs, df_equals
 
+@pytest.fixture(autouse=True)
+def _require_tpu_backend():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("fusion internals require the TpuOnJax execution")
+
+
 _rng = np.random.default_rng(3)
 
 

@@ -5,7 +5,7 @@ import pandas
 import pytest
 
 import modin_tpu.pandas as pd
-from tests.utils import create_test_dfs, df_equals
+from tests.utils import assert_no_fallback, create_test_dfs, df_equals
 
 _rng = np.random.default_rng(7)
 N = 200
@@ -123,42 +123,30 @@ def test_groupby_median_quantile(dfs):
 @pytest.mark.parametrize("q", [0.1, 0.25, 0.5, 0.75, 0.9])
 def test_groupby_quantile_device(dfs, q, interp):
     # device path: no default-to-pandas fallback permitted
-    import warnings
-
     md, pdf = dfs
-    with warnings.catch_warnings():
-        warnings.simplefilter("error")
-        df_equals(
+    assert_no_fallback(lambda: df_equals(
             md.groupby("int_key")[["val_int", "val_float"]].quantile(q, interpolation=interp),
             pdf.groupby("int_key")[["val_int", "val_float"]].quantile(q, interpolation=interp),
-        )
+    ))
 
 
 @pytest.mark.parametrize("agg", ["median", "nunique", "first", "last"])
 @pytest.mark.parametrize("key", ["int_key", "sparse_key", "float_key"])
 def test_groupby_order_aggs_device(dfs, agg, key):
-    import warnings
-
     md, pdf = dfs
-    with warnings.catch_warnings():
-        warnings.simplefilter("error")
-        df_equals(
+    assert_no_fallback(lambda: df_equals(
             getattr(md.groupby(key)[["val_int", "val_float"]], agg)(),
             getattr(pdf.groupby(key)[["val_int", "val_float"]], agg)(),
-        )
+    ))
 
 
 @pytest.mark.parametrize("agg", ["median", "nunique", "first", "last"])
 def test_groupby_order_aggs_multikey(dfs, agg):
-    import warnings
-
     md, pdf = dfs
-    with warnings.catch_warnings():
-        warnings.simplefilter("error")
-        df_equals(
+    assert_no_fallback(lambda: df_equals(
             getattr(md.groupby(["int_key", "sparse_key"])[["val_int", "val_float"]], agg)(),
             getattr(pdf.groupby(["int_key", "sparse_key"])[["val_int", "val_float"]], agg)(),
-        )
+    ))
 
 
 def test_groupby_nunique_dropna(dfs):

@@ -1,0 +1,249 @@
+"""Indexing edge-case suite (ported shapes from modin/tests/pandas/dataframe/
+test_indexing.py, 2,784 LoC): loc/iloc slices and fancy keys, boolean masks,
+at/iat, setitem enlargement, MultiIndex, reindex, and alignment corners."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals, eval_general
+
+_rng = np.random.default_rng(51)
+N = 60
+
+
+@pytest.fixture
+def dfs():
+    data = {
+        "a": _rng.normal(size=N),
+        "b": _rng.integers(0, 100, N),
+        "c": np.array([f"s{i % 9}" for i in range(N)]),
+        "d": _rng.random(N) < 0.5,
+    }
+    return create_test_dfs(data)
+
+
+@pytest.fixture
+def labeled():
+    data = {"x": np.arange(10.0), "y": np.arange(10) * 2}
+    index = list("abcdefghij")
+    return create_test_dfs(data, index=index)
+
+
+LOC_KEYS = [
+    3,
+    slice(2, 7),
+    slice(None, 5),
+    slice(5, None),
+    slice(None, None, 2),
+    [1, 5, 9],
+    [9, 1, 5],
+]
+
+
+@pytest.mark.parametrize("key", LOC_KEYS, ids=[str(k) for k in LOC_KEYS])
+def test_loc_row_keys(dfs, key):
+    md, pdf = dfs
+    eval_general(md, pdf, lambda df: df.loc[key])
+
+
+ILOC_KEYS = [
+    0,
+    -1,
+    slice(3, 12),
+    slice(-5, None),
+    slice(None, None, 3),
+    slice(None, None, -1),
+    [0, 2, 4],
+    [-1, -3],
+    np.array([5, 1, 3]),
+]
+
+
+@pytest.mark.parametrize("key", ILOC_KEYS, ids=[str(k) for k in ILOC_KEYS])
+def test_iloc_row_keys(dfs, key):
+    md, pdf = dfs
+    eval_general(md, pdf, lambda df: df.iloc[key])
+
+
+@pytest.mark.parametrize(
+    "cols", [["a"], ["b", "d"], slice("a", "c"), slice(None)], ids=str
+)
+def test_loc_column_keys(dfs, cols):
+    md, pdf = dfs
+    eval_general(md, pdf, lambda df: df.loc[2:8, cols])
+
+
+@pytest.mark.parametrize("cols", [0, [0, 2], slice(1, 3), [-1]], ids=str)
+def test_iloc_column_keys(dfs, cols):
+    md, pdf = dfs
+    eval_general(md, pdf, lambda df: df.iloc[2:8, cols])
+
+
+def test_loc_boolean_array(dfs):
+    md, pdf = dfs
+    mask = np.asarray(_rng.random(N) < 0.4)
+    df_equals(md.loc[mask], pdf.loc[mask])
+    df_equals(md.loc[mask, ["a", "c"]], pdf.loc[mask, ["a", "c"]])
+
+
+def test_loc_boolean_series_aligned(dfs):
+    md, pdf = dfs
+    df_equals(md.loc[md["d"]], pdf.loc[pdf["d"]])
+
+
+def test_loc_with_string_labels(labeled):
+    md, pdf = labeled
+    df_equals(md.loc["c"], pdf.loc["c"])
+    df_equals(md.loc["c":"g"], pdf.loc["c":"g"])
+    df_equals(md.loc[["b", "e", "i"]], pdf.loc[["b", "e", "i"]])
+    df_equals(md.loc["d", "x"], pdf.loc["d", "x"])
+
+
+def test_loc_missing_label_raises(labeled):
+    md, pdf = labeled
+    eval_general(md, pdf, lambda df: df.loc["zz"])
+    eval_general(md, pdf, lambda df: df.loc[["a", "zz"]])
+
+
+def test_iloc_out_of_bounds_raises(dfs):
+    md, pdf = dfs
+    eval_general(md, pdf, lambda df: df.iloc[N + 5])
+
+
+def test_at_iat(labeled):
+    md, pdf = labeled
+    assert md.at["b", "y"] == pdf.at["b", "y"]
+    assert md.iat[4, 0] == pdf.iat[4, 0]
+
+
+def test_setitem_scalar_and_array(dfs):
+    md, pdf = dfs
+    md["e"], pdf["e"] = 7.5, 7.5
+    df_equals(md, pdf)
+    values = _rng.normal(size=N)
+    md["f"], pdf["f"] = values, values
+    df_equals(md, pdf)
+
+
+def test_setitem_from_own_column(dfs):
+    md, pdf = dfs
+    md["g"] = md["a"] * 2 + md["b"]
+    pdf["g"] = pdf["a"] * 2 + pdf["b"]
+    df_equals(md, pdf)
+
+
+def test_setitem_overwrite_with_dtype_change(dfs):
+    md, pdf = dfs
+    md["b"] = md["a"]
+    pdf["b"] = pdf["a"]
+    df_equals(md, pdf)
+
+
+def test_loc_setitem_region(dfs):
+    md, pdf = dfs
+
+    def op(df):
+        out = df.copy()
+        out.loc[3:6, "a"] = 0.0
+        return out
+
+    eval_general(md, pdf, op)
+
+
+def test_iloc_setitem_region(dfs):
+    md, pdf = dfs
+
+    def op(df):
+        out = df.copy()
+        out.iloc[2:5, 0] = -1.0
+        return out
+
+    eval_general(md, pdf, op)
+
+
+def test_reindex(labeled):
+    md, pdf = labeled
+    df_equals(md.reindex(["a", "c", "zz"]), pdf.reindex(["a", "c", "zz"]))
+    df_equals(
+        md.reindex(columns=["y", "x", "missing"]),
+        pdf.reindex(columns=["y", "x", "missing"]),
+    )
+
+
+def test_set_reset_index(dfs):
+    md, pdf = dfs
+    df_equals(md.set_index("b"), pdf.set_index("b"))
+    df_equals(md.set_index(["b", "c"]), pdf.set_index(["b", "c"]))
+    df_equals(md.set_index("b").reset_index(), pdf.set_index("b").reset_index())
+
+
+def test_multiindex_loc():
+    arrays = [["bar", "bar", "baz", "baz", "foo", "foo"], [1, 2, 1, 2, 1, 2]]
+    idx = pandas.MultiIndex.from_arrays(arrays, names=("k1", "k2"))
+    data = {"v": np.arange(6.0)}
+    md = pd.DataFrame(data, index=idx)
+    pdf = pandas.DataFrame(data, index=idx)
+    df_equals(md.loc["bar"], pdf.loc["bar"])
+    df_equals(md.loc[("baz", 2)], pdf.loc[("baz", 2)])
+    df_equals(md.xs("foo"), pdf.xs("foo"))
+
+
+def test_head_tail_edge_counts(dfs):
+    md, pdf = dfs
+    for k in (0, 1, -3, N, N + 10):
+        df_equals(md.head(k), pdf.head(k))
+        df_equals(md.tail(k), pdf.tail(k))
+
+
+def test_take_axis_both(dfs):
+    md, pdf = dfs
+    df_equals(md.take([5, 0, -1]), pdf.take([5, 0, -1]))
+    df_equals(md.take([2, 0], axis=1), pdf.take([2, 0], axis=1))
+
+
+def test_filter_items_like_regex(dfs):
+    md, pdf = dfs
+    df_equals(md.filter(items=["a", "d"]), pdf.filter(items=["a", "d"]))
+    df_equals(md.filter(like="b"), pdf.filter(like="b"))
+    df_equals(md.filter(regex="^[ac]$"), pdf.filter(regex="^[ac]$"))
+
+
+def test_series_indexing(dfs):
+    md, pdf = dfs
+    ms, ps = md["a"], pdf["a"]
+    df_equals(ms.iloc[3:9], ps.iloc[3:9])
+    df_equals(ms.loc[5], ps.loc[5])
+    df_equals(ms[ms > 0], ps[ps > 0])
+    df_equals(ms.head(7), ps.head(7))
+
+
+def test_where_mask(dfs):
+    md, pdf = dfs
+    num_md, num_pd = md[["a", "b"]], pdf[["a", "b"]]
+    eval_general(num_md, num_pd, lambda df: df.where(df > 0))
+    eval_general(num_md, num_pd, lambda df: df.where(df > 0, -df))
+    eval_general(num_md, num_pd, lambda df: df.mask(df > 0))
+
+
+def test_pop_and_del(dfs):
+    md, pdf = dfs
+    got, want = md.pop("b"), pdf.pop("b")
+    df_equals(got, want)
+    df_equals(md, pdf)
+    del md["c"]
+    del pdf["c"]
+    df_equals(md, pdf)
+
+
+def test_getitem_columns_duplicate_selection(dfs):
+    md, pdf = dfs
+    df_equals(md[["a", "a"]], pdf[["a", "a"]])
+
+
+def test_squeeze():
+    md, pdf = create_test_dfs({"only": [1.5, 2.5, 3.5]})
+    df_equals(md.squeeze(axis=1), pdf.squeeze(axis=1))
+    md1, pdf1 = create_test_dfs({"only": [42.0]})
+    assert md1.squeeze() == pdf1.squeeze()

@@ -12,6 +12,15 @@ _rng = np.random.default_rng(21)
 N = 500
 
 
+def _require_tpu():
+    import pytest as _pytest
+
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        _pytest.skip("device-buffer internals require TpuOnJax")
+
+
 @pytest.fixture
 def frames():
     data = {
@@ -34,6 +43,7 @@ def test_round_trip_matches_pandas_producer(frames):
 
 
 def test_zero_copy_over_host_cache(frames):
+    _require_tpu()
     md, _ = frames
     dfx = md.__dataframe__()
     buf, _dtype = dfx.get_column_by_name("i").get_buffers()["data"]
@@ -43,6 +53,7 @@ def test_zero_copy_over_host_cache(frames):
 
 def test_no_full_frame_materialization(frames):
     # consuming one column must not call to_pandas on the whole frame
+    _require_tpu()
     md, _ = frames
     qc = md._query_compiler
     called = {"n": 0}

@@ -163,6 +163,7 @@ class TestParallelPathEngages:
         """Regression: default-bound kwargs (no_default sentinels) must not
         disqualify the chunked path, and the native chunker must accept the
         mmap buffer."""
+        _require_tpu()
         import modin_tpu.core.io.text.csv_dispatcher as disp
 
         rng = np.random.default_rng(3)
@@ -192,8 +193,18 @@ class TestParallelPathEngages:
         assert sum(e - s for s, e in ranges) == len(body) - 2
 
 
+def _require_tpu():
+    import pytest as _pytest
+
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        _pytest.skip("parallel dispatchers are wired to the TpuOnJax factory")
+
+
 class TestParallelJSONFWF:
     def test_read_json_lines_parallel(self, tmp_path, monkeypatch):
+        _require_tpu()
         import modin_tpu.core.io.text.json_dispatcher as disp
 
         rng = np.random.default_rng(5)
@@ -229,6 +240,7 @@ class TestParallelJSONFWF:
 
     @pytest.mark.parametrize("colspec_mode", ["infer", "explicit", "widths"])
     def test_read_fwf_parallel(self, tmp_path, monkeypatch, colspec_mode):
+        _require_tpu()
         import modin_tpu.core.io.text.fwf_dispatcher as disp
 
         n = 20_000
@@ -258,6 +270,7 @@ class TestParallelJSONFWF:
         df_equals(md, pandas.read_fwf(path, **kwargs))
 
     def test_read_fwf_skiprows(self, tmp_path, monkeypatch):
+        _require_tpu()
         import modin_tpu.core.io.text.fwf_dispatcher as disp
 
         path = tmp_path / "skip.fwf"

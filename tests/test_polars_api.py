@@ -169,13 +169,19 @@ class TestExpandedVerbs:
             df.unpivot(on=["val", "qty"], index="grp"),
             PDF.melt(id_vars="grp", value_vars=["val", "qty"]),
         )
-        got = df.pivot(on="grp", values="val", aggregate_function="mean")
-        want = PDF.pivot_table(columns="grp", values="val", aggfunc="mean")
+        # polars: unnamed index role takes the remaining columns (qty here)
+        got = df.pivot(on="grp", index="qty", values="val", aggregate_function="mean")
+        want = (
+            PDF.pivot_table(index="qty", columns="grp", values="val", aggfunc="mean")
+            .reset_index()
+        )
         got_pdf = got.to_pandas()
-        # polars keeps first-appearance column order; compare by label
-        for grp_val in want.columns:
+        assert "qty" in got_pdf.columns
+        for grp_val in [c for c in want.columns if c != "qty"]:
             np.testing.assert_allclose(
-                float(got_pdf[grp_val].iloc[0]), float(want[grp_val].iloc[0])
+                got_pdf.sort_values("qty")[grp_val].to_numpy(),
+                want.sort_values("qty")[grp_val].to_numpy(),
+                equal_nan=True,
             )
 
     def test_reverse_and_rows(self, df):

@@ -67,11 +67,11 @@ def test_query_local_resolved_in_direct_caller(dfs):
 
 
 def test_query_runs_on_device(dfs):
+    from tests.utils import assert_no_fallback
+
     md, _ = dfs
     numeric = md[["a", "b"]]
-    with warnings.catch_warnings():
-        warnings.simplefilter("error", UserWarning)  # no pandas fallback
-        result = numeric.query("a > 0 & b < 5")
+    result = assert_no_fallback(lambda: numeric.query("a > 0 & b < 5"))
     assert len(result) > 0
 
 

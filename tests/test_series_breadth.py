@@ -1,0 +1,132 @@
+"""Series method breadth (ported shapes from modin/tests/pandas/test_series.py,
+5,274 LoC / 366 tests: unary/stat/transform methods across dtype fixtures)."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_series, df_equals, eval_general
+
+_rng = np.random.default_rng(91)
+N = 80
+
+SERIES_DATA = {
+    "floats": _rng.normal(size=N) * 10,
+    "floats_nan": np.where(_rng.random(N) < 0.25, np.nan, _rng.normal(size=N)),
+    "ints": _rng.integers(-50, 50, N),
+    "bools": _rng.random(N) < 0.5,
+}
+
+
+@pytest.fixture(params=list(SERIES_DATA), ids=list(SERIES_DATA))
+def series_pair(request):
+    return create_test_series(SERIES_DATA[request.param])
+
+
+STAT_METHODS = [
+    "sum", "mean", "min", "max", "count", "prod", "median", "std", "var",
+    "sem", "skew", "kurt", "nunique", "any", "all",
+]
+
+
+@pytest.mark.parametrize("method", STAT_METHODS)
+def test_series_stats(series_pair, method):
+    ms, ps = series_pair
+    eval_general(ms, ps, lambda s: getattr(s, method)())
+
+
+@pytest.mark.parametrize("method", ["sum", "mean", "min", "max", "std", "var"])
+def test_series_stats_no_skipna(series_pair, method):
+    ms, ps = series_pair
+    eval_general(ms, ps, lambda s: getattr(s, method)(skipna=False))
+
+
+TRANSFORMS = [
+    lambda s: s.abs(),
+    lambda s: s.round(1),
+    lambda s: s.rank(),
+    lambda s: s.rank(method="min"),
+    lambda s: s.rank(pct=True),
+    lambda s: s.clip(-5, 5),
+    lambda s: s.cumsum(),
+    lambda s: s.cummax(),
+    lambda s: s.cummin(),
+    lambda s: s.cumprod(),
+    lambda s: s.diff(),
+    lambda s: s.diff(-2),
+    lambda s: s.shift(3),
+    lambda s: s.shift(-1),
+    lambda s: s.pct_change(),
+    lambda s: s.fillna(0),
+    lambda s: s.ffill(),
+    lambda s: s.bfill(),
+    lambda s: s.dropna(),
+    lambda s: s.drop_duplicates(),
+    lambda s: s.sort_values(kind="stable"),
+    lambda s: s.sort_values(ascending=False, kind="stable"),
+    lambda s: s.sort_index(ascending=False),
+    lambda s: s.nlargest(5),
+    lambda s: s.nsmallest(5),
+    lambda s: s.mode(),
+    lambda s: s.unique(),
+    lambda s: s.between(-1, 1),
+    lambda s: s.isin([1, 2, 3]),
+    lambda s: s.replace(1, 99),
+    lambda s: s.astype(str),
+    lambda s: s.to_frame(),
+    lambda s: s.reset_index(drop=True),
+    lambda s: s.idxmax(),
+    lambda s: s.idxmin(),
+    lambda s: s.value_counts(),
+    lambda s: s.value_counts(normalize=True),
+    lambda s: s.quantile(0.3),
+    lambda s: s.quantile([0.1, 0.9]),
+    lambda s: s.describe(),
+    lambda s: len(s.sample(10, random_state=0)),
+    lambda s: s.memory_usage() > 0,
+    lambda s: s.nbytes > 0,
+    lambda s: s.duplicated(),
+    lambda s: s.autocorr() if s.dtype.kind == "f" else None,
+    lambda s: s.is_monotonic_increasing,
+    lambda s: s.is_unique,
+    lambda s: s.hasnans,
+]
+
+
+@pytest.mark.parametrize("op", TRANSFORMS, ids=range(len(TRANSFORMS)))
+def test_series_transforms(series_pair, op):
+    ms, ps = series_pair
+    eval_general(ms, ps, op)
+
+
+def test_series_apply_map():
+    ms, ps = create_test_series(SERIES_DATA["floats"])
+    eval_general(ms, ps, lambda s: s.apply(lambda v: v * 2 + 1))
+    eval_general(ms, ps, lambda s: s.map(lambda v: abs(v)))
+
+
+def test_series_agg_lists():
+    ms, ps = create_test_series(SERIES_DATA["floats"])
+    eval_general(ms, ps, lambda s: s.agg(["sum", "mean", "max"]))
+
+
+def test_series_combine():
+    a_md, a_pd = create_test_series(SERIES_DATA["floats"])
+    b_md, b_pd = create_test_series(SERIES_DATA["ints"])
+    df_equals(a_md.combine(b_md, max), a_pd.combine(b_pd, max))
+    df_equals(a_md.combine_first(b_md), a_pd.combine_first(b_pd))
+
+
+def test_series_align_on_different_index():
+    a_md, a_pd = create_test_series([1.0, 2.0, 3.0], index=[0, 1, 2])
+    b_md, b_pd = create_test_series([10.0, 20.0, 30.0], index=[1, 2, 3])
+    df_equals(a_md + b_md, a_pd + b_pd)
+    df_equals(a_md.mul(b_md, fill_value=0), a_pd.mul(b_pd, fill_value=0))
+
+
+def test_series_repeat_explode():
+    ms, ps = create_test_series([1, 2, 3])
+    eval_general(ms, ps, lambda s: s.repeat(2))
+    ml, pl_ = create_test_series([[1, 2], [3], []])
+    eval_general(ml, pl_, lambda s: s.explode())

@@ -25,9 +25,9 @@ def dfs():
 
 
 def _no_fallback(fn):
-    with warnings.catch_warnings():
-        warnings.simplefilter("error", UserWarning)
-        return fn()
+    from tests.utils import assert_no_fallback
+
+    return assert_no_fallback(fn)
 
 
 def test_corr_device(dfs):

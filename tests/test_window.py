@@ -65,11 +65,9 @@ def test_rolling_series(dfs):
 
 
 def _no_fallback(fn):
-    import warnings
+    from tests.utils import assert_no_fallback
 
-    with warnings.catch_warnings():
-        warnings.simplefilter("error", UserWarning)
-        return fn()
+    return assert_no_fallback(fn)
 
 
 @pytest.mark.parametrize("agg", ["sum", "mean", "count", "min", "max", "std", "var", "sem"])

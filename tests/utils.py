@@ -93,6 +93,25 @@ def create_test_series(*args: Any, **kwargs: Any):
     return pd.Series(*args, **kwargs), pandas.Series(*args, **kwargs)
 
 
+def assert_no_fallback(fn: Callable):
+    """Run ``fn`` asserting no default-to-pandas warning fires.
+
+    Device-path assertions only make sense on the TpuOnJax execution; other
+    executions (``--execution NativeOnNative``) skip instead of failing.
+    """
+    import warnings
+
+    import pytest
+
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("device-path assertion requires TpuOnJax")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        return fn()
+
+
 def eval_general(
     modin_obj: Any,
     pandas_obj: Any,
