@@ -340,9 +340,18 @@ def _jit_remap(n_present: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_out: int):
+def _jit_segment_agg(
+    agg: str, n_cols: int, num_segments: int, ddof: int, p_out: int,
+    adaptive: bool = False,
+):
     """One jit computing the aggregation for every value column; results are
-    sliced to the real group count and padded to the shard multiple."""
+    sliced to the real group count and padded to the shard multiple.
+
+    ``adaptive`` (single-shard meshes only — lax.cond over sharded operands
+    is unsafe under SPMD) runs the unmasked segment sum first and falls into
+    the NaN-masked form only when the result shows a NaN occurred, sharing
+    one group-sizes histogram across clean columns.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -350,6 +359,45 @@ def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_out:
 
     def finish(r):
         return _slice_pad(r, n_groups, p_out)
+
+    def seg_adaptive(c, codes, sizes):
+        import jax.lax as lax
+
+        ns = num_segments
+        if agg == "count":
+            # no value aggregation needed: probe NaNs directly (a segment
+            # scatter just for the probe would cost more than it saves)
+            has_nan = jnp.any(jnp.isnan(c) & (codes < n_groups))
+            s_raw = None
+        else:
+            s_raw = jax.ops.segment_sum(c, codes, num_segments=ns)
+            has_nan = jnp.isnan(jnp.sum(s_raw[:n_groups]))
+
+        def dirty():
+            if agg == "count":
+                vcnt = jax.ops.segment_sum(
+                    (~jnp.isnan(c)).astype(jnp.int32), codes, num_segments=ns
+                )
+                return vcnt.astype(jnp.int64)
+            x = jnp.where(jnp.isnan(c), 0, c)
+            s = jax.ops.segment_sum(x, codes, num_segments=ns)
+            if agg == "sum":
+                return s
+            vcnt = jax.ops.segment_sum(
+                (~jnp.isnan(c)).astype(jnp.int32), codes, num_segments=ns
+            )
+            return s / vcnt  # mean
+
+        def clean():
+            if agg == "sum":
+                return s_raw
+            if agg == "count":
+                return sizes
+            # cast sizes to the SUM dtype: cond branches must type-match and
+            # the masked path keeps float32 means float32
+            return s_raw / sizes.astype(s_raw.dtype)
+
+        return finish(lax.cond(has_nan, dirty, clean))
 
     def seg(c, codes):
         is_f = jnp.issubdtype(c.dtype, jnp.floating)
@@ -361,7 +409,8 @@ def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_out:
                 return s
             valid = (~jnp.isnan(c)).astype(jnp.int64) if is_f else jnp.ones(c.shape, jnp.int64)
             ncnt = jax.ops.segment_sum(valid, codes, num_segments=ns)
-            mean = s / ncnt
+            # divide in the sum's dtype: float32 means stay float32 (pandas)
+            mean = s / (ncnt.astype(s.dtype) if is_f else ncnt)
             if agg == "mean":
                 return mean
             # two-pass centered variance: gathering the group mean back per row
@@ -399,7 +448,21 @@ def _jit_segment_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_out:
         raise ValueError(agg)
 
     def fn(cols: Tuple, codes):
-        return tuple(finish(seg(c, codes)) for c in cols)
+        sizes = None
+        if adaptive and agg in ("sum", "mean", "count"):
+            sizes = jax.ops.segment_sum(
+                jnp.ones(codes.shape, jnp.int64), codes,
+                num_segments=num_segments,
+            )
+        out = []
+        for c in cols:
+            if sizes is not None and jnp.issubdtype(c.dtype, jnp.floating):
+                out.append(seg_adaptive(c, codes, sizes))
+            elif sizes is not None and agg == "count":
+                out.append(finish(sizes))
+            else:
+                out.append(finish(seg(c, codes)))
+        return tuple(out)
 
     return jax.jit(fn)
 
@@ -661,7 +724,10 @@ def groupby_reduce(
         # TPU scatters serialize badly; the masked scan keeps the work on the VPU
         fn = _jit_masked_scan_agg(agg, len(value_cols), ns, int(ddof), p_out, _SCAN_CHUNK)
         return list(fn(tuple(value_cols), codes))
-    fn = _jit_segment_agg(agg, len(value_cols), ns, int(ddof), p_out)
+    from modin_tpu.parallel.mesh import num_row_shards
+
+    adaptive = num_row_shards() == 1
+    fn = _jit_segment_agg(agg, len(value_cols), ns, int(ddof), p_out, adaptive)
     return list(fn(tuple(value_cols), codes))
 
 

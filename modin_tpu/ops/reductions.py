@@ -57,16 +57,21 @@ def _reduce_one(op: str, c, n: int, skipna: bool, ddof: int, adaptive: bool = Fa
             return fast
     # unpadded columns (lengths dividing the shard count) elide the iota
     # validity mask — clean int/float reductions become a single fused pass
+    cnt_dtype = jnp.int32 if n < 2**31 else jnp.int64
     if unpadded:
         valid = None
         nan_mask = jnp.isnan(c) if is_f else None
         use = ~nan_mask if (skipna and is_f) else None
-        n_use = jnp.sum(use) if use is not None else jnp.asarray(n, jnp.int64)
+        n_use = (
+            jnp.sum(use, dtype=cnt_dtype).astype(jnp.int64)
+            if use is not None
+            else jnp.asarray(n, jnp.int64)
+        )
     else:
         valid = _valid_mask(c, n)
         nan_mask = jnp.isnan(c) & valid if is_f else None
         use = valid & ~nan_mask if (skipna and is_f) else valid
-        n_use = jnp.sum(use)
+        n_use = jnp.sum(use, dtype=cnt_dtype).astype(jnp.int64)
 
     def sel(x, neutral):
         return x if use is None else jnp.where(use, x, neutral)
@@ -77,7 +82,7 @@ def _reduce_one(op: str, c, n: int, skipna: bool, ddof: int, adaptive: bool = Fa
     if op == "count":
         if nan_mask is None:
             return jnp.asarray(n, jnp.int64)
-        return jnp.sum(sel_valid(~nan_mask, False)).astype(jnp.int64)
+        return jnp.sum(sel_valid(~nan_mask, False), dtype=cnt_dtype).astype(jnp.int64)
     if op == "sum":
         return jnp.sum(sel(c, 0))
     if op == "prod":
@@ -155,8 +160,12 @@ def _reduce_clean_adaptive(op: str, c, n: int, ddof: int):
     def masked(neutral):
         return jnp.where(jnp.isnan(c), neutral, c)
 
+    # int32 accumulation of the bool mask is ~3x faster on XLA CPU than the
+    # default int64 widening (n < 2^31 always holds for per-shard lengths)
+    cnt_dtype = jnp.int32 if n < 2**31 else jnp.int64
+
     def n_use():
-        return n - jnp.sum(jnp.isnan(c))
+        return (n - jnp.sum(jnp.isnan(c), dtype=cnt_dtype)).astype(jnp.int64)
 
     if op == "sum":
         s = jnp.sum(c)
@@ -165,7 +174,7 @@ def _reduce_clean_adaptive(op: str, c, n: int, ddof: int):
         p = jnp.prod(c)
         return lax.cond(jnp.isnan(p), lambda: jnp.prod(masked(1.0)), lambda: p)
     if op == "count":
-        return (n - jnp.sum(jnp.isnan(c))).astype(jnp.int64)
+        return n_use()
     if op in ("min", "max"):
         reducer = jnp.min if op == "min" else jnp.max
         r = reducer(c)
