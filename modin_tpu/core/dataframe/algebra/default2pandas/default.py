@@ -232,8 +232,15 @@ class ResampleDefault(DefaultMethod):
             query_compiler: Any, resample_kwargs: dict, *args: Any, **kwargs: Any
         ) -> Any:
             df = query_compiler.to_pandas()
-            if squeeze_self:
+            if squeeze_self or query_compiler._shape_hint == "column":
+                # a Series resample must run as a SERIES: frame resample
+                # changes result shapes (ohlc -> MultiIndex columns)
                 df = df.squeeze(axis=1)
+                if (
+                    isinstance(df, pandas.Series)
+                    and df.name == MODIN_UNNAMED_SERIES_LABEL
+                ):
+                    df = df.rename(None)
             ErrorMessage.default_to_pandas(f"`Resampler.{fn_name}`")
             resampler = df.resample(**resample_kwargs)
             fn = getattr(type(resampler), fn_name) if isinstance(func, str) else func

@@ -1401,6 +1401,138 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
         return self._wrap_device_result(datas)
 
+    def _try_device_resample(self, op: str, resample_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        """Fixed-frequency resample as time-bucket codes + segment aggregation.
+
+        The reference runs pandas.resample per row block and regroups
+        (ResampleDefault here, fold in the reference); on device the bucket
+        id of every row is pure int arithmetic on the (host-side) datetime
+        index, and the aggregation is the same segment kernel groupby uses —
+        empty buckets fall out naturally (sum 0, count 0, mean/min/max NaN).
+        Only tick frequencies (fixed ns width, <= days) with default
+        closed/label/origin bucket like this; everything else falls back.
+        """
+        from modin_tpu.ops import groupby as gb_ops
+        from modin_tpu.ops.structural import pad_len
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        rule = resample_kwargs.get("rule")
+        defaults = {
+            "closed": None, "label": None, "convention": "start", "on": None,
+            "level": None, "origin": "start_day", "offset": None,
+            "group_keys": False, "axis": 0,
+        }
+        for key, default in defaults.items():
+            if resample_kwargs.get(key, default) != default:
+                return None
+        extra = dict(kwargs)
+        ddof = extra.pop("ddof", 1) if op in ("var", "std") else 1
+        if extra.pop("numeric_only", False):
+            return None
+        if extra or not isinstance(ddof, (int, np.integer)):
+            return None
+        try:
+            offset = pandas.tseries.frequencies.to_offset(rule)
+        except ValueError:
+            return None
+        if isinstance(offset, pandas.tseries.offsets.Tick):
+            freq_ns = int(offset.nanos)
+        elif isinstance(offset, pandas.tseries.offsets.Day):
+            # Day is calendar-aware in pandas 3 (not a Tick) but fixed 24h
+            # on the tz-naive indexes this path is gated to
+            freq_ns = int(offset.n) * 86_400_000_000_000
+        else:
+            return None  # week/month/... buckets are not fixed-width
+        frame = self._modin_frame
+        if len(frame) == 0:
+            return None
+        index = frame.index
+        if not isinstance(index, pandas.DatetimeIndex) or index.tz is not None:
+            return None
+        if index.hasnans:
+            return None  # pandas drops NaT rows; int64 bucket math overflows
+        unit_ns = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000}.get(
+            index.unit
+        )
+        if unit_ns is None or freq_ns % unit_ns != 0:
+            # sub-unit bucket edges would round when cast back to the
+            # index's unit (pandas errors on this input)
+            return None
+        value_positions = [
+            i for i, c in enumerate(frame._columns)
+            if c.is_device and c.pandas_dtype.kind in "biuf"
+        ]
+        if op != "size" and (
+            len(value_positions) != frame.num_cols or not value_positions
+        ):
+            return None
+
+        # ---- bucket codes (pandas Tick semantics, origin='start_day') ---- #
+        ts = index.as_unit("ns").asi8
+        origin = int(pandas.Timestamp(index.min()).normalize().value)
+        first_bucket = origin + ((int(ts.min()) - origin) // freq_ns) * freq_ns
+        codes_host = (ts - first_bucket) // freq_ns
+        n_groups = int(codes_host.max()) + 1
+        if n_groups > (1 << 24):
+            return None  # pathological rule vs span: huge empty range
+        has_empty = bool(
+            (np.bincount(codes_host, minlength=n_groups) == 0).any()
+        )
+        n = len(frame)
+        codes_padded = np.full(pad_len(n), n_groups, dtype=np.int64)
+        codes_padded[:n] = codes_host
+        codes = JaxWrapper.put(codes_padded)
+
+        import jax.numpy as jnp
+
+        if op == "size":
+            datas = gb_ops.groupby_reduce("size", [], codes, n_groups, n)
+            # a named series source keeps its name on the size result
+            labels = (
+                frame.columns[:1]
+                if self._shape_hint == "column"
+                else pandas.Index([MODIN_UNNAMED_SERIES_LABEL])
+            )
+            out_dtypes = [np.dtype(np.int64)]
+        else:
+            frame.materialize_device()
+            arrays = []
+            for i in value_positions:
+                a = frame._columns[i].data
+                if a.dtype == jnp.bool_:
+                    if op in ("min", "max") and has_empty:
+                        return None  # pandas yields object dtype here
+                    if op in ("sum", "mean", "var", "std"):
+                        a = a.astype(jnp.int64)
+                if (
+                    op in ("min", "max")
+                    and has_empty
+                    and jnp.issubdtype(a.dtype, jnp.integer)
+                ):
+                    # empty buckets put NaN in the result: pandas promotes
+                    # int min/max to float64 exactly in this case
+                    a = a.astype(jnp.float64)
+                arrays.append(a)
+            datas = gb_ops.groupby_reduce(
+                op, arrays, codes, n_groups, n, ddof=int(ddof)
+            )
+            labels = frame.columns[value_positions]
+            out_dtypes = [np.dtype(d.dtype) for d in datas]
+
+        result_index = pandas.DatetimeIndex(
+            first_bucket + np.arange(n_groups, dtype=np.int64) * freq_ns,
+            freq=offset,
+        ).as_unit(index.unit)  # keep the source index's datetime unit
+        new_cols = [
+            DeviceColumn(d, dt, length=n_groups)
+            for d, dt in zip(datas, out_dtypes)
+        ]
+        result_frame = TpuDataframe(new_cols, labels, result_index, nrows=n_groups)
+        qc = type(self)(result_frame)
+        if op == "size":
+            qc._shape_hint = "column"
+        return qc
+
     def _try_device_expanding(self, op: str, expanding_args: list, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         from modin_tpu.ops.window import expanding_reduce
 
@@ -1853,6 +1985,26 @@ def _make_nonskipna_reduce_override(op: str):
 for _op in ["count", "any", "all"]:
     setattr(TpuQueryCompiler, _op, _make_nonskipna_reduce_override(_op))
 
+RESAMPLE_DEVICE_OPS = ("sum", "mean", "count", "min", "max", "var", "std", "size")
+
+
+def _make_resample_override(op: str):
+    def method(self, resample_kwargs: dict, *args: Any, **kwargs: Any):
+        result = (
+            self._try_device_resample(op, resample_kwargs, dict(kwargs))
+            if not args
+            else None
+        )
+        if result is not None:
+            return result
+        return getattr(super(TpuQueryCompiler, self), f"resample_{op}")(
+            resample_kwargs, *args, **kwargs
+        )
+
+    method.__name__ = f"resample_{op}"
+    return method
+
+
 def _make_rolling_override(op: str):
     def method(self, rolling_kwargs: dict, *args: Any, **kwargs: Any):
         result = (
@@ -1896,6 +2048,8 @@ for _op in _ROLL_OPS:
     setattr(TpuQueryCompiler, f"rolling_{_op}", _make_rolling_override(_op))
 for _op in _EXP_OPS:
     setattr(TpuQueryCompiler, f"expanding_{_op}", _make_expanding_override(_op))
+for _op in RESAMPLE_DEVICE_OPS:
+    setattr(TpuQueryCompiler, f"resample_{_op}", _make_resample_override(_op))
 
 # the generated overrides above were installed after __init_subclass__ ran,
 # so they need the backend-caster wrap applied explicitly

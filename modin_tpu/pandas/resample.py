@@ -31,7 +31,11 @@ class Resampler(ClassLogger, modin_layer="PANDAS-API"):
     def _wrap(self, qc: Any):
         if not hasattr(qc, "to_pandas"):
             return qc
-        if self._dataframe.ndim == 1:
+        # size() on a frame is a Series too (shape_hint set by the compiler);
+        # a series source can still produce a frame (ohlc)
+        if (
+            self._dataframe.ndim == 1 or qc._shape_hint == "column"
+        ) and qc.get_axis_len(1) <= 1:
             from modin_tpu.pandas.series import Series
 
             qc._shape_hint = "column"
@@ -83,3 +87,22 @@ for _name in [
         return method
 
     setattr(Resampler, _name, _make_resample(_name))
+
+
+def _resample_size(self, *args: Any, **kwargs: Any):
+    """size() is a Series regardless of source shape or fallback path."""
+    from modin_tpu.pandas.dataframe import DataFrame
+    from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
+
+    out = self._agg("size", *args, **kwargs)
+    if isinstance(out, DataFrame) and len(out.columns) == 1:
+        label = out.columns[0]
+        series = out[label]
+        if label == MODIN_UNNAMED_SERIES_LABEL:
+            series = series.rename(None)
+        return series
+    return out
+
+
+_resample_size.__name__ = "size"
+Resampler.size = _resample_size

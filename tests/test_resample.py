@@ -1,0 +1,78 @@
+"""Device resample tests (time-bucket codes + segment aggregation)."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import assert_no_fallback, create_test_dfs, df_equals
+
+_rng = np.random.default_rng(41)
+N = 1500
+
+
+@pytest.fixture
+def frames():
+    idx = pandas.DatetimeIndex(
+        pandas.Timestamp("2024-03-01 06:30")
+        + pandas.to_timedelta(np.sort(_rng.integers(0, 86400 * 3, N)), unit="s")
+    )
+    data = {
+        "v": np.where(_rng.random(N) < 0.15, np.nan, _rng.normal(size=N)),
+        "q": _rng.integers(0, 100, N),
+    }
+    return create_test_dfs(data, index=idx)
+
+
+@pytest.mark.parametrize("rule", ["5min", "h", "1D", "90s", "2h"])
+@pytest.mark.parametrize("agg", ["sum", "mean", "count", "min", "max", "var", "std"])
+def test_resample_device_matrix(frames, rule, agg):
+    md, pdf = frames
+    got = assert_no_fallback(lambda: getattr(md.resample(rule), agg)())
+    df_equals(got, getattr(pdf.resample(rule), agg)())
+
+
+def test_resample_size(frames):
+    md, pdf = frames
+    df_equals(md.resample("h").size(), pdf.resample("h").size())
+
+
+def test_resample_series(frames):
+    md, pdf = frames
+    df_equals(md["v"].resample("h").mean(), pdf["v"].resample("h").mean())
+
+
+def test_resample_empty_buckets_int_promotion():
+    idx = pandas.DatetimeIndex(["2024-01-01", "2024-01-05", "2024-01-02 13:00"])
+    md, pdf = create_test_dfs({"q": [1, 2, 3]}, index=idx)
+    for agg in ("sum", "min", "max", "count", "mean"):
+        df_equals(
+            getattr(md.resample("1D"), agg)(), getattr(pdf.resample("1D"), agg)()
+        )
+
+
+def test_resample_calendar_rules_fall_back(frames):
+    md, pdf = frames
+    df_equals(md.resample("ME").sum(), pdf.resample("ME").sum())
+    df_equals(md.resample("W").mean(), pdf.resample("W").mean())
+
+
+def test_resample_kwargs_fall_back(frames):
+    md, pdf = frames
+    df_equals(
+        md.resample("h", closed="right").sum(),
+        pdf.resample("h", closed="right").sum(),
+    )
+    df_equals(
+        md.resample("h", label="right").sum(),
+        pdf.resample("h", label="right").sum(),
+    )
+
+
+def test_resample_ohlc_and_agg(frames):
+    md, pdf = frames
+    df_equals(md["v"].resample("6h").ohlc(), pdf["v"].resample("6h").ohlc())
+    df_equals(
+        md.resample("6h").agg({"v": "mean", "q": "sum"}),
+        pdf.resample("6h").agg({"v": "mean", "q": "sum"}),
+    )
