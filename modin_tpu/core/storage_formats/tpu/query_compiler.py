@@ -1579,6 +1579,11 @@ class TpuQueryCompiler(BaseQueryCompiler):
             by, agg_func, axis, groupby_kwargs or {}, agg_args, agg_kwargs or {},
             drop, series_groupby, selection,
         )
+        if result is None:
+            result = self._try_device_groupby_multi(
+                by, agg_func, axis, groupby_kwargs or {}, agg_args,
+                agg_kwargs or {}, drop, series_groupby, selection,
+            )
         if result is not None:
             return result
         return super().groupby_agg(
@@ -1586,6 +1591,84 @@ class TpuQueryCompiler(BaseQueryCompiler):
             agg_args=agg_args, agg_kwargs=agg_kwargs, how=how, drop=drop,
             series_groupby=series_groupby, selection=selection,
         )
+
+    def _try_device_groupby_multi(
+        self, by, agg_func, axis, groupby_kwargs, agg_args, agg_kwargs, drop,
+        series_groupby, selection,
+    ) -> Optional["TpuQueryCompiler"]:
+        """agg(["sum", "mean"]) / agg({"col": "sum"}) on device: one
+        factorization (memoized), one segment kernel per aggregation, columns
+        combined like pandas (MultiIndex (col, agg) for lists, flat for
+        dicts).  The factorize cache makes the per-agg passes cheap."""
+        if not groupby_kwargs.get("as_index", True):
+            return None  # key-column reinsertion differs per layout
+
+        def run_one(func, sel):
+            return self._try_device_groupby(
+                by, func, axis, groupby_kwargs, agg_args, agg_kwargs, drop,
+                series_groupby, sel,
+            )
+
+        if (
+            isinstance(agg_func, list)
+            and agg_func
+            and all(isinstance(f, str) for f in agg_func)
+        ):
+            if not series_groupby and len(set(agg_func)) != len(agg_func):
+                return None  # pandas raises SpecificationError on duplicates
+            parts = []
+            for f in agg_func:
+                part = run_one(f, selection)
+                if part is None:
+                    return None  # bail before running the remaining kernels
+                parts.append(part)
+            frames = [p._modin_frame for p in parts]
+            base_labels = frames[0].columns
+            if isinstance(base_labels, pandas.MultiIndex):
+                return None  # pandas flattens to a deeper MultiIndex
+            if not all(f.columns.equals(base_labels) for f in frames[1:]):
+                return None
+            new_cols, labels = [], []
+            if series_groupby:
+                # a series groupby yields flat agg-named columns
+                for frame, fname in zip(frames, agg_func):
+                    new_cols.append(frame._columns[0])
+                    labels.append(fname)
+                new_labels = pandas.Index(labels)
+            else:
+                for pos, label in enumerate(base_labels):
+                    for frame, fname in zip(frames, agg_func):
+                        new_cols.append(frame._columns[pos])
+                        labels.append((label, fname))
+                new_labels = pandas.MultiIndex.from_tuples(labels)
+            result_frame = TpuDataframe(
+                new_cols, new_labels, frames[0]._index, nrows=len(frames[0])
+            )
+            return type(self)(result_frame)
+
+        if (
+            isinstance(agg_func, dict)
+            and agg_func
+            and not series_groupby
+            and selection is None
+            and all(isinstance(f, str) for f in agg_func.values())
+        ):
+            parts = []
+            for col, f in agg_func.items():
+                part = run_one(f, [col])
+                if part is None:
+                    return None
+                parts.append(part)
+            frames = [p._modin_frame for p in parts]
+            if not all(f.num_cols == 1 for f in frames):
+                return None
+            new_cols = [f._columns[0] for f in frames]
+            new_labels = pandas.Index(list(agg_func))
+            result_frame = TpuDataframe(
+                new_cols, new_labels, frames[0]._index, nrows=len(frames[0])
+            )
+            return type(self)(result_frame)
+        return None
 
     def _try_device_groupby(
         self, by, agg_func, axis, groupby_kwargs, agg_args, agg_kwargs, drop,

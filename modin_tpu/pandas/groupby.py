@@ -113,7 +113,8 @@ class DataFrameGroupBy(ClassLogger, modin_layer="PANDAS-API"):
         )
         if not hasattr(result_qc, "to_pandas"):
             return result_qc
-        if series_groupby:
+        if series_groupby and not isinstance(agg_func, list):
+            # a LIST spec always yields a frame, even with one function
             cols = result_qc.columns
             if len(cols) == 1:
                 result_qc._shape_hint = "column"
@@ -258,6 +259,20 @@ class DataFrameGroupBy(ClassLogger, modin_layer="PANDAS-API"):
             return self._groupby_agg(
                 lambda grp, **kw: grp.agg(**kw), agg_kwargs=kwargs
             )
+        if (
+            not args
+            and not kwargs
+            and (
+                (isinstance(func, list) and all(isinstance(f, str) for f in func))
+                or (
+                    isinstance(func, dict)
+                    and all(isinstance(f, str) for f in func.values())
+                )
+            )
+        ):
+            # list-of-strings / dict-of-strings pass through intact so the
+            # compiler's device multi-agg path can see them
+            return self._groupby_agg(func)
         return self._groupby_agg(
             func if isinstance(func, str) else (lambda grp, *a, **kw: grp.agg(try_cast_to_pandas(func), *a, **kw)),
             agg_args=args,

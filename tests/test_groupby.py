@@ -233,3 +233,50 @@ def test_pallas_bincount_matches_scatter(monkeypatch):
         got = np.asarray(pallas_bincount(ids, width, interpret=True))
         want = np.asarray(_jit_scatter_counts(width)(ids))
         np.testing.assert_array_equal(got, want)
+
+
+def test_groupby_agg_list_device(dfs):
+    md, pdf = dfs
+    got = assert_no_fallback(
+        lambda: md.groupby("int_key")[["val_int", "val_float"]].agg(["sum", "mean", "median"])
+    )
+    df_equals(got, pdf.groupby("int_key")[["val_int", "val_float"]].agg(["sum", "mean", "median"]))
+
+
+def test_groupby_agg_dict_device(dfs):
+    md, pdf = dfs
+    spec = {"val_int": "max", "val_float": "mean"}
+    got = assert_no_fallback(lambda: md.groupby("int_key").agg(spec))
+    df_equals(got, pdf.groupby("int_key").agg(spec))
+
+
+def test_groupby_series_agg_list_device(dfs):
+    md, pdf = dfs
+    got = assert_no_fallback(lambda: md.groupby("int_key")["val_float"].agg(["sum", "max"]))
+    df_equals(got, pdf.groupby("int_key")["val_float"].agg(["sum", "max"]))
+
+
+def test_groupby_agg_callable_falls_back(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key")[["val_float"]].agg(["sum", lambda s: s.max()]),
+        pdf.groupby("int_key")[["val_float"]].agg(["sum", lambda s: s.max()]),
+    )
+
+
+def test_groupby_agg_single_element_list_is_frame(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key")["val_float"].agg(["sum"]),
+        pdf.groupby("int_key")["val_float"].agg(["sum"]),
+    )
+
+
+def test_groupby_agg_duplicate_names_raise(dfs):
+    md, pdf = dfs
+    from tests.utils import eval_general
+
+    eval_general(
+        md, pdf,
+        lambda df: df.groupby("int_key")[["val_float"]].agg(["sum", "sum"]),
+    )
