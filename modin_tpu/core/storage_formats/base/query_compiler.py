@@ -492,6 +492,25 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
             result = result.to_frame(name)
         return self.from_pandas(result, type(self._modin_frame) if self._modin_frame is not None else None)
 
+    def groupby_transform(
+        self,
+        by: Any,
+        agg_func: Any,
+        groupby_kwargs: Optional[dict] = None,
+        drop: bool = False,
+        series_groupby: bool = False,
+        selection: Any = None,
+    ) -> "BaseQueryCompiler":
+        """Row-shaped groupby transform (``grp.transform(func)``)."""
+        return self.groupby_agg(
+            by,
+            lambda grp: grp.transform(agg_func),
+            groupby_kwargs=groupby_kwargs,
+            drop=drop,
+            series_groupby=series_groupby,
+            selection=selection,
+        )
+
     # ------------------------------------------------------------------ #
     # Merge / join
     # ------------------------------------------------------------------ #

@@ -1592,6 +1592,114 @@ class TpuQueryCompiler(BaseQueryCompiler):
             series_groupby=series_groupby, selection=selection,
         )
 
+    def groupby_transform(
+        self,
+        by: Any,
+        agg_func: Any,
+        groupby_kwargs: Optional[dict] = None,
+        drop: bool = False,
+        series_groupby: bool = False,
+        selection: Any = None,
+    ) -> "TpuQueryCompiler":
+        result = self._try_device_groupby_transform(
+            by, agg_func, groupby_kwargs or {}, drop, series_groupby, selection
+        )
+        if result is not None:
+            return result
+        return super().groupby_transform(
+            by, agg_func, groupby_kwargs=groupby_kwargs, drop=drop,
+            series_groupby=series_groupby, selection=selection,
+        )
+
+    def _try_device_groupby_transform(
+        self, by, agg_func, groupby_kwargs, drop, series_groupby, selection
+    ) -> Optional["TpuQueryCompiler"]:
+        """transform("sum"/"mean"/...) = segment aggregate + gather-back.
+
+        Reference: groupby transform ships blocks to workers; here it is the
+        memoized factorization, one segment kernel, and one row gather — the
+        original frame shape and index are preserved.  Restricted to int/bool
+        key columns (NaN keys make the output dtype data-dependent)."""
+        from modin_tpu.ops import groupby as gb_ops
+
+        if not isinstance(agg_func, str) or agg_func not in (
+            gb_ops.SEGMENT_AGGS - {"size"}
+        ):
+            return None
+        if groupby_kwargs.get("level") is not None:
+            return None
+        if groupby_kwargs.get("dropna", True) is not True:
+            return None
+        frame = self._modin_frame
+        if len(frame) == 0:
+            return None
+        if not (isinstance(by, list) and drop and all(
+            not hasattr(b, "to_pandas") for b in by
+        )):
+            return None
+        key_positions = []
+        for label in by:
+            pos = frame.column_position(label)
+            if len(pos) != 1 or pos[0] < 0:
+                return None
+            key_positions.append(pos[0])
+        key_cols = [frame._columns[p] for p in key_positions]
+        # int/bool keys only: no NaN keys, so no rows fall outside any group
+        if not all(c.is_device and c.pandas_dtype.kind in "biu" for c in key_cols):
+            return None
+
+        if selection is not None:
+            sel_list = [selection] if not isinstance(selection, list) else list(selection)
+            value_positions = []
+            for label in sel_list:
+                pos = frame.column_position(label)
+                if len(pos) != 1 or pos[0] < 0:
+                    return None
+                value_positions.append(pos[0])
+        else:
+            value_positions = [
+                i for i in range(frame.num_cols) if i not in key_positions
+            ]
+        value_cols = [frame._columns[i] for i in value_positions]
+        if not value_cols or not all(
+            c.is_device and c.pandas_dtype.kind in "biuf" for c in value_cols
+        ):
+            return None
+
+        frame.materialize_device()
+        try:
+            codes, n_groups, _keys = gb_ops.factorize_keys_cached(
+                [c.data for c in key_cols], len(frame)
+            )
+        except gb_ops._TooManyGroups:
+            return None
+        if n_groups == 0:
+            return None
+        import jax.numpy as jnp
+
+        arrays = []
+        for c in value_cols:
+            a = c.data
+            if a.dtype == jnp.bool_ and agg_func in ("sum", "prod", "mean", "var", "std", "sem"):
+                a = a.astype(jnp.int64)
+            arrays.append(a)
+        aggs = gb_ops.groupby_reduce(agg_func, arrays, codes, n_groups, len(frame))
+        datas = gb_ops.groupby_broadcast(aggs, codes)
+        new_cols = [
+            DeviceColumn(d, np.dtype(d.dtype), length=len(frame))
+            for d in datas
+        ]
+        result_frame = TpuDataframe(
+            new_cols,
+            frame.columns[value_positions],
+            frame._index,
+            nrows=len(frame),
+        )
+        qc = type(self)(result_frame)
+        if series_groupby:
+            qc._shape_hint = "column"
+        return qc
+
     def _try_device_groupby_multi(
         self, by, agg_func, axis, groupby_kwargs, agg_args, agg_kwargs, drop,
         series_groupby, selection,

@@ -921,3 +921,24 @@ def groupby_first_last(
         agg == "last", len(value_cols), num_groups + 1, pad_len(num_groups)
     )
     return list(fn(tuple(value_cols), codes))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_broadcast_groups(n_cols: int):
+    """Gather each row's group aggregate back to row positions (transform)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(aggs: Tuple, codes):
+        out = []
+        for a in aggs:
+            safe = jnp.minimum(codes, a.shape[0] - 1)  # pad rows: garbage, sliced off
+            out.append(jnp.take(a, safe))
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
+def groupby_broadcast(agg_cols: List[Any], codes: Any) -> List[Any]:
+    """Row-shaped device arrays where row i holds its group's aggregate."""
+    return list(_jit_broadcast_groups(len(agg_cols))(tuple(agg_cols), codes))

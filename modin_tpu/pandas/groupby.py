@@ -291,6 +291,24 @@ class DataFrameGroupBy(ClassLogger, modin_layer="PANDAS-API"):
         )
 
     def transform(self, func: Any, *args: Any, engine: Any = None, engine_kwargs: Any = None, **kwargs: Any):
+        if isinstance(func, str) and not args and not kwargs:
+            from modin_tpu.pandas.dataframe import DataFrame
+            from modin_tpu.pandas.series import Series
+
+            by, drop = self._resolve_by()
+            is_series = self._pandas_class is pandas.core.groupby.SeriesGroupBy
+            result_qc = self._query_compiler.groupby_transform(
+                by=by,
+                agg_func=func,
+                groupby_kwargs=dict(self._kwargs),
+                drop=drop,
+                series_groupby=is_series,
+                selection=self._selection,
+            )
+            if is_series and result_qc.get_axis_len(1) == 1:
+                result_qc._shape_hint = "column"
+                return Series(query_compiler=result_qc)
+            return DataFrame(query_compiler=result_qc)
         return self._groupby_agg(
             lambda grp, *a, **kw: grp.transform(func, *a, **kw),
             agg_args=args,

@@ -280,3 +280,34 @@ def test_groupby_agg_duplicate_names_raise(dfs):
         md, pdf,
         lambda df: df.groupby("int_key")[["val_float"]].agg(["sum", "sum"]),
     )
+
+
+@pytest.mark.parametrize("agg", ["sum", "mean", "min", "max", "count", "var", "std"])
+def test_groupby_transform_device(dfs, agg):
+    md, pdf = dfs
+    got = assert_no_fallback(
+        lambda: md.groupby("int_key")[["val_int", "val_float"]].transform(agg)
+    )
+    df_equals(got, pdf.groupby("int_key")[["val_int", "val_float"]].transform(agg))
+
+
+def test_groupby_series_transform_device(dfs):
+    md, pdf = dfs
+    got = assert_no_fallback(lambda: md.groupby("int_key")["val_float"].transform("mean"))
+    df_equals(got, pdf.groupby("int_key")["val_float"].transform("mean"))
+
+
+def test_groupby_transform_callable_falls_back(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("int_key")[["val_float"]].transform(lambda s: s - s.mean()),
+        pdf.groupby("int_key")[["val_float"]].transform(lambda s: s - s.mean()),
+    )
+
+
+def test_groupby_transform_float_key_falls_back(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("float_key")[["val_float"]].transform("sum"),
+        pdf.groupby("float_key")[["val_float"]].transform("sum"),
+    )
