@@ -1584,6 +1584,21 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 by, agg_func, axis, groupby_kwargs or {}, agg_args,
                 agg_kwargs or {}, drop, series_groupby, selection,
             )
+        if result is None and not agg_args and axis == 0:
+            from modin_tpu.ops.groupby import CUM_AGGS
+
+            if (
+                isinstance(agg_func, str)
+                and agg_func in CUM_AGGS
+                and not {
+                    k: v for k, v in (agg_kwargs or {}).items()
+                    if not (k == "numeric_only" and v is False)
+                }
+            ):
+                result = self._try_device_groupby_cum(
+                    agg_func, by, groupby_kwargs or {}, drop, series_groupby,
+                    selection,
+                )
         if result is not None:
             return result
         return super().groupby_agg(
@@ -1626,6 +1641,48 @@ class TpuQueryCompiler(BaseQueryCompiler):
             gb_ops.SEGMENT_AGGS - {"size"}
         ):
             return None
+        resolved = self._resolve_rowwise_groupby(
+            by, groupby_kwargs, drop, selection, "biuf"
+        )
+        if resolved is None:
+            return None
+        value_positions, codes, n_groups = resolved
+        frame = self._modin_frame
+        import jax.numpy as jnp
+
+        arrays = []
+        for i in value_positions:
+            a = frame._columns[i].data
+            if a.dtype == jnp.bool_ and agg_func in ("sum", "prod", "mean", "var", "std", "sem"):
+                a = a.astype(jnp.int64)
+            arrays.append(a)
+        aggs = gb_ops.groupby_reduce(agg_func, arrays, codes, n_groups, len(frame))
+        datas = gb_ops.groupby_broadcast(aggs, codes)
+        new_cols = [
+            DeviceColumn(d, np.dtype(d.dtype), length=len(frame))
+            for d in datas
+        ]
+        result_frame = TpuDataframe(
+            new_cols,
+            frame.columns[value_positions],
+            frame._index,
+            nrows=len(frame),
+        )
+        qc = type(self)(result_frame)
+        if series_groupby:
+            qc._shape_hint = "column"
+        return qc
+
+    def _resolve_rowwise_groupby(
+        self, by, groupby_kwargs, drop, selection, value_kinds: str
+    ):
+        """Shared gate/resolution for row-shaped groupby ops (transform,
+        cumulatives): returns (value_positions, codes, n_groups) or None.
+
+        Restricted to int/bool key columns — NaN keys would make the output
+        dtype (and NaN placement) data-dependent."""
+        from modin_tpu.ops import groupby as gb_ops
+
         if groupby_kwargs.get("level") is not None:
             return None
         if groupby_kwargs.get("dropna", True) is not True:
@@ -1644,7 +1701,6 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 return None
             key_positions.append(pos[0])
         key_cols = [frame._columns[p] for p in key_positions]
-        # int/bool keys only: no NaN keys, so no rows fall outside any group
         if not all(c.is_device and c.pandas_dtype.kind in "biu" for c in key_cols):
             return None
 
@@ -1662,7 +1718,8 @@ class TpuQueryCompiler(BaseQueryCompiler):
             ]
         value_cols = [frame._columns[i] for i in value_positions]
         if not value_cols or not all(
-            c.is_device and c.pandas_dtype.kind in "biuf" for c in value_cols
+            c.is_device and c.pandas_dtype.kind in value_kinds
+            for c in value_cols
         ):
             return None
 
@@ -1675,19 +1732,39 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         if n_groups == 0:
             return None
+        return value_positions, codes, n_groups
+
+    def _try_device_groupby_cum(
+        self, op, by, groupby_kwargs, drop, series_groupby, selection
+    ) -> Optional["TpuQueryCompiler"]:
+        """Row-shaped grouped cumulatives: ONE segmented scan over rows
+        sorted by group code, scattered back to original row order."""
+        from modin_tpu.ops import groupby as gb_ops
+
+        # bools change dtype per-op in pandas: value kinds exclude them
+        resolved = self._resolve_rowwise_groupby(
+            by, groupby_kwargs, drop, selection, "iuf"
+        )
+        if resolved is None:
+            return None
+        value_positions, codes, _n_groups = resolved
+        frame = self._modin_frame
         import jax.numpy as jnp
 
         arrays = []
-        for c in value_cols:
-            a = c.data
-            if a.dtype == jnp.bool_ and agg_func in ("sum", "prod", "mean", "var", "std", "sem"):
+        for i in value_positions:
+            a = frame._columns[i].data
+            if (
+                op in ("cumsum", "cumprod")
+                and jnp.issubdtype(a.dtype, jnp.signedinteger)
+                and a.dtype != jnp.int64
+            ):
+                # pandas 3 promotes signed sub-int64 cumsum/cumprod to int64
                 a = a.astype(jnp.int64)
             arrays.append(a)
-        aggs = gb_ops.groupby_reduce(agg_func, arrays, codes, n_groups, len(frame))
-        datas = gb_ops.groupby_broadcast(aggs, codes)
+        datas = gb_ops.groupby_cumulative(op, arrays, codes)
         new_cols = [
-            DeviceColumn(d, np.dtype(d.dtype), length=len(frame))
-            for d in datas
+            DeviceColumn(d, np.dtype(d.dtype), length=len(frame)) for d in datas
         ]
         result_frame = TpuDataframe(
             new_cols,

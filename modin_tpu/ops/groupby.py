@@ -942,3 +942,57 @@ def _jit_broadcast_groups(n_cols: int):
 def groupby_broadcast(agg_cols: List[Any], codes: Any) -> List[Any]:
     """Row-shaped device arrays where row i holds its group's aggregate."""
     return list(_jit_broadcast_groups(len(agg_cols))(tuple(agg_cols), codes))
+
+
+# row-shaped cumulative aggregations (segmented scan)
+CUM_AGGS = {"cumsum", "cumprod", "cummax", "cummin"}
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_grouped_cum(op: str, n_cols: int):
+    """Grouped cumulatives: sort rows by group code, run ONE segmented
+    associative scan (reset at group boundaries), scatter back to row order.
+    pandas NaN semantics: a NaN keeps its position without poisoning later
+    entries."""
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    op_fn = {
+        "cumsum": jnp.add, "cumprod": jnp.multiply,
+        "cummax": jnp.maximum, "cummin": jnp.minimum,
+    }[op]
+    float_neutral = {
+        "cumsum": 0.0, "cumprod": 1.0, "cummax": -jnp.inf, "cummin": jnp.inf,
+    }[op]
+
+    def one(c, order, inv, newgrp):
+        is_f = jnp.issubdtype(c.dtype, jnp.floating)
+        x = jnp.take(c, order)
+        nanm = jnp.isnan(x) if is_f else None
+        filled = jnp.where(nanm, float_neutral, x) if is_f else x
+
+        def combine(a, b):
+            fa, va = a
+            fb, vb = b
+            return fa | fb, jnp.where(fb, vb, op_fn(va, vb))
+
+        _, scanned = lax.associative_scan(combine, (newgrp, filled))
+        if is_f:
+            scanned = jnp.where(nanm, jnp.nan, scanned)
+        return jnp.take(scanned, inv)
+
+    def fn(cols: Tuple, codes):
+        order = jnp.argsort(codes, stable=True)
+        inv = jnp.argsort(order)
+        cs = jnp.take(codes, order)
+        newgrp = jnp.concatenate([jnp.ones(1, bool), cs[1:] != cs[:-1]])
+        return tuple(one(c, order, inv, newgrp) for c in cols)
+
+    return jax.jit(fn)
+
+
+def groupby_cumulative(op: str, value_cols: List[Any], codes: Any) -> List[Any]:
+    """Row-shaped grouped cumsum/cumprod/cummax/cummin."""
+    fn = _jit_grouped_cum(op, len(value_cols))
+    return list(fn(tuple(value_cols), codes))

@@ -311,3 +311,35 @@ def test_groupby_transform_float_key_falls_back(dfs):
         md.groupby("float_key")[["val_float"]].transform("sum"),
         pdf.groupby("float_key")[["val_float"]].transform("sum"),
     )
+
+
+@pytest.mark.parametrize("op", ["cumsum", "cumprod", "cummax", "cummin"])
+def test_groupby_cumulative_device(dfs, op):
+    md, pdf = dfs
+    got = assert_no_fallback(
+        lambda: getattr(md.groupby("int_key")[["val_int", "val_float"]], op)()
+    )
+    df_equals(got, getattr(pdf.groupby("int_key")[["val_int", "val_float"]], op)())
+
+
+def test_groupby_series_cumsum_device(dfs):
+    md, pdf = dfs
+    got = assert_no_fallback(lambda: md.groupby("int_key")["val_float"].cumsum())
+    df_equals(got, pdf.groupby("int_key")["val_float"].cumsum())
+
+
+def test_groupby_cumulative_float_key_falls_back(dfs):
+    md, pdf = dfs
+    df_equals(
+        md.groupby("float_key")[["val_float"]].cumsum(),
+        pdf.groupby("float_key")[["val_float"]].cumsum(),
+    )
+
+
+def test_groupby_cumsum_narrow_int_promotes():
+    # pandas 3 promotes signed sub-int64 cumsum/cumprod to int64 (no wrap)
+    md, pdf = create_test_dfs(
+        {"k": [0, 0, 1], "v": np.array([100, 100, 7], dtype=np.int8)}
+    )
+    df_equals(md.groupby("k").cumsum(), pdf.groupby("k").cumsum())
+    df_equals(md.groupby("k").cummax(), pdf.groupby("k").cummax())
