@@ -50,18 +50,25 @@ def _build_bincount(n_blocks: int, g_padded: int, interpret: bool):
             jnp.int32, (1, 1, g_padded), dimension=2
         )
         onehot = (codes_block[:, :, None] == group_ids).astype(jnp.int32)
-        partial = jnp.sum(onehot, axis=(0, 1))  # [g_padded]
+        # pin the accumulation dtype: with x64 enabled jnp.sum follows numpy
+        # and widens int32 sums to int64, which TPU pallas cannot lower
+        partial = jnp.sum(onehot, axis=(0, 1), dtype=jnp.int32)  # [g_padded]
         out_ref[0, :] += partial
 
     block_spec_kwargs = {"memory_space": vmem} if vmem is not None else {}
+    # index maps must yield int32: with x64 enabled a literal 0 traces as a
+    # weak int64 and Mosaic refuses the (i32, i64) index tuple
+    zero = np.int32(0)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((1, g_padded), jnp.int32),
         grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((_BR, _LANES), lambda i: (i, 0), **block_spec_kwargs)
+            pl.BlockSpec((_BR, _LANES), lambda i: (i, zero), **block_spec_kwargs)
         ],
-        out_specs=pl.BlockSpec((1, g_padded), lambda i: (0, 0), **block_spec_kwargs),
+        out_specs=pl.BlockSpec(
+            (1, g_padded), lambda i: (zero, zero), **block_spec_kwargs
+        ),
         interpret=interpret,
     )
 
