@@ -8,6 +8,7 @@ from modin_tpu.config.envvars import (  # noqa: F401
     AutoSwitchBackend,
     Backend,
     BenchmarkMode,
+    CompilationCacheDir,
     CpuCount,
     DeviceCount,
     DevicePutChunkBytes,

@@ -1042,7 +1042,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             from modin_tpu.ops import groupby as gb_ops
 
             try:
-                codes, n_groups, group_keys = gb_ops.factorize_keys_cached(
+                codes, n_groups, group_keys, sizes = gb_ops.factorize_keys_cached(
                     [col.data], len(frame), dropna=dropna
                 )
             except gb_ops._TooManyGroups:
@@ -1051,7 +1051,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 return super().series_value_counts(**kwargs)
             import jax
 
-            counts_dev = gb_ops.groupby_reduce("size", [], codes, n_groups, len(frame))[0]
+            counts_dev = gb_ops.groupby_reduce(
+                "size", [], codes, n_groups, len(frame), sizes=sizes
+            )[0]
             first_dev = gb_ops.groupby_first_position(codes, n_groups)
             counts, first_pos = (
                 np.asarray(v)
@@ -1475,9 +1477,8 @@ class TpuQueryCompiler(BaseQueryCompiler):
         n_groups = int(codes_host.max()) + 1
         if n_groups > (1 << 24):
             return None  # pathological rule vs span: huge empty range
-        has_empty = bool(
-            (np.bincount(codes_host, minlength=n_groups) == 0).any()
-        )
+        bucket_sizes = np.bincount(codes_host, minlength=n_groups)
+        has_empty = bool((bucket_sizes == 0).any())
         n = len(frame)
         codes_padded = np.full(pad_len(n), n_groups, dtype=np.int64)
         codes_padded[:n] = codes_host
@@ -1486,7 +1487,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
         import jax.numpy as jnp
 
         if op == "size":
-            datas = gb_ops.groupby_reduce("size", [], codes, n_groups, n)
+            datas = gb_ops.groupby_reduce(
+                "size", [], codes, n_groups, n, sizes=bucket_sizes
+            )
             # a named series source keeps its name on the size result
             labels = (
                 frame.columns[:1]
@@ -1514,7 +1517,8 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     a = a.astype(jnp.float64)
                 arrays.append(a)
             datas = gb_ops.groupby_reduce(
-                op, arrays, codes, n_groups, n, ddof=int(ddof)
+                op, arrays, codes, n_groups, n, ddof=int(ddof),
+                sizes=bucket_sizes,
             )
             labels = frame.columns[value_positions]
             out_dtypes = [np.dtype(d.dtype) for d in datas]
@@ -1646,7 +1650,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
         if resolved is None:
             return None
-        value_positions, codes, n_groups = resolved
+        value_positions, codes, n_groups, sizes = resolved
         frame = self._modin_frame
         import jax.numpy as jnp
 
@@ -1656,7 +1660,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
             if a.dtype == jnp.bool_ and agg_func in ("sum", "prod", "mean", "var", "std", "sem"):
                 a = a.astype(jnp.int64)
             arrays.append(a)
-        aggs = gb_ops.groupby_reduce(agg_func, arrays, codes, n_groups, len(frame))
+        aggs = gb_ops.groupby_reduce(
+            agg_func, arrays, codes, n_groups, len(frame), sizes=sizes
+        )
         datas = gb_ops.groupby_broadcast(aggs, codes)
         new_cols = [
             DeviceColumn(d, np.dtype(d.dtype), length=len(frame))
@@ -1725,14 +1731,14 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
         frame.materialize_device()
         try:
-            codes, n_groups, _keys = gb_ops.factorize_keys_cached(
+            codes, n_groups, _keys, sizes = gb_ops.factorize_keys_cached(
                 [c.data for c in key_cols], len(frame)
             )
         except gb_ops._TooManyGroups:
             return None
         if n_groups == 0:
             return None
-        return value_positions, codes, n_groups
+        return value_positions, codes, n_groups, sizes
 
     def _try_device_groupby_cum(
         self, op, by, groupby_kwargs, drop, series_groupby, selection
@@ -1747,7 +1753,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
         if resolved is None:
             return None
-        value_positions, codes, _n_groups = resolved
+        value_positions, codes, _n_groups, _sizes = resolved
         frame = self._modin_frame
         import jax.numpy as jnp
 
@@ -1983,7 +1989,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
         frame.materialize_device()
         try:
-            codes, n_groups, group_keys = gb_ops.factorize_keys_cached(
+            codes, n_groups, group_keys, sizes = gb_ops.factorize_keys_cached(
                 [c.data for c in key_cols], len(frame), dropna=dropna
             )
         except gb_ops._TooManyGroups:
@@ -2007,7 +2013,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     a = a.astype(jnp.int64)
             arrays.append(a)
         if agg_func == "size":
-            datas = gb_ops.groupby_reduce("size", [], codes, n_groups, len(frame))
+            datas = gb_ops.groupby_reduce(
+                "size", [], codes, n_groups, len(frame), sizes=sizes
+            )
             value_labels = [MODIN_UNNAMED_SERIES_LABEL]
             out_dtypes = [np.dtype(np.int64)]
         elif agg_func in ("median", "quantile"):
@@ -2030,7 +2038,8 @@ class TpuQueryCompiler(BaseQueryCompiler):
             out_dtypes = [np.dtype(d.dtype) for d in datas]
         else:
             datas = gb_ops.groupby_reduce(
-                agg_func, arrays, codes, n_groups, len(frame), ddof=ddof
+                agg_func, arrays, codes, n_groups, len(frame), ddof=ddof,
+                sizes=sizes,
             )
             for c, d in zip(value_cols, datas):
                 if c.pandas_dtype.kind in "mM" and agg_func in ("min", "max"):

@@ -163,13 +163,15 @@ def _jit_mask_codes(n: int, overflow: int):
 # codes; the cache keys on the device arrays' identity so any new/modified
 # column misses.  Strong refs to the key arrays keep ids stable; the size
 # bound caps pinned device memory.
-_FACTORIZE_CACHE: List[Tuple[Tuple, List[Any], Tuple[Any, int, List[np.ndarray]]]] = []
+_FACTORIZE_CACHE: List[
+    Tuple[Tuple, List[Any], Tuple[Any, int, List[np.ndarray], Any]]
+] = []
 _FACTORIZE_CACHE_MAX = 8
 
 
 def factorize_keys_cached(
     key_cols: List[Any], n: int, dropna: bool = True
-) -> Tuple[Any, int, List[np.ndarray]]:
+) -> Tuple[Any, int, List[np.ndarray], Any]:
     """Memoized :func:`factorize_keys` (same-identity key columns hit)."""
     cache_key = (tuple(id(k) for k in key_cols), int(n), bool(dropna))
     for entry_key, _refs, result in _FACTORIZE_CACHE:
@@ -184,13 +186,16 @@ def factorize_keys_cached(
 
 def factorize_keys(
     key_cols: List[Any], n: int, dropna: bool = True
-) -> Tuple[Any, int, List[np.ndarray]]:
+) -> Tuple[Any, int, List[np.ndarray], Any]:
     """Device factorization of one or more padded key columns (logical len n).
 
-    Returns (codes, num_groups, group_key_arrays_host): ``codes`` maps each
-    row to [0, num_groups), with pads (and NaN keys when dropna) mapped to
-    ``num_groups``.  Group key values are host-side, sorted ascending (pandas
-    sort=True order); a NaN group, when kept, is last.
+    Returns (codes, num_groups, group_key_arrays_host, sizes): ``codes`` maps
+    each row to [0, num_groups), with pads (and NaN keys when dropna) mapped
+    to ``num_groups``.  Group key values are host-side, sorted ascending
+    (pandas sort=True order); a NaN group, when kept, is last.  ``sizes`` is
+    a host int64 array of per-group row counts where the factorization
+    computed one anyway (range/multi-key paths), else None — callers reuse it
+    so ``size``/``mean`` aggregations skip a histogram pass.
     """
     import jax
     import jax.numpy as jnp
@@ -216,14 +221,14 @@ def factorize_keys(
                     uniques = uniques.astype(bool)
                 else:
                     uniques = uniques.astype(np.dtype(str(kdt)))
-                return codes, len(present), [uniques]
+                return codes, len(present), [uniques], counts[present]
             # large-range ints: unique path with pads mapped to k[0]
             k_prepped = _jit_int_prep(n)(k64)
             uniques, codes = jnp.unique(k_prepped, return_inverse=True)
             n_groups = int(uniques.shape[0])
             codes = _jit_mask_codes(n, n_groups)(codes)
             uniques_host = np.asarray(jax.device_get(uniques)).astype(np.dtype(str(kdt)))
-            return codes, n_groups, [uniques_host]
+            return codes, n_groups, [uniques_host], None
         if jnp.issubdtype(kdt, jnp.floating):
             k_prepped, has_nan = _jit_float_prep(n)(k)
             has_nan = bool(has_nan)
@@ -234,12 +239,12 @@ def factorize_keys(
             # >= n_valid — clamp them to one bucket
             if dropna or not has_nan:
                 codes = _jit_clamp_codes(n, n_valid)(codes)
-                return codes, n_valid, [uniques_host[:n_valid]]
+                return codes, n_valid, [uniques_host[:n_valid]], None
             # keep the NaN group (real NaNs), pads -> overflow
             codes = _jit_nan_group_codes(n, n_valid)(codes, k)
             return codes, n_valid + 1, [
                 np.concatenate([uniques_host[:n_valid], [np.nan]])
-            ]
+            ], None
         raise _TooManyGroups()
 
     # multi-key: combine per-level codes into one composite code
@@ -247,7 +252,7 @@ def factorize_keys(
     level_uniques = []
     n_groups_each = []
     for k in key_cols:
-        codes_i, n_i, uniques_i = factorize_keys([k], n, dropna=dropna)
+        codes_i, n_i, uniques_i, _sizes_i = factorize_keys([k], n, dropna=dropna)
         level_codes.append(codes_i)
         level_uniques.append(uniques_i[0])
         n_groups_each.append(n_i)
@@ -268,7 +273,7 @@ def factorize_keys(
         keys_out.append(np.asarray(uniques_i)[rem % n_i])
         rem = rem // n_i
     keys_out.reverse()
-    return codes, len(present), keys_out
+    return codes, len(present), keys_out, counts[present]
 
 
 @functools.lru_cache(maxsize=None)
@@ -343,6 +348,7 @@ def _jit_remap(n_present: int):
 def _jit_segment_agg(
     agg: str, n_cols: int, num_segments: int, ddof: int, p_out: int,
     adaptive: bool = False,
+    has_sizes: bool = False,
 ):
     """One jit computing the aggregation for every value column; results are
     sliced to the real group count and padded to the shard multiple.
@@ -350,7 +356,8 @@ def _jit_segment_agg(
     ``adaptive`` (single-shard meshes only — lax.cond over sharded operands
     is unsafe under SPMD) runs the unmasked segment sum first and falls into
     the NaN-masked form only when the result shows a NaN occurred, sharing
-    one group-sizes histogram across clean columns.
+    one group-sizes histogram across clean columns.  With ``has_sizes`` the
+    histogram arrives precomputed (factorization by-product) as an operand.
     """
     import jax
     import jax.numpy as jnp
@@ -447,13 +454,16 @@ def _jit_segment_agg(
             return jax.ops.segment_min(x.astype(jnp.int32), codes, num_segments=ns).astype(bool)
         raise ValueError(agg)
 
-    def fn(cols: Tuple, codes):
+    def fn(cols: Tuple, codes, sizes_in=None):
         sizes = None
         if adaptive and agg in ("sum", "mean", "count"):
-            sizes = jax.ops.segment_sum(
-                jnp.ones(codes.shape, jnp.int64), codes,
-                num_segments=num_segments,
-            )
+            if has_sizes:
+                sizes = sizes_in
+            else:
+                sizes = jax.ops.segment_sum(
+                    jnp.ones(codes.shape, jnp.int64), codes,
+                    num_segments=num_segments,
+                )
         out = []
         for c in cols:
             if sizes is not None and jnp.issubdtype(c.dtype, jnp.floating):
@@ -689,25 +699,34 @@ def groupby_reduce(
     num_groups: int,
     n: int,
     ddof: int = 1,
+    sizes: Any = None,
 ) -> List[Any]:
     """Aggregate value columns by group codes; returns device arrays padded to
     the shard multiple with logical length num_groups (the overflow pad/NaN
-    bucket is sliced off)."""
-    import jax
+    bucket is sliced off).
 
-    from modin_tpu.ops.structural import pad_len
+    ``sizes`` (host int64 per-group row counts, a factorization by-product)
+    lets ``size`` skip the histogram kernel entirely and feeds the adaptive
+    sum/mean/count path its denominator for free.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from modin_tpu.ops.structural import pad_host, pad_len
 
     ns = num_groups + 1
     p_out = pad_len(num_groups)
     if agg == "size":
+        if sizes is not None:
+            return [jnp.asarray(pad_host(np.asarray(sizes, np.int64), num_groups))]
         from modin_tpu.ops.pallas.groupby_kernels import (
             bincount_supported,
             pallas_bincount,
         )
 
         if bincount_supported(codes, num_groups):
-            sizes = pallas_bincount(codes, num_groups)
-            return [_jit_pad_to(p_out)(sizes)]
+            counts = pallas_bincount(codes, num_groups)
+            return [_jit_pad_to(p_out)(counts)]
         return [_jit_segment_size(ns, p_out)(codes)]
     on_tpu = next(iter(codes.devices())).platform == "tpu"
     if _FORCE_KERNEL == "masked_scan":
@@ -727,7 +746,19 @@ def groupby_reduce(
     from modin_tpu.parallel.mesh import num_row_shards
 
     adaptive = num_row_shards() == 1
-    fn = _jit_segment_agg(agg, len(value_cols), ns, int(ddof), p_out, adaptive)
+    has_sizes = (
+        adaptive and sizes is not None and agg in ("sum", "mean", "count")
+    )
+    fn = _jit_segment_agg(
+        agg, len(value_cols), ns, int(ddof), p_out, adaptive, has_sizes
+    )
+    if has_sizes:
+        # operand layout matches the in-kernel histogram: ns slots with an
+        # overflow bucket (its value is sliced off, 1 avoids a 0-divide)
+        sizes_dev = jnp.asarray(
+            np.append(np.asarray(sizes, np.int64), 1)
+        )
+        return list(fn(tuple(value_cols), codes, sizes_dev))
     return list(fn(tuple(value_cols), codes))
 
 

@@ -33,9 +33,28 @@ def initialize_jax() -> None:
     # int64/float64 frames round-trip exactly; hot kernels can downcast
     # explicitly where the Float64Policy config allows it.
     jax.config.update("jax_enable_x64", True)
+
     from modin_tpu.parallel.mesh import get_mesh
 
     get_mesh()
+
+    from modin_tpu.config import CompilationCacheDir
+
+    cache_dir = CompilationCacheDir.get()
+    # TPU/accelerator only: every fresh compile over the tunnel is a 20-40s
+    # remote round-trip, so persist all of them.  XLA:CPU AOT artifacts are
+    # not portable across host feature detection (SIGILL warnings), and CPU
+    # compiles are fast — skip the cache there.
+    if cache_dir and jax.default_backend() != "cpu":
+        try:
+            import os
+
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:  # pragma: no cover - cache is best-effort
+            pass
 
 
 class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
