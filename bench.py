@@ -101,7 +101,8 @@ def time_ops(df, ops, execute):
 
 
 def main() -> None:
-    platform = "timeout" if os.environ.get("BENCH_FORCE_CPU") else _probe_devices()
+    force_cpu = os.environ.get("BENCH_FORCE_CPU", "").lower() in ("1", "true", "yes")
+    platform = "timeout" if force_cpu else _probe_devices()
     if platform in ("timeout", "error"):
         # the accelerator tunnel is down: restart jax on CPU in this process
         # so the bench still emits a (CPU-vs-CPU) line instead of hanging

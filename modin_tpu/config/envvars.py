@@ -355,10 +355,15 @@ class AsvImplementation(EnvironmentVariable, type=ExactStr):
 
 
 class TrackFileLeaks(EnvironmentVariable, type=bool):
-    """Test-only: check for leaked file descriptors after each test."""
+    """Audit IO reads for leaked file descriptors (ResourceWarning on leak).
+
+    Off by default: the /proc/self/fd scan costs on every read, and some
+    formats legitimately retain descriptors (mmap).  The test suite turns it
+    on globally (tests/conftest.py), mirroring the reference's test-conftest
+    use of its flag (reference: envvars.py:893)."""
 
     varname = "MODIN_TPU_TEST_TRACK_FILE_LEAKS"
-    default = os.name != "nt"
+    default = False
 
 
 class PersistentPickle(EnvironmentVariable, type=bool):

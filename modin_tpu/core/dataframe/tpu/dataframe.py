@@ -60,7 +60,10 @@ class DeviceColumn:
     computed column drops the cache.
     """
 
-    __slots__ = ("_data", "pandas_dtype", "length", "host_cache")
+    __slots__ = (
+        "_data", "pandas_dtype", "length", "host_cache", "_ledger_key",
+        "__weakref__",
+    )
     is_device = True
 
     def __init__(
@@ -77,6 +80,12 @@ class DeviceColumn:
         self.pandas_dtype = np.dtype(pandas_dtype)
         self.length = int(length) if length is not None else int(data.shape[0])
         self.host_cache = host_cache
+        self._ledger_key = None
+        if host_cache is not None:
+            # host caches count against the Memory spill budget (core/memory.py)
+            from modin_tpu.core.memory import ledger
+
+            ledger.register(self)
 
     @property
     def data(self) -> Any:
@@ -127,8 +136,12 @@ class DeviceColumn:
     def to_numpy(self) -> np.ndarray:
         from modin_tpu.parallel.engine import JaxWrapper
 
-        if self.host_cache is not None:
-            return self.host_cache
+        cache = self.host_cache  # single read: eviction may race us
+        if cache is not None:
+            from modin_tpu.core.memory import ledger
+
+            ledger.touch(self)
+            return cache
         values = np.asarray(JaxWrapper.materialize(self.data))[: self.length]
         if self.pandas_dtype.kind in "mM":
             values = values.view(self.pandas_dtype)
@@ -359,11 +372,8 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
             )
             for i, d in zip(device_idx, datas):
                 col = self._columns[i]
-                cache = (
-                    col.host_cache.take(pos_arr)
-                    if col.host_cache is not None
-                    else None
-                )
+                src = col.host_cache  # single read: eviction may race us
+                cache = src.take(pos_arr) if src is not None else None
                 new_columns[i] = DeviceColumn(
                     d, col.pandas_dtype, length=len(pos_arr), host_cache=cache
                 )
@@ -456,9 +466,11 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
             datas, n_out = concat_columns(parts, lengths)
             for ci, d in zip(device_cis, datas):
                 cols = [f._columns[ci] for f in frames]
+                # single read per column: eviction may race us
+                caches = [c.host_cache for c in cols]
                 cache = None
-                if all(c.host_cache is not None for c in cols):
-                    cache = np.concatenate([c.host_cache for c in cols])
+                if all(c is not None for c in caches):
+                    cache = np.concatenate(caches)
                 new_columns[ci] = DeviceColumn(
                     d, cols[0].pandas_dtype, length=total, host_cache=cache
                 )

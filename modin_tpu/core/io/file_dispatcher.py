@@ -22,8 +22,14 @@ class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
 
     @classmethod
     def read(cls, *args: Any, **kwargs: Any):
-        """Template: normalize, dispatch to _read, postprocess."""
-        return cls._read(*args, **kwargs)
+        """Template: normalize, dispatch to _read, postprocess.
+
+        Under the ``TrackFileLeaks`` config every read is audited for leaked
+        file descriptors (reference guard: modin/config/envvars.py:893)."""
+        from modin_tpu.utils.file_leaks import track_file_leaks
+
+        with track_file_leaks():
+            return cls._read(*args, **kwargs)
 
     @classmethod
     def _read(cls, *args: Any, **kwargs: Any):

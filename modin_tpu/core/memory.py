@@ -1,0 +1,125 @@
+"""Host-memory budget for device-column caches (spill policy).
+
+TPU-native analogue of the reference's ``Memory`` knob (reference:
+modin/config/envvars.py:188-ish ``Memory`` sizes the object-store /plasma
+spill budget for its engines).  Here the analogous host-RAM consumer is
+``DeviceColumn.host_cache`` — the exact host copy kept so device round-trips
+are bit-exact and fallbacks skip transfers.  When ``Memory`` (bytes) is set,
+a process-wide LRU ledger evicts the coldest caches once the total exceeds
+the budget; the device buffer remains authoritative, so eviction only drops
+a cache whose dtype round-trips exactly from device (not logical float64
+stored as f32 under ``Float64Policy=Downcast``).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Optional
+
+
+class _HostCacheLedger:
+    """LRU accounting of live host caches across all device columns."""
+
+    def __init__(self) -> None:
+        # reentrant: a weakref callback can fire via GC while the same
+        # thread already holds the lock (a plain Lock would self-deadlock)
+        self._lock = threading.RLock()
+        # ledger id -> (weakref to column, nbytes); insertion order = LRU
+        self._entries: "OrderedDict[int, tuple]" = OrderedDict()
+        self._total = 0
+        self._next_id = 0
+
+    def register(self, col: Any) -> None:
+        cache = col.host_cache
+        if cache is None or not hasattr(cache, "nbytes"):
+            return
+        nbytes = int(cache.nbytes)
+        with self._lock:
+            key = self._next_id
+            self._next_id += 1
+
+            def _on_dead(_ref: Any, *, _key: int = key) -> None:
+                self._forget(_key)
+
+            self._entries[key] = (weakref.ref(col, _on_dead), nbytes)
+            col._ledger_key = key
+            self._total += nbytes
+        self.enforce()
+
+    def _forget(self, key: int) -> None:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._total -= entry[1]
+
+    def touch(self, col: Any) -> None:
+        key = getattr(col, "_ledger_key", None)
+        if key is None:
+            return
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+
+    def total_bytes(self) -> int:
+        return self._total
+
+    def budget(self) -> Optional[int]:
+        from modin_tpu.config import Memory
+
+        return Memory.get()
+
+    def enforce(self) -> None:
+        """Evict least-recently-used evictable caches until under budget."""
+        budget = self.budget()
+        if budget is None or self._total <= budget:
+            return
+        with self._lock:
+            for key in list(self._entries):
+                if self._total <= budget:
+                    break
+                entry = self._entries.get(key)
+                if entry is None:  # removed by a GC callback mid-iteration
+                    continue
+                ref, nbytes = entry
+                col = ref()
+                if col is None:
+                    self._entries.pop(key)
+                    self._total -= nbytes
+                    continue
+                if not _evictable(col):
+                    continue
+                col.host_cache = None
+                col._ledger_key = None
+                self._entries.pop(key)
+                self._total -= nbytes
+
+
+def _evictable(col: Any) -> bool:
+    """Whether dropping this cache keeps host reads bit-exact.
+
+    The device buffer must round-trip the logical dtype exactly: anything
+    except a logical float64 column stored downcast to f32 qualifies (with
+    x64 on, ints/floats/datetimes round-trip; datetimes live as int64 views).
+    """
+    cache = col.host_cache
+    if cache is None:
+        return False
+    if col.is_lazy:
+        return False  # materialization may still want the exact source
+    try:
+        device_dtype = col.raw.dtype
+    except Exception:
+        return False
+    if col.pandas_dtype.kind == "f" and str(device_dtype) != str(col.pandas_dtype):
+        return False  # Downcast policy: the cache IS the exact copy
+    return True
+
+
+ledger = _HostCacheLedger()
+
+
+def host_cache_bytes() -> int:
+    """Total host bytes currently pinned by device-column caches."""
+    return ledger.total_bytes()

@@ -41,14 +41,26 @@ def _inherit_docstrings_in_place(
     excluded: List[object],
     overwrite_existing: bool = False,
     apilink: Optional[Union[str, List[str]]] = None,
+    record: Optional[List[tuple]] = None,
+    only: Optional[set] = None,
 ) -> None:
+    """Copy docs from ``parent`` onto ``cls_or_func`` (class walks its MRO).
+
+    ``record`` collects a key for every docstring actually written, so a
+    later ``DocModule`` re-source can restrict itself (via ``only``) to
+    exactly the inheritance-managed docs — hand-written docstrings that the
+    decoration-time pass preserved stay untouched forever.
+    """
     if parent in excluded:
         return
-    if parent not in _docstring_inheritance_calls:
+    _CLS_DOC = ("cls",)
+    if parent not in _docstring_inheritance_calls and (only is None or _CLS_DOC in only):
         doc = getattr(parent, "__doc__", None)
-        if doc and (not cls_or_func.__doc__ or overwrite_existing):
+        if doc and (not cls_or_func.__doc__ or overwrite_existing or only):
             try:
                 cls_or_func.__doc__ = doc
+                if record is not None:
+                    record.append(_CLS_DOC)
             except AttributeError:
                 pass
     if not isinstance(cls_or_func, types.FunctionType):
@@ -60,6 +72,8 @@ def _inherit_docstrings_in_place(
                 if attr in seen or attr.startswith("__"):
                     continue
                 seen.add(attr)
+                if only is not None and (base, attr) not in only:
+                    continue
                 parent_obj = getattr(parent, attr, None)
                 if parent_obj is None:
                     continue
@@ -67,25 +81,97 @@ def _inherit_docstrings_in_place(
                 if not parent_doc:
                     continue
                 if isinstance(obj, property):
-                    if obj.__doc__ is None or overwrite_existing:
+                    if obj.__doc__ is None or overwrite_existing or only:
                         try:
                             setattr(
                                 base,
                                 attr,
                                 property(obj.fget, obj.fset, obj.fdel, parent_doc),
                             )
+                            if record is not None:
+                                record.append((base, attr))
                         except (AttributeError, TypeError):
                             pass
                 elif callable(obj) or isinstance(obj, (classmethod, staticmethod)):
                     target = obj.__func__ if isinstance(obj, (classmethod, staticmethod)) else obj
-                    if getattr(target, "__doc__", None) is None or overwrite_existing:
+                    if getattr(target, "__doc__", None) is None or overwrite_existing or only:
                         try:
                             target.__doc__ = parent_doc
+                            if record is not None:
+                                record.append((base, attr))
                         except AttributeError:
                             pass
 
 
 _docstring_inheritance_calls: set = set()
+
+# every _inherit_docstrings application, so DocModule can re-source docs later
+_DOC_CALLS: List[tuple] = []
+# the module object docs are currently sourced from (None = plain pandas)
+_ACTIVE_DOC_MODULE: Optional[types.ModuleType] = None
+
+
+def _resolve_doc_counterpart(parent: object, doc_module: types.ModuleType) -> object:
+    """The object in ``doc_module`` matching ``parent``'s qualified name.
+
+    Falls back to ``parent`` itself (keeping pandas docs) when the custom
+    module has no counterpart — DocModule overrides are partial by design
+    (reference behavior: envvars.py DocModule + utils.py doc re-sourcing).
+    """
+    if isinstance(parent, types.ModuleType):
+        return doc_module
+    path = getattr(parent, "__qualname__", getattr(parent, "__name__", None))
+    if not path:
+        return parent
+    obj: object = doc_module
+    for part in path.split("."):
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return parent
+    return obj
+
+
+def _apply_doc_module(param) -> None:
+    """DocModule subscriber: re-source registered docstrings from the module.
+
+    Only docstrings the decoration-time pass itself wrote (each call's
+    ``written`` record) are ever re-sourced; reverting to ``"pandas"``
+    restores the originals from each call's own parent.
+    """
+    global _ACTIVE_DOC_MODULE
+    name = param.get()
+    if name == "pandas":
+        if _ACTIVE_DOC_MODULE is not None:
+            # restore the decoration-time docs from each original parent
+            _ACTIVE_DOC_MODULE = None
+            for cls_or_func, parent, excluded, apilink, written in list(_DOC_CALLS):
+                _inherit_docstrings_in_place(
+                    cls_or_func, parent, excluded,
+                    apilink=apilink, only=set(written),
+                )
+        return
+    try:
+        mod = importlib.import_module(name)
+    except ImportError:
+        import warnings
+
+        previous = getattr(_ACTIVE_DOC_MODULE, "__name__", "pandas")
+        warnings.warn(
+            f"DocModule {name!r} is not importable; keeping docs from {previous!r}"
+        )
+        return
+    _ACTIVE_DOC_MODULE = mod
+    for cls_or_func, parent, excluded, apilink, written in list(_DOC_CALLS):
+        # attrs without a counterpart in the custom module restore/keep their
+        # parent docs (_resolve falls back to parent); the ``written`` filter
+        # means hand-written docstrings are never touched
+        _inherit_docstrings_in_place(
+            cls_or_func,
+            _resolve_doc_counterpart(parent, mod),
+            excluded,
+            apilink=apilink,
+            only=set(written),
+        )
 
 
 def _inherit_docstrings(
@@ -97,17 +183,39 @@ def _inherit_docstrings(
     """Class/function decorator copying docstrings from a pandas counterpart.
 
     Reference: modin/utils.py:544 — keeps the public API self-documenting
-    without duplicating pandas' docs in-repo.
+    without duplicating pandas' docs in-repo.  Applications are recorded so a
+    ``DocModule`` change re-sources every registered docstring from the
+    user's module (reference: envvars.py:1338).
     """
     excluded = excluded or []
 
     def decorator(cls_or_func: Fn) -> Fn:
+        written: List[tuple] = []
         _inherit_docstrings_in_place(
-            cls_or_func, parent, excluded, overwrite_existing, apilink
+            cls_or_func, parent, excluded, overwrite_existing, apilink,
+            record=written,
         )
+        _DOC_CALLS.append((cls_or_func, parent, excluded, apilink, written))
+        if _ACTIVE_DOC_MODULE is not None:
+            # DocModule was set before this class was imported: apply now
+            counterpart = _resolve_doc_counterpart(parent, _ACTIVE_DOC_MODULE)
+            if counterpart is not parent:
+                _inherit_docstrings_in_place(
+                    cls_or_func,
+                    counterpart,
+                    excluded,
+                    apilink=apilink,
+                    only=set(written),
+                )
         return cls_or_func
 
     return decorator
+
+
+def _subscribe_doc_module() -> None:
+    from modin_tpu.config import DocModule
+
+    DocModule.subscribe(_apply_doc_module)
 
 
 def expanduser_path_arg(argname: str) -> Callable[[Fn], Fn]:
@@ -315,3 +423,6 @@ def sentinel(name: str) -> object:
 
 
 no_default = pandas.api.extensions.no_default
+
+
+_subscribe_doc_module()

@@ -1,0 +1,63 @@
+"""File-descriptor leak tracking for the IO layer.
+
+TPU-native analogue of the reference's ``TrackFileLeaks`` test guard
+(reference: modin/config/envvars.py:893 and its use in modin/tests/pandas
+conftest): when the config is enabled, every dispatcher ``read`` snapshots
+the process's open regular-file descriptors before and after and raises
+``ResourceWarning`` on anything left behind.  Uses ``/proc/self/fd`` (no
+psutil in the image); on platforms without procfs the tracker is a no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import warnings
+from typing import Dict, Iterator
+
+_FD_DIR = "/proc/self/fd"
+
+
+def open_file_fds() -> Dict[int, str]:
+    """Open fds resolving to regular files (pipes/sockets/devices excluded)."""
+    out: Dict[int, str] = {}
+    try:
+        fds = os.listdir(_FD_DIR)
+    except OSError:  # no procfs
+        return out
+    for name in fds:
+        try:
+            target = os.readlink(os.path.join(_FD_DIR, name))
+        except OSError:
+            continue  # fd closed while listing (e.g. the listdir handle)
+        if target.startswith("/") and not target.startswith(("/dev", "/proc", "/sys")):
+            with contextlib.suppress(ValueError):
+                out[int(name)] = target
+    return out
+
+
+@contextlib.contextmanager
+def track_file_leaks() -> Iterator[None]:
+    """Raise ``ResourceWarning`` if the block leaks regular-file descriptors.
+
+    Gated on the ``TrackFileLeaks`` config; zero overhead when disabled.
+    """
+    from modin_tpu.config import TrackFileLeaks
+
+    if not TrackFileLeaks.get():
+        yield
+        return
+    before = open_file_fds()
+    yield
+    leaked = {
+        fd: path
+        for fd, path in open_file_fds().items()
+        if before.get(fd) != path
+    }
+    if leaked:
+        warnings.warn(
+            "file descriptors leaked by IO operation: "
+            + ", ".join(f"{fd}->{path}" for fd, path in sorted(leaked.items())),
+            ResourceWarning,
+            stacklevel=3,
+        )

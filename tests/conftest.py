@@ -16,6 +16,9 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# audit every dispatcher read for fd leaks throughout the suite (the config
+# defaults off in production; see TrackFileLeaks)
+os.environ.setdefault("MODIN_TPU_TEST_TRACK_FILE_LEAKS", "True")
 
 import jax  # noqa: E402
 
