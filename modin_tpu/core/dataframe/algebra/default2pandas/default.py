@@ -182,7 +182,9 @@ class RollingDefault(DefaultMethod):
 
     @classmethod
     def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
-        fn_name = func if isinstance(func, str) else getattr(func, "__name__", str(func))
+        fn_name = kw.get("fn_name") or (
+            func if isinstance(func, str) else getattr(func, "__name__", str(func))
+        )
 
         def caller(
             query_compiler: Any, rolling_kwargs: dict, *args: Any, **kwargs: Any
@@ -192,7 +194,7 @@ class RollingDefault(DefaultMethod):
                 df = df.squeeze(axis=1)
             ErrorMessage.default_to_pandas(f"`Rolling.{fn_name}`")
             roller = df.rolling(**rolling_kwargs)
-            fn = getattr(type(roller), fn_name) if isinstance(func, str) else func
+            fn = getattr(type(roller), func) if isinstance(func, str) else func
             return cls.build_output(query_compiler, fn(roller, *args, **kwargs))
 
         caller.__name__ = f"rolling_{fn_name}"
@@ -204,7 +206,9 @@ class ExpandingDefault(DefaultMethod):
 
     @classmethod
     def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
-        fn_name = func if isinstance(func, str) else getattr(func, "__name__", str(func))
+        fn_name = kw.get("fn_name") or (
+            func if isinstance(func, str) else getattr(func, "__name__", str(func))
+        )
 
         def caller(
             query_compiler: Any, expanding_args: list, *args: Any, **kwargs: Any
@@ -214,7 +218,7 @@ class ExpandingDefault(DefaultMethod):
                 df = df.squeeze(axis=1)
             ErrorMessage.default_to_pandas(f"`Expanding.{fn_name}`")
             roller = df.expanding(*expanding_args)
-            fn = getattr(type(roller), fn_name) if isinstance(func, str) else func
+            fn = getattr(type(roller), func) if isinstance(func, str) else func
             return cls.build_output(query_compiler, fn(roller, *args, **kwargs))
 
         caller.__name__ = f"expanding_{fn_name}"
@@ -226,7 +230,9 @@ class ResampleDefault(DefaultMethod):
 
     @classmethod
     def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
-        fn_name = func if isinstance(func, str) else getattr(func, "__name__", str(func))
+        fn_name = kw.get("fn_name") or (
+            func if isinstance(func, str) else getattr(func, "__name__", str(func))
+        )
 
         def caller(
             query_compiler: Any, resample_kwargs: dict, *args: Any, **kwargs: Any
@@ -243,7 +249,7 @@ class ResampleDefault(DefaultMethod):
                     df = df.rename(None)
             ErrorMessage.default_to_pandas(f"`Resampler.{fn_name}`")
             resampler = df.resample(**resample_kwargs)
-            fn = getattr(type(resampler), fn_name) if isinstance(func, str) else func
+            fn = getattr(type(resampler), func) if isinstance(func, str) else func
             return cls.build_output(query_compiler, fn(resampler, *args, **kwargs))
 
         caller.__name__ = f"resample_{fn_name}"
@@ -290,7 +296,12 @@ class BinaryDefault(DefaultMethod):
     @classmethod
     def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
         fn = cls.get_func(func, pandas.DataFrame)
-        fn_name = getattr(func, "__name__", str(func)) if not isinstance(func, str) else func
+        # lookup name (resolves the Series counterpart method) stays tied to
+        # the pandas callable; fn_name only overrides the display/QC name
+        lookup_name = (
+            func if isinstance(func, str) else getattr(func, "__name__", str(func))
+        )
+        fn_name = kw.get("fn_name") or lookup_name
 
         def caller(
             query_compiler: Any, other: Any, *args: Any, **kwargs: Any
@@ -318,7 +329,7 @@ class BinaryDefault(DefaultMethod):
                     if k not in ("axis", "level", "fill_value")
                 }
             if isinstance(df, pandas.Series):
-                series_fn = getattr(pandas.Series, fn_name, None)
+                series_fn = getattr(pandas.Series, lookup_name, None)
                 result = (
                     series_fn(df, other, *args, **kwargs)
                     if series_fn is not None

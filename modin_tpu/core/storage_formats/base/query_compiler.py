@@ -889,8 +889,145 @@ def _register_defaults() -> None:
         "cumcount", "rank", "shift", "diff", "pct_change", "quantile",
         "fillna", "ffill", "bfill", "idxmin", "idxmax", "corr", "cov",
         "value_counts", "ohlc", "sample", "nth", "unique",
+        "get_group", "nlargest", "nsmallest", "take", "hist", "boxplot",
     ]:
         setattr(BaseQueryCompiler, f"groupby_{name}", GroupByDefault.register(name))
+
+    _register_long_tail()
+
+
+def _register_long_tail() -> None:
+    """The rest of the reference QC surface (ref base/query_compiler.py:162):
+    binary comparisons in Series form, reshape free functions, Arrow list/
+    struct accessors, win_type rolling, and resample shape variants.  All
+    default-to-pandas; concrete compilers may override any of them, and the
+    caster/extensions/tracing layers observe them by name."""
+    # Series-form binary comparisons (ref: series_eq..series_ge) + divmod
+    for name in ("eq", "ne", "lt", "le", "gt", "ge"):
+        setattr(
+            BaseQueryCompiler,
+            f"series_{name}",
+            BinaryDefault.register(
+                getattr(pandas.Series, name), squeeze_self=True, fn_name=f"series_{name}"
+            ),
+        )
+    BaseQueryCompiler.divmod = BinaryDefault.register(
+        pandas.Series.divmod, squeeze_self=True, fn_name="divmod"
+    )
+    BaseQueryCompiler.rdivmod = BinaryDefault.register(
+        pandas.Series.rdivmod, squeeze_self=True, fn_name="rdivmod"
+    )
+    BaseQueryCompiler.equals = BinaryDefault.register(pandas.DataFrame.equals)
+    BaseQueryCompiler.corrwith = BinaryDefault.register(pandas.DataFrame.corrwith)
+    BaseQueryCompiler.mask = BinaryDefault.register(pandas.DataFrame.mask)
+    BaseQueryCompiler.series_mask = BinaryDefault.register(
+        pandas.Series.mask, squeeze_self=True, fn_name="series_mask"
+    )
+
+    # reshape / free-function surface applied against self
+    BaseQueryCompiler.pivot_table = DataFrameDefault.register(
+        pandas.DataFrame.pivot_table
+    )
+    BaseQueryCompiler.cut = SeriesDefault.register(
+        lambda s, **kwargs: pandas.cut(s, **kwargs), fn_name="cut"
+    )
+    BaseQueryCompiler.qcut = SeriesDefault.register(
+        lambda s, **kwargs: pandas.qcut(s, **kwargs), fn_name="qcut"
+    )
+    BaseQueryCompiler.merge_ordered = BinaryDefault.register(
+        lambda df, right, **kwargs: pandas.merge_ordered(df, right, **kwargs),
+        fn_name="merge_ordered",
+    )
+    BaseQueryCompiler.wide_to_long = DataFrameDefault.register(
+        lambda df, **kwargs: pandas.wide_to_long(df, **kwargs), fn_name="wide_to_long"
+    )
+    BaseQueryCompiler.lreshape = DataFrameDefault.register(
+        lambda df, groups, **kwargs: pandas.lreshape(df, groups, **kwargs),
+        fn_name="lreshape",
+    )
+
+    # conversions / misc parity names
+    BaseQueryCompiler.dataframe_to_dict = DataFrameDefault.register(
+        pandas.DataFrame.to_dict, fn_name="dataframe_to_dict"
+    )
+    BaseQueryCompiler.series_to_dict = SeriesDefault.register(
+        pandas.Series.to_dict, fn_name="series_to_dict"
+    )
+    BaseQueryCompiler.to_list = SeriesDefault.register(
+        pandas.Series.to_list, fn_name="to_list"
+    )
+    BaseQueryCompiler.argsort = SeriesDefault.register(pandas.Series.argsort)
+    BaseQueryCompiler.conj = DataFrameDefault.register(
+        lambda df: pandas.DataFrame(
+            np.conj(df.to_numpy()), index=df.index, columns=df.columns
+        ),
+        fn_name="conj",
+    )
+    BaseQueryCompiler.delitem = DataFrameDefault.register(
+        lambda df, key: df.drop(columns=[key]), fn_name="delitem"
+    )
+    BaseQueryCompiler.sizeof = DataFrameDefault.register(
+        lambda df: df.memory_usage(index=True, deep=True).sum(), fn_name="sizeof"
+    )
+    BaseQueryCompiler.quantile_for_single_value = DataFrameDefault.register(
+        pandas.DataFrame.quantile, fn_name="quantile_for_single_value"
+    )
+    BaseQueryCompiler.quantile_for_list_of_values = DataFrameDefault.register(
+        pandas.DataFrame.quantile, fn_name="quantile_for_list_of_values"
+    )
+
+    # dt unit conversion (pandas 2+ non-nano support)
+    BaseQueryCompiler.dt_as_unit = SeriesDefault.register(
+        lambda s, *a, **k: s.dt.as_unit(*a, **k), fn_name="as_unit"
+    )
+
+    # Arrow-backed list/struct accessors (ref: list_*, struct_*)
+    BaseQueryCompiler.list_flatten = ListDefault.register("flatten", fn_name="flatten")
+    BaseQueryCompiler.list_len = ListDefault.register("len", fn_name="len")
+    BaseQueryCompiler.list___getitem__ = ListDefault.register(
+        "__getitem__", fn_name="__getitem__"
+    )
+    BaseQueryCompiler.struct_explode = StructDefault.register(
+        "explode", fn_name="explode"
+    )
+    BaseQueryCompiler.struct_field = StructDefault.register("field", fn_name="field")
+    BaseQueryCompiler.struct_dtypes = StructDefault.register(
+        lambda acc: acc.dtypes, fn_name="dtypes"
+    )
+
+    # win_type rolling (pandas Window object; kwargs carry win_type)
+    for name in ("mean", "sum", "var", "std"):
+        setattr(BaseQueryCompiler, f"window_{name}", RollingDefault.register(name))
+
+    # resample shape variants (ref: resample_agg_df/ser, app_df/ser, ohlc_*)
+    BaseQueryCompiler.resample_agg_df = ResampleDefault.register(
+        "aggregate", fn_name="agg_df"
+    )
+    BaseQueryCompiler.resample_agg_ser = ResampleDefault.register(
+        "aggregate", squeeze_self=True, fn_name="agg_ser"
+    )
+    BaseQueryCompiler.resample_app_df = ResampleDefault.register(
+        "apply", fn_name="app_df"
+    )
+    BaseQueryCompiler.resample_app_ser = ResampleDefault.register(
+        "apply", squeeze_self=True, fn_name="app_ser"
+    )
+    BaseQueryCompiler.resample_ohlc_df = ResampleDefault.register(
+        "ohlc", fn_name="ohlc_df"
+    )
+    BaseQueryCompiler.resample_ohlc_ser = ResampleDefault.register(
+        "ohlc", squeeze_self=True, fn_name="ohlc_ser"
+    )
+    BaseQueryCompiler.resample_fillna = ResampleDefault.register(
+        lambda r, method, limit=None: r.nearest(limit=limit)
+        if method == "nearest"
+        else getattr(r, method)(limit=limit),
+        fn_name="fillna",
+    )
+    BaseQueryCompiler.resample_get_group = ResampleDefault.register(
+        "get_group", fn_name="get_group"
+    )
+    BaseQueryCompiler.resample_pipe = ResampleDefault.register("pipe", fn_name="pipe")
 
 
 _register_defaults()

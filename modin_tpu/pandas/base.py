@@ -10,6 +10,7 @@ full pandas surface is available from day one.
 from __future__ import annotations
 
 import functools
+import inspect
 import pickle as pkl
 import re
 from typing import Any, Hashable, Optional, Sequence, Union
@@ -121,19 +122,24 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
             result = attr(*args, **kwargs) if callable(attr) else attr
         if result is None and kwargs.get("inplace", False):
             # the pandas op mutated pandas_obj in place
-            return self._create_or_update_from_compiler(
-                type(self._query_compiler).from_pandas(
-                    pandas_obj
-                    if isinstance(pandas_obj, pandas.DataFrame)
-                    else pandas_obj.to_frame(
-                        pandas_obj.name
-                        if pandas_obj.name is not None
-                        else MODIN_UNNAMED_SERIES_LABEL
-                    )
-                ),
-                inplace=True,
-            )
+            return self._update_inplace_from_pandas(pandas_obj)
         return self._wrap_pandas(result)
+
+    def _update_inplace_from_pandas(self, pandas_obj: Any) -> None:
+        """Replace this object's contents with a mutated pandas object."""
+        new_qc = type(self._query_compiler).from_pandas(
+            pandas_obj
+            if isinstance(pandas_obj, pandas.DataFrame)
+            else pandas_obj.to_frame(
+                pandas_obj.name
+                if pandas_obj.name is not None
+                else MODIN_UNNAMED_SERIES_LABEL
+            )
+        )
+        # from_pandas always builds a frame-shaped QC; a Series must keep its
+        # column hint or downstream squeezes (binary ops, casts) break
+        new_qc._shape_hint = self._query_compiler._shape_hint
+        return self._create_or_update_from_compiler(new_qc, inplace=True)
 
     def _reduce_dimension(self, query_compiler) -> Any:
         """Turn a reduction-result QC into a Series (DataFrame) or scalar (Series)."""
@@ -865,6 +871,35 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
                 final_qc = new_qc.reindex(axis=1, labels=columns, **kwargs)
         return self._create_or_update_from_compiler(final_qc)
 
+    def rename_axis(
+        self,
+        mapper: Any = no_default,
+        *,
+        index: Any = no_default,
+        columns: Any = no_default,
+        axis: Any = 0,
+        copy: Any = None,
+        inplace: bool = False,
+    ):
+        # metadata-only: pandas resolves the mapper semantics against an empty
+        # shell carrying our axis labels, then the new names apply in place
+        obj = self if inplace else self.copy()
+        if self.ndim == 2:
+            shell = pandas.DataFrame(index=self.index[:0], columns=self.columns)
+            shell.rename_axis(
+                mapper, index=index, columns=columns, axis=axis, inplace=True
+            )
+            if list(shell.index.names) != list(obj.index.names):
+                obj.index = obj.index.set_names(shell.index.names)
+            if list(shell.columns.names) != list(obj.columns.names):
+                obj.columns = obj.columns.set_names(shell.columns.names)
+        else:
+            shell = pandas.Series(index=self.index[:0], dtype="float64")
+            shell.rename_axis(mapper, index=index, axis=axis, inplace=True)
+            if list(shell.index.names) != list(obj.index.names):
+                obj.index = obj.index.set_names(shell.index.names)
+        return None if inplace else obj
+
     def drop(
         self,
         labels: Any = None,
@@ -1287,12 +1322,23 @@ def _install_fallbacks(modin_cls: type, pandas_cls: type) -> None:
             return result
 
         def setter(self, value):
-            raise AttributeError(
-                f"Setting `{name}` is not supported by modin_tpu; "
-                "operate on a pandas object via df.modin.to_pandas() instead"
-            )
+            # materialize, delegate the assignment to pandas (so non-settable
+            # properties raise pandas' own error), and resync in place
+            pandas_obj = self._to_pandas()
+            setattr(pandas_obj, name, value)
+            self._update_inplace_from_pandas(pandas_obj)
 
         return property(getter, setter)
+
+    def make_classmethod(name: str):
+        def cm(cls, *args: Any, **kwargs: Any):
+            result = getattr(pandas_cls, name)(*args, **kwargs)
+            if isinstance(result, (pandas.DataFrame, pandas.Series)):
+                return cls(result)
+            return result
+
+        cm.__name__ = name
+        return classmethod(cm)
 
     defined = set()
     for klass in modin_cls.__mro__:
@@ -1310,7 +1356,10 @@ def _install_fallbacks(modin_cls: type, pandas_cls: type) -> None:
             attr = getattr(pandas_cls, name)
         except Exception:
             continue
-        if isinstance(attr, property):
+        raw = inspect.getattr_static(pandas_cls, name)
+        if isinstance(raw, (classmethod, staticmethod)):
+            setattr(modin_cls, name, make_classmethod(name))
+        elif isinstance(attr, property):
             setattr(modin_cls, name, make_property(name))
         elif isinstance(attr, functools.cached_property):
             setattr(modin_cls, name, make_property(name))

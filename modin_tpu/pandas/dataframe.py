@@ -654,9 +654,28 @@ class DataFrame(BasePandasDataset):
         )
 
     def corrwith(self, other: Any, axis: Any = 0, drop: bool = False, method: Any = "pearson", numeric_only: bool = False):
-        return self._default_to_pandas(
-            "corrwith", try_cast_to_pandas(other), axis=axis, drop=drop,
-            method=method, numeric_only=numeric_only,
+        return self._reduce_dimension(
+            self._query_compiler.corrwith(
+                other._query_compiler if isinstance(other, BasePandasDataset) else other,
+                axis=axis, drop=drop, method=method, numeric_only=numeric_only,
+            )
+        )
+
+    def equals(self, other: Any) -> bool:
+        return self._query_compiler.equals(
+            other._query_compiler if isinstance(other, BasePandasDataset) else other
+        )
+
+    def select_dtypes(self, include: Any = None, exclude: Any = None) -> "DataFrame":
+        # metadata-only: pandas resolves the include/exclude rules against an
+        # EMPTY shell with our dtypes, then we slice columns positionally —
+        # no device data moves
+        shell = pandas.DataFrame(
+            {i: pandas.Series(dtype=dt) for i, dt in enumerate(self.dtypes)}
+        )
+        keep = list(shell.select_dtypes(include=include, exclude=exclude).columns)
+        return DataFrame(
+            query_compiler=self._query_compiler.take_2d_positional(columns=keep)
         )
 
     def dot(self, other: Any):
@@ -736,11 +755,15 @@ class DataFrame(BasePandasDataset):
         observed: Any = True,
         sort: bool = True,
     ) -> "DataFrame":
-        return self._default_to_pandas(
-            "pivot_table",
-            values=values, index=index, columns=columns, aggfunc=aggfunc,
-            fill_value=fill_value, margins=margins, dropna=dropna,
-            margins_name=margins_name, observed=observed, sort=sort,
+        return DataFrame(
+            query_compiler=self._query_compiler.pivot_table(
+                values=try_cast_to_pandas(values, squeeze=True),
+                index=try_cast_to_pandas(index, squeeze=True),
+                columns=try_cast_to_pandas(columns, squeeze=True),
+                aggfunc=try_cast_to_pandas(aggfunc),
+                fill_value=fill_value, margins=margins, dropna=dropna,
+                margins_name=margins_name, observed=observed, sort=sort,
+            )
         )
 
     def melt(

@@ -551,8 +551,28 @@ class Series(BasePandasDataset):
         )
 
     def equals(self, other: Any) -> bool:
-        other_pandas = try_cast_to_pandas(other, squeeze=True)
-        return self._to_pandas().equals(other_pandas)
+        return self._query_compiler.equals(
+            other._query_compiler if isinstance(other, Series) else other
+        )
+
+    def pop(self, item: Any):
+        result = self[item]
+        self.drop(labels=[item], inplace=True)
+        return result
+
+    def divmod(self, other: Any, level: Any = None, fill_value: Any = None, axis: Any = 0):
+        div, mod = self._query_compiler.divmod(
+            try_cast_to_pandas(other, squeeze=True),
+            level=level, fill_value=fill_value, axis=axis,
+        )
+        return self.__constructor__(div), self.__constructor__(mod)
+
+    def rdivmod(self, other: Any, level: Any = None, fill_value: Any = None, axis: Any = 0):
+        div, mod = self._query_compiler.rdivmod(
+            try_cast_to_pandas(other, squeeze=True),
+            level=level, fill_value=fill_value, axis=axis,
+        )
+        return self.__constructor__(div), self.__constructor__(mod)
 
     # ------------------------------------------------------------------ #
     # Accessors
@@ -577,6 +597,18 @@ class Series(BasePandasDataset):
         return CategoryMethods(self)
 
     @property
+    def list(self):
+        from modin_tpu.pandas.series_utils import ListAccessor
+
+        return ListAccessor(self)
+
+    @property
+    def struct(self):
+        from modin_tpu.pandas.series_utils import StructAccessor
+
+        return StructAccessor(self)
+
+    @property
     def plot(self):
         return self._to_pandas().plot
 
@@ -594,10 +626,10 @@ class Series(BasePandasDataset):
         return self._default_to_pandas("to_csv", path_or_buf, **kwargs)
 
     def __divmod__(self, other: Any):
-        return self._default_to_pandas("__divmod__", try_cast_to_pandas(other))
+        return self.divmod(other)
 
     def __rdivmod__(self, other: Any):
-        return self._default_to_pandas("__rdivmod__", try_cast_to_pandas(other))
+        return self.rdivmod(other)
 
     def __matmul__(self, other: Any):
         return self.dot(other)

@@ -142,3 +142,43 @@ for _name in [
     "as_ordered", "as_unordered",
 ]:
     setattr(CategoryMethods, _name, _make_accessor_method(_name))
+
+DatetimeProperties.as_unit = _make_accessor_method("as_unit")
+
+
+class ListAccessor(_AccessorBase):
+    """``.list`` accessor for ArrowDtype list columns (ref series_utils.py ListAccessor)."""
+
+    _prefix = "list_"
+
+    def __getitem__(self, key: Any):
+        return self._dispatch("__getitem__", key)
+
+    def flatten(self):
+        return self._dispatch("flatten")
+
+    def len(self):
+        return self._dispatch("len")
+
+
+class StructAccessor(_AccessorBase):
+    """``.struct`` accessor for ArrowDtype struct columns (ref series_utils.py StructAccessor)."""
+
+    _prefix = "struct_"
+
+    @property
+    def dtypes(self):
+        return self._dispatch("dtypes")
+
+    def explode(self):
+        result = self._dispatch("explode")
+        from modin_tpu.pandas.dataframe import DataFrame
+
+        if hasattr(result, "_query_compiler"):
+            qc = result._query_compiler
+            qc._shape_hint = None
+            return DataFrame(query_compiler=qc)
+        return result
+
+    def field(self, name_or_index: Any):
+        return self._dispatch("field", name_or_index)

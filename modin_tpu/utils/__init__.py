@@ -162,9 +162,14 @@ def _apply_doc_module(param) -> None:
         return
     _ACTIVE_DOC_MODULE = mod
     for cls_or_func, parent, excluded, apilink, written in list(_DOC_CALLS):
-        # attrs without a counterpart in the custom module restore/keep their
-        # parent docs (_resolve falls back to parent); the ``written`` filter
-        # means hand-written docstrings are never touched
+        # restore the decoration-time docs first: when switching from custom
+        # module A to B, attrs that A documented but B lacks must fall back to
+        # the pandas parent, not keep A's text
+        _inherit_docstrings_in_place(
+            cls_or_func, parent, excluded, apilink=apilink, only=set(written)
+        )
+        # then overlay the custom module's counterparts; the ``written``
+        # filter means hand-written docstrings are never touched
         _inherit_docstrings_in_place(
             cls_or_func,
             _resolve_doc_counterpart(parent, mod),
