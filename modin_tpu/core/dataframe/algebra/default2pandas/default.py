@@ -159,8 +159,15 @@ class CatDefault(SeriesDefault):
         return df.squeeze(axis=1).cat
 
 
+class _AccessorLookupOnly:
+    """Sentinel DEFAULT_OBJECT_TYPE: forces string funcs through
+    ObjTypeDeterminer so names that collide with pandas.Series methods
+    (``__getitem__``, ``explode``...) resolve on the ACCESSOR object."""
+
+
 class ListDefault(SeriesDefault):
     OBJECT_TYPE = "Series.list"
+    DEFAULT_OBJECT_TYPE = _AccessorLookupOnly
 
     @classmethod
     def frame_wrapper(cls, df: pandas.DataFrame) -> Any:
@@ -169,6 +176,7 @@ class ListDefault(SeriesDefault):
 
 class StructDefault(SeriesDefault):
     OBJECT_TYPE = "Series.struct"
+    DEFAULT_OBJECT_TYPE = _AccessorLookupOnly
 
     @classmethod
     def frame_wrapper(cls, df: pandas.DataFrame) -> Any:

@@ -1,0 +1,3 @@
+from modin_tpu.core.io.excel.xlsx import read_xlsx, write_xlsx
+
+__all__ = ["read_xlsx", "write_xlsx"]

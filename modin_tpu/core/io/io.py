@@ -8,8 +8,11 @@ override the hot formats in engine-specific IO classes.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
+import inspect
+
+import numpy as np
 import pandas
 
 from modin_tpu.core.storage_formats.base.query_compiler import BaseQueryCompiler
@@ -127,3 +130,111 @@ for _name in (
     "to_feather", "to_stata", "to_pickle", "to_sql", "to_orc",
 ):
     setattr(BaseIO, _name, _make_default_writer(_name))
+
+
+# ---- Excel: no engine (openpyxl/xlrd) ships in this environment, so fall
+# back to the in-tree OOXML subset parser (core/io/excel/xlsx.py; the
+# reference instead chunk-feeds openpyxl, excel_dispatcher.py:31) ---------- #
+
+_engine_read_excel = BaseIO.read_excel.__func__
+_engine_to_excel = BaseIO.to_excel.__func__
+_NATIVE_READ_EXCEL_KEYS = {
+    "io", "sheet_name", "header", "names", "skiprows", "nrows", "usecols",
+    "index_col", "dtype", "engine",
+}
+
+
+def _native_read_excel_unsupported(kwargs: dict) -> Optional[str]:
+    """Reason the native parser must decline, or None if the forms are OK."""
+    if kwargs.get("engine") is not None:
+        return f"engine={kwargs['engine']!r} was explicitly requested"
+    sig = inspect.signature(pandas.read_excel)
+    for key, value in kwargs.items():
+        if key in _NATIVE_READ_EXCEL_KEYS:
+            continue
+        param = sig.parameters.get(key)
+        if param is not None and value is not param.default:
+            return f"{key}={value!r}"
+    header = kwargs.get("header", 0)
+    if not (header is None or isinstance(header, (int, np.integer))):
+        return f"header={header!r} (only a single row index)"
+    skiprows = kwargs.get("skiprows")
+    if callable(skiprows):
+        return "callable skiprows"
+    usecols = kwargs.get("usecols")
+    if usecols is not None and not (
+        isinstance(usecols, (list, tuple, range, np.ndarray))
+    ):
+        return f"usecols={usecols!r} (only a list of positions/labels)"
+    index_col = kwargs.get("index_col")
+    if index_col is not None and not isinstance(
+        index_col, (int, np.integer, list, tuple)
+    ):
+        return f"index_col={index_col!r}"
+    return None
+
+
+@classmethod
+def _read_excel_with_native_fallback(cls, **kwargs: Any) -> Any:
+    try:
+        return _engine_read_excel(cls, **kwargs)
+    except ImportError as err:
+        reason = _native_read_excel_unsupported(kwargs)
+        if reason is not None:
+            raise ImportError(
+                "read_excel: no engine installed and the native xlsx "
+                f"parser does not support {reason}"
+            ) from err
+        from modin_tpu.core.io.excel import read_xlsx
+
+        native_kwargs = {
+            k: v for k, v in kwargs.items()
+            if k in _NATIVE_READ_EXCEL_KEYS and k not in ("io", "engine")
+        }
+        result = read_xlsx(kwargs["io"], **native_kwargs)
+        if isinstance(result, dict):
+            return {k: cls._wrap(v) for k, v in result.items()}
+        return cls._wrap(result)
+
+
+@classmethod
+def _to_excel_with_native_fallback(cls, qc: BaseQueryCompiler, **kwargs: Any) -> Any:
+    try:
+        return _engine_to_excel(cls, qc, **kwargs)
+    except ImportError as err:
+        sig = inspect.signature(pandas.DataFrame.to_excel)
+        unsupported = {
+            k: v for k, v in kwargs.items()
+            if k not in ("excel_writer", "sheet_name", "index", "header")
+            and not (
+                k in sig.parameters and v == sig.parameters[k].default
+            )
+            # the native writer never merges cells, so any bool is equivalent
+            and not (k == "merge_cells" and isinstance(v, bool))
+        }
+        if unsupported or not isinstance(kwargs.get("header", True), bool):
+            raise ImportError(
+                f"to_excel: no engine installed and the native xlsx writer "
+                f"does not support {sorted(unsupported)}"
+            ) from err
+        from modin_tpu.core.io.excel import write_xlsx
+
+        df = qc.to_pandas()
+        if qc._shape_hint == "column":
+            # the engine-backed path writes the squeezed Series: the internal
+            # unnamed-column sentinel must not leak into the file
+            series = df.squeeze(axis=1)
+            if series.name == MODIN_UNNAMED_SERIES_LABEL:
+                series = series.rename(None)
+            df = series.to_frame()
+        write_xlsx(
+            df,
+            kwargs["excel_writer"],
+            sheet_name=kwargs.get("sheet_name", "Sheet1"),
+            index=kwargs.get("index", True),
+            header=kwargs.get("header", True),
+        )
+
+
+BaseIO.read_excel = _read_excel_with_native_fallback
+BaseIO.to_excel = _to_excel_with_native_fallback

@@ -1408,11 +1408,12 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
         The reference runs pandas.resample per row block and regroups
         (ResampleDefault here, fold in the reference); on device the bucket
-        id of every row is pure int arithmetic on the (host-side) datetime
-        index, and the aggregation is the same segment kernel groupby uses —
-        empty buckets fall out naturally (sum 0, count 0, mean/min/max NaN).
-        Only tick frequencies (fixed ns width, <= days) with default
-        closed/label/origin bucket like this; everything else falls back.
+        id of every row comes from pandas' own binner over the (host-side)
+        datetime index — every rule family (tick, calendar anchors ME/QE/YE/
+        W/B, closed/label/origin/offset variants) — and the aggregation is
+        the same segment kernel groupby uses; empty buckets fall out
+        naturally (sum 0, count 0, mean/min/max NaN).  Non-monotonic or
+        NaT-bearing indexes fall back.
         """
         from modin_tpu.ops import groupby as gb_ops
         from modin_tpu.ops.structural import pad_len
@@ -1420,8 +1421,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
         rule = resample_kwargs.get("rule")
         defaults = {
-            "closed": None, "label": None, "convention": "start", "on": None,
-            "level": None, "origin": "start_day", "offset": None,
+            "convention": "start", "on": None, "level": None,
             "group_keys": False, "axis": 0,
         }
         for key, default in defaults.items():
@@ -1433,33 +1433,34 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         if extra or not isinstance(ddof, (int, np.integer)):
             return None
-        try:
-            offset = pandas.tseries.frequencies.to_offset(rule)
-        except ValueError:
-            return None
-        if isinstance(offset, pandas.tseries.offsets.Tick):
-            freq_ns = int(offset.nanos)
-        elif isinstance(offset, pandas.tseries.offsets.Day):
-            # Day is calendar-aware in pandas 3 (not a Tick) but fixed 24h
-            # on the tz-naive indexes this path is gated to
-            freq_ns = int(offset.n) * 86_400_000_000_000
-        else:
-            return None  # week/month/... buckets are not fixed-width
         frame = self._modin_frame
         if len(frame) == 0:
             return None
         index = frame.index
-        if not isinstance(index, pandas.DatetimeIndex) or index.tz is not None:
+        if not isinstance(index, pandas.DatetimeIndex):
             return None
         if index.hasnans:
-            return None  # pandas drops NaT rows; int64 bucket math overflows
-        unit_ns = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000}.get(
-            index.unit
-        )
-        if unit_ns is None or freq_ns % unit_ns != 0:
-            # sub-unit bucket edges would round when cast back to the
-            # index's unit (pandas errors on this input)
+            return None  # pandas drops NaT rows before binning
+        if not index.is_monotonic_increasing:
+            # the cumulative-bin trick below requires sorted timestamps
             return None
+        # pandas' own binner (every rule family: Tick, W/ME/QE/YE anchors,
+        # business days; closed/label/origin/offset semantics included) —
+        # bins are cumulative row counts per bucket over the sorted index
+        try:
+            grouper = pandas.Grouper(
+                freq=rule,
+                closed=resample_kwargs.get("closed"),
+                label=resample_kwargs.get("label"),
+                origin=resample_kwargs.get("origin", "start_day"),
+                offset=resample_kwargs.get("offset"),
+            )
+            _binner, bins, bin_labels = grouper._get_time_bins(index)
+        except Exception:
+            return None
+        n_groups = len(bin_labels)
+        if n_groups == 0 or n_groups > (1 << 24):
+            return None  # pathological rule vs span: huge empty range
         value_positions = [
             i for i, c in enumerate(frame._columns)
             if c.is_device and c.pandas_dtype.kind in "biuf"
@@ -1469,17 +1470,13 @@ class TpuQueryCompiler(BaseQueryCompiler):
         ):
             return None
 
-        # ---- bucket codes (pandas Tick semantics, origin='start_day') ---- #
-        ts = index.as_unit("ns").asi8
-        origin = int(pandas.Timestamp(index.min()).normalize().value)
-        first_bucket = origin + ((int(ts.min()) - origin) // freq_ns) * freq_ns
-        codes_host = (ts - first_bucket) // freq_ns
-        n_groups = int(codes_host.max()) + 1
-        if n_groups > (1 << 24):
-            return None  # pathological rule vs span: huge empty range
-        bucket_sizes = np.bincount(codes_host, minlength=n_groups)
+        # ---- bucket codes from the cumulative bins ---- #
+        bucket_sizes = np.diff(np.r_[0, np.asarray(bins, dtype=np.int64)])
+        codes_host = np.repeat(np.arange(n_groups, dtype=np.int64), bucket_sizes)
         has_empty = bool((bucket_sizes == 0).any())
         n = len(frame)
+        if len(codes_host) != n:
+            return None  # rows outside the binner (should not happen)
         codes_padded = np.full(pad_len(n), n_groups, dtype=np.int64)
         codes_padded[:n] = codes_host
         codes = JaxWrapper.put(codes_padded)
@@ -1523,10 +1520,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             labels = frame.columns[value_positions]
             out_dtypes = [np.dtype(d.dtype) for d in datas]
 
-        result_index = pandas.DatetimeIndex(
-            first_bucket + np.arange(n_groups, dtype=np.int64) * freq_ns,
-            freq=offset,
-        ).as_unit(index.unit)  # keep the source index's datetime unit
+        result_index = bin_labels  # pandas' own binner labels: exact parity
         new_cols = [
             DeviceColumn(d, dt, length=n_groups)
             for d, dt in zip(datas, out_dtypes)

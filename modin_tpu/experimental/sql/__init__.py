@@ -1,27 +1,46 @@
-"""SQL-on-dataframe entry point (reference: modin/experimental/sql/).
+"""SQL-on-dataframe entry point (reference analogue: modin/experimental/sql,
+present in earlier reference releases; removed upstream but kept here as a
+working surface).
 
 ``query(sql, **frames)`` evaluates a SQL query against modin_tpu frames.
-Uses duckdb when available; otherwise raises with guidance.
+Engine preference: duckdb when importable (full analytic SQL), else the
+stdlib ``sqlite3`` (zero extra dependencies — pandas speaks DBAPI2 directly),
+so the API works out of the box in this environment.
 """
 
 from typing import Any
 
 
 def query(sql: str, **frames: Any):
-    """Run a SQL query over named modin_tpu DataFrames."""
+    """Run a SQL query over named modin_tpu DataFrames.
+
+    Each keyword argument becomes a table with that name.  Returns a
+    modin_tpu DataFrame.
+    """
     from modin_tpu.utils import try_cast_to_pandas
+
+    import modin_tpu.pandas as pd
 
     try:
         import duckdb
-    except ImportError as err:
-        raise ImportError(
-            "modin_tpu.experimental.sql requires 'duckdb' (not bundled in this "
-            "environment)"
-        ) from err
-    con = duckdb.connect()
-    for name, frame in frames.items():
-        con.register(name, try_cast_to_pandas(frame))
-    result = con.execute(sql).df()
-    import modin_tpu.pandas as pd
+    except ImportError:
+        duckdb = None
 
+    if duckdb is not None:
+        con = duckdb.connect()
+        for name, frame in frames.items():
+            con.register(name, try_cast_to_pandas(frame))
+        return pd.DataFrame(con.execute(sql).df())
+
+    import sqlite3
+
+    import pandas
+
+    con = sqlite3.connect(":memory:")
+    try:
+        for name, frame in frames.items():
+            try_cast_to_pandas(frame).to_sql(name, con, index=False)
+        result = pandas.read_sql_query(sql, con)
+    finally:
+        con.close()
     return pd.DataFrame(result)

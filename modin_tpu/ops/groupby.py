@@ -660,6 +660,157 @@ def _jit_masked_scan_agg(agg: str, n_cols: int, num_segments: int, ddof: int, p_
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=None)
+def _jit_masked_scan_smc(
+    agg: str,
+    n_cols: int,
+    num_segments: int,
+    p_out: int,
+    chunk: int,
+    adaptive: bool,
+    has_sizes: bool,
+):
+    """sum/mean/count masked-scan with a SHARED group-size histogram.
+
+    The main scan accumulates every column's nan-zeroed sum plus ONE sizes
+    histogram (skipped when the factorization by-product arrives as an
+    operand).  Per-column valid counts then come for free on clean data:
+    int columns always equal the shared sizes; float columns probe NaNs with
+    one cheap pass and (``adaptive``, single-shard meshes only — lax.cond
+    over sharded operands is unsafe under SPMD) fall into a dedicated
+    count-scan only when a NaN actually occurred.  Cuts mean from 2 O(n*G)
+    passes per column to 1, and count to a single shared pass.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    G = num_segments
+    n_groups = num_segments - 1
+
+    def finish(r):
+        return _slice_pad(r, n_groups, p_out)
+
+    def fn(cols: Tuple, codes, sizes_in=None):
+        P = codes.shape[0]
+        steps = -(-P // chunk)
+        pad = steps * chunk - P
+        cpad = jnp.concatenate(
+            [codes, jnp.full(pad, n_groups, codes.dtype)]
+        ).reshape(steps, chunk)
+        xpads = tuple(
+            jnp.concatenate([c, jnp.zeros(pad, c.dtype)]).reshape(steps, chunk)
+            for c in cols
+        )
+        gid = jnp.arange(G)
+        is_float = [bool(jnp.issubdtype(c.dtype, jnp.floating)) for c in cols]
+
+        need_sum = agg in ("sum", "mean")
+        # shared histogram wanted whenever some column's count can reuse it
+        need_sizes = agg in ("mean", "count") and (
+            has_sizes or adaptive or not all(is_float)
+        )
+        # per-column inline count accumulators (non-adaptive float columns)
+        inline_count = [
+            agg in ("mean", "count") and f and not adaptive for f in is_float
+        ]
+
+        def body(carry, inp):
+            cc = inp[0]
+            oh = cc[:, None] == gid[None, :]
+            new_carry = []
+            ci = 0
+            for i in range(n_cols):
+                xc = inp[1 + i]
+                nanm = jnp.isnan(xc) if is_float[i] else None
+                if need_sum:
+                    xz = jnp.where(nanm, 0, xc) if is_float[i] else xc
+                    new_carry.append(
+                        carry[ci] + jnp.sum(jnp.where(oh, xz[:, None], 0), axis=0)
+                    )
+                    ci += 1
+                if inline_count[i]:
+                    new_carry.append(
+                        carry[ci]
+                        + jnp.sum(
+                            oh & (~nanm)[:, None], axis=0, dtype=jnp.int32
+                        )
+                    )
+                    ci += 1
+            if need_sizes and not has_sizes:
+                new_carry.append(
+                    carry[ci] + jnp.sum(oh, axis=0, dtype=jnp.int32)
+                )
+                ci += 1
+            return tuple(new_carry), None
+
+        init = []
+        for i, c in enumerate(cols):
+            if need_sum:
+                init.append(jnp.zeros(G, c.dtype))
+            if inline_count[i]:
+                init.append(jnp.zeros(G, jnp.int64))
+        if need_sizes and not has_sizes:
+            init.append(jnp.zeros(G, jnp.int64))
+        carry, _ = jax.lax.scan(body, tuple(init), (cpad, *xpads))
+
+        ci = 0
+        sums, counts = [], []
+        for i in range(n_cols):
+            if need_sum:
+                sums.append(carry[ci]); ci += 1
+            else:
+                sums.append(None)
+            if inline_count[i]:
+                counts.append(carry[ci]); ci += 1
+            else:
+                counts.append(None)
+        if need_sizes:
+            sizes = sizes_in if has_sizes else carry[ci]
+        else:
+            sizes = None
+
+        def count_scan(xpad_c):
+            def cbody(carry, inp):
+                cc, xi = inp
+                oh = cc[:, None] == gid[None, :]
+                return (
+                    carry
+                    + jnp.sum(
+                        oh & (~jnp.isnan(xi))[:, None], axis=0, dtype=jnp.int32
+                    ),
+                    None,
+                )
+
+            out, _ = jax.lax.scan(cbody, jnp.zeros(G, jnp.int64), (cpad, xpad_c))
+            return out
+
+        out = []
+        for i, c in enumerate(cols):
+            if agg == "sum":
+                out.append(finish(sums[i]))
+                continue
+            # resolve the valid count for mean/count
+            if not is_float[i]:
+                cnt = sizes
+            elif counts[i] is not None:
+                cnt = counts[i]
+            else:
+                has_nan = jnp.any(jnp.isnan(c))
+                cnt = jax.lax.cond(
+                    has_nan,
+                    lambda i=i: count_scan(xpads[i]),
+                    lambda: sizes.astype(jnp.int64),
+                )
+            if agg == "count":
+                out.append(finish(cnt.astype(jnp.int64)))
+            else:  # mean — divide in the sum's dtype so f32 means stay f32
+                s = sums[i]
+                out.append(finish(s / cnt.astype(s.dtype)))
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
 _INT_MAXES = {
     k: np.iinfo(k).max
     for k in ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
@@ -739,11 +890,25 @@ def groupby_reduce(
         # var/std/sem need the two-pass centered form -> segment path
         and agg in ("sum", "count", "mean", "min", "max", "prod", "any", "all")
     )
+    from modin_tpu.parallel.mesh import num_row_shards
+
     if use_masked_scan:
         # TPU scatters serialize badly; the masked scan keeps the work on the VPU
+        if agg in ("sum", "mean", "count"):
+            scan_adaptive = num_row_shards() == 1
+            scan_has_sizes = sizes is not None and agg in ("mean", "count")
+            fn = _jit_masked_scan_smc(
+                agg, len(value_cols), ns, p_out, _SCAN_CHUNK,
+                scan_adaptive, scan_has_sizes,
+            )
+            if scan_has_sizes:
+                sizes_dev = jnp.asarray(
+                    np.append(np.asarray(sizes, np.int64), 1)
+                )
+                return list(fn(tuple(value_cols), codes, sizes_dev))
+            return list(fn(tuple(value_cols), codes))
         fn = _jit_masked_scan_agg(agg, len(value_cols), ns, int(ddof), p_out, _SCAN_CHUNK)
         return list(fn(tuple(value_cols), codes))
-    from modin_tpu.parallel.mesh import num_row_shards
 
     adaptive = num_row_shards() == 1
     has_sizes = (

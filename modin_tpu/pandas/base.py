@@ -1151,7 +1151,23 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
         return self._default_to_pandas("to_hdf", path_or_buf, key=key, **kwargs)
 
     def to_excel(self, excel_writer: Any, *args: Any, **kwargs: Any):
-        return self._default_to_pandas("to_excel", excel_writer, *args, **kwargs)
+        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+            FactoryDispatcher,
+        )
+
+        if args:
+            # re-bind positionals (sheet_name, na_rep, ...) onto names
+            import inspect as _inspect
+
+            sig = _inspect.signature(pandas.DataFrame.to_excel)
+            bound = sig.bind(self, excel_writer, *args, **kwargs)
+            kwargs = {
+                k: v for k, v in bound.arguments.items()
+                if k not in ("self", "excel_writer")
+            }
+        return FactoryDispatcher.to_excel(
+            self._query_compiler, excel_writer=excel_writer, **kwargs
+        )
 
     # ------------------------------------------------------------------ #
     # Pickle support (by value)

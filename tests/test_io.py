@@ -284,3 +284,77 @@ class TestParallelJSONFWF:
             pd.read_fwf(str(path), skiprows=1),
             pandas.read_fwf(path, skiprows=1),
         )
+
+
+class TestNativeExcel:
+    """xlsx IO through the in-tree OOXML parser (no engine installed)."""
+
+    @pytest.fixture
+    def frame(self):
+        return pd.DataFrame(
+            {
+                "i": [1, 2, 3],
+                "f": [1.5, np.nan, 3.25],
+                "s": ["alpha", "beta & <gamma>", "delta"],
+                "b": [True, False, True],
+                "d": pandas.to_datetime(
+                    ["2024-01-02 03:04:05", "2024-06-07 00:00:00", "2025-12-31 23:59:59"]
+                ),
+            }
+        )
+
+    def test_roundtrip(self, frame, tmp_path):
+        p = tmp_path / "t.xlsx"
+        frame.to_excel(p, index=False)
+        back = pd.read_excel(p)._to_pandas()
+        want = frame._to_pandas()
+        assert back["i"].tolist() == want["i"].tolist()
+        np.testing.assert_allclose(back["f"].fillna(-1), want["f"].fillna(-1))
+        assert back["s"].tolist() == want["s"].tolist()
+        assert back["b"].tolist() == want["b"].tolist()
+        assert (back["d"] == want["d"]).all()
+
+    def test_index_and_sheet_name(self, frame, tmp_path):
+        p = tmp_path / "t.xlsx"
+        frame.to_excel(p, sheet_name="Data")
+        back = pd.read_excel(p, sheet_name="Data", index_col=0)
+        assert back.shape == (3, 5)
+        assert pd.read_excel(p, sheet_name=None).keys() == {"Data"}
+
+    def test_header_skiprows_nrows_usecols(self, frame, tmp_path):
+        p = tmp_path / "t.xlsx"
+        frame.to_excel(p, index=False)
+        assert pd.read_excel(p, skiprows=1, header=None, nrows=2).shape == (2, 5)
+        assert list(pd.read_excel(p, usecols=[0, 1]).columns) == ["i", "f"]
+
+    def test_unsupported_kwarg_raises(self, frame, tmp_path):
+        p = tmp_path / "t.xlsx"
+        frame.to_excel(p, index=False)
+        try:
+            import openpyxl  # noqa: F401
+
+            pytest.skip("engine installed; fallback not reachable")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="decimal"):
+            pd.read_excel(p, decimal=",")
+
+    def test_series_to_excel(self, tmp_path):
+        p = tmp_path / "s.xlsx"
+        pd.Series([1, 2], name="x").to_excel(p)
+        assert pd.read_excel(p, index_col=0).shape == (2, 1)
+
+
+def test_experimental_sql_query():
+    from modin_tpu.experimental import sql
+
+    a = pd.DataFrame({"k": [1, 2, 1, 3], "v": [10.0, 20.0, 30.0, 40.0]})
+    b = pd.DataFrame({"k": [1, 2], "lbl": ["x", "y"]})
+    r = sql.query(
+        "SELECT a.k AS k, SUM(a.v) AS s, b.lbl AS lbl "
+        "FROM a JOIN b ON a.k=b.k GROUP BY a.k, b.lbl ORDER BY a.k",
+        a=a, b=b,
+    )._to_pandas()
+    assert r["k"].tolist() == [1, 2]
+    assert r["s"].tolist() == [40.0, 20.0]
+    assert r["lbl"].tolist() == ["x", "y"]

@@ -76,3 +76,62 @@ def test_resample_ohlc_and_agg(frames):
         md.resample("6h").agg({"v": "mean", "q": "sum"}),
         pdf.resample("6h").agg({"v": "mean", "q": "sum"}),
     )
+
+
+@pytest.fixture
+def calendar_frames():
+    idx = pandas.date_range("2023-11-07", periods=400, freq="31h")
+    data = {
+        "v": np.where(_rng.random(400) < 0.1, np.nan, _rng.normal(size=400)),
+        "q": _rng.integers(0, 50, 400),
+    }
+    return create_test_dfs(data, index=idx)
+
+
+@pytest.mark.parametrize("rule", ["ME", "MS", "W", "W-TUE", "QE", "YE", "B", "2W"])
+@pytest.mark.parametrize("agg", ["sum", "mean", "count", "min", "max"])
+def test_resample_calendar_rules_device(calendar_frames, rule, agg):
+    md, pdf = calendar_frames
+    got = assert_no_fallback(lambda: getattr(md.resample(rule), agg)())
+    df_equals(got, getattr(pdf.resample(rule), agg)())
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"closed": "left"},
+        {"label": "left"},
+        {"closed": "left", "label": "left"},
+    ],
+)
+def test_resample_calendar_closed_label_device(calendar_frames, kwargs):
+    md, pdf = calendar_frames
+    got = assert_no_fallback(lambda: md.resample("ME", **kwargs).sum())
+    df_equals(got, pdf.resample("ME", **kwargs).sum())
+
+
+@pytest.mark.parametrize("kwargs", [{"origin": "epoch"}, {"offset": "17min"}])
+def test_resample_tick_origin_offset_device(calendar_frames, kwargs):
+    md, pdf = calendar_frames
+    got = assert_no_fallback(lambda: md.resample("3h", **kwargs).mean())
+    df_equals(got, pdf.resample("3h", **kwargs).mean())
+
+
+def test_resample_tz_aware_device(calendar_frames):
+    md, pdf = calendar_frames
+    md = md.set_index(md.index.tz_localize("US/Pacific") if hasattr(md.index, "tz_localize") else md.index)
+    pdf = pdf.set_index(pdf.index.tz_localize("US/Pacific"))
+    got = md.resample("ME").sum()
+    df_equals(got, pdf.resample("ME").sum())
+
+
+def test_resample_non_monotonic_falls_back(calendar_frames):
+    md, pdf = calendar_frames
+    md, pdf = md.iloc[::-1], pdf.iloc[::-1]
+    # correctness through the fallback (device path must decline)
+    df_equals(md.resample("ME").sum(), pdf.resample("ME").sum())
+
+
+def test_resample_quarter_series_device(calendar_frames):
+    md, pdf = calendar_frames
+    df_equals(md["v"].resample("QE").mean(), pdf["v"].resample("QE").mean())

@@ -130,3 +130,23 @@ def test_series_repeat_explode():
     eval_general(ms, ps, lambda s: s.repeat(2))
     ml, pl_ = create_test_series([[1, 2], [3], []])
     eval_general(ml, pl_, lambda s: s.explode())
+
+
+def test_arrow_list_struct_accessors():
+    pa = pytest.importorskip("pyarrow")
+    s = pd.Series(
+        pandas.Series([[1, 2], [3]], dtype=pandas.ArrowDtype(pa.list_(pa.int64())))
+    )
+    assert s.list.len().tolist() == [2, 1]
+    assert s.list[0].tolist() == [1, 3]
+    assert s.list.flatten().tolist() == [1, 2, 3]
+    st = pd.Series(
+        pandas.Series(
+            [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}],
+            dtype=pandas.ArrowDtype(pa.struct([("a", pa.int64()), ("b", pa.string())])),
+        )
+    )
+    assert st.struct.field("a").tolist() == [1, 2]
+    exploded = st.struct.explode()
+    assert list(exploded.columns) == ["a", "b"]
+    assert exploded.shape == (2, 2)
