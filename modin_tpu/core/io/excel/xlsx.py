@@ -103,10 +103,11 @@ def _workbook_xml(sheet_name: str) -> str:
 
 def _cell_xml(ref: str, value: Any) -> str:
     """One <c> element, or '' for missing values (blank cell)."""
-    if value is None:
-        return ""
-    if isinstance(value, float) and np.isnan(value):
-        return ""
+    try:
+        if value is None or pandas.isna(value):  # None / NaN / NaT / pd.NA
+            return ""
+    except (TypeError, ValueError):  # non-scalar (e.g. a list cell value)
+        pass
     if isinstance(value, (bool, np.bool_)):
         return f'<c r="{ref}" t="b"><v>{int(value)}</v></c>'
     if isinstance(value, (_dt.datetime, np.datetime64, pandas.Timestamp)):
@@ -269,29 +270,40 @@ def _parse_value(cell: ET.Element, strings: List[str], date_styles: set) -> Any:
 
 
 def _read_grid(path_or_buf: Any, sheet_name: Union[int, str]) -> List[list]:
+    if isinstance(path_or_buf, zipfile.ZipFile):
+        return _read_grid_from_zip(path_or_buf, sheet_name)
     with zipfile.ZipFile(path_or_buf) as zf:
-        strings = _shared_strings(zf)
-        date_styles = _date_styles(zf)
-        target = _sheet_target(zf, sheet_name)
-        grid: List[list] = []
-        width = 0
-        with zf.open(target) as fh:
-            for _event, el in ET.iterparse(fh, events=("end",)):
-                if el.tag != f"{_MAIN_NS}row":
-                    continue
-                row_num = int(el.get("r", len(grid) + 1))
-                while len(grid) < row_num - 1:
-                    grid.append([])
-                values: list = []
-                for cell in el.findall(f"{_MAIN_NS}c"):
-                    ref = cell.get("r")
-                    ci = _col_index(ref) if ref else len(values)
-                    while len(values) < ci:
-                        values.append(None)
-                    values.append(_parse_value(cell, strings, date_styles))
-                grid.append(values)
-                width = max(width, len(values))
-                el.clear()
+        return _read_grid_from_zip(zf, sheet_name)
+
+
+def _read_grid_from_zip(zf: zipfile.ZipFile, sheet_name: Union[int, str]) -> List[list]:
+    # memoize the workbook-global tables on the (possibly multi-sheet) handle
+    cache = getattr(zf, "_modin_tpu_xlsx_cache", None)
+    if cache is None:
+        cache = {"strings": _shared_strings(zf), "styles": _date_styles(zf)}
+        zf._modin_tpu_xlsx_cache = cache
+    strings = cache["strings"]
+    date_styles = cache["styles"]
+    target = _sheet_target(zf, sheet_name)
+    grid: List[list] = []
+    width = 0
+    with zf.open(target) as fh:
+        for _event, el in ET.iterparse(fh, events=("end",)):
+            if el.tag != f"{_MAIN_NS}row":
+                continue
+            row_num = int(el.get("r", len(grid) + 1))
+            while len(grid) < row_num - 1:
+                grid.append([])
+            values: list = []
+            for cell in el.findall(f"{_MAIN_NS}c"):
+                ref = cell.get("r")
+                ci = _col_index(ref) if ref else len(values)
+                while len(values) < ci:
+                    values.append(None)
+                values.append(_parse_value(cell, strings, date_styles))
+            grid.append(values)
+            width = max(width, len(values))
+            el.clear()
     for row in grid:
         row.extend([None] * (width - len(row)))
     return grid

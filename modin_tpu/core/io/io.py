@@ -203,12 +203,19 @@ def _to_excel_with_native_fallback(cls, qc: BaseQueryCompiler, **kwargs: Any) ->
         return _engine_to_excel(cls, qc, **kwargs)
     except ImportError as err:
         sig = inspect.signature(pandas.DataFrame.to_excel)
+
+        def is_default(k: Any, v: Any) -> bool:
+            if k not in sig.parameters:
+                return False
+            try:
+                return bool(v == sig.parameters[k].default)
+            except (TypeError, ValueError):  # array-valued kwarg
+                return False
+
         unsupported = {
             k: v for k, v in kwargs.items()
             if k not in ("excel_writer", "sheet_name", "index", "header")
-            and not (
-                k in sig.parameters and v == sig.parameters[k].default
-            )
+            and not is_default(k, v)
             # the native writer never merges cells, so any bool is equivalent
             and not (k == "merge_cells" and isinstance(v, bool))
         }

@@ -280,3 +280,172 @@ class array:
             _query_compiler=self._query_compiler.unary_math(op_name),
             _ndim=self._ndim,
         )
+
+    # ------------------------------------------------------------------ #
+    # Named-method surface (ref arr.py: multiply/divide/... are methods too)
+    # ------------------------------------------------------------------ #
+
+    def multiply(self, other):
+        return self._binary("mul", other)
+
+    def divide(self, other):
+        return self._binary("truediv", other)
+
+    def subtract(self, other):
+        return self._binary("sub", other)
+
+    def power(self, other):
+        return self._binary("pow", other)
+
+    def floor_divide(self, other):
+        return self._binary("floordiv", other)
+
+    def remainder(self, other):
+        return self._binary("mod", other)
+
+    def exp(self):
+        return self._math("exp")
+
+    def sqrt(self):
+        return self._math("sqrt")
+
+    def tanh(self):
+        return self._math("tanh")
+
+    def argmax(self, axis: Optional[int] = None):
+        # array labels ARE positions (RangeIndex), so idxmax is argmax
+        if self._ndim == 2 and axis is None:
+            return int(numpy.argmax(self._to_numpy()))
+        return self._reduce("idxmax", axis, skipna=False)
+
+    def argmin(self, axis: Optional[int] = None):
+        if self._ndim == 2 and axis is None:
+            return int(numpy.argmin(self._to_numpy()))
+        return self._reduce("idxmin", axis, skipna=False)
+
+    def where(self, x: Any = None, y: Any = None):
+        """np.where dispatch target: self is the condition."""
+        if x is None and y is None:
+            return tuple(array(ix) for ix in numpy.where(self._to_numpy()))
+        if x is None or y is None:
+            raise ValueError("either both or neither of x and y should be given")
+        x_arr = x if isinstance(x, array) else None
+        if x_arr is not None and x_arr.shape == self.shape:
+            other = y._query_compiler if isinstance(y, array) else y
+            return array(
+                _query_compiler=x_arr._query_compiler.where(
+                    self._query_compiler, other
+                ),
+                _ndim=self._ndim,
+            )
+        return array(
+            numpy.where(
+                self._to_numpy(),
+                x._to_numpy() if isinstance(x, array) else x,
+                y._to_numpy() if isinstance(y, array) else y,
+            )
+        )
+
+    def append(self, values: Any, axis: Optional[int] = None) -> "array":
+        vals = values if isinstance(values, array) else array(values)
+        if self._ndim == 1 and vals._ndim == 1 and axis in (None, 0):
+            return array(
+                _query_compiler=self._query_compiler.concat(
+                    0, [vals._query_compiler], ignore_index=True
+                ),
+                _ndim=1,
+            )
+        return array(numpy.append(self._to_numpy(), vals._to_numpy(), axis=axis))
+
+    def hstack(self, others: Any, dtype: Any = None) -> "array":
+        arrs = [o if isinstance(o, array) else array(o) for o in others]
+        if self._ndim == 1 and all(a._ndim == 1 for a in arrs):
+            out = array(
+                _query_compiler=self._query_compiler.concat(
+                    0, [a._query_compiler for a in arrs], ignore_index=True
+                ),
+                _ndim=1,
+            )
+        else:
+            out = array(
+                numpy.hstack([self._to_numpy(), *[a._to_numpy() for a in arrs]])
+            )
+        return out.astype(dtype) if dtype is not None else out
+
+    def split(self, indices_or_sections: Any, axis: int = 0) -> list:
+        return [
+            array(part)
+            for part in numpy.split(self._to_numpy(), indices_or_sections, axis=axis)
+        ]
+
+    # ------------------------------------------------------------------ #
+    # numpy protocol hooks
+    # ------------------------------------------------------------------ #
+
+    def __matmul__(self, other):
+        return self.dot(other)
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        data = self._to_numpy().copy()
+        data[key] = value._to_numpy() if isinstance(value, array) else value
+        self._query_compiler = array(data)._query_compiler
+
+    _UFUNC_BINARY = {
+        "add": "add", "subtract": "sub", "multiply": "mul",
+        "true_divide": "truediv", "divide": "truediv",
+        "floor_divide": "floordiv", "remainder": "mod", "power": "pow",
+        "equal": "eq", "not_equal": "ne", "less": "lt", "less_equal": "le",
+        "greater": "gt", "greater_equal": "ge",
+    }
+    _UFUNC_UNARY = {
+        "sqrt", "exp", "log", "log2", "log10", "sin", "cos", "tan",
+        "sinh", "cosh", "tanh", "floor", "ceil",
+    }
+
+    def __array_ufunc__(self, ufunc: Any, method: str, *inputs: Any, **kwargs: Any):
+        """Route numpy ufuncs at device arrays back through the QC fast paths."""
+        name = ufunc.__name__
+        if method == "__call__" and not kwargs:
+            if name in self._UFUNC_BINARY and len(inputs) == 2:
+                left, right = inputs
+                if left is self:
+                    return self._binary(self._UFUNC_BINARY[name], right)
+                # reflected: scalar/ndarray op array
+                flipped = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+                op = self._UFUNC_BINARY[name]
+                if op in ("eq", "ne"):
+                    return self._binary(op, left)
+                if op in flipped:
+                    return self._binary(flipped[op], left)
+                return self._binary(
+                    "r" + op if not op.startswith("r") else op, left
+                )
+            if name in self._UFUNC_UNARY and len(inputs) == 1 and inputs[0] is self:
+                return self._math(name)
+            if name == "negative" and inputs[0] is self:
+                return -self
+            if name == "absolute" and inputs[0] is self:
+                return abs(self)
+        # anything else: materialize, run numpy, wrap
+        np_inputs = [
+            i._to_numpy() if isinstance(i, array) else i for i in inputs
+        ]
+        result = getattr(ufunc, method)(*np_inputs, **kwargs)
+        if isinstance(result, numpy.ndarray) and result.ndim in (1, 2):
+            return array(result)
+        return result
+
+    def __array_function__(self, func: Any, types: Any, args: Any, kwargs: Any):
+        """NEP-18: run the numpy function on materialized operands, wrap back."""
+
+        def conv(obj: Any) -> Any:
+            if isinstance(obj, array):
+                return obj._to_numpy()
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(conv(o) for o in obj)
+            return obj
+
+        result = func(*conv(tuple(args)), **{k: conv(v) for k, v in kwargs.items()})
+        if isinstance(result, numpy.ndarray) and result.ndim in (1, 2):
+            return array(result)
+        return result

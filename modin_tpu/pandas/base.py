@@ -1157,9 +1157,7 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
 
         if args:
             # re-bind positionals (sheet_name, na_rep, ...) onto names
-            import inspect as _inspect
-
-            sig = _inspect.signature(pandas.DataFrame.to_excel)
+            sig = inspect.signature(pandas.DataFrame.to_excel)
             bound = sig.bind(self, excel_writer, *args, **kwargs)
             kwargs = {
                 k: v for k, v in bound.arguments.items()

@@ -489,7 +489,7 @@ class Series(BasePandasDataset):
             return None
         return result
 
-    def _series_reset_index(self, level: Any, names: Any, inplace: bool):
+    def _series_reset_index(self, level: Any, inplace: bool):
         """reset_index(drop=False) — becomes a DataFrame."""
         from modin_tpu.pandas.dataframe import DataFrame
 
@@ -497,7 +497,7 @@ class Series(BasePandasDataset):
             raise TypeError(
                 "Cannot reset_index inplace on a Series to create a DataFrame"
             )
-        pandas_result = self._to_pandas().reset_index(level=level, drop=False, names=names)
+        pandas_result = self._to_pandas().reset_index(level=level, drop=False)
         return self._wrap_pandas(pandas_result)
 
     def reset_index(self, level: Any = None, *, drop: bool = False, name: Any = no_default, inplace: bool = False, allow_duplicates: bool = False):
@@ -514,7 +514,7 @@ class Series(BasePandasDataset):
         obj = self.copy()
         if name is not no_default:
             obj.name = name
-        return obj._series_reset_index(level, None, inplace)
+        return obj._series_reset_index(level, inplace)
 
     def update(self, other: Any) -> None:
         if not isinstance(other, Series):

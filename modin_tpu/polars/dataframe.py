@@ -751,3 +751,1055 @@ class Series:
 
     def __lt__(self, other):
         return self._wrap_op(other, lambda a, b: a < b)
+
+
+# ---------------------------------------------------------------------- #
+# Series surface expansion (ref modin/polars/series.py: 167 methods; the
+# mixin below + the inline class cover the non-namespace surface, each verb
+# delegating to the device-backed modin series)
+# ---------------------------------------------------------------------- #
+
+
+class _SeriesMethods:
+    """Bulk polars Series verbs, attached to ``Series`` below."""
+
+    # -- casts / exports ------------------------------------------------ #
+
+    def to_frame(self, name: Optional[str] = None) -> "DataFrame":
+        md = self._md_series.rename(name) if name else self._md_series
+        return DataFrame._from_md(md.to_frame())
+
+    def to_init_repr(self, n: int = 1000) -> str:
+        vals = self.to_list()[:n]
+        return f"pl.Series({self.name!r}, {vals!r})"
+
+    def alias(self, name: str) -> "Series":
+        return Series(_md=self._md_series.rename(name))
+
+    def rename(self, name: str) -> "Series":
+        return self.alias(name)
+
+    def clear(self, n: int = 0) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        return Series(_md=mpd.Series([None] * n, name=self.name, dtype=self.dtype))
+
+    def clone(self) -> "Series":
+        return Series(_md=self._md_series.copy())
+
+    def rechunk(self, in_place: bool = False) -> "Series":
+        return self
+
+    def set_sorted(self, *, descending: bool = False) -> "Series":
+        return self
+
+    def to_physical(self) -> "Series":
+        md = self._md_series
+        if str(md.dtype) == "category":
+            return Series(_md=md.cat.codes)
+        return self
+
+    def shrink_dtype(self) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        s = self._md_series._to_pandas()
+        kind = s.dtype.kind
+        if kind in "iu":
+            s = pandas.to_numeric(s, downcast="integer")
+        elif kind == "f":
+            s = pandas.to_numeric(s, downcast="float")
+        return Series(_md=mpd.Series(s))
+
+    @property
+    def shape(self) -> tuple:
+        return (len(self),)
+
+    def len(self) -> int:
+        return len(self)
+
+    def item(self, index: Optional[int] = None) -> Any:
+        if index is not None:
+            return self.to_list()[index]
+        if len(self) != 1:
+            raise ValueError("can only call .item() if the series is of length 1")
+        return self.to_list()[0]
+
+    def chunk_lengths(self) -> list:
+        return [len(self)]
+
+    def get_chunks(self) -> list:
+        return [self]
+
+    def estimated_size(self, unit: str = "b") -> float:
+        nbytes = float(self._md_series.memory_usage(index=False))
+        scale = {"b": 1, "kb": 1024, "mb": 1024**2, "gb": 1024**3, "tb": 1024**4}
+        return nbytes / scale[unit]
+
+    # -- elementwise math ---------------------------------------------- #
+
+    def _unary_np(self, fn) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        s = self._md_series._to_pandas()
+        return Series(_md=mpd.Series(pandas.Series(fn(s.to_numpy()), index=s.index, name=s.name)))
+
+    def abs(self) -> "Series":
+        return Series(_md=self._md_series.abs())
+
+    def round(self, decimals: int = 0) -> "Series":
+        return Series(_md=self._md_series.round(decimals))
+
+    def round_sig_figs(self, digits: int) -> "Series":
+        def fn(a):
+            with np.errstate(divide="ignore", invalid="ignore"):
+                mags = 10.0 ** (digits - 1 - np.floor(np.log10(np.abs(a))))
+            out = np.round(a * mags) / mags
+            return np.where(a == 0, 0.0, out)
+
+        return self._unary_np(fn)
+
+    def ceil(self) -> "Series":
+        return self._unary_np(np.ceil)
+
+    def floor(self) -> "Series":
+        return self._unary_np(np.floor)
+
+    def sqrt(self) -> "Series":
+        return self._unary_np(np.sqrt)
+
+    def cbrt(self) -> "Series":
+        return self._unary_np(np.cbrt)
+
+    def exp(self) -> "Series":
+        return self._unary_np(np.exp)
+
+    def log(self, base: float = np.e) -> "Series":
+        return self._unary_np(lambda a: np.log(a) / np.log(base))
+
+    def log10(self) -> "Series":
+        return self._unary_np(np.log10)
+
+    def log1p(self) -> "Series":
+        return self._unary_np(np.log1p)
+
+    def sign(self) -> "Series":
+        return self._unary_np(np.sign)
+
+    def sin(self) -> "Series":
+        return self._unary_np(np.sin)
+
+    def cos(self) -> "Series":
+        return self._unary_np(np.cos)
+
+    def tan(self) -> "Series":
+        return self._unary_np(np.tan)
+
+    def cot(self) -> "Series":
+        return self._unary_np(lambda a: 1.0 / np.tan(a))
+
+    def sinh(self) -> "Series":
+        return self._unary_np(np.sinh)
+
+    def cosh(self) -> "Series":
+        return self._unary_np(np.cosh)
+
+    def tanh(self) -> "Series":
+        return self._unary_np(np.tanh)
+
+    def arcsin(self) -> "Series":
+        return self._unary_np(np.arcsin)
+
+    def arccos(self) -> "Series":
+        return self._unary_np(np.arccos)
+
+    def arctan(self) -> "Series":
+        return self._unary_np(np.arctan)
+
+    def arcsinh(self) -> "Series":
+        return self._unary_np(np.arcsinh)
+
+    def arccosh(self) -> "Series":
+        return self._unary_np(np.arccosh)
+
+    def arctanh(self) -> "Series":
+        return self._unary_np(np.arctanh)
+
+    def not_(self) -> "Series":
+        return Series(_md=~self._md_series)
+
+    def pow(self, exponent: Any) -> "Series":
+        return self._wrap_op(exponent, lambda a, b: a**b)
+
+    def dot(self, other: Any) -> float:
+        other_md = other._md_series if isinstance(other, Series) else other
+        return float((self._md_series * other_md).sum())
+
+    def clip(self, lower_bound: Any = None, upper_bound: Any = None) -> "Series":
+        return Series(_md=self._md_series.clip(lower_bound, upper_bound))
+
+    # -- null / nan predicates ------------------------------------------ #
+
+    def is_null(self) -> "Series":
+        return Series(_md=self._md_series.isna())
+
+    def is_not_null(self) -> "Series":
+        return Series(_md=self._md_series.notna())
+
+    def is_nan(self) -> "Series":
+        return self._unary_np(lambda a: np.isnan(a.astype(np.float64)))
+
+    def is_not_nan(self) -> "Series":
+        return self._unary_np(lambda a: ~np.isnan(a.astype(np.float64)))
+
+    def is_finite(self) -> "Series":
+        return self._unary_np(lambda a: np.isfinite(a.astype(np.float64)))
+
+    def is_infinite(self) -> "Series":
+        return self._unary_np(lambda a: np.isinf(a.astype(np.float64)))
+
+    def has_nulls(self) -> bool:
+        return bool(self._md_series.isna().any())
+
+    def null_count(self) -> int:
+        return int(self._md_series.isna().sum())
+
+    # -- reductions ----------------------------------------------------- #
+
+    def std(self, ddof: int = 1):
+        return self._md_series.std(ddof=ddof)
+
+    def var(self, ddof: int = 1):
+        return self._md_series.var(ddof=ddof)
+
+    def median(self):
+        return self._md_series.median()
+
+    def product(self):
+        return self._md_series.prod()
+
+    def quantile(self, quantile: float, interpolation: str = "nearest"):
+        return self._md_series.quantile(quantile, interpolation=interpolation)
+
+    def all(self, *, ignore_nulls: bool = True) -> bool:
+        return bool(self._md_series.all())
+
+    def any(self, *, ignore_nulls: bool = True) -> bool:
+        return bool(self._md_series.any())
+
+    def n_unique(self) -> int:
+        return int(self._md_series.nunique(dropna=False))
+
+    def skew(self, bias: bool = True):
+        s = self._md_series._to_pandas()
+        n = s.count()
+        if n < 3:
+            return None
+        m = s - s.mean()
+        g1 = (m**3).mean() / ((m**2).mean() ** 1.5)
+        if bias:
+            return float(g1)
+        return float(g1 * np.sqrt(n * (n - 1)) / (n - 2))
+
+    def kurtosis(self, *, fisher: bool = True, bias: bool = True):
+        s = self._md_series._to_pandas()
+        n = s.count()
+        if n < 2:
+            return None
+        m = s - s.mean()
+        g2 = (m**4).mean() / ((m**2).mean() ** 2)
+        if not bias and n > 3:
+            g2 = ((n - 1) / ((n - 2) * (n - 3))) * ((n + 1) * g2 - 3 * (n - 1)) + 3
+        return float(g2 - 3.0) if fisher else float(g2)
+
+    def entropy(self, base: float = np.e, *, normalize: bool = True):
+        p = self._md_series._to_pandas().to_numpy(dtype=np.float64)
+        if normalize and p.sum() != 0:
+            p = p / p.sum()
+        with np.errstate(divide="ignore", invalid="ignore"):
+            terms = np.where(p > 0, p * (np.log(p) / np.log(base)), 0.0)
+        return float(-terms.sum())
+
+    def nan_max(self):
+        return self._md_series._to_pandas().max(skipna=False)
+
+    def nan_min(self):
+        return self._md_series._to_pandas().min(skipna=False)
+
+    def lower_bound(self):
+        dt = np.dtype(str(self.dtype))
+        return np.iinfo(dt).min if dt.kind in "iu" else -np.inf
+
+    def upper_bound(self):
+        dt = np.dtype(str(self.dtype))
+        return np.iinfo(dt).max if dt.kind in "iu" else np.inf
+
+    # -- positions / order ---------------------------------------------- #
+
+    def arg_max(self) -> int:
+        return int(np.argmax(self.to_numpy()))
+
+    def arg_min(self) -> int:
+        return int(np.argmin(self.to_numpy()))
+
+    def arg_sort(self, *, descending: bool = False) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        order = np.argsort(self.to_numpy(), kind="stable")
+        if descending:
+            order = order[::-1]
+        return Series(_md=mpd.Series(order, name=self.name))
+
+    def arg_true(self) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        return Series(_md=mpd.Series(np.nonzero(self.to_numpy())[0], name=self.name))
+
+    def arg_unique(self) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        s = self._md_series._to_pandas().reset_index(drop=True)
+        return Series(_md=mpd.Series(s.drop_duplicates(keep="first").index.to_numpy(), name=self.name))
+
+    def search_sorted(self, element: Any, side: str = "any") -> Any:
+        np_side = "left" if side in ("any", "left") else "right"
+        result = np.searchsorted(self.to_numpy(), element, side=np_side)
+        if np.ndim(result) == 0:
+            return int(result)
+        import modin_tpu.pandas as mpd
+
+        return Series(_md=mpd.Series(result, name=self.name))
+
+    def is_sorted(self, *, descending: bool = False) -> bool:
+        md = self._md_series
+        return bool(
+            md.is_monotonic_decreasing if descending else md.is_monotonic_increasing
+        )
+
+    def peak_max(self) -> "Series":
+        s = self._md_series
+        return Series(_md=(s > s.shift(1)).fillna(True) & (s > s.shift(-1)).fillna(True))
+
+    def peak_min(self) -> "Series":
+        s = self._md_series
+        return Series(_md=(s < s.shift(1)).fillna(True) & (s < s.shift(-1)).fillna(True))
+
+    # -- selection / reshaping ------------------------------------------ #
+
+    def gather(self, indices: Any) -> "Series":
+        idx = indices.to_list() if isinstance(indices, Series) else list(indices)
+        return Series(_md=self._md_series.take(idx))
+
+    def head(self, n: int = 10) -> "Series":
+        return Series(_md=self._md_series.head(n))
+
+    def tail(self, n: int = 10) -> "Series":
+        return Series(_md=self._md_series.tail(n))
+
+    def limit(self, n: int = 10) -> "Series":
+        return self.head(n)
+
+    def slice(self, offset: int, length: Optional[int] = None) -> "Series":
+        stop = None if length is None else offset + length
+        return Series(_md=self._md_series.iloc[offset:stop])
+
+    def reverse(self) -> "Series":
+        return Series(_md=self._md_series.iloc[::-1])
+
+    def shuffle(self, seed: Optional[int] = None) -> "Series":
+        return Series(_md=self._md_series.sample(frac=1.0, random_state=seed))
+
+    def append(self, other: "Series") -> "Series":
+        import modin_tpu.pandas as mpd
+
+        return Series(
+            _md=mpd.concat([self._md_series, other._md_series], ignore_index=True)
+        )
+
+    def extend_constant(self, value: Any, n: int) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        return self.append(Series(_md=mpd.Series([value] * n)))
+
+    def drop_nans(self) -> "Series":
+        return Series(_md=self._md_series.dropna())
+
+    def drop_nulls(self) -> "Series":
+        return Series(_md=self._md_series.dropna())
+
+    def scatter(self, indices: Any, values: Any) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        # deep copy: _to_pandas may hand out read-only (device-cache) buffers
+        s = self._md_series._to_pandas().reset_index(drop=True).copy(deep=True)
+        idx = indices.to_list() if isinstance(indices, Series) else indices
+        vals = values.to_list() if isinstance(values, Series) else values
+        s.iloc[idx] = vals
+        return Series(_md=mpd.Series(s))
+
+    def set(self, filter: "Series", value: Any) -> "Series":
+        mask = filter._md_series if isinstance(filter, Series) else filter
+        return Series(_md=self._md_series.mask(mask, value))
+
+    def zip_with(self, mask: "Series", other: "Series") -> "Series":
+        return Series(
+            _md=self._md_series.where(mask._md_series, other._md_series, axis=0)
+        )
+
+    def interpolate_by(self, by: "Series") -> "Series":
+        import modin_tpu.pandas as mpd
+
+        s = self._md_series._to_pandas().reset_index(drop=True)
+        x = by.to_numpy()
+        valid = s.notna().to_numpy()
+        out = np.interp(
+            np.asarray(x, dtype=np.float64),
+            np.asarray(x, dtype=np.float64)[valid],
+            s.to_numpy(dtype=np.float64)[valid],
+        )
+        return Series(_md=mpd.Series(out, name=self.name))
+
+    # -- membership / comparisons --------------------------------------- #
+
+    def is_in(self, other: Any) -> "Series":
+        vals = other.to_list() if isinstance(other, Series) else list(other)
+        return Series(_md=self._md_series.isin(vals))
+
+    def is_between(self, lower_bound: Any, upper_bound: Any, closed: str = "both") -> "Series":
+        inclusive = {"both": "both", "left": "left", "right": "right", "none": "neither"}[closed]
+        return Series(_md=self._md_series.between(lower_bound, upper_bound, inclusive=inclusive))
+
+    def is_first_distinct(self) -> "Series":
+        return Series(_md=~self._md_series.duplicated(keep="first"))
+
+    def is_last_distinct(self) -> "Series":
+        return Series(_md=~self._md_series.duplicated(keep="last"))
+
+    def eq(self, other: Any) -> "Series":
+        return self._wrap_op(other, lambda a, b: a == b)
+
+    def ne(self, other: Any) -> "Series":
+        return self._wrap_op(other, lambda a, b: a != b)
+
+    def lt(self, other: Any) -> "Series":
+        return self._wrap_op(other, lambda a, b: a < b)
+
+    def le(self, other: Any) -> "Series":
+        return self._wrap_op(other, lambda a, b: a <= b)
+
+    def gt(self, other: Any) -> "Series":
+        return self._wrap_op(other, lambda a, b: a > b)
+
+    def ge(self, other: Any) -> "Series":
+        return self._wrap_op(other, lambda a, b: a >= b)
+
+    def eq_missing(self, other: Any) -> "Series":
+        both_null = self.is_null() & (
+            other.is_null() if isinstance(other, Series) else pandas.isna(other)
+        )
+        return (self.eq(other) | both_null).fill_null(False)
+
+    def ne_missing(self, other: Any) -> "Series":
+        return self.eq_missing(other).not_()
+
+    def fill_null(self, value: Any = None) -> "Series":
+        return Series(_md=self._md_series.fillna(value))
+
+    def __and__(self, other):
+        return self._wrap_op(other, lambda a, b: a & b)
+
+    def __or__(self, other):
+        return self._wrap_op(other, lambda a, b: a | b)
+
+    def __invert__(self):
+        return self.not_()
+
+    def __ge__(self, other):
+        return self._wrap_op(other, lambda a, b: a >= b)
+
+    def __le__(self, other):
+        return self._wrap_op(other, lambda a, b: a <= b)
+
+    def __eq__(self, other):  # type: ignore[override]
+        return self._wrap_op(other, lambda a, b: a == b)
+
+    def __ne__(self, other):  # type: ignore[override]
+        return self._wrap_op(other, lambda a, b: a != b)
+
+    def __getitem__(self, key: Any):
+        if isinstance(key, slice):
+            return Series(_md=self._md_series.iloc[key])
+        return self._md_series.iloc[key]
+
+    # -- windows / cumulatives ------------------------------------------ #
+
+    def cum_sum(self, *, reverse: bool = False) -> "Series":
+        return self._cumulative("cumsum", reverse)
+
+    def cum_max(self, *, reverse: bool = False) -> "Series":
+        return self._cumulative("cummax", reverse)
+
+    def cum_min(self, *, reverse: bool = False) -> "Series":
+        return self._cumulative("cummin", reverse)
+
+    def cum_prod(self, *, reverse: bool = False) -> "Series":
+        return self._cumulative("cumprod", reverse)
+
+    def cum_count(self, *, reverse: bool = False) -> "Series":
+        counted = self.is_not_null()._md_series.astype("int64")
+        if reverse:
+            return Series(_md=counted.iloc[::-1].cumsum().iloc[::-1])
+        return Series(_md=counted.cumsum())
+
+    def _cumulative(self, op: str, reverse: bool) -> "Series":
+        md = self._md_series
+        if reverse:
+            return Series(_md=getattr(md.iloc[::-1], op)().iloc[::-1])
+        return Series(_md=getattr(md, op)())
+
+    def cumulative_eval(self, expr: Any, *args: Any, **kwargs: Any) -> "Series":
+        raise NotImplementedError("cumulative_eval requires polars expressions")
+
+    def diff(self, n: int = 1, null_behavior: str = "ignore") -> "Series":
+        result = self._md_series.diff(n)
+        if null_behavior == "drop":
+            result = result.dropna()
+        return Series(_md=result)
+
+    def pct_change(self, n: int = 1) -> "Series":
+        return Series(_md=self._md_series.pct_change(n))
+
+    def shift(self, n: int = 1, *, fill_value: Any = None) -> "Series":
+        return Series(_md=self._md_series.shift(n, fill_value=fill_value))
+
+    def rank(self, method: str = "average", *, descending: bool = False) -> "Series":
+        pd_method = {"average": "average", "min": "min", "max": "max", "dense": "dense", "ordinal": "first"}[method]
+        return Series(_md=self._md_series.rank(method=pd_method, ascending=not descending))
+
+    def _rolling(self, op: str, window_size: int, *args: Any, **kwargs: Any) -> "Series":
+        min_samples = kwargs.pop("min_samples", None) or window_size
+        roller = self._md_series.rolling(window_size, min_periods=min_samples)
+        return Series(_md=getattr(roller, op)(*args, **kwargs))
+
+    def rolling_sum(self, window_size: int, **kwargs: Any) -> "Series":
+        return self._rolling("sum", window_size, **kwargs)
+
+    def rolling_mean(self, window_size: int, **kwargs: Any) -> "Series":
+        return self._rolling("mean", window_size, **kwargs)
+
+    def rolling_min(self, window_size: int, **kwargs: Any) -> "Series":
+        return self._rolling("min", window_size, **kwargs)
+
+    def rolling_max(self, window_size: int, **kwargs: Any) -> "Series":
+        return self._rolling("max", window_size, **kwargs)
+
+    def rolling_std(self, window_size: int, ddof: int = 1, **kwargs: Any) -> "Series":
+        return self._rolling("std", window_size, ddof=ddof, **kwargs)
+
+    def rolling_var(self, window_size: int, ddof: int = 1, **kwargs: Any) -> "Series":
+        return self._rolling("var", window_size, ddof=ddof, **kwargs)
+
+    def rolling_median(self, window_size: int, **kwargs: Any) -> "Series":
+        return self._rolling("median", window_size, **kwargs)
+
+    def rolling_skew(self, window_size: int, **kwargs: Any) -> "Series":
+        return self._rolling("skew", window_size, **kwargs)
+
+    def rolling_quantile(self, quantile: float, interpolation: str = "nearest", window_size: int = 2, **kwargs: Any) -> "Series":
+        return self._rolling("quantile", window_size, quantile, interpolation=interpolation, **kwargs)
+
+    def rolling_map(self, function: Any, window_size: int, **kwargs: Any) -> "Series":
+        min_samples = kwargs.pop("min_samples", None) or window_size
+        roller = self._md_series.rolling(window_size, min_periods=min_samples)
+        return Series(_md=roller.apply(function))
+
+    def ewm_mean(self, com: Any = None, span: Any = None, half_life: Any = None, alpha: Any = None, *, adjust: bool = True, min_samples: int = 1, ignore_nulls: bool = False, **kwargs: Any) -> "Series":
+        ewm = self._md_series.ewm(com=com, span=span, halflife=half_life, alpha=alpha, adjust=adjust, min_periods=min_samples, ignore_na=ignore_nulls)
+        return Series(_md=ewm.mean())
+
+    def ewm_std(self, com: Any = None, span: Any = None, half_life: Any = None, alpha: Any = None, *, adjust: bool = True, bias: bool = False, min_samples: int = 1, ignore_nulls: bool = False, **kwargs: Any) -> "Series":
+        ewm = self._md_series.ewm(com=com, span=span, halflife=half_life, alpha=alpha, adjust=adjust, min_periods=min_samples, ignore_na=ignore_nulls)
+        return Series(_md=ewm.std(bias=bias))
+
+    def ewm_var(self, com: Any = None, span: Any = None, half_life: Any = None, alpha: Any = None, *, adjust: bool = True, bias: bool = False, min_samples: int = 1, ignore_nulls: bool = False, **kwargs: Any) -> "Series":
+        ewm = self._md_series.ewm(com=com, span=span, halflife=half_life, alpha=alpha, adjust=adjust, min_periods=min_samples, ignore_na=ignore_nulls)
+        return Series(_md=ewm.var(bias=bias))
+
+    def ewm_mean_by(self, by: Any, *, half_life: Any) -> "Series":
+        raise NotImplementedError("ewm_mean_by requires event-time decay")
+
+    # -- distinct / binning --------------------------------------------- #
+
+    def value_counts(self, *, sort: bool = False, name: str = "count") -> "DataFrame":
+        vc = self._md_series.value_counts(sort=sort, dropna=False)
+        out = vc.reset_index()
+        out.columns = [self.name or "", name]
+        return DataFrame._from_md(out)
+
+    def unique_counts(self) -> "Series":
+        return Series(_md=self._md_series.value_counts(sort=False))
+
+    def mode(self) -> "Series":
+        return Series(_md=self._md_series.mode())
+
+    def rle_id(self) -> "Series":
+        s = self._md_series
+        changed = s.ne(s.shift(1)).fillna(True)
+        return Series(_md=changed.astype("int64").cumsum() - 1)
+
+    def rle(self) -> "DataFrame":
+        import modin_tpu.pandas as mpd
+
+        s = self._md_series._to_pandas().reset_index(drop=True)
+        changed = s.ne(s.shift(1)).fillna(True)
+        run_id = changed.cumsum()
+        lengths = run_id.value_counts(sort=False).sort_index()
+        values = s[changed.to_numpy()]
+        return DataFrame._from_md(
+            mpd.DataFrame({"len": lengths.to_numpy(), "value": values.to_numpy()})
+        )
+
+    def cut(self, breaks: Any, *, labels: Any = None, left_closed: bool = False) -> "Series":
+        result = pandas.cut(
+            self._md_series._to_pandas(), breaks, labels=labels, right=not left_closed
+        )
+        import modin_tpu.pandas as mpd
+
+        return Series(_md=mpd.Series(result.astype(str), name=self.name))
+
+    def qcut(self, quantiles: Any, *, labels: Any = None) -> "Series":
+        result = pandas.qcut(self._md_series._to_pandas(), quantiles, labels=labels)
+        import modin_tpu.pandas as mpd
+
+        return Series(_md=mpd.Series(result.astype(str), name=self.name))
+
+    def hist(self, bins: Any = None, *, bin_count: Optional[int] = None) -> "DataFrame":
+        import modin_tpu.pandas as mpd
+
+        data = self._md_series._to_pandas().dropna().to_numpy(dtype=np.float64)
+        counts, edges = np.histogram(
+            data, bins=bins if bins is not None else (bin_count or 10)
+        )
+        return DataFrame._from_md(
+            mpd.DataFrame({"breakpoint": edges[1:], "count": counts})
+        )
+
+    def describe(self) -> "DataFrame":
+        import modin_tpu.pandas as mpd
+
+        desc = self._md_series._to_pandas().describe()
+        return DataFrame._from_md(
+            mpd.DataFrame({"statistic": desc.index.to_numpy(), "value": desc.to_numpy()})
+        )
+
+    # -- remapping ------------------------------------------------------ #
+
+    def replace(self, old: Any, new: Any = None) -> "Series":
+        mapping = old if isinstance(old, dict) else dict(zip(np.atleast_1d(old), np.atleast_1d(new)))
+        md = self._md_series
+        return Series(_md=md.map(lambda v: mapping.get(v, v)))
+
+    def replace_strict(self, old: Any, new: Any = None, *, default: Any = None) -> "Series":
+        mapping = old if isinstance(old, dict) else dict(zip(np.atleast_1d(old), np.atleast_1d(new)))
+        md = self._md_series
+        return Series(_md=md.map(lambda v: mapping.get(v, default)))
+
+    def map_elements(self, function: Any, return_dtype: Any = None) -> "Series":
+        result = self._md_series.map(function)
+        if return_dtype is not None:
+            result = result.astype(return_dtype)
+        return Series(_md=result)
+
+    def hash(self, seed: int = 0, **kwargs: Any) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        hashed = pandas.util.hash_pandas_object(
+            self._md_series._to_pandas().reset_index(drop=True), index=False
+        )
+        return Series(_md=mpd.Series(hashed.to_numpy(), name=self.name))
+
+    def implode(self) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        return Series(_md=mpd.Series([self.to_list()], name=self.name))
+
+    # -- accessor namespaces -------------------------------------------- #
+
+    @property
+    def str(self) -> "_PolarsStrNamespace":
+        return _PolarsStrNamespace(self)
+
+    @property
+    def dt(self) -> "_PolarsDtNamespace":
+        return _PolarsDtNamespace(self)
+
+    @property
+    def cat(self) -> "_PolarsCatNamespace":
+        return _PolarsCatNamespace(self)
+
+
+for _name, _value in vars(_SeriesMethods).items():
+    if not _name.startswith("_") or _name in ("_unary_np", "_cumulative", "_rolling"):
+        setattr(Series, _name, _value)
+for _dunder in ("__and__", "__or__", "__invert__", "__ge__", "__le__", "__eq__", "__ne__", "__getitem__"):
+    setattr(Series, _dunder, vars(_SeriesMethods)[_dunder])
+Series.__hash__ = None
+
+
+class _PolarsStrNamespace:
+    """polars ``Series.str`` verbs over the pandas str accessor."""
+
+    def __init__(self, series: Series) -> None:
+        self._s = series
+
+    def _map(self, fn) -> Series:
+        return Series(_md=fn(self._s._md_series.str))
+
+    def to_uppercase(self) -> Series:
+        return self._map(lambda s: s.upper())
+
+    def to_lowercase(self) -> Series:
+        return self._map(lambda s: s.lower())
+
+    def to_titlecase(self) -> Series:
+        return self._map(lambda s: s.title())
+
+    def len_chars(self) -> Series:
+        return self._map(lambda s: s.len())
+
+    def contains(self, pattern: str, *, literal: bool = False) -> Series:
+        return self._map(lambda s: s.contains(pattern, regex=not literal))
+
+    def starts_with(self, prefix: str) -> Series:
+        return self._map(lambda s: s.startswith(prefix))
+
+    def ends_with(self, suffix: str) -> Series:
+        return self._map(lambda s: s.endswith(suffix))
+
+    def strip_chars(self, characters: Optional[str] = None) -> Series:
+        return self._map(lambda s: s.strip(characters))
+
+    def replace_all(self, pattern: str, value: str, *, literal: bool = False) -> Series:
+        return self._map(lambda s: s.replace(pattern, value, regex=not literal))
+
+    def slice(self, offset: int, length: Optional[int] = None) -> Series:
+        stop = None if length is None else offset + length
+        return self._map(lambda s: s.slice(offset, stop))
+
+    def split(self, by: str) -> Series:
+        return self._map(lambda s: s.split(by))
+
+    def zfill(self, length: int) -> Series:
+        return self._map(lambda s: s.zfill(length))
+
+
+class _PolarsDtNamespace:
+    """polars ``Series.dt`` verbs over the pandas dt accessor."""
+
+    def __init__(self, series: Series) -> None:
+        self._s = series
+
+    def _prop(self, name: str) -> Series:
+        return Series(_md=getattr(self._s._md_series.dt, name))
+
+    def year(self) -> Series:
+        return self._prop("year")
+
+    def month(self) -> Series:
+        return self._prop("month")
+
+    def day(self) -> Series:
+        return self._prop("day")
+
+    def hour(self) -> Series:
+        return self._prop("hour")
+
+    def minute(self) -> Series:
+        return self._prop("minute")
+
+    def second(self) -> Series:
+        return self._prop("second")
+
+    def ordinal_day(self) -> Series:
+        return self._prop("dayofyear")
+
+    def weekday(self) -> Series:
+        # polars: Monday=1 .. Sunday=7; pandas: Monday=0
+        return Series(_md=self._s._md_series.dt.dayofweek + 1)
+
+    def date(self) -> Series:
+        return self._prop("date")
+
+    def strftime(self, format: str) -> Series:
+        return Series(_md=self._s._md_series.dt.strftime(format))
+
+
+class _PolarsCatNamespace:
+    """polars ``Series.cat`` verbs over the pandas cat accessor."""
+
+    def __init__(self, series: Series) -> None:
+        self._s = series
+
+    def get_categories(self) -> Series:
+        import modin_tpu.pandas as mpd
+
+        return Series(_md=mpd.Series(self._s._md_series.cat.categories.to_numpy()))
+
+
+# ---------------------------------------------------------------------- #
+# GroupBy surface expansion (ref modin/polars/groupby.py: 17 methods)
+# ---------------------------------------------------------------------- #
+
+
+class _GroupByMethods:
+    def median(self) -> DataFrame:
+        return self._simple("median")
+
+    def n_unique(self) -> DataFrame:
+        md = self._df._md
+        result = md.groupby(self._keys, sort=True).nunique()
+        return DataFrame._from_md(result.reset_index())
+
+    def first(self) -> DataFrame:
+        return self._simple("first")
+
+    def last(self) -> DataFrame:
+        return self._simple("last")
+
+    def quantile(self, quantile: float, interpolation: str = "nearest") -> DataFrame:
+        md = self._df._md
+        result = md.groupby(self._keys, sort=True).quantile(
+            quantile, interpolation=interpolation
+        )
+        return DataFrame._from_md(result.reset_index())
+
+    def head(self, n: int = 5) -> DataFrame:
+        md = self._df._md
+        return DataFrame._from_md(
+            md.groupby(self._keys, sort=False).head(n).reset_index(drop=True)
+        )
+
+    def tail(self, n: int = 5) -> DataFrame:
+        md = self._df._md
+        return DataFrame._from_md(
+            md.groupby(self._keys, sort=False).tail(n).reset_index(drop=True)
+        )
+
+    def all(self) -> DataFrame:
+        md = self._df._md
+        value_cols = [c for c in md.columns if c not in self._keys]
+        result = md.groupby(self._keys, sort=True)[value_cols].agg(list)
+        return DataFrame._from_md(result.reset_index())
+
+    def map_groups(self, function: Any) -> DataFrame:
+        md = self._df._md
+        pieces = [
+            function(DataFrame._from_md(part.reset_index(drop=True)))
+            for _key, part in md.groupby(self._keys, sort=True)
+        ]
+        import modin_tpu.pandas as mpd
+
+        return DataFrame._from_md(
+            mpd.concat([p._md for p in pieces], ignore_index=True)
+        )
+
+
+for _name, _value in vars(_GroupByMethods).items():
+    if not _name.startswith("_"):
+        setattr(GroupBy, _name, _value)
+
+
+# ---------------------------------------------------------------------- #
+# DataFrame surface expansion (ref modin/polars/dataframe.py long tail)
+# ---------------------------------------------------------------------- #
+
+
+class _DataFrameMethods:
+    def select_seq(self, *exprs: Any, **named_exprs: Any) -> "DataFrame":
+        return self.select(*exprs, **named_exprs)
+
+    def with_columns_seq(self, *exprs: Any, **named_exprs: Any) -> "DataFrame":
+        return self.with_columns(*exprs, **named_exprs)
+
+    def with_row_index(self, name: str = "index", offset: int = 0) -> "DataFrame":
+        md = self._md.copy()
+        md.insert(0, name, np.arange(offset, offset + len(md), dtype=np.uint32))
+        return DataFrame._from_md(md)
+
+    def melt(self, id_vars: Any = None, value_vars: Any = None, variable_name: Optional[str] = None, value_name: Optional[str] = None) -> "DataFrame":
+        return DataFrame._from_md(
+            self._md.melt(
+                id_vars=id_vars, value_vars=value_vars,
+                var_name=variable_name or "variable",
+                value_name=value_name or "value",
+            )
+        )
+
+    def unpivot(self, on: Any = None, *, index: Any = None, variable_name: Optional[str] = None, value_name: Optional[str] = None) -> "DataFrame":
+        return self.melt(id_vars=index, value_vars=on, variable_name=variable_name, value_name=value_name)
+
+    def approx_n_unique(self) -> "DataFrame":
+        counts = {c: [int(self._md[c].nunique(dropna=False))] for c in self._md.columns}
+        import modin_tpu.pandas as mpd
+
+        return DataFrame._from_md(mpd.DataFrame(counts))
+
+    def collect_schema(self) -> dict:
+        return self.schema
+
+    def glimpse(self, *, return_as_string: bool = False) -> Optional[str]:
+        lines = [f"Rows: {len(self._md)}", f"Columns: {len(self._md.columns)}"]
+        head = self._md.head(10)._to_pandas()
+        for c in head.columns:
+            vals = ", ".join(repr(v) for v in head[c].tolist()[:5])
+            lines.append(f"$ {c} <{head[c].dtype}> {vals}")
+        text = "\n".join(lines)
+        if return_as_string:
+            return text
+        print(text)
+        return None
+
+    def to_init_repr(self, n: int = 1000) -> str:
+        head = self._md.head(n)._to_pandas()
+        cols = ", ".join(
+            f"pl.Series({c!r}, {head[c].tolist()!r})" for c in head.columns
+        )
+        return f"pl.DataFrame([{cols}])"
+
+    def merge_sorted(self, other: "DataFrame", key: str) -> "DataFrame":
+        import modin_tpu.pandas as mpd
+
+        merged = mpd.concat([self._md, other._md], ignore_index=True)
+        return DataFrame._from_md(
+            merged.sort_values(key, kind="stable").reset_index(drop=True)
+        )
+
+    def update(self, other: "DataFrame", on: Any = None, how: str = "left") -> "DataFrame":
+        import modin_tpu.pandas as mpd
+
+        # deep copy: _to_pandas may hand out read-only (device-cache) buffers
+        pdf = self._md._to_pandas().copy(deep=True)
+        opdf = other._md._to_pandas()
+        if on is not None:
+            pdf = pdf.set_index(on)
+            opdf = opdf.set_index(on)
+        pdf.update(opdf)
+        if on is not None:
+            if how == "inner":
+                pdf = pdf.loc[pdf.index.intersection(opdf.index)]
+            elif how == "full":
+                extra = opdf.loc[opdf.index.difference(pdf.index)]
+                pdf = pandas.concat([pdf, extra]).sort_index()
+            pdf = pdf.reset_index()
+        return DataFrame._from_md(mpd.DataFrame(pdf))
+
+    def hash_rows(self, seed: int = 0, **kwargs: Any) -> "Series":
+        import modin_tpu.pandas as mpd
+
+        hashed = pandas.util.hash_pandas_object(
+            self._md._to_pandas().reset_index(drop=True), index=False
+        )
+        return Series(_md=mpd.Series(hashed.to_numpy(), name=""))
+
+    def iter_slices(self, n_rows: int = 10000):
+        for start in range(0, len(self._md), n_rows):
+            yield DataFrame._from_md(
+                self._md.iloc[start:start + n_rows].reset_index(drop=True)
+            )
+
+    def iter_rows(self, *, named: bool = False):
+        return iter(self.rows(named=named))
+
+    def join_asof(self, other: "DataFrame", *, on: Any = None, left_on: Any = None, right_on: Any = None, by: Any = None, strategy: str = "backward", suffix: str = "_right") -> "DataFrame":
+        import modin_tpu.pandas as mpd
+
+        direction = {"backward": "backward", "forward": "forward", "nearest": "nearest"}[strategy]
+        left = self._md._to_pandas()
+        right = other._md._to_pandas()
+        merged = pandas.merge_asof(
+            left, right,
+            on=on, left_on=left_on, right_on=right_on, by=by,
+            direction=direction, suffixes=("", suffix),
+        )
+        return DataFrame._from_md(mpd.DataFrame(merged))
+
+    def sql(self, query: str, *, table_name: str = "self") -> "DataFrame":
+        from modin_tpu.experimental import sql as _sql
+
+        return DataFrame._from_md(_sql.query(query, **{table_name: self._md}))
+
+    def map_rows(self, function: Any) -> "DataFrame":
+        import modin_tpu.pandas as mpd
+
+        rows = [function(r) for r in self.rows()]
+        if rows and isinstance(rows[0], tuple):
+            out = mpd.DataFrame(rows, columns=[f"column_{i}" for i in range(len(rows[0]))])
+        else:
+            out = mpd.DataFrame({"map": rows})
+        return DataFrame._from_md(out)
+
+    def rows_by_key(self, key: Any, *, named: bool = False, unique: bool = False) -> dict:
+        keys = [key] if isinstance(key, str) else list(key)
+        out: dict = {}
+        for row in self.rows(named=True):
+            k = tuple(row[c] for c in keys)
+            k = k[0] if len(keys) == 1 else k
+            val = row if named else tuple(v for c, v in row.items() if c not in keys)
+            if unique:
+                out[k] = val
+            else:
+                out.setdefault(k, []).append(val)
+        return out
+
+    def serialize(self, file: Any = None):
+        import pickle
+
+        payload = pickle.dumps(self._md._to_pandas())
+        if file is None:
+            return payload
+        if hasattr(file, "write"):
+            file.write(payload)
+        else:
+            with open(file, "wb") as fh:
+                fh.write(payload)
+        return None
+
+    @classmethod
+    def deserialize(cls, source: Any) -> "DataFrame":
+        import pickle
+
+        import modin_tpu.pandas as mpd
+
+        if hasattr(source, "read"):
+            payload = source.read()
+        elif isinstance(source, (bytes, bytearray)):
+            payload = bytes(source)
+        else:
+            with open(source, "rb") as fh:
+                payload = fh.read()
+        return DataFrame._from_md(mpd.DataFrame(pickle.loads(payload)))
+
+    def set_sorted(self, column: str, *, descending: bool = False) -> "DataFrame":
+        return self
+
+    def rechunk(self) -> "DataFrame":
+        return self
+
+    def unnest(self, columns: Any) -> "DataFrame":
+        cols = [columns] if isinstance(columns, str) else list(columns)
+        import modin_tpu.pandas as mpd
+
+        pdf = self._md._to_pandas()
+        pieces = []
+        for c in pdf.columns:
+            if c in cols:
+                expanded = pandas.json_normalize(pdf[c])
+                expanded.index = pdf.index
+                pieces.append(expanded)
+            else:
+                pieces.append(pdf[[c]])
+        return DataFrame._from_md(mpd.DataFrame(pandas.concat(pieces, axis=1)))
+
+
+for _name, _value in vars(_DataFrameMethods).items():
+    if not _name.startswith("_"):
+        setattr(DataFrame, _name, _value)

@@ -143,3 +143,56 @@ class TestExpandedSurface:
         np.testing.assert_allclose(
             np.asarray(mnp.float_power(mnp.array([2.0, 3.0]), 2.0)), [4.0, 9.0]
         )
+
+
+class TestArrayMethodSurface:
+    """ref arr.py parity: named methods, ufunc + NEP-18 protocols."""
+
+    def test_named_binary_and_unary(self):
+        a = mnp.array([1.0, 4.0, 9.0])
+        b = mnp.array([1.0, 2.0, 3.0])
+        assert a.multiply(b).tolist() == [1.0, 8.0, 27.0]
+        assert a.subtract(b).tolist() == [0.0, 2.0, 6.0]
+        assert a.divide(b).tolist() == [1.0, 2.0, 3.0]
+        assert a.power(b).tolist() == [1.0, 16.0, 729.0]
+        assert a.floor_divide(b).tolist() == [1.0, 2.0, 3.0]
+        assert a.remainder(b).tolist() == [0.0, 0.0, 0.0]
+        assert a.sqrt().tolist() == [1.0, 2.0, 3.0]
+        np.testing.assert_allclose(a.exp().tolist(), np.exp([1.0, 4.0, 9.0]))
+        np.testing.assert_allclose(a.tanh().tolist(), np.tanh([1.0, 4.0, 9.0]))
+
+    def test_ufunc_protocol(self):
+        a = mnp.array([1.0, 4.0, 9.0])
+        assert np.add(a, 1.0).tolist() == [2.0, 5.0, 10.0]
+        assert np.subtract(10.0, a).tolist() == [9.0, 6.0, 1.0]
+        assert np.less(4.0, a).tolist() == [False, False, True]
+        assert np.sqrt(a).tolist() == [1.0, 2.0, 3.0]
+        assert isinstance(np.add(a, a), mnp.array)
+
+    def test_array_function_protocol(self):
+        a, b = mnp.array([1.0]), mnp.array([2.0])
+        r = np.concatenate([a, b])
+        assert isinstance(r, mnp.array) and r.tolist() == [1.0, 2.0]
+        assert np.stack([a, b]).shape == (2, 1)
+
+    def test_argmax_argmin(self):
+        a = mnp.array([3.0, 1.0, 7.0])
+        assert a.argmax() == 2 and a.argmin() == 1
+        m = mnp.array([[1.0, 9.0], [5.0, 2.0]])
+        assert m.argmax(axis=0).tolist() == [1, 0]
+        assert m.argmax() == 1
+
+    def test_append_hstack_split(self):
+        a = mnp.array([1.0, 2.0])
+        assert a.append(mnp.array([3.0])).tolist() == [1.0, 2.0, 3.0]
+        assert a.hstack([[3.0], [4.0]]).tolist() == [1.0, 2.0, 3.0, 4.0]
+        assert [p.tolist() for p in a.split(2)] == [[1.0], [2.0]]
+
+    def test_where_setitem_matmul(self):
+        cond = mnp.array([True, False, True])
+        a, b = mnp.array([1.0, 4.0, 9.0]), mnp.array([1.0, 2.0, 3.0])
+        assert cond.where(a, b).tolist() == [1.0, 2.0, 9.0]
+        m = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+        assert (m @ m).tolist() == [[7.0, 10.0], [15.0, 22.0]]
+        a[1] = 42.0
+        assert a.tolist() == [1.0, 42.0, 9.0]

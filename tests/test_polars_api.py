@@ -222,3 +222,100 @@ class TestExpandedVerbs:
         )
         assert df.clear().height == 0
         eq(df.corr(), PDF.corr())
+
+
+class TestSeriesSurface:
+    """ref modin/polars/series.py parity: the expanded verb surface."""
+
+    def test_math_and_predicates(self):
+        s = pl.Series("v", [3.0, 1.0, None, 7.0])
+        assert s.null_count() == 1 and s.has_nulls()
+        assert s.n_unique() == 4
+        assert s.fill_null(0.0).to_list() == [3.0, 1.0, 0.0, 7.0]
+        assert s.is_between(1.0, 4.0).to_list() == [True, True, False, False]
+        assert pl.Series("x", [4.0]).sqrt().to_list() == [2.0]
+        assert pl.Series("x", [1, -2]).abs().to_list() == [1, 2]
+        assert pl.Series("x", [1.0, 2.0]).dot(pl.Series("y", [3.0, 4.0])) == 11.0
+
+    def test_order_and_positions(self):
+        s = pl.Series("v", [3.0, 1.0, 7.0])
+        assert s.arg_max() == 2 and s.arg_min() == 1
+        assert s.arg_sort().to_list() == [1, 0, 2]
+        assert s.reverse().to_list() == [7.0, 1.0, 3.0]
+        assert pl.Series("x", [1, 2, 3]).is_sorted()
+        assert pl.Series("b", [False, True, False]).arg_true().to_list() == [1]
+
+    def test_cumulative_and_rolling(self):
+        s = pl.Series("v", [1.0, 2.0, 3.0])
+        assert s.cum_sum().to_list() == [1.0, 3.0, 6.0]
+        assert s.cum_sum(reverse=True).to_list() == [6.0, 5.0, 3.0]
+        assert s.rolling_sum(2).to_list()[1:] == [3.0, 5.0]
+        assert s.diff().to_list()[1:] == [1.0, 1.0]
+
+    def test_runs_and_counts(self):
+        assert pl.Series("x", [1, 1, 2, 2, 2, 1]).rle_id().to_list() == [0, 0, 1, 1, 1, 2]
+        vc = pl.Series("x", [1, 1, 2]).value_counts().to_pandas()
+        assert vc["count"].tolist() == [2, 1]
+        rle = pl.Series("x", [5, 5, 6]).rle().to_pandas()
+        assert rle["len"].tolist() == [2, 1] and rle["value"].tolist() == [5, 6]
+
+    def test_remap_and_set_ops(self):
+        s = pl.Series("x", [1, 2, 3])
+        assert s.replace({1: 10}).to_list() == [10, 2, 3]
+        assert s.replace_strict({1: 10}, default=0).to_list() == [10, 0, 0]
+        assert s.scatter([0], [9]).to_list() == [9, 2, 3]
+        assert s.is_in([2, 3]).to_list() == [False, True, True]
+        mask = pl.Series("m", [True, False, True])
+        other = pl.Series("o", [7, 8, 9])
+        assert s.zip_with(mask, other).to_list() == [1, 8, 3]
+
+    def test_namespaces(self):
+        s = pl.Series("t", ["ab", "CD"])
+        assert s.str.to_uppercase().to_list() == ["AB", "CD"]
+        assert s.str.contains("a").to_list() == [True, False]
+        assert s.str.len_chars().to_list() == [2, 2]
+        d = pl.Series("d", np.array(["2024-01-01", "2024-03-05"], dtype="datetime64[ns]"))
+        assert d.dt.year().to_list() == [2024, 2024]
+        assert d.dt.weekday().to_list() == [1, 2]  # polars: Monday=1
+
+    def test_append_extend_implode(self):
+        s = pl.Series("x", [1, 2])
+        assert s.append(pl.Series("y", [3])).to_list() == [1, 2, 3]
+        assert s.extend_constant(0, 2).to_list() == [1, 2, 0, 0]
+        assert s.implode().to_list() == [[1, 2]]
+
+
+class TestDataFrameSurface:
+    def test_row_index_and_melt(self):
+        df = pl.DataFrame({"k": [1, 1, 2], "v": [1.0, 3.0, 5.0]})
+        assert df.with_row_index().to_pandas().columns.tolist() == ["index", "k", "v"]
+        assert df.melt(id_vars="k").to_pandas().shape == (3, 3)
+
+    def test_groupby_expansion(self):
+        df = pl.DataFrame({"k": [1, 1, 2], "v": [1.0, 3.0, 5.0]})
+        med = df.group_by("k").median().to_pandas()
+        assert med["v"].tolist() == [2.0, 5.0]
+        assert df.group_by("k").n_unique().to_pandas()["v"].tolist() == [2, 1]
+        assert df.group_by("k").all().to_pandas()["v"].tolist() == [[1.0, 3.0], [5.0]]
+
+    def test_join_asof_and_merge_sorted(self):
+        left = pl.DataFrame({"t": [1.0, 2.0, 3.0]})
+        right = pl.DataFrame({"t": [1.5, 2.5], "lbl": ["a", "b"]})
+        asof = left.join_asof(right, on="t").to_pandas()
+        assert asof["lbl"].tolist()[1:] == ["a", "b"]
+        ms = pl.DataFrame({"t": [1, 3]}).merge_sorted(pl.DataFrame({"t": [2]}), "t")
+        assert ms.to_pandas()["t"].tolist() == [1, 2, 3]
+
+    def test_serialize_sql_update_unnest(self):
+        df = pl.DataFrame({"a": [1, 2], "b": [3.0, 4.0]})
+        assert pl.DataFrame.deserialize(df.serialize()).to_pandas().equals(df.to_pandas())
+        assert df.sql("SELECT SUM(a) AS s FROM self").to_pandas()["s"].tolist() == [3]
+        upd = df.update(pl.DataFrame({"b": [np.nan, 9.0]})).to_pandas()
+        assert upd["b"].tolist() == [3.0, 9.0]
+        dfn = pl.DataFrame({"s": [{"x": 1}, {"x": 2}], "z": [0.5, 0.7]})
+        assert dfn.unnest("s").to_pandas().columns.tolist() == ["x", "z"]
+
+    def test_rows_by_key_and_slices(self):
+        df = pl.DataFrame({"k": [1, 1, 2], "v": [1.0, 3.0, 5.0]})
+        assert df.rows_by_key("k") == {1: [(1.0,), (3.0,)], 2: [(5.0,)]}
+        assert [len(c.to_pandas()) for c in df.iter_slices(2)] == [2, 1]
