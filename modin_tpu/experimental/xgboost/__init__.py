@@ -1,58 +1,87 @@
-"""XGBoost integration (reference: modin/experimental/xgboost/, 1,219 LoC).
+"""Distributed gradient-boosted trees over modin_tpu frames.
 
-xgboost is not available in this environment; the API surface is provided and
-raises a clear error on use.  With xgboost installed, DMatrix feeds the
-device-backed columns through the exported raw buffers
-(modin_tpu.distributed.dataframe.pandas.unwrap_partitions).
+Reference component: modin/experimental/xgboost/ (xgboost_ray.py:43, 1,219
+LoC) — Ray actors each train on their partitions and merge gradient
+statistics through rabit allreduce.  This environment has no xgboost
+package, so the TPU build ships its own trainer (``native.py``): the same
+histogram-GBT algorithm expressed as jit-compiled XLA programs, where the
+per-level (node, feature, bin) gradient histogram is one ``segment_sum`` —
+over row-sharded columns that lowers to per-shard partials + a mesh psum,
+the role rabit's allreduce plays in the reference.
+
+When the real ``xgboost`` package is importable it is preferred (exact
+parity with the reference's semantics); otherwise the native trainer runs.
 """
 
-from typing import Any
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from modin_tpu.experimental.xgboost.native import NativeBooster, _train_native
 
 
-def _require_xgboost():
+def _optional_xgboost():
     try:
-        import xgboost  # noqa: F401
+        import xgboost
 
         return xgboost
-    except ImportError as err:
-        raise ImportError(
-            "modin_tpu.experimental.xgboost requires the 'xgboost' package"
-        ) from err
+    except ImportError:
+        return None
 
 
 class DMatrix:
-    """xgboost.DMatrix built from a modin_tpu DataFrame."""
+    """Training matrix built from modin_tpu frames (features + label)."""
 
     def __init__(self, data: Any, label: Any = None, **kwargs: Any):
-        xgb = _require_xgboost()
         from modin_tpu.utils import try_cast_to_pandas
 
-        self._dmatrix = xgb.DMatrix(
-            try_cast_to_pandas(data), label=try_cast_to_pandas(label), **kwargs
+        pdf = try_cast_to_pandas(data)
+        self._index = pdf.index
+        self.feature_names = list(map(str, pdf.columns))
+        self._features = pdf.to_numpy(dtype=np.float64)
+        self._label = (
+            None
+            if label is None
+            else np.asarray(try_cast_to_pandas(label, squeeze=True), dtype=np.float64)
+        )
+        xgb = _optional_xgboost()
+        self._dmatrix = (
+            xgb.DMatrix(pdf, label=self._label, **kwargs) if xgb else None
         )
 
-    def __getattr__(self, item: str) -> Any:
-        return getattr(self._dmatrix, item)
+    def num_row(self) -> int:
+        return self._features.shape[0]
+
+    def num_col(self) -> int:
+        return self._features.shape[1]
+
+    def get_label(self):
+        return self._label
 
 
-def train(params: dict, dtrain: "DMatrix", *args: Any, **kwargs: Any):
-    """xgboost.train over a modin_tpu-backed DMatrix."""
-    xgb = _require_xgboost()
-    inner = dtrain._dmatrix if isinstance(dtrain, DMatrix) else dtrain
-    return xgb.train(params, inner, *args, **kwargs)
+def train(
+    params: dict,
+    dtrain: DMatrix,
+    num_boost_round: int = 10,
+    *,
+    evals: Any = (),
+    evals_result: Optional[Dict[str, Any]] = None,
+    **kwargs: Any,
+):
+    """Train a boosted-tree model; returns a Booster with ``.predict``."""
+    xgb = _optional_xgboost()
+    if xgb is not None and dtrain._dmatrix is not None:
+        return xgb.train(
+            params, dtrain._dmatrix, num_boost_round=num_boost_round, **kwargs
+        )
+    if dtrain._label is None:
+        raise ValueError("train requires a DMatrix built with a label")
+    return _train_native(
+        params, dtrain._features, dtrain._label, num_boost_round,
+        evals_result=evals_result,
+    )
 
 
-class Booster:
-    def __init__(self, *args: Any, **kwargs: Any):
-        xgb = _require_xgboost()
-        self._booster = xgb.Booster(*args, **kwargs)
+Booster = NativeBooster
 
-    def predict(self, data: Any, **kwargs: Any):
-        from modin_tpu.utils import try_cast_to_pandas
-
-        xgb = _require_xgboost()
-        inner = data._dmatrix if isinstance(data, DMatrix) else xgb.DMatrix(try_cast_to_pandas(data))
-        return self._booster.predict(inner, **kwargs)
-
-    def __getattr__(self, item: str) -> Any:
-        return getattr(self._booster, item)
+__all__ = ["DMatrix", "train", "Booster", "NativeBooster"]

@@ -334,7 +334,7 @@ class array:
             other = y._query_compiler if isinstance(y, array) else y
             return array(
                 _query_compiler=x_arr._query_compiler.where(
-                    self._query_compiler, other
+                    self._query_compiler, other, axis=0
                 ),
                 _ndim=self._ndim,
             )

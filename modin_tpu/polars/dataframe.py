@@ -1149,13 +1149,16 @@ class _SeriesMethods:
         import modin_tpu.pandas as mpd
 
         s = self._md_series._to_pandas().reset_index(drop=True)
-        x = by.to_numpy()
-        valid = s.notna().to_numpy()
-        out = np.interp(
-            np.asarray(x, dtype=np.float64),
-            np.asarray(x, dtype=np.float64)[valid],
-            s.to_numpy(dtype=np.float64)[valid],
-        )
+        x = np.asarray(by.to_numpy(), dtype=np.float64)
+        # np.interp requires monotonically increasing sample points: sort by
+        # the by-column, interpolate, then scatter back to the input order
+        order = np.argsort(x, kind="stable")
+        xs = x[order]
+        vals = s.to_numpy(dtype=np.float64)[order]
+        valid = ~np.isnan(vals)
+        out_sorted = np.interp(xs, xs[valid], vals[valid])
+        out = np.empty_like(out_sorted)
+        out[order] = out_sorted
         return Series(_md=mpd.Series(out, name=self.name))
 
     # -- membership / comparisons --------------------------------------- #
@@ -1359,15 +1362,23 @@ class _SeriesMethods:
         )
 
     def cut(self, breaks: Any, *, labels: Any = None, left_closed: bool = False) -> "Series":
+        # polars breaks are INTERIOR split points (implicit +/-inf bounds);
+        # pandas.cut wants the complete edge list
+        edges = [-np.inf, *list(breaks), np.inf]
         result = pandas.cut(
-            self._md_series._to_pandas(), breaks, labels=labels, right=not left_closed
+            self._md_series._to_pandas(), edges, labels=labels, right=not left_closed
         )
         import modin_tpu.pandas as mpd
 
         return Series(_md=mpd.Series(result.astype(str), name=self.name))
 
     def qcut(self, quantiles: Any, *, labels: Any = None) -> "Series":
-        result = pandas.qcut(self._md_series._to_pandas(), quantiles, labels=labels)
+        if isinstance(quantiles, int):
+            q = quantiles
+        else:
+            # polars quantiles are interior probabilities; close the range
+            q = [0.0, *list(quantiles), 1.0]
+        result = pandas.qcut(self._md_series._to_pandas(), q, labels=labels)
         import modin_tpu.pandas as mpd
 
         return Series(_md=mpd.Series(result.astype(str), name=self.name))

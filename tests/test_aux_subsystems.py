@@ -113,6 +113,15 @@ class TestTrackFileLeaks:
         handle.close()
 
 
+@pytest.fixture(autouse=True)
+def _require_device_columns(request):
+    if "TestMemoryBudget" in request.node.nodeid:
+        from modin_tpu.utils import get_current_execution
+
+        if get_current_execution() != "TpuOnJax":
+            pytest.skip("host-cache ledger exists only for device columns")
+
+
 class TestMemoryBudget:
     def test_lru_eviction_under_budget(self):
         from modin_tpu.core.memory import host_cache_bytes, ledger

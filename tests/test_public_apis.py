@@ -112,12 +112,14 @@ class TestExperimental:
         df_equals(out1, (pdf + 1) * 2)
         df_equals(out2, ((pdf + 1) * 2).sum())
 
-    def test_xgboost_raises_cleanly(self):
+    def test_xgboost_native_available(self):
+        # the native trainer works without the xgboost package (see
+        # tests/test_xgboost_native.py for training behavior)
         from modin_tpu.experimental import xgboost as mxgb
 
-        md, _ = create_test_dfs({"a": [1.0]})
-        with pytest.raises(ImportError, match="xgboost"):
-            mxgb.DMatrix(md)
+        md, _ = create_test_dfs({"a": [1.0, 2.0], "y": [0.0, 1.0]})
+        dm = mxgb.DMatrix(md[["a"]], label=md["y"])
+        assert dm.num_row() == 2 and dm.num_col() == 1
 
 
 class TestInterchange:

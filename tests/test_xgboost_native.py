@@ -1,0 +1,96 @@
+"""TPU-native boosted-trees trainer (experimental.xgboost.native)."""
+
+import numpy as np
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.experimental import xgboost as mxgb
+
+
+@pytest.fixture(scope="module")
+def regression_data():
+    rng = np.random.default_rng(0)
+    n = 800
+    X = pd.DataFrame(
+        {"x1": rng.uniform(-3, 3, n), "x2": rng.uniform(-3, 3, n)}
+    )
+    y_true = np.sin(X["x1"].to_numpy()) * 2 + 0.5 * X["x2"].to_numpy() ** 2
+    y = pd.Series(y_true + rng.normal(0, 0.05, n))
+    return X, y, y_true
+
+
+def test_regression_learns(regression_data):
+    X, y, y_true = regression_data
+    dtrain = mxgb.DMatrix(X, label=y)
+    res = {}
+    bst = mxgb.train(
+        {"max_depth": 3, "eta": 0.3}, dtrain, num_boost_round=12, evals_result=res
+    )
+    rmse = res["train"]["rmse"]
+    assert rmse[-1] < rmse[0] * 0.5  # loss halves at minimum
+    assert rmse[-1] < np.std(y_true) * 0.4  # far better than the mean predictor
+    pred = bst.predict(dtrain)
+    assert isinstance(pred, pd.Series) and len(pred) == len(y)
+    assert np.corrcoef(pred.to_numpy(), y_true)[0, 1] > 0.95
+
+
+def test_predict_on_fresh_frame(regression_data):
+    X, y, _ = regression_data
+    dtrain = mxgb.DMatrix(X, label=y)
+    bst = mxgb.train({"max_depth": 3}, dtrain, num_boost_round=6)
+    head = X.head(50)
+    pred = bst.predict(head)
+    assert len(pred) == 50
+    full = bst.predict(dtrain).to_numpy()[:50]
+    np.testing.assert_allclose(pred.to_numpy(), full, rtol=1e-6)
+
+
+def test_binary_logistic():
+    rng = np.random.default_rng(1)
+    n = 800
+    X = pd.DataFrame(
+        {"a": rng.normal(size=n), "b": rng.normal(size=n)}
+    )
+    y = pd.Series((X["a"].to_numpy() + X["b"].to_numpy() > 0).astype(float))
+    dm = mxgb.DMatrix(X, label=y)
+    res = {}
+    bst = mxgb.train(
+        {"max_depth": 3, "eta": 0.4, "objective": "binary:logistic"},
+        dm, num_boost_round=10, evals_result=res,
+    )
+    p = bst.predict(dm).to_numpy()
+    assert ((p >= 0) & (p <= 1)).all()  # probabilities, not margins
+    assert np.mean((p > 0.5) == (y.to_numpy() > 0.5)) > 0.9
+    assert res["train"]["logloss"][-1] < res["train"]["logloss"][0]
+
+
+def test_nan_features_and_param_aliases():
+    rng = np.random.default_rng(2)
+    n = 500
+    x = rng.uniform(-2, 2, n)
+    x[rng.integers(0, n, 60)] = np.nan
+    X = pd.DataFrame({"x": x})
+    y = pd.Series(np.where(np.isnan(x), 3.0, x * 2.0))
+    dm = mxgb.DMatrix(X, label=y)
+    bst = mxgb.train(
+        {"max_depth": 2, "learning_rate": 0.5, "reg_lambda": 0.5},
+        dm, num_boost_round=8,
+    )
+    pred = bst.predict(dm).to_numpy()
+    assert np.corrcoef(pred, y.to_numpy())[0, 1] > 0.9
+
+
+def test_dmatrix_introspection(regression_data):
+    X, y, _ = regression_data
+    dm = mxgb.DMatrix(X, label=y)
+    assert dm.num_row() == len(X._to_pandas())
+    assert dm.num_col() == 2
+    assert dm.feature_names == ["x1", "x2"]
+    assert len(dm.get_label()) == dm.num_row()
+
+
+def test_unsupported_objective_raises(regression_data):
+    X, y, _ = regression_data
+    dm = mxgb.DMatrix(X, label=y)
+    with pytest.raises(ValueError, match="objective"):
+        mxgb.train({"objective": "multi:softmax"}, dm, num_boost_round=2)
