@@ -96,7 +96,7 @@ def _workbook_xml(sheet_name: str) -> str:
         '<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
         '<workbook xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main" '
         'xmlns:r="http://schemas.openxmlformats.org/officeDocument/2006/relationships">'
-        f'<sheets><sheet name="{escape(str(sheet_name))}" sheetId="1" r:id="rId1"/></sheets>'
+        f'<sheets><sheet name="{escape(str(sheet_name), {chr(34): "&quot;"})}" sheetId="1" r:id="rId1"/></sheets>'
         "</workbook>"
     )
 
@@ -212,9 +212,20 @@ def _date_styles(zf: zipfile.ZipFile) -> set:
     return date_styles
 
 
+def _required_member(zf: zipfile.ZipFile, name: str) -> bytes:
+    """Read a member every OOXML workbook must have; a zip without it is not
+    an xlsx file, which callers report as BadZipFile (not a bare KeyError)."""
+    try:
+        return zf.read(name)
+    except KeyError as err:
+        raise zipfile.BadZipFile(
+            f"not an OOXML workbook: missing archive member {name!r}"
+        ) from err
+
+
 def _sheet_target(zf: zipfile.ZipFile, sheet_name: Union[int, str]) -> str:
-    wb = ET.fromstring(zf.read("xl/workbook.xml"))
-    rels = ET.fromstring(zf.read("xl/_rels/workbook.xml.rels"))
+    wb = ET.fromstring(_required_member(zf, "xl/workbook.xml"))
+    rels = ET.fromstring(_required_member(zf, "xl/_rels/workbook.xml.rels"))
     rid_ns = "{http://schemas.openxmlformats.org/officeDocument/2006/relationships}id"
     targets = {
         rel.get("Id"): rel.get("Target") for rel in rels.iter(f"{_REL_NS}Relationship")
@@ -238,7 +249,7 @@ def _sheet_target(zf: zipfile.ZipFile, sheet_name: Union[int, str]) -> str:
 
 def sheet_names(path_or_buf: Any) -> List[str]:
     with zipfile.ZipFile(path_or_buf) as zf:
-        wb = ET.fromstring(zf.read("xl/workbook.xml"))
+        wb = ET.fromstring(_required_member(zf, "xl/workbook.xml"))
         return [s.get("name") for s in wb.iter(f"{_MAIN_NS}sheet")]
 
 

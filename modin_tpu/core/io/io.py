@@ -174,10 +174,34 @@ def _native_read_excel_unsupported(kwargs: dict) -> Optional[str]:
     return None
 
 
+def _no_excel_engine_installed() -> bool:
+    for mod in ("openpyxl", "xlrd", "calamine", "pyxlsb"):
+        try:
+            __import__(mod)
+            return False
+        except ImportError:
+            continue
+    return True
+
+
 @classmethod
 def _read_excel_with_native_fallback(cls, **kwargs: Any) -> Any:
+    import zipfile as _zipfile
+
     try:
         return _engine_read_excel(cls, **kwargs)
+    except _zipfile.BadZipFile as err:
+        # pandas' format sniffing opens the zip itself; with no engine
+        # installed, surface a clear error naming the engine-free constraint.
+        # With an engine present this is a genuine corrupt-file error — keep
+        # the pandas-parity exception type.
+        if not _no_excel_engine_installed():
+            raise
+        raise ImportError(
+            "read_excel: no engine installed (openpyxl/xlrd) and the "
+            "native parser only supports OOXML .xlsx files; "
+            f"{kwargs.get('io')!r} is not a readable .xlsx workbook"
+        ) from err
     except ImportError as err:
         reason = _native_read_excel_unsupported(kwargs)
         if reason is not None:
@@ -191,7 +215,14 @@ def _read_excel_with_native_fallback(cls, **kwargs: Any) -> Any:
             k: v for k, v in kwargs.items()
             if k in _NATIVE_READ_EXCEL_KEYS and k not in ("io", "engine")
         }
-        result = read_xlsx(kwargs["io"], **native_kwargs)
+        try:
+            result = read_xlsx(kwargs["io"], **native_kwargs)
+        except _zipfile.BadZipFile as native_err:
+            raise ImportError(
+                "read_excel: no engine installed (openpyxl/xlrd) and the "
+                "native parser only supports OOXML .xlsx files; "
+                f"{kwargs['io']!r} is not a readable .xlsx workbook"
+            ) from native_err
         if isinstance(result, dict):
             return {k: cls._wrap(v) for k, v in result.items()}
         return cls._wrap(result)

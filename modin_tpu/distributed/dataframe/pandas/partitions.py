@@ -20,19 +20,35 @@ def unwrap_partitions(api_layer_object: Any, axis: Optional[int] = None, get_ip:
 
     For the Tpu backend returns ``[(label, jax.Array | host_array), ...]`` —
     the live (possibly sharded) device columns, zero-copy.  For host backends
-    returns the column arrays.
+    returns the column arrays.  With ``get_ip=True`` each element becomes
+    ``(location, (label, data))`` — the reference's ``(ip, partition)`` shape
+    (partitions.py:58), where the locality token is the set of devices the
+    buffer lives on ("host" for host columns).
     """
     qc = api_layer_object._query_compiler
     frame = getattr(qc, "_modin_frame", None)
     result = []
     if frame is not None and hasattr(frame, "_columns"):
         for label, col in zip(frame.columns, frame._columns):
-            if col.is_device:
-                result.append((label, col.data))
+            data = col.data
+            if get_ip:
+                if col.is_device:
+                    devices = sorted(
+                        str(d) for d in getattr(data.sharding, "device_set", ())
+                    )
+                    location = ",".join(devices) or "host"
+                else:
+                    location = "host"
+                result.append((location, (label, data)))
             else:
-                result.append((label, col.data))
+                result.append((label, data))
         return result
     pandas_df = qc.to_pandas()
+    if get_ip:
+        return [
+            ("host", (label, pandas_df[label].to_numpy()))
+            for label in pandas_df.columns
+        ]
     return [(label, pandas_df[label].to_numpy()) for label in pandas_df.columns]
 
 
@@ -66,10 +82,16 @@ def from_partitions(
     except ImportError:  # pragma: no cover
         jax_array_type = ()
 
-    pairs = [
-        item if isinstance(item, tuple) and len(item) == 2 else (i, item)
-        for i, item in enumerate(partitions)
-    ]
+    def _normalize(i, item):
+        if isinstance(item, tuple) and len(item) == 2:
+            # (location, (label, data)) from unwrap_partitions(get_ip=True):
+            # drop the locality token and keep the labelled buffer
+            if isinstance(item[1], tuple) and len(item[1]) == 2:
+                return item[1]
+            return item
+        return (i, item)
+
+    pairs = [_normalize(i, item) for i, item in enumerate(partitions)]
     # the logical length: the index wins; otherwise the first host buffer;
     # otherwise a raw device buffer is taken as exactly-logical
     if index is not None:

@@ -72,13 +72,22 @@ def train(
     xgb = _optional_xgboost()
     if xgb is not None and dtrain._dmatrix is not None:
         return xgb.train(
-            params, dtrain._dmatrix, num_boost_round=num_boost_round, **kwargs
+            params,
+            dtrain._dmatrix,
+            num_boost_round=num_boost_round,
+            evals=[(dm._dmatrix, name) for dm, name in evals],
+            evals_result=evals_result,
+            **kwargs,
         )
     if dtrain._label is None:
         raise ValueError("train requires a DMatrix built with a label")
+    for dm, _name in evals:
+        if dm._label is None:
+            raise ValueError("every eval DMatrix must be built with a label")
     return _train_native(
         params, dtrain._features, dtrain._label, num_boost_round,
         evals_result=evals_result,
+        evals=[(dm._features, dm._label, name) for dm, name in evals],
     )
 
 

@@ -252,6 +252,7 @@ def _train_native(
     label: np.ndarray,
     num_boost_round: int,
     evals_result: Optional[Dict[str, Any]] = None,
+    evals: Any = (),
 ) -> NativeBooster:
     import jax
     import jax.numpy as jnp
@@ -273,6 +274,26 @@ def _train_native(
     pred = jnp.full(n, base_margin, dtype=jnp.float32)
     trees: List[_Tree] = []
     history: List[float] = []
+    # eval sets: pre-bin with the training quantile edges, keep a running
+    # margin per set so each round's metric is one tree-predict + loss
+    eval_sets = []
+    for feats_e, label_e, name in evals:
+        feats_e = np.asarray(feats_e, dtype=np.float64)
+        if feats_e.shape[1] != features.shape[1]:
+            raise ValueError(
+                f"eval set {name!r} has {feats_e.shape[1]} features, "
+                f"training data has {features.shape[1]}"
+            )
+        bins_e = NativeBooster._bin_features(feats_e, edges, max_bin)
+        eval_sets.append(
+            {
+                "name": str(name),
+                "bins": bins_e,
+                "y": jnp.asarray(label_e, dtype=jnp.float32),
+                "pred": jnp.full(bins_e.shape[0], base_margin, dtype=jnp.float32),
+                "history": [],
+            }
+        )
 
     grad_fn = jax.jit(
         (lambda pr, yy: (jax.nn.sigmoid(pr) - yy, jax.nn.sigmoid(pr) * (1 - jax.nn.sigmoid(pr))))
@@ -344,8 +365,16 @@ def _train_native(
             jnp.float32(0.0),
         )
         history.append(float(loss_fn(pred, y)))
+        for ev in eval_sets:
+            ev["pred"] = ev["pred"] + _jit_predict_tree(max_depth)(
+                ev["bins"], tree.feature, tree.threshold, tree.is_split,
+                tree.leaf_value, jnp.float32(0.0),
+            )
+            ev["history"].append(float(loss_fn(ev["pred"], ev["y"])))
 
     if evals_result is not None:
         metric = "logloss" if logistic else "rmse"
         evals_result.setdefault("train", {})[metric] = history
+        for ev in eval_sets:
+            evals_result.setdefault(ev["name"], {})[metric] = ev["history"]
     return NativeBooster(p, edges, trees, base_margin)

@@ -135,3 +135,18 @@ def test_resample_non_monotonic_falls_back(calendar_frames):
 def test_resample_quarter_series_device(calendar_frames):
     md, pdf = calendar_frames
     df_equals(md["v"].resample("QE").mean(), pdf["v"].resample("QE").mean())
+
+
+def test_pandas_grouper_time_bins_api_pin():
+    """Pin the private pandas API the device resample path depends on.
+
+    query_compiler.py's device resample calls ``Grouper._get_time_bins``
+    (guarded by a broad fallback); if a pandas upgrade removes or reshapes
+    it, this test fails loudly instead of silently degrading every rule to
+    the host path.
+    """
+    idx = pandas.date_range("2024-01-01", periods=10, freq="h")
+    grouper = pandas.Grouper(freq="2h")
+    binner, bins, labels = grouper._get_time_bins(idx)
+    assert isinstance(labels, pandas.DatetimeIndex)
+    assert list(np.asarray(bins, dtype=np.int64)) == [2, 4, 6, 8, 10]
