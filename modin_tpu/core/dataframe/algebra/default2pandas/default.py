@@ -98,15 +98,21 @@ class DefaultMethod:
     @classmethod
     def build_output(cls, query_compiler: Any, result: Any) -> Any:
         """Wrap a pandas result back into a query compiler when 2-D/1-D."""
-        if isinstance(result, pandas.Series):
+        was_series = isinstance(result, pandas.Series)
+        if was_series:
             name = result.name if result.name is not None else MODIN_UNNAMED_SERIES_LABEL
             result = result.to_frame(name)
         if isinstance(result, pandas.DataFrame):
-            return query_compiler.__constructor__.from_pandas(
+            out = query_compiler.__constructor__.from_pandas(
                 result, type(query_compiler._modin_frame)
                 if hasattr(query_compiler, "_modin_frame")
                 else None
             )
+            if was_series:
+                # consumers (API fallback routing) wrap hint=="column" results
+                # back as Series
+                out._shape_hint = "column"
+            return out
         return result
 
 

@@ -310,11 +310,23 @@ class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
             col._data = value
 
     def finalize(self) -> None:
-        """Block until device work for this frame completes (one sync)."""
+        """Block until device work for this frame completes (one sync).
+
+        Columns with a ``host_cache`` are skipped: their values are already
+        known on the host (the device buffer is a pending *upload*, not
+        pending compute), so there is nothing observable to wait for — any
+        downstream device op consuming the buffer orders after the transfer
+        on-device.  Blocking on them costs a full tunnel round-trip per call
+        on remote TPU for no information.
+        """
         from modin_tpu.parallel.engine import JaxWrapper
 
         self.materialize_device()
-        device_data = [col.data for col in self._columns if col.is_device]
+        device_data = [
+            col.data
+            for col in self._columns
+            if col.is_device and col.host_cache is None
+        ]
         if device_data:
             JaxWrapper.wait(device_data)
 

@@ -1,9 +1,20 @@
-"""Parquet reader: pyarrow row-group parallel read -> device columns.
+"""Parquet IO: row-group-parallel read + chunk-streamed parallel-safe write.
 
 Reference design: /root/reference/modin/core/io/column_stores/
-parquet_dispatcher.py:298 (row-group balanced splitting at :350, dataset
-abstraction at :42).  pyarrow's native reader is already multi-threaded C++;
-the TPU-side work is the column assembly + device upload.
+parquet_dispatcher.py:298 — ``_determine_partitioning`` (:350) balances row
+groups across partitions, ``call_deploy`` (:424) reads each split in a
+worker, ``write`` (:912) writes per-partition.  The TPU translation:
+
+- read: contiguous row-group ranges balanced by *row count* across a thread
+  pool (pyarrow's decoder releases the GIL); the per-range Arrow tables
+  concatenate zero-copy and convert to pandas ONCE (a single conversion keeps
+  pandas-metadata index reconstruction — RangeIndex descriptors included —
+  exactly equal to the serial reader's), then columns upload to device
+  sharded in ``from_pandas``.
+- write: the frame streams through ``pyarrow.ParquetWriter`` in bounded row
+  windows, so a sharded device frame is fetched chunk-by-chunk instead of one
+  full-frame gather (the reference's per-partition write, expressed over a
+  columnar store).
 """
 
 from __future__ import annotations
@@ -14,13 +25,16 @@ import pandas
 
 from modin_tpu.core.io.file_dispatcher import FileDispatcher
 
+# target rows per write window (bounds host memory during device fetch)
+_WRITE_CHUNK_ROWS = 4 << 20
+
 
 class ParquetDispatcher(FileDispatcher):
     @classmethod
     def _read(cls, path: Any = None, engine: str = "auto", columns: Optional[List] = None, **kwargs: Any):
         filters = kwargs.get("filters")
         try:
-            import pyarrow.parquet as pq
+            import pyarrow.parquet as pq  # noqa: F401
         except ImportError:
             df = pandas.read_parquet(path, engine=engine, columns=columns, **kwargs)
             return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
@@ -30,17 +44,18 @@ class ParquetDispatcher(FileDispatcher):
             if k != "filters" and v not in (None, False)
             and not (k == "dtype_backend" and v is pandas.api.extensions.no_default)
         }
-        if not isinstance(path, (str,)) or extra:
+        if (
+            not isinstance(path, (str,))
+            or extra
+            or not cls.is_local_plain_file(cls.get_path(path))
+        ):
             # kwargs the arrow fast path can't honor (dtype_backend,
             # filesystem, storage_options, ...) take the pandas reader
             df = pandas.read_parquet(path, engine=engine, columns=columns, **kwargs)
             return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
         try:
-            table = pq.read_table(
-                cls.get_path(path),
-                columns=columns,
-                use_threads=True,
-                filters=filters,
+            table = cls._read_table_row_group_parallel(
+                cls.get_path(path), columns, filters
             )
             df = table.to_pandas(split_blocks=True, self_destruct=True)
         except Exception:
@@ -48,8 +63,123 @@ class ParquetDispatcher(FileDispatcher):
         return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
 
     @classmethod
+    def _row_group_splits(cls, row_counts: List[int], n_tasks: int) -> List[range]:
+        """Contiguous row-group ranges balanced by row count (the role of the
+        reference's ``_determine_partitioning``, over one dimension)."""
+        total = sum(row_counts)
+        n_tasks = max(1, min(n_tasks, len(row_counts)))
+        target = max(1, total // n_tasks)
+        splits: List[range] = []
+        start, acc = 0, 0
+        for i, n in enumerate(row_counts):
+            acc += n
+            remaining_groups = len(row_counts) - (i + 1)
+            remaining_tasks = n_tasks - len(splits) - 1
+            # close this split once it hits the target, but keep at least one
+            # group available for every remaining task
+            if acc >= target and remaining_groups >= remaining_tasks > 0:
+                splits.append(range(start, i + 1))
+                start, acc = i + 1, 0
+        if start < len(row_counts):
+            splits.append(range(start, len(row_counts)))
+        return splits
+
+    @classmethod
+    def _read_table_row_group_parallel(
+        cls, path: str, columns: Optional[List], filters: Any
+    ):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from modin_tpu.config import CpuCount
+
+        meta_file = pq.ParquetFile(path)
+        try:
+            metadata = meta_file.metadata
+            n_groups = metadata.num_row_groups
+            if filters is not None or n_groups <= 1:
+                return pq.read_table(
+                    path, columns=columns, use_threads=True, filters=filters
+                )
+            row_counts = [metadata.row_group(i).num_rows for i in range(n_groups)]
+        finally:
+            meta_file.close()
+
+        splits = cls._row_group_splits(row_counts, CpuCount.get() * 2)
+        if len(splits) == 1:
+            return pq.read_table(path, columns=columns, use_threads=True)
+
+        def read_split(groups: range):
+            # one handle per task: pyarrow file handles are not thread-safe
+            with pq.ParquetFile(path) as f:
+                return f.read_row_groups(
+                    list(groups), columns=columns, use_threads=False
+                )
+
+        tables = cls._parse_ranges_threaded(splits, read_split)
+        return pa.concat_tables(tables)
+
+    @classmethod
     def write(cls, qc: Any, path: Any, **kwargs: Any):
-        return qc.to_pandas().to_parquet(path, **kwargs)
+        try:
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+        except ImportError:
+            return qc.to_pandas().to_parquet(path, **kwargs)
+
+        engine = kwargs.pop("engine", "auto")
+        compression = kwargs.pop("compression", "snappy")
+        index = kwargs.pop("index", None)
+        if (
+            kwargs
+            or engine not in ("auto", "pyarrow")
+            or not isinstance(path, (str,))
+        ):
+            # partition_cols / storage_options / buffer targets: serial pandas
+            kwargs.setdefault("compression", compression)
+            if index is not None:
+                kwargs["index"] = index
+            return qc.to_pandas().to_parquet(path, engine=engine, **kwargs)
+
+        n_rows = qc.get_axis_len(0)
+        # RangeIndex pandas-metadata is per-schema: a chunked write would
+        # record only the first window's descriptor.  A default trivial
+        # RangeIndex is therefore dropped (read-back reconstructs it
+        # identically); anything else is preserved as index columns, which
+        # chunk-concatenate correctly.
+        if index is None:
+            idx = qc.index
+            preserve = not (
+                isinstance(idx, pandas.RangeIndex)
+                and idx.start == 0
+                and idx.step == 1
+                and idx.name is None
+            )
+        else:
+            preserve = bool(index)
+        writer = None
+        try:
+            if n_rows == 0:
+                table = pa.Table.from_pandas(qc.to_pandas(), preserve_index=preserve)
+                writer = pq.ParquetWriter(path, table.schema, compression=compression)
+                writer.write_table(table)
+                return None
+            for start in range(0, n_rows, _WRITE_CHUNK_ROWS):
+                chunk_qc = qc.take_2d_positional(
+                    index=range(start, min(start + _WRITE_CHUNK_ROWS, n_rows))
+                )
+                table = pa.Table.from_pandas(
+                    chunk_qc.to_pandas(), preserve_index=preserve
+                )
+                if writer is None:
+                    writer = pq.ParquetWriter(
+                        path, table.schema, compression=compression
+                    )
+                writer.write_table(table)
+        finally:
+            if writer is not None:
+                writer.close()
+        return None
 
 
 class FeatherDispatcher(FileDispatcher):

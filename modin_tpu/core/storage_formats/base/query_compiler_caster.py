@@ -49,6 +49,18 @@ def register_backend_qc(cls: type) -> None:
         _BACKEND_REGISTRY.append(cls)
 
 
+def qc_class_for_backend(backend: str) -> type:
+    """Resolve a backend name ("Tpu", "Pandas", ...) to its QC class."""
+    from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+        FactoryDispatcher,
+    )
+
+    for cls in _BACKEND_REGISTRY:
+        if FactoryDispatcher.get_backend_for_compiler(cls) == backend:
+            return cls
+    raise ValueError(f"No query compiler registered for backend {backend!r}")
+
+
 def _iter_qcs(base_cls: type, args: tuple, kwargs: dict):
     for a in args:
         if isinstance(a, base_cls):
@@ -112,6 +124,56 @@ def _cheapest_backend(
     return best
 
 
+# Explicit switch points (reference: query_compiler_caster.py:1222,1243
+# register_function_for_post_op_switch / pre_op_switch): entries are
+# (class_name or None, backend, method).  Pre-op points force backend
+# consideration for a specific (backend, method) even while the global
+# every-method auto-switch heuristic is off; post-op points re-price the
+# RESULT after the op (ops known to shrink data hand small results to the
+# in-process backend).
+_PRE_OP_SWITCH_POINTS: set = set()
+_POST_OP_SWITCH_POINTS: set = set()
+
+
+def register_function_for_pre_op_switch(
+    class_name: Optional[str] = None, backend: Optional[str] = None, method: str = ""
+) -> None:
+    _PRE_OP_SWITCH_POINTS.add((class_name, backend, method))
+
+
+def register_function_for_post_op_switch(
+    class_name: Optional[str] = None, backend: Optional[str] = None, method: str = ""
+) -> None:
+    _POST_OP_SWITCH_POINTS.add((class_name, backend, method))
+
+
+def _is_switch_point(registry: set, backend: str, method: str) -> bool:
+    return any(
+        m == method and (b is None or b == backend) for (_c, b, m) in registry
+    )
+
+
+def _maybe_switch_result_backend(result: Any, name: str, self_type: type) -> Any:
+    """Post-op backend switch: re-price the result and move it if strictly
+    cheaper elsewhere (reference: _maybe_switch_backend_post_op :660)."""
+    from modin_tpu.core.storage_formats.base.query_compiler import (
+        BaseQueryCompiler,
+    )
+
+    if not isinstance(result, BaseQueryCompiler):
+        return result
+    result_type = type(result)
+    candidates = [result_type] + [
+        t for t in _BACKEND_REGISTRY if t is not result_type
+    ]
+    best = _cheapest_backend(name, [result], candidates)
+    if best is not None and best is not result_type:
+        moved = best.move_from(result)
+        moved._shape_hint = result._shape_hint
+        return moved
+    return result
+
+
 def _wrap_method(name: str, fn: Callable) -> Callable:
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
@@ -125,6 +187,14 @@ def _wrap_method(name: str, fn: Callable) -> Callable:
         ]
         mixed = any(type(qc) is not self_type for qc in others)
 
+        backend_name: Optional[str] = None
+        if _PRE_OP_SWITCH_POINTS or _POST_OP_SWITCH_POINTS:
+            from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+                FactoryDispatcher,
+            )
+
+            backend_name = FactoryDispatcher.get_backend_for_compiler(self_type)
+
         target: Optional[type] = None
         if mixed:
             candidates: List[type] = []
@@ -135,7 +205,11 @@ def _wrap_method(name: str, fn: Callable) -> Callable:
         else:
             from modin_tpu.config import AutoSwitchBackend
 
-            if AutoSwitchBackend.get() and len(_BACKEND_REGISTRY) > 1:
+            consider = AutoSwitchBackend.get() or (
+                backend_name is not None
+                and _is_switch_point(_PRE_OP_SWITCH_POINTS, backend_name, name)
+            )
+            if consider and len(_BACKEND_REGISTRY) > 1:
                 # self first: _cheapest_backend breaks ties toward the first
                 # candidate, so staying put wins unless strictly cheaper
                 candidates = [self_type] + [
@@ -160,9 +234,17 @@ def _wrap_method(name: str, fn: Callable) -> Callable:
                 for k, v in kwargs.items()
             }
             if self_type is target:
-                return fn(new_self, *new_args, **new_kwargs)
-            return getattr(new_self, name)(*new_args, **new_kwargs)
-        return fn(self, *args, **kwargs)
+                result = fn(new_self, *new_args, **new_kwargs)
+            else:
+                result = getattr(new_self, name)(*new_args, **new_kwargs)
+        else:
+            result = fn(self, *args, **kwargs)
+
+        if backend_name is not None and _is_switch_point(
+            _POST_OP_SWITCH_POINTS, backend_name, name
+        ):
+            result = _maybe_switch_result_backend(result, name, self_type)
+        return result
 
     wrapper.__qc_cast_wrapped__ = True
     return wrapper

@@ -1,25 +1,96 @@
-"""Accessor/method registration API.
+"""Accessor/method registration API with per-backend overrides.
 
 Reference design: modin/pandas/api/extensions/extensions.py:135-371
 (register_dataframe_accessor / register_series_accessor /
-register_base_accessor / register_pd_accessor).  Registered accessors are
-cached-per-instance like pandas' own extension machinery.
+register_base_accessor / register_pd_accessor, each accepting ``backend=``).
+A registration with ``backend=None`` applies to every backend; a registration
+naming a backend ("Tpu", "Pandas") is visible ONLY on objects whose query
+compiler currently lives on that backend — the lookup happens at attribute
+access time, so the same object exposes/hides the extension as it moves
+between backends.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+import sys
+from types import MethodType
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from modin_tpu.pandas.accessor import CachedAccessor
 
+# (owner class, attribute name) -> {backend or None: accessor object}
+_EXTENSIONS: Dict[Tuple[type, str], Dict[Optional[str], Any]] = {}
+# original class attribute shadowed by the dispatcher (None if absent)
+_SHADOWED: Dict[Tuple[type, str], Any] = {}
 
-def _register_accessor(name: str, cls: type) -> Callable:
+# module-level (pd) accessors: name -> {backend or None: object}
+_PD_EXTENSIONS: Dict[str, Dict[Optional[str], Any]] = {}
+
+
+def _current_backend(instance: Any) -> Optional[str]:
+    qc = getattr(instance, "_query_compiler", None)
+    if qc is None:
+        return None
+    try:
+        return qc.get_backend()
+    except Exception:
+        return None
+
+
+class _BackendDispatchingAttribute:
+    """Descriptor resolving an extension by the instance's live backend."""
+
+    def __init__(self, owner: type, name: str):
+        self._key = (owner, name)
+        self._name = name
+
+    def _resolve(self, instance: Any) -> Any:
+        overrides = _EXTENSIONS.get(self._key, {})
+        backend = _current_backend(instance)
+        if backend in overrides:
+            return overrides[backend]
+        if None in overrides:
+            return overrides[None]
+        fallback = _SHADOWED.get(self._key)
+        if fallback is None:
+            raise AttributeError(
+                f"{type(instance).__name__} object has no attribute "
+                f"{self._name!r} on backend {backend!r}"
+            )
+        return fallback
+
+    def __get__(self, instance: Any, owner: Optional[type] = None) -> Any:
+        if instance is None:
+            return self
+        accessor = self._resolve(instance)
+        if hasattr(accessor, "__get__"):
+            # original descriptor (property, CachedAccessor, function...)
+            return accessor.__get__(instance, owner)
+        if isinstance(accessor, type):
+            return accessor(instance)
+        if callable(accessor):
+            return MethodType(accessor, instance)
+        return accessor
+
+
+def _register_accessor(name: str, cls: type, backend: Optional[str]) -> Callable:
     def decorator(accessor: Any) -> Any:
-        if callable(accessor) and not isinstance(accessor, type):
-            # function accessor: expose directly as a method
-            setattr(cls, name, accessor)
-        else:
-            setattr(cls, name, CachedAccessor(name, accessor))
+        key = (cls, name)
+        if key not in _EXTENSIONS:
+            # shadow the existing attribute (if any, anywhere on the MRO)
+            # behind the dispatcher so unmatched backends keep stock behavior
+            shadowed = None
+            for klass in cls.__mro__:
+                if name in klass.__dict__:
+                    shadowed = klass.__dict__[name]
+                    break
+            _SHADOWED[key] = shadowed
+            setattr(cls, name, _BackendDispatchingAttribute(cls, name))
+        entry: Any = accessor
+        if isinstance(accessor, type):
+            # accessor classes get the pandas-style per-instance cache
+            entry = CachedAccessor(name, accessor)
+        _EXTENSIONS.setdefault(key, {})[backend] = entry
         return accessor
 
     return decorator
@@ -29,42 +100,72 @@ def register_dataframe_accessor(name: str, backend: Optional[str] = None) -> Cal
     """Register a custom accessor/method on modin_tpu DataFrame."""
     from modin_tpu.pandas.dataframe import DataFrame
 
-    return _register_accessor(name, DataFrame)
+    return _register_accessor(name, DataFrame, backend)
 
 
 def register_series_accessor(name: str, backend: Optional[str] = None) -> Callable:
     """Register a custom accessor/method on modin_tpu Series."""
     from modin_tpu.pandas.series import Series
 
-    return _register_accessor(name, Series)
+    return _register_accessor(name, Series, backend)
 
 
 def register_base_accessor(name: str, backend: Optional[str] = None) -> Callable:
     """Register a custom accessor on the shared DataFrame/Series base."""
     from modin_tpu.pandas.base import BasePandasDataset
 
-    return _register_accessor(name, BasePandasDataset)
+    return _register_accessor(name, BasePandasDataset, backend)
 
 
 def register_dataframe_groupby_accessor(name: str, backend: Optional[str] = None) -> Callable:
     from modin_tpu.pandas.groupby import DataFrameGroupBy
 
-    return _register_accessor(name, DataFrameGroupBy)
+    return _register_accessor(name, DataFrameGroupBy, backend)
 
 
 def register_series_groupby_accessor(name: str, backend: Optional[str] = None) -> Callable:
     from modin_tpu.pandas.groupby import SeriesGroupBy
 
-    return _register_accessor(name, SeriesGroupBy)
+    return _register_accessor(name, SeriesGroupBy, backend)
+
+
+def _resolve_pd_extension(name: str) -> Any:
+    """Resolve a module-level extension against the session backend."""
+    from modin_tpu.config import Backend
+
+    overrides = _PD_EXTENSIONS[name]
+    backend = None
+    try:
+        backend = Backend.get()
+    except Exception:
+        pass
+    if backend in overrides:
+        return overrides[backend]
+    if None in overrides:
+        return overrides[None]
+    raise AttributeError(
+        f"module 'modin_tpu.pandas' has no attribute {name!r} on backend {backend!r}"
+    )
 
 
 def register_pd_accessor(name: str, backend: Optional[str] = None) -> Callable:
     """Register a custom function/object on the modin_tpu.pandas module."""
 
     def decorator(obj: Any) -> Any:
-        import modin_tpu.pandas as pd_module
+        pd_module = sys.modules["modin_tpu.pandas"]
+        _PD_EXTENSIONS.setdefault(name, {})[backend] = obj
+        if backend is None:
+            setattr(pd_module, name, obj)
+        else:
+            # a dispatching shim: resolves against the session backend on call
+            def shim(*args: Any, **kwargs: Any) -> Any:
+                target = _resolve_pd_extension(name)
+                if callable(target):
+                    return target(*args, **kwargs)
+                return target
 
-        setattr(pd_module, name, obj)
+            shim.__name__ = name
+            setattr(pd_module, name, shim)
         return obj
 
     return decorator

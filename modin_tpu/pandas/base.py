@@ -109,7 +109,19 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
         return result
 
     def _default_to_pandas(self, op: Any, *args: Any, **kwargs: Any) -> Any:
-        """Materialize, apply a pandas operation, wrap the result back."""
+        """Materialize, apply a pandas operation, wrap the result back.
+
+        String ops with a named BaseQueryCompiler counterpart dispatch
+        through the QC (``series_<op>`` for Series) so the whole long tail is
+        visible to the caster/cost model and per-backend overrides —
+        the reference's every-API-method-reaches-a-QC-method invariant
+        (ref base/query_compiler.py:162); only the residue (callables, ops
+        without a QC name) materializes here at the API layer.
+        """
+        if isinstance(op, str):
+            routed = self._try_qc_dispatch(op, args, kwargs)
+            if routed is not NotImplemented:
+                return routed
         op_name = op if isinstance(op, str) else getattr(op, "__name__", str(op))
         ErrorMessage.default_to_pandas(f"`{type(self).__name__}.{op_name}`")
         args = try_cast_to_pandas(args)
@@ -124,6 +136,47 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
             # the pandas op mutated pandas_obj in place
             return self._update_inplace_from_pandas(pandas_obj)
         return self._wrap_pandas(result)
+
+    def _try_qc_dispatch(self, op: str, args: tuple, kwargs: dict) -> Any:
+        """Dispatch a pandas-signature fallback through a named QC method.
+
+        Returns ``NotImplemented`` when no route exists (caller materializes
+        at the API layer instead).
+        """
+        from modin_tpu.core.storage_formats.base.query_compiler import (
+            BaseQueryCompiler,
+            DATAFRAME_QC_ROUTES,
+            SERIES_QC_ROUTES,
+        )
+
+        routes = SERIES_QC_ROUTES if self.ndim == 1 else DATAFRAME_QC_ROUTES
+        qc_name = routes.get(op)
+        qc = self._query_compiler
+        qc_method = getattr(type(qc), qc_name, None) if qc_name else None
+        if qc_method is None:
+            return NotImplemented
+        args = try_cast_to_pandas(args)
+        kwargs = try_cast_to_pandas(kwargs)
+        # the QC level is out-of-place (reference invariant): compute a new
+        # compiler, then adopt it in place here when the user asked for it
+        inplace = bool(kwargs.get("inplace", False))
+        if inplace:
+            kwargs = {**kwargs, "inplace": False}
+        result = qc_method(qc, *args, **kwargs)
+        if isinstance(result, BaseQueryCompiler):
+            if inplace:
+                return self._create_or_update_from_compiler(result, inplace=True)
+            return self._wrap_from_qc(result)
+        return result
+
+    def _wrap_from_qc(self, result_qc: Any) -> Any:
+        """Wrap a result QC as Series/DataFrame based on its shape hint."""
+        from modin_tpu.pandas.dataframe import DataFrame
+        from modin_tpu.pandas.series import Series
+
+        if result_qc._shape_hint == "column":
+            return Series(query_compiler=result_qc)
+        return DataFrame(query_compiler=result_qc)
 
     def _update_inplace_from_pandas(self, pandas_obj: Any) -> None:
         """Replace this object's contents with a mutated pandas object."""

@@ -1,0 +1,169 @@
+"""Extensions API: per-backend accessor overrides + pre/post-op switch points.
+
+Reference surface: modin/pandas/api/extensions/extensions.py:135-371 (the
+``backend=`` parameter) and modin/core/storage_formats/pandas/
+query_compiler_caster.py:660,1222 (post-op switch registration).
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.core.storage_formats.base.query_compiler_caster import (
+    _POST_OP_SWITCH_POINTS,
+    _PRE_OP_SWITCH_POINTS,
+    register_function_for_post_op_switch,
+    register_function_for_pre_op_switch,
+)
+from modin_tpu.core.storage_formats.native.query_compiler import (
+    NativeQueryCompiler,
+)
+from modin_tpu.pandas.api.extensions import (
+    register_dataframe_accessor,
+    register_pd_accessor,
+    register_series_accessor,
+)
+from modin_tpu.pandas.api.extensions.extensions import _EXTENSIONS, _SHADOWED
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu_backend():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("extension backend tests need the TpuOnJax default")
+
+
+def _native_df(data):
+    qc = NativeQueryCompiler.from_pandas(pandas.DataFrame(data))
+    return pd.DataFrame(query_compiler=qc)
+
+
+@pytest.fixture
+def _clean_registry():
+    """Snapshot + restore extension/switch registries around a test."""
+    ext = {k: dict(v) for k, v in _EXTENSIONS.items()}
+    shadowed = dict(_SHADOWED)
+    pre = set(_PRE_OP_SWITCH_POINTS)
+    post = set(_POST_OP_SWITCH_POINTS)
+    new_keys_before = set(_EXTENSIONS)
+    yield
+    for key in set(_EXTENSIONS) - new_keys_before:
+        cls, name = key
+        orig = _SHADOWED.get(key)
+        if orig is None:
+            if name in cls.__dict__:
+                delattr(cls, name)
+        else:
+            setattr(cls, name, orig)
+    _EXTENSIONS.clear()
+    _EXTENSIONS.update(ext)
+    _SHADOWED.clear()
+    _SHADOWED.update(shadowed)
+    _PRE_OP_SWITCH_POINTS.clear()
+    _PRE_OP_SWITCH_POINTS.update(pre)
+    _POST_OP_SWITCH_POINTS.clear()
+    _POST_OP_SWITCH_POINTS.update(post)
+
+
+def test_accessor_all_backends(_clean_registry):
+    @register_dataframe_accessor("total_cells")
+    def total_cells(self):
+        return int(self.shape[0] * self.shape[1])
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": [4, 5, 6]})
+    assert df.total_cells() == 6
+    ndf = _native_df({"a": [1.0]})
+    assert ndf.total_cells() == 1
+
+
+def test_accessor_backend_scoped_invisible_elsewhere(_clean_registry):
+    @register_dataframe_accessor("tpu_only_tag", backend="Tpu")
+    def tpu_only_tag(self):
+        return "on-device"
+
+    tpu_df = pd.DataFrame({"a": [1, 2, 3]})
+    assert tpu_df.tpu_only_tag() == "on-device"
+
+    native_df = _native_df({"a": [1.0]})
+    with pytest.raises(AttributeError):
+        native_df.tpu_only_tag()
+
+
+def test_accessor_backend_override_beats_all_backend(_clean_registry):
+    @register_dataframe_accessor("which_backend")
+    def which_any(self):
+        return "any"
+
+    @register_dataframe_accessor("which_backend", backend="Pandas")
+    def which_native(self):
+        return "native"
+
+    assert pd.DataFrame({"a": [1]}).which_backend() == "any"
+    assert _native_df({"a": [1.0]}).which_backend() == "native"
+
+
+def test_accessor_override_existing_method_per_backend(_clean_registry):
+    # overriding a REAL method for one backend keeps the stock behavior on
+    # the other
+    @register_series_accessor("sum", backend="Pandas")
+    def fake_sum(self, *args, **kwargs):
+        return -1
+
+    native_s = _native_df({"a": [1.0, 2.0]})["a"]
+    assert native_s.sum() == -1
+    tpu_s = pd.Series([1.0, 2.0])
+    assert float(tpu_s.sum()) == 3.0
+
+
+def test_register_pd_accessor_backend_scoped(_clean_registry):
+    @register_pd_accessor("read_tpu_tag", backend="Tpu")
+    def read_tpu_tag():
+        return "tpu-reader"
+
+    assert pd.read_tpu_tag() == "tpu-reader"
+
+
+def test_accessor_class_cached(_clean_registry):
+    class MyAccessor:
+        def __init__(self, obj):
+            self._obj = obj
+
+        def ncols(self):
+            return self._obj.shape[1]
+
+    register_dataframe_accessor("myacc")(MyAccessor)
+    df = pd.DataFrame({"a": [1], "b": [2]})
+    assert df.myacc.ncols() == 2
+
+
+def test_post_op_switch_moves_small_result(_clean_registry):
+    register_function_for_post_op_switch(
+        class_name=None, backend="Tpu", method="describe"
+    )
+    df = pd.DataFrame({"a": np.arange(100.0)})
+    out = df.describe()
+    # describe shrinks 100 rows -> 8; the post-op point re-prices the result
+    # and hands it to the in-process backend
+    assert type(out._query_compiler).__name__ == "NativeQueryCompiler"
+    expected = pandas.DataFrame({"a": np.arange(100.0)}).describe()
+    pandas.testing.assert_frame_equal(out._to_pandas(), expected)
+
+
+def test_no_post_op_switch_without_registration(_clean_registry):
+    df = pd.DataFrame({"a": np.arange(100.0)})
+    out = df.describe()
+    assert type(out._query_compiler).__name__ == "TpuQueryCompiler"
+
+
+def test_pre_op_switch_point_moves_before_op(_clean_registry):
+    register_function_for_pre_op_switch(
+        class_name=None, backend="Tpu", method="nsmallest"
+    )
+    df = pd.DataFrame({"a": np.arange(50.0)})
+    out = df.nsmallest(3, "a")
+    expected = pandas.DataFrame({"a": np.arange(50.0)}).nsmallest(3, "a")
+    pandas.testing.assert_frame_equal(
+        out._to_pandas().astype(float), expected.astype(float)
+    )
