@@ -93,6 +93,9 @@ class DefaultMethod:
             return cls.build_output(query_compiler, result)
 
         caller.__name__ = fn_display_name
+        # generated straight from the pandas callable: safe to invoke with
+        # pandas-signature args (the routing tables key off this marker)
+        caller._pandas_signature_default = True
         return caller
 
     @classmethod
@@ -354,4 +357,5 @@ class BinaryDefault(DefaultMethod):
             return cls.build_output(query_compiler, result)
 
         caller.__name__ = fn_name
+        caller._pandas_signature_default = True
         return caller

@@ -98,3 +98,9 @@ class TpuOnJaxIO(BaseIO):
     @classmethod
     def to_sql(cls, qc: Any, **kwargs: Any):
         return TpuSQLDispatcher.write(qc, **kwargs)
+
+    @classmethod
+    def to_parquet(cls, qc: Any, path: Any = None, **kwargs: Any):
+        # chunk-streamed writer: bounded host memory instead of a full gather
+        # (reference: per-partition write, parquet_dispatcher.py:912)
+        return TpuParquetDispatcher.write(qc, path, **kwargs)

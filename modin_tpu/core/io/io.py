@@ -175,7 +175,7 @@ def _native_read_excel_unsupported(kwargs: dict) -> Optional[str]:
 
 
 def _no_excel_engine_installed() -> bool:
-    for mod in ("openpyxl", "xlrd", "calamine", "pyxlsb"):
+    for mod in ("openpyxl", "xlrd", "python_calamine", "pyxlsb"):
         try:
             __import__(mod)
             return False

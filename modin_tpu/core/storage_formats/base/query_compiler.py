@@ -360,6 +360,25 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
                 to_write = to_write.to_numpy() if not need_columns_reindex else to_write
             if isinstance(to_write, (pandas.DataFrame, pandas.Series)):
                 to_write = np.asarray(to_write)
+            if not is_scalar(to_write) and not isinstance(
+                to_write, (pandas.DataFrame, pandas.Series)
+            ):
+                arr = np.asarray(to_write)
+                if arr.ndim == 1:
+                    n_rows_sel = (
+                        len(range(*row_numeric_index.indices(len(df))))
+                        if isinstance(row_numeric_index, slice)
+                        else len(list(row_numeric_index))
+                    )
+                    n_cols_sel = (
+                        len(range(*col_numeric_index.indices(df.shape[1])))
+                        if isinstance(col_numeric_index, slice)
+                        else len(list(col_numeric_index))
+                    )
+                    if n_cols_sel == 1 and len(arr) == n_rows_sel:
+                        # a 1-D value into an (n, 1) selection is a column
+                        # write, not a row broadcast
+                        to_write = arr.reshape(-1, 1)
             df.iloc[
                 list(row_numeric_index)
                 if not isinstance(row_numeric_index, slice)
@@ -467,39 +486,26 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
 
         Returns per axis: ``slice(None)`` for a full-axis grab (kept symbolic
         to avoid forcing lazy axis lengths), else a numpy position array or
-        range-like.  MultiIndex axes resolve through ``Index.get_locs`` /
-        ``get_indexer_for`` (partial-tuple lookups included).
+        range-like.  Semantics follow pandas ``.loc`` exactly (reference
+        base/query_compiler.py:4844): label slices are closed intervals;
+        scalars resolve through ``Index.get_loc`` (partial-string datetime
+        keys included); ``range``/``RangeIndex`` locators are *label lists*
+        (missing labels raise ``KeyError``), not positions; MultiIndex axes
+        resolve tuples through ``Index.get_locs`` (partial keys included) and
+        label lists through level-0 selection.
         """
         from pandas.api.types import is_list_like
-        from pandas.core.dtypes.common import is_bool_dtype
 
         out = []
         for axis, loc in ((0, row_loc), (1, col_loc)):
             if isinstance(loc, slice) and loc == slice(None):
                 out.append(loc)
                 continue
-            if is_scalar(loc):
-                loc = np.array([loc])
-            labels: Optional[pandas.Index] = None
-
-            def get_labels() -> pandas.Index:
-                nonlocal labels
-                if labels is None:
-                    labels = self.get_axis(axis)
-                return labels
-
-            if isinstance(loc, pandas.RangeIndex):
-                out.append(loc)
-                continue
-            if isinstance(loc, (slice, range)):
-                lab = get_labels()
-                if isinstance(loc, range):
-                    loc = slice(loc.start, loc.stop, loc.step)
-                    positions = lab.slice_indexer(loc.start, loc.stop - (loc.step or 1), loc.step)
-                else:
-                    # label slices are closed intervals in .loc; slice_indexer
-                    # expects label bounds directly
-                    positions = lab.slice_indexer(loc.start, loc.stop, loc.step)
+            if isinstance(loc, slice):
+                lab = self.get_axis(axis)
+                # label slices are closed intervals in .loc; slice_indexer
+                # expects label bounds directly
+                positions = lab.slice_indexer(loc.start, loc.stop, loc.step)
                 n = len(lab)
                 out.append(
                     pandas.RangeIndex(
@@ -509,26 +515,52 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
                     )
                 )
                 continue
-            if self.has_multiindex(axis):
-                lab = get_labels()
-                if isinstance(loc, pandas.MultiIndex):
-                    positions = lab.get_indexer_for(loc)
-                    if (positions == -1).any():
-                        raise KeyError(list(loc[positions == -1]))
+            if is_scalar(loc):
+                out.append(self._scalar_label_positions(axis, loc))
+                continue
+            if isinstance(loc, tuple):
+                if self.has_multiindex(axis):
+                    # per-level selectors (partial or full key); get_locs
+                    # raises KeyError for missing labels itself
+                    lab = self.get_axis(axis)
+                    out.append(np.asarray(lab.get_locs(list(loc))))
                 else:
-                    # get_locs handles partial tuples / per-level selectors and
-                    # raises KeyError/IndexError for missing labels itself
-                    positions = lab.get_locs(loc)
+                    # on a flat index a tuple is itself a label
+                    out.append(self._scalar_label_positions(axis, loc))
+                continue
+            if isinstance(loc, pandas.MultiIndex):
+                lab = self.get_axis(axis)
+                positions = lab.get_indexer_for(loc)
+                if (positions == -1).any():
+                    raise KeyError(list(loc[positions == -1]))
                 out.append(np.asarray(positions))
                 continue
-            arr = np.asarray(loc) if not isinstance(loc, (np.ndarray, pandas.Index, pandas.Series)) else loc
-            values = np.asarray(arr)
-            if values.dtype == bool or (
-                hasattr(arr, "dtype") and is_bool_dtype(getattr(arr, "dtype", None))
-            ):
+            values = np.asarray(loc)
+            if values.dtype == bool:
+                lab = self.get_axis(axis)
+                if len(values) != len(lab):
+                    raise IndexError(
+                        f"Boolean index has wrong length: "
+                        f"{len(values)} instead of {len(lab)}"
+                    )
                 out.append(np.flatnonzero(values))
                 continue
-            lab = get_labels()
+            lab = self.get_axis(axis)
+            if self.has_multiindex(axis):
+                keys = list(loc)
+                if any(isinstance(k, tuple) for k in keys):
+                    # list of (full) key tuples: exact-key selection
+                    positions = lab.get_indexer_for(keys)
+                    if (positions == -1).any():
+                        raise KeyError(
+                            [k for k, p in zip(keys, positions) if p == -1]
+                        )
+                    out.append(np.asarray(positions))
+                else:
+                    # list of scalars selects on the first level, keeping all
+                    # levels (pandas .loc[list] on a MultiIndex)
+                    out.append(np.asarray(lab.get_locs([keys])))
+                continue
             if is_list_like(loc) and not isinstance(loc, (np.ndarray, pandas.Index)):
                 try:
                     loc = np.asarray(list(loc), dtype=lab.dtype)
@@ -538,10 +570,29 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
             missing = positions == -1
             if missing.any():
                 raise KeyError(
-                    list(np.asarray(loc)[missing]) if is_list_like(loc) else loc
+                    f"{list(np.asarray(loc)[missing])} not in index"
                 )
             out.append(positions)
         return out
+
+    def _scalar_label_positions(self, axis: int, loc: Any) -> Any:
+        """Positions of one scalar label via ``Index.get_loc`` (handles
+        duplicate labels and partial-string datetime keys)."""
+        lab = self.get_axis(axis)
+        try:
+            pos = lab.get_loc(loc)
+        except TypeError:
+            raise KeyError(loc)
+        if isinstance(pos, slice):
+            n = len(lab)
+            return pandas.RangeIndex(
+                (pos.start or 0) + (n if (pos.start or 0) < 0 else 0),
+                pos.stop + (n if pos.stop < 0 else 0),
+                pos.step or 1,
+            )
+        if isinstance(pos, np.ndarray):
+            return np.flatnonzero(pos) if pos.dtype == bool else np.asarray(pos)
+        return np.array([pos], dtype=np.int64)
 
     def take_2d_labels(self, index: Any, columns: Any) -> "BaseQueryCompiler":
         row_lookup, col_lookup = self.get_positions_from_labels(index, columns)
@@ -562,7 +613,12 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
 
         def setter(df: pandas.DataFrame, row_loc: Any, col_loc: Any, item: Any) -> pandas.DataFrame:
             df = df.copy()
-            df.loc[row_loc.squeeze(axis=1), col_loc] = item
+            mask = (
+                row_loc.squeeze(axis=1)
+                if isinstance(row_loc, pandas.DataFrame)
+                else row_loc
+            )
+            df.loc[mask, col_loc] = item
             return df
 
         return DataFrameDefault.register(setter, fn_name="setitem_bool")(
@@ -798,10 +854,14 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
             result = getattr(grp, agg_func)(*agg_args, **agg_kwargs)
         else:
             result = grp.agg(agg_func, *agg_args, **agg_kwargs)
-        if isinstance(result, pandas.Series):
+        was_series = isinstance(result, pandas.Series)
+        if was_series:
             name = result.name if result.name is not None else MODIN_UNNAMED_SERIES_LABEL
             result = result.to_frame(name)
-        return self.from_pandas(result, type(self._modin_frame) if self._modin_frame is not None else None)
+        qc = self.from_pandas(result, type(self._modin_frame) if self._modin_frame is not None else None)
+        if was_series:
+            qc._shape_hint = "column"
+        return qc
 
     def groupby_transform(
         self,
@@ -813,9 +873,11 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
         selection: Any = None,
     ) -> "BaseQueryCompiler":
         """Row-shaped groupby transform (``grp.transform(func)``)."""
+        transformer = lambda grp: grp.transform(agg_func)  # noqa: E731
+        transformer._row_shaped_groupby = True
         return self.groupby_agg(
             by,
-            lambda grp: grp.transform(agg_func),
+            transformer,
             groupby_kwargs=groupby_kwargs,
             drop=drop,
             series_groupby=series_groupby,
@@ -1078,14 +1140,22 @@ def _register_defaults() -> None:
         "add_prefix": "add_prefix", "add_suffix": "add_suffix",
     }
     for qc_name, pandas_name in df_methods.items():
-        if getattr(BaseQueryCompiler, qc_name, None) is None:
+        existing = getattr(BaseQueryCompiler, qc_name, None)
+        if existing is None:
             fn = getattr(pandas.DataFrame, pandas_name, None)
             if fn is None:
                 continue
-            setattr(BaseQueryCompiler, qc_name, DataFrameDefault.register(fn))
-        if qc_name == pandas_name and not pandas_name.startswith("_"):
+            existing = DataFrameDefault.register(fn)
+            setattr(BaseQueryCompiler, qc_name, existing)
+        if (
+            qc_name == pandas_name
+            and not pandas_name.startswith("_")
+            and getattr(existing, "_pandas_signature_default", False)
+        ):
             # generated from the pandas callable itself -> signature-safe to
-            # route the API fallback through the named QC method
+            # route the API fallback through the named QC method (dispatch
+            # re-verifies the marker on the *resolved* method, so a backend
+            # override with a normalized signature is never mis-bound)
             DATAFRAME_QC_ROUTES.setdefault(pandas_name, qc_name)
 
     # ops that must run against the squeezed Series

@@ -39,6 +39,9 @@ from modin_tpu.core.storage_formats.base.query_compiler import (
 )
 from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
 
+# below this, one host gather is cheaper than the shuffle + chunked fetches
+_SHUFFLE_APPLY_MIN_ROWS = 1 << 19
+
 
 class TpuQueryCompiler(BaseQueryCompiler):
     """Query compiler over a TpuDataframe (sharded jax.Array columns)."""
@@ -268,6 +271,25 @@ class TpuQueryCompiler(BaseQueryCompiler):
             if arr.ndim == 1 and len(arr) == n:
                 return HostColumn(pandas.array(arr))
         return None
+
+    def rowwise_query(self, expr: str, **kwargs: Any) -> "TpuQueryCompiler":
+        """Row-wise ``df.query`` compiled onto the device operator surface
+        (reference pandas/query_compiler.py:3585 — NotImplementedError routes
+        the caller to the pandas fallback)."""
+        local_dict = kwargs.pop("local_dict", None)
+        if kwargs:
+            raise NotImplementedError(
+                "only plain row-wise expressions take the native query path"
+            )
+        from modin_tpu.core.computation.eval import try_query
+        from modin_tpu.pandas.dataframe import DataFrame
+
+        result = try_query(DataFrame(query_compiler=self), expr, local_dict)
+        if result is None:
+            raise NotImplementedError(
+                f"the expression {expr!r} is not a supported row-wise query"
+            )
+        return result._query_compiler
 
     def setitem(self, axis: int, key: Any, value: Any) -> "TpuQueryCompiler":
         if axis == 0:
@@ -1597,6 +1619,11 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     agg_func, by, groupby_kwargs or {}, drop, series_groupby,
                     selection,
                 )
+        if result is None and callable(agg_func) and axis == 0 and not series_groupby:
+            result = self._try_shuffle_groupby_apply(
+                by, agg_func, groupby_kwargs or {}, agg_args, agg_kwargs or {},
+                selection,
+            )
         if result is not None:
             return result
         return super().groupby_agg(
@@ -1604,6 +1631,91 @@ class TpuQueryCompiler(BaseQueryCompiler):
             agg_args=agg_args, agg_kwargs=agg_kwargs, how=how, drop=drop,
             series_groupby=series_groupby, selection=selection,
         )
+
+    def _try_shuffle_groupby_apply(
+        self, by, agg_func, groupby_kwargs, agg_args, agg_kwargs, selection
+    ) -> Optional["TpuQueryCompiler"]:
+        """Non-reducible groupby UDFs through the range-partition shuffle.
+
+        Reference: modin routes groupby.apply through
+        ``_apply_func_to_range_partitioning`` + per-partition pandas apply
+        (dataframe.py:4163, :2565).  TPU translation: range-partition the
+        *row ids* by the key on device (parallel/shuffle.py) so every group
+        lands wholly inside one shard range, then run the pandas UDF on each
+        range's sub-frame fetched chunk-by-chunk and concatenate — host peak
+        memory is O(chunk), never the full frame (the base-class path's
+        ``self.to_pandas()`` cliff).
+        """
+        from modin_tpu.parallel.mesh import num_row_shards
+        from modin_tpu.parallel.shuffle import ShuffleSkewError, range_shuffle
+
+        S = num_row_shards()
+        frame = self._modin_frame
+        n = len(frame)
+        if S < 2 or n < _SHUFFLE_APPLY_MIN_ROWS:
+            return None
+        if getattr(agg_func, "_row_shaped_groupby", False):
+            # transform/filter results follow the ORIGINAL frame order; the
+            # key-ordered chunk concat cannot reproduce that
+            return None
+        gk = dict(groupby_kwargs)
+        if gk.get("level") is not None or gk.pop("axis", 0) not in (0, "index"):
+            return None
+        if not gk.get("sort", True) or not gk.get("as_index", True):
+            # chunk concat reproduces key-sorted group order only
+            return None
+        if gk.get("group_keys", True) is False:
+            # with group_keys=False pandas restores original row order for
+            # like-indexed UDF results — same concat-order hazard
+            return None
+        by_list = [by] if not isinstance(by, list) else list(by)
+        if len(by_list) != 1 or hasattr(by_list[0], "to_pandas"):
+            return None
+        pos = frame.column_position(by_list[0])
+        if len(pos) != 1 or pos[0] < 0:
+            return None
+        key_col = frame._columns[pos[0]]
+        if not key_col.is_device or key_col.pandas_dtype.kind not in "biuf":
+            return None
+
+        import jax.numpy as jnp
+
+        iota = jnp.arange(key_col.data.shape[0], dtype=jnp.int64)
+        try:
+            _, (rowid_out,), counts, _ = range_shuffle(key_col.data, [iota], n)
+        except ShuffleSkewError:
+            return None
+        rowids = np.asarray(rowid_out)[:n]
+        results = []
+        start = 0
+        for count in counts:
+            stop = start + int(count)
+            if stop == start:
+                start = stop
+                continue
+            sub = self.take_2d_positional(index=rowids[start:stop]).to_pandas()
+            grp = sub.groupby(by=by_list[0], **groupby_kwargs)
+            if selection is not None:
+                grp = grp[selection]
+            results.append(agg_func(grp, *agg_args, **agg_kwargs))
+            start = stop
+        if not results:
+            return None
+        if not all(isinstance(r, (pandas.Series, pandas.DataFrame)) for r in results):
+            return None
+        if len({type(r) for r in results}) > 1:
+            return None
+        result = pandas.concat(results)
+        was_series = isinstance(result, pandas.Series)
+        if was_series:
+            name = (
+                result.name if result.name is not None else MODIN_UNNAMED_SERIES_LABEL
+            )
+            result = result.to_frame(name)
+        qc = self.from_pandas(result, type(frame))
+        if was_series:
+            qc._shape_hint = "column"
+        return qc
 
     def groupby_transform(
         self,

@@ -180,7 +180,16 @@ __version__ = pandas.__version__
 
 
 def __getattr__(name: str):
-    """Forward anything else to pandas (reference: extensions __getattr__)."""
+    """Resolve registered pd extensions (backend-aware, objects returned
+    as-is), then forward anything else to pandas (reference: extensions
+    module __getattr__, extensions.py:300)."""
+    from modin_tpu.pandas.api.extensions.extensions import (
+        _PD_EXTENSIONS,
+        _resolve_pd_extension,
+    )
+
+    if name in _PD_EXTENSIONS:
+        return _resolve_pd_extension(name)
     try:
         return getattr(pandas, name)
     except AttributeError:

@@ -25,6 +25,8 @@ _SHADOWED: Dict[Tuple[type, str], Any] = {}
 
 # module-level (pd) accessors: name -> {backend or None: object}
 _PD_EXTENSIONS: Dict[str, Dict[Optional[str], Any]] = {}
+# module attribute displaced by a pd extension (None if the module had none)
+_PD_SHADOWED: Dict[str, Any] = {}
 
 
 def _current_backend(instance: Any) -> Optional[str]:
@@ -130,7 +132,12 @@ def register_series_groupby_accessor(name: str, backend: Optional[str] = None) -
 
 
 def _resolve_pd_extension(name: str) -> Any:
-    """Resolve a module-level extension against the session backend."""
+    """Resolve a module-level extension against the session backend.
+
+    Returns the registered object ITSELF (reference extensions.py:300 — the
+    module ``__getattr__`` hands back whatever was registered, callable or
+    not), falling back to the module attribute the registration displaced.
+    """
     from modin_tpu.config import Backend
 
     overrides = _PD_EXTENSIONS[name]
@@ -143,29 +150,30 @@ def _resolve_pd_extension(name: str) -> Any:
         return overrides[backend]
     if None in overrides:
         return overrides[None]
+    shadowed = _PD_SHADOWED.get(name)
+    if shadowed is not None:
+        return shadowed
     raise AttributeError(
         f"module 'modin_tpu.pandas' has no attribute {name!r} on backend {backend!r}"
     )
 
 
 def register_pd_accessor(name: str, backend: Optional[str] = None) -> Callable:
-    """Register a custom function/object on the modin_tpu.pandas module."""
+    """Register a custom function/object on the modin_tpu.pandas module.
+
+    Resolution happens in the module's ``__getattr__`` at attribute-access
+    time, so non-callable registrations (constants, submodules) are returned
+    directly and backend-scoped registrations track the live session backend.
+    """
 
     def decorator(obj: Any) -> Any:
         pd_module = sys.modules["modin_tpu.pandas"]
+        if name not in _PD_SHADOWED:
+            _PD_SHADOWED[name] = pd_module.__dict__.get(name)
         _PD_EXTENSIONS.setdefault(name, {})[backend] = obj
-        if backend is None:
-            setattr(pd_module, name, obj)
-        else:
-            # a dispatching shim: resolves against the session backend on call
-            def shim(*args: Any, **kwargs: Any) -> Any:
-                target = _resolve_pd_extension(name)
-                if callable(target):
-                    return target(*args, **kwargs)
-                return target
-
-            shim.__name__ = name
-            setattr(pd_module, name, shim)
+        # clear the plain module attribute so __getattr__ resolves every
+        # access against the registry (and the displaced original, if any)
+        pd_module.__dict__.pop(name, None)
         return obj
 
     return decorator

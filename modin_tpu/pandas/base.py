@@ -155,6 +155,11 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
         qc_method = getattr(type(qc), qc_name, None) if qc_name else None
         if qc_method is None:
             return NotImplemented
+        if not getattr(qc_method, "_pandas_signature_default", False):
+            # a backend override with a normalized (non-pandas) signature
+            # shadows the generated default — routing pandas-signature args
+            # into it would mis-bind, so take the API-layer fallback instead
+            return NotImplemented
         args = try_cast_to_pandas(args)
         kwargs = try_cast_to_pandas(kwargs)
         # the QC level is out-of-place (reference invariant): compute a new

@@ -949,16 +949,22 @@ class DataFrame(BasePandasDataset):
         return result
 
     def query(self, expr: str, *, inplace: bool = False, **kwargs: Any):
-        from modin_tpu.core.computation.eval import caller_namespace, try_query
+        from modin_tpu.core.computation.eval import caller_namespace
 
         if not kwargs:
+            # named QC seam first (reference dataframe.py:1788): the storage
+            # format compiles simple row-wise expressions natively and raises
+            # NotImplementedError to route everything else to the fallback
             ns = caller_namespace() if "@" in expr else None
-            native = try_query(self, expr, ns)
-            if native is not None:
+            try:
+                new_qc = self._query_compiler.rowwise_query(expr, local_dict=ns)
+            except NotImplementedError:
+                new_qc = None
+            if new_qc is not None:
                 if inplace:
-                    self._update_inplace(native._query_compiler)
+                    self._update_inplace(new_qc)
                     return None
-                return native
+                return DataFrame(query_compiler=new_qc)
         result = self._default_to_pandas("query", expr, **kwargs)
         if inplace:
             self._update_inplace(result._query_compiler)

@@ -119,6 +119,14 @@ class DataFrameGroupBy(ClassLogger, modin_layer="PANDAS-API"):
             if len(cols) == 1:
                 result_qc._shape_hint = "column"
                 return Series(query_compiler=result_qc)
+        if (
+            not series_groupby
+            and getattr(result_qc, "_shape_hint", None) == "column"
+            and len(result_qc.columns) == 1
+        ):
+            # the UDF produced a scalar per group: the QC carries the
+            # was-a-Series hint so the frame groupby still squeezes
+            return Series(query_compiler=result_qc)
         return DataFrame(query_compiler=result_qc)
 
     # ------------------------------------------------------------------ #
@@ -309,18 +317,16 @@ class DataFrameGroupBy(ClassLogger, modin_layer="PANDAS-API"):
                 result_qc._shape_hint = "column"
                 return Series(query_compiler=result_qc)
             return DataFrame(query_compiler=result_qc)
-        return self._groupby_agg(
-            lambda grp, *a, **kw: grp.transform(func, *a, **kw),
-            agg_args=args,
-            agg_kwargs=kwargs,
-        )
+        transformer = lambda grp, *a, **kw: grp.transform(func, *a, **kw)  # noqa: E731
+        # row-shaped result (original frame order): the key-ordered shuffle
+        # concat must not claim it
+        transformer._row_shaped_groupby = True
+        return self._groupby_agg(transformer, agg_args=args, agg_kwargs=kwargs)
 
     def filter(self, func: Any, dropna: bool = True, *args: Any, **kwargs: Any):
-        return self._groupby_agg(
-            lambda grp, *a, **kw: grp.filter(func, dropna=dropna, *a, **kw),
-            agg_args=args,
-            agg_kwargs=kwargs,
-        )
+        filterer = lambda grp, *a, **kw: grp.filter(func, dropna=dropna, *a, **kw)  # noqa: E731
+        filterer._row_shaped_groupby = True
+        return self._groupby_agg(filterer, agg_args=args, agg_kwargs=kwargs)
 
     def pipe(self, func: Any, *args: Any, **kwargs: Any):
         if isinstance(func, tuple):

@@ -1,23 +1,29 @@
 """``loc``/``iloc``/``at``/``iat`` indexers.
 
 Reference design: /root/reference/modin/pandas/indexing.py (_LocationIndexerBase
-:283, _LocIndexer :698, _iLocIndexer :1059): label keys are converted to
-positions on the host (the index is host metadata), then a single
-``take_2d_positional`` runs on the storage format.  Exotic cases (MultiIndex
-partial keys, enlargement setitem) default to pandas.
+:283, _LocIndexer :698, _iLocIndexer :1059): the API layer parses locators and
+computes the result's dimensionality, while *label resolution lives in the
+query compiler* — ``qc.take_2d_labels`` / ``qc.get_positions_from_labels``
+(reference base/query_compiler.py:4809,4844) — so the storage format sees a
+named, cost-modelable operation and device frames stay on device through
+``.loc``.  MultiIndex axes resolve through ``Index.get_locs`` in the QC seam
+(partial-tuple keys included); level dropping after a partial lookup is an
+API-layer fixup, as in the reference (:812-841).  Setitem routes existing-label
+assignments through ``qc.write_items`` and the boolean-mask hot path through
+``qc.setitem_bool`` (reference indexing.py:954); enlargement and aligned
+frame-valued assignment default to pandas.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple, Union
+from typing import Any
 
 import numpy as np
 import pandas
-from pandas.api.types import is_bool_dtype, is_list_like
+from pandas.api.types import is_bool_dtype, is_list_like, is_scalar
 from pandas.core.dtypes.common import is_bool, is_integer
 
 from modin_tpu.logging import ClassLogger
-from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
 
 
 def is_boolean_array(x: Any) -> bool:
@@ -34,6 +40,10 @@ def is_integer_array(x: Any) -> bool:
     if isinstance(x, (np.ndarray, pandas.Series, pandas.Index)):
         return x.dtype.kind in "iu"
     return isinstance(x, (list, tuple)) and len(x) > 0 and all(is_integer(v) for v in x)
+
+
+class _FallbackToPandas(Exception):
+    pass
 
 
 class _LocationIndexerBase(ClassLogger, modin_layer="PANDAS-API"):
@@ -59,12 +69,24 @@ class _LocationIndexerBase(ClassLogger, modin_layer="PANDAS-API"):
 
     def _wrap_row_series(self, row_qc: Any, label: Any) -> Any:
         """One selected row -> Series indexed by columns."""
-        from modin_tpu.pandas.series import Series
-
         pandas_df = row_qc.to_pandas()
         row_series = pandas_df.iloc[0]
         row_series.name = label
         return self.df._wrap_pandas(row_series)
+
+    def _write_positional(self, row_lookup: Any, col_lookup: Any, value: Any) -> bool:
+        """Positional assignment via ``qc.write_items``; False if the value
+        needs label alignment (frame-valued) and must take the fallback."""
+        from modin_tpu.pandas.base import BasePandasDataset
+
+        if isinstance(value, (BasePandasDataset, pandas.Series, pandas.DataFrame)):
+            # .loc/.iloc setitem with a pandas-like value aligns on labels;
+            # keep those semantics on the oracle path
+            return False
+        new_qc = self.qc.write_items(row_lookup, col_lookup, value)
+        self.df._update_inplace(new_qc)
+        self.qc = self.df._query_compiler
+        return True
 
 
 class _iLocIndexer(_LocationIndexerBase):
@@ -137,20 +159,9 @@ class _iLocIndexer(_LocationIndexerBase):
         raise TypeError(f"Cannot index by location index with a key of type {type(axis_key)}")
 
     def __setitem__(self, key: Any, value: Any) -> None:
-        self._fallback_set(key, value, "iloc")
-
-
-class _LocIndexer(_LocationIndexerBase):
-    def __getitem__(self, key: Any) -> Any:
-        from modin_tpu.pandas.dataframe import DataFrame
-        from modin_tpu.pandas.series import Series
-
         if callable(key):
-            return self.__getitem__(key(self.df))
+            key = key(self.df)
         ndim = self.df.ndim
-        index = self.df.index
-        if isinstance(index, pandas.MultiIndex):
-            return self._fallback_get(key, "loc")
         if isinstance(key, tuple) and ndim == 2:
             if len(key) > 2:
                 raise pandas.errors.IndexingError("Too many indexers")
@@ -158,96 +169,286 @@ class _LocIndexer(_LocationIndexerBase):
             col_key = key[1] if len(key) > 1 else slice(None)
         else:
             row_key, col_key = key, slice(None)
-
-        if ndim == 2 and isinstance(self.df.columns, pandas.MultiIndex):
-            return self._fallback_get(key, "loc")
-        if isinstance(row_key, DataFrame) or (
-            ndim == 2 and isinstance(col_key, DataFrame)
-        ):
-            return self._fallback_get(key, "loc")
-
         try:
-            row_pos, row_scalar, row_label = self._label_positions(row_key, index)
+            row_pos = self._positions(row_key, len(self.df.index), "row")
+            col_pos = (
+                self._positions(col_key, len(self.df.columns), "column")
+                if ndim == 2
+                else slice(None)
+            )
+        except (TypeError, IndexError):
+            return self._fallback_set(key, value, "iloc")
+        if not self._write_positional(row_pos, col_pos, value):
+            self._fallback_set(key, value, "iloc")
+
+
+class _LocIndexer(_LocationIndexerBase):
+    def __getitem__(self, key: Any) -> Any:
+        if callable(key):
+            return self.__getitem__(key(self.df))
+        if self.df.empty:
+            return self._fallback_get(key, "loc")
+        ndim_self = self.df.ndim
+        index = self.df.index
+        if ndim_self == 2 and isinstance(key, tuple):
+            if (
+                isinstance(index, pandas.MultiIndex)
+                and 2 <= len(key) <= index.nlevels
+                and all(is_scalar(k) for k in key)
+            ):
+                # loc[('a', 'b')] is ambiguous: a (partial) row key or a
+                # (row, column) pair.  pandas prefers the row interpretation
+                # when it resolves (reference indexing.py:731-747).
+                try:
+                    return self._getitem_via_qc(key, key, slice(None))
+                except KeyError:
+                    pass
+            if len(key) > 2:
+                raise pandas.errors.IndexingError("Too many indexers")
+            row_key = key[0]
+            col_key = key[1] if len(key) > 1 else slice(None)
+        else:
+            row_key, col_key = key, slice(None)
+            if (
+                ndim_self == 1
+                and isinstance(key, tuple)
+                and not isinstance(index, pandas.MultiIndex)
+            ):
+                if len(key) > 1:
+                    raise pandas.errors.IndexingError("Too many indexers")
+                row_key = key[0] if key else slice(None)
+        try:
+            return self._getitem_via_qc(key, row_key, col_key)
         except _FallbackToPandas:
             return self._fallback_get(key, "loc")
 
-        if ndim == 1:
-            if row_scalar:
-                sub = self.qc.take_2d_positional(index=row_pos)
-                return sub.to_pandas().iloc[0, 0]
-            new_qc = self.qc.take_2d_positional(index=row_pos)
-            new_qc._shape_hint = "column"
-            return Series(query_compiler=new_qc)
-
-        columns = self.df.columns
-        try:
-            col_pos, col_scalar, col_label = self._label_positions(col_key, columns)
-        except _FallbackToPandas:
-            return self._fallback_get(key, "loc")
-
-        new_qc = self.qc.take_2d_positional(index=row_pos, columns=col_pos)
-        if row_scalar and col_scalar:
-            return new_qc.to_pandas().iloc[0, 0]
-        if row_scalar:
-            return self._wrap_row_series(new_qc, row_label)
-        if col_scalar:
-            new_qc._shape_hint = "column"
-            return Series(query_compiler=new_qc)
-        return DataFrame(query_compiler=new_qc)
-
-    def _label_positions(self, axis_key: Any, labels: pandas.Index):
-        """Return (positions, is_scalar, scalar_label); raise _FallbackToPandas."""
+    def _getitem_via_qc(self, key: Any, row_key: Any, col_key: Any) -> Any:
+        from modin_tpu.pandas.dataframe import DataFrame
         from modin_tpu.pandas.series import Series
 
-        if isinstance(axis_key, slice):
-            if axis_key == slice(None):
-                return axis_key, False, None
-            try:
-                start, stop = labels.slice_locs(axis_key.start, axis_key.stop, axis_key.step)
-            except Exception:
-                raise _FallbackToPandas()
-            return slice(start, stop, axis_key.step), False, None
-        if isinstance(axis_key, Series):
-            if is_bool_dtype(axis_key.dtype):
-                axis_key = axis_key._to_pandas()
-            else:
-                axis_key = axis_key.to_numpy()
-        if isinstance(axis_key, pandas.Series):
-            if is_bool_dtype(axis_key.dtype):
-                axis_key = axis_key.reindex(labels).fillna(False).to_numpy()
-            else:
-                axis_key = axis_key.to_numpy()
-        if is_boolean_array(axis_key):
-            mask = np.asarray(axis_key)
-            if len(mask) != len(labels):
-                raise IndexError(
-                    f"Boolean index has wrong length: {len(mask)} instead of {len(labels)}"
+        row_scalar = is_scalar(row_key)
+        col_scalar = is_scalar(col_key)
+        row_mi_full = self._multiindex_full_key(0, row_key)
+        col_mi_full = (
+            self._multiindex_full_key(1, col_key) if self.df.ndim == 2 else False
+        )
+
+        # Boolean-mask rows on a device frame: reuse the __getitem__ filter
+        # fast path (mask fuses into the kernel) instead of materializing
+        # positions on the host (reference _handle_boolean_masking :631).
+        if (
+            self.df.ndim == 2
+            and isinstance(row_key, Series)
+            and is_boolean_array(row_key)
+        ):
+            masked = self.df[row_key]
+            if isinstance(col_key, slice) and col_key == slice(None):
+                return masked
+            return masked.loc[:, col_key]
+
+        row_key = self._normalize_key(row_key, 0)
+        if self.df.ndim == 2:
+            col_key = self._normalize_key(col_key, 1)
+
+        qc_view = self.qc.take_2d_labels(
+            row_key, col_key if self.df.ndim == 2 else slice(None)
+        )
+
+        # An axis squeezes only when its key pins exactly one label: a scalar
+        # (or tuple label) on a flat axis, or a full-depth tuple on a
+        # MultiIndex axis.  A PARTIAL MultiIndex key keeps the axis and drops
+        # the looked-up levels instead (pandas xs semantics).
+        has_mi_rows = self.qc.has_multiindex(0)
+        has_mi_cols = self.df.ndim == 2 and self.qc.has_multiindex(1)
+        row_squeeze = row_mi_full or (
+            (row_scalar or isinstance(row_key, tuple)) and not has_mi_rows
+        )
+        col_squeeze = col_mi_full or (
+            (col_scalar or isinstance(col_key, tuple)) and not has_mi_cols
+        )
+        if self.df.ndim == 1:
+            qc_view._shape_hint = "column"
+            result = Series(query_compiler=qc_view)
+            if row_squeeze:
+                result = result.squeeze(axis=0)
+        else:
+            result = DataFrame(query_compiler=qc_view)
+            if row_squeeze or col_squeeze:
+                axis = (
+                    None if row_squeeze and col_squeeze else 1 if col_squeeze else 0
                 )
-            return list(np.nonzero(mask)[0]), False, None
-        if is_list_like(axis_key) and not isinstance(axis_key, tuple):
-            keys = list(axis_key)
-            positions = labels.get_indexer_for(keys)
-            if (np.asarray(positions) == -1).any():
-                missing = [k for k, p in zip(keys, positions) if p == -1]
-                raise KeyError(f"{missing} not in index")
-            return list(positions), False, None
-        # scalar label
-        try:
-            loc = labels.get_loc(axis_key)
-        except (KeyError, TypeError):
-            raise KeyError(axis_key)
-        if isinstance(loc, slice):
-            return loc, False, None
-        if isinstance(loc, np.ndarray):
-            return list(np.nonzero(loc)[0]) if loc.dtype == bool else list(loc), False, None
-        return [int(loc)], True, axis_key
+                result = result.squeeze(axis=axis)
+
+        result = self._drop_levels(
+            result, row_key, col_key, row_scalar, col_scalar,
+            levels_already_dropped=row_mi_full or col_mi_full,
+            row_squeezed=row_squeeze, col_squeezed=col_squeeze,
+        )
+        # Keep index state (e.g. DatetimeIndex freq) when selecting all
+        # columns by an Index-valued row key (reference indexing.py:843-851)
+        if (
+            isinstance(key, pandas.Index)
+            and not isinstance(key, pandas.MultiIndex)
+            and isinstance(col_key, slice)
+            and col_key == slice(None)
+            and hasattr(result, "index")
+            and len(result.index) == len(key)
+        ):
+            result.index = key
+        return result
+
+    def _drop_levels(
+        self,
+        result: Any,
+        row_key: Any,
+        col_key: Any,
+        row_scalar: bool,
+        col_scalar: bool,
+        levels_already_dropped: bool,
+        row_squeezed: bool = False,
+        col_squeezed: bool = False,
+    ) -> Any:
+        """Partial-key MultiIndex lookups drop the looked-up levels
+        (reference indexing.py:812-841)."""
+        from modin_tpu.pandas.base import BasePandasDataset
+        from modin_tpu.pandas.dataframe import DataFrame
+        from modin_tpu.pandas.series import Series
+
+        if not isinstance(result, BasePandasDataset) or levels_already_dropped:
+            return result
+        col_list = [col_key] if col_scalar else col_key
+        row_list = [row_key] if row_scalar else row_key
+        if isinstance(result.index, pandas.MultiIndex):
+            # a Series whose index came from the COLUMNS (row axis squeezed
+            # away, columns kept) drops col-key levels; every other result's
+            # index is the row axis and drops row-key levels
+            index_is_columns = (
+                isinstance(result, Series) and row_squeezed and not col_squeezed
+            )
+            if index_is_columns:
+                if (
+                    isinstance(col_list, (list, tuple))
+                    and 0 < len(col_list) < result.index.nlevels
+                    and all(
+                        not isinstance(col_list[i], slice)
+                        and col_list[i] in result.index.levels[i]
+                        for i in range(len(col_list))
+                    )
+                ):
+                    result.index = result.index.droplevel(list(range(len(col_list))))
+            elif (
+                (row_scalar or isinstance(row_key, tuple))
+                and isinstance(row_list, (list, tuple))
+                and 0 < len(row_list) < result.index.nlevels
+                and all(
+                    not isinstance(row_list[i], slice)
+                    and is_scalar(row_list[i])
+                    and row_list[i] in result.index.levels[i]
+                    for i in range(len(row_list))
+                )
+            ):
+                result.index = result.index.droplevel(list(range(len(row_list))))
+        if (
+            isinstance(result, DataFrame)
+            and isinstance(result.columns, pandas.MultiIndex)
+            and (col_scalar or isinstance(col_key, tuple))
+            and isinstance(col_list, (list, tuple))
+            and 0 < len(col_list) < result.columns.nlevels
+            and all(
+                not isinstance(col_list[i], slice)
+                and is_scalar(col_list[i])
+                and col_list[i] in result.columns.levels[i]
+                for i in range(len(col_list))
+            )
+        ):
+            result.columns = result.columns.droplevel(list(range(len(col_list))))
+        return result
+
+    def _multiindex_full_key(self, axis: int, key: Any) -> bool:
+        """Tuple key whose length spans every level of a MultiIndex axis
+        (reference _multiindex_possibly_contains_key, indexing.py:664)."""
+        if not isinstance(key, tuple) or not self.qc.has_multiindex(axis):
+            return False
+        if not all(is_scalar(k) for k in key):
+            return False
+        return len(key) == self.qc.get_axis(axis).nlevels
+
+    def _normalize_key(self, loc: Any, axis: int) -> Any:
+        """Materialize modin-object keys; align boolean Series masks by label
+        (pandas ``check_bool_indexer`` semantics)."""
+        from modin_tpu.pandas.base import BasePandasDataset
+        from modin_tpu.pandas.dataframe import DataFrame
+        from modin_tpu.pandas.series import Series
+
+        if isinstance(loc, (DataFrame, pandas.DataFrame)):
+            raise _FallbackToPandas()
+        if isinstance(loc, Series):
+            loc = loc._to_pandas()
+        if isinstance(loc, BasePandasDataset):
+            raise _FallbackToPandas()
+        if isinstance(loc, pandas.Series):
+            if is_bool_dtype(loc.dtype):
+                labels = self.qc.get_axis(axis)
+                if not loc.index.equals(labels):
+                    loc = loc.reindex(labels)
+                    if loc.isna().any():
+                        raise pandas.errors.IndexingError(
+                            "Unalignable boolean Series provided as indexer "
+                            "(index of the boolean Series and of the indexed "
+                            "object do not match)."
+                        )
+                return loc.to_numpy(dtype=bool)
+            return loc.to_numpy()
+        return loc
 
     def __setitem__(self, key: Any, value: Any) -> None:
-        self._fallback_set(key, value, "loc")
+        from modin_tpu.pandas.series import Series
 
+        if callable(key):
+            key = key(self.df)
+        ndim_self = self.df.ndim
+        index = self.df.index
+        if isinstance(index, pandas.MultiIndex) or (
+            ndim_self == 2 and isinstance(self.df.columns, pandas.MultiIndex)
+        ):
+            return self._fallback_set(key, value, "loc")
+        if isinstance(key, tuple) and ndim_self == 2:
+            if len(key) > 2:
+                raise pandas.errors.IndexingError("Too many indexers")
+            row_key = key[0]
+            col_key = key[1] if len(key) > 1 else slice(None)
+        else:
+            row_key, col_key = key, slice(None)
 
-class _FallbackToPandas(Exception):
-    pass
+        # The reference's boolean hot path (indexing.py:954): mask rows,
+        # scalar value -> one named QC op
+        if (
+            ndim_self == 2
+            and isinstance(row_key, Series)
+            and is_boolean_array(row_key)
+            and is_scalar(value)
+            and not isinstance(col_key, slice)
+        ):
+            new_qc = self.qc.setitem_bool(row_key._query_compiler, col_key, value)
+            self.df._update_inplace(new_qc)
+            self.qc = self.df._query_compiler
+            return
+
+        try:
+            row_norm = self._normalize_key(row_key, 0)
+            col_norm = (
+                self._normalize_key(col_key, 1) if ndim_self == 2 else slice(None)
+            )
+            row_lookup, col_lookup = self.qc.get_positions_from_labels(
+                row_norm, col_norm
+            )
+        except KeyError:
+            # missing labels: .loc setitem enlarges; keep pandas as the oracle
+            return self._fallback_set(key, value, "loc")
+        except (_FallbackToPandas, pandas.errors.IndexingError, TypeError):
+            return self._fallback_set(key, value, "loc")
+        if not self._write_positional(row_lookup, col_lookup, value):
+            self._fallback_set(key, value, "loc")
 
 
 class _AtIndexer(_LocationIndexerBase):
@@ -255,7 +456,7 @@ class _AtIndexer(_LocationIndexerBase):
         return self.df.loc[key]
 
     def __setitem__(self, key: Any, value: Any) -> None:
-        self._fallback_set(key, value, "at")
+        self.df.loc[key] = value
 
 
 class _iAtIndexer(_LocationIndexerBase):
@@ -263,4 +464,4 @@ class _iAtIndexer(_LocationIndexerBase):
         return self.df.iloc[key]
 
     def __setitem__(self, key: Any, value: Any) -> None:
-        self._fallback_set(key, value, "iat")
+        self.df.iloc[key] = value

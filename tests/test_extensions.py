@@ -43,12 +43,27 @@ def _native_df(data):
 @pytest.fixture
 def _clean_registry():
     """Snapshot + restore extension/switch registries around a test."""
+    from modin_tpu.pandas.api.extensions.extensions import (
+        _PD_EXTENSIONS,
+        _PD_SHADOWED,
+    )
+
     ext = {k: dict(v) for k, v in _EXTENSIONS.items()}
     shadowed = dict(_SHADOWED)
+    pd_ext = {k: dict(v) for k, v in _PD_EXTENSIONS.items()}
+    pd_shadowed = dict(_PD_SHADOWED)
     pre = set(_PRE_OP_SWITCH_POINTS)
     post = set(_POST_OP_SWITCH_POINTS)
     new_keys_before = set(_EXTENSIONS)
     yield
+    for name in set(_PD_EXTENSIONS) - set(pd_ext):
+        orig = _PD_SHADOWED.get(name)
+        if orig is not None:
+            pd.__dict__[name] = orig
+    _PD_EXTENSIONS.clear()
+    _PD_EXTENSIONS.update(pd_ext)
+    _PD_SHADOWED.clear()
+    _PD_SHADOWED.update(pd_shadowed)
     for key in set(_EXTENSIONS) - new_keys_before:
         cls, name = key
         orig = _SHADOWED.get(key)
@@ -123,6 +138,24 @@ def test_register_pd_accessor_backend_scoped(_clean_registry):
         return "tpu-reader"
 
     assert pd.read_tpu_tag() == "tpu-reader"
+
+
+def test_register_pd_accessor_non_callable(_clean_registry):
+    """ADVICE r3: attribute access must return the object itself, not a
+    callable shim (reference extensions.py:300)."""
+    register_pd_accessor("tpu_answer", backend="Tpu")(42)
+    assert pd.tpu_answer == 42
+    register_pd_accessor("global_const")({"k": "v"})
+    assert pd.global_const == {"k": "v"}
+
+
+def test_register_pd_accessor_shadow_restores_original(_clean_registry):
+    """A backend-scoped override of a stock function must fall back to the
+    original on other backends."""
+    original = pd.read_csv
+    register_pd_accessor("read_csv", backend="Pandas")(lambda *a, **k: "native")
+    # session backend is Tpu: the Pandas-scoped override must NOT apply
+    assert pd.read_csv is original
 
 
 def test_accessor_class_cached(_clean_registry):

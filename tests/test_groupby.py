@@ -5,7 +5,7 @@ import pandas
 import pytest
 
 import modin_tpu.pandas as pd
-from tests.utils import assert_no_fallback, create_test_dfs, df_equals
+from tests.utils import assert_no_fallback, create_test_dfs, df_equals, eval_general
 
 _rng = np.random.default_rng(7)
 N = 200
@@ -396,3 +396,139 @@ def test_masked_scan_smc_kernel_direct(agg, adaptive, has_sizes, with_nan):
     if agg == "mean":
         # f32 means must stay f32 (pandas dtype parity)
         assert out[2].dtype == jnp.float32
+
+
+class TestShuffleGroupbyApply:
+    """Non-reducible UDFs through the range-partition shuffle (reference
+    dataframe.py:4163,2565): groups never span chunks, host memory is
+    O(chunk), results match the full-frame pandas oracle."""
+
+    @pytest.fixture
+    def big(self, monkeypatch):
+        import modin_tpu.core.storage_formats.tpu.query_compiler as qc_mod
+
+        monkeypatch.setattr(qc_mod, "_SHUFFLE_APPLY_MIN_ROWS", 100)
+        rng = np.random.default_rng(29)
+        n = 6000
+        data = {
+            "k": rng.integers(0, 40, n),
+            "v": rng.normal(size=n),
+            "w": rng.integers(-5, 5, n),
+        }
+        return create_test_dfs(data)
+
+    def _spy(self, monkeypatch):
+        import modin_tpu.core.storage_formats.tpu.query_compiler as qc_mod
+
+        calls = {"n": 0}
+        orig = qc_mod.TpuQueryCompiler._try_shuffle_groupby_apply
+
+        def wrapper(self, *a, **k):
+            out = orig(self, *a, **k)
+            if out is not None:
+                calls["n"] += 1
+            return out
+
+        monkeypatch.setattr(
+            qc_mod.TpuQueryCompiler, "_try_shuffle_groupby_apply", wrapper
+        )
+        return calls
+
+    def test_apply_scalar_per_group(self, big, monkeypatch):
+        from modin_tpu.utils import get_current_execution
+
+        if get_current_execution() != "TpuOnJax":
+            pytest.skip("shuffle path needs the sharded backend")
+        calls = self._spy(monkeypatch)
+        md, pdf = big
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("k")[["v", "w"]].apply(
+                lambda g: g["v"].max() - g["w"].min()
+            ),
+        )
+        assert calls["n"] >= 1
+
+    def test_apply_frame_per_group(self, big, monkeypatch):
+        from modin_tpu.utils import get_current_execution
+
+        if get_current_execution() != "TpuOnJax":
+            pytest.skip("shuffle path needs the sharded backend")
+        calls = self._spy(monkeypatch)
+        md, pdf = big
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("k")[["v"]].apply(lambda g: g.head(2)),
+        )
+        assert calls["n"] >= 1
+
+    def test_agg_lambda(self, big):
+        md, pdf = big
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("k")["v"].agg(lambda s: (s > 0).sum()),
+        )
+
+    def test_float_key(self, big):
+        md, pdf = big
+        md = md.assign(fk=md["w"] * 0.5)
+        pdf = pdf.assign(fk=pdf["w"] * 0.5)
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("fk")[["v"]].apply(lambda g: g["v"].sum()),
+        )
+
+    def test_sort_false_falls_back_correct(self, big):
+        md, pdf = big
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("k", sort=False)[["v"]].apply(
+                lambda g: g["v"].mean()
+            ),
+        )
+
+    def test_with_nan_keys(self, big):
+        md, pdf = big
+        md = md.assign(fk=md["w"].where(md["w"] > -3, np.nan))
+        pdf = pdf.assign(fk=pdf["w"].where(pdf["w"] > -3, np.nan))
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("fk")[["v"]].apply(lambda g: g["v"].sum()),
+        )
+
+
+class TestRowShapedCallablesBypassShuffle:
+    """transform/filter lambdas and group_keys=False apply keep the ORIGINAL
+    frame row order; the key-ordered shuffle concat must never claim them."""
+
+    @pytest.fixture
+    def big(self, monkeypatch):
+        import modin_tpu.core.storage_formats.tpu.query_compiler as qc_mod
+
+        monkeypatch.setattr(qc_mod, "_SHUFFLE_APPLY_MIN_ROWS", 100)
+        rng = np.random.default_rng(41)
+        n = 5000
+        data = {"k": rng.integers(0, 30, n), "v": rng.normal(size=n)}
+        return create_test_dfs(data)
+
+    def test_transform_lambda_original_order(self, big):
+        md, pdf = big
+        eval_general(
+            md, pdf, lambda df: df.groupby("k").transform(lambda s: s - s.mean())
+        )
+
+    def test_filter_original_order(self, big):
+        md, pdf = big
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("k").filter(lambda g: g["v"].mean() > 0),
+        )
+
+    def test_apply_group_keys_false_original_order(self, big):
+        md, pdf = big
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("k", group_keys=False)[["v"]].apply(
+                lambda g: g - g.mean()
+            ),
+        )

@@ -1,0 +1,401 @@
+"""The loc/iloc label seam: API -> qc.take_2d_labels/get_positions_from_labels
+-> take_2d_positional (reference modin/pandas/indexing.py:698 ->
+base/query_compiler.py:4809,4844), plus the setitem routes through
+qc.write_items / qc.setitem_bool and df.query through qc.rowwise_query.
+
+Scenario shapes ported from modin/tests/pandas/dataframe/test_indexing.py."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import create_test_dfs, df_equals, eval_general
+
+_rng = np.random.default_rng(77)
+
+
+@pytest.fixture
+def mi_dfs():
+    idx = pandas.MultiIndex.from_product(
+        [["bar", "baz", "foo", "qux"], ["one", "two"], [1, 2]],
+        names=["k1", "k2", "k3"],
+    )
+    data = {"v": np.arange(16.0), "w": np.arange(16) * 3}
+    return create_test_dfs(data, index=idx)
+
+
+@pytest.fixture
+def mi_col_dfs():
+    cols = pandas.MultiIndex.from_product([["a", "b"], ["x", "y"]])
+    data = _rng.normal(size=(8, 4))
+    md = pd.DataFrame(data, columns=cols)
+    pdf = pandas.DataFrame(data, columns=cols)
+    return md, pdf
+
+
+class TestMultiIndexLoc:
+    def test_partial_scalar_key_drops_level(self, mi_dfs):
+        md, pdf = mi_dfs
+        eval_general(md, pdf, lambda df: df.loc["bar"])
+        eval_general(md, pdf, lambda df: df.loc["qux"])
+
+    def test_partial_tuple_key(self, mi_dfs):
+        md, pdf = mi_dfs
+        eval_general(md, pdf, lambda df: df.loc[("baz", "one")])
+
+    def test_full_tuple_key_returns_series(self, mi_dfs):
+        md, pdf = mi_dfs
+        m, p = md.loc[("foo", "two", 1)], pdf.loc[("foo", "two", 1)]
+        assert m.name == p.name
+        df_equals(m, p)
+
+    def test_full_key_and_column(self, mi_dfs):
+        md, pdf = mi_dfs
+        assert md.loc[("foo", "two", 1), "v"] == pdf.loc[("foo", "two", 1), "v"]
+
+    def test_scalar_key_and_column_list(self, mi_dfs):
+        md, pdf = mi_dfs
+        eval_general(md, pdf, lambda df: df.loc["bar", ["v"]])
+
+    def test_level0_label_list_keeps_levels(self, mi_dfs):
+        md, pdf = mi_dfs
+        eval_general(md, pdf, lambda df: df.loc[["bar", "foo"]])
+
+    def test_list_of_full_tuples(self, mi_dfs):
+        md, pdf = mi_dfs
+        key = [("bar", "one", 1), ("qux", "two", 2)]
+        eval_general(md, pdf, lambda df: df.loc[key])
+
+    def test_per_level_selectors_with_slice(self, mi_dfs):
+        md, pdf = mi_dfs
+        eval_general(md, pdf, lambda df: df.loc[("baz", slice(None), 2), :])
+
+    def test_label_slice_over_level0(self, mi_dfs):
+        md, pdf = mi_dfs
+        eval_general(md, pdf, lambda df: df.loc["baz":"foo"])
+
+    def test_missing_key_raises(self, mi_dfs):
+        md, pdf = mi_dfs
+        eval_general(md, pdf, lambda df: df.loc["nope"])
+        eval_general(md, pdf, lambda df: df.loc[("bar", "three")])
+
+    def test_series_multiindex_loc(self, mi_dfs):
+        md, pdf = mi_dfs
+        ms, ps = md["v"], pdf["v"]
+        df_equals(ms.loc["bar"], ps.loc["bar"])
+        assert ms.loc[("foo", "two", 1)] == ps.loc[("foo", "two", 1)]
+        df_equals(ms.loc[("baz", "one")], ps.loc[("baz", "one")])
+
+    def test_no_wholesale_fallback(self, mi_dfs):
+        """MultiIndex loc must route through the QC seam, not default to
+        pandas (the round-3 gap this seam exists to close)."""
+        md, _ = mi_dfs
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            md.loc["bar"]
+            md.loc[("baz", "one")]
+            md.loc[["bar", "foo"]]
+            md.loc["baz":"foo"]
+
+
+class TestMultiIndexColumns:
+    def test_partial_column_tuple_drops_level(self, mi_col_dfs):
+        md, pdf = mi_col_dfs
+        eval_general(md, pdf, lambda df: df.loc[:, ("a",)])
+
+    def test_full_column_tuple(self, mi_col_dfs):
+        md, pdf = mi_col_dfs
+        eval_general(md, pdf, lambda df: df.loc[:, ("a", "y")])
+
+    def test_column_label_list(self, mi_col_dfs):
+        md, pdf = mi_col_dfs
+        eval_general(md, pdf, lambda df: df.loc[:, [("a", "x"), ("b", "y")]])
+
+    def test_rows_and_column_level0(self, mi_col_dfs):
+        md, pdf = mi_col_dfs
+        eval_general(md, pdf, lambda df: df.loc[2:5, ("b",)])
+
+
+class TestPositionsFromLabels:
+    """Direct unit coverage of the QC seam (the round-3 dead methods)."""
+
+    @pytest.fixture
+    def qc(self):
+        return pd.DataFrame(
+            {"x": np.arange(8.0), "y": np.arange(8) * 2},
+            index=[10, 20, 30, 40, 50, 60, 70, 80],
+        )._query_compiler
+
+    def test_full_slices_stay_symbolic(self, qc):
+        rows, cols = qc.get_positions_from_labels(slice(None), slice(None))
+        assert rows == slice(None) and cols == slice(None)
+
+    def test_label_slice_closed(self, qc):
+        rows, _ = qc.get_positions_from_labels(slice(20, 50), slice(None))
+        assert list(rows) == [1, 2, 3, 4]
+
+    def test_range_is_labels_not_positions(self, qc):
+        # ADVICE r3: pandas .loc treats range as list-like LABELS and raises
+        # KeyError for missing ones — not a positional window
+        with pytest.raises(KeyError):
+            qc.get_positions_from_labels(range(2, 5), slice(None))
+        rows, _ = qc.get_positions_from_labels(range(10, 40, 10), slice(None))
+        assert list(rows) == [0, 1, 2]
+
+    def test_scalar_and_missing(self, qc):
+        rows, _ = qc.get_positions_from_labels(30, slice(None))
+        assert list(rows) == [2]
+        with pytest.raises(KeyError):
+            qc.get_positions_from_labels(35, slice(None))
+
+    def test_bool_mask_length_checked(self, qc):
+        with pytest.raises(IndexError):
+            qc.get_positions_from_labels([True, False], slice(None))
+        rows, _ = qc.get_positions_from_labels(
+            np.arange(8) % 3 == 0, slice(None)
+        )
+        assert list(rows) == [0, 3, 6]
+
+    def test_duplicate_labels(self):
+        qc = pd.DataFrame(
+            {"x": [1.0, 2.0, 3.0, 4.0]}, index=["a", "b", "a", "c"]
+        )._query_compiler
+        rows, _ = qc.get_positions_from_labels("a", slice(None))
+        assert list(rows) == [0, 2]
+
+    def test_partial_string_datetime(self):
+        idx = pandas.date_range("2021-01-30", periods=6, freq="D")
+        qc = pd.DataFrame({"x": np.arange(6.0)}, index=idx)._query_compiler
+        rows, _ = qc.get_positions_from_labels("2021-02", slice(None))
+        assert list(rows) == [2, 3, 4, 5]
+
+    def test_take_2d_labels_matches_loc(self, qc):
+        out = qc.take_2d_labels([20, 60], ["y"]).to_pandas()
+        assert list(out.index) == [20, 60]
+        assert list(out.columns) == ["y"]
+        assert list(out["y"]) == [2, 10]
+
+    def test_lookup(self, qc):
+        vals = qc.lookup([20, 40, 80], ["x", "y", "x"])
+        assert list(vals) == [1.0, 6.0, 7.0]
+
+
+class TestSetitemRouting:
+    def test_loc_scalar_set(self):
+        md, pdf = create_test_dfs({"a": np.arange(6.0), "b": np.arange(6) * 2})
+
+        def op(df):
+            df = df.copy()
+            df.loc[3, "a"] = 99.0
+            return df
+
+        eval_general(md, pdf, op)
+
+    def test_loc_array_set(self):
+        md, pdf = create_test_dfs({"a": np.arange(6.0), "b": np.arange(6) * 2})
+
+        def op(df):
+            df = df.copy()
+            df.loc[[1, 4], "b"] = np.array([-1, -2])
+            return df
+
+        eval_general(md, pdf, op)
+
+    def test_loc_slice_rows_all_cols(self):
+        md, pdf = create_test_dfs({"a": np.arange(6.0), "b": np.arange(6.0)})
+
+        def op(df):
+            df = df.copy()
+            df.loc[2:4] = 0.0
+            return df
+
+        eval_general(md, pdf, op)
+
+    def test_iloc_set(self):
+        md, pdf = create_test_dfs({"a": np.arange(6.0), "b": np.arange(6) * 2})
+
+        def op(df):
+            df = df.copy()
+            df.iloc[[0, 5], 1] = 7
+            return df
+
+        eval_general(md, pdf, op)
+
+        def op2(df):
+            df = df.copy()
+            df.iloc[1:3, :] = 0.5
+            return df
+
+        eval_general(md, pdf, op2)
+
+    def test_bool_mask_routes_setitem_bool(self, monkeypatch):
+        """df.loc[mask, col] = scalar is the reference's named-QC hot path
+        (indexing.py:954)."""
+        md, pdf = create_test_dfs({"a": np.arange(6.0), "b": np.arange(6.0)})
+        qc_cls = type(md._query_compiler)
+        calls = {"n": 0}
+        orig = qc_cls.setitem_bool
+
+        def spy(self, row_loc, col_loc, item):
+            calls["n"] += 1
+            return orig(self, row_loc, col_loc, item)
+
+        monkeypatch.setattr(qc_cls, "setitem_bool", spy)
+        md.loc[md["a"] > 2, "b"] = -5.0
+        pdf.loc[pdf["a"] > 2, "b"] = -5.0
+        assert calls["n"] == 1
+        df_equals(md, pdf)
+
+    def test_enlargement_still_correct(self):
+        md, pdf = create_test_dfs({"a": [1.0, 2.0]}, index=["x", "y"])
+
+        def op(df):
+            df = df.copy()
+            df.loc["z"] = 9.0
+            return df
+
+        eval_general(md, pdf, op)
+
+    def test_loc_set_aligned_series_value(self):
+        md, pdf = create_test_dfs({"a": np.arange(4.0), "b": np.arange(4.0)})
+        value = pandas.Series([10.0, 20.0], index=[2, 0])
+
+        def op(df):
+            df = df.copy()
+            df.loc[[0, 2], "a"] = value
+            return df
+
+        eval_general(md, pdf, op)
+
+
+class TestRowwiseQuery:
+    def test_query_routes_through_qc(self, monkeypatch):
+        md, pdf = create_test_dfs(
+            {"a": _rng.normal(size=50), "b": _rng.integers(0, 5, 50)}
+        )
+        qc_cls = type(md._query_compiler)
+        if not hasattr(qc_cls, "rowwise_query"):
+            pytest.skip("backend has no rowwise_query")
+        calls = {"n": 0}
+        orig = qc_cls.rowwise_query
+
+        def spy(self, expr, **kw):
+            calls["n"] += 1
+            return orig(self, expr, **kw)
+
+        monkeypatch.setattr(qc_cls, "rowwise_query", spy)
+        df_equals(md.query("a > 0 and b < 3"), pdf.query("a > 0 and b < 3"))
+        assert calls["n"] == 1
+
+    def test_query_local_variable(self):
+        md, pdf = create_test_dfs({"a": np.arange(20.0)})
+        lim = 12.5
+        df_equals(md.query("a > @lim"), pdf.query("a > @lim"))
+
+    def test_query_fallback_still_works(self):
+        md, pdf = create_test_dfs({"a": np.arange(10.0)})
+        eval_general(md, pdf, lambda df: df.query("index > 4"))
+
+
+class TestLocParityBreadth:
+    """Extra shapes from the reference indexing suite."""
+
+    def test_loc_bool_series_unalignable_raises(self):
+        md, pdf = create_test_dfs({"a": np.arange(4.0)})
+        mask = pandas.Series([True, False, True], index=[0, 1, 9])
+        eval_general(md, pdf, lambda df: df.loc[mask])
+
+    def test_loc_datetime_partial_string(self):
+        idx = pandas.date_range("2022-03-28", periods=10, freq="D")
+        md, pdf = create_test_dfs({"v": np.arange(10.0)}, index=idx)
+        eval_general(md, pdf, lambda df: df.loc["2022-04"])
+        eval_general(md, pdf, lambda df: df.loc["2022-03-29":"2022-04-02"])
+
+    def test_loc_duplicate_index_scalar(self):
+        md, pdf = create_test_dfs(
+            {"v": np.arange(5.0)}, index=["a", "b", "a", "c", "a"]
+        )
+        eval_general(md, pdf, lambda df: df.loc["a"])
+        eval_general(md, pdf, lambda df: df.loc["b"])
+
+    def test_loc_tuple_label_on_flat_index(self):
+        idx = pandas.Index([("a", 1), ("b", 2), ("c", 3)], tupleize_cols=False)
+        md, pdf = create_test_dfs({"v": [1.0, 2.0, 3.0]}, index=idx)
+        eval_general(md, pdf, lambda df: df.loc[[("b", 2)]])
+
+    def test_loc_empty_list(self):
+        md, pdf = create_test_dfs({"a": np.arange(4.0)})
+        eval_general(md, pdf, lambda df: df.loc[[]])
+
+    def test_loc_callable(self):
+        md, pdf = create_test_dfs({"a": np.arange(6.0), "b": np.arange(6.0)})
+        eval_general(md, pdf, lambda df: df.loc[lambda d: d["a"] > 2])
+
+    def test_loc_index_key_preserves_freq(self):
+        idx = pandas.date_range("2020-01-01", periods=8, freq="D")
+        md, pdf = create_test_dfs({"v": np.arange(8.0)}, index=idx)
+        key = idx[2:5]
+        m, p = md.loc[key], pdf.loc[key]
+        df_equals(m, p)
+        assert m.index.freq == p.index.freq
+
+
+class TestReviewRegressions:
+    """Shapes caught in round-4 review: over-squeeze of single-match partial
+    MultiIndex keys, level drops keyed to the wrong axis, and 1-D values
+    written into single-column positional selections."""
+
+    def test_partial_scalar_single_match_stays_frame(self):
+        mi = pandas.MultiIndex.from_tuples([("a", 1), ("b", 1), ("b", 2)])
+        md, pdf = create_test_dfs(
+            {"x": [1.0, 2, 3], "y": [4.0, 5, 6]}, index=mi
+        )
+        eval_general(md, pdf, lambda df: df.loc["a"])
+
+    def test_series_partial_tuple_single_match_stays_series(self):
+        mi = pandas.MultiIndex.from_tuples(
+            [("a", "b", 1), ("a", "c", 2), ("d", "e", 3)]
+        )
+        ps = pandas.Series([1.0, 2, 3], index=mi)
+        ms = pd.Series(ps)
+        eval_general(ms, ps, lambda s: s.loc[("a", "b")])
+        eval_general(ms, ps, lambda s: s.loc[("a", "b", 1)])
+
+    def test_mi_columns_partial_single_subcolumn_stays_frame(self):
+        cols = pandas.MultiIndex.from_tuples([("a", "p"), ("q", "r")])
+        data = [[1.0, 2.0], [3.0, 4.0]]
+        md = pd.DataFrame(data, columns=cols)
+        pdf = pandas.DataFrame(data, columns=cols)
+        eval_general(md, pdf, lambda df: df.loc[:, "a"])
+        eval_general(md, pdf, lambda df: df.loc[0, "a"])
+
+    def test_col_label_coinciding_with_row_level_value(self):
+        mi = pandas.MultiIndex.from_tuples([("v", 1), ("v", 2), ("w", 1)])
+        md, pdf = create_test_dfs(
+            {"v": [1.0, 2, 3], "z": [4.0, 5, 6]}, index=mi
+        )
+        eval_general(md, pdf, lambda df: df.loc[["v"], "v"])
+
+    def test_setitem_single_column_list_value(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3], "b": [4, 5, 6]})
+        def set_loc(df):
+            df = df.copy()
+            df.loc[:, "b"] = [7, 8, 9]
+            return df
+        def set_iloc(df):
+            df = df.copy()
+            df.iloc[:, 1] = [10, 11, 12]
+            return df
+        def set_subset(df):
+            df = df.copy()
+            df.iloc[[0, 2], 0] = [77, 88]
+            return df
+        def set_broadcast(df):
+            df = df.copy()
+            df.iloc[:, [0, 1]] = [1, 2]
+            return df
+        for op in (set_loc, set_iloc, set_subset, set_broadcast):
+            eval_general(md, pdf, op)
