@@ -63,6 +63,7 @@ ARITHMETIC_OPS = [
     ("mul", lambda df: df * 2.0),
     ("abs", lambda df: df.abs()),
     ("gt", lambda df: df > 50.0),
+    ("ewm_mean", lambda df: df.ewm(alpha=0.1).mean()),
 ]
 
 GROUPBY_OPS = [

@@ -6,6 +6,7 @@ from modin_tpu.core.dataframe.algebra.default2pandas.default import (  # noqa: F
     DataFrameDefault,
     DateTimeDefault,
     DefaultMethod,
+    EwmDefault,
     ExpandingDefault,
     GroupByDefault,
     ListDefault,

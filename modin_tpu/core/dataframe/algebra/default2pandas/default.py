@@ -242,6 +242,33 @@ class ExpandingDefault(DefaultMethod):
         return caller
 
 
+class EwmDefault(DefaultMethod):
+    """Defaults for exponentially-weighted-window aggregations
+    (reference modin/pandas/window.py ExponentialMovingWindow)."""
+
+    OBJECT_TYPE = "Ewm"
+
+    @classmethod
+    def register(cls, func: Union[str, Callable], squeeze_self: bool = False, **kw: Any) -> Callable:
+        fn_name = kw.get("fn_name") or (
+            func if isinstance(func, str) else getattr(func, "__name__", str(func))
+        )
+
+        def caller(
+            query_compiler: Any, ewm_kwargs: dict, *args: Any, **kwargs: Any
+        ) -> Any:
+            df = query_compiler.to_pandas()
+            if squeeze_self:
+                df = df.squeeze(axis=1)
+            ErrorMessage.default_to_pandas(f"`ExponentialMovingWindow.{fn_name}`")
+            roller = df.ewm(**ewm_kwargs)
+            fn = getattr(type(roller), func) if isinstance(func, str) else func
+            return cls.build_output(query_compiler, fn(roller, *args, **kwargs))
+
+        caller.__name__ = f"ewm_{fn_name}"
+        return caller
+
+
 class ResampleDefault(DefaultMethod):
     OBJECT_TYPE = "Resampler"
 

@@ -26,6 +26,7 @@ from modin_tpu.core.dataframe.algebra.default2pandas import (
     CatDefault,
     DataFrameDefault,
     DateTimeDefault,
+    EwmDefault,
     ExpandingDefault,
     GroupByDefault,
     ListDefault,
@@ -1258,6 +1259,8 @@ def _register_defaults() -> None:
     ]:
         setattr(BaseQueryCompiler, f"rolling_{name}", RollingDefault.register(name))
         setattr(BaseQueryCompiler, f"expanding_{name}", ExpandingDefault.register(name))
+    for name in ["mean", "sum", "var", "std", "corr", "cov", "aggregate"]:
+        setattr(BaseQueryCompiler, f"ewm_{name}", EwmDefault.register(name))
     for name in [
         "count", "sum", "mean", "median", "var", "std", "min", "max", "sem",
         "first", "last", "ohlc", "prod", "size", "nunique", "quantile",

@@ -1425,6 +1425,87 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
         return self._wrap_device_result(datas)
 
+    def _try_device_ewm(self, op: str, ewm_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        """Exponentially weighted windows as associative linear-recurrence
+        scans (ops/window.py ewm_reduce).  Reference surface:
+        modin/pandas/window.py ExponentialMovingWindow (per-block pandas);
+        times/method='table'/numeric_only and non-numeric frames fall back."""
+        from modin_tpu.ops.window import ewm_reduce
+
+        ek = dict(ewm_kwargs)
+        if ek.pop("times", None) is not None:
+            return None
+        if ek.pop("method", "single") != "single":
+            return None
+        com = ek.pop("com", None)
+        span = ek.pop("span", None)
+        halflife = ek.pop("halflife", None)
+        alpha = ek.pop("alpha", None)
+        adjust = ek.pop("adjust", True)
+        ignore_na = ek.pop("ignore_na", False)
+        min_periods = ek.pop("min_periods", 0)
+        if ek:
+            return None
+        if min_periods is None:
+            min_periods = 0
+        if (
+            isinstance(min_periods, bool)
+            or not isinstance(min_periods, (int, np.integer))
+            or min_periods < 0
+        ):
+            return None
+        if not isinstance(adjust, (bool, np.bool_)) or not isinstance(
+            ignore_na, (bool, np.bool_)
+        ):
+            return None
+        decay = [v for v in (com, span, halflife, alpha) if v is not None]
+        if len(decay) != 1 or isinstance(decay[0], bool) or not isinstance(
+            decay[0], (int, float, np.integer, np.floating)
+        ):
+            # zero/multiple decay params or a timedelta halflife: pandas
+            # raises the proper error on the fallback
+            return None
+        if com is not None:
+            if com < 0:
+                return None
+            a = 1.0 / (1.0 + float(com))
+        elif span is not None:
+            if span < 1:
+                return None
+            a = 2.0 / (float(span) + 1.0)
+        elif halflife is not None:
+            if halflife <= 0:
+                return None
+            a = 1.0 - float(np.exp(-np.log(2.0) / float(halflife)))
+        else:
+            if not 0 < alpha <= 1:
+                return None
+            a = float(alpha)
+        extra = dict(kwargs)
+        bias = extra.pop("bias", False) if op in ("var", "std") else False
+        if not isinstance(bias, (bool, np.bool_)):
+            return None
+        if extra.pop("numeric_only", False):
+            return None  # changes column selection: pandas fallback
+        for k in ("engine", "engine_kwargs"):
+            if k in extra and extra[k] is None:
+                extra.pop(k)
+        if extra:
+            return None
+        if op == "sum" and not adjust:
+            return None  # pandas raises NotImplementedError on the fallback
+        frame = self._modin_frame
+        if len(frame) == 0 or not all(
+            c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns
+        ):
+            return None
+        frame.materialize_device()
+        datas = ewm_reduce(
+            op, [c.data for c in frame._columns], len(frame), a, bool(adjust),
+            bool(ignore_na), int(min_periods), bool(bias),
+        )
+        return self._wrap_device_result(datas)
+
     def _try_device_resample(self, op: str, resample_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         """Fixed-frequency resample as time-bucket codes + segment aggregation.
 
@@ -2422,7 +2503,25 @@ def _make_expanding_override(op: str):
     return method
 
 
+def _make_ewm_override(op: str):
+    def method(self, ewm_kwargs: dict, *args: Any, **kwargs: Any):
+        result = (
+            self._try_device_ewm(op, ewm_kwargs, dict(kwargs))
+            if not args
+            else None
+        )
+        if result is not None:
+            return result
+        return getattr(super(TpuQueryCompiler, self), f"ewm_{op}")(
+            ewm_kwargs, *args, **kwargs
+        )
+
+    method.__name__ = f"ewm_{op}"
+    return method
+
+
 from modin_tpu.ops.window import (  # noqa: E402
+    EWM_DEVICE_OPS as _EWM_OPS,
     EXPANDING_DEVICE_OPS as _EXP_OPS,
     ROLLING_DEVICE_OPS as _ROLL_OPS,
 )
@@ -2431,6 +2530,8 @@ for _op in _ROLL_OPS:
     setattr(TpuQueryCompiler, f"rolling_{_op}", _make_rolling_override(_op))
 for _op in _EXP_OPS:
     setattr(TpuQueryCompiler, f"expanding_{_op}", _make_expanding_override(_op))
+for _op in _EWM_OPS:
+    setattr(TpuQueryCompiler, f"ewm_{_op}", _make_ewm_override(_op))
 for _op in RESAMPLE_DEVICE_OPS:
     setattr(TpuQueryCompiler, f"resample_{_op}", _make_resample_override(_op))
 

@@ -810,8 +810,33 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
 
         return Expanding(self, min_periods=min_periods, method=method)
 
-    def ewm(self, *args: Any, **kwargs: Any):
-        return self._default_to_pandas("ewm", *args, **kwargs)
+    def ewm(
+        self,
+        com: Any = None,
+        span: Any = None,
+        halflife: Any = None,
+        alpha: Any = None,
+        min_periods: Any = 0,
+        adjust: bool = True,
+        ignore_na: bool = False,
+        times: Any = None,
+        method: str = "single",
+    ):
+        from modin_tpu.pandas.window import Ewm
+        from modin_tpu.utils import try_cast_to_pandas
+
+        return Ewm(
+            self,
+            com=com,
+            span=span,
+            halflife=halflife,
+            alpha=alpha,
+            min_periods=min_periods,
+            adjust=adjust,
+            ignore_na=ignore_na,
+            times=try_cast_to_pandas(times, squeeze=True),
+            method=method,
+        )
 
     def resample(
         self,

@@ -122,6 +122,80 @@ for _name in [
     setattr(Expanding, _name, _make_exp(_name))
 
 
+@_inherit_docstrings(pandas.core.window.ewm.ExponentialMovingWindow)
+class Ewm(ClassLogger, modin_layer="PANDAS-API"):
+    """Lazy exponentially-weighted-window handle dispatching to ``ewm_*``
+    query-compiler methods (reference modin/pandas/window.py
+    ExponentialMovingWindow)."""
+
+    def __init__(self, dataframe: Any, **ewm_kwargs: Any) -> None:
+        self._dataframe = dataframe
+        self.ewm_kwargs = ewm_kwargs
+
+    @property
+    def _query_compiler(self):
+        return self._dataframe._query_compiler
+
+    _wrap = Rolling._wrap
+
+    def _agg(self, name: str, *args: Any, **kwargs: Any):
+        qc_method = getattr(self._query_compiler, f"ewm_{name}")
+        new_qc = qc_method(self.ewm_kwargs, *args, **kwargs)
+        return self._wrap(new_qc)
+
+    def mean(self, numeric_only: bool = False, engine: Any = None, engine_kwargs: Any = None):
+        return self._agg("mean", numeric_only=numeric_only, engine=engine, engine_kwargs=engine_kwargs)
+
+    def sum(self, numeric_only: bool = False, engine: Any = None, engine_kwargs: Any = None):
+        return self._agg("sum", numeric_only=numeric_only, engine=engine, engine_kwargs=engine_kwargs)
+
+    def var(self, bias: bool = False, numeric_only: bool = False):
+        return self._agg("var", bias=bias, numeric_only=numeric_only)
+
+    def std(self, bias: bool = False, numeric_only: bool = False):
+        return self._agg("std", bias=bias, numeric_only=numeric_only)
+
+    def corr(self, other: Any = None, pairwise: Any = None, numeric_only: bool = False):
+        from modin_tpu.utils import try_cast_to_pandas
+
+        return self._agg(
+            "corr", other=try_cast_to_pandas(other, squeeze=True),
+            pairwise=pairwise, numeric_only=numeric_only,
+        )
+
+    def cov(self, other: Any = None, pairwise: Any = None, bias: bool = False, numeric_only: bool = False):
+        from modin_tpu.utils import try_cast_to_pandas
+
+        return self._agg(
+            "cov", other=try_cast_to_pandas(other, squeeze=True),
+            pairwise=pairwise, bias=bias, numeric_only=numeric_only,
+        )
+
+    def aggregate(self, func: Any, *args: Any, **kwargs: Any):
+        return self._agg("aggregate", func, *args, **kwargs)
+
+    agg = aggregate
+
+    def __getattr__(self, name: str):
+        # anything beyond the implemented surface (online(), attribute
+        # introspection, future pandas additions) defaults to pandas; missing
+        # names raise like pandas would
+        if name.startswith("_") or not hasattr(
+            pandas.core.window.ewm.ExponentialMovingWindow, name
+        ):
+            raise AttributeError(name)
+        df = self._dataframe
+        ewm_kwargs = self.ewm_kwargs
+
+        def fallback(*args: Any, **kwargs: Any):
+            return df._default_to_pandas(
+                lambda obj: getattr(obj.ewm(**ewm_kwargs), name)(*args, **kwargs)
+            )
+
+        fallback.__name__ = name
+        return fallback
+
+
 class GroupByRolling(ClassLogger, modin_layer="PANDAS-API"):
     """Rolling over groupby groups (``df.groupby(...).rolling(...)``)."""
 

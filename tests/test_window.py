@@ -126,3 +126,120 @@ def test_rolling_inf_treated_as_missing():
 def test_rolling_ddof_on_non_var_raises():
     md, pdf = create_test_dfs({"a": [1.0, 2.0, 3.0, 4.0]})
     eval_general(md, pdf, lambda df: df.rolling(2).sum(ddof=2))
+
+
+# --------------------------------------------------------------------- #
+# Exponentially weighted windows (reference modin/pandas/window.py
+# ExponentialMovingWindow; modin/tests/pandas/test_rolling.py shapes)
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("agg", ["mean", "sum", "var", "std"])
+@pytest.mark.parametrize("adjust", [True, False])
+@pytest.mark.parametrize("ignore_na", [False, True])
+def test_ewm_aggs(dfs, agg, adjust, ignore_na):
+    if agg == "sum" and not adjust:
+        pytest.skip("pandas raises NotImplementedError for sum with adjust=False")
+    md, pdf = dfs
+    df_equals(
+        getattr(md.ewm(alpha=0.35, adjust=adjust, ignore_na=ignore_na), agg)(),
+        getattr(pdf.ewm(alpha=0.35, adjust=adjust, ignore_na=ignore_na), agg)(),
+    )
+
+
+@pytest.mark.parametrize("decay", [{"com": 2.5}, {"span": 9}, {"halflife": 4.0}, {"alpha": 0.08}])
+def test_ewm_decay_params(dfs, decay):
+    md, pdf = dfs
+    df_equals(md.ewm(**decay).mean(), pdf.ewm(**decay).mean())
+
+
+@pytest.mark.parametrize("min_periods", [0, 1, 6])
+def test_ewm_min_periods(dfs, min_periods):
+    md, pdf = dfs
+    df_equals(
+        md.ewm(span=5, min_periods=min_periods).mean(),
+        pdf.ewm(span=5, min_periods=min_periods).mean(),
+    )
+
+
+@pytest.mark.parametrize("bias", [False, True])
+def test_ewm_var_bias(dfs, bias):
+    md, pdf = dfs
+    df_equals(md.ewm(alpha=0.5).var(bias=bias), pdf.ewm(alpha=0.5).var(bias=bias))
+    df_equals(md.ewm(alpha=0.5).std(bias=bias), pdf.ewm(alpha=0.5).std(bias=bias))
+
+
+def test_ewm_series(dfs):
+    md, pdf = dfs
+    df_equals(md["b"].ewm(alpha=0.2).mean(), pdf["b"].ewm(alpha=0.2).mean())
+    df_equals(
+        md["b"].ewm(com=3, adjust=False).var(), pdf["b"].ewm(com=3, adjust=False).var()
+    )
+
+
+@pytest.mark.parametrize("agg", ["mean", "sum", "var", "std"])
+def test_ewm_device_no_fallback(dfs, agg):
+    md, pdf = dfs
+    got = _no_fallback(lambda: getattr(md.ewm(alpha=0.3, min_periods=2), agg)())
+    df_equals(got, getattr(pdf.ewm(alpha=0.3, min_periods=2), agg)())
+
+
+def test_ewm_sum_adjust_false_raises(dfs):
+    md, pdf = dfs
+    eval_general(md, pdf, lambda df: df.ewm(alpha=0.4, adjust=False).sum())
+
+
+def test_ewm_corr_cov_fallback(dfs):
+    md, pdf = dfs
+    df_equals(md.ewm(alpha=0.4).corr(), pdf.ewm(alpha=0.4).corr())
+    df_equals(md.ewm(alpha=0.4).cov(), pdf.ewm(alpha=0.4).cov())
+
+
+def test_ewm_times_falls_back_correct(dfs):
+    md, pdf = dfs
+    import pandas
+
+    times = pandas.date_range("2021-01-01", periods=len(pdf), freq="D")
+    df_equals(
+        md.ewm(halflife="2 days", times=times).mean(),
+        pdf.ewm(halflife="2 days", times=times).mean(),
+    )
+
+
+def test_ewm_all_nan_column():
+    md, pdf = create_test_dfs({"a": [np.nan] * 12, "b": np.arange(12.0)})
+    df_equals(md.ewm(alpha=0.6).mean(), pdf.ewm(alpha=0.6).mean())
+    df_equals(md.ewm(alpha=0.6, adjust=False).std(), pdf.ewm(alpha=0.6, adjust=False).std())
+
+
+def test_ewm_invalid_params(dfs):
+    md, pdf = dfs
+    eval_general(md, pdf, lambda df: df.ewm().mean())  # no decay param
+    eval_general(md, pdf, lambda df: df.ewm(alpha=0.3, com=2).mean())  # two
+    eval_general(md, pdf, lambda df: df.ewm(alpha=1.5).mean())  # out of range
+
+
+def test_ewm_alpha_one_carries_through_nans():
+    md, pdf = create_test_dfs({"a": [1.0, np.nan, 2.0, np.nan, np.nan]})
+    df_equals(md.ewm(alpha=1.0).mean(), pdf.ewm(alpha=1.0).mean())
+    df_equals(md.ewm(com=0).mean(), pdf.ewm(com=0).mean())
+
+
+def test_ewm_alpha_sweep_no_recompile(dfs):
+    # distinct alphas must reuse one compiled kernel (alpha is traced)
+    from modin_tpu.ops import window as w
+
+    md, pdf = dfs
+    md.ewm(alpha=0.11).mean()._query_compiler.execute()
+    before = w._jit_ewm.cache_info().currsize
+    for a in (0.22, 0.33, 0.44):
+        df_equals(md.ewm(alpha=a).mean(), pdf.ewm(alpha=a).mean())
+    assert w._jit_ewm.cache_info().currsize == before
+
+
+def test_ewm_aggregate_and_online():
+    md, pdf = create_test_dfs({"a": np.arange(10.0)})
+    eval_general(md, pdf, lambda df: df.ewm(alpha=0.3).aggregate("mean"))
+    eval_general(md, pdf, lambda df: df.ewm(alpha=0.3).agg(["mean", "std"]))
+    with pytest.raises(AttributeError):
+        md.ewm(alpha=0.3).not_a_real_method
