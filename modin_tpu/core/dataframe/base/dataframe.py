@@ -1,0 +1,130 @@
+"""Abstract core-frame contract.
+
+Reference: modin/core/dataframe/base/dataframe/dataframe.py:26
+(``ModinDataframe``) pins the dataframe-algebra surface every core frame
+must expose, independent of the partitioning substrate.  The tpu
+translation keeps the same role — one pluggable seam below the query
+compiler — but the algebra is adapted to the columnar sharded store:
+
+- the reference's 2-D block grid operators (``map``/``fold``/``reduce``
+  over partitions) do not appear here because fan-out IS compilation in
+  this design: one jitted kernel over whole device columns replaces a
+  partition sweep, so compute enters through the ``ops/`` kernel modules,
+  not through a frame method taking a Python callable;
+- what remains frame-shaped is the STRUCTURAL algebra — selection,
+  projection, masking, concatenation, relabeling — plus the host/device
+  materialization lifecycle, and that is the contract below.
+
+``TpuDataframe`` is the device implementation.  A hypothetical second
+storage format (e.g. an Arrow-backed host frame) would implement this same
+surface and slot under the existing query compilers unchanged.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Optional, Sequence
+
+import pandas
+
+
+class BaseDataframe(abc.ABC):
+    """The structural dataframe algebra + materialization lifecycle."""
+
+    # ---------------------------- construction ------------------------ #
+
+    @classmethod
+    @abc.abstractmethod
+    def from_pandas(cls, df: pandas.DataFrame) -> "BaseDataframe":
+        """Build a frame from host pandas data."""
+
+    @abc.abstractmethod
+    def to_pandas(self) -> pandas.DataFrame:
+        """Materialize the full frame on the host, bit-exact."""
+
+    @abc.abstractmethod
+    def to_numpy(self, **kwargs: Any) -> Any:
+        """Materialize the frame as a single 2-D ndarray."""
+
+    # ------------------------------ axes ------------------------------ #
+
+    @property
+    @abc.abstractmethod
+    def index(self) -> pandas.Index:
+        """Row labels (may force a lazily deferred index)."""
+
+    @property
+    @abc.abstractmethod
+    def columns(self) -> pandas.Index:
+        """Column labels."""
+
+    @property
+    @abc.abstractmethod
+    def dtypes(self) -> pandas.Series:
+        """Per-column pandas dtypes."""
+
+    @abc.abstractmethod
+    def __len__(self) -> int:
+        """Number of rows (never forces the index)."""
+
+    # ----------------------- structural algebra ----------------------- #
+    # selection/projection/masking/concat: the reference's
+    # take_2d_labels_or_positional + filter + concat rows
+    # (modin/core/dataframe/base/dataframe/dataframe.py:38,:278,:499),
+    # split into orthogonal primitives so lazy metadata survives each.
+
+    @abc.abstractmethod
+    def select_columns_by_position(
+        self, positions: Sequence[int]
+    ) -> "BaseDataframe":
+        """Projection: keep the columns at ``positions`` (order honored)."""
+
+    @abc.abstractmethod
+    def rename_columns(self, new_labels: pandas.Index) -> "BaseDataframe":
+        """Relabel columns without touching data."""
+
+    @abc.abstractmethod
+    def with_columns(
+        self, positions: Sequence[int], new_columns: Sequence[Any]
+    ) -> "BaseDataframe":
+        """Replace the columns at ``positions`` with ``new_columns``."""
+
+    @abc.abstractmethod
+    def take_rows_positional(self, positions: Any) -> "BaseDataframe":
+        """Selection: gather rows by position (slice, range, or array)."""
+
+    @abc.abstractmethod
+    def filter_rows_mask(self, mask: Any) -> "BaseDataframe":
+        """Selection: keep rows where ``mask`` is true."""
+
+    @abc.abstractmethod
+    def concat_rows(self, others: List["BaseDataframe"]) -> "BaseDataframe":
+        """Stack frames with identical column sets along axis 0."""
+
+    # ----------------------- materialization -------------------------- #
+
+    @abc.abstractmethod
+    def copy(self) -> "BaseDataframe":
+        """A frame sharing immutable column data (columns are replaced,
+        never mutated, so sharing is safe)."""
+
+    @abc.abstractmethod
+    def finalize(self) -> None:
+        """Force every deferred computation (lazy columns, deferred index)
+        so subsequent accesses are pure reads.  The reference's
+        ``ModinDataframe.finalize`` (dataframe.py:729)."""
+
+    @abc.abstractmethod
+    def free(self) -> None:
+        """Release device buffers (spill/teardown hook)."""
+
+
+def __getattr__(name: str) -> Any:  # pragma: no cover - import convenience
+    if name == "TpuDataframe":
+        from modin_tpu.core.dataframe.tpu.dataframe import TpuDataframe
+
+        return TpuDataframe
+    raise AttributeError(name)
+
+
+__all__ = ["BaseDataframe"]

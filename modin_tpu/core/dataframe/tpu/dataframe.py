@@ -29,6 +29,7 @@ from typing import Any, Callable, List, Optional, Sequence, Union
 import numpy as np
 import pandas
 
+from modin_tpu.core.dataframe.base.dataframe import BaseDataframe
 from modin_tpu.core.dataframe.tpu.metadata import LazyIndex, ensure_index
 from modin_tpu.logging import ClassLogger
 
@@ -197,8 +198,12 @@ class HostColumn:
 Column = Union[DeviceColumn, HostColumn]
 
 
-class TpuDataframe(ClassLogger, modin_layer="CORE-FRAME"):
-    """Columnar frame: host metadata + device/host column store."""
+class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
+    """Columnar frame: host metadata + device/host column store.
+
+    Implements the abstract structural algebra
+    (core/dataframe/base/dataframe.py BaseDataframe; reference
+    modin/core/dataframe/base/dataframe/dataframe.py:26)."""
 
     def __init__(
         self,

@@ -104,3 +104,11 @@ class TpuOnJaxIO(BaseIO):
         # chunk-streamed writer: bounded host memory instead of a full gather
         # (reference: per-partition write, parquet_dispatcher.py:912)
         return TpuParquetDispatcher.write(qc, path, **kwargs)
+
+    @classmethod
+    def to_csv(cls, qc: Any, path_or_buf: Any = None, **kwargs: Any):
+        return TpuCSVDispatcher.write(qc, path_or_buf, **kwargs)
+
+    @classmethod
+    def to_json(cls, qc: Any, path_or_buf: Any = None, **kwargs: Any):
+        return TpuJSONDispatcher.write(qc, path_or_buf, **kwargs)

@@ -165,8 +165,10 @@ class ParquetDispatcher(FileDispatcher):
                 writer.write_table(table)
                 return None
             for start in range(0, n_rows, _WRITE_CHUNK_ROWS):
+                # a slice keeps the gather on the device fast path (no
+                # materialized index list)
                 chunk_qc = qc.take_2d_positional(
-                    index=range(start, min(start + _WRITE_CHUNK_ROWS, n_rows))
+                    index=slice(start, min(start + _WRITE_CHUNK_ROWS, n_rows))
                 )
                 table = pa.Table.from_pandas(
                     chunk_qc.to_pandas(), preserve_index=preserve

@@ -17,6 +17,7 @@ single pandas parse — correct, just serial.
 from __future__ import annotations
 
 import io
+import re
 from typing import Any, List, Optional
 
 import numpy as np
@@ -82,6 +83,32 @@ class CSVDispatcher(FileDispatcher):
         return cls._read_gated(filepath_or_buffer, "filepath_or_buffer", kwargs)
 
     @classmethod
+    def write(cls, qc: Any, path_or_buf: Any = None, **kwargs: Any):
+        """Chunk-streamed ``to_csv``: per-window device fetch + append, so a
+        sharded frame writes with O(chunk) host memory instead of one full
+        gather (reference pattern: per-partition writes,
+        modin/core/io/column_stores/parquet_dispatcher.py:912)."""
+        if (
+            not appendable_local_path(path_or_buf, kwargs.get("compression", "infer"))
+            or kwargs.get("mode", "w") not in ("w", "wt")
+            or not _append_safe_encoding(kwargs.get("encoding"))
+            or qc._shape_hint == "column"  # Series.to_csv header semantics
+        ):
+            return serial_write(qc, "to_csv", path_or_buf, kwargs)
+        kwargs.pop("mode", None)
+        header = kwargs.pop("header", True)
+        first = True
+        for chunk_qc in iter_write_chunks(qc):
+            chunk_qc.to_pandas().to_csv(
+                path_or_buf,
+                mode="w" if first else "a",
+                header=header if first else False,
+                **kwargs,
+            )
+            first = False
+        return None
+
+    @classmethod
     def _read_fallback(cls, path: Any, kwargs: dict):
         df = cls.read_fn(path, **kwargs)
         if isinstance(df, pandas.DataFrame):
@@ -138,6 +165,60 @@ class CSVDispatcher(FileDispatcher):
         # from_pandas; column-wise concat keeps peak memory bounded)
         result = pandas.concat(frames, ignore_index=True, copy=False)
         return cls.query_compiler_cls.from_pandas(result, cls.frame_cls)
+
+
+_WRITE_CHUNK_ROWS = 4 << 20
+# encodings that are safe to reopen-and-append mid-stream; BOM-writing
+# codecs (utf-8-sig, utf-16/32) would emit a marker per chunk
+_APPEND_SAFE_ENCODINGS = {"utf8", "ascii", "latin1", "latin", "cp1252", "iso88591"}
+_URL_SCHEME_RE = re.compile(r"^[A-Za-z][A-Za-z0-9+.\-]*://")
+
+
+def _append_safe_encoding(encoding: Any) -> bool:
+    if encoding is None:
+        return True
+    return (
+        str(encoding).lower().replace("-", "").replace("_", "")
+        in _APPEND_SAFE_ENCODINGS
+    )
+
+
+def appendable_local_path(path: Any, compression: Any) -> bool:
+    """True when ``path`` can take per-chunk reopen-and-append writes: a
+    local (non-URL) string path that pandas will not route through a
+    compression codec (each append would start a new archive member)."""
+    if not isinstance(path, str) or _URL_SCHEME_RE.match(path):
+        return False
+    if compression not in (None, "infer"):
+        return False
+    if compression == "infer":
+        from pandas.io.common import infer_compression
+
+        # pandas' own inference: case-insensitive, includes .tar variants
+        if infer_compression(path, "infer") is not None:
+            return False
+    return True
+
+
+def iter_write_chunks(qc: Any):
+    """Row windows of ``qc`` as sliced compilers (device columns stay
+    sliced views; each ``to_pandas`` fetches O(chunk) host bytes)."""
+    n_rows = qc.get_axis_len(0)
+    for start in range(0, max(n_rows, 1), _WRITE_CHUNK_ROWS):
+        yield qc.take_2d_positional(
+            index=slice(start, min(start + _WRITE_CHUNK_ROWS, n_rows))
+        )
+
+
+def serial_write(qc: Any, method: str, path: Any, kwargs: dict):
+    """The one-gather fallback shared by every streamed writer."""
+    from modin_tpu.error_message import ErrorMessage
+
+    ErrorMessage.default_to_pandas(f"`{method}`")
+    df = qc.to_pandas()
+    if qc._shape_hint == "column":
+        df = df.squeeze(axis=1)
+    return getattr(df, method)(path, **kwargs)
 
 
 class TableDispatcher(CSVDispatcher):

@@ -100,3 +100,37 @@ class JSONDispatcher(FileDispatcher):
         frames = cls._parse_ranges_threaded(ranges, parse)
         result = pandas.concat(frames, ignore_index=True, copy=False)
         return cls.query_compiler_cls.from_pandas(result, cls.frame_cls)
+
+    @classmethod
+    def write(cls, qc, path_or_buf=None, **kwargs):
+        """Chunk-streamed ``to_json`` for the appendable form
+        (orient='records', lines=True — the same shape the parallel reader
+        splits on); everything else is a single pandas write.  Reference
+        pattern: per-partition writes,
+        modin/core/io/column_stores/parquet_dispatcher.py:912."""
+        from modin_tpu.core.io.text.csv_dispatcher import (
+            appendable_local_path,
+            iter_write_chunks,
+            serial_write,
+        )
+
+        streamable = (
+            appendable_local_path(path_or_buf, kwargs.get("compression", "infer"))
+            and kwargs.get("lines", False)
+            # orient must be EXPLICIT: lines=True without orient='records'
+            # raises in pandas, and the fallback reproduces that
+            and kwargs.get("orient") == "records"
+            and kwargs.get("mode", "w") == "w"
+            and qc._shape_hint != "column"  # Series records are bare values
+        )
+        if not streamable:
+            return serial_write(qc, "to_json", path_or_buf, kwargs)
+
+        kwargs.pop("mode", None)
+        first = True
+        for chunk_qc in iter_write_chunks(qc):
+            chunk_qc.to_pandas().to_json(
+                path_or_buf, mode="w" if first else "a", **kwargs
+            )
+            first = False
+        return None

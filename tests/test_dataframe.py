@@ -421,3 +421,30 @@ class TestMisc:
         for (mk, mv), (pk, pv) in zip(md.items(), pdf.items()):
             assert mk == pk
             df_equals(mv, pv)
+
+
+def test_core_frame_implements_abstract_contract():
+    """SURVEY #5: the structural-algebra ABC (reference
+    modin/core/dataframe/base/dataframe/dataframe.py:26) is real and
+    TpuDataframe satisfies it."""
+    from modin_tpu.core.dataframe.base.dataframe import BaseDataframe
+    from modin_tpu.core.dataframe.tpu.dataframe import TpuDataframe
+
+    assert issubclass(TpuDataframe, BaseDataframe)
+    abstract = {
+        name
+        for name in dir(BaseDataframe)
+        if getattr(getattr(BaseDataframe, name), "__isabstractmethod__", False)
+    }
+    assert {
+        "from_pandas", "to_pandas", "to_numpy", "select_columns_by_position",
+        "rename_columns", "with_columns", "take_rows_positional",
+        "filter_rows_mask", "concat_rows", "copy", "finalize", "free",
+    } <= abstract
+    assert not TpuDataframe.__abstractmethods__
+
+    class Partial(BaseDataframe):
+        pass
+
+    with pytest.raises(TypeError):
+        Partial()
