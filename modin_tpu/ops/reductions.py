@@ -35,17 +35,27 @@ def _valid_mask(c, n):
     return jnp.arange(c.shape[0]) < n
 
 
-def _reduce_one(op: str, c, n: int, skipna: bool, ddof: int, adaptive: bool = False):
+def _reduce_one(
+    op: str,
+    c,
+    n: int,
+    skipna: bool,
+    ddof: int,
+    adaptive: bool = False,
+    adaptive_sharded: bool = False,
+):
     """Reduce one padded column with logical length n.
 
     When the column is unpadded (shape == n, the common case: lengths that
     divide the shard count evenly), the validity iota-mask is skipped — on
     clean data that leaves a single fused pass over the column.
 
-    ``adaptive`` additionally enables the NaN-adaptive lax.cond fast path;
-    only valid on single-shard meshes (SPMD partitioning of lax.cond over
-    sharded operands produces wrong values — observed on the virtual CPU
-    mesh), which is exactly the single-chip bench topology where it matters.
+    ``adaptive`` additionally enables the NaN-adaptive lax.cond fast path on
+    single-shard meshes (a GLOBAL lax.cond over sharded operands miscompiles
+    under SPMD partitioning — observed on the virtual CPU mesh).
+    ``adaptive_sharded`` is the multi-shard formulation: the cond runs PER
+    SHARD inside shard_map, where its operands are local, and scalar
+    partials combine outside (see _reduce_adaptive_sharded).
     """
     import jax.numpy as jnp
 
@@ -53,6 +63,10 @@ def _reduce_one(op: str, c, n: int, skipna: bool, ddof: int, adaptive: bool = Fa
     unpadded = c.shape[0] == n
     if adaptive and unpadded and is_f and skipna and n > 0:
         fast = _reduce_clean_adaptive(op, c, n, ddof)
+        if fast is not None:
+            return fast
+    if adaptive_sharded and unpadded and is_f and skipna and n > 0:
+        fast = _reduce_adaptive_sharded(op, c, n)
         if fast is not None:
             return fast
     # unpadded columns (lengths dividing the shard count) elide the iota
@@ -234,6 +248,96 @@ def _reduce_clean_adaptive(op: str, c, n: int, ddof: int):
     return None
 
 
+_SHARDED_ADAPTIVE_OPS = ("sum", "prod", "count", "min", "max", "mean")
+
+
+def _reduce_adaptive_sharded(op: str, c, n: int):
+    """NaN-adaptive reduction on a row-sharded column.
+
+    The single-shard form's global ``lax.cond`` cannot be SPMD-partitioned
+    over sharded operands, so here the cond runs PER SHARD inside
+    ``shard_map`` — each branch sees only the shard's local block — and the
+    shards return (partial, nan_count) scalars that combine outside the
+    map.  Clean shards skip the isnan/where passes entirely; a NaN only
+    slows the shard that contains it.  The var/skew family keeps the masked
+    path when sharded: its two global passes (mean, then centered moments)
+    leave little for the adaptive branch to save.
+    """
+    import jax.lax as lax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from modin_tpu.parallel.mesh import get_mesh
+
+    if op not in _SHARDED_ADAPTIVE_OPS:
+        return None
+    mesh = get_mesh()
+    cnt_dtype = jnp.int32 if n < 2**31 else jnp.int64
+
+    def local(x):
+        def nan_count():
+            return jnp.sum(jnp.isnan(x), dtype=cnt_dtype).astype(jnp.int64)
+
+        def no_nans():
+            return jnp.zeros((), jnp.int64)
+
+        if op in ("sum", "prod"):
+            reducer = jnp.sum if op == "sum" else jnp.prod
+            neutral = jnp.asarray(0 if op == "sum" else 1, x.dtype)
+            s = reducer(x)
+            ms = lax.cond(
+                jnp.isnan(s),
+                lambda: reducer(jnp.where(jnp.isnan(x), neutral, x)),
+                lambda: s,
+            )
+            return ms[None], jnp.zeros((1,), jnp.int64)
+        if op == "count":
+            # one plain sum proves the shard is clean; inf-inf false
+            # positives only cost the slow branch, never correctness
+            s = jnp.sum(x)
+            nc = lax.cond(jnp.isnan(s), nan_count, no_nans)
+            return jnp.zeros((1,), x.dtype), nc[None]
+        if op in ("min", "max"):
+            reducer = jnp.min if op == "min" else jnp.max
+            neutral = jnp.asarray(jnp.inf if op == "min" else -jnp.inf, x.dtype)
+            r = reducer(x)
+            m, nc = lax.cond(
+                jnp.isnan(r),
+                lambda: (reducer(jnp.where(jnp.isnan(x), neutral, x)), nan_count()),
+                lambda: (r, jnp.zeros((), jnp.int64)),
+            )
+            return m[None], nc[None]
+        # mean: float64 accumulation, matching the masked path
+        x64 = x.astype(jnp.float64)
+        s = jnp.sum(x64)
+        ms, nc = lax.cond(
+            jnp.isnan(s),
+            lambda: (jnp.sum(jnp.where(jnp.isnan(x64), 0.0, x64)), nan_count()),
+            lambda: (s, jnp.zeros((), jnp.int64)),
+        )
+        return ms[None], nc[None]
+
+    partials, ncs = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P("rows"),
+        out_specs=(P("rows"), P("rows")),
+        check_vma=False,
+    )(c)
+    n_use = n - jnp.sum(ncs)
+    if op == "count":
+        return n_use.astype(jnp.int64)
+    if op == "sum":
+        return jnp.sum(partials)
+    if op == "prod":
+        return jnp.prod(partials)
+    if op == "mean":
+        return jnp.where(n_use == 0, jnp.nan, jnp.sum(partials) / n_use)
+    reducer = jnp.min if op == "min" else jnp.max
+    return jnp.where(n_use == 0, jnp.nan, reducer(partials))
+
+
 def _int_max(dtype):
     import jax.numpy as jnp
 
@@ -271,18 +375,26 @@ def reduce_columns(
     from modin_tpu.parallel.mesh import num_row_shards
 
     n, skipna, ddof = int(n), bool(skipna), int(ddof)
-    adaptive = num_row_shards() == 1
+    n_shards = num_row_shards()
+    adaptive = n_shards == 1
+    # shard-local adaptive form needs evenly-divided (unpadded) rows
+    adaptive_sharded = n_shards > 1 and n > 0 and n % n_shards == 0
 
     def tail(arrs):
         import jax.numpy as jnp
 
         if cast_bool:
             arrs = [a.astype(jnp.int64) if a.dtype == jnp.bool_ else a for a in arrs]
-        return tuple(_reduce_one(op_name, c, n, skipna, ddof, adaptive) for c in arrs)
+        return tuple(
+            _reduce_one(op_name, c, n, skipna, ddof, adaptive, adaptive_sharded)
+            for c in arrs
+        )
 
     results = run_fused(
         cols,
-        tail_key=("reduce", op_name, n, skipna, ddof, bool(cast_bool), adaptive),
+        # adaptive/adaptive_sharded are derived from (n, n_shards), so the
+        # shard count alone completes the cache key
+        tail_key=("reduce", op_name, n, skipna, ddof, bool(cast_bool), n_shards),
         tail_builder=tail,
     )
     return [np.asarray(r) for r in jax.device_get(results)]

@@ -93,3 +93,78 @@ def test_adaptive_segment_agg_matches_masked(agg, case):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(w), rtol=1e-12, equal_nan=True
         )
+
+
+class TestShardedAdaptive:
+    """Multi-shard NaN-adaptive form: the lax.cond runs per shard inside
+    shard_map (a global cond over sharded operands miscompiles under SPMD),
+    partials combine outside.  Runs on the suite's 8-device virtual mesh."""
+
+    SHARDED_OPS = ["sum", "prod", "count", "min", "max", "mean"]
+
+    @pytest.mark.parametrize("op", SHARDED_OPS)
+    @pytest.mark.parametrize(
+        "case", ["clean", "with_nans", "all_nan_shard", "all_nan", "one_nan"]
+    )
+    def test_matches_pandas_on_8_shards(self, op, case):
+        from modin_tpu.ops.reductions import _reduce_adaptive_sharded
+        from modin_tpu.parallel.mesh import num_row_shards, row_sharding
+
+        S = num_row_shards()
+        if S < 2:
+            pytest.skip("needs a multi-device mesh")
+        n = 16 * S
+        rng = np.random.default_rng(7)
+        values = rng.uniform(-10, 10, n)
+        if case == "with_nans":
+            values[rng.random(n) < 0.3] = np.nan
+        elif case == "all_nan_shard":
+            values[: n // S] = np.nan  # shard 0 entirely NaN
+        elif case == "all_nan":
+            values[:] = np.nan
+        elif case == "one_nan":
+            values[n // 2] = np.nan
+        c = jax.device_put(jax.numpy.asarray(values), row_sharding())
+        fn = jax.jit(lambda c: _reduce_adaptive_sharded(op, c, n))
+        got = np.asarray(fn(c))
+        expected = _pandas_ref(op, pandas.Series(values))
+        if isinstance(expected, float) and np.isnan(expected):
+            assert np.isnan(got), (op, case, got)
+        else:
+            np.testing.assert_allclose(
+                got, expected, rtol=1e-12, err_msg=f"{op} {case}"
+            )
+
+    def test_qc_reduction_takes_sharded_adaptive_path(self, monkeypatch):
+        """df.sum() on an evenly-sharded float frame must route through the
+        shard_map formulation (and agree with pandas)."""
+        import modin_tpu.ops.reductions as red
+        from modin_tpu.parallel.mesh import num_row_shards
+
+        if num_row_shards() < 2:
+            pytest.skip("needs a multi-device mesh")
+        import modin_tpu.pandas as pd
+
+        calls = []
+        orig = red._reduce_adaptive_sharded
+
+        def spy(op, c, n):
+            out = orig(op, c, n)
+            if out is not None:
+                calls.append(op)
+            return out
+
+        monkeypatch.setattr(red, "_reduce_adaptive_sharded", spy)
+        # the spy fires at TRACE time; drop the fused-program cache so a
+        # same-fingerprint reduction from an earlier test cannot skip it
+        from modin_tpu.ops import lazy
+
+        lazy._FUSED_CACHE.clear()
+        n = 64 * num_row_shards()
+        vals = np.random.default_rng(3).normal(size=n)
+        vals[5] = np.nan
+        md = pd.DataFrame({"a": vals})
+        got = md.sum()._to_pandas()
+        want = pandas.DataFrame({"a": vals}).sum()
+        assert calls, "sharded adaptive path not taken"
+        np.testing.assert_allclose(got.to_numpy(), want.to_numpy(), rtol=1e-12)
