@@ -1,0 +1,1 @@
+"""IO benchmarks (reference asv_bench/benchmarks/io/)."""

@@ -1,0 +1,78 @@
+"""CSV io benchmarks mirroring the reference suite
+(asv_bench/benchmarks/io/csv.py: TimeReadCsvSkiprows,
+TimeReadCsvTrueFalseValues, TimeReadCsvNamesDtype) plus the streamed
+writer.  Data files are generated once into a temp dir."""
+
+import numpy as np
+
+from ..utils import IO_SHAPES, execute, io_data_dir, make_frame, pd, prepare_csv
+
+
+class TimeReadCsvSkiprows:
+    param_names = ["shape", "skiprows"]
+    params = [IO_SHAPES, [None, "lambda_even_rows", "range_uniform", "range_step2"]]
+
+    def setup(self, shape, skiprows):
+        self.path = prepare_csv(io_data_dir(), "skiprows", shape, "str_int")
+        rows = shape[0]
+        self.skiprows = {
+            None: None,
+            "lambda_even_rows": lambda x: x % 2,
+            "range_uniform": np.arange(1, rows // 10),
+            "range_step2": np.arange(1, rows, 2),
+        }[skiprows]
+
+    def time_skiprows(self, shape, skiprows):
+        execute(pd.read_csv(self.path, skiprows=self.skiprows))
+
+
+class TimeReadCsvTrueFalseValues:
+    param_names = ["shape"]
+    params = [IO_SHAPES]
+
+    def setup(self, shape):
+        self.path = prepare_csv(io_data_dir(), "tfv", shape, "true_false_int")
+
+    def time_true_false_values(self, shape):
+        execute(
+            pd.read_csv(
+                self.path,
+                true_values=["Yes", "true"],
+                false_values=["No", "false"],
+            )
+        )
+
+
+class TimeReadCsvNamesDtype:
+    param_names = ["shape", "dtype"]
+    params = [IO_SHAPES, ["Int64", "Int64_Timestamp"]]
+
+    def setup(self, shape, dtype):
+        kind = "int" if dtype == "Int64" else "int_timestamp"
+        self.path = prepare_csv(io_data_dir(), "names", shape, kind)
+        cols = shape[1]
+        self.names = [f"c{i}" for i in range(cols)]
+        if dtype == "Int64":
+            self.dtype = {f"c{i}": "Int64" for i in range(cols)}
+            self.parse_dates = None
+        else:
+            self.dtype = {f"c{i}": "Int64" for i in range(2, cols)}
+            self.parse_dates = ["c0", "c1"]
+
+    def time_names_dtype(self, shape, dtype):
+        kwargs = dict(names=self.names, dtype=self.dtype, skiprows=1)
+        if self.parse_dates:
+            kwargs["parse_dates"] = self.parse_dates
+        execute(pd.read_csv(self.path, **kwargs))
+
+
+class TimeToCsv:
+    param_names = ["shape"]
+    params = [IO_SHAPES]
+
+    def setup(self, shape):
+        self.df = make_frame(shape, seed=1)
+        execute(self.df)
+
+    def time_to_csv(self, shape):
+        self.df.to_csv(f"{io_data_dir()}/out.csv")

@@ -1,0 +1,49 @@
+"""Parquet io benchmarks mirroring the reference suite
+(asv_bench/benchmarks/io/parquet.py: TimeReadParquet) plus the
+chunk-streamed writer.  The read file is written with multiple row
+groups so the row-group-parallel path is what gets measured."""
+
+import numpy as np
+
+from ..utils import IO_SHAPES, execute, io_data_dir, make_frame, pd
+
+
+def _prepare_parquet(shape, n_groups=8, seed=0):
+    rows, cols = shape
+    path = f"{io_data_dir()}/read_{rows}x{cols}.parquet"
+    import os
+
+    if os.path.exists(path):
+        return path
+    import pandas
+
+    rng = np.random.default_rng(seed)
+    data = {f"col{i}": rng.integers(0, 100, rows) for i in range(cols)}
+    data["col_s"] = rng.choice(["alpha", "beta", "gamma"], rows)
+    pandas.DataFrame(data).to_parquet(
+        path, row_group_size=max(rows // n_groups, 1)
+    )
+    return path
+
+
+class TimeReadParquet:
+    param_names = ["shape"]
+    params = [IO_SHAPES]
+
+    def setup(self, shape):
+        self.path = _prepare_parquet(shape)
+
+    def time_read_parquet(self, shape):
+        execute(pd.read_parquet(self.path))
+
+
+class TimeToParquet:
+    param_names = ["shape"]
+    params = [IO_SHAPES]
+
+    def setup(self, shape):
+        self.df = make_frame(shape, seed=1)
+        execute(self.df)
+
+    def time_to_parquet(self, shape):
+        self.df.to_parquet(f"{io_data_dir()}/out.parquet")

@@ -1,0 +1,1 @@
+"""Scalability benchmarks (reference asv_bench/benchmarks/scalability/)."""

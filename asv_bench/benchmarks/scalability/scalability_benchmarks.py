@@ -1,0 +1,50 @@
+"""Host<->device boundary benchmarks mirroring the reference suite
+(asv_bench/benchmarks/scalability/scalability_benchmarks.py:
+TimeFromPandas/TimeToPandas/TimeToNumPy).  The reference varies worker
+cpus; here the boundary is the host->HBM upload / gather, so the knob is
+the frame shape only (the mesh is fixed for the process)."""
+
+from ..utils import UNARY_SHAPES, execute, make_frame, pd
+
+
+def _host_frame(shape, seed=0):
+    df = make_frame(shape, seed=seed)
+    return df._to_pandas() if hasattr(df, "_to_pandas") else df
+
+
+class TimeFromPandas:
+    param_names = ["shape"]
+    params = [UNARY_SHAPES]
+
+    def setup(self, shape):
+        self.data = _host_frame(shape)
+        pd.DataFrame([])  # engine init outside the timed region
+
+    def time_from_pandas(self, shape):
+        execute(pd.DataFrame(self.data))
+
+
+class TimeToPandas:
+    param_names = ["shape"]
+    params = [UNARY_SHAPES]
+
+    def setup(self, shape):
+        self.df = make_frame(shape)
+        execute(self.df)
+
+    def time_to_pandas(self, shape):
+        # a no-op copy on the pandas baseline keeps the A/B comparable
+        df = self.df
+        df._to_pandas() if hasattr(df, "_to_pandas") else df.copy()
+
+
+class TimeToNumPy:
+    param_names = ["shape"]
+    params = [UNARY_SHAPES]
+
+    def setup(self, shape):
+        self.df = make_frame(shape)
+        execute(self.df)
+
+    def time_to_numpy(self, shape):
+        self.df.to_numpy()

@@ -48,3 +48,59 @@ def execute(obj):
         qc.execute()
         return obj
     return obj
+
+
+# IO shape profiles (reference: asv_bench/benchmarks/utils/data_shapes.py —
+# the io suite reads one (rows, cols) profile per size)
+IO_SHAPES = {
+    "Small": [(10_000, 10)],
+    "Big": [(1_000_000, 10)],
+}[DATASET_SIZE]
+
+
+def io_data_dir() -> str:
+    """Deterministic per-user scratch dir so generated io files are reused
+    across benchmark runs instead of orphaned per-process tempdirs."""
+    import getpass
+    import pathlib
+    import tempfile
+
+    d = (
+        pathlib.Path(tempfile.gettempdir())
+        / f"modin_tpu_asv_{getpass.getuser()}"
+    )
+    d.mkdir(parents=True, exist_ok=True)
+    return str(d)
+
+
+def prepare_csv(tmp_dir, name, shape, kind="int", seed=0):
+    """Write (once) and return a csv path for the io benchmarks.
+
+    kind: 'int' | 'str_int' (every 3rd column short strings) |
+    'true_false_int' (every 3rd column Yes/No/true/false) |
+    'int_timestamp' (two ms-resolution datetime columns).
+    """
+    import pathlib
+
+    rows, cols = shape
+    path = pathlib.Path(tmp_dir) / f"{name}_{rows}x{cols}_{kind}.csv"
+    if path.exists():
+        return str(path)
+    rng = np.random.default_rng(seed)
+    import pandas
+
+    data = {}
+    for i in range(cols):
+        if kind == "str_int" and i % 3 == 2:
+            data[f"col{i}"] = rng.choice(["alpha", "beta", "gamma-delta"], rows)
+        elif kind == "true_false_int" and i % 3 == 2:
+            data[f"col{i}"] = rng.choice(["Yes", "No", "true", "false"], rows)
+        else:
+            data[f"col{i}"] = rng.integers(0, 100, rows)
+    df = pandas.DataFrame(data)
+    if kind == "int_timestamp":
+        stamp = pandas.date_range("2000", periods=rows, freq="ms")
+        df["col0"] = stamp
+        df["col1"] = stamp
+    df.to_csv(path, index=False)
+    return str(path)

@@ -9,10 +9,22 @@ import sys
 import time
 
 from benchmarks import benchmarks
+from benchmarks.io import csv as io_csv
+from benchmarks.io import parquet as io_parquet
+from benchmarks.scalability import scalability_benchmarks
+
+_MODULES = [benchmarks, io_csv, io_parquet, scalability_benchmarks]
+
+
+def _classes():
+    for mod in _MODULES:
+        for name, cls in inspect.getmembers(mod, inspect.isclass):
+            if cls.__module__ == mod.__name__:
+                yield name, cls
 
 
 def run(pattern: str = "") -> None:
-    for name, cls in inspect.getmembers(benchmarks, inspect.isclass):
+    for name, cls in _classes():
         if not name.startswith("Time") or pattern not in name:
             continue
         params = getattr(cls, "params", [[None]])

@@ -255,6 +255,23 @@ class DataFrameGroupBy(ClassLogger, modin_layer="PANDAS-API"):
     def ohlc(self):
         return self._groupby_agg("ohlc")
 
+    def describe(self, percentiles: Any = None, include: Any = None, exclude: Any = None):
+        return self._groupby_agg(
+            "describe",
+            agg_kwargs={
+                "percentiles": percentiles, "include": include, "exclude": exclude,
+            },
+        )
+
+    def corrwith(self, other: Any, drop: bool = False, method: str = "pearson", numeric_only: bool = False):
+        return self._groupby_agg(
+            "corrwith",
+            agg_kwargs={
+                "other": try_cast_to_pandas(other), "drop": drop,
+                "method": method, "numeric_only": numeric_only,
+            },
+        )
+
     def corr(self, method: str = "pearson", min_periods: int = 1, numeric_only: bool = False):
         return self._groupby_agg("corr", agg_kwargs={"method": method, "min_periods": min_periods, "numeric_only": numeric_only})
 

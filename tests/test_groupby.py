@@ -532,3 +532,20 @@ class TestRowShapedCallablesBypassShuffle:
                 lambda g: g - g.mean()
             ),
         )
+
+
+def test_groupby_describe_and_corrwith():
+    rng = np.random.default_rng(13)
+    n = 200
+    data = {
+        "k": rng.integers(0, 5, n),
+        "v": rng.normal(size=n),
+        "w": rng.normal(size=n),
+    }
+    md, pdf = create_test_dfs(data)
+    eval_general(md, pdf, lambda df: df.groupby("k").describe())
+    eval_general(md, pdf, lambda df: df.groupby("k")["v"].describe())
+    other = pdf[["v", "w"]] * 2
+    eval_general(
+        md, pdf, lambda df: df.groupby("k")[["v", "w"]].corrwith(other)
+    )
