@@ -113,13 +113,14 @@ for _name in (
 def _make_default_writer(method_name: str):
     @classmethod
     def writer(cls, qc: BaseQueryCompiler, **kwargs: Any) -> Any:
+        from modin_tpu.utils import qc_to_pandas_for_write
+
         ErrorMessage.default_to_pandas(f"`{method_name}`")
-        df = qc.to_pandas()
-        if qc._shape_hint == "column":
-            obj = df.squeeze(axis=1)
-            if hasattr(obj, method_name):
-                return getattr(obj, method_name)(**kwargs)
-        return getattr(df, method_name)(**kwargs)
+        obj = qc_to_pandas_for_write(qc)
+        if not hasattr(obj, method_name):
+            # frame-only writer driven from a Series-shaped compiler
+            obj = qc.to_pandas()
+        return getattr(obj, method_name)(**kwargs)
 
     writer.__func__.__name__ = method_name
     return writer

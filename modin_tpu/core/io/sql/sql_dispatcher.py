@@ -87,7 +87,10 @@ class SQLDispatcher(FileDispatcher):
 
     @classmethod
     def write(cls, qc: Any, name: str, con: Any, **kwargs: Any):
-        df = qc.to_pandas()
+        from modin_tpu.utils import qc_to_pandas_for_write
+
+        # Series-shaped compilers write with Series.to_sql column naming
+        df = qc_to_pandas_for_write(qc)
         if isinstance(con, ModinDatabaseConnection):
             connection = con.get_connection()
             try:

@@ -213,12 +213,10 @@ def iter_write_chunks(qc: Any):
 def serial_write(qc: Any, method: str, path: Any, kwargs: dict):
     """The one-gather fallback shared by every streamed writer."""
     from modin_tpu.error_message import ErrorMessage
+    from modin_tpu.utils import qc_to_pandas_for_write
 
     ErrorMessage.default_to_pandas(f"`{method}`")
-    df = qc.to_pandas()
-    if qc._shape_hint == "column":
-        df = df.squeeze(axis=1)
-    return getattr(df, method)(path, **kwargs)
+    return getattr(qc_to_pandas_for_write(qc), method)(path, **kwargs)
 
 
 class TableDispatcher(CSVDispatcher):

@@ -954,6 +954,27 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
                 final_qc = new_qc.reindex(axis=1, labels=columns, **kwargs)
         return self._create_or_update_from_compiler(final_qc)
 
+    def reindex_like(
+        self,
+        other: Any,
+        method: Any = None,
+        copy: Any = no_default,
+        limit: Any = None,
+        tolerance: Any = None,
+    ):
+        kwargs: dict = {}
+        if method is not None:
+            kwargs["method"] = method
+        if limit is not None:
+            kwargs["limit"] = limit
+        if tolerance is not None:
+            kwargs["tolerance"] = tolerance
+        return self.reindex(
+            index=other.index,
+            columns=other.columns if self.ndim == 2 else None,
+            **kwargs,
+        )
+
     def rename_axis(
         self,
         mapper: Any = no_default,
@@ -1204,6 +1225,13 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
         )
 
         return FactoryDispatcher.to_json(self._query_compiler, path_or_buf=path_or_buf, **kwargs)
+
+    def to_sql(self, name: str, con: Any, **kwargs: Any):
+        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+            FactoryDispatcher,
+        )
+
+        return FactoryDispatcher.to_sql(self._query_compiler, name=name, con=con, **kwargs)
 
     def to_pickle(self, path: Any, **kwargs: Any):
         from modin_tpu.core.execution.dispatching.factories.dispatcher import (

@@ -856,23 +856,40 @@ class DataFrame(BasePandasDataset):
         return FactoryDispatcher.to_parquet(self._query_compiler, path=path, **kwargs)
 
     def to_feather(self, path: Any, **kwargs: Any):
-        return self._default_to_pandas("to_feather", path, **kwargs)
+        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+            FactoryDispatcher,
+        )
+
+        return FactoryDispatcher.to_feather(self._query_compiler, path=path, **kwargs)
 
     def to_orc(self, path: Any = None, **kwargs: Any):
-        return self._default_to_pandas("to_orc", path, **kwargs)
+        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+            FactoryDispatcher,
+        )
+
+        return FactoryDispatcher.to_orc(self._query_compiler, path=path, **kwargs)
+
+    def to_stata(self, path: Any, **kwargs: Any):
+        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+            FactoryDispatcher,
+        )
+
+        return FactoryDispatcher.to_stata(self._query_compiler, path=path, **kwargs)
+
+    def to_xml(self, path_or_buffer: Any = None, **kwargs: Any):
+        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+            FactoryDispatcher,
+        )
+
+        return FactoryDispatcher.to_xml(
+            self._query_compiler, path_or_buffer=path_or_buffer, **kwargs
+        )
 
     def to_records(self, *args: Any, **kwargs: Any):
         return self._default_to_pandas("to_records", *args, **kwargs)
 
     def to_html(self, *args: Any, **kwargs: Any):
         return self._default_to_pandas("to_html", *args, **kwargs)
-
-    def to_sql(self, name: str, con: Any, **kwargs: Any):
-        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
-            FactoryDispatcher,
-        )
-
-        return FactoryDispatcher.to_sql(self._query_compiler, name=name, con=con, **kwargs)
 
     # ------------------------------------------------------------------ #
     # Plotting & accessors

@@ -622,9 +622,6 @@ class Series(BasePandasDataset):
     # IO
     # ------------------------------------------------------------------ #
 
-    def to_csv(self, path_or_buf: Any = None, **kwargs: Any):
-        return self._default_to_pandas("to_csv", path_or_buf, **kwargs)
-
     def __divmod__(self, other: Any):
         return self.divmod(other)
 

@@ -285,6 +285,19 @@ def wrap_into_list(*args: Any, skipna: bool = True) -> List[Any]:
     return res
 
 
+def qc_to_pandas_for_write(qc: Any) -> Any:
+    """Materialize a query compiler for a writer: Series-shaped compilers
+    squeeze and shed the internal unnamed-column sentinel (pandas would
+    otherwise emit ``__reduced__`` as the column/header name)."""
+    df = qc.to_pandas()
+    if getattr(qc, "_shape_hint", None) == "column":
+        obj = df.squeeze(axis=1)
+        if isinstance(obj, pandas.Series) and obj.name == MODIN_UNNAMED_SERIES_LABEL:
+            obj.name = None
+        return obj
+    return df
+
+
 def try_cast_to_pandas(obj: Any, squeeze: bool = False) -> Any:
     """Recursively convert modin_tpu objects inside ``obj`` to plain pandas."""
     if hasattr(obj, "_to_pandas"):

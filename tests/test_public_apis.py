@@ -186,3 +186,110 @@ class TestFuzzydata:
 
         trace = run_workflow(seed=123, steps=6)
         assert len(trace) == 6
+
+
+class TestFallbackResidue:
+    """VERDICT r3 #10: every generated API fallback should reach a NAMED QC
+    method; the residue is pinned here so it can only shrink."""
+
+    ALLOWED_DF = {"to_iceberg"}  # needs pyiceberg; no QC value in routing
+    ALLOWED_SERIES = {"hist", "info", "sparse"}  # display/accessor-only
+
+    @staticmethod
+    def _residue(pandas_cls, modin_cls, routes):
+        import inspect
+
+        from modin_tpu.core.storage_formats.base.query_compiler import (
+            BaseQueryCompiler,
+        )
+
+        out = set()
+        for name in dir(modin_cls):
+            if name.startswith("_"):
+                continue
+            raw = inspect.getattr_static(modin_cls, name)
+            wrapped = getattr(raw, "__wrapped__", None)
+            if wrapped is None or getattr(pandas_cls, name, None) is not wrapped:
+                continue  # explicit implementation, not a generated fallback
+            qc_name = routes.get(name)
+            qc_m = getattr(BaseQueryCompiler, qc_name, None) if qc_name else None
+            if qc_m is None or not getattr(
+                qc_m, "_pandas_signature_default", False
+            ):
+                out.add(name)
+        return out
+
+    def test_dataframe_residue_pinned(self):
+        from modin_tpu.core.storage_formats.base.query_compiler import (
+            DATAFRAME_QC_ROUTES,
+        )
+        from modin_tpu.pandas.dataframe import DataFrame
+
+        residue = self._residue(pandas.DataFrame, DataFrame, DATAFRAME_QC_ROUTES)
+        assert residue <= self.ALLOWED_DF, f"new unrouted fallbacks: {residue - self.ALLOWED_DF}"
+
+    def test_series_residue_pinned(self):
+        from modin_tpu.core.storage_formats.base.query_compiler import (
+            SERIES_QC_ROUTES,
+        )
+        from modin_tpu.pandas.series import Series
+
+        residue = self._residue(pandas.Series, Series, SERIES_QC_ROUTES)
+        assert residue <= self.ALLOWED_SERIES, f"new unrouted fallbacks: {residue - self.ALLOWED_SERIES}"
+
+
+class TestWriterWiring:
+    def test_reindex_like(self):
+        from tests.utils import create_test_dfs, eval_general
+
+        md, pdf = create_test_dfs({"a": [1.0, 2, 3], "b": [4.0, 5, 6]})
+        other = pandas.DataFrame({"a": [0.0, 0.0], "c": [0.0, 0.0]}, index=[1, 9])
+        eval_general(md, pdf, lambda df: df.reindex_like(other))
+        eval_general(md["a"], pdf["a"], lambda s: s.reindex_like(other["a"]))
+
+    def test_to_stata_roundtrip(self, tmp_path):
+        from tests.utils import create_test_dfs
+
+        md, pdf = create_test_dfs({"a": [1.0, 2, 3], "b": [4, 5, 6]})
+        mp_, pp = tmp_path / "m.dta", tmp_path / "p.dta"
+        md.to_stata(str(mp_), time_stamp=pandas.Timestamp("2020-01-01"))
+        pdf.to_stata(str(pp), time_stamp=pandas.Timestamp("2020-01-01"))
+        pandas.testing.assert_frame_equal(
+            pandas.read_stata(mp_), pandas.read_stata(pp)
+        )
+
+    def test_to_xml_identical(self):
+        from tests.utils import create_test_dfs
+
+        md, pdf = create_test_dfs({"a": [1, 2], "b": ["x", "y"]})
+        try:
+            want = pdf.to_xml()
+        except ImportError:
+            pytest.skip("no xml writer backend installed")
+        assert md.to_xml() == want
+
+    def test_series_to_csv_and_sql(self, tmp_path):
+        import sqlite3
+
+        from tests.utils import create_test_dfs
+
+        md, pdf = create_test_dfs({"v": [1.5, 2.5, 3.5]})
+        ms, ps = md["v"], pdf["v"]
+        assert ms.to_csv() == ps.to_csv()
+        # UNNAMED series: pandas emits header/column '0', never the internal
+        # unnamed-column sentinel
+        mu = ms.rename(None)
+        pu = ps.rename(None)
+        assert mu.to_csv() == pu.to_csv()
+        mdb, pdb = tmp_path / "m.db", tmp_path / "p.db"
+        with sqlite3.connect(mdb) as c:
+            ms.to_sql("t", c, index=False)
+            mu.to_sql("u", c, index=False)
+        with sqlite3.connect(pdb) as c:
+            ps.to_sql("t", c, index=False)
+            pu.to_sql("u", c, index=False)
+        with sqlite3.connect(mdb) as c1, sqlite3.connect(pdb) as c2:
+            for table in ("t", "u"):
+                got = pandas.read_sql(f"SELECT * FROM {table}", c1)
+                want = pandas.read_sql(f"SELECT * FROM {table}", c2)
+                pandas.testing.assert_frame_equal(got, want)
