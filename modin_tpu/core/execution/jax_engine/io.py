@@ -112,3 +112,7 @@ class TpuOnJaxIO(BaseIO):
     @classmethod
     def to_json(cls, qc: Any, path_or_buf: Any = None, **kwargs: Any):
         return TpuJSONDispatcher.write(qc, path_or_buf, **kwargs)
+
+    @classmethod
+    def to_feather(cls, qc: Any, path: Any = None, **kwargs: Any):
+        return TpuFeatherDispatcher.write(qc, path, **kwargs)

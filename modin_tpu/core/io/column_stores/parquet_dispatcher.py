@@ -164,18 +164,22 @@ class ParquetDispatcher(FileDispatcher):
                 writer = pq.ParquetWriter(path, table.schema, compression=compression)
                 writer.write_table(table)
                 return None
+            schema = None
             for start in range(0, n_rows, _WRITE_CHUNK_ROWS):
                 # a slice keeps the gather on the device fast path (no
                 # materialized index list)
                 chunk_qc = qc.take_2d_positional(
                     index=slice(start, min(start + _WRITE_CHUNK_ROWS, n_rows))
                 )
+                # pin the first window's schema: a later all-null window
+                # would otherwise infer a mismatching (null) column type
                 table = pa.Table.from_pandas(
-                    chunk_qc.to_pandas(), preserve_index=preserve
+                    chunk_qc.to_pandas(), preserve_index=preserve, schema=schema
                 )
                 if writer is None:
+                    schema = table.schema
                     writer = pq.ParquetWriter(
-                        path, table.schema, compression=compression
+                        path, schema, compression=compression
                     )
                 writer.write_table(table)
         finally:
@@ -185,7 +189,113 @@ class ParquetDispatcher(FileDispatcher):
 
 
 class FeatherDispatcher(FileDispatcher):
+    """Feather v2 is the Arrow IPC file format: the unit of parallelism is
+    the RECORD BATCH, the column-store analogue of a parquet row group
+    (reference serial read: modin/core/io/column_stores/feather_dispatcher.py:26)."""
+
     @classmethod
     def _read(cls, path: Any = None, columns: Optional[List] = None, **kwargs: Any):
-        df = pandas.read_feather(cls.get_path(path) if isinstance(path, str) else path, columns=columns, **kwargs)
+        use_threads = kwargs.pop("use_threads", True)
+        # the frontend reader binds every signature default, so filter
+        # defaulted kwargs like the parquet path does
+        extra = {
+            k: v
+            for k, v in kwargs.items()
+            if v is not None
+            and not (k == "dtype_backend" and v is pandas.api.extensions.no_default)
+        }
+        if not extra and use_threads is True and isinstance(path, str):
+            try:
+                df = cls._read_ipc_batch_parallel(cls.get_path(path), columns)
+                return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
+            except Exception:
+                pass  # legacy feather v1 / unreadable-as-IPC: pandas path
+        df = pandas.read_feather(
+            cls.get_path(path) if isinstance(path, str) else path,
+            columns=columns,
+            use_threads=use_threads,
+            **kwargs,
+        )
         return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
+
+    @classmethod
+    def _read_ipc_batch_parallel(
+        cls, path: str, columns: Optional[List]
+    ) -> pandas.DataFrame:
+        import pyarrow as pa
+        from pyarrow import ipc
+
+        with pa.memory_map(path) as source:
+            reader = ipc.open_file(source)
+            n = reader.num_record_batches
+            schema = reader.schema
+        # project DURING the read (skips decompression of dropped columns)
+        options = None
+        if columns is not None:
+            indices = [schema.get_field_index(c) for c in columns]
+            if any(i < 0 for i in indices):
+                raise KeyError(list(columns))
+            options = ipc.IpcReadOptions(included_fields=indices)
+
+        def read_batch(i):
+            # one handle per task: IPC readers race on lazy dictionary
+            # loading when shared across threads (observed on categorical
+            # columns); the mmap itself stays zero-copy
+            with pa.memory_map(path) as src:
+                return ipc.open_file(src, options=options).get_batch(i)
+
+        if n <= 1:
+            with pa.memory_map(path) as source:
+                table = ipc.open_file(source, options=options).read_all()
+        else:
+            table = pa.Table.from_batches(
+                cls._parse_ranges_threaded(list(range(n)), read_batch)
+            )
+        if columns is not None:
+            table = table.select(list(columns))  # honor the requested ORDER
+        return table.to_pandas(split_blocks=True, self_destruct=True)
+
+    @classmethod
+    def write(cls, qc: Any, path: Any, **kwargs: Any):
+        """Chunk-streamed feather write: bounded row windows through one
+        Arrow IPC file writer (the parquet writer pattern; reference writes
+        serially via a full-frame gather)."""
+        import pyarrow as pa
+
+        idx = qc.index
+        trivial_index = (
+            isinstance(idx, pandas.RangeIndex)
+            and idx.start == 0
+            and idx.step == 1
+            and idx.name is None
+        )
+        if kwargs or not isinstance(path, str) or not trivial_index:
+            # buffer targets / explicit write options, or a non-default
+            # index (pandas raises its own error for that) -> serial pandas
+            return qc.to_pandas().to_feather(path, **kwargs)
+
+        try:
+            options = pa.ipc.IpcWriteOptions(compression="lz4")
+        except Exception:
+            options = None
+        n_rows = qc.get_axis_len(0)
+        writer = None
+        schema = None
+        try:
+            for start in range(0, max(n_rows, 1), _WRITE_CHUNK_ROWS):
+                chunk_qc = qc.take_2d_positional(
+                    index=slice(start, min(start + _WRITE_CHUNK_ROWS, n_rows))
+                )
+                # pin the first window's schema: a later all-null window
+                # would otherwise infer a mismatching (null) column type
+                table = pa.Table.from_pandas(
+                    chunk_qc.to_pandas(), preserve_index=False, schema=schema
+                )
+                if writer is None:
+                    schema = table.schema
+                    writer = pa.ipc.new_file(path, schema, options=options)
+                writer.write_table(table)
+        finally:
+            if writer is not None:
+                writer.close()
+        return None
