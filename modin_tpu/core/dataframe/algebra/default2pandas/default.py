@@ -257,12 +257,17 @@ class EwmDefault(DefaultMethod):
         def caller(
             query_compiler: Any, ewm_kwargs: dict, *args: Any, **kwargs: Any
         ) -> Any:
+            from modin_tpu.utils import try_cast_to_pandas
+
             df = query_compiler.to_pandas()
             if squeeze_self:
                 df = df.squeeze(axis=1)
             ErrorMessage.default_to_pandas(f"`ExponentialMovingWindow.{fn_name}`")
             roller = df.ewm(**ewm_kwargs)
             fn = getattr(type(roller), func) if isinstance(func, str) else func
+            # raw compilers may arrive as `other` from the device pair path
+            args = try_cast_to_pandas(args, squeeze=True)
+            kwargs = try_cast_to_pandas(kwargs, squeeze=True)
             return cls.build_output(query_compiler, fn(roller, *args, **kwargs))
 
         caller.__name__ = f"ewm_{fn_name}"

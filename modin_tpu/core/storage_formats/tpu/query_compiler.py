@@ -1425,13 +1425,11 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
         return self._wrap_device_result(datas)
 
-    def _try_device_ewm(self, op: str, ewm_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
-        """Exponentially weighted windows as associative linear-recurrence
-        scans (ops/window.py ewm_reduce).  Reference surface:
-        modin/pandas/window.py ExponentialMovingWindow (per-block pandas);
-        times/method='table'/numeric_only and non-numeric frames fall back."""
-        from modin_tpu.ops.window import ewm_reduce
-
+    @staticmethod
+    def _parse_ewm_kwargs(ewm_kwargs: dict):
+        """Resolve ewm construction kwargs to (alpha, adjust, ignore_na,
+        min_periods), or None when only the pandas fallback can honor (or
+        properly reject) them."""
         ek = dict(ewm_kwargs)
         if ek.pop("times", None) is not None:
             return None
@@ -1481,6 +1479,19 @@ class TpuQueryCompiler(BaseQueryCompiler):
             if not 0 < alpha <= 1:
                 return None
             a = float(alpha)
+        return a, bool(adjust), bool(ignore_na), int(min_periods)
+
+    def _try_device_ewm(self, op: str, ewm_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
+        """Exponentially weighted windows as associative linear-recurrence
+        scans (ops/window.py ewm_reduce).  Reference surface:
+        modin/pandas/window.py ExponentialMovingWindow (per-block pandas);
+        times/method='table'/numeric_only and non-numeric frames fall back."""
+        from modin_tpu.ops.window import ewm_reduce
+
+        parsed = self._parse_ewm_kwargs(ewm_kwargs)
+        if parsed is None:
+            return None
+        a, adjust, ignore_na, min_periods = parsed
         extra = dict(kwargs)
         bias = extra.pop("bias", False) if op in ("var", "std") else False
         if not isinstance(bias, (bool, np.bool_)):
@@ -1505,6 +1516,104 @@ class TpuQueryCompiler(BaseQueryCompiler):
             bool(ignore_na), int(min_periods), bool(bias),
         )
         return self._wrap_device_result(datas)
+
+    def _try_device_ewm_pair(
+        self, op: str, ewm_kwargs: dict, kwargs: dict
+    ) -> Optional["TpuQueryCompiler"]:
+        """ewm cov/corr under JOINT validity (ops/window.py ewm_pair_reduce).
+
+        Covered shapes: self vs itself (other=None) and self vs a
+        label-matched same-length compiler (Series-vs-Series and
+        column-matched frames).  pairwise=True's MultiIndex block output
+        stays on the pandas fallback."""
+        from modin_tpu.ops.window import ewm_pair_reduce
+
+        parsed = self._parse_ewm_kwargs(ewm_kwargs)
+        if parsed is None:
+            return None
+        a, adjust, ignore_na, min_periods = parsed
+        extra = dict(kwargs)
+        other = extra.pop("other", None)
+        if extra.pop("pairwise", None) not in (None, False):
+            return None
+        bias = extra.pop("bias", False) if op == "cov" else False
+        if not isinstance(bias, (bool, np.bool_)):
+            return None
+        if extra.pop("numeric_only", False):
+            return None
+        if extra:
+            return None
+        frame = self._modin_frame
+        if len(frame) == 0 or not all(
+            c.is_device and c.pandas_dtype.kind in "iuf" for c in frame._columns
+        ):
+            return None
+        both_series = self._shape_hint == "column" and (
+            other is None or getattr(other, "_shape_hint", None) == "column"
+        )
+        if other is None:
+            if self._shape_hint != "column":
+                # DataFrame cov/corr with no other is PAIRWISE in pandas
+                # (MultiIndex block output) — fallback territory
+                return None
+            oframe = frame
+        else:
+            if not isinstance(other, TpuQueryCompiler):
+                return None
+            oframe = other._modin_frame
+            if len(oframe) != len(frame) or not all(
+                c.is_device and c.pandas_dtype.kind in "iuf"
+                for c in oframe._columns
+            ):
+                return None
+            if frame.num_cols != oframe.num_cols:
+                return None
+            # Series pairs ignore names; frames must be column-matched
+            if not both_series and not frame.columns.equals(oframe.columns):
+                return None
+            if not self._fast_index_match(other) and not frame.index.equals(
+                oframe.index
+            ):
+                # pandas aligns on labels first; misaligned inputs fall back
+                return None
+        frame.materialize_device()
+        oframe.materialize_device()
+        datas = ewm_pair_reduce(
+            op,
+            [c.data for c in frame._columns],
+            [c.data for c in oframe._columns],
+            len(frame), a, bool(adjust), bool(ignore_na), int(min_periods),
+            bool(bias),
+        )
+        col_labels = None
+        if (
+            other is not None
+            and both_series
+            and frame.columns[0] != oframe.columns[0]
+        ):
+            # binary-op name convention: differing names -> unnamed
+            col_labels = pandas.Index([MODIN_UNNAMED_SERIES_LABEL])
+        return self._wrap_device_result(datas, col_labels=col_labels)
+
+    def ewm_cov(self, ewm_kwargs: dict, *args: Any, **kwargs: Any):
+        result = (
+            self._try_device_ewm_pair("cov", ewm_kwargs, dict(kwargs))
+            if not args
+            else None
+        )
+        if result is not None:
+            return result
+        return super().ewm_cov(ewm_kwargs, *args, **kwargs)
+
+    def ewm_corr(self, ewm_kwargs: dict, *args: Any, **kwargs: Any):
+        result = (
+            self._try_device_ewm_pair("corr", ewm_kwargs, dict(kwargs))
+            if not args
+            else None
+        )
+        if result is not None:
+            return result
+        return super().ewm_corr(ewm_kwargs, *args, **kwargs)
 
     def _try_device_resample(self, op: str, resample_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         """Fixed-frequency resample as time-bucket codes + segment aggregation.

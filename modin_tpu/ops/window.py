@@ -284,6 +284,162 @@ def _one_ewm(op: str, c, n: int, alpha, adjust: bool, ignore_na: bool,
     return jnp.sqrt(v) if op == "std" else v
 
 
+def _one_ewm_pair(op: str, cx, cy, n: int, alpha, adjust: bool,
+                  ignore_na: bool, min_periods, bias: bool):
+    """ewm cov/corr of one column pair under JOINT validity (a row counts
+    as an observation only when BOTH sides are non-missing — the pandas
+    ewmcov contract).  corr is the ratio of the three BIASED covariances
+    over the same joint mask.  Same scan structure as _one_ewm; the three
+    cov recurrences share coefficients, so they run as one stacked scan."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    P = cx.shape[0]
+    in_frame = jnp.arange(P) < n
+
+    def missing(c):
+        if jnp.issubdtype(c.dtype, jnp.floating):
+            return jnp.isnan(c) | jnp.isinf(c)
+        return jnp.zeros(c.shape, bool)
+
+    valid = in_frame & ~missing(cx) & ~missing(cy)
+    x = jnp.where(valid, cx, 0).astype(jnp.float64)
+    y = jnp.where(valid, cy, 0).astype(jnp.float64)
+
+    alpha = jnp.float64(alpha)
+    f = 1.0 - alpha
+    mp = jnp.maximum(jnp.int64(min_periods), 1)
+    idx = jnp.arange(P, dtype=jnp.int64)
+    cnt = jnp.cumsum(valid.astype(jnp.int64))
+    is_first = valid & (cnt == 1)
+    lastv = lax.associative_scan(jnp.maximum, jnp.where(valid, idx, -1))
+    lastv_excl = jnp.concatenate([jnp.full(1, -1, idx.dtype), lastv[:-1]])
+    gap = (
+        jnp.ones(P, jnp.float64)
+        if ignore_na
+        else (idx - lastv_excl).astype(jnp.float64)
+    )
+    fd = f ** gap
+
+    if adjust:
+        a_step = jnp.full(P, f) if not ignore_na else jnp.where(valid, f, 1.0)
+        bv = valid.astype(jnp.float64)
+        a4 = jnp.stack(
+            [a_step, a_step, a_step, a_step * a_step], axis=1
+        )
+        b4 = jnp.stack(
+            [jnp.where(valid, x, 0.0), jnp.where(valid, y, 0.0), bv, bv],
+            axis=1,
+        )
+        num_x, num_y, den, sum_wt2 = jnp.moveaxis(_linear_scan(a4, b4), 1, 0)
+        den_safe = jnp.where(den == 0, 1.0, den)
+        carried = lastv >= 0
+        mx = jnp.where(
+            carried, jnp.take(num_x / den_safe, jnp.clip(lastv, 0)), 0.0
+        )
+        my = jnp.where(
+            carried, jnp.take(num_y / den_safe, jnp.clip(lastv, 0)), 0.0
+        )
+        sum_wt = den
+        ow = a_step * jnp.concatenate([jnp.zeros(1), den[:-1]])
+        nw = jnp.float64(1.0)
+    else:
+        cnorm = fd + alpha
+        a_mean = jnp.where(valid, jnp.where(is_first, 0.0, fd / cnorm), 1.0)
+        mid0 = valid & ~is_first
+        a_w = jnp.where(mid0, fd / cnorm, jnp.where(valid, 0.0, 1.0))
+        a_w2 = jnp.where(
+            mid0, (fd * fd) / (cnorm * cnorm), jnp.where(valid, 0.0, 1.0)
+        )
+        a4 = jnp.stack([a_mean, a_mean, a_w, a_w2], axis=1)
+        b4 = jnp.stack(
+            [
+                jnp.where(valid, jnp.where(is_first, x, alpha * x / cnorm), 0.0),
+                jnp.where(valid, jnp.where(is_first, y, alpha * y / cnorm), 0.0),
+                jnp.where(mid0, alpha / cnorm, jnp.where(valid, 1.0, 0.0)),
+                jnp.where(
+                    mid0,
+                    (alpha * alpha) / (cnorm * cnorm),
+                    jnp.where(valid, 1.0, 0.0),
+                ),
+            ],
+            axis=1,
+        )
+        mx, my, sum_wt, sum_wt2 = jnp.moveaxis(_linear_scan(a4, b4), 1, 0)
+        ow = jnp.where(is_first, 0.0, fd)
+        nw = jnp.float64(alpha)
+
+    mid = valid & ~is_first
+    mxp = jnp.concatenate([jnp.zeros(1), mx[:-1]])
+    myp = jnp.concatenate([jnp.zeros(1), my[:-1]])
+    denom_t = jnp.where(mid, ow + nw, 1.0)
+    ac = jnp.where(mid, ow / denom_t, jnp.where(valid, 0.0, 1.0))
+
+    def cov_scan(u, v, up, vp, mu, mv):
+        cc = jnp.where(
+            mid,
+            (ow * (up - mu) * (vp - mv) + nw * (u - mu) * (v - mv)) / denom_t,
+            0.0,
+        )
+        return cc
+
+    if op == "cov":
+        cov = _linear_scan(ac, cov_scan(x, y, mxp, myp, mx, my))
+        if not bias:
+            numr = sum_wt * sum_wt
+            denr = numr - sum_wt2
+            cov = jnp.where(
+                denr > 0, cov * numr / jnp.where(denr == 0, 1.0, denr), jnp.nan
+            )
+        return jnp.where(cnt >= mp, cov, jnp.nan)
+    # corr: the three biased covariances share coefficients -> one scan
+    a3 = jnp.stack([ac, ac, ac], axis=1)
+    b3 = jnp.stack(
+        [
+            cov_scan(x, y, mxp, myp, mx, my),
+            cov_scan(x, x, mxp, mxp, mx, mx),
+            cov_scan(y, y, myp, myp, my, my),
+        ],
+        axis=1,
+    )
+    cxy, cxx, cyy = jnp.moveaxis(_linear_scan(a3, b3), 1, 0)
+    denom = jnp.sqrt(cxx * cyy)
+    r = jnp.where(denom > 0, cxy / jnp.where(denom == 0, 1.0, denom), jnp.nan)
+    return jnp.where(cnt >= mp, r, jnp.nan)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_ewm_pair(op: str, n_cols: int, n: int, adjust: bool,
+                  ignore_na: bool, bias: bool):
+    import jax
+
+    def fn(xs: Tuple, ys: Tuple, alpha, min_periods):
+        return tuple(
+            _one_ewm_pair(op, x, y, n, alpha, adjust, ignore_na, min_periods, bias)
+            for x, y in zip(xs, ys)
+        )
+
+    return jax.jit(fn)
+
+
+def ewm_pair_reduce(
+    op: str,
+    xs: List[Any],
+    ys: List[Any],
+    n: int,
+    alpha: float,
+    adjust: bool,
+    ignore_na: bool,
+    min_periods: int,
+    bias: bool = False,
+) -> List[Any]:
+    """ewm cov/corr over matched column pairs (padded, logical length n)."""
+    fn = _jit_ewm_pair(
+        op, len(xs), int(n), bool(adjust), bool(ignore_na), bool(bias)
+    )
+    return list(fn(tuple(xs), tuple(ys), float(alpha), int(min_periods)))
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_ewm(op: str, n_cols: int, n: int, adjust: bool, ignore_na: bool,
              bias: bool):

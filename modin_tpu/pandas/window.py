@@ -155,19 +155,23 @@ class Ewm(ClassLogger, modin_layer="PANDAS-API"):
     def std(self, bias: bool = False, numeric_only: bool = False):
         return self._agg("std", bias=bias, numeric_only=numeric_only)
 
-    def corr(self, other: Any = None, pairwise: Any = None, numeric_only: bool = False):
-        from modin_tpu.utils import try_cast_to_pandas
+    @staticmethod
+    def _other_qc(other: Any) -> Any:
+        # hand the raw compiler to the QC (device pair path); the pandas
+        # fallback casts it (EwmDefault try_cast_to_pandas)
+        from modin_tpu.pandas.base import BasePandasDataset
 
+        return other._query_compiler if isinstance(other, BasePandasDataset) else other
+
+    def corr(self, other: Any = None, pairwise: Any = None, numeric_only: bool = False):
         return self._agg(
-            "corr", other=try_cast_to_pandas(other, squeeze=True),
+            "corr", other=self._other_qc(other),
             pairwise=pairwise, numeric_only=numeric_only,
         )
 
     def cov(self, other: Any = None, pairwise: Any = None, bias: bool = False, numeric_only: bool = False):
-        from modin_tpu.utils import try_cast_to_pandas
-
         return self._agg(
-            "cov", other=try_cast_to_pandas(other, squeeze=True),
+            "cov", other=self._other_qc(other),
             pairwise=pairwise, bias=bias, numeric_only=numeric_only,
         )
 

@@ -243,3 +243,73 @@ def test_ewm_aggregate_and_online():
     eval_general(md, pdf, lambda df: df.ewm(alpha=0.3).agg(["mean", "std"]))
     with pytest.raises(AttributeError):
         md.ewm(alpha=0.3).not_a_real_method
+
+
+class TestEwmPairwise:
+    """Device ewm cov/corr under joint validity (scan pair kernel)."""
+
+    @pytest.fixture
+    def pair(self):
+        rng = np.random.default_rng(23)
+        n = 300
+        x = rng.normal(size=n)
+        y = 0.5 * x + rng.normal(size=n)
+        x[[3, 4, 50]] = np.nan
+        y[[5, 50, 120]] = np.nan
+        return create_test_dfs({"x": x, "y": y})
+
+    @pytest.mark.parametrize("adjust", [True, False])
+    @pytest.mark.parametrize("ignore_na", [False, True])
+    def test_series_cov_corr(self, pair, adjust, ignore_na):
+        md, pdf = pair
+        kw = dict(alpha=0.3, adjust=adjust, ignore_na=ignore_na)
+        eval_general(
+            md, pdf, lambda df: df["x"].ewm(**kw).cov(df["y"])
+        )
+        eval_general(
+            md, pdf, lambda df: df["x"].ewm(**kw).corr(df["y"])
+        )
+
+    @pytest.mark.parametrize("bias", [False, True])
+    def test_series_cov_bias(self, pair, bias):
+        md, pdf = pair
+        eval_general(
+            md, pdf, lambda df: df["x"].ewm(span=7).cov(df["y"], bias=bias)
+        )
+
+    def test_self_cov_equals_var(self, pair):
+        md, pdf = pair
+        eval_general(md, pdf, lambda df: df.ewm(alpha=0.4).cov())
+        eval_general(md, pdf, lambda df: df["x"].ewm(alpha=0.4).cov())
+
+    def test_frame_vs_frame_matched(self, pair):
+        md, pdf = pair
+        m2, p2 = md * 2, pdf * 2
+        df_equals(
+            md.ewm(alpha=0.25).cov(m2, pairwise=False),
+            pdf.ewm(alpha=0.25).cov(p2, pairwise=False),
+        )
+        df_equals(
+            md.ewm(alpha=0.25).corr(m2, pairwise=False),
+            pdf.ewm(alpha=0.25).corr(p2, pairwise=False),
+        )
+
+    def test_pairwise_true_falls_back_correct(self, pair):
+        md, pdf = pair
+        df_equals(md.ewm(alpha=0.4).cov(), pdf.ewm(alpha=0.4).cov())
+        df_equals(
+            md.ewm(alpha=0.4).corr(pairwise=True),
+            pdf.ewm(alpha=0.4).corr(pairwise=True),
+        )
+
+    def test_min_periods_gate(self, pair):
+        md, pdf = pair
+        eval_general(
+            md, pdf,
+            lambda df: df["x"].ewm(alpha=0.3, min_periods=5).cov(df["y"]),
+        )
+
+    def test_device_no_fallback_series_pair(self, pair):
+        md, pdf = pair
+        got = _no_fallback(lambda: md["x"].ewm(alpha=0.3).cov(md["y"]))
+        df_equals(got, pdf["x"].ewm(alpha=0.3).cov(pdf["y"]))
