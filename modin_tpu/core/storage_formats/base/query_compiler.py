@@ -1030,17 +1030,41 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
             pandas.core.window.rolling.Rolling.aggregate
         )(self, rolling_kwargs, func, *args, **kwargs)
 
-    def groupby_rolling(self, by, agg_func, axis, groupby_kwargs, rolling_kwargs, agg_args, agg_kwargs, drop=False):
-        def fn(grp: Any, *args: Any, **kwargs: Any) -> Any:
-            roller = grp.rolling(**rolling_kwargs)
-            if isinstance(agg_func, str):
-                return getattr(roller, agg_func)(*args, **kwargs)
-            return agg_func(roller, *args, **kwargs)
+    def groupby_window(
+        self, by, kind, window_kwargs, agg_func, groupby_kwargs, agg_args,
+        agg_kwargs, drop=False, selection=None, series_groupby=False,
+    ):
+        """Windowed aggregation over groups: ``grp.<kind>(**kw).<agg>()``
+        for kind in rolling/expanding/ewm (reference modin/pandas/window.py
+        RollingGroupby; one generic seam here since all three window
+        families share the groupby shape)."""
+        df = self.to_pandas()
+        if series_groupby and selection is None:
+            df = df.squeeze(axis=1)
+        pandas_by = try_cast_to_pandas(by, squeeze=True)
+        ErrorMessage.default_to_pandas(f"`GroupBy.{kind}.{agg_func}`")
+        grp = df.groupby(by=pandas_by, **dict(groupby_kwargs or {}))
+        if selection is not None:
+            grp = grp[selection]
+        win = getattr(grp, kind)(**window_kwargs)
+        if isinstance(agg_func, str):
+            result = getattr(win, agg_func)(*agg_args, **dict(agg_kwargs or {}))
+        else:
+            result = agg_func(win, *agg_args, **dict(agg_kwargs or {}))
+        was_series = isinstance(result, pandas.Series)
+        if was_series:
+            name = result.name if result.name is not None else MODIN_UNNAMED_SERIES_LABEL
+            result = result.to_frame(name)
+        qc = self.from_pandas(result, type(self._modin_frame) if self._modin_frame is not None else None)
+        if was_series:
+            qc._shape_hint = "column"
+        return qc
 
-        fn.__name__ = f"rolling.{agg_func}"
-        return GroupByDefault.register(fn)(
-            self, by=by, agg_args=agg_args, agg_kwargs=agg_kwargs,
-            groupby_kwargs=groupby_kwargs, drop=drop,
+    def groupby_rolling(self, by, agg_func, axis, groupby_kwargs, rolling_kwargs, agg_args, agg_kwargs, drop=False, selection=None, series_groupby=False):
+        return self.groupby_window(
+            by, "rolling", rolling_kwargs, agg_func, groupby_kwargs,
+            agg_args, agg_kwargs, drop=drop, selection=selection,
+            series_groupby=series_groupby,
         )
 
     # ------------------------------------------------------------------ #

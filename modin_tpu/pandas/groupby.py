@@ -364,10 +364,48 @@ class DataFrameGroupBy(ClassLogger, modin_layer="PANDAS-API"):
             lambda grp, *a, **kw: grp.resample(rule, *a, **kw).sum(), agg_args=args, agg_kwargs=kwargs
         )
 
-    def rolling(self, window: Any, *args: Any, **kwargs: Any):
+    def rolling(
+        self,
+        window: Any = None,
+        min_periods: Any = None,
+        center: bool = False,
+        win_type: Any = None,
+        on: Any = None,
+        closed: Any = None,
+        method: str = "single",
+    ):
         from modin_tpu.pandas.window import GroupByRolling
 
-        return GroupByRolling(self, window, *args, **kwargs)
+        return GroupByRolling(
+            self, window, min_periods=min_periods, center=center,
+            win_type=win_type, on=on, closed=closed, method=method,
+        )
+
+    def expanding(self, min_periods: int = 1, method: str = "single"):
+        from modin_tpu.pandas.window import GroupByExpanding
+
+        return GroupByExpanding(self, min_periods=min_periods, method=method)
+
+    def ewm(
+        self,
+        com: Any = None,
+        span: Any = None,
+        halflife: Any = None,
+        alpha: Any = None,
+        min_periods: Any = 0,
+        adjust: bool = True,
+        ignore_na: bool = False,
+        times: Any = None,
+        method: str = "single",
+    ):
+        from modin_tpu.pandas.window import GroupByEwm
+        from modin_tpu.utils import try_cast_to_pandas
+
+        return GroupByEwm(
+            self, com=com, span=span, halflife=halflife, alpha=alpha,
+            min_periods=min_periods, adjust=adjust, ignore_na=ignore_na,
+            times=try_cast_to_pandas(times, squeeze=True), method=method,
+        )
 
     # ------------------------------------------------------------------ #
     # Introspection

@@ -200,38 +200,84 @@ class Ewm(ClassLogger, modin_layer="PANDAS-API"):
         return fallback
 
 
-class GroupByRolling(ClassLogger, modin_layer="PANDAS-API"):
-    """Rolling over groupby groups (``df.groupby(...).rolling(...)``)."""
+class _GroupByWindow(ClassLogger, modin_layer="PANDAS-API"):
+    """Windowed aggregation over groupby groups: the lazy handle for
+    ``df.groupby(...).{rolling,expanding,ewm}(...)`` (reference
+    modin/pandas/window.py RollingGroupby).  The full pandas surface
+    resolves through ``__getattr__`` against the matching pandas groupby
+    window class, so every aggregation it supports dispatches (and missing
+    names raise like pandas)."""
 
-    def __init__(self, groupby: Any, window: Any, *args: Any, **kwargs: Any) -> None:
+    _kind: str = ""
+    _pandas_cls: Any = None
+
+    def __init__(self, groupby: Any, **window_kwargs: Any) -> None:
         self._groupby = groupby
-        self._rolling_kwargs = {"window": window, **kwargs}
+        self._window_kwargs = window_kwargs
 
     def _agg(self, name: str, *args: Any, **kwargs: Any):
+        import pandas.core.groupby as pg
+
         gb = self._groupby
         by, drop = gb._resolve_by()
-        qc = gb._query_compiler.groupby_rolling(
+        qc = gb._query_compiler.groupby_window(
             by=by,
+            kind=self._kind,
+            window_kwargs=self._window_kwargs,
             agg_func=name,
-            axis=0,
             groupby_kwargs=gb._kwargs,
-            rolling_kwargs=self._rolling_kwargs,
             agg_args=args,
             agg_kwargs=kwargs,
             drop=drop,
+            selection=gb._selection,
+            series_groupby=gb._pandas_class is pg.SeriesGroupBy,
         )
         from modin_tpu.pandas.dataframe import DataFrame
+        from modin_tpu.pandas.series import Series
 
+        if getattr(qc, "_shape_hint", None) == "column":
+            return Series(query_compiler=qc)
         return DataFrame(query_compiler=qc)
 
+    def __getattr__(self, name: str):
+        if name.startswith("_") or not callable(
+            getattr(self._pandas_cls, name, None)
+        ):
+            raise AttributeError(name)
 
-for _name in ["count", "sum", "mean", "median", "var", "std", "min", "max"]:
-
-    def _make_gbr(name):
-        def method(self, *args: Any, **kwargs: Any):
+        def method(*args: Any, **kwargs: Any):
             return self._agg(name, *args, **kwargs)
 
         method.__name__ = name
         return method
 
-    setattr(GroupByRolling, _name, _make_gbr(_name))
+
+class GroupByRolling(_GroupByWindow):
+    _kind = "rolling"
+    _pandas_cls = pandas.core.window.rolling.RollingGroupby
+
+    def __init__(
+        self,
+        groupby: Any,
+        window: Any = None,
+        min_periods: Any = None,
+        center: bool = False,
+        win_type: Any = None,
+        on: Any = None,
+        closed: Any = None,
+        method: str = "single",
+    ) -> None:
+        super().__init__(
+            groupby, window=window, min_periods=min_periods, center=center,
+            win_type=win_type, on=on, closed=closed, method=method,
+        )
+
+
+class GroupByExpanding(_GroupByWindow):
+    _kind = "expanding"
+    _pandas_cls = pandas.core.window.expanding.ExpandingGroupby
+
+
+class GroupByEwm(_GroupByWindow):
+    _kind = "ewm"
+    _pandas_cls = pandas.core.window.ewm.ExponentialMovingWindowGroupby

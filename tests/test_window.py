@@ -313,3 +313,74 @@ class TestEwmPairwise:
         md, pdf = pair
         got = _no_fallback(lambda: md["x"].ewm(alpha=0.3).cov(md["y"]))
         df_equals(got, pdf["x"].ewm(alpha=0.3).cov(pdf["y"]))
+
+
+class TestGroupByWindows:
+    """groupby().{rolling,expanding,ewm}() handles (reference
+    modin/pandas/window.py RollingGroupby), Series and frame shapes."""
+
+    @pytest.fixture
+    def gdfs(self):
+        rng = np.random.default_rng(31)
+        n = 120
+        return create_test_dfs(
+            {"k": rng.integers(0, 4, n), "v": rng.normal(size=n),
+             "w": rng.normal(size=n)}
+        )
+
+    def test_groupby_rolling_frame(self, gdfs):
+        md, pdf = gdfs
+        eval_general(md, pdf, lambda df: df.groupby("k").rolling(3).sum())
+        eval_general(
+            md, pdf, lambda df: df.groupby("k").rolling(5, min_periods=2).mean()
+        )
+
+    def test_groupby_rolling_series(self, gdfs):
+        md, pdf = gdfs
+        eval_general(md, pdf, lambda df: df.groupby("k")["v"].rolling(2).sum())
+
+    def test_groupby_expanding(self, gdfs):
+        md, pdf = gdfs
+        eval_general(md, pdf, lambda df: df.groupby("k").expanding().sum())
+        eval_general(
+            md, pdf, lambda df: df.groupby("k")["v"].expanding(min_periods=3).mean()
+        )
+
+    def test_groupby_ewm(self, gdfs):
+        md, pdf = gdfs
+        eval_general(md, pdf, lambda df: df.groupby("k").ewm(alpha=0.4).mean())
+        eval_general(md, pdf, lambda df: df.groupby("k")["v"].ewm(span=5).std())
+
+    def test_groupby_rolling_selection_list(self, gdfs):
+        md, pdf = gdfs
+        eval_general(
+            md, pdf, lambda df: df.groupby("k")[["v", "w"]].rolling(4).max()
+        )
+
+    def test_series_groupby_window_returns_series(self, gdfs):
+        md, pdf = gdfs
+        eval_general(
+            md, pdf, lambda df: df["v"].groupby(df["k"]).rolling(2).sum()
+        )
+        eval_general(
+            md, pdf, lambda df: df["v"].groupby(df["k"]).ewm(alpha=0.5).mean()
+        )
+
+    def test_positional_min_periods(self, gdfs):
+        md, pdf = gdfs
+        eval_general(md, pdf, lambda df: df.groupby("k").rolling(3, 2).sum())
+
+    def test_positional_ewm_com(self, gdfs):
+        md, pdf = gdfs
+        eval_general(md, pdf, lambda df: df.groupby("k").ewm(0.5).mean())
+
+    def test_full_surface_via_getattr(self, gdfs):
+        md, pdf = gdfs
+        eval_general(md, pdf, lambda df: df.groupby("k").rolling(4).skew())
+        eval_general(md, pdf, lambda df: df.groupby("k").expanding().kurt())
+        eval_general(
+            md, pdf,
+            lambda df: df.groupby("k")[["v", "w"]].rolling(4).corr(),
+        )
+        with pytest.raises(AttributeError):
+            md.groupby("k").rolling(3).not_a_method
