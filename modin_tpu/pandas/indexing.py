@@ -52,11 +52,19 @@ class _LocationIndexerBase(ClassLogger, modin_layer="PANDAS-API"):
         self.qc = modin_df._query_compiler
 
     def _fallback_get(self, key: Any, attr: str) -> Any:
+        from modin_tpu.utils import try_cast_to_pandas
+
+        # pandas must never see modin objects inside the key (it would
+        # treat e.g. a boolean-Series mask as a label list)
+        key = try_cast_to_pandas(key)
         return self.df._default_to_pandas(lambda obj: getattr(obj, attr)[key])
 
     def _fallback_set(self, key: Any, value: Any, attr: str) -> None:
         from modin_tpu.utils import try_cast_to_pandas
 
+        # the key can carry modin objects too (e.g. a boolean-Series mask in
+        # a (rows, col) tuple) — pandas must never see them
+        key = try_cast_to_pandas(key)
         value = try_cast_to_pandas(value)
 
         def setter(obj):

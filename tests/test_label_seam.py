@@ -399,3 +399,174 @@ class TestReviewRegressions:
             return df
         for op in (set_loc, set_iloc, set_subset, set_broadcast):
             eval_general(md, pdf, op)
+
+
+class TestLocBreadthPort:
+    """Scenario shapes ported from the reference indexing suite
+    (modin/tests/pandas/dataframe/test_indexing.py:367-975)."""
+
+    @pytest.fixture
+    def dfs(self):
+        rng = np.random.default_rng(55)
+        data = {f"col{i}": rng.integers(0, 100, 20) for i in range(7)}
+        data["colf"] = rng.normal(size=20)
+        return create_test_dfs(data)
+
+    def test_loc_core_shapes(self, dfs):
+        md, pdf = dfs
+        key1, key2 = pdf.columns[0], pdf.columns[1]
+        for op in (
+            lambda df: df.loc[0, key1],
+            lambda df: df.loc[0],
+            lambda df: df.loc[1:, key1],
+            lambda df: df.loc[1:2, key1],
+            lambda df: df.loc[:, key1],
+            lambda df: df.loc[[1, 2]],
+            lambda df: df.loc[1:2, key1:key2],
+            lambda df: df.loc[:, [key2, key1]],
+            lambda df: df.loc[[2, 1], :],
+            lambda df: df.loc[:, key1 : pdf.columns[-2]],
+        ):
+            eval_general(md, pdf, op)
+
+    def test_loc_boolean_lists(self, dfs):
+        md, pdf = dfs
+        indices = [i % 3 == 0 for i in range(len(pdf.index))]
+        columns = [i % 5 == 0 for i in range(len(pdf.columns))]
+        eval_general(md, pdf, lambda df: df.loc[indices, columns])
+        eval_general(md, pdf, lambda df: df.loc[:, columns])
+        eval_general(md, pdf, lambda df: df.loc[indices])
+
+    def test_loc_boolean_series_keys(self, dfs):
+        md, pdf = dfs
+        indices = [i % 3 == 0 for i in range(len(pdf.index))]
+        columns = [i % 5 == 0 for i in range(len(pdf.columns))]
+        m = md.loc[pd.Series(indices), pd.Series(columns, index=md.columns)]
+        p = pdf.loc[
+            pandas.Series(indices), pandas.Series(columns, index=pdf.columns)
+        ]
+        df_equals(m, p)
+
+    def test_loc_write_rows(self, dfs):
+        md, pdf = dfs
+        md, pdf = md.copy(), pdf.copy()
+        md.loc[[1, 2]] = 42
+        pdf.loc[[1, 2]] = 42
+        df_equals(md, pdf)
+
+    def test_loc_mask_then_transform_assignment(self):
+        md, pdf = create_test_dfs({"a": [1, 2], "b": [3.0, 4.0]})
+        pdf.loc[pdf["a"] > 1, "b"] = np.log(pdf["b"])
+        md.loc[md["a"] > 1, "b"] = np.log(md["b"])
+        df_equals(md, pdf)
+
+    @pytest.mark.parametrize("locator_name", ["loc", "iloc"])
+    @pytest.mark.parametrize(
+        "slice_indexer",
+        [
+            slice(None, None, -2),
+            slice(1, 10, None),
+            slice(None, 10, None),
+            slice(10, None, None),
+            slice(10, None, -2),
+            slice(-10, None, -2),
+            slice(None, 1_000_000_000, None),
+        ],
+    )
+    def test_slice_indexers_shifted_index(self, locator_name, slice_indexer):
+        rng = np.random.default_rng(5)
+        md, pdf = create_test_dfs({"v": rng.normal(size=30), "w": rng.integers(0, 9, 30)})
+        shifted = pandas.RangeIndex(1, 31)
+        md.index = shifted
+        pdf.index = shifted
+        eval_general(
+            md, pdf, lambda df: getattr(df, locator_name)[slice_indexer]
+        )
+
+    def test_loc_empty_frame(self):
+        md, pdf = create_test_dfs({})
+        eval_general(md, pdf, lambda df: df.loc[[]])
+
+    def test_at_iat(self, dfs):
+        md, pdf = dfs
+        assert md.at[3, "col2"] == pdf.at[3, "col2"]
+        assert md.iat[3, 2] == pdf.iat[3, 2]
+        md, pdf = md.copy(), pdf.copy()
+        md.at[3, "col2"] = -7
+        pdf.at[3, "col2"] = -7
+        df_equals(md, pdf)
+        md.iat[0, 0] = -9
+        pdf.iat[0, 0] = -9
+        df_equals(md, pdf)
+
+    def test_loc_enlargement_falls_back_correct(self):
+        md, pdf = create_test_dfs({"a": [1, 2, 3]})
+        md, pdf = md.copy(), pdf.copy()
+        md.loc[99] = 7
+        pdf.loc[99] = 7
+        df_equals(md, pdf)
+        md.loc[:, "new"] = 1.5
+        pdf.loc[:, "new"] = 1.5
+        df_equals(md, pdf)
+
+
+class TestLocSetOrderAndEdges:
+    """More reference scenarios: unsorted/repeated positional writes,
+    MultiIndex on both axes, empty frames (test_indexing.py:704-760,2715)."""
+
+    @pytest.mark.parametrize("indexer", ["loc", "iloc"])
+    def test_set_order_unsorted_repeated(self, indexer):
+        rng = np.random.default_rng(0)
+        is_loc = indexer == "loc"
+        data = {"col": rng.integers(0, 100, size=100)}
+        row_indexer = rng.integers(0, 100, size=20)
+        col_indexer = "col" if is_loc else 0
+        set_data = list(range(100, 120))
+        md, pdf = create_test_dfs(data)
+
+        def get(df):
+            return getattr(df, indexer)[row_indexer, col_indexer]
+
+        eval_general(md, pdf, get)
+        getattr(md, indexer)[row_indexer, col_indexer] = set_data
+        getattr(pdf, indexer)[row_indexer, col_indexer] = set_data
+        df_equals(md, pdf)
+        eval_general(md, pdf, get)
+
+    def test_multiindex_both_axes(self):
+        mi = pandas.MultiIndex.from_tuples(
+            [("r0", "rA"), ("r1", "rB")], names=["Courses", "Fee"]
+        )
+        cols = pandas.MultiIndex.from_tuples(
+            [("Gasoline", "Toyota"), ("Gasoline", "Ford"),
+             ("Electric", "Tesla"), ("Electric", "Nio")]
+        )
+        data = [[100, 300, 900, 400], [200, 500, 300, 600]]
+        md = pd.DataFrame(data, columns=cols, index=mi)
+        pdf = pandas.DataFrame(data, columns=cols, index=mi)
+        eval_general(md, pdf, lambda df: df.loc[("r0", "rA"), :])
+        eval_general(md, pdf, lambda df: df.loc[:, ("Gasoline", "Toyota")])
+        eval_general(md, pdf, lambda df: df.loc[("r1", "rB"), ("Electric", "Nio")])
+
+    def test_loc_empty_columns_frame(self):
+        md = pd.DataFrame(index=range(5))
+        pdf = pandas.DataFrame(index=range(5))
+        df_equals(md.loc[1], pdf.loc[1])
+        md.loc[1] = 3
+        pdf.loc[1] = 3
+        df_equals(md, pdf)
+
+    def test_loc_missing_label_raises(self):
+        md, pdf = create_test_dfs({"a": [1.0, 2, 3]}, index=["x", "y", "z"])
+        eval_general(md, pdf, lambda df: df.loc["missing"])
+        eval_general(md, pdf, lambda df: df.loc[["x", "missing"]])
+        eval_general(md, pdf, lambda df: df.loc[:, "nocol"])
+
+    def test_fallback_get_casts_modin_mask(self):
+        # empty frames take the wholesale pandas fallback; a modin boolean
+        # Series key must still align like a pandas one
+        md = pd.DataFrame(index=[0, 1, 2])
+        pdf = pandas.DataFrame(index=[0, 1, 2])
+        m_mask = pd.Series([True, False, False], index=[2, 1, 0])
+        p_mask = pandas.Series([True, False, False], index=[2, 1, 0])
+        df_equals(md.loc[m_mask], pdf.loc[p_mask])
