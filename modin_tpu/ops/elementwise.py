@@ -80,6 +80,11 @@ def _build_ops() -> dict:
         "__rand__": lambda x, y: y & x,
         "__ror__": lambda x, y: y | x,
         "__rxor__": lambda x, y: y ^ x,
+        # membership against a runtime value ARRAY (one compile per list
+        # length, values stay jit arguments); the _nan variant adds pandas'
+        # NaN-matches-NaN rule when the value list contains NaN
+        "isin_vals": lambda x, v: jnp.isin(x, v),
+        "isin_vals_nan": lambda x, v: jnp.isin(x, v) | jnp.isnan(x),
         # unary
         "abs": lambda x: abs(x),
         "negative": lambda x: -x,

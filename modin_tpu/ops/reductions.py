@@ -507,3 +507,125 @@ def idx_minmax(op_name: str, cols: List[Any], n: int, skipna: bool = True):
     positions, counts = _jit_idx_minmax(op_name, len(cols), int(n))(tuple(cols))
     fetched = jax.device_get((positions, counts))
     return [int(r) for r in fetched[0]], [int(c) for c in fetched[1]]
+
+
+# --------------------------------------------------------------------- #
+# Distinct counts and quantiles (sort-based single-column reductions)
+# --------------------------------------------------------------------- #
+
+
+def _sorted_valid(c, n):
+    """(sorted values, n_valid): NaN/pad rows sort to the tail as +inf/NaN
+    surrogates so the first n_valid entries are exactly the clean data."""
+    import jax.numpy as jnp
+
+    is_f = jnp.issubdtype(c.dtype, jnp.floating)
+    valid = _valid_mask(c, n) if c.shape[0] != n else None
+    if is_f:
+        nanm = jnp.isnan(c) if valid is None else (jnp.isnan(c) | ~valid)
+        x = jnp.where(nanm, jnp.inf, c)
+        n_valid = (n if valid is None else jnp.sum(valid)) - jnp.sum(
+            jnp.isnan(c) if valid is None else (jnp.isnan(c) & valid)
+        )
+    else:
+        x = c if valid is None else jnp.where(valid, c, _int_max(c.dtype))
+        n_valid = jnp.asarray(n, jnp.int64)
+    return jnp.sort(x), n_valid
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_nunique(n_cols: int, n: int, dropna: bool):
+    import jax
+
+    def fn(cols: Tuple):
+        import jax.numpy as jnp
+
+        out = []
+        for c in cols:
+            if c.dtype == jnp.bool_:
+                c = c.astype(jnp.int8)
+            is_f = jnp.issubdtype(c.dtype, jnp.floating)
+            xs, n_valid = _sorted_valid(c, n)
+            idx = jnp.arange(xs.shape[0])
+            firsts = jnp.concatenate(
+                [jnp.ones(1, bool), xs[1:] != xs[:-1]]
+            )
+            count = jnp.sum(firsts & (idx < n_valid))
+            if is_f and not dropna:
+                had_nan = n_valid < (
+                    n if c.shape[0] == n else jnp.sum(_valid_mask(c, n))
+                )
+                count = count + had_nan.astype(count.dtype)
+            out.append(count)
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
+def nunique_columns(cols: List[Any], n: int, dropna: bool = True) -> list:
+    """Distinct-count per padded column: sort + adjacent-difference."""
+    import jax
+
+    fn = _jit_nunique(len(cols), int(n), bool(dropna))
+    return [int(v) for v in jax.device_get(fn(tuple(cols)))]
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_quantile(n_cols: int, n: int, n_q: int, interpolation: str):
+    import jax
+
+    element_select = interpolation in ("lower", "higher", "nearest")
+
+    def fn(cols: Tuple, qs):
+        import jax.numpy as jnp
+
+        out = []
+        for c in cols:
+            is_f = jnp.issubdtype(c.dtype, jnp.floating)
+            xs, n_valid = _sorted_valid(c, n)
+            # fractional position of each q over the valid prefix
+            pos = qs * jnp.maximum(n_valid - 1, 0).astype(jnp.float64)
+            lo = jnp.floor(pos).astype(jnp.int64)
+            hi = jnp.ceil(pos).astype(jnp.int64)
+            if element_select:
+                # pandas keeps the ORIGINAL dtype value exactly (int64
+                # results stay int64) — select without a float cast
+                if interpolation == "lower":
+                    idx = lo
+                elif interpolation == "higher":
+                    idx = hi
+                else:  # nearest: numpy half-to-even
+                    idx = jnp.round(pos).astype(jnp.int64)
+                v = jnp.take(xs, idx)
+                if is_f:
+                    v = jnp.where(n_valid > 0, v, jnp.nan)
+                out.append(v)
+                continue
+            xs64 = xs.astype(jnp.float64)
+            vlo = jnp.take(xs64, lo)
+            vhi = jnp.take(xs64, hi)
+            if interpolation == "linear":
+                v = vlo + (vhi - vlo) * (pos - lo)
+            else:  # midpoint
+                v = (vlo + vhi) / 2.0
+            out.append(jnp.where(n_valid > 0, v, jnp.nan))
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
+def quantile_columns(
+    cols: List[Any], n: int, qs: List[float], interpolation: str = "linear"
+) -> list:
+    """Quantiles per padded column -> list of (n_q,) host arrays, one per
+    column, each in its pandas result dtype: float64 for 'linear'/'midpoint',
+    the column's own dtype for the element-selecting interpolations
+    ('lower'/'higher'/'nearest' — pandas keeps int64 exact there).  An
+    all-NaN/empty int column cannot carry NaN; the QC gate guarantees n>0
+    and int columns are never NaN."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _jit_quantile(len(cols), int(n), len(qs), str(interpolation))
+    results = fn(tuple(cols), jnp.asarray(qs, jnp.float64))
+    return [np.asarray(r) for r in jax.device_get(results)]

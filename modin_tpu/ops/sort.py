@@ -85,3 +85,84 @@ def lexsort_permutation(
         len(keys), int(n), tuple(bool(a) for a in ascending), na_position == "last"
     )
     return fn(tuple(keys))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_top_k(n: int, k: int, largest: bool, is_float: bool, is_int64: bool, is_signed: bool):
+    import jax
+
+    def fn(c):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        P = c.shape[0]
+        idx = jnp.arange(P)
+        valid = idx < n
+        if is_float:
+            # IEEE total-order bits: real +/-inf stay DISTINCT from the
+            # excluded (NaN/pad) rows, which get the absolute-minimum key
+            x = c.astype(jnp.float64)
+            nan_row = jnp.isnan(x) & valid
+            bad = jnp.isnan(x) | ~valid
+            bits = lax.bitcast_convert_type(x, jnp.uint64)
+            sign = (bits >> jnp.uint64(63)) == 1
+            u = jnp.where(sign, ~bits, bits | jnp.uint64(1 << 63))
+            key = u if largest else ~u
+            key = jnp.where(bad, jnp.uint64(0), key)
+            n_valid = jnp.sum(~bad)
+        elif is_int64:
+            # signed: order-preserving sign-bit bias to uint64; unsigned:
+            # already ordered. Complement flips for smallest-first without
+            # the INT_MIN negation overflow.
+            if is_signed:
+                u = c.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+            else:
+                u = c.astype(jnp.uint64)
+            key = u if largest else ~u
+            key = jnp.where(valid, key, jnp.uint64(0))
+            nan_row = jnp.zeros(P, bool)
+            n_valid = jnp.sum(valid)
+        else:
+            x = c.astype(jnp.int64)
+            pad = np.iinfo(np.int64).min if largest else np.iinfo(np.int64).max
+            x = jnp.where(valid, x, pad)
+            key = x if largest else -x
+            nan_row = jnp.zeros(P, bool)
+            n_valid = jnp.sum(valid)
+        _, positions = lax.top_k(key, k)
+        # earliest NaN rows, in original order (pandas pads the result with
+        # them when k exceeds the valid count)
+        nan_key = jnp.where(nan_row, jnp.int64(P) - idx, jnp.int64(-1))
+        _, nan_positions = lax.top_k(nan_key, k)
+        return positions, nan_positions, n_valid
+
+    return jax.jit(fn)
+
+
+def top_k_positions(col, n: int, k: int, largest: bool):
+    """Row positions for pandas nlargest/nsmallest keep='first': the k
+    best valid values (ties keep the earlier row — XLA top_k is stable),
+    then earliest NaN rows as filler when k exceeds the valid count.
+    Returns (positions ndarray of length min(k, n), n_valid)."""
+    import jax
+    import jax.numpy as jnp
+
+    k = max(min(int(k), int(n)), 0)
+    if k == 0:
+        return np.empty(0, np.int64), 0
+    is_float = jnp.issubdtype(col.dtype, jnp.floating)
+    is_int64 = col.dtype in (jnp.int64, jnp.uint64)
+    is_signed = col.dtype != jnp.uint64
+    fn = _jit_top_k(
+        int(n), k, bool(largest), bool(is_float), bool(is_int64), bool(is_signed)
+    )
+    positions, nan_positions, n_valid = jax.device_get(fn(col))
+    n_valid = int(n_valid)
+    if k <= n_valid:
+        return np.asarray(positions[:k], np.int64), n_valid
+    filler = np.asarray(nan_positions[: k - n_valid], np.int64)
+    return (
+        np.concatenate([np.asarray(positions[:n_valid], np.int64), filler]),
+        n_valid,
+    )
+

@@ -1,0 +1,191 @@
+"""Device paths for sort/search-shaped ops: nunique, quantile,
+nlargest/nsmallest (lax.top_k), isin(value list).
+
+Differential vs pandas, with path-taken assertions via the fallback
+warning (tests.utils.assert_no_fallback)."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import assert_no_fallback, create_test_dfs, df_equals, eval_general
+
+_rng = np.random.default_rng(67)
+
+
+@pytest.fixture
+def dfs():
+    n = 500
+    v = _rng.normal(size=n)
+    v[[3, 77, 200]] = np.nan
+    data = {
+        "k": _rng.integers(-10, 10, n),
+        "v": v,
+        "b": _rng.random(n) < 0.5,
+    }
+    return create_test_dfs(data)
+
+
+class TestNunique:
+    def test_frame_and_series(self, dfs):
+        md, pdf = dfs
+        for dropna in (True, False):
+            got = assert_no_fallback(lambda: md.nunique(dropna=dropna))
+            df_equals(got, pdf.nunique(dropna=dropna))
+        assert md["k"].nunique() == pdf["k"].nunique()
+        assert md["v"].nunique(dropna=False) == pdf["v"].nunique(dropna=False)
+
+    def test_all_nan_and_constant(self):
+        md, pdf = create_test_dfs({"a": [np.nan] * 6, "c": [2.5] * 6})
+        eval_general(md, pdf, lambda df: df.nunique())
+        eval_general(md, pdf, lambda df: df.nunique(dropna=False))
+
+
+class TestQuantileDevice:
+    @pytest.fixture
+    def num_dfs(self, dfs):
+        md, pdf = dfs
+        return md[["k", "v"]], pdf[["k", "v"]]
+
+    @pytest.mark.parametrize(
+        "interpolation", ["linear", "lower", "higher", "midpoint", "nearest"]
+    )
+    def test_interpolations(self, num_dfs, interpolation):
+        md, pdf = num_dfs
+        got = assert_no_fallback(
+            lambda: md.quantile(0.35, interpolation=interpolation)
+        )
+        df_equals(got, pdf.quantile(0.35, interpolation=interpolation))
+
+    def test_list_q(self, num_dfs):
+        md, pdf = num_dfs
+        eval_general(md, pdf, lambda df: df.quantile([0.0, 0.25, 0.5, 1.0]))
+
+    def test_bool_column_raises_like_pandas(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df.quantile(0.5))
+
+    def test_series_and_edges(self, dfs):
+        md, pdf = dfs
+        assert np.isclose(md["v"].quantile(0.8), pdf["v"].quantile(0.8))
+        # all-NaN -> NaN like pandas
+        ma, pa = create_test_dfs({"a": [np.nan, np.nan]})
+        eval_general(ma, pa, lambda df: df.quantile(0.5))
+
+    def test_numeric_only_with_string_column(self):
+        md, pdf = create_test_dfs({"a": [3.0, 1.0, 2.0], "s": ["x", "y", "z"]})
+        eval_general(md, pdf, lambda df: df.quantile(0.5, numeric_only=True))
+
+
+class TestTopK:
+    def test_frame_nlargest_nsmallest(self, dfs):
+        md, pdf = dfs
+        for op in ("nlargest", "nsmallest"):
+            got = assert_no_fallback(lambda: getattr(md, op)(7, "v"))
+            df_equals(got, getattr(pdf, op)(7, "v"))
+            eval_general(md, pdf, lambda df: getattr(df, op)(4, "k"))
+
+    def test_series_topk(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df["v"].nlargest(6))
+        eval_general(md, pdf, lambda df: df["k"].nsmallest(6))
+
+    def test_nan_excluded_and_k_exceeds_valid(self):
+        md, pdf = create_test_dfs({"v": [1.0, np.nan, 3.0, np.nan, 2.0]})
+        eval_general(md, pdf, lambda df: df.nlargest(5, "v"))
+        eval_general(md, pdf, lambda df: df["v"].nsmallest(10))
+
+    def test_ties_keep_first(self):
+        md, pdf = create_test_dfs({"v": [2.0, 1.0, 2.0, 2.0, 1.0]})
+        eval_general(md, pdf, lambda df: df.nlargest(2, "v"))
+        eval_general(md, pdf, lambda df: df["v"].nsmallest(1))
+
+    def test_int64_extremes(self):
+        lo, hi = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+        md, pdf = create_test_dfs({"v": [0, lo, hi, lo + 1, hi - 1, 5]})
+        eval_general(md, pdf, lambda df: df.nlargest(3, "v"))
+        eval_general(md, pdf, lambda df: df.nsmallest(3, "v"))
+
+    def test_keep_variants_fall_back_correct(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df.nlargest(3, "k", keep="last"))
+        eval_general(md, pdf, lambda df: df["k"].nlargest(3, keep="all"))
+
+    def test_multi_column_falls_back_correct(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df.nlargest(5, ["k", "v"]))
+
+
+class TestIsinDevice:
+    def test_frame_and_series(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.isin([1, 2, -3]))
+        df_equals(got, pdf.isin([1, 2, -3]))
+        eval_general(md, pdf, lambda df: df["v"].isin([0.5]))
+
+    def test_nan_matches_nan(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df["v"].isin([np.nan]))
+        eval_general(md, pdf, lambda df: df.isin([np.nan, 1.0]))
+
+    def test_bool_and_mixed_values(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df["b"].isin([True]))
+        eval_general(md, pdf, lambda df: df.isin([True, 2, 0.5]))
+
+    def test_nonscalar_values_fall_back_correct(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df["k"].isin(["x", 1]))
+        eval_general(
+            md, pdf, lambda df: df.isin({"k": [1, 2], "v": [0.5]})
+        )
+        # Series-valued isin aligns on index in DataFrame.isin
+        eval_general(md, pdf, lambda df: df["k"].isin(df["k"].head(10)))
+
+
+class TestReviewScenarios:
+    """Exact shapes from review: NaN vs real infinities in top_k, uint64
+    ordering, half-to-even nearest, int64-exact quantile and isin."""
+
+    def test_topk_nan_vs_real_infinities(self):
+        md, pdf = create_test_dfs({"v": [1.0, np.nan, -np.inf, np.inf]})
+        eval_general(md, pdf, lambda df: df["v"].nlargest(4))
+        eval_general(md, pdf, lambda df: df["v"].nsmallest(4))
+        eval_general(md, pdf, lambda df: df.nlargest(3, "v"))
+
+    def test_topk_uint64_above_sign_bit(self):
+        vals = np.array([1, 2**63, 2**64 - 1, 7], dtype=np.uint64)
+        md, pdf = create_test_dfs({"v": vals})
+        eval_general(md, pdf, lambda df: df.nlargest(2, "v"))
+        eval_general(md, pdf, lambda df: df.nsmallest(2, "v"))
+
+    def test_quantile_nearest_half_to_even(self):
+        md, pdf = create_test_dfs({"v": [10.0, 20.0, 30.0]})
+        eval_general(
+            md, pdf, lambda df: df["v"].quantile(0.75, interpolation="nearest")
+        )
+        eval_general(
+            md, pdf, lambda df: df.quantile(0.25, interpolation="nearest")
+        )
+
+    def test_quantile_int64_exact_element_select(self):
+        big = 2**53 + 1
+        md, pdf = create_test_dfs({"v": [big, 5, big + 2]})
+        for interp in ("lower", "higher", "nearest"):
+            eval_general(
+                md, pdf, lambda df, i=interp: df.quantile(1.0, interpolation=i)
+            )
+            got = md["v"].quantile(1.0, interpolation=interp)
+            want = pdf["v"].quantile(1.0, interpolation=interp)
+            assert got == want and type(got) is type(want), (got, want)
+
+    def test_isin_int64_beyond_f64_precision(self):
+        big = 2**53
+        md, pdf = create_test_dfs({"v": np.array([big, big + 1], dtype=np.int64)})
+        # all-int value lists compare exactly (numpy int promotion)...
+        eval_general(md, pdf, lambda df: df["v"].isin([big]))
+        eval_general(md, pdf, lambda df: df["v"].isin([big + 1]))
+        # ...while a float in the list promotes the whole comparison to
+        # float64, lossy — exactly as pandas behaves
+        eval_general(md, pdf, lambda df: df["v"].isin([0.5, big]))
