@@ -995,6 +995,74 @@ class TpuQueryCompiler(BaseQueryCompiler):
     series_nlargest._pandas_signature_default = True
     series_nsmallest._pandas_signature_default = True
 
+    def _duplicated_device_mask(self, subset: Any, keep: Any):
+        """Device duplicate-row mask over the subset columns, or None when
+        the gate fails (non-device/non-numeric keys, exotic keep)."""
+        from modin_tpu.ops.join import duplicated_mask
+
+        if keep not in ("first", "last", False):
+            return None
+        frame = self._modin_frame
+        if len(frame) == 0:
+            return None
+        if subset is None:
+            positions = list(range(frame.num_cols))
+        else:
+            # pandas accepts any list-like subset; a tuple stays one label
+            if isinstance(subset, (list, np.ndarray, pandas.Index, pandas.Series)):
+                subset_list = list(subset)
+            else:
+                subset_list = [subset]
+            positions = []
+            for label in subset_list:
+                matches = frame.column_position(label)
+                if len(matches) != 1 or matches[0] < 0:
+                    return None  # missing/duplicate label: pandas raises
+                positions.append(matches[0])
+        if not positions or not all(
+            frame._columns[i].is_device
+            and frame._columns[i].pandas_dtype.kind in "biuf"
+            for i in positions
+        ):
+            return None
+        frame.materialize_device()
+        return duplicated_mask(
+            [frame._columns[i].data for i in positions], len(frame), keep
+        )
+
+    def duplicated(self, subset: Any = None, keep: Any = "first", **kwargs: Any):
+        mask = (
+            self._duplicated_device_mask(subset, keep) if not kwargs else None
+        )
+        if mask is not None:
+            return self._wrap_device_result(
+                [mask],
+                dtypes=[np.dtype(bool)],
+                col_labels=pandas.Index([MODIN_UNNAMED_SERIES_LABEL]),
+            )
+        return super().duplicated(subset=subset, keep=keep, **kwargs)
+
+    def drop_duplicates(
+        self,
+        subset: Any = None,
+        keep: Any = "first",
+        ignore_index: bool = False,
+        **kwargs: Any,
+    ):
+        mask = (
+            self._duplicated_device_mask(subset, keep) if not kwargs else None
+        )
+        if mask is not None:
+            new_frame = self._modin_frame.filter_rows_mask_device(~mask)
+            if ignore_index:
+                # the filter already synced the kept-count; a fresh
+                # RangeIndex costs nothing and keeps device residency
+                new_frame.index = pandas.RangeIndex(len(new_frame))
+            return type(self)(new_frame)
+        return super().drop_duplicates(
+            subset=subset, keep=keep, ignore_index=ignore_index, **kwargs
+        )
+
     def isin(self, values: Any, ignore_indices: bool = False, **kwargs: Any) -> "TpuQueryCompiler":
         frame = self._modin_frame
         scalar_list = isinstance(values, (list, tuple, set, frozenset, np.ndarray))

@@ -256,3 +256,58 @@ def gather_right_columns(cols, positions) -> list:
     if not cols:
         return []
     return list(_jit_gather_with_null(len(cols))(tuple(cols), positions))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_duplicated(n_cols: int, float_flags: Tuple[bool, ...], n: int, keep: Any):
+    """Row-duplicate mask over one frame's key columns.
+
+    The same rank-fold as the join codes, against a single frame: per
+    column rank via sorted searchsorted (floats through the IEEE total
+    order, so every NaN compares equal — pandas duplicated treats NaNs as
+    duplicates of each other), composite re-ranked per fold to stay in
+    int64.  A stable argsort of the codes groups equal rows with original
+    order preserved; first/last flags inside each group give every keep
+    variant, scattered back to row positions."""
+    import jax
+    import jax.numpy as jnp
+
+    def rank(v):
+        s = jnp.sort(v)
+        return jnp.searchsorted(s, v, side="left")
+
+    def fn(cols: Tuple):
+        P = cols[0].shape[0]
+        valid = jnp.arange(P) < n
+        code = None
+        for c, is_f in zip(cols, float_flags):
+            v = _total_order(c) if is_f else c.astype(jnp.int64)
+            r = rank(v)
+            code = r if code is None else rank(code * jnp.int64(P) + r)
+        code = jnp.where(valid, code, jnp.int64(-1))  # pads group below
+        order = jnp.argsort(code, stable=True)
+        sc = jnp.take(code, order)
+        change = sc[1:] != sc[:-1]
+        first = jnp.concatenate([jnp.ones(1, bool), change])
+        last = jnp.concatenate([change, jnp.ones(1, bool)])
+        if keep == "first":
+            dup_sorted = ~first
+        elif keep == "last":
+            dup_sorted = ~last
+        else:  # keep=False: every member of a >1 group
+            dup_sorted = ~(first & last)
+        return jnp.zeros(P, bool).at[order].set(dup_sorted)
+
+    return jax.jit(fn)
+
+
+def duplicated_mask(cols: list, n: int, keep: Any):
+    """Boolean duplicate-row mask (pandas ``duplicated`` semantics) over
+    padded device key columns."""
+    import jax.numpy as jnp
+
+    float_flags = tuple(
+        bool(jnp.issubdtype(c.dtype, jnp.floating)) for c in cols
+    )
+    fn = _jit_duplicated(len(cols), float_flags, int(n), keep)
+    return fn(tuple(cols))

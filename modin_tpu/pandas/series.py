@@ -478,7 +478,8 @@ class Series(BasePandasDataset):
         return Series(query_compiler=qc)
 
     def duplicated(self, keep: Any = "first") -> "Series":
-        return self.to_frame("__dup__").duplicated(keep=keep)
+        # pandas keeps the series name on the boolean result
+        return self.to_frame("__dup__").duplicated(keep=keep).rename(self.name)
 
     def drop_duplicates(self, *, keep: Any = "first", inplace: bool = False, ignore_index: bool = False):
         result = self._default_to_pandas(

@@ -189,3 +189,69 @@ class TestReviewScenarios:
         # ...while a float in the list promotes the whole comparison to
         # float64, lossy — exactly as pandas behaves
         eval_general(md, pdf, lambda df: df["v"].isin([0.5, big]))
+
+
+class TestDuplicatedDevice:
+    """Device duplicated/drop_duplicates via rank-fold row codes."""
+
+    @pytest.fixture
+    def dup_dfs(self):
+        rng = np.random.default_rng(91)
+        n = 400
+        v = rng.normal(size=n).round(1)
+        v[::13] = np.nan
+        return create_test_dfs(
+            {"k": rng.integers(0, 6, n), "v": v, "b": rng.random(n) < 0.5}
+        )
+
+    @pytest.mark.parametrize("keep", ["first", "last", False])
+    def test_duplicated_keeps(self, dup_dfs, keep):
+        md, pdf = dup_dfs
+        got = assert_no_fallback(lambda: md.duplicated(keep=keep))
+        df_equals(got, pdf.duplicated(keep=keep))
+
+    def test_subset_and_nan_equality(self, dup_dfs):
+        md, pdf = dup_dfs
+        eval_general(md, pdf, lambda df: df.duplicated(subset=["k"]))
+        eval_general(md, pdf, lambda df: df.duplicated(subset=["v", "k"]))
+        # every NaN is a duplicate of every other NaN, like pandas
+        ma, pa = create_test_dfs({"x": [np.nan, 1.0, np.nan, np.nan]})
+        eval_general(ma, pa, lambda df: df.duplicated())
+
+    @pytest.mark.parametrize("keep", ["first", "last"])
+    def test_drop_duplicates(self, dup_dfs, keep):
+        md, pdf = dup_dfs
+        got = assert_no_fallback(lambda: md.drop_duplicates(keep=keep))
+        df_equals(got, pdf.drop_duplicates(keep=keep))
+        eval_general(
+            md, pdf,
+            lambda df: df.drop_duplicates(subset=["k"], ignore_index=True),
+        )
+
+    def test_series_duplicated_keeps_name(self, dup_dfs):
+        md, pdf = dup_dfs
+        eval_general(md, pdf, lambda df: df["v"].duplicated())
+        eval_general(md, pdf, lambda df: df["k"].duplicated(keep=False))
+
+    def test_missing_subset_label_raises(self, dup_dfs):
+        md, pdf = dup_dfs
+        eval_general(md, pdf, lambda df: df.duplicated(subset=["nope"]))
+
+    def test_string_column_falls_back_correct(self):
+        md, pdf = create_test_dfs({"s": ["a", "b", "a"], "v": [1.0, 2.0, 1.0]})
+        eval_general(md, pdf, lambda df: df.duplicated())
+        eval_general(md, pdf, lambda df: df.drop_duplicates())
+
+    def test_arraylike_subset_and_ignore_index_residency(self, dup_dfs):
+        md, pdf = dup_dfs
+        eval_general(md, pdf, lambda df: df.duplicated(subset=np.array(["k", "v"])))
+        eval_general(md, pdf, lambda df: df.duplicated(subset=pandas.Index(["k"])))
+        # ignore_index must not bounce through a pandas round trip
+        got = assert_no_fallback(
+            lambda: md.drop_duplicates(subset=["k"], ignore_index=True)
+        )
+        df_equals(got, pdf.drop_duplicates(subset=["k"], ignore_index=True))
+        assert all(
+            c.is_device for c in got._query_compiler._modin_frame._columns
+            if c.pandas_dtype.kind in "biuf"
+        )
