@@ -995,6 +995,54 @@ class TpuQueryCompiler(BaseQueryCompiler):
     series_nlargest._pandas_signature_default = True
     series_nsmallest._pandas_signature_default = True
 
+    def rank(
+        self,
+        axis: int = 0,
+        method: str = "average",
+        numeric_only: bool = False,
+        na_option: str = "keep",
+        ascending: bool = True,
+        pct: bool = False,
+        **kwargs: Any,
+    ):
+        frame = self._modin_frame
+        device_ok = (
+            axis in (0, None)
+            and not kwargs
+            and method in ("average", "min", "max", "first", "dense")
+            and na_option in ("keep", "top", "bottom")
+            and isinstance(ascending, (bool, np.bool_))
+            and isinstance(pct, (bool, np.bool_))
+            and len(frame) > 0
+        )
+        if device_ok:
+            positions = []
+            for i, col in enumerate(frame._columns):
+                if col.is_device and col.pandas_dtype.kind in "biuf":
+                    positions.append(i)
+                elif numeric_only and col.pandas_dtype.kind not in "biufc":
+                    continue  # pandas drops it
+                else:
+                    device_ok = False
+                    break
+        if device_ok and positions:
+            from modin_tpu.ops.sort import rank_columns
+
+            frame.materialize_device()
+            datas = rank_columns(
+                [frame._columns[i].data for i in positions], len(frame),
+                method, bool(ascending), na_option, bool(pct),
+            )
+            return self._wrap_device_result(
+                datas,
+                dtypes=[np.dtype(np.float64)] * len(datas),
+                col_labels=frame.columns[positions],
+            )
+        return super().rank(
+            axis=axis, method=method, numeric_only=numeric_only,
+            na_option=na_option, ascending=ascending, pct=pct, **kwargs,
+        )
+
     def _duplicated_device_mask(self, subset: Any, keep: Any):
         """Device duplicate-row mask over the subset columns, or None when
         the gate fails (non-device/non-numeric keys, exotic keep)."""

@@ -166,3 +166,90 @@ def top_k_positions(col, n: int, k: int, largest: bool):
         n_valid,
     )
 
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_rank(n_cols: int, float_flags: Tuple[bool, ...], n: int, method: str,
+              ascending: bool, na_option: str, pct: bool):
+    """Column rank with full pandas tie/NaN semantics.
+
+    Sort once per column (order-preserving uint64 keys; NaNs collapse to
+    one tied key and zone-sort to the top/bottom/tail per na_option, pads
+    strictly last), then every method is a per-group statistic over the
+    sorted run: first/last indexes of each tie group give min/max/average,
+    the running group ordinal gives dense, and the sorted position itself
+    gives 'first'.  Ranks scatter back through the sort permutation."""
+    import jax
+    import jax.numpy as jnp
+
+    from modin_tpu.ops.structural import float_total_order
+
+    def one(c):
+        P = c.shape[0]
+        idx = jnp.arange(P)
+        valid = idx < n
+        is_f = jnp.issubdtype(c.dtype, jnp.floating)
+        nanm = (jnp.isnan(c) & valid) if is_f else jnp.zeros(P, bool)
+        if jnp.issubdtype(c.dtype, jnp.unsignedinteger):
+            ku = c.astype(jnp.uint64)  # already in key order, no sign bias
+        else:
+            t = float_total_order(c) if is_f else c.astype(jnp.int64)
+            ku = t.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+        if not ascending:
+            ku = ~ku
+        ku = jnp.where(nanm, jnp.uint64(0), ku)  # NaNs tie with each other
+        nan_zone = 0 if na_option == "top" else 2
+        zone = jnp.where(valid, jnp.where(nanm, nan_zone, 1), 3).astype(jnp.uint8)
+        order = jnp.lexsort((ku, zone))  # primary zone, then key, stable
+        sku = jnp.take(ku, order)
+        szone = jnp.take(zone, order)
+        change = (szone[1:] != szone[:-1]) | (sku[1:] != sku[:-1])
+        first = jnp.concatenate([jnp.ones(1, bool), change])
+        pos = idx  # position within the sorted order
+        f_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(first, pos, 0))
+        last = jnp.concatenate([change, jnp.ones(1, bool)])
+        l_idx = (P - 1) - jax.lax.associative_scan(
+            jnp.maximum, jnp.where(last[::-1], pos, 0)
+        )[::-1]
+        if method == "average":
+            ranks = (f_idx + l_idx).astype(jnp.float64) / 2.0 + 1.0
+        elif method == "min":
+            ranks = f_idx.astype(jnp.float64) + 1.0
+        elif method == "max":
+            ranks = l_idx.astype(jnp.float64) + 1.0
+        elif method == "first":
+            ranks = pos.astype(jnp.float64) + 1.0
+        else:  # dense
+            ranks = jnp.cumsum(first.astype(jnp.int64)).astype(jnp.float64)
+        out = jnp.zeros(P, jnp.float64).at[order].set(ranks)
+        counted = valid if na_option in ("top", "bottom") else (valid & ~nanm)
+        if pct:
+            if method == "dense":
+                denom = jnp.max(jnp.where(counted, out, 0.0))
+            else:
+                denom = jnp.sum(counted).astype(jnp.float64)
+            out = out / jnp.maximum(denom, 1.0)
+        if na_option == "keep":
+            out = jnp.where(nanm, jnp.nan, out)
+        return out
+
+    def fn(cols: Tuple):
+        return tuple(one(c) for c in cols)
+
+    return jax.jit(fn)
+
+
+def rank_columns(
+    cols: List[Any], n: int, method: str, ascending: bool, na_option: str,
+    pct: bool,
+) -> List[Any]:
+    import jax.numpy as jnp
+
+    float_flags = tuple(
+        bool(jnp.issubdtype(c.dtype, jnp.floating)) for c in cols
+    )
+    fn = _jit_rank(
+        len(cols), float_flags, int(n), str(method), bool(ascending),
+        str(na_option), bool(pct),
+    )
+    return list(fn(tuple(cols)))

@@ -255,3 +255,57 @@ class TestDuplicatedDevice:
             c.is_device for c in got._query_compiler._modin_frame._columns
             if c.pandas_dtype.kind in "biuf"
         )
+
+
+class TestRankDevice:
+    """Device rank: sorted tie-group statistics with pandas NaN zones."""
+
+    @pytest.fixture
+    def rank_dfs(self):
+        rng = np.random.default_rng(101)
+        n = 300
+        v = rng.normal(size=n).round(1)
+        v[::11] = np.nan
+        return create_test_dfs(
+            {"k": rng.integers(-4, 4, n), "v": v, "b": rng.random(n) < 0.5}
+        )
+
+    @pytest.mark.parametrize("method", ["average", "min", "max", "first", "dense"])
+    @pytest.mark.parametrize("ascending", [True, False])
+    def test_methods(self, rank_dfs, method, ascending):
+        md, pdf = rank_dfs
+        got = assert_no_fallback(
+            lambda: md.rank(method=method, ascending=ascending)
+        )
+        df_equals(got, pdf.rank(method=method, ascending=ascending))
+
+    @pytest.mark.parametrize("na_option", ["keep", "top", "bottom"])
+    @pytest.mark.parametrize("pct", [False, True])
+    def test_na_and_pct(self, rank_dfs, na_option, pct):
+        md, pdf = rank_dfs
+        eval_general(
+            md, pdf, lambda df: df.rank(na_option=na_option, pct=pct)
+        )
+        eval_general(
+            md, pdf,
+            lambda df: df["v"].rank(
+                method="dense", na_option=na_option, pct=pct
+            ),
+        )
+
+    def test_numeric_only_and_string_fallback(self):
+        md, pdf = create_test_dfs({"a": [3.0, 1.0, 2.0], "s": ["x", "z", "y"]})
+        eval_general(md, pdf, lambda df: df.rank(numeric_only=True))
+        eval_general(md, pdf, lambda df: df.rank())  # lexical string ranks
+        eval_general(md, pdf, lambda df: df.rank(axis=1))
+
+    def test_all_nan_and_ties(self):
+        md, pdf = create_test_dfs({"a": [np.nan, np.nan], "t": [1.0, 1.0]})
+        eval_general(md, pdf, lambda df: df.rank())
+        eval_general(md, pdf, lambda df: df.rank(method="dense", pct=True))
+
+    def test_uint64_above_sign_bit(self):
+        vals = np.array([2**63, 1, 2**64 - 1, 5], dtype=np.uint64)
+        md, pdf = create_test_dfs({"u": vals})
+        eval_general(md, pdf, lambda df: df.rank())
+        eval_general(md, pdf, lambda df: df.rank(ascending=False, method="min"))
