@@ -166,16 +166,18 @@ def _scalar_binop(method: str, left: Any, right: Any) -> Any:
     return _MIRROR[method](left, right)
 
 
-def caller_namespace() -> Dict[str, Any]:
+def caller_namespace(extra_levels: int = 0) -> Dict[str, Any]:
     """Namespace of the frame that invoked ``DataFrame.query``/``eval``.
 
     Captured at the API call site and passed down explicitly.  Resolution
     walks outward past modin_tpu-internal frames (logging wrappers, fallback
     installers sit between the public method and the user), landing on the
     user's direct calling frame — the same frame pandas' level-based lookup
-    resolves for a direct ``df.query(...)`` call.  A caller-supplied
-    ``level=`` kwarg routes to the pandas fallback untouched, so explicit
-    level overrides keep exact pandas semantics.
+    resolves for a direct ``df.query(...)`` call.  ``extra_levels`` walks
+    that many additional user frames outward, mirroring a caller-supplied
+    ``level=`` kwarg (pandas counts levels above its own internals, so the
+    captured namespace must too — the fallback executes deep inside the QC
+    layers where pandas' own frame walk would land on modin_tpu frames).
     """
     import sys
 
@@ -183,6 +185,10 @@ def caller_namespace() -> Dict[str, Any]:
     while frame is not None and frame.f_globals.get("__name__", "").startswith(
         "modin_tpu"
     ):
+        frame = frame.f_back
+    for _ in range(extra_levels):
+        if frame is None:
+            break
         frame = frame.f_back
     if frame is None:
         return {}

@@ -206,8 +206,12 @@ class RollingDefault(DefaultMethod):
         def caller(
             query_compiler: Any, rolling_kwargs: dict, *args: Any, **kwargs: Any
         ) -> Any:
-            df = query_compiler.to_pandas()
-            if squeeze_self:
+            from modin_tpu.utils import qc_to_pandas_for_write
+
+            # series-shaped compilers run through Series.rolling so
+            # pandas' own result shapes/naming apply (cov/corr vs a Series)
+            df = qc_to_pandas_for_write(query_compiler)
+            if squeeze_self and isinstance(df, pandas.DataFrame):
                 df = df.squeeze(axis=1)
             ErrorMessage.default_to_pandas(f"`Rolling.{fn_name}`")
             roller = df.rolling(**rolling_kwargs)
@@ -230,8 +234,12 @@ class ExpandingDefault(DefaultMethod):
         def caller(
             query_compiler: Any, expanding_args: list, *args: Any, **kwargs: Any
         ) -> Any:
-            df = query_compiler.to_pandas()
-            if squeeze_self:
+            from modin_tpu.utils import qc_to_pandas_for_write
+
+            # series-shaped compilers run through Series.expanding so
+            # pandas' own result shapes/naming apply (cov/corr vs a Series)
+            df = qc_to_pandas_for_write(query_compiler)
+            if squeeze_self and isinstance(df, pandas.DataFrame):
                 df = df.squeeze(axis=1)
             ErrorMessage.default_to_pandas(f"`Expanding.{fn_name}`")
             roller = df.expanding(*expanding_args)
@@ -257,10 +265,12 @@ class EwmDefault(DefaultMethod):
         def caller(
             query_compiler: Any, ewm_kwargs: dict, *args: Any, **kwargs: Any
         ) -> Any:
-            from modin_tpu.utils import try_cast_to_pandas
+            from modin_tpu.utils import qc_to_pandas_for_write, try_cast_to_pandas
 
-            df = query_compiler.to_pandas()
-            if squeeze_self:
+            # series-shaped compilers run through Series.ewm so pandas' own
+            # result-naming conventions apply (cov/corr vs another Series)
+            df = qc_to_pandas_for_write(query_compiler)
+            if squeeze_self and isinstance(df, pandas.DataFrame):
                 df = df.squeeze(axis=1)
             ErrorMessage.default_to_pandas(f"`ExponentialMovingWindow.{fn_name}`")
             roller = df.ewm(**ewm_kwargs)

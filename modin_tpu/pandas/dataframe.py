@@ -942,8 +942,12 @@ class DataFrame(BasePandasDataset):
     def eval(self, expr: str, inplace: bool = False, **kwargs: Any):
         from modin_tpu.core.computation.eval import caller_namespace, try_eval
 
+        ns = (
+            caller_namespace(int(kwargs.get("level", 0) or 0))
+            if "@" in expr and "local_dict" not in kwargs
+            else None
+        )
         if not kwargs:
-            ns = caller_namespace() if "@" in expr else None
             native = try_eval(self, expr, ns)
             if native is not None:
                 result, assigned = native
@@ -957,6 +961,12 @@ class DataFrame(BasePandasDataset):
                 if not inplace:
                     return result
                 raise ValueError("Cannot operate inplace if there is no assignment")
+        if ns is not None:
+            # the pandas fallback runs deep inside the QC layers where the
+            # user's locals are out of frame-walking reach; level is already
+            # folded into the captured namespace
+            kwargs["local_dict"] = ns
+            kwargs.pop("level", None)
         result = self._default_to_pandas("eval", expr, **kwargs)
         if inplace:
             if isinstance(result, DataFrame):
@@ -968,11 +978,15 @@ class DataFrame(BasePandasDataset):
     def query(self, expr: str, *, inplace: bool = False, **kwargs: Any):
         from modin_tpu.core.computation.eval import caller_namespace
 
+        ns = (
+            caller_namespace(int(kwargs.get("level", 0) or 0))
+            if "@" in expr and "local_dict" not in kwargs
+            else None
+        )
         if not kwargs:
             # named QC seam first (reference dataframe.py:1788): the storage
             # format compiles simple row-wise expressions natively and raises
             # NotImplementedError to route everything else to the fallback
-            ns = caller_namespace() if "@" in expr else None
             try:
                 new_qc = self._query_compiler.rowwise_query(expr, local_dict=ns)
             except NotImplementedError:
@@ -982,6 +996,12 @@ class DataFrame(BasePandasDataset):
                     self._update_inplace(new_qc)
                     return None
                 return DataFrame(query_compiler=new_qc)
+        if ns is not None:
+            # the pandas fallback runs deep inside the QC layers where the
+            # user's locals are out of frame-walking reach; level is already
+            # folded into the captured namespace
+            kwargs["local_dict"] = ns
+            kwargs.pop("level", None)
         result = self._default_to_pandas("query", expr, **kwargs)
         if inplace:
             self._update_inplace(result._query_compiler)

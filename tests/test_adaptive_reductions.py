@@ -12,6 +12,7 @@ import pandas
 import pytest
 
 from modin_tpu.ops.reductions import _reduce_one
+from tests.utils import require_tpu_execution
 
 OPS = ["sum", "prod", "count", "min", "max", "mean", "var", "std", "sem"]
 
@@ -138,6 +139,7 @@ class TestShardedAdaptive:
     def test_qc_reduction_takes_sharded_adaptive_path(self, monkeypatch):
         """df.sum() on an evenly-sharded float frame must route through the
         shard_map formulation (and agree with pandas)."""
+        require_tpu_execution()
         import modin_tpu.ops.reductions as red
         from modin_tpu.parallel.mesh import num_row_shards
 

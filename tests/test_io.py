@@ -14,7 +14,7 @@ from modin_tpu.core.io.chunker import (
     find_header_end,
     split_record_ranges,
 )
-from tests.utils import df_equals, eval_general
+from tests.utils import df_equals, eval_general, require_tpu_execution
 
 
 @pytest.fixture
@@ -143,6 +143,7 @@ class TestParquet:
     def test_multi_row_group_read_parallel(self, tmp_path, monkeypatch):
         """The row-group-parallel read path must engage on ≥4-group files and
         match pandas exactly (reference: parquet_dispatcher.py:350)."""
+        require_tpu_execution()
         pytest.importorskip("pyarrow")
         import modin_tpu.core.io.column_stores.parquet_dispatcher as disp
 
@@ -203,6 +204,7 @@ class TestParquet:
         """Streamed writer: multiple windows must concatenate into a file
         byte-equal in content to a single-shot pandas write, including a
         non-trivial index (reference: parquet_dispatcher.py:912)."""
+        require_tpu_execution()
         pytest.importorskip("pyarrow")
         import pyarrow.parquet as pq
 
@@ -231,6 +233,7 @@ class TestParquet:
         df_equals(pandas.read_parquet(path2), md2.modin.to_pandas())
 
     def test_to_parquet_no_fallback_warning(self, tmp_path):
+        require_tpu_execution()
         pytest.importorskip("pyarrow")
         import warnings
 
@@ -537,6 +540,7 @@ class TestStreamedTextWriters:
         assert md.to_json() == pdf.to_json()
 
     def test_streamed_write_no_full_gather(self, frame, tmp_path, monkeypatch):
+        require_tpu_execution()
         # the streamed path must never call qc.to_pandas() on the FULL frame
         md, _ = frame
         qc = md._query_compiler
@@ -577,6 +581,7 @@ class TestStreamedTextWriters:
         )
 
     def test_to_json_explicit_no_compression_streams(self, frame, tmp_path):
+        require_tpu_execution()
         md, pdf = frame
         mp_, pp = tmp_path / "m.jsonl", tmp_path / "p.jsonl"
         import modin_tpu.core.storage_formats.tpu.query_compiler as qc_mod
@@ -606,6 +611,7 @@ class TestFeather:
     of the parquet row-group paths)."""
 
     def test_roundtrip_multibatch(self, tmp_path, monkeypatch):
+        require_tpu_execution()
         import modin_tpu.core.io.column_stores.parquet_dispatcher as pq_mod
 
         monkeypatch.setattr(pq_mod, "_WRITE_CHUNK_ROWS", 50)
@@ -654,6 +660,7 @@ class TestFeather:
     def test_parallel_read_path_actually_engages(self, tmp_path, monkeypatch):
         """The frontend binds every signature default; the parallel reader
         must still engage (it was dead code before the default filter)."""
+        require_tpu_execution()
         import modin_tpu.core.io.column_stores.parquet_dispatcher as disp
 
         rng = np.random.default_rng(3)

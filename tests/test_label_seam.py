@@ -10,7 +10,7 @@ import pandas
 import pytest
 
 import modin_tpu.pandas as pd
-from tests.utils import create_test_dfs, df_equals, eval_general
+from tests.utils import create_test_dfs, df_equals, eval_general, require_tpu_execution
 
 _rng = np.random.default_rng(77)
 
@@ -90,6 +90,7 @@ class TestMultiIndexLoc:
     def test_no_wholesale_fallback(self, mi_dfs):
         """MultiIndex loc must route through the QC seam, not default to
         pandas (the round-3 gap this seam exists to close)."""
+        require_tpu_execution()
         md, _ = mi_dfs
         import warnings
 

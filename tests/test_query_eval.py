@@ -118,3 +118,17 @@ def test_query_undefined_name_raises(dfs):
         pdf.query("nope > 1")
     with pytest.raises(Exception):
         md.query("nope > 1")
+
+
+def test_query_eval_local_dict_reaches_fallback():
+    """@-locals must resolve on the FALLBACK path too (the pandas call runs
+    deep inside the QC layers where frame-walking cannot see user locals).
+    Exercised by forcing an expression rowwise_query cannot compile."""
+    from tests.utils import create_test_dfs, eval_general
+
+    md, pdf = create_test_dfs({"s": ["ab", "cd", "ef"], "v": [1.0, 2.0, 3.0]})
+    pat = "c"
+
+    eval_general(md, pdf, lambda df: df.query("s.str.contains(@pat)"))
+    lo = 1.5
+    eval_general(md, pdf, lambda df: df.eval("v + @lo"))

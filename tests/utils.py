@@ -169,3 +169,14 @@ def sort_if_range_partitioning(df1: Any, df2: Any, comparator: Callable = df_equ
         df1 = df1.sort_index() if hasattr(df1, "sort_index") else df1
         df2 = df2.sort_index() if hasattr(df2, "sort_index") else df2
     comparator(df1, df2)
+
+
+def require_tpu_execution() -> None:
+    """Skip the calling test on executions without the TpuOnJax device/IO
+    wiring (mirrors assert_no_fallback's behavior for path assertions)."""
+    import pytest
+
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("TpuOnJax-specific path")
