@@ -60,8 +60,36 @@ def run_workflow(seed: int = 0, steps: int = 10, on_divergence: str = "raise") -
         name, op = ops[int(rng.integers(0, len(ops)))]
         trace.append(name)
         op_seed = int(rng.integers(0, 2**32))
-        md = op(md, np.random.default_rng(op_seed))
-        pdf = op(pdf, np.random.default_rng(op_seed))
+        # matching exceptions are AGREEMENT (e.g. both reject sorting by a
+        # duplicated label); only a one-sided or mismatched raise diverges
+        try:
+            pdf_next = op(pdf, np.random.default_rng(op_seed))
+            pdf_exc = None
+        except Exception as e:  # noqa: BLE001 - differential harness
+            pdf_next, pdf_exc = None, e
+        try:
+            md_next = op(md, np.random.default_rng(op_seed))
+            md_exc = None
+        except Exception as e:  # noqa: BLE001
+            md_next, md_exc = None, e
+        if pdf_exc is not None or md_exc is not None:
+            agree = (
+                pdf_exc is not None
+                and md_exc is not None
+                and (
+                    isinstance(md_exc, type(pdf_exc))
+                    or isinstance(pdf_exc, type(md_exc))
+                )
+            )
+            if agree:
+                continue  # the op never applied on either side
+            if on_divergence == "raise":
+                raise AssertionError(
+                    f"one-sided exception after {trace}: "
+                    f"pandas={pdf_exc!r} modin_tpu={md_exc!r}"
+                )
+            return trace
+        md, pdf = md_next, pdf_next
         try:
             assert_frame_equal(md._to_pandas(), pdf)
         except AssertionError:
