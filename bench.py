@@ -161,17 +161,25 @@ def main() -> None:
         }
         for name, _ in ops
     }
+    payload = {
+        "metric": "TimeArithmetic+TimeGroupByDefaultAggregations wall-sec (1e8 rows float64)",
+        "value": round(modin_total, 4),
+        "unit": "seconds",
+        "vs_baseline": round(pandas_total / max(modin_total, 1e-9), 2),
+        "detail": detail,
+        "rows": ROWS,
+        "platform": platform,
+    }
+    if not platform.startswith("tpu"):
+        payload["note"] = (
+            "No TPU at bench time (platform above); these are CPU-substrate "
+            "numbers where XLA has no accelerator advantage — NOT comparable "
+            "to the >=5x TPU target. See BENCH_r03.json for the last "
+            "real-TPU run (7.34x) of the same op set."
+        )
     print(
         json.dumps(
-            {
-                "metric": "TimeArithmetic+TimeGroupByDefaultAggregations wall-sec (1e8 rows float64)",
-                "value": round(modin_total, 4),
-                "unit": "seconds",
-                "vs_baseline": round(pandas_total / max(modin_total, 1e-9), 2),
-                "detail": detail,
-                "rows": ROWS,
-                "platform": platform,
-            }
+            payload
         )
     )
 
