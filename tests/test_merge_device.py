@@ -7,7 +7,7 @@ import pandas
 import pytest
 
 import modin_tpu.pandas as pd
-from tests.utils import create_test_dfs, df_equals
+from tests.utils import create_test_dfs, df_equals, eval_general
 
 
 @pytest.fixture(autouse=True)
@@ -228,3 +228,86 @@ def test_merge_three_keys():
     for how in ("inner", "left", "right", "outer"):
         got = assert_no_fallback(lambda: ml.merge(mr, on=["a", "b", "c"], how=how))
         df_equals(got, pl_.merge(pr, on=["a", "b", "c"], how=how))
+
+
+class TestJoinMergePort:
+    """Scenario shapes ported from the reference join/merge suite
+    (modin/tests/pandas/dataframe/test_join_sort.py:184-560)."""
+
+    @pytest.mark.parametrize("how", ["left", "right", "inner", "outer"])
+    def test_join_empty(self, how):
+        md, pdf = create_test_dfs({"a": [1, 2, 3]})
+        me = pd.DataFrame(columns=["b"])
+        pe = pandas.DataFrame(columns=["b"])
+        df_equals(md.join(me, how=how), pdf.join(pe, how=how))
+
+    def test_join_cross_with_lsuffix(self):
+        data = [[7, 8, 9], [10, 11, 12]]
+        md, pdf = create_test_dfs(data, columns=["x", "y", "z"])
+        m = md.join(md[["x"]].set_axis(["p", "q"], axis=0), how="cross", lsuffix="p")
+        p = pdf.join(pdf[["x"]].set_axis(["p", "q"], axis=0), how="cross", lsuffix="p")
+        df_equals(m, p)
+
+    def test_join_list_with_on_raises(self):
+        data = np.ones([2, 4])
+        pairs = [create_test_dfs(data, columns=list("abcd")) for _ in range(3)]
+        mds, pds = zip(*pairs)
+        for dfs in (mds, pds):
+            with pytest.raises(
+                ValueError,
+                match="Joining multiple DataFrames only supported for joining on index",
+            ):
+                dfs[0].join([dfs[1], dfs[2]], how="inner", on="a")
+
+    def test_join_series_rename(self):
+        abbrev_m = pd.Series(
+            ["Major League Baseball", "National Basketball Association"],
+            index=["MLB", "NBA"],
+        )
+        abbrev_p = pandas.Series(
+            ["Major League Baseball", "National Basketball Association"],
+            index=["MLB", "NBA"],
+        )
+        data = {
+            "name": ["Mariners", "Lakers"] * 50,
+            "league_abbreviation": ["MLB", "NBA"] * 50,
+        }
+        md, pdf = create_test_dfs(data)
+        m = md.set_index("league_abbreviation").join(abbrev_m.rename("league_name"))
+        p = pdf.set_index("league_abbreviation").join(abbrev_p.rename("league_name"))
+        df_equals(m, p)
+
+    @pytest.mark.parametrize("how", ["left", "right", "inner", "outer"])
+    def test_merge_empty_frames(self, how):
+        md, pdf = create_test_dfs({"k": [1, 2], "v": [1.0, 2.0]})
+        me = pd.DataFrame(columns=["k", "w"])
+        pe = pandas.DataFrame(columns=["k", "w"])
+        eval_general(
+            (md, me), (pdf, pe), lambda dfs: dfs[0].merge(dfs[1], on="k", how=how)
+        )
+
+    def test_merge_with_mi_columns(self):
+        md1, pd1 = create_test_dfs(
+            {("col0", "a"): [1, 2, 3, 4], ("col0", "b"): [2, 3, 4, 5]}
+        )
+        md2, pd2 = create_test_dfs(
+            {("col0", "a"): [1, 2, 3, 4], ("col0", "c"): [2, 3, 4, 5]}
+        )
+        df_equals(
+            md1.merge(md2, on=[("col0", "a")]), pd1.merge(pd2, on=[("col0", "a")])
+        )
+
+    @pytest.mark.parametrize(
+        "kwargs",
+        [
+            {"left_index": True, "right_index": True},
+            {"left_index": True, "right_on": "k2"},
+            {"left_on": "k", "right_index": True},
+        ],
+    )
+    def test_merge_on_single_index(self, kwargs):
+        md1, pd1 = create_test_dfs({"k": [3, 1, 2], "v": [1.0, 2.0, 3.0]})
+        md2, pd2 = create_test_dfs({"k2": [1, 2, 9], "w": [5.0, 6.0, 7.0]})
+        eval_general(
+            (md1, md2), (pd1, pd2), lambda dfs: dfs[0].merge(dfs[1], **kwargs)
+        )
