@@ -98,7 +98,10 @@ def _fetch_token():
 def execute_modin(result):
     qc = getattr(result, "_query_compiler", None)
     if qc is not None:
-        qc.execute()
+        # dispatch-only: the token fetch below is already a full barrier
+        # (FIFO stream); a block_until_ready would spend a second tunnel
+        # round-trip and has been observed returning early on fresh compiles
+        qc.dispatch()
         _fetch_token()
     return result
 

@@ -95,6 +95,16 @@ class TpuQueryCompiler(BaseQueryCompiler):
     def execute(self) -> None:
         self._modin_frame.finalize()
 
+    def dispatch(self) -> None:
+        """Dispatch all deferred device work WITHOUT a host block.
+
+        The async counterpart of ``execute``: callers that have their own
+        completion barrier (e.g. the bench's FIFO token fetch — a
+        ``block_until_ready`` over the tunnel costs a round-trip and has
+        been observed returning early on fresh compiles) use this to put
+        the work on the stream and nothing more."""
+        self._modin_frame.materialize_device()
+
     # ------------------------------------------------------------------ #
     # Metadata
     # ------------------------------------------------------------------ #
