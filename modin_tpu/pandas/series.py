@@ -482,13 +482,15 @@ class Series(BasePandasDataset):
         return self.to_frame("__dup__").duplicated(keep=keep).rename(self.name)
 
     def drop_duplicates(self, *, keep: Any = "first", inplace: bool = False, ignore_index: bool = False):
-        result = self._default_to_pandas(
-            "drop_duplicates", keep=keep, ignore_index=ignore_index
+        # value-dedup of a Series IS row-dedup of its single-column frame
+        new_qc = self._query_compiler.drop_duplicates(
+            subset=None, keep=keep, ignore_index=ignore_index
         )
+        new_qc._shape_hint = "column"
         if inplace:
-            self._update_inplace(result._query_compiler)
+            self._update_inplace(new_qc)
             return None
-        return result
+        return Series(query_compiler=new_qc)
 
     def _series_reset_index(self, level: Any, inplace: bool):
         """reset_index(drop=False) — becomes a DataFrame."""

@@ -309,3 +309,21 @@ class TestRankDevice:
         md, pdf = create_test_dfs({"u": vals})
         eval_general(md, pdf, lambda df: df.rank())
         eval_general(md, pdf, lambda df: df.rank(ascending=False, method="min"))
+
+    @pytest.mark.parametrize("keep", ["first", "last", False])
+    def test_series_drop_duplicates(self, keep):
+        rng = np.random.default_rng(71)
+        n = 200
+        v = rng.normal(size=n).round(1)
+        v[::9] = np.nan
+        md, pdf = create_test_dfs({"k": rng.integers(0, 5, n), "v": v})
+        eval_general(md, pdf, lambda df: df["v"].drop_duplicates(keep=keep))
+        eval_general(
+            md, pdf,
+            lambda df: df["k"].drop_duplicates(keep=keep, ignore_index=True),
+        )
+
+    def test_series_drop_duplicates_string_fallback(self):
+        ms = pd.Series(["a", "b", "a"], name="s")
+        ps = pandas.Series(["a", "b", "a"], name="s")
+        eval_general(ms, ps, lambda s: s.drop_duplicates())
