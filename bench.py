@@ -1,14 +1,38 @@
 """Benchmark: asv TimeArithmetic + TimeGroupByDefaultAggregations equivalents.
 
-Mirrors the reference's operative baseline (BASELINE.md: asv_bench
-benchmarks.py:42-113,383-433) at the driver's north-star scale: a 10^8-row
-float64 frame plus an int key column with 100 groups.  Each op runs under
-BenchmarkMode (synchronous execution) after a warm-up pass, and the identical
-ops run on in-process pandas as the CPU baseline (the reference's
-PandasOnRay headline is ~4x a 4-core laptop's pandas; this host is 1 core).
+Op-set parity with the reference's operative baseline (BASELINE.md;
+reference asv_bench/benchmarks/benchmarks.py:383-433 TimeArithmetic and
+:70-88 TimeGroupByDefaultAggregations), int data in [0, 100) like the
+reference's RAND_LOW/RAND_HIGH, at the driver's north-star scale where the
+op is O(n)-kernel-shaped, and at the reference's own shapes where it is not:
 
-Prints ONE json line: {"metric", "value" (modin_tpu wall-sec), "unit",
-"vs_baseline" (pandas_sec / modin_tpu_sec, higher is better)}.
+- ``axis0`` (THE HEADLINE: ``value``/``vs_baseline``): sum, mean, count,
+  median, nunique, mode, add(2), mul(2), mod(2), abs, gt, isin([0,2]) on a
+  1e8-row frame, plus groupby count/size/sum/mean measured COLD (the key
+  factorization memo is cleared before every timed rep; warm numbers are
+  reported separately in the detail — a warm-only number measures a
+  memo lookup, not a kernel).
+- ``axis1``: the axis=1 variants (sum, count, median, nunique, mean, mode,
+  add, mul, mod) at the reference's big shape (1e6 x 10).
+- ``host_udf``: apply/aggregate (both axes) and transpose at the
+  reference's small shape (1e4 x 10).  These are black-box-UDF /
+  structural ops a device frame cannot accelerate (they measure host
+  pandas + transfer); kept out of the headline so the kernel aggregate
+  stays meaningful, reported in full here.
+- ``ewm``: ewm.mean at 1e8 rows, separate section (not part of the
+  reference TimeArithmetic family; added r04, moved out of the headline
+  r05 so headline numbers stay comparable across rounds).
+
+Provenance: r01-r03 measured {sum, mean, count, add(=df+df), mul(=df*2),
+abs, gt, gb_*(warm)} on float64; r04 added ewm_mean to the same aggregate
+(which broke cross-round comparability and was flagged in VERDICT r4); r05
+is the first round measuring the full reference op set, on int64, with
+flex add/mul/mod matching the reference's scalar form and cold groupby
+numbers.  Compare rounds per-op, not by aggregate.
+
+Prints ONE json line: {"metric", "value" (modin_tpu headline wall-sec),
+"unit", "vs_baseline" (pandas_sec / modin_tpu_sec, higher is better),
+"detail" (per-op + per-section), ...}.
 """
 
 import json
@@ -43,27 +67,28 @@ def _probe_devices(timeout_s: float = 60.0) -> str:
 
 
 ROWS = int(os.environ.get("BENCH_ROWS", 100_000_000))
+AXIS1_ROWS = int(os.environ.get("BENCH_AXIS1_ROWS", 1_000_000))
+UDF_ROWS = int(os.environ.get("BENCH_UDF_ROWS", 10_000))
 COLS = 5
 NGROUPS = 100
 REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+# a single rep past this long is its own answer; don't repeat it
+SLOW_OP_S = float(os.environ.get("BENCH_SLOW_OP_S", 10.0))
 
 
-def build_data():
-    rng = np.random.default_rng(0)
-    data = {f"c{i}": rng.uniform(0.0, 100.0, ROWS) for i in range(COLS)}
-    data["key"] = rng.integers(0, NGROUPS, ROWS)
-    return data
-
-
-ARITHMETIC_OPS = [
+AXIS0_OPS = [
     ("sum", lambda df: df.sum()),
     ("mean", lambda df: df.mean()),
     ("count", lambda df: df.count()),
-    ("add", lambda df: df + df),
-    ("mul", lambda df: df * 2.0),
+    ("median", lambda df: df.median()),
+    ("nunique", lambda df: df.nunique()),
+    ("mode", lambda df: df.mode()),
+    ("add", lambda df: df.add(2)),
+    ("mul", lambda df: df.mul(2)),
+    ("mod", lambda df: df.mod(2)),
     ("abs", lambda df: df.abs()),
-    ("gt", lambda df: df > 50.0),
-    ("ewm_mean", lambda df: df.ewm(alpha=0.1).mean()),
+    ("gt", lambda df: df > 50),
+    ("isin", lambda df: df.isin([0, 2])),
 ]
 
 GROUPBY_OPS = [
@@ -71,6 +96,30 @@ GROUPBY_OPS = [
     ("gb_size", lambda df: df.groupby("key").size()),
     ("gb_sum", lambda df: df.groupby("key").sum()),
     ("gb_mean", lambda df: df.groupby("key").mean()),
+]
+
+AXIS1_OPS = [
+    ("sum1", lambda df: df.sum(axis=1)),
+    ("count1", lambda df: df.count(axis=1)),
+    ("median1", lambda df: df.median(axis=1)),
+    ("nunique1", lambda df: df.nunique(axis=1)),
+    ("mean1", lambda df: df.mean(axis=1)),
+    ("mode1", lambda df: df.mode(axis=1)),
+    ("add1", lambda df: df.add(2, axis=1)),
+    ("mul1", lambda df: df.mul(2, axis=1)),
+    ("mod1", lambda df: df.mod(2, axis=1)),
+]
+
+UDF_OPS = [
+    ("apply0", lambda df: df.apply(lambda s: s.sum(), axis=0)),
+    ("agg0", lambda df: df.aggregate(lambda s: s.sum(), axis=0)),
+    ("apply1", lambda df: df.apply(lambda s: s.sum(), axis=1)),
+    ("agg1", lambda df: df.aggregate(lambda s: s.sum(), axis=1)),
+    ("transpose", lambda df: df.transpose()),
+]
+
+EWM_OPS = [
+    ("ewm_mean", lambda df: df.ewm(alpha=0.1).mean()),
 ]
 
 
@@ -110,20 +159,51 @@ def execute_pandas(result):
     return result
 
 
-def time_ops(df, ops, execute):
+def _clear_groupby_memo():
+    from modin_tpu.ops.groupby import clear_factorize_cache
+
+    clear_factorize_cache()
+
+
+def time_ops(df, ops, execute, repeats, warmup=True, pre_rep=None):
+    """min-of-reps per op.  ``pre_rep`` runs before every timed rep (outside
+    the timer would hide its cost — cold-path reps must INCLUDE the work the
+    cleared cache forces, so it runs inside).  A rep slower than SLOW_OP_S
+    is not repeated: its first measurement is the answer."""
     total = 0.0
     per_op = {}
     for name, fn in ops:
-        execute(fn(df))  # warm-up (jit compile + caches)
+        if warmup:
+            if pre_rep is not None:
+                pre_rep()
+            execute(fn(df))  # jit compile + trace caches (excluded, like asv)
         best = float("inf")
-        for _ in range(REPEATS):
+        for _ in range(max(repeats, 1)):
             t0 = time.perf_counter()
+            if pre_rep is not None:
+                pre_rep()
             execute(fn(df))
             dt = time.perf_counter() - t0
             best = min(best, dt)
+            if dt > SLOW_OP_S:
+                break
         per_op[name] = best
         total += best
     return total, per_op
+
+
+def _section(mdf, pdf, ops, repeats, detail, pre_rep=None, pandas_pre_rep=None):
+    m_total, m_ops = time_ops(mdf, ops, execute_modin, repeats, pre_rep=pre_rep)
+    p_total, p_ops = time_ops(
+        pdf, ops, execute_pandas, repeats, warmup=False, pre_rep=pandas_pre_rep
+    )
+    for opname, _ in ops:
+        detail[opname] = {
+            "modin_tpu_s": round(m_ops[opname], 4),
+            "pandas_s": round(p_ops[opname], 4),
+            "speedup": round(p_ops[opname] / max(m_ops[opname], 1e-9), 2),
+        }
+    return m_total, p_total
 
 
 def main() -> None:
@@ -136,55 +216,129 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu (accelerator unavailable)"
+    on_tpu = platform.startswith("tpu") or platform.startswith("axon")
+    # CPU-substrate runs are flagged non-comparable anyway; don't spend 20+
+    # extra minutes of driver time perfecting them
+    repeats = REPEATS if on_tpu else 1
 
-    data = build_data()
+    rng = np.random.default_rng(0)
 
     import pandas
-
-    pdf = pandas.DataFrame(data)
 
     import modin_tpu.pandas as pd
     from modin_tpu.config import BenchmarkMode
 
     BenchmarkMode.put(True)
+
+    detail = {}
+    sections = {}
+
+    # ---- axis0 (headline) + groupby, 1e8 x (5 + key) int64 ---- #
+    data = {f"c{i}": rng.integers(0, 100, ROWS) for i in range(COLS)}
+    data["key"] = rng.integers(0, NGROUPS, ROWS)
+    pdf = pandas.DataFrame(data)
     mdf = pd.DataFrame(data)
     mdf._query_compiler.execute()
-
     del data
 
-    ops = ARITHMETIC_OPS + GROUPBY_OPS
-    modin_total, modin_ops = time_ops(mdf, ops, execute_modin)
-    pandas_total, pandas_ops = time_ops(pdf, ops, execute_pandas)
+    ax0_m, ax0_p = _section(mdf, pdf, AXIS0_OPS, repeats, detail)
 
-    detail = {
-        name: {
-            "modin_tpu_s": round(modin_ops[name], 4),
-            "pandas_s": round(pandas_ops[name], 4),
-            "speedup": round(pandas_ops[name] / max(modin_ops[name], 1e-9), 2),
-        }
-        for name, _ in ops
+    # groupby COLD: the factorize memo is cleared inside every timed rep, so
+    # the number includes the key factorization (r04's warm-only gb_size was
+    # a 0.8ms memo lookup billed as a 1e8-row kernel — VERDICT r4 weak #1)
+    gbc_m, gbc_p = _section(
+        mdf, pdf, GROUPBY_OPS, repeats, detail,
+        pre_rep=_clear_groupby_memo,
+    )
+    # groupby WARM (memo present): the product's steady-state behavior,
+    # reported under *_warm, excluded from the headline
+    warm_detail = {}
+    gbw_m, gbw_p = _section(
+        mdf, pdf, GROUPBY_OPS, repeats, warm_detail
+    )
+    for opname, _ in GROUPBY_OPS:
+        detail[opname + "_warm"] = warm_detail[opname]
+
+    headline_m = ax0_m + gbc_m
+    headline_p = ax0_p + gbc_p
+    sections["headline_axis0_plus_groupby_cold"] = {
+        "modin_tpu_s": round(headline_m, 4),
+        "pandas_s": round(headline_p, 4),
+        "speedup": round(headline_p / max(headline_m, 1e-9), 2),
     }
+    sections["groupby_warm"] = {
+        "modin_tpu_s": round(gbw_m, 4),
+        "pandas_s": round(gbw_p, 4),
+        "speedup": round(gbw_p / max(gbw_m, 1e-9), 2),
+    }
+
+    # ---- ewm, same 1e8 frame, separate section ---- #
+    ewm_m, ewm_p = _section(mdf, pdf, EWM_OPS, repeats, detail)
+    sections["ewm"] = {
+        "modin_tpu_s": round(ewm_m, 4),
+        "pandas_s": round(ewm_p, 4),
+        "speedup": round(ewm_p / max(ewm_m, 1e-9), 2),
+    }
+
+    del mdf, pdf
+
+    # ---- axis1 at the reference's big shape (1e6 x 10 int) ---- #
+    data1 = {f"c{i}": rng.integers(0, 100, AXIS1_ROWS) for i in range(10)}
+    pdf1 = pandas.DataFrame(data1)
+    mdf1 = pd.DataFrame(data1)
+    mdf1._query_compiler.execute()
+    del data1
+    ax1_m, ax1_p = _section(mdf1, pdf1, AXIS1_OPS, repeats, detail)
+    sections["axis1"] = {
+        "modin_tpu_s": round(ax1_m, 4),
+        "pandas_s": round(ax1_p, 4),
+        "speedup": round(ax1_p / max(ax1_m, 1e-9), 2),
+    }
+    del mdf1, pdf1
+
+    # ---- host UDF + structural at the reference's small shape ---- #
+    datau = {f"c{i}": rng.integers(0, 100, UDF_ROWS) for i in range(10)}
+    pdfu = pandas.DataFrame(datau)
+    mdfu = pd.DataFrame(datau)
+    mdfu._query_compiler.execute()
+    del datau
+    udf_m, udf_p = _section(mdfu, pdfu, UDF_OPS, repeats, detail)
+    sections["host_udf"] = {
+        "modin_tpu_s": round(udf_m, 4),
+        "pandas_s": round(udf_p, 4),
+        "speedup": round(udf_p / max(udf_m, 1e-9), 2),
+    }
+    del mdfu, pdfu
+
     payload = {
-        "metric": "TimeArithmetic+TimeGroupByDefaultAggregations wall-sec (1e8 rows float64)",
-        "value": round(modin_total, 4),
+        "metric": (
+            "TimeArithmetic(axis0)+TimeGroupByDefaultAggregations(cold) "
+            "wall-sec (1e8 rows int64)"
+        ),
+        "value": round(headline_m, 4),
         "unit": "seconds",
-        "vs_baseline": round(pandas_total / max(modin_total, 1e-9), 2),
+        "vs_baseline": round(headline_p / max(headline_m, 1e-9), 2),
         "detail": detail,
+        "sections": sections,
         "rows": ROWS,
         "platform": platform,
+        "provenance": (
+            "r05: full reference TimeArithmetic op set on int64 (flex "
+            "add/mul/mod(2) like the reference; r01-r03 used add=df+df on "
+            "float64), groupby timed cold (memo cleared per rep; r01-r04 "
+            "groupby numbers were warm), ewm/axis1/host_udf in separate "
+            "sections outside the headline.  NOT directly comparable to "
+            "any earlier round's aggregate; compare per-op."
+        ),
     }
-    if not platform.startswith("tpu"):
+    if not on_tpu:
         payload["note"] = (
             "No TPU at bench time (platform above); these are CPU-substrate "
             "numbers where XLA has no accelerator advantage — NOT comparable "
             "to the >=5x TPU target. See BENCH_r03.json for the last "
-            "real-TPU run (7.34x) of the same op set."
+            "real-TPU run (7.34x on the r03 op subset)."
         )
-    print(
-        json.dumps(
-            payload
-        )
-    )
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -168,14 +168,21 @@ class DeviceColumn:
 
 
 class HostColumn:
-    """One column kept on host (object/string/categorical/extension dtypes)."""
+    """One column kept on host (object/string/categorical/extension dtypes).
 
-    __slots__ = ("data",)
+    ``_dict_cache`` lazily holds the column's dictionary encoding — (codes
+    DeviceColumn, sorted categories) — or False once found unencodable (see
+    ops/dictionary.py).  Columns are replaced, never mutated in place, so
+    the cache cannot go stale.
+    """
+
+    __slots__ = ("data", "_dict_cache")
     is_device = False
 
     def __init__(self, data: Any):
         # data: 1-D numpy array or pandas ExtensionArray (unpadded)
         self.data = data
+        self._dict_cache = None
 
     @property
     def pandas_dtype(self):

@@ -9,6 +9,7 @@ Tpu query compiler directly.
 from typing import Any
 
 from modin_tpu.core.dataframe.tpu.dataframe import TpuDataframe
+from modin_tpu.core.io.column_stores.hdf_dispatcher import HDFDispatcher
 from modin_tpu.core.io.column_stores.parquet_dispatcher import (
     FeatherDispatcher,
     ParquetDispatcher,
@@ -47,6 +48,11 @@ class TpuParquetDispatcher(ParquetDispatcher):
 
 
 class TpuFeatherDispatcher(FeatherDispatcher):
+    query_compiler_cls = TpuQueryCompiler
+    frame_cls = TpuDataframe
+
+
+class TpuHDFDispatcher(HDFDispatcher):
     query_compiler_cls = TpuQueryCompiler
     frame_cls = TpuDataframe
 
@@ -90,6 +96,14 @@ class TpuOnJaxIO(BaseIO):
     @classmethod
     def read_feather(cls, **kwargs: Any):
         return TpuFeatherDispatcher.read(**kwargs)
+
+    @classmethod
+    def read_hdf(cls, **kwargs: Any):
+        return TpuHDFDispatcher.read(**kwargs)
+
+    @classmethod
+    def to_hdf(cls, qc: Any, path_or_buf: Any = None, **kwargs: Any):
+        return TpuHDFDispatcher.write(qc, path_or_buf, **kwargs)
 
     @classmethod
     def read_sql(cls, **kwargs: Any):

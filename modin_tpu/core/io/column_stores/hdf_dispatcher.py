@@ -1,0 +1,142 @@
+"""HDF5 dispatcher: chunked reads/writes for ``table``-format stores.
+
+Reference shape: modin/core/io/column_stores/hdf_dispatcher.py:21 (validate
+the store's ``table_type``, then split a table-format dataset by row ranges;
+``fixed``-format stores only support whole-dataset reads, so they take the
+serial path with the same advisory the reference gives).
+
+pytables does not ship in this image, so every path here is gated: with no
+``tables`` module the read/write surfaces raise pandas' own canonical
+ImportError ("Missing optional dependency 'pytables'"), and the
+row-chunking tests are env-gated (tests/test_io.py::TestHDF skips).  The
+dispatcher exists so an environment WITH pytables gets bounded-memory
+chunked IO rather than a full-frame gather.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import pandas
+
+from modin_tpu.core.io.file_dispatcher import FileDispatcher
+
+# one read/write window; matches the text/parquet writers' bound of keeping
+# O(chunk) host memory regardless of frame size
+_HDF_CHUNK_ROWS = 1 << 20
+
+
+def _pytables_available() -> bool:
+    try:
+        import tables  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+class HDFDispatcher(FileDispatcher):
+    @classmethod
+    def _table_nrows(cls, path: Any, key: Optional[str]) -> Optional[int]:
+        """Row count of a ``table``-format dataset, or None when the store
+        is ``fixed``-format / unreadable (callers then go serial)."""
+        try:
+            with pandas.HDFStore(path, mode="r") as store:
+                keys = store.keys()
+                use_key = key
+                if use_key is None:
+                    if len(keys) != 1:
+                        return None
+                    use_key = keys[0]
+                storer = store.get_storer(use_key)
+                if storer is None or not getattr(storer, "is_table", False):
+                    return None
+                return int(storer.nrows)
+        except Exception:
+            return None
+
+    @classmethod
+    def _read(cls, path_or_buf: Any = None, key: Any = None, **kwargs: Any):
+        if not _pytables_available():
+            # surface pandas' canonical missing-dependency error
+            return cls.query_compiler_cls.from_pandas(
+                pandas.read_hdf(path_or_buf, key=key, **kwargs), cls.frame_cls
+            )
+        mode = kwargs.pop("mode", "r")
+        chunk_ok = (
+            isinstance(path_or_buf, str)
+            and kwargs.get("iterator") in (None, False)
+            and kwargs.get("chunksize") is None
+            and kwargs.get("where") is None
+            and kwargs.get("start") is None
+            and kwargs.get("stop") is None
+        )
+        nrows = cls._table_nrows(path_or_buf, key) if chunk_ok else None
+        if nrows is None or nrows <= _HDF_CHUNK_ROWS:
+            result = pandas.read_hdf(path_or_buf, key=key, mode=mode, **kwargs)
+            if not isinstance(result, (pandas.DataFrame, pandas.Series)):
+                return result  # iterator/chunksize: hand pandas' own back
+            return cls.query_compiler_cls.from_pandas(
+                result if isinstance(result, pandas.DataFrame) else result.to_frame(),
+                cls.frame_cls,
+            )
+        # table format with a known row count: bounded-memory window reads
+        # (each window is device_put as it lands; the host never holds more
+        # than one window plus the assembled device frame)
+        pieces: List[pandas.DataFrame] = []
+        for start in range(0, nrows, _HDF_CHUNK_ROWS):
+            pieces.append(
+                pandas.read_hdf(
+                    path_or_buf,
+                    key=key,
+                    mode=mode,
+                    start=start,
+                    stop=min(start + _HDF_CHUNK_ROWS, nrows),
+                    **kwargs,
+                )
+            )
+        df = pandas.concat(pieces, axis=0)
+        return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
+
+    @classmethod
+    def write(cls, qc: Any, path_or_buf: Any, key: Any = None, **kwargs: Any):
+        if not _pytables_available():
+            # canonical pandas error path
+            return qc.to_pandas().to_hdf(path_or_buf, key=key, **kwargs)
+        import os
+
+        fmt = kwargs.get("format")
+        n_rows = qc.get_axis_len(0)
+        # pandas' default mode='a' keeps OTHER keys in an existing store; the
+        # chunked path rewrites the file, so it only runs when that rewrite
+        # is what the caller asked for (explicit mode='w') or indistinguishable
+        # from it (no pre-existing file)
+        mode_kw = kwargs.get("mode")
+        chunk_ok = (
+            isinstance(path_or_buf, str)
+            and fmt == "table"
+            and kwargs.get("append") in (None, False)
+            and (
+                mode_kw == "w"
+                or (mode_kw in (None, "a") and not os.path.exists(path_or_buf))
+            )
+            and n_rows > _HDF_CHUNK_ROWS
+        )
+        if not chunk_ok:
+            return qc.to_pandas().to_hdf(path_or_buf, key=key, **kwargs)
+        # chunk-streamed append: table format supports it natively
+        wkwargs = dict(kwargs)
+        wkwargs.pop("append", None)
+        wkwargs.pop("mode", None)
+        for start in range(0, n_rows, _HDF_CHUNK_ROWS):
+            chunk_qc = qc.take_2d_positional(
+                index=slice(start, min(start + _HDF_CHUNK_ROWS, n_rows))
+            )
+            chunk_qc.to_pandas().to_hdf(
+                path_or_buf,
+                key=key,
+                mode="w" if start == 0 else "a",
+                append=start > 0,
+                **wkwargs,
+            )
+        return None

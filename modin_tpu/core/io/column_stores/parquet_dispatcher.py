@@ -178,6 +178,19 @@ class ParquetDispatcher(FileDispatcher):
                 )
                 if writer is None:
                     schema = table.schema
+                    if any(pa.types.is_null(f.type) for f in schema):
+                        # the first window saw only nulls in some column, so
+                        # the pinned type is pa.null and a later non-null
+                        # chunk cannot cast into it — single-shot write
+                        # instead (pandas infers from the whole column)
+                        table = pa.Table.from_pandas(
+                            qc.to_pandas(), preserve_index=preserve
+                        )
+                        writer = pq.ParquetWriter(
+                            path, table.schema, compression=compression
+                        )
+                        writer.write_table(table)
+                        return None
                     writer = pq.ParquetWriter(
                         path, schema, compression=compression
                     )
@@ -293,6 +306,17 @@ class FeatherDispatcher(FileDispatcher):
                 )
                 if writer is None:
                     schema = table.schema
+                    if any(pa.types.is_null(f.type) for f in schema):
+                        # null-pinned field: later non-null chunks cannot
+                        # cast into it — single-shot write instead
+                        table = pa.Table.from_pandas(
+                            qc.to_pandas(), preserve_index=False
+                        )
+                        writer = pa.ipc.new_file(
+                            path, table.schema, options=options
+                        )
+                        writer.write_table(table)
+                        return None
                     writer = pa.ipc.new_file(path, schema, options=options)
                 writer.write_table(table)
         finally:

@@ -160,6 +160,9 @@ class BaseQueryCompiler(ClassLogger, abc.ABC, modin_layer="QUERY-COMPILER"):
     def execute(self) -> None:
         """Block until all submitted device/engine work for this frame completes."""
 
+    def dispatch(self) -> None:
+        """Enqueue any deferred work without blocking (no-op off-device)."""
+
     def support_materialization_in_worker_process(self) -> bool:
         return True
 

@@ -878,7 +878,125 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return type(self).from_pandas(
                 result.to_frame(MODIN_UNNAMED_SERIES_LABEL)
             )
+        if (
+            axis == 1
+            and not kwargs
+            and len(frame)
+            and 1 <= frame.num_cols <= 64
+            and all(
+                c.is_device and c.pandas_dtype.kind in "biuf"
+                for c in frame._columns
+            )
+        ):
+            from modin_tpu.ops.reductions import nunique_axis1
+
+            frame.materialize_device()
+            data = nunique_axis1(
+                [c.data for c in frame._columns], len(frame), bool(dropna)
+            )
+            result_col = DeviceColumn(data, np.dtype(np.int64), length=len(frame))
+            result_frame = TpuDataframe(
+                [result_col],
+                pandas.Index([MODIN_UNNAMED_SERIES_LABEL]),
+                frame._index,
+            )
+            qc = type(self)(result_frame)
+            qc._shape_hint = "column"
+            return qc
         return super().nunique(axis=axis, dropna=dropna, **kwargs)
+
+    def mode(
+        self,
+        axis: int = 0,
+        numeric_only: bool = False,
+        dropna: bool = True,
+        **kwargs: Any,
+    ):
+        """Modal values via sorted run-length kernels (ops/reductions.py).
+
+        Parity surface: pandas ``DataFrame.mode`` (reference defaults it to a
+        full-column fold, modin/core/storage_formats/pandas/
+        query_compiler.py).  Gates: ``dropna=True`` (NaN-counting modes keep
+        the pandas fallback), numeric device columns only."""
+        frame = self._modin_frame
+        device_ok = (
+            dropna
+            and not kwargs
+            and len(frame)
+            and frame.num_cols
+            and all(
+                c.is_device and c.pandas_dtype.kind in "biuf"
+                for c in frame._columns
+            )
+        )
+        if device_ok and axis == 0:
+            from modin_tpu.ops.reductions import mode_columns
+
+            frame.materialize_device()
+            per_col = mode_columns(
+                [c.data for c in frame._columns], len(frame)
+            )
+            if all(v is not None for v in per_col):
+                pieces = [
+                    pandas.Series(
+                        np.asarray(v).astype(col.pandas_dtype, copy=False),
+                        name=label,
+                    )
+                    for v, col, label in zip(
+                        per_col, frame._columns, frame.columns
+                    )
+                ]
+                result = pandas.concat(pieces, axis=1)
+                result.columns = frame.columns
+                return type(self).from_pandas(result)
+        elif device_ok and axis == 1 and frame.num_cols <= 64:
+            from modin_tpu.ops.reductions import mode_axis1
+
+            frame.materialize_device()
+            vals, vals_f, m_max, uniform = mode_axis1(
+                [c.data for c in frame._columns], len(frame)
+            )
+            if m_max > 0:
+                integral = all(
+                    c.pandas_dtype.kind in "biu" for c in frame._columns
+                )
+                matrix = vals if uniform else vals_f
+                out_dtype = (
+                    np.dtype(np.int64)
+                    if (uniform and integral)
+                    else np.dtype(np.float64)
+                )
+                cols = []
+                for j in range(m_max):
+                    data = matrix[:, j]
+                    if uniform and integral:
+                        data = data.astype(np.int64)
+                    cols.append(
+                        DeviceColumn(data, out_dtype, length=len(frame))
+                    )
+                result_frame = TpuDataframe(
+                    cols, pandas.RangeIndex(m_max), frame._index
+                )
+                return type(self)(result_frame)
+        return super().mode(
+            axis=axis, numeric_only=numeric_only, dropna=dropna, **kwargs
+        )
+
+    # Beyond this many resulting columns a transpose leaves the columnar
+    # device store: per-column objects at 1e5+ columns cost minutes to build
+    # and gigabytes of Python overhead, so the wide result rides a host
+    # (Native) compiler instead — the per-method caster handles the mixed
+    # backends downstream.
+    _TRANSPOSE_WIDE_COLS = 4096
+
+    def transpose(self, *args: Any, **kwargs: Any):
+        if len(self._modin_frame) > self._TRANSPOSE_WIDE_COLS:
+            from modin_tpu.core.storage_formats.native.query_compiler import (
+                NativeQueryCompiler,
+            )
+
+            return NativeQueryCompiler(self.to_pandas().T)
+        return super().transpose(*args, **kwargs)
 
     def quantile(
         self,
@@ -1506,9 +1624,14 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 return None
             lkey_positions.append(lp[0])
             rkey_positions.append(rp[0])
-        for lp, rp in zip(lkey_positions, rkey_positions):
+        # dict_key_pairs[ki] = ((l_codes_col, l_cats), (r_codes_col, r_cats))
+        # for string/object key pairs riding their dictionary encodings
+        # (ops/dictionary.py): codes are remapped to the union dictionary
+        # below and the numeric sort-merge join applies unchanged
+        dict_key_pairs: dict = {}
+        for ki, (lp, rp) in enumerate(zip(lkey_positions, rkey_positions)):
             lc, rc = lframe.get_column(lp), rframe.get_column(rp)
-            if not (
+            if (
                 lc.is_device and rc.is_device
                 and lc.pandas_dtype.kind in "biuf"
                 # exact dtype match: same-kind different-width keys (int32 vs
@@ -1516,13 +1639,28 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 # coalesced right/outer paths — pandas promotes, so fall back
                 and lc.pandas_dtype == rc.pandas_dtype
             ):
-                return None
+                continue
+            if not lc.is_device and not rc.is_device:
+                from modin_tpu.ops.dictionary import encode_host_column
+
+                l_enc = encode_host_column(lc)
+                r_enc = encode_host_column(rc)
+                if l_enc is not None and r_enc is not None:
+                    dict_key_pairs[ki] = (l_enc, r_enc)
+                    continue
+            return None
         if len(lframe) == 0 or len(rframe) == 0:
             return None
-        if not all(c.is_device for c in lframe._columns) or not all(
-            c.is_device for c in rframe._columns
-        ):
-            return None
+        # host columns are allowed when object/str-typed: their output rows
+        # gather on the host by the (once-fetched) join positions; other
+        # extension dtypes keep the pandas fallback
+        for fr in (lframe, rframe):
+            for c in fr._columns:
+                if not c.is_device and not (
+                    c.pandas_dtype == object
+                    or isinstance(c.pandas_dtype, pandas.StringDtype)
+                ):
+                    return None
         suffixes = kwargs.get("suffixes") or ("_x", "_y")
         if (
             not isinstance(suffixes, (tuple, list))
@@ -1566,14 +1704,25 @@ class TpuQueryCompiler(BaseQueryCompiler):
         rframe.materialize_device()
 
         # ---- key codes -------------------------------------------------- #
+        lkey_datas, rkey_datas = [], []
+        for ki, (lp, rp) in enumerate(zip(lkey_positions, rkey_positions)):
+            if ki in dict_key_pairs:
+                from modin_tpu.ops.dictionary import (
+                    remap_codes_device,
+                    union_categories,
+                )
+
+                (l_codes, l_cats), (r_codes, r_cats) = dict_key_pairs[ki]
+                _, l_map, r_map = union_categories(l_cats, r_cats)
+                lkey_datas.append(remap_codes_device(l_codes.data, l_map))
+                rkey_datas.append(remap_codes_device(r_codes.data, r_map))
+            else:
+                lkey_datas.append(lframe.get_column(lp).data)
+                rkey_datas.append(rframe.get_column(rp).data)
         if len(lkey_positions) == 1:
-            lkey = lframe.get_column(lkey_positions[0]).data
-            rkey = rframe.get_column(rkey_positions[0]).data
+            lkey, rkey = lkey_datas[0], rkey_datas[0]
         else:
-            lkey, rkey = composite_key_codes(
-                [lframe.get_column(p).data for p in lkey_positions],
-                [rframe.get_column(p).data for p in rkey_positions],
-            )
+            lkey, rkey = composite_key_codes(lkey_datas, rkey_datas)
 
         # ---- match positions -------------------------------------------- #
         if how == "right":
@@ -1603,14 +1752,53 @@ class TpuQueryCompiler(BaseQueryCompiler):
         n_total = n_out + n_appendix
 
         # ---- gather + assemble ------------------------------------------ #
+        # host (object) columns gather on the host by the join positions,
+        # fetched ONCE per positions array; device columns keep the fused
+        # device gathers.  new_cols tuples: (data, dtype, src_i, side,
+        # is_host) — host data is an UNPADDED length-n_out object array.
+        import jax as _jax
+
+        _pos_fetch_cache: dict = {}
+
+        def _pos_h(arr, count):
+            key_ = (id(arr), count)
+            if key_ not in _pos_fetch_cache:
+                _pos_fetch_cache[key_] = np.asarray(
+                    _jax.device_get(arr)
+                )[:count].astype(np.int64)
+            return _pos_fetch_cache[key_]
+
+        def _host_take(values, positions):
+            vals = np.asarray(values, dtype=object)
+            out = np.empty(len(positions), dtype=object)
+            valid = positions >= 0
+            out[valid] = vals[positions[valid]]
+            if not valid.all():
+                out[~valid] = np.nan
+            return out
+
+        def _restore_host_dtype(arr, dtype):
+            # assembly works on plain object arrays; str-dtype (pandas>=3
+            # default for strings) columns convert back at the end
+            if dtype == object:
+                return arr
+            try:
+                return pandas.array(arr, dtype=dtype)
+            except Exception:
+                return arr
+
+        l_dev_positions = [
+            i for i, c in enumerate(lframe._columns) if c.is_device
+        ]
         if how == "right":
-            left_datas = gather_right_columns(
-                [c.data for c in lframe._columns], left_pos
+            l_gathered = gather_right_columns(
+                [lframe._columns[i].data for i in l_dev_positions], left_pos
             )
         else:
-            left_datas = gather_columns_device(
-                [c.data for c in lframe._columns], left_pos
+            l_gathered = gather_columns_device(
+                [lframe._columns[i].data for i in l_dev_positions], left_pos
             )
+        l_data_by_pos = dict(zip(l_dev_positions, l_gathered))
         suffix_l, suffix_r = suffixes
         right_labels_set = {rframe.columns[i] for i in right_value_positions}
         new_cols: list = []
@@ -1620,12 +1808,26 @@ class TpuQueryCompiler(BaseQueryCompiler):
             # appendix values for coalesced key columns come from the right key
             for lp, rp, co in zip(lkey_positions, rkey_positions, coalesce):
                 if co:
-                    key_appendix[lp] = rframe.get_column(rp).data
-        for i, (col, data) in enumerate(zip(lframe._columns, left_datas)):
+                    key_appendix[lp] = rframe.get_column(rp)
+        for i, col in enumerate(lframe._columns):
             label = lframe.columns[i]
             if label in right_labels_set and i not in coalesced_lkeys:
                 label = f"{label}{suffix_l}"
             dtype = col.pandas_dtype
+            if not col.is_device:
+                if how == "right" and i in lkey_to_rkey:
+                    # coalesced key in a right join: values come from the
+                    # (always-valid) right side
+                    data = _host_take(
+                        rframe.get_column(lkey_to_rkey[i]).to_numpy(),
+                        _pos_h(right_pos, n_out),
+                    )
+                else:
+                    data = _host_take(col.to_numpy(), _pos_h(left_pos, n_out))
+                new_cols.append((data, dtype, i, "left", True))
+                new_labels.append(label)
+                continue
+            data = l_data_by_pos[i]
             if how == "right" and i in lkey_to_rkey:
                 # coalesced key: every output row is a right row, so the key
                 # value comes from the (always-valid) right side
@@ -1638,25 +1840,35 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 if how == "right":
                     data = jnp.where(left_pos < 0, jnp.nan, data)
                 dtype = np.dtype(np.float64)
-            new_cols.append((data, dtype, i, "left"))
+            new_cols.append((data, dtype, i, "left", False))
             new_labels.append(label)
+        r_dev_positions = [
+            i for i in right_value_positions if rframe.get_column(i).is_device
+        ]
         right_datas = gather_right_columns(
-            [rframe.get_column(i).data for i in right_value_positions], right_pos
+            [rframe.get_column(i).data for i in r_dev_positions], right_pos
         )
+        r_data_by_pos = dict(zip(r_dev_positions, right_datas))
         left_labels_set = set(lframe.columns)
         coalesced_label_set = {
             lframe.columns[lp] for lp in coalesced_lkeys
         }
-        for i, data in zip(right_value_positions, right_datas):
+        for i in right_value_positions:
             col = rframe.get_column(i)
             label = rframe.columns[i]
             if label in left_labels_set and label not in coalesced_label_set:
                 label = f"{label}{suffix_r}"
             dtype = col.pandas_dtype
+            if not col.is_device:
+                data = _host_take(col.to_numpy(), _pos_h(right_pos, n_out))
+                new_cols.append((data, dtype, i, "right", True))
+                new_labels.append(label)
+                continue
+            data = r_data_by_pos[i]
             if right_has_nulls and dtype.kind in "iu":
                 data = jnp.where(right_pos < 0, jnp.nan, data.astype(jnp.float64))
                 dtype = np.dtype(np.float64)
-            new_cols.append((data, dtype, i, "right"))
+            new_cols.append((data, dtype, i, "right", False))
             new_labels.append(label)
 
         if not pandas.Index(new_labels).is_unique:
@@ -1668,15 +1880,32 @@ class TpuQueryCompiler(BaseQueryCompiler):
             from modin_tpu.ops.join import _null_sentinel
             from modin_tpu.ops.structural import concat_columns
 
-            appendix_datas = []
-            for data, dtype, src_i, side in new_cols:
+            app_pos_h = None
+            dev_main, dev_appendix, dev_slots = [], [], []
+            host_merged: dict = {}
+            for slot, (data, dtype, src_i, side, is_host) in enumerate(new_cols):
+                if is_host:
+                    if app_pos_h is None:
+                        app_pos_h = _pos_h(appendix_positions, n_appendix)
+                    if side == "right":
+                        app = _host_take(
+                            rframe.get_column(src_i).to_numpy(), app_pos_h
+                        )
+                    elif src_i in key_appendix:
+                        app = _host_take(
+                            key_appendix[src_i].to_numpy(), app_pos_h
+                        )
+                    else:
+                        app = np.full(n_appendix, np.nan, dtype=object)
+                    host_merged[slot] = np.concatenate([data, app])
+                    continue
                 if side == "right":
                     app = gather_columns_device(
                         [rframe.get_column(src_i).data], appendix_positions
                     )[0]
                 elif src_i in key_appendix:
                     app = gather_columns_device(
-                        [key_appendix[src_i]], appendix_positions
+                        [key_appendix[src_i].data], appendix_positions
                     )[0]
                 elif dtype.kind == "f":
                     app = jnp.full(appendix_positions.shape, jnp.nan, data.dtype)
@@ -1688,33 +1917,73 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     )
                 if app.dtype != data.dtype:
                     app = app.astype(data.dtype)
-                appendix_datas.append(app)
+                dev_main.append(data)
+                dev_appendix.append(app)
+                dev_slots.append(slot)
             datas, _ = concat_columns(
-                [[d for d, _, _, _ in new_cols], appendix_datas],
-                [n_out, n_appendix],
-            )
-            for (data, dtype, _, _), merged in zip(new_cols, datas):
-                final_cols.append(DeviceColumn(merged, dtype, length=n_total))
+                [dev_main, dev_appendix], [n_out, n_appendix]
+            ) if dev_main else ([], None)
+            dev_merged = dict(zip(dev_slots, datas))
+            for slot, (data, dtype, _, _, is_host) in enumerate(new_cols):
+                if is_host:
+                    final_cols.append(
+                        HostColumn(_restore_host_dtype(host_merged[slot], dtype))
+                    )
+                else:
+                    final_cols.append(
+                        DeviceColumn(dev_merged[slot], dtype, length=n_total)
+                    )
         else:
-            for data, dtype, _, _ in new_cols:
-                final_cols.append(DeviceColumn(data, dtype, length=n_total))
+            for data, dtype, _, _, is_host in new_cols:
+                if is_host:
+                    final_cols.append(HostColumn(_restore_host_dtype(data, dtype)))
+                else:
+                    final_cols.append(DeviceColumn(data, dtype, length=n_total))
 
         if how == "outer" and n_total > 0:
             # pandas always sorts an outer merge by the join keys (stable, so
-            # within equal keys the left-join expansion order is kept)
+            # within equal keys the left-join expansion order is kept).
+            # Dict-encoded keys sort by their OUTPUT CODES (order-isomorphic
+            # to the strings): codes gathered by the join positions + the
+            # appendix, concatenated like the value columns were.
             from modin_tpu.ops import sort as sort_ops
+            from modin_tpu.ops.structural import concat_columns
 
-            key_arrays = [final_cols[lp].data for lp in lkey_positions]
+            key_arrays = []
+            for ki, lp in enumerate(lkey_positions):
+                if ki in dict_key_pairs:
+                    main = gather_columns_device([lkey_datas[ki]], left_pos)[0]
+                    if n_appendix > 0:
+                        app = gather_columns_device(
+                            [rkey_datas[ki]], appendix_positions
+                        )[0]
+                        merged, _ = concat_columns(
+                            [[main], [app]], [n_out, n_appendix]
+                        )
+                        key_arrays.append(merged[0])
+                    else:
+                        key_arrays.append(main)
+                else:
+                    key_arrays.append(final_cols[lp].data)
             perm = sort_ops.lexsort_permutation(
                 key_arrays, n_total, [True] * len(key_arrays)
             )
-            sorted_datas = gather_columns_device(
-                [c.data for c in final_cols], perm
+            perm_h = None
+            sorted_dev = gather_columns_device(
+                [c.data for c in final_cols if c.is_device], perm
             )
-            final_cols = [
-                DeviceColumn(d, c.pandas_dtype, length=n_total)
-                for d, c in zip(sorted_datas, final_cols)
-            ]
+            di = iter(sorted_dev)
+            resorted: list = []
+            for c in final_cols:
+                if c.is_device:
+                    resorted.append(
+                        DeviceColumn(next(di), c.pandas_dtype, length=n_total)
+                    )
+                else:
+                    if perm_h is None:
+                        perm_h = np.asarray(_jax.device_get(perm))[:n_total]
+                    resorted.append(HostColumn(c.data[perm_h]))
+            final_cols = resorted
 
         result_frame = TpuDataframe(
             final_cols,
@@ -2568,7 +2837,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
         ):
             ext = by if isinstance(by, TpuQueryCompiler) else by[0]
             eframe = ext._modin_frame
-            if eframe.num_cols != 1 or not eframe.get_column(0).is_device:
+            # non-device (object) external keys pass through: the key
+            # resolution below dictionary-encodes them or falls back
+            if eframe.num_cols != 1:
                 return None
             if len(eframe) != len(frame) or not self._fast_index_match(ext):
                 return None
@@ -2578,9 +2849,26 @@ class TpuQueryCompiler(BaseQueryCompiler):
             key_cols = [external_key]
         else:
             return None
-        if not all(
-            c.is_device and c.pandas_dtype.kind in "biuf" for c in key_cols
-        ):
+        # device-computable keys: numeric device columns directly, host
+        # string/object columns through their dictionary encoding (codes on
+        # device, categories host-side — ops/dictionary.py); key_decoders[i]
+        # holds the categories needed to translate level i's group codes
+        # back to labels when building the result index
+        key_data_cols = []
+        key_decoders: List[Any] = []
+        for c in key_cols:
+            if c.is_device and c.pandas_dtype.kind in "biuf":
+                key_data_cols.append(c)
+                key_decoders.append(None)
+                continue
+            if not c.is_device:
+                from modin_tpu.ops.dictionary import encode_host_column
+
+                enc = encode_host_column(c)
+                if enc is not None:
+                    key_data_cols.append(enc[0])
+                    key_decoders.append(enc[1])
+                    continue
             return None
         if len(frame) == 0:
             return None
@@ -2623,7 +2911,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         frame.materialize_device()
         try:
             codes, n_groups, group_keys, sizes = gb_ops.factorize_keys_cached(
-                [c.data for c in key_cols], len(frame), dropna=dropna
+                [c.data for c in key_data_cols], len(frame), dropna=dropna
             )
         except gb_ops._TooManyGroups:
             return None
@@ -2680,11 +2968,20 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 else:
                     out_dtypes.append(np.dtype(d.dtype))
 
-        # build result index from group keys
+        # build result index from group keys (dict-encoded levels translate
+        # their code values back to category labels)
+        from modin_tpu.ops.dictionary import decode_codes
+
+        decoded_keys = [
+            decode_codes(vals, cats) if cats is not None else vals
+            for vals, cats in zip(group_keys, key_decoders)
+        ]
         if len(key_labels) == 1:
-            result_index = pandas.Index(group_keys[0], name=key_labels[0])
+            result_index = pandas.Index(decoded_keys[0], name=key_labels[0])
         else:
-            result_index = pandas.MultiIndex.from_arrays(group_keys, names=key_labels)
+            result_index = pandas.MultiIndex.from_arrays(
+                decoded_keys, names=key_labels
+            )
 
         new_cols = [
             DeviceColumn(d, dt, length=n_groups) for d, dt in zip(datas, out_dtypes)

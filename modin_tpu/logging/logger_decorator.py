@@ -78,10 +78,14 @@ def enable_logging(
                     from modin_tpu.core.execution.progress import call_progress_bar
 
                     with call_progress_bar(log_name):
-                        return _run_inner(mode, metrics_on, *args, **kwargs)
-            return _run_inner(mode, metrics_on, *args, **kwargs)
+                        return _run_inner((mode, metrics_on), *args, **kwargs)
+            return _run_inner((mode, metrics_on), *args, **kwargs)
 
-        def _run_inner(mode: str, metrics_on: bool, *args: Any, **kwargs: Any) -> Any:
+        # state rides in ONE private positional: spreading it as named
+        # positionals collided with wrapped calls whose own kwargs include
+        # e.g. ``mode`` (pandas read_hdf/to_hdf/to_csv all have one)
+        def _run_inner(_log_state: tuple, *args: Any, **kwargs: Any) -> Any:
+            mode, metrics_on = _log_state
             if mode == "Disable" and not metrics_on:
                 return obj(*args, **kwargs)
             if mode == "Enable_Api_Only" and not is_api_layer and not metrics_on:

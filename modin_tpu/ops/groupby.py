@@ -169,6 +169,12 @@ _FACTORIZE_CACHE: List[
 _FACTORIZE_CACHE_MAX = 8
 
 
+def clear_factorize_cache() -> None:
+    """Drop all memoized key factorizations (cold-path benchmarking and
+    tests: a warm memo turns groupby timings into cache-hit lookups)."""
+    _FACTORIZE_CACHE.clear()
+
+
 def factorize_keys_cached(
     key_cols: List[Any], n: int, dropna: bool = True
 ) -> Tuple[Any, int, List[np.ndarray], Any]:

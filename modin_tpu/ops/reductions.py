@@ -629,3 +629,170 @@ def quantile_columns(
     fn = _jit_quantile(len(cols), int(n), len(qs), str(interpolation))
     results = fn(tuple(cols), jnp.asarray(qs, jnp.float64))
     return [np.asarray(r) for r in jax.device_get(results)]
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_mode(n_cols: int, n: int, k_bound: int):
+    import jax
+
+    def fn(cols: Tuple):
+        import jax.numpy as jnp
+
+        outs = []
+        for c in cols:
+            if c.dtype == jnp.bool_:
+                c = c.astype(jnp.int8)
+            xs, n_valid = _sorted_valid(c, n)
+            idx = jnp.arange(xs.shape[0])
+            valid = idx < n_valid
+            firsts = (
+                jnp.concatenate([jnp.ones(1, bool), xs[1:] != xs[:-1]]) & valid
+            )
+            # run id per element; counts via scatter-add of run starts' spans
+            rid = jnp.cumsum(firsts) - 1
+            ones = valid.astype(jnp.int64)
+            run_counts = jnp.zeros(xs.shape[0], jnp.int64).at[rid].add(ones)
+            count_of = run_counts[rid]
+            max_count = jnp.max(jnp.where(valid, count_of, 0))
+            is_modal = firsts & (count_of == max_count)
+            m = jnp.sum(is_modal)
+            # gather the modal values (already ascending) into k_bound slots
+            pos = jnp.cumsum(is_modal) - 1
+            slot = jnp.where(is_modal, pos, k_bound)
+            vals = (
+                jnp.zeros(k_bound, xs.dtype).at[slot].set(xs, mode="drop")
+            )
+            outs.append((vals, m))
+        return tuple(outs)
+
+    return jax.jit(fn)
+
+
+def mode_columns(cols: List[Any], n: int, k_bound: int = 1024) -> list:
+    """Per-column modal values (``dropna=True`` semantics): sort +
+    run-length + max-count.  Returns one host array per column holding that
+    column's modes in ascending order (pandas' order), or ``None`` in a slot
+    whose mode set exceeded ``k_bound`` or is empty (all-NaN column) — the
+    caller falls back for those.
+
+    Mirrors the reference's TreeReduce-based ``mode`` behavior
+    (modin/core/storage_formats/pandas/query_compiler.py) with a single
+    fused sort-based kernel per column instead of a partition map-reduce."""
+    import jax
+
+    fn = _jit_mode(len(cols), int(n), int(k_bound))
+    fetched = jax.device_get(fn(tuple(cols)))
+    out = []
+    for vals, m in fetched:
+        m = int(m)
+        out.append(np.asarray(vals[:m]) if 0 < m <= int(k_bound) else None)
+    return out
+
+
+def _axis1_matrix(cols, n):
+    """Stack padded columns into an (n_pad, k) matrix in their numpy common
+    dtype (pandas' axis-1 upcast rule)."""
+    import jax.numpy as jnp
+
+    common = np.result_type(*[np.dtype(str(c.dtype)) for c in cols])
+    if common.kind == "b":
+        common = np.dtype(np.int8)
+    return jnp.stack([c.astype(common.name) for c in cols], axis=1)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_nunique_axis1(n_cols: int, n: int, dropna: bool):
+    import jax
+
+    def fn(cols: Tuple):
+        import jax.numpy as jnp
+
+        x = _axis1_matrix(cols, n)
+        xs = jnp.sort(x, axis=1)  # NaN sort to the row tail
+        k = xs.shape[1]
+        if jnp.issubdtype(xs.dtype, jnp.floating):
+            nv = jnp.sum(~jnp.isnan(xs), axis=1)
+        else:
+            nv = jnp.full(xs.shape[0], k, jnp.int64)
+        j = jnp.arange(1, k)
+        news = (xs[:, 1:] != xs[:, :-1]) & (j[None, :] < nv[:, None])
+        distinct = jnp.where(nv > 0, 1 + jnp.sum(news, axis=1), 0)
+        if not dropna and jnp.issubdtype(xs.dtype, jnp.floating):
+            distinct = distinct + (nv < k).astype(distinct.dtype)
+        return distinct.astype(jnp.int64)
+
+    return jax.jit(fn)
+
+
+def nunique_axis1(cols: List[Any], n: int, dropna: bool = True) -> Any:
+    """Row-wise distinct count across columns -> padded device int64 array.
+
+    Sorted-row adjacent-difference: one jit, no per-row Python.  Parity
+    target: pandas ``DataFrame.nunique(axis=1)`` (reference routes it
+    through a full-axis fold, modin/core/storage_formats/pandas/
+    query_compiler.py)."""
+    return _jit_nunique_axis1(len(cols), int(n), bool(dropna))(tuple(cols))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_mode_axis1(n_cols: int, n: int):
+    import jax
+
+    def fn(cols: Tuple):
+        import jax.numpy as jnp
+
+        x = _axis1_matrix(cols, n)
+        nrow, k = x.shape
+        is_f = jnp.issubdtype(x.dtype, jnp.floating)
+        xs = jnp.sort(x, axis=1)  # NaN to the row tail
+        if is_f:
+            nv = jnp.sum(~jnp.isnan(xs), axis=1)  # valid count per row
+        else:
+            nv = jnp.full(nrow, k, jnp.int64)
+        j = jnp.arange(k)
+        valid = j[None, :] < nv[:, None]
+        firsts = (
+            jnp.concatenate(
+                [jnp.ones((nrow, 1), bool), xs[:, 1:] != xs[:, :-1]], axis=1
+            )
+            & valid
+        )
+        rid = jnp.cumsum(firsts, axis=1) - 1
+        # run counts without 2-D scatter: O(k) unrolled equality folds
+        run_counts = jnp.stack(
+            [jnp.sum((rid == q) & valid, axis=1) for q in range(k)], axis=1
+        )
+        count_of = jnp.take_along_axis(run_counts, jnp.maximum(rid, 0), axis=1)
+        max_count = jnp.max(jnp.where(valid, count_of, 0), axis=1)
+        is_modal = firsts & (count_of == max_count[:, None])
+        m = jnp.sum(is_modal, axis=1)
+        pos = jnp.cumsum(is_modal, axis=1) - 1
+        slot = jnp.where(is_modal, pos, k)
+        rows = jnp.arange(nrow)[:, None]
+        # native-dtype output (zero-padded; exact for int64) + a float64
+        # NaN-padded view for the ragged case (pandas' upcast)
+        vals = jnp.zeros((nrow, k + 1), xs.dtype).at[rows, slot].set(xs)[:, :k]
+        placed = jnp.zeros((nrow, k + 1), bool).at[rows, slot].set(True)[:, :k]
+        vals_f = jnp.where(placed, vals.astype(jnp.float64), jnp.nan)
+        row_ok = jnp.arange(nrow) < n
+        m = jnp.where(row_ok, m, 0)
+        m_max = jnp.max(m)
+        uniform = jnp.all(jnp.where(row_ok, m == m_max, True))
+        return vals, vals_f, m_max, uniform
+
+    return jax.jit(fn)
+
+
+def mode_axis1(cols: List[Any], n: int) -> Tuple[Any, Any, int, bool]:
+    """Row-wise modes (``dropna=True``): (native-dtype zero-padded matrix,
+    float64 NaN-padded matrix, max mode count over valid rows, whether every
+    valid row has exactly max_count modes).  The caller takes the native
+    matrix when uniform (no padding -> pandas keeps the source dtype) and
+    the float64 one otherwise."""
+    import jax
+
+    vals, vals_f, m_max, uniform = _jit_mode_axis1(len(cols), int(n))(
+        tuple(cols)
+    )
+    m_max, uniform = jax.device_get((m_max, uniform))
+    return vals, vals_f, int(m_max), bool(uniform)

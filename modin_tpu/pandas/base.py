@@ -1259,7 +1259,13 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
         return self._default_to_pandas("to_xarray", *args, **kwargs)
 
     def to_hdf(self, path_or_buf: Any, *, key: str, **kwargs: Any):
-        return self._default_to_pandas("to_hdf", path_or_buf, key=key, **kwargs)
+        from modin_tpu.core.execution.dispatching.factories.dispatcher import (
+            FactoryDispatcher,
+        )
+
+        return FactoryDispatcher.to_hdf(
+            self._query_compiler, path_or_buf=path_or_buf, key=key, **kwargs
+        )
 
     def to_excel(self, excel_writer: Any, *args: Any, **kwargs: Any):
         from modin_tpu.core.execution.dispatching.factories.dispatcher import (

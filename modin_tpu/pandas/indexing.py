@@ -210,7 +210,11 @@ class _LocIndexer(_LocationIndexerBase):
                 try:
                     return self._getitem_via_qc(key, key, slice(None))
                 except KeyError:
-                    pass
+                    # a >2-long all-scalar tuple can only be a row key (a
+                    # (row, col) pair has 2 parts): pandas surfaces the
+                    # KeyError, not "Too many indexers"
+                    if len(key) > 2:
+                        raise
             if len(key) > 2:
                 raise pandas.errors.IndexingError("Too many indexers")
             row_key = key[0]
@@ -334,11 +338,16 @@ class _LocIndexer(_LocationIndexerBase):
                 isinstance(result, Series) and row_squeezed and not col_squeezed
             )
             if index_is_columns:
+                # same guard as the row branch below: only a scalar or tuple
+                # col key looks up INTO the levels; a LIST key selects whole
+                # level-0 entries and pandas keeps all levels
                 if (
-                    isinstance(col_list, (list, tuple))
+                    (col_scalar or isinstance(col_key, tuple))
+                    and isinstance(col_list, (list, tuple))
                     and 0 < len(col_list) < result.index.nlevels
                     and all(
                         not isinstance(col_list[i], slice)
+                        and is_scalar(col_list[i])
                         and col_list[i] in result.index.levels[i]
                         for i in range(len(col_list))
                     )

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# One-command multi-execution verification (VERDICT r4 item 6; mirrors the
+# reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
+#
+#   ./scripts/check_all.sh            # all four gates, fail on any red
+#   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
+#
+# Gates:
+#   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
+#   2. suite under PandasOnPython
+#   3. suite under NativeOnNative
+#   4. dryrun_multichip(8): the real multi-chip training-step sharding
+#      compiled + executed on an 8-device virtual CPU mesh
+set -u
+cd "$(dirname "$0")/.."
+
+XDIST=${XDIST:-}
+EXTRA=${FAST:+-x}
+fails=()
+
+run_gate() {
+  local name="$1"; shift
+  echo "=== gate: $name ==="
+  if "$@"; then
+    echo "=== gate OK: $name ==="
+  else
+    echo "=== gate FAILED: $name ==="
+    fails+=("$name")
+  fi
+}
+
+run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
+run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
+run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
+run_gate "dryrun_multichip" python __graft_entry__.py
+
+if [ "${#fails[@]}" -ne 0 ]; then
+  echo "RED gates: ${fails[*]}"
+  exit 1
+fi
+echo "ALL FOUR GATES GREEN"

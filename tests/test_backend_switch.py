@@ -72,12 +72,14 @@ def test_mixed_backend_concat_coerces():
 def test_auto_switch_moves_fallback_op_to_native():
     # a small device frame running an op with no device kernel should
     # relocate to the Native backend when AutoSwitchBackend is on
+    # (melt has no TpuQC override; mode — the op used before r05 — grew a
+    # device kernel and stays on Tpu)
     md = pd.DataFrame({"a": [3.0, 1.0, 2.0, 1.0]})
     assert _backend(md) == "TpuQueryCompiler"
     with AutoSwitchBackend.context(True):
-        out = md.mode()
+        out = md.melt()
     assert _backend(out) == "NativeQueryCompiler"
-    df_equals(out, pandas.DataFrame({"a": [3.0, 1.0, 2.0, 1.0]}).mode())
+    df_equals(out, pandas.DataFrame({"a": [3.0, 1.0, 2.0, 1.0]}).melt())
 
 
 def test_no_auto_switch_when_disabled():

@@ -1,0 +1,197 @@
+"""Dictionary-encoded string columns (ops/dictionary.py): device groupby and
+merge on string/object keys via float64 codes + host categories.
+
+SURVEY §7's staged string answer; the reference instead ships whole object
+partitions to workers (modin/core/storage_formats/pandas/query_compiler.py
+groupby/merge on object keys).  Differential vs pandas with path-taken
+assertions (tests.utils.assert_no_fallback).
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import assert_no_fallback, create_test_dfs, df_equals, eval_general
+
+_rng = np.random.default_rng(41)
+_CITIES = np.array(
+    ["tokyo", "oslo", "lima", "cairo", "perth", "quito", "dakar"], dtype=object
+)
+
+
+def _str_frame(n=1500, nan_frac=0.0, seed=0):
+    rng = np.random.default_rng(seed)
+    key = _CITIES[rng.integers(0, len(_CITIES), n)].copy()
+    if nan_frac:
+        key[rng.random(n) < nan_frac] = np.nan
+    return {
+        "city": key,
+        "v": rng.normal(size=n),
+        "w": rng.integers(0, 9, n),
+    }
+
+
+class TestDictGroupBy:
+    @pytest.mark.parametrize("agg", ["sum", "mean", "count", "size", "median", "min", "max"])
+    def test_str_key_aggs_device(self, agg):
+        md, pdf = create_test_dfs(_str_frame())
+        got = assert_no_fallback(lambda: getattr(md.groupby("city"), agg)())
+        df_equals(got, getattr(pdf.groupby("city"), agg)())
+
+    def test_str_key_selection(self):
+        md, pdf = create_test_dfs(_str_frame())
+        got = assert_no_fallback(lambda: md.groupby("city")["v"].mean())
+        df_equals(got, pdf.groupby("city")["v"].mean())
+
+    @pytest.mark.parametrize("dropna", [True, False])
+    def test_nan_keys(self, dropna):
+        md, pdf = create_test_dfs(_str_frame(nan_frac=0.1))
+        got = assert_no_fallback(
+            lambda: md.groupby("city", dropna=dropna).sum()
+        )
+        df_equals(got, pdf.groupby("city", dropna=dropna).sum())
+
+    def test_multi_key_str_plus_int(self):
+        md, pdf = create_test_dfs(_str_frame())
+        got = assert_no_fallback(lambda: md.groupby(["city", "w"])["v"].sum())
+        df_equals(got, pdf.groupby(["city", "w"])["v"].sum())
+
+    def test_by_external_str_series(self):
+        md, pdf = create_test_dfs(_str_frame())
+        got = assert_no_fallback(lambda: md["v"].groupby(md["city"]).sum())
+        df_equals(got, pdf["v"].groupby(pdf["city"]).sum())
+
+    def test_sort_false_appearance_order(self):
+        md, pdf = create_test_dfs(_str_frame())
+        eval_general(
+            md, pdf, lambda df: df.groupby("city", sort=False).sum()
+        )
+
+    def test_as_index_false(self):
+        md, pdf = create_test_dfs(_str_frame())
+        eval_general(
+            md, pdf, lambda df: df.groupby("city", as_index=False).sum()
+        )
+
+    def test_unorderable_mixed_key_falls_back_correct(self):
+        data = {
+            "k": np.array([1, "a", 2.5, "a", 1] * 20, dtype=object),
+            "v": np.arange(100.0),
+        }
+        md, pdf = create_test_dfs(data)
+        eval_general(md, pdf, lambda df: df.groupby("k")["v"].sum())
+
+    def test_encoding_cached_across_aggs(self):
+        md, pdf = create_test_dfs(_str_frame())
+        col = md._query_compiler._modin_frame.get_column(0)
+        assert_no_fallback(lambda: md.groupby("city").sum())
+        first = col._dict_cache
+        assert first not in (None, False)
+        assert_no_fallback(lambda: md.groupby("city").mean())
+        assert col._dict_cache is first  # same encoding object: no re-factorize
+
+
+class TestDictMerge:
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_str_key_merge_device(self, how):
+        L = _str_frame(n=1200, seed=1)
+        R = {
+            "city": _CITIES[np.random.default_rng(2).integers(1, 7, 900)],
+            "z": np.random.default_rng(2).normal(size=900),
+        }
+        md_l, pdf_l = create_test_dfs(L)
+        md_r, pdf_r = create_test_dfs(R)
+        got = assert_no_fallback(lambda: md_l.merge(md_r, on="city", how=how))
+        df_equals(got, pdf_l.merge(pdf_r, on="city", how=how))
+
+    @pytest.mark.parametrize("how", ["inner", "left", "outer"])
+    def test_nan_keys_match_like_pandas(self, how):
+        # pandas joins NaN keys to NaN keys; the IEEE total order the join
+        # kernels share makes NaN codes behave identically
+        L = _str_frame(n=800, nan_frac=0.1, seed=3)
+        R = _str_frame(n=700, nan_frac=0.1, seed=4)
+        R = {"city": R["city"], "z": R["v"]}
+        md_l, pdf_l = create_test_dfs(L)
+        md_r, pdf_r = create_test_dfs(R)
+        got = assert_no_fallback(lambda: md_l.merge(md_r, on="city", how=how))
+        df_equals(got, pdf_l.merge(pdf_r, on="city", how=how))
+
+    def test_two_str_keys(self):
+        rng = np.random.default_rng(5)
+        L = {
+            "city": _CITIES[rng.integers(0, 6, 1000)],
+            "tag": np.array(["x", "y"], dtype=object)[rng.integers(0, 2, 1000)],
+            "v": rng.normal(size=1000),
+        }
+        R = {
+            "city": _CITIES[rng.integers(1, 7, 800)],
+            "tag": np.array(["x", "y", "z"], dtype=object)[rng.integers(0, 3, 800)],
+            "w": rng.integers(0, 5, 800),
+        }
+        md_l, pdf_l = create_test_dfs(L)
+        md_r, pdf_r = create_test_dfs(R)
+        got = assert_no_fallback(lambda: md_l.merge(md_r, on=["city", "tag"]))
+        df_equals(got, pdf_l.merge(pdf_r, on=["city", "tag"]))
+
+    def test_left_on_right_on_str(self):
+        rng = np.random.default_rng(6)
+        L = {"a_city": _CITIES[rng.integers(0, 6, 500)], "v": rng.normal(size=500)}
+        R = {"b_city": _CITIES[rng.integers(1, 7, 400)], "w": rng.integers(0, 5, 400)}
+        md_l, pdf_l = create_test_dfs(L)
+        md_r, pdf_r = create_test_dfs(R)
+        got = assert_no_fallback(
+            lambda: md_l.merge(md_r, left_on="a_city", right_on="b_city")
+        )
+        df_equals(got, pdf_l.merge(pdf_r, left_on="a_city", right_on="b_city"))
+
+    def test_str_payload_columns_gather_on_host(self):
+        rng = np.random.default_rng(8)
+        L = _str_frame(n=600, seed=8)
+        L["note"] = np.array(["a", "bb", "ccc"], dtype=object)[
+            rng.integers(0, 3, 600)
+        ]
+        R = {"city": _CITIES[rng.integers(0, 7, 500)], "z": rng.normal(size=500)}
+        md_l, pdf_l = create_test_dfs(L)
+        md_r, pdf_r = create_test_dfs(R)
+        for how in ("inner", "left", "outer"):
+            got = assert_no_fallback(lambda: md_l.merge(md_r, on="city", how=how))
+            df_equals(got, pdf_l.merge(pdf_r, on="city", how=how))
+
+    def test_mixed_numeric_and_str_key_dtypes_fall_back_correct(self):
+        # str key on one side, numeric on the other: pandas raises
+        L = {"k": _CITIES[np.random.default_rng(1).integers(0, 3, 50)]}
+        R = {"k": np.arange(50)}
+        md_l, pdf_l = create_test_dfs(L)
+        md_r, pdf_r = create_test_dfs(R)
+        eval_general(md_l, pdf_l, lambda df: df.merge(md_r if df is md_l else pdf_r, on="k"))
+
+
+class TestDictEncodingUnit:
+    def test_codes_order_isomorphic(self):
+        from modin_tpu.ops.dictionary import encode_host_column
+
+        md, _ = create_test_dfs({"s": np.array(["b", "a", "c", "a"], dtype=object)})
+        col = md._query_compiler._modin_frame.get_column(0)
+        enc = encode_host_column(col)
+        assert enc is not None
+        codes_col, cats = enc
+        assert list(cats) == ["a", "b", "c"]
+        codes = np.asarray(codes_col.data)[:4]
+        assert codes.tolist() == [1.0, 0.0, 2.0, 0.0]
+
+    def test_union_categories_preserves_order(self):
+        from modin_tpu.ops.dictionary import union_categories
+
+        u, lm, rm = union_categories(
+            np.array(["a", "c"], dtype=object), np.array(["b", "c"], dtype=object)
+        )
+        assert list(u) == ["a", "b", "c"]
+        assert lm.tolist() == [0.0, 2.0] and rm.tolist() == [1.0, 2.0]
+
+    def test_non_string_column_not_encoded(self):
+        from modin_tpu.ops.dictionary import encode_host_column
+
+        md, _ = create_test_dfs({"x": pandas.array([1, 2, None], dtype="Int64")})
+        col = md._query_compiler._modin_frame.get_column(0)
+        assert encode_host_column(col) is None

@@ -247,3 +247,34 @@ def test_squeeze():
     df_equals(md.squeeze(axis=1), pdf.squeeze(axis=1))
     md1, pdf1 = create_test_dfs({"only": [42.0]})
     assert md1.squeeze() == pdf1.squeeze()
+
+
+class TestAdviceR4Indexing:
+    """Regressions from the r4 advisor review (ADVICE.md)."""
+
+    def test_loc_scalar_row_list_col_keeps_mi_column_levels(self):
+        # md.loc[0, ["a"]] on 2-level columns: a LIST col key selects whole
+        # level-0 entries; pandas keeps [('a','x'),('a','y')] — the
+        # level-drop applies only to scalar/tuple keys
+        cols = pandas.MultiIndex.from_product([["a", "b"], ["x", "y"]])
+        vals = np.arange(8).reshape(2, 4)
+        md = pd.DataFrame(vals, columns=cols)
+        pdf = pandas.DataFrame(vals, columns=cols)
+        df_equals(md.loc[0, ["a"]], pdf.loc[0, ["a"]])
+        # scalar and tuple col keys still drop the looked-up levels
+        df_equals(md.loc[0, "a"], pdf.loc[0, "a"])
+        df_equals(md.loc[0, ("a", "x")], pdf.loc[0, ("a", "x")])
+
+    def test_loc_missing_full_depth_tuple_raises_keyerror(self):
+        # loc[('bar','one',99)] on a 3-level index: pandas raises KeyError,
+        # not IndexingError('Too many indexers')
+        mi = pandas.MultiIndex.from_tuples(
+            [("bar", "one", 1), ("bar", "two", 2), ("foo", "one", 3)]
+        )
+        md = pd.DataFrame({"v": [1, 2, 3]}, index=mi)
+        pdf = pandas.DataFrame({"v": [1, 2, 3]}, index=mi)
+        eval_general(md, pdf, lambda df: df.loc[("bar", "one", 99)])
+        # the full-depth hit still resolves
+        df_equals(md.loc[("bar", "one", 1)], pdf.loc[("bar", "one", 1)])
+        # and 4 indexers on a 3-level frame still over-indexes both sides
+        eval_general(md, pdf, lambda df: df.loc[("bar", "one", 1, 7)])
