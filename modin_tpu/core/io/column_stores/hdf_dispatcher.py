@@ -80,23 +80,24 @@ class HDFDispatcher(FileDispatcher):
                 result if isinstance(result, pandas.DataFrame) else result.to_frame(),
                 cls.frame_cls,
             )
-        # table format with a known row count: bounded-memory window reads
-        # (each window is device_put as it lands; the host never holds more
-        # than one window plus the assembled device frame)
-        pieces: List[pandas.DataFrame] = []
+        # table format with a known row count: bounded-memory window reads —
+        # each window becomes a device-backed compiler as it lands (its
+        # numeric columns device_put immediately), then one device-side row
+        # concat; the host holds one window, never the full frame
+        qcs: List[Any] = []
         for start in range(0, nrows, _HDF_CHUNK_ROWS):
-            pieces.append(
-                pandas.read_hdf(
-                    path_or_buf,
-                    key=key,
-                    mode=mode,
-                    start=start,
-                    stop=min(start + _HDF_CHUNK_ROWS, nrows),
-                    **kwargs,
-                )
+            window = pandas.read_hdf(
+                path_or_buf,
+                key=key,
+                mode=mode,
+                start=start,
+                stop=min(start + _HDF_CHUNK_ROWS, nrows),
+                **kwargs,
             )
-        df = pandas.concat(pieces, axis=0)
-        return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
+            qcs.append(cls.query_compiler_cls.from_pandas(window, cls.frame_cls))
+        if len(qcs) == 1:
+            return qcs[0]
+        return qcs[0].concat(0, qcs[1:])
 
     @classmethod
     def write(cls, qc: Any, path_or_buf: Any, key: Any = None, **kwargs: Any):

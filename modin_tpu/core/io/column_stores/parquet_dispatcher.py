@@ -29,6 +29,20 @@ from modin_tpu.core.io.file_dispatcher import FileDispatcher
 _WRITE_CHUNK_ROWS = 4 << 20
 
 
+def _null_pinned_single_shot(pa, qc, schema, preserve_index, make_writer):
+    """When the first streamed window pinned a pa.null-typed field (it saw
+    only nulls), later non-null chunks cannot cast into the schema: write the
+    whole frame in one shot instead (pandas-style whole-column inference).
+    Returns the opened writer after writing, or None when the schema is fine
+    and the chunked stream should proceed."""
+    if not any(pa.types.is_null(f.type) for f in schema):
+        return None
+    table = pa.Table.from_pandas(qc.to_pandas(), preserve_index=preserve_index)
+    writer = make_writer(table.schema)
+    writer.write_table(table)
+    return writer
+
+
 class ParquetDispatcher(FileDispatcher):
     @classmethod
     def _read(cls, path: Any = None, engine: str = "auto", columns: Optional[List] = None, **kwargs: Any):
@@ -178,18 +192,11 @@ class ParquetDispatcher(FileDispatcher):
                 )
                 if writer is None:
                     schema = table.schema
-                    if any(pa.types.is_null(f.type) for f in schema):
-                        # the first window saw only nulls in some column, so
-                        # the pinned type is pa.null and a later non-null
-                        # chunk cannot cast into it — single-shot write
-                        # instead (pandas infers from the whole column)
-                        table = pa.Table.from_pandas(
-                            qc.to_pandas(), preserve_index=preserve
-                        )
-                        writer = pq.ParquetWriter(
-                            path, table.schema, compression=compression
-                        )
-                        writer.write_table(table)
+                    writer = _null_pinned_single_shot(
+                        pa, qc, schema, preserve,
+                        lambda s: pq.ParquetWriter(path, s, compression=compression),
+                    )
+                    if writer is not None:
                         return None
                     writer = pq.ParquetWriter(
                         path, schema, compression=compression
@@ -306,16 +313,11 @@ class FeatherDispatcher(FileDispatcher):
                 )
                 if writer is None:
                     schema = table.schema
-                    if any(pa.types.is_null(f.type) for f in schema):
-                        # null-pinned field: later non-null chunks cannot
-                        # cast into it — single-shot write instead
-                        table = pa.Table.from_pandas(
-                            qc.to_pandas(), preserve_index=False
-                        )
-                        writer = pa.ipc.new_file(
-                            path, table.schema, options=options
-                        )
-                        writer.write_table(table)
+                    writer = _null_pinned_single_shot(
+                        pa, qc, schema, False,
+                        lambda s: pa.ipc.new_file(path, s, options=options),
+                    )
+                    if writer is not None:
                         return None
                     writer = pa.ipc.new_file(path, schema, options=options)
                 writer.write_table(table)

@@ -206,9 +206,13 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 positions = [int(c) for c in columns]
             frame = frame.select_columns_by_position(positions)
         if index is not None:
-            frame = frame.take_rows_positional(
-                index if isinstance(index, slice) else np.asarray(list(index), dtype=np.int64)
-            )
+            if not isinstance(index, slice):
+                # materialize generators; arrays/Index pass through without
+                # the million-python-int list a bare list() would build
+                if not hasattr(index, "__len__"):
+                    index = list(index)
+                index = np.asarray(index, dtype=np.int64)
+            frame = frame.take_rows_positional(index)
         return type(self)(frame)
 
     def getitem_array(self, key: Any) -> "TpuQueryCompiler":
@@ -859,25 +863,47 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     def nunique(self, axis: int = 0, dropna: bool = True, **kwargs: Any):
         frame = self._modin_frame
-        if (
-            axis == 0
-            and not kwargs
-            and len(frame)
-            and all(
-                c.is_device and c.pandas_dtype.kind in "biuf"
-                for c in frame._columns
-            )
-        ):
-            from modin_tpu.ops.reductions import nunique_columns
+        if axis == 0 and not kwargs and len(frame):
+            # numeric device columns -> sort-based kernel; object/str columns
+            # read their distinct count straight off the dictionary encoding
+            # (categories are the distinct non-missing values)
+            dev_positions = []
+            dict_counts: dict = {}
+            ok = bool(frame.num_cols)
+            for i, c in enumerate(frame._columns):
+                if c.is_device and c.pandas_dtype.kind in "biuf":
+                    dev_positions.append(i)
+                    continue
+                if not c.is_device:
+                    from modin_tpu.ops.dictionary import encode_host_column
 
-            frame.materialize_device()
-            counts = nunique_columns(
-                [c.data for c in frame._columns], len(frame), bool(dropna)
-            )
-            result = pandas.Series(counts, index=frame.columns, dtype=np.int64)
-            return type(self).from_pandas(
-                result.to_frame(MODIN_UNNAMED_SERIES_LABEL)
-            )
+                    enc = encode_host_column(c)
+                    if enc is not None:
+                        dict_counts[i] = len(enc.categories) + (
+                            0 if dropna else int(enc.has_nan)
+                        )
+                        continue
+                ok = False
+                break
+            if ok:
+                from modin_tpu.ops.reductions import nunique_columns
+
+                frame.materialize_device()
+                dev_counts = nunique_columns(
+                    [frame._columns[i].data for i in dev_positions],
+                    len(frame),
+                    bool(dropna),
+                )
+                by_pos = dict(zip(dev_positions, dev_counts))
+                by_pos.update(dict_counts)
+                result = pandas.Series(
+                    [by_pos[i] for i in range(frame.num_cols)],
+                    index=frame.columns,
+                    dtype=np.int64,
+                )
+                return type(self).from_pandas(
+                    result.to_frame(MODIN_UNNAMED_SERIES_LABEL)
+                )
         if (
             axis == 1
             and not kwargs
@@ -1245,31 +1271,66 @@ class TpuQueryCompiler(BaseQueryCompiler):
         if scalar_list:
             vals = list(values)
             scalar_list = 0 < len(vals) <= 1024 and all(
-                isinstance(v, (int, float, bool, np.integer, np.floating, np.bool_))
+                isinstance(
+                    v, (int, float, bool, str, np.integer, np.floating, np.bool_)
+                )
                 for v in vals
             )
-        if (
-            scalar_list
-            and not kwargs
-            and len(frame)
-            and all(
-                c.is_device and c.pandas_dtype.kind in "biuf"
-                for c in frame._columns
+        plans = None
+        if scalar_list and not kwargs and len(frame):
+            # per-column plan: numeric device columns compare raw values;
+            # object/str columns compare dictionary CODES of the values that
+            # exist in their categories (absent/unorderable values can't match)
+            missing_vals = any(
+                v is None
+                or (isinstance(v, (float, np.floating)) and np.isnan(v))
+                for v in vals
             )
-        ):
+            plans = []
+            for c in frame._columns:
+                if c.is_device and c.pandas_dtype.kind in "biuf":
+                    plans.append((c, None, False))
+                    continue
+                if not c.is_device:
+                    from modin_tpu.ops.dictionary import encode_host_column
+
+                    enc = encode_host_column(c)
+                    if enc is not None:
+                        # object dtype keeps None and np.nan DISTINCT in
+                        # pandas isin, but both encode to NaN codes: with
+                        # missing rows AND a missing search value the match
+                        # is undecidable post-encoding — fall back.  The
+                        # str dtype unifies them (all-missing match), so
+                        # its device path survives.
+                        if (
+                            missing_vals
+                            and enc.has_nan
+                            and c.pandas_dtype == object
+                        ):
+                            plans = None
+                            break
+                        plans.append(
+                            (enc.codes, enc.categories, missing_vals)
+                        )
+                        continue
+                plans = None
+                break
+        if plans is not None:
             import jax.numpy as jnp
 
+            from modin_tpu.ops.dictionary import lookup_values
             from modin_tpu.ops.lazy import lazy_op
 
             has_nan = any(
                 isinstance(v, (float, np.floating)) and np.isnan(v) for v in vals
             )
-            clean = [
+            numeric = [
                 v for v in vals
-                if not (isinstance(v, (float, np.floating)) and np.isnan(v))
+                if isinstance(v, (int, float, bool, np.integer, np.floating, np.bool_))
+                and not (isinstance(v, (float, np.floating)) and np.isnan(v))
             ]
 
-            clean_arr = np.asarray(clean) if clean else np.empty(0, np.float64)
+            clean_arr = np.asarray(numeric) if numeric else np.empty(0, np.float64)
             all_int_values = clean_arr.dtype.kind in "biu"
 
             def values_for(dtype: np.dtype):
@@ -1288,13 +1349,23 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
             frame.materialize_device()
             datas = []
-            for c in frame._columns:
-                op = (
-                    "isin_vals_nan"
-                    if has_nan and c.pandas_dtype.kind == "f"
-                    else "isin_vals"
-                )
-                datas.append(lazy_op(op, c.data, values_for(c.pandas_dtype)))
+            for col, cats, match_missing in plans:
+                if cats is None:
+                    op = (
+                        "isin_vals_nan"
+                        if has_nan and col.pandas_dtype.kind == "f"
+                        else "isin_vals"
+                    )
+                    datas.append(
+                        lazy_op(op, col.data, values_for(col.pandas_dtype))
+                    )
+                else:
+                    code_vals = lookup_values(vals, cats)
+                    code_vals = code_vals[~np.isnan(code_vals)]
+                    op = "isin_vals_nan" if match_missing else "isin_vals"
+                    datas.append(
+                        lazy_op(op, col.data, jnp.asarray(code_vals))
+                    )
             return self._wrap_device_result(
                 datas, dtypes=[np.dtype(bool)] * len(datas)
             )
@@ -1509,18 +1580,27 @@ class TpuQueryCompiler(BaseQueryCompiler):
         dropna = kwargs.get("dropna", True)
         frame = self._modin_frame
         col = frame.get_column(0) if frame.num_cols == 1 else None
+        decoder = None
+        data_col = col
+        if col is not None and not col.is_device and bins is None and len(frame) > 0:
+            # string/object series count by their dictionary codes
+            from modin_tpu.ops.dictionary import encode_host_column
+
+            enc = encode_host_column(col)
+            if enc is not None:
+                data_col, decoder = enc.codes, enc.categories
         if (
             bins is None
-            and col is not None
-            and col.is_device
-            and col.pandas_dtype.kind in "biuf"
+            and data_col is not None
+            and data_col.is_device
+            and (decoder is not None or col.pandas_dtype.kind in "biuf")
             and len(frame) > 0
         ):
             from modin_tpu.ops import groupby as gb_ops
 
             try:
                 codes, n_groups, group_keys, sizes = gb_ops.factorize_keys_cached(
-                    [col.data], len(frame), dropna=dropna
+                    [data_col.data], len(frame), dropna=dropna
                 )
             except gb_ops._TooManyGroups:
                 return super().series_value_counts(**kwargs)
@@ -1537,7 +1617,12 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 for v in jax.device_get((counts_dev, first_dev))
             )
             counts = counts[:n_groups]
-            keys = np.asarray(group_keys[0])
+            if decoder is not None:
+                from modin_tpu.ops.dictionary import decode_codes
+
+                keys = decode_codes(np.asarray(group_keys[0]), decoder)
+            else:
+                keys = np.asarray(group_keys[0])
             values = counts / counts.sum() if normalize else counts
             name = frame.columns[0]
             result = pandas.Series(
@@ -1712,10 +1797,12 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     union_categories,
                 )
 
-                (l_codes, l_cats), (r_codes, r_cats) = dict_key_pairs[ki]
-                _, l_map, r_map = union_categories(l_cats, r_cats)
-                lkey_datas.append(remap_codes_device(l_codes.data, l_map))
-                rkey_datas.append(remap_codes_device(r_codes.data, r_map))
+                l_enc, r_enc = dict_key_pairs[ki]
+                _, l_map, r_map = union_categories(
+                    l_enc.categories, r_enc.categories
+                )
+                lkey_datas.append(remap_codes_device(l_enc.codes.data, l_map))
+                rkey_datas.append(remap_codes_device(r_enc.codes.data, r_map))
             else:
                 lkey_datas.append(lframe.get_column(lp).data)
                 rkey_datas.append(rframe.get_column(rp).data)
@@ -2442,6 +2529,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         memory is O(chunk), never the full frame (the base-class path's
         ``self.to_pandas()`` cliff).
         """
+        from modin_tpu.ops import groupby as gb_ops
         from modin_tpu.parallel.mesh import num_row_shards
         from modin_tpu.parallel.shuffle import ShuffleSkewError, range_shuffle
 
@@ -2457,31 +2545,104 @@ class TpuQueryCompiler(BaseQueryCompiler):
         gk = dict(groupby_kwargs)
         if gk.get("level") is not None or gk.pop("axis", 0) not in (0, "index"):
             return None
-        if not gk.get("sort", True) or not gk.get("as_index", True):
-            # chunk concat reproduces key-sorted group order only
-            return None
         if gk.get("group_keys", True) is False:
             # with group_keys=False pandas restores original row order for
             # like-indexed UDF results — same concat-order hazard
             return None
-        by_list = [by] if not isinstance(by, list) else list(by)
-        if len(by_list) != 1 or hasattr(by_list[0], "to_pandas"):
-            return None
-        pos = frame.column_position(by_list[0])
-        if len(pos) != 1 or pos[0] < 0:
-            return None
-        key_col = frame._columns[pos[0]]
-        if not key_col.is_device or key_col.pandas_dtype.kind not in "biuf":
+        sort = gk.get("sort", True)
+        as_index = gk.get("as_index", True)
+        dropna = gk.get("dropna", True)
+        if not sort and not dropna:
+            # the appearance-order reorder maps result rows to groups by key
+            # VALUE; NaN keys (kept by dropna=False) don't hash-match
             return None
 
+        # ---- resolve keys: in-frame labels (numeric or dict-encoded) and
+        #      external single-column compilers ---------------------------- #
+        by_list = [by] if not isinstance(by, list) else list(by)
+        key_datas = []
+        key_decoders: List[Any] = []
+        ext_positions: dict = {}
+        for bi, b in enumerate(by_list):
+            if isinstance(b, TpuQueryCompiler):
+                eframe = b._modin_frame
+                if (
+                    eframe.num_cols != 1
+                    or len(eframe) != n
+                    or not self._fast_index_match(b)
+                ):
+                    return None
+                col = eframe.get_column(0)
+                ext_positions[bi] = b
+            elif hasattr(b, "to_pandas"):
+                return None
+            else:
+                pos = frame.column_position(b)
+                if len(pos) != 1 or pos[0] < 0:
+                    return None
+                col = frame._columns[pos[0]]
+            if col.is_device and col.pandas_dtype.kind in "biuf":
+                if col.is_lazy:
+                    # the OWNING frame batches the fused materialization —
+                    # for an external by-Series that is eframe, not self
+                    (eframe if bi in ext_positions else frame).materialize_device()
+                key_datas.append(col.data)
+                key_decoders.append(None)
+            elif not col.is_device:
+                from modin_tpu.ops.dictionary import encode_host_column
+
+                enc = encode_host_column(col)
+                if enc is None:
+                    return None
+                key_datas.append(enc.codes.data)
+                key_decoders.append(enc.categories)
+            else:
+                return None
+
+        # one composite group code per row: the shuffle key.  Sorted-group
+        # codes keep chunk ranges in key order, so the chunk concat IS the
+        # sort=True group order; NaN-key rows overflow past n_groups and the
+        # in-chunk pandas groupby drops them (dropna=True)
+        try:
+            codes, n_groups, group_keys_u, _sizes = gb_ops.factorize_keys_cached(
+                key_datas, n, dropna=dropna
+            )
+        except gb_ops._TooManyGroups:
+            return None
+        if n_groups == 0:
+            return None
+
+        import jax
         import jax.numpy as jnp
 
-        iota = jnp.arange(key_col.data.shape[0], dtype=jnp.int64)
+        iota = jnp.arange(codes.shape[0], dtype=jnp.int64)
         try:
-            _, (rowid_out,), counts, _ = range_shuffle(key_col.data, [iota], n)
+            key_out, (rowid_out,), counts, _ = range_shuffle(codes, [iota], n)
         except ShuffleSkewError:
             return None
         rowids = np.asarray(rowid_out)[:n]
+        # dropna=True gives NaN-key rows overflow codes; they must not reach
+        # the chunks (an all-dropped chunk yields an empty apply result that
+        # poisons the concat's index metadata)
+        n_overflow = int(jax.device_get(jnp.sum(codes[: n] >= n_groups)))
+        if n_overflow:
+            shuffled_codes = np.asarray(key_out)[:n]
+            keep = shuffled_codes < n_groups
+            new_counts = []
+            start = 0
+            kept_ids = []
+            for count in counts:
+                stop = start + int(count)
+                seg = keep[start:stop]
+                kept_ids.append(rowids[start:stop][seg])
+                new_counts.append(int(seg.sum()))
+                start = stop
+            rowids = np.concatenate(kept_ids) if kept_ids else rowids[:0]
+            counts = new_counts
+
+        inner_gk = dict(groupby_kwargs)
+        inner_gk["as_index"] = True
+        inner_gk["sort"] = True
         results = []
         start = 0
         for count in counts:
@@ -2489,8 +2650,25 @@ class TpuQueryCompiler(BaseQueryCompiler):
             if stop == start:
                 start = stop
                 continue
-            sub = self.take_2d_positional(index=rowids[start:stop]).to_pandas()
-            grp = sub.groupby(by=by_list[0], **groupby_kwargs)
+            chunk_ids = rowids[start:stop]
+            sub = self.take_2d_positional(index=chunk_ids).to_pandas()
+            by_arg = []
+            for bi, b in enumerate(by_list):
+                if bi in ext_positions:
+                    ser = (
+                        ext_positions[bi]
+                        .take_2d_positional(index=chunk_ids)
+                        .to_pandas()
+                        .iloc[:, 0]
+                    )
+                    if ser.name == MODIN_UNNAMED_SERIES_LABEL:
+                        ser.name = None
+                    by_arg.append(ser)
+                else:
+                    by_arg.append(b)
+            grp = sub.groupby(
+                by=by_arg if len(by_arg) > 1 else by_arg[0], **inner_gk
+            )
             if selection is not None:
                 grp = grp[selection]
             results.append(agg_func(grp, *agg_args, **agg_kwargs))
@@ -2499,9 +2677,107 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         if not all(isinstance(r, (pandas.Series, pandas.DataFrame)) for r in results):
             return None
+        nkeys = len(by_list)
+        # Under group_keys=True every genuine Series/DataFrame UDF result
+        # carries the key levels PREFIXED (nlevels >= nkeys+1), so a chunk
+        # frame at exactly nkeys levels is pandas WIDENING Series results:
+        # either (a) per-chunk, because the chunk held a single group of a
+        # like-indexed UDF (columns = that group's row labels, differing per
+        # chunk — stack back to the Series form the other chunks have), or
+        # (b) globally, because the UDF returns a constant-index Series
+        # (identical columns everywhere — pandas' own full-frame shape, so
+        # the wide chunks concat as-is).  Without (a)'s restack, an
+        # all-single-group chunking (n_groups <= shards) would concat
+        # disjoint wide frames and silently corrupt.
+        frames_at_k = [
+            r
+            for r in results
+            if isinstance(r, pandas.DataFrame) and r.index.nlevels == nkeys
+        ]
+        if frames_at_k and not (
+            len(frames_at_k) == len(results)
+            and all(
+                f.columns.equals(frames_at_k[0].columns) for f in frames_at_k
+            )
+        ):
+
+            def _unwiden(r):
+                # the row labels were the UDF series' index (level name None)
+                # and the series' shared name rode into columns.name
+                s = r.stack()
+                s.index = s.index.set_names(
+                    list(r.index.names) + [None]
+                )
+                s.name = r.columns.name
+                return s
+
+            results = [
+                _unwiden(r)
+                if isinstance(r, pandas.DataFrame) and r.index.nlevels == nkeys
+                else r
+                for r in results
+            ]
         if len({type(r) for r in results}) > 1:
             return None
         result = pandas.concat(results)
+
+        if not sort:
+            # canonical result is key-sorted; pandas sort=False orders groups
+            # by first appearance.  First row position per group comes from a
+            # device segment-min; result rows reorder host-side by that rank.
+            import jax
+
+            first_pos = np.asarray(
+                jax.device_get(
+                    jnp.full(n_groups, n, jnp.int64)
+                    .at[jnp.where(iota < n, codes, n_groups)]
+                    .min(jnp.minimum(iota, n), mode="drop")
+                )
+            )
+            appearance = np.argsort(first_pos, kind="stable")
+            rank_of_gid = np.empty(n_groups, dtype=np.int64)
+            rank_of_gid[appearance] = np.arange(n_groups)
+            from modin_tpu.ops.dictionary import decode_codes
+
+            decoded_levels = [
+                decode_codes(vals, cats) if cats is not None else vals
+                for vals, cats in zip(group_keys_u, key_decoders)
+            ]
+            if nkeys == 1:
+                gid_of_key = {k: g for g, k in enumerate(decoded_levels[0])}
+                row_keys = result.index.get_level_values(0)
+            else:
+                gid_of_key = {
+                    k: g for g, k in enumerate(zip(*decoded_levels))
+                }
+                row_keys = list(
+                    zip(*[result.index.get_level_values(i) for i in range(nkeys)])
+                )
+            try:
+                row_rank = np.fromiter(
+                    (rank_of_gid[gid_of_key[k]] for k in row_keys),
+                    dtype=np.int64,
+                    count=len(result),
+                )
+            except KeyError:
+                return None  # key value failed to round-trip: stay safe
+            result = result.iloc[np.argsort(row_rank, kind="stable")]
+
+        if not as_index:
+            if isinstance(result, pandas.Series) and result.index.nlevels == nkeys:
+                # scalar-per-group: keys become columns, value column named
+                # None (pandas' exact shape for as_index=False apply)
+                key_names = list(result.index.names)
+                result = result.reset_index()
+                # pandas names the value column the literal None (object
+                # columns Index, "mixed" inferred type)
+                result.columns = pandas.Index([*key_names, None], dtype=object)
+            elif result.index.nlevels == nkeys:
+                # widened constant-index-Series shape: keys become columns
+                result = result.reset_index()
+            else:
+                result = result.droplevel(list(range(nkeys)))
+
         was_series = isinstance(result, pandas.Series)
         if was_series:
             name = (
@@ -3084,29 +3360,58 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     positions = None
                     break
                 positions.append(pos[0])
-            if (
-                positions is not None
-                and len(frame) > 0
-                and all(
-                    frame._columns[p].is_device
-                    and frame._columns[p].pandas_dtype.kind in "biuf"
-                    for p in positions
-                )
-                and all(c.is_device for c in frame._columns)
+            keys = None
+            if positions is not None and len(frame) > 0:
+                # sort keys: numeric device columns directly, host object/str
+                # columns through their dictionary codes (sorted categories
+                # make codes order-isomorphic — ops/dictionary.py); NaN codes
+                # ride the kernels' existing na_position handling
+                keys = []
+                for p in positions:
+                    kc = frame._columns[p]
+                    if kc.is_device and kc.pandas_dtype.kind in "biuf":
+                        keys.append(kc)
+                    elif not kc.is_device:
+                        from modin_tpu.ops.dictionary import encode_host_column
+
+                        enc = encode_host_column(kc)
+                        if enc is None:
+                            keys = None
+                            break
+                        keys.append(enc[0])
+                    else:
+                        keys = None
+                        break
+            if keys is not None and all(
+                c.is_device or hasattr(c.data, "take") for c in frame._columns
             ):
                 from modin_tpu.ops.structural import gather_columns_device
 
                 n = len(frame)
                 frame.materialize_device()
-                keys = [frame._columns[p].data for p in positions]
-                perm = sort_ops.lexsort_permutation(keys, n, [bool(a) for a in asc])
-                datas = gather_columns_device(
-                    [c.data for c in frame._columns], perm
+                perm = sort_ops.lexsort_permutation(
+                    [k.data for k in keys], n, [bool(a) for a in asc]
                 )
-                new_cols = [
-                    DeviceColumn(d, c.pandas_dtype, length=n)
-                    for d, c in zip(datas, frame._columns)
+                dev_positions = [
+                    i for i, c in enumerate(frame._columns) if c.is_device
                 ]
+                datas = gather_columns_device(
+                    [frame._columns[i].data for i in dev_positions], perm
+                )
+                dev_iter = iter(datas)
+                perm_h = None
+                new_cols: list = []
+                for c in frame._columns:
+                    if c.is_device:
+                        new_cols.append(
+                            DeviceColumn(next(dev_iter), c.pandas_dtype, length=n)
+                        )
+                    else:
+                        # host (object/str) payloads reorder by the fetched
+                        # permutation — one n-int fetch shared by all of them
+                        if perm_h is None:
+                            perm_h = np.asarray(perm)[:n]
+                        new_cols.append(HostColumn(c.data.take(perm_h)))
                 if kwargs.get("ignore_index", False):
                     new_index = LazyIndex(pandas.RangeIndex(n), n)
                 else:

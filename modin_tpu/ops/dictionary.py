@@ -25,7 +25,7 @@ columns cost nothing and a repeated ``df.groupby("city")`` factorizes once.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 import pandas
@@ -35,8 +35,14 @@ import pandas
 _MAX_CATEGORIES = 1 << 24
 
 
-def encode_host_column(col: Any) -> Optional[Tuple[Any, np.ndarray]]:
-    """(codes DeviceColumn, categories) for a HostColumn, or None.
+class DictEncoding(NamedTuple):
+    codes: Any  # DeviceColumn of float64 codes (NaN = missing)
+    categories: np.ndarray  # sorted distinct values, host-side
+    has_nan: bool  # whether any row is missing (NaN code present)
+
+
+def encode_host_column(col: Any) -> Optional[DictEncoding]:
+    """The column's :class:`DictEncoding`, or None.
 
     None means the column is not dictionary-encodable (non-object dtype,
     unorderable mixed values, or category count past the device-exactness
@@ -50,7 +56,7 @@ def encode_host_column(col: Any) -> Optional[Tuple[Any, np.ndarray]]:
     return result
 
 
-def _encode(col: Any) -> Optional[Tuple[Any, np.ndarray]]:
+def _encode(col: Any) -> Optional[DictEncoding]:
     from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
 
     dtype = col.pandas_dtype
@@ -68,9 +74,10 @@ def _encode(col: Any) -> Optional[Tuple[Any, np.ndarray]]:
     if len(categories) > _MAX_CATEGORIES:
         return None
     fcodes = codes.astype(np.float64)
-    if (codes == -1).any():
+    has_nan = bool((codes == -1).any())
+    if has_nan:
         fcodes[codes == -1] = np.nan
-    return DeviceColumn.from_numpy(fcodes), categories
+    return DictEncoding(DeviceColumn.from_numpy(fcodes), categories, has_nan)
 
 
 def encodable(col: Any) -> bool:
@@ -122,8 +129,8 @@ def lookup_values(values: List[Any], categories: np.ndarray) -> np.ndarray:
     the host half of a device ``isin`` on an encoded column."""
     out = np.full(len(values), np.nan, dtype=np.float64)
     for i, v in enumerate(values):
-        pos = np.searchsorted(categories, v)
         try:
+            pos = np.searchsorted(categories, v)
             if pos < len(categories) and categories[pos] == v:
                 out[i] = float(pos)
         except TypeError:

@@ -923,13 +923,17 @@ class BasePandasDataset(ClassLogger, modin_layer="PANDAS-API"):
         length = len(self.index)
         if n is None:
             n = int(length * frac)
-        rng = np.random.default_rng(
-            random_state if not isinstance(random_state, np.random.RandomState) else None
-        )
-        if isinstance(random_state, np.random.RandomState):
-            positions = random_state.choice(length, n, replace=replace)
+        # pandas resolves seeds through np.random.RandomState (com.random_state),
+        # so an int random_state must reproduce pandas' exact draw
+        if isinstance(
+            random_state, (np.random.RandomState, np.random.Generator)
+        ):
+            rng = random_state
+        elif random_state is None:
+            rng = np.random.default_rng()
         else:
-            positions = rng.choice(length, n, replace=replace)
+            rng = np.random.RandomState(random_state)
+        positions = rng.choice(length, n, replace=replace)
         result = self._create_or_update_from_compiler(
             self._query_compiler.getitem_row_array(list(positions))
         )

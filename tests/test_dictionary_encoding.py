@@ -175,9 +175,9 @@ class TestDictEncodingUnit:
         col = md._query_compiler._modin_frame.get_column(0)
         enc = encode_host_column(col)
         assert enc is not None
-        codes_col, cats = enc
-        assert list(cats) == ["a", "b", "c"]
-        codes = np.asarray(codes_col.data)[:4]
+        assert list(enc.categories) == ["a", "b", "c"]
+        assert enc.has_nan is False
+        codes = np.asarray(enc.codes.data)[:4]
         assert codes.tolist() == [1.0, 0.0, 2.0, 0.0]
 
     def test_union_categories_preserves_order(self):
@@ -195,3 +195,129 @@ class TestDictEncodingUnit:
         md, _ = create_test_dfs({"x": pandas.array([1, 2, None], dtype="Int64")})
         col = md._query_compiler._modin_frame.get_column(0)
         assert encode_host_column(col) is None
+
+
+class TestDictSort:
+    """sort_values by string keys (dictionary codes are order-isomorphic)
+    and host payload columns reordered by the fetched permutation."""
+
+    @pytest.fixture
+    def dfs(self):
+        rng = np.random.default_rng(11)
+        n = 800
+        data = {
+            "city": _CITIES[rng.integers(0, 6, n)],
+            "v": rng.normal(size=n),
+            "w": rng.integers(0, 50, n),
+            "note": np.array(["a", "bb", "ccc"], dtype=object)[
+                rng.integers(0, 3, n)
+            ],
+        }
+        return create_test_dfs(data)
+
+    def test_sort_by_str(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.sort_values("city", kind="stable"))
+        df_equals(got, pdf.sort_values("city", kind="stable"))
+
+    def test_sort_by_str_descending(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(
+            lambda: md.sort_values("city", ascending=False, kind="stable")
+        )
+        df_equals(got, pdf.sort_values("city", ascending=False, kind="stable"))
+
+    def test_sort_str_then_numeric(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(
+            lambda: md.sort_values(["city", "w"], kind="stable")
+        )
+        df_equals(got, pdf.sort_values(["city", "w"], kind="stable"))
+
+    def test_sort_numeric_with_str_payload(self, dfs):
+        # the gap the r5 verify drive exposed: a str payload column forced
+        # the whole sort to fall back
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.sort_values("v"))
+        df_equals(got, pdf.sort_values("v"))
+
+    def test_sort_str_nan_last(self):
+        rng = np.random.default_rng(12)
+        n = 400
+        k = _CITIES[rng.integers(0, 4, n)].copy()
+        k[rng.random(n) < 0.1] = np.nan
+        md, pdf = create_test_dfs({"city": k, "v": rng.normal(size=n)})
+        got = assert_no_fallback(lambda: md.sort_values("city", kind="stable"))
+        df_equals(got, pdf.sort_values("city", kind="stable"))
+
+    def test_sort_ignore_index(self, dfs):
+        md, pdf = dfs
+        eval_general(
+            md, pdf,
+            lambda df: df.sort_values("city", kind="stable", ignore_index=True),
+        )
+
+
+class TestDictValueCountsNuniqueIsin:
+    @pytest.fixture
+    def dfs(self):
+        rng = np.random.default_rng(13)
+        n = 900
+        k = _CITIES[rng.integers(0, 4, n)].copy()
+        k[rng.random(n) < 0.06] = np.nan
+        return create_test_dfs(
+            {"city": k, "v": rng.normal(size=n), "w": rng.integers(0, 9, n)}
+        )
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {},
+            {"normalize": True},
+            {"dropna": False},
+            {"ascending": True},
+            {"sort": False},
+        ],
+    )
+    def test_value_counts_str(self, dfs, kw):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md["city"].value_counts(**kw))
+        df_equals(got, pdf["city"].value_counts(**kw))
+
+    @pytest.mark.parametrize("dropna", [True, False])
+    def test_nunique_mixed_frame(self, dfs, dropna):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.nunique(dropna=dropna))
+        df_equals(got, pdf.nunique(dropna=dropna))
+
+    def test_isin_mixed_values_frame(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.isin(["tokyo", "lima", 3]))
+        df_equals(got, pdf.isin(["tokyo", "lima", 3]))
+
+    def test_isin_series_variants(self, dfs):
+        md, pdf = dfs
+        for vals in (["oslo"], ["oslo", np.nan], ["zzz"]):
+            got = assert_no_fallback(lambda: md["city"].isin(vals))
+            df_equals(got, pdf["city"].isin(vals))
+
+
+class TestIsinNoneVsNan:
+    """r5 review: object dtype keeps None and np.nan DISTINCT in isin; both
+    encode to NaN codes, so that combination must fall back; the str dtype
+    unifies missing values and keeps the device path."""
+
+    def test_object_none_vs_nan_distinct(self):
+        md, pdf = create_test_dfs(
+            {"s": np.array(["a", np.nan, None, "b"], dtype=object)}
+        )
+        for vals in ([np.nan], [None], ["a", np.nan]):
+            eval_general(md, pdf, lambda df: df["s"].isin(vals))
+
+    def test_str_dtype_missing_unified_device(self):
+        s = pandas.Series(["a", np.nan, None, "b"], dtype="str")
+        md = pd.DataFrame({"s": s})
+        pdf = pandas.DataFrame({"s": s})
+        for vals in ([np.nan], [None], ["a", np.nan]):
+            got = md["s"].isin(vals)
+            df_equals(got, pdf["s"].isin(vals))

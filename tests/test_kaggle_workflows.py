@@ -281,3 +281,375 @@ def test_kaggle6_digit_recognizer_prep(tmp_path):
     df_equals(mn, pn)
     np.testing.assert_allclose(ms, ps)
     np.testing.assert_array_equal(my, py)
+
+
+# --------------------------------------------------------------------- #
+# r5 ports: the remaining 10 notebooks (VERDICT r4 item 5), str/datetime-
+# heavy ones first.  Each pipeline re-derives its notebook's pandas-op mix
+# on synthetic data (reference: stress_tests/kaggle/kaggle{N}.py).
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture
+def titanic_csv(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 600
+    names = [
+        f"{ln}, {t}. {fn}"
+        for ln, t, fn in zip(
+            rng.choice(["Braund", "Cumings", "Allen", "Moran", "Smith"], n),
+            rng.choice(["Mr", "Mrs", "Miss", "Master", "Dr"], n),
+            rng.choice(["John", "Anna", "Elsa", "Owen", "Maria"], n),
+        )
+    ]
+    df = pandas.DataFrame(
+        {
+            "PassengerId": np.arange(1, n + 1),
+            "Survived": rng.integers(0, 2, n),
+            "Pclass": rng.integers(1, 4, n),
+            "Name": names,
+            "Sex": rng.choice(["male", "female"], n),
+            "Age": np.where(rng.random(n) < 0.2, np.nan, rng.uniform(1, 80, n).round(1)),
+            "SibSp": rng.integers(0, 5, n),
+            "Parch": rng.integers(0, 4, n),
+            "Fare": rng.uniform(5, 500, n).round(2),
+            "Embarked": np.where(
+                rng.random(n) < 0.02, None, rng.choice(["S", "C", "Q"], n)
+            ),
+            "Cabin": np.where(rng.random(n) < 0.7, None, rng.choice(["C85", "E46", "B28"], n)),
+        }
+    )
+    p = tmp_path / "titanic.csv"
+    df.to_csv(p, index=False)
+    return str(p)
+
+
+def test_kaggle3_pokemon_and_timeseries(tmp_path):
+    """kaggle3: corr of numeric frame, logical-indexing filters, apply over a
+    column, datetime index + resample interpolation, loc slices
+    (stress_tests/kaggle/kaggle3.py)."""
+    rng = np.random.default_rng(3)
+    n = 300
+    df = pandas.DataFrame(
+        {
+            "Name": rng.choice(["Bulbasaur", "Charmander", "Squirtle", "Pidgey"], n),
+            "Type 1": rng.choice(["Grass", "Fire", "Water", "Normal"], n),
+            "Attack": rng.integers(5, 190, n),
+            "Defense": rng.integers(5, 230, n),
+            "Speed": rng.integers(5, 180, n),
+            "HP": rng.integers(1, 255, n),
+            "Legendary": rng.random(n) < 0.08,
+        }
+    )
+    p = tmp_path / "pokemon.csv"
+    df.to_csv(p, index=False)
+
+    def pipeline(impl, path):
+        data = impl.read_csv(path)
+        corr = data[["Attack", "Defense", "Speed", "HP"]].corr()
+        filtered = data[(data["Defense"] > 200) | (data["Attack"] > 100)]
+        data["speed_level"] = data["Speed"].apply(
+            lambda s: "high" if s > 90 else "low"
+        )
+        levels = data["speed_level"].value_counts()
+        ts = impl.DataFrame(
+            {"v": np.arange(10.0)},
+            index=impl.to_datetime(
+                [f"2020-01-{d:02d}" for d in range(1, 11)]
+            ),
+        )
+        monthly = ts.resample("ME").mean()
+        return corr, filtered, levels, monthly, data.loc[:20, ["Attack", "Defense"]]
+
+    (mc, mf, ml, mm, mloc), (pc, pf, pl, pm, ploc) = _both(pipeline, str(p))
+    df_equals(mc, pc)
+    df_equals(mf, pf)
+    df_equals(ml, pl)
+    df_equals(mm, pm)
+    df_equals(mloc, ploc)
+
+
+def test_kaggle4_titanic_fillna_modes(titanic_csv):
+    """kaggle4: mode-based fillna of str/numeric columns, get_dummies,
+    numeric corr, groupby survival rates (stress_tests/kaggle/kaggle4.py)."""
+
+    def pipeline(impl, path):
+        df = impl.read_csv(path)
+        nulls = df.isnull().sum()
+        df["Embarked"] = df["Embarked"].fillna(df["Embarked"].mode()[0])
+        df["Age"] = df["Age"].fillna(df["Age"].median())
+        df["Fare"] = df["Fare"].fillna(df["Fare"].mode()[0])
+        df = df.drop(["Cabin"], axis=1)
+        rates = (
+            df[["Sex", "Survived"]]
+            .groupby("Sex", as_index=False)
+            .mean()
+            .sort_values(by="Survived", ascending=False)
+        )
+        dummies = impl.get_dummies(df["Embarked"], prefix="Emb")
+        corr = df[["Survived", "Pclass", "Age", "Fare"]].corr()
+        return nulls, df, rates, dummies, corr
+
+    (mn, md, mr, mdum, mc), (pn, pdf_, pr, pdum, pc) = _both(pipeline, titanic_csv)
+    df_equals(mn, pn)
+    df_equals(md, pdf_)
+    df_equals(mr, pr)
+    df_equals(mdum, pdum)
+    df_equals(mc, pc)
+
+
+def test_kaggle5_titanic_feature_engineering(titanic_csv):
+    """kaggle5: str.extract of titles, map/replace recodes, qcut fare bands,
+    loc age banding, groupby means (stress_tests/kaggle/kaggle5.py)."""
+
+    def pipeline(impl, path):
+        df = impl.read_csv(path)
+        df["Title"] = df["Name"].str.extract(r" ([A-Za-z]+)\.", expand=False)
+        df["Title"] = df["Title"].replace(["Dr"], "Rare")
+        df["Title"] = df["Title"].map(
+            {"Mr": 1, "Miss": 2, "Mrs": 3, "Master": 4, "Rare": 5}
+        ).fillna(0).astype(int)
+        title_rate = (
+            df[["Title", "Survived"]].groupby("Title", as_index=False).mean()
+        )
+        df["Sex"] = df["Sex"].map({"female": 1, "male": 0}).astype(int)
+        df = df.drop(["Name", "PassengerId", "Cabin"], axis=1)
+        df["Age"] = df["Age"].fillna(df["Age"].median())
+        df.loc[df["Age"] <= 16, "Age"] = 0
+        df.loc[(df["Age"] > 16) & (df["Age"] <= 32), "Age"] = 1
+        df.loc[(df["Age"] > 32) & (df["Age"] <= 48), "Age"] = 2
+        df.loc[df["Age"] > 48, "Age"] = 3
+        df["FareBand"] = impl.qcut(df["Fare"], 4, labels=[0, 1, 2, 3])
+        band_rate = (
+            df[["FareBand", "Survived"]]
+            .groupby("FareBand", as_index=False, observed=False)
+            .mean()
+            .sort_values(by="FareBand", ascending=True)
+        )
+        df["IsAlone"] = ((df["SibSp"] + df["Parch"]) == 0).astype(int)
+        alone_rate = df[["IsAlone", "Survived"]].groupby("IsAlone", as_index=False).mean()
+        return title_rate, band_rate, alone_rate, df.head(20)
+
+    (mt, mb, ma, mh), (pt, pb, pa, ph) = _both(pipeline, titanic_csv)
+    df_equals(mt, pt)
+    df_equals(mb, pb)
+    df_equals(ma, pa)
+    df_equals(mh, ph)
+
+
+def test_kaggle7_house_merge_dummies(tmp_path):
+    """kaggle7: two-frame merge, get_dummies over a categorical, corr-driven
+    feature ranking, replace + sort_values (stress_tests/kaggle/kaggle7.py)."""
+    rng = np.random.default_rng(77)
+    n = 500
+    main = pandas.DataFrame(
+        {
+            "Id": np.arange(n),
+            "Neighborhood": rng.choice(["NAmes", "CollgCr", "OldTown", "Edwards"], n),
+            "OverallQual": rng.integers(1, 11, n),
+            "GrLivArea": rng.integers(400, 4000, n),
+            "SalePrice": rng.integers(50_000, 500_000, n),
+        }
+    )
+    lookup = pandas.DataFrame(
+        {
+            "Neighborhood": ["NAmes", "CollgCr", "OldTown", "Edwards"],
+            "SchoolRating": [7, 9, 5, 4],
+        }
+    )
+    mp_, lp = tmp_path / "main.csv", tmp_path / "lookup.csv"
+    main.to_csv(mp_, index=False)
+    lookup.to_csv(lp, index=False)
+
+    def pipeline(impl, main_path, lookup_path):
+        df = impl.read_csv(main_path)
+        lk = impl.read_csv(lookup_path)
+        merged = df.merge(lk, on="Neighborhood")
+        corr = merged[["OverallQual", "GrLivArea", "SalePrice", "SchoolRating"]].corr()
+        ranked = corr["SalePrice"].sort_values(ascending=False)
+        dummies = impl.get_dummies(merged["Neighborhood"])
+        merged["QualBand"] = merged["OverallQual"].replace(
+            {1: "low", 2: "low", 3: "low", 4: "mid", 5: "mid", 6: "mid"}
+        )
+        counts = merged["QualBand"].value_counts()
+        desc = merged[["GrLivArea", "SalePrice"]].describe()
+        return merged.sort_values("SalePrice").head(15), ranked, dummies.head(), counts, desc
+
+    (mm, mr, mdm, mc, mdsc), (pm, pr, pdm, pc, pdsc) = _both(pipeline, str(mp_), str(lp))
+    df_equals(mm, pm)
+    df_equals(mr, pr)
+    df_equals(mdm, pdm)
+    df_equals(mc, pc)
+    df_equals(mdsc, pdsc)
+
+
+def test_kaggle10_loc_column_slices(titanic_csv):
+    """kaggle10: .loc label/column slicing drills, iloc windows, get_dummies,
+    describe (stress_tests/kaggle/kaggle10.py)."""
+
+    def pipeline(impl, path):
+        df = impl.read_csv(path)
+        a = df.loc[:, "Name":"Age"]
+        b = df.loc[df["Sex"] == "female", ["Name", "Age", "Survived"]]
+        c = df.iloc[10:20, 2:6]
+        d = df.loc[df["Age"] > 60, :]
+        dummies = impl.get_dummies(df["Pclass"], prefix="class")
+        desc = df.describe()
+        counts = df["Embarked"].value_counts(dropna=False)
+        return a.head(25), b.head(25), c, d, dummies.head(10), desc, counts
+
+    outs_m, outs_p = _both(pipeline, titanic_csv)
+    for m, p in zip(outs_m, outs_p):
+        df_equals(m, p)
+
+
+def test_kaggle12_map_concat_dummies(titanic_csv):
+    """kaggle12: train/test concat, map recodes, get_dummies + concat of
+    frames, iloc re-split, numeric corr (stress_tests/kaggle/kaggle12.py)."""
+
+    def pipeline(impl, path):
+        df = impl.read_csv(path)
+        train, test = df.iloc[:400], df.iloc[400:]
+        both = impl.concat([train, test], ignore_index=True)
+        both["Sex"] = both["Sex"].map({"male": 0, "female": 1})
+        both["Embarked"] = both["Embarked"].fillna("S").map({"S": 0, "C": 1, "Q": 2})
+        nulls = both.isnull().sum()
+        pclass_d = impl.get_dummies(both["Pclass"], prefix="P")
+        both2 = impl.concat([both[["Sex", "Embarked", "Age", "Fare"]], pclass_d], axis=1)
+        both2["Age"] = both2["Age"].fillna(both2["Age"].median())
+        corr = both2.corr()
+        re_train = both2.iloc[:400].reset_index(drop=True)
+        return nulls, both2.head(30), corr, re_train.describe()
+
+    (mn, mh, mc, md), (pn, ph, pc, pdsc) = _both(pipeline, titanic_csv)
+    df_equals(mn, pn)
+    df_equals(mh, ph)
+    df_equals(mc, pc)
+    df_equals(md, pdsc)
+
+
+def test_kaggle14_banding_and_extract(titanic_csv):
+    """kaggle14: str.extract titles, replace-consolidation, loc band
+    assignment, qcut, per-band survival, numeric corr
+    (stress_tests/kaggle/kaggle14.py)."""
+
+    def pipeline(impl, path):
+        df = impl.read_csv(path)
+        df["Title"] = df["Name"].str.extract(r" ([A-Za-z]+)\.", expand=False)
+        tcounts = impl.crosstab(df["Title"], df["Sex"]) if hasattr(impl, "crosstab") else None
+        df["Title"] = df["Title"].replace(["Dr", "Master"], "Other")
+        rate = df[["Title", "Survived"]].groupby("Title").mean().sort_values("Survived")
+        df["AgeBand"] = impl.cut(df["Age"], 5)
+        band = (
+            df[["AgeBand", "Survived"]]
+            .groupby("AgeBand", observed=False)
+            .mean()
+            .sort_values("AgeBand")
+        )
+        df.loc[df["Fare"] <= 100, "Fare"] = 0
+        df.loc[df["Fare"] > 100, "Fare"] = 1
+        fare_counts = df["Fare"].value_counts()
+        corr = df[["Survived", "Pclass", "SibSp", "Parch", "Fare"]].corr()
+        return tcounts, rate, band, fare_counts, corr
+
+    (mt, mr, mb, mf, mc), (pt, pr, pb, pf, pc) = _both(pipeline, titanic_csv)
+    if mt is not None and pt is not None:
+        df_equals(mt, pt)
+    df_equals(mr, pr)
+    df_equals(mb, pb)
+    df_equals(mf, pf)
+    df_equals(mc, pc)
+
+
+def test_kaggle18_categorical_profiling(titanic_csv):
+    """kaggle18: value_counts ladders, nunique, map + apply feature codes,
+    deterministic sample, reset_index chains (stress_tests/kaggle/kaggle18.py)."""
+
+    def pipeline(impl, path):
+        df = impl.read_csv(path)
+        vc = df["Pclass"].value_counts()
+        vc_norm = df["Embarked"].value_counts(normalize=True)
+        uniq = df[["Sex", "Embarked", "Pclass"]].nunique()
+        df["SexCode"] = df["Sex"].map({"male": 0, "female": 1})
+        df["FamilySize"] = df.apply(lambda r: r["SibSp"] + r["Parch"] + 1, axis=1)
+        fam = df["FamilySize"].value_counts().reset_index()
+        samp = df.sample(n=25, random_state=42).reset_index(drop=True)
+        top = (
+            df.groupby("Pclass")["Fare"]
+            .mean()
+            .sort_values(ascending=False)
+            .reset_index()
+        )
+        return vc, vc_norm, uniq, fam, samp, top
+
+    outs_m, outs_p = _both(pipeline, titanic_csv)
+    for m, p in zip(outs_m, outs_p):
+        df_equals(m, p)
+
+
+def test_kaggle19_cut_and_corr(tmp_path):
+    """kaggle19: pd.cut age bins, fillna ladder, groupby bins, corr ranking
+    (stress_tests/kaggle/kaggle19.py)."""
+    rng = np.random.default_rng(19)
+    n = 400
+    df = pandas.DataFrame(
+        {
+            "age": np.where(rng.random(n) < 0.1, np.nan, rng.uniform(18, 90, n).round()),
+            "balance": rng.normal(1200, 800, n).round(2),
+            "duration": rng.integers(10, 3000, n),
+            "outcome": rng.integers(0, 2, n),
+        }
+    )
+    p = tmp_path / "bank.csv"
+    df.to_csv(p, index=False)
+
+    def pipeline(impl, path):
+        d = impl.read_csv(path)
+        d["age"] = d["age"].fillna(d["age"].median())
+        d["age_group"] = impl.cut(
+            d["age"], bins=[0, 30, 45, 60, 100], labels=["young", "mid", "senior", "old"]
+        )
+        grp = d.groupby("age_group", observed=False)["outcome"].mean()
+        corr = d[["age", "balance", "duration", "outcome"]].corr()
+        ranked = corr["outcome"].sort_values(ascending=False)
+        return grp, corr, ranked, d.sort_values("balance").head(10)
+
+    (mg, mc, mr, mh), (pg, pc, pr, ph) = _both(pipeline, str(p))
+    df_equals(mg, pg)
+    df_equals(mc, pc)
+    df_equals(mr, pr)
+    df_equals(mh, ph)
+
+
+def test_kaggle20_melt_concat(tmp_path):
+    """kaggle20: iloc splits, melt to long form, concat rows/cols, corr,
+    describe (stress_tests/kaggle/kaggle20.py)."""
+    rng = np.random.default_rng(20)
+    n = 240
+    df = pandas.DataFrame(
+        {
+            "country": rng.choice(["ar", "br", "cl", "pe"], n),
+            "y2019": rng.normal(100, 20, n).round(1),
+            "y2020": rng.normal(95, 25, n).round(1),
+            "y2021": rng.normal(105, 22, n).round(1),
+        }
+    )
+    p = tmp_path / "gdp.csv"
+    df.to_csv(p, index=False)
+
+    def pipeline(impl, path):
+        d = impl.read_csv(path)
+        top, bottom = d.iloc[:120], d.iloc[120:]
+        stacked = impl.concat([top, bottom], ignore_index=True)
+        long = stacked.melt(
+            id_vars="country", var_name="year", value_name="gdp"
+        )
+        side = impl.concat([d["y2019"], d["y2020"]], axis=1)
+        corr = d[["y2019", "y2020", "y2021"]].corr()
+        return long.head(30), long["year"].value_counts(), side.describe(), corr
+
+    (ml, mv, ms, mc), (pl, pv, ps, pc) = _both(pipeline, str(p))
+    df_equals(ml, pl)
+    df_equals(mv, pv)
+    df_equals(ms, ps)
+    df_equals(mc, pc)
