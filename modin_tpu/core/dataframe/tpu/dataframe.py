@@ -171,18 +171,21 @@ class HostColumn:
     """One column kept on host (object/string/categorical/extension dtypes).
 
     ``_dict_cache`` lazily holds the column's dictionary encoding — (codes
-    DeviceColumn, sorted categories) — or False once found unencodable (see
-    ops/dictionary.py).  Columns are replaced, never mutated in place, so
-    the cache cannot go stale.
+    DeviceColumn, SORTED categories) — or False once found unencodable (see
+    ops/dictionary.py).  ``_cat_cache`` is the separate cache for the
+    categorical-dtype encoding, whose categories keep CATEGORY order — the
+    two orderings must never be served to each other's consumers.  Columns
+    are replaced, never mutated in place, so the caches cannot go stale.
     """
 
-    __slots__ = ("data", "_dict_cache")
+    __slots__ = ("data", "_dict_cache", "_cat_cache")
     is_device = False
 
     def __init__(self, data: Any):
         # data: 1-D numpy array or pandas ExtensionArray (unpadded)
         self.data = data
         self._dict_cache = None
+        self._cat_cache = None
 
     @property
     def pandas_dtype(self):

@@ -372,6 +372,33 @@ class TpuQueryCompiler(BaseQueryCompiler):
                         pandas.RangeIndex(len(result)), len(result)
                     )
                 return qc
+        if (
+            axis == 1
+            and not ignore_index
+            and not sort  # sort=True reorders even identical indexes
+            and all(isinstance(o, TpuQueryCompiler) for o in other)
+            and all(self._fast_index_match(o) for o in other)
+        ):
+            # column concat of index-aligned frames: append the column lists,
+            # zero data movement (census: the get_dummies-then-concat
+            # pattern).  Duplicate labels are legal in pandas concat.
+            base = self._modin_frame
+            new_cols = list(base._columns)
+            labels = list(base.columns)
+            for o in other:
+                of = o._modin_frame
+                new_cols.extend(of._columns)
+                labels.extend(of.columns)
+            try:
+                label_index = pandas.Index(labels)
+            except Exception:
+                return super().concat(
+                    axis, other, join=join, ignore_index=ignore_index,
+                    sort=sort, **kwargs
+                )
+            return type(self)(
+                TpuDataframe(new_cols, label_index, base._index, nrows=len(base))
+            )
         return super().concat(axis, other, join=join, ignore_index=ignore_index, sort=sort, **kwargs)
 
     def columnarize(self) -> "TpuQueryCompiler":
@@ -701,6 +728,67 @@ class TpuQueryCompiler(BaseQueryCompiler):
             )
             if result is not None:
                 return result
+        # per-column scalar mapping: fillna(dict) / fillna(df.mean()) — each
+        # mapped numeric column fills on device, unmapped columns pass
+        # through untouched (census: the all_data.fillna(all_data.mean())
+        # Kaggle pattern)
+        mapping = None
+        if isinstance(value, dict):
+            mapping = value
+        elif isinstance(value, BaseQueryCompiler) and kwargs.get("squeeze_value"):
+            ser = value.to_pandas()
+            ser = ser.iloc[:, 0] if ser.shape[1] == 1 else None
+            if ser is not None and ser.index.is_unique:
+                mapping = ser.to_dict()
+        if (
+            mapping is not None
+            and kwargs.get("limit") is None
+            and kwargs.get("axis") in (0, None)
+            and not kwargs.get("squeeze_self")
+            and all(
+                isinstance(v, (int, float, np.integer, np.floating))
+                and not isinstance(v, bool)
+                for v in mapping.values()
+            )
+        ):
+            frame = self._modin_frame
+            ok = len(frame) > 0
+            if ok:
+                for i, label in enumerate(frame.columns):
+                    if label not in mapping:
+                        continue
+                    c = frame._columns[i]
+                    if not (c.is_device and c.pandas_dtype.kind in "biuf"):
+                        ok = False
+                        break
+            if ok:
+                import jax.numpy as jnp
+
+                frame.materialize_device()
+                new_cols = list(frame._columns)
+                for i, label in enumerate(frame.columns):
+                    if label not in mapping:
+                        continue
+                    c = frame._columns[i]
+                    if c.pandas_dtype.kind != "f":
+                        continue  # int/bool columns carry no NaN
+                    fillv = mapping[label]
+                    if isinstance(fillv, float) and np.isnan(fillv):
+                        continue  # NaN fill is a no-op
+                    data = jnp.where(
+                        jnp.isnan(c.data),
+                        jnp.asarray(fillv, c.data.dtype),
+                        c.data,
+                    )
+                    new_cols[i] = DeviceColumn(
+                        data, c.pandas_dtype, length=len(frame)
+                    )
+                return type(self)(
+                    TpuDataframe(
+                        new_cols, frame._col_labels, frame._index,
+                        nrows=len(frame),
+                    )
+                )
         return super().fillna(**kwargs)
 
     def clip(self, lower: Any, upper: Any, **kwargs: Any) -> "TpuQueryCompiler":
@@ -1006,6 +1094,311 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 return type(self)(result_frame)
         return super().mode(
             axis=axis, numeric_only=numeric_only, dropna=dropna, **kwargs
+        )
+
+    def describe(
+        self, percentiles: Any = None, include: Any = None, exclude: Any = None
+    ):
+        """Numeric describe = count/mean/std + quantiles + min/max, every
+        piece an existing device kernel, assembled into the 8-row pandas
+        layout host-side (census: 7 hits).  Non-numeric columns and
+        include/exclude selections keep the pandas fallback."""
+        frame = self._modin_frame
+        if percentiles is None:
+            qs = [0.25, 0.5, 0.75]
+        else:
+            try:
+                # pandas 3 uses the given percentiles verbatim (no implicit
+                # median insertion)
+                qs = sorted(float(p) for p in percentiles)
+            except (TypeError, ValueError):
+                qs = None
+            if qs is not None and len(set(qs)) != len(qs):
+                qs = None  # pandas raises on duplicate percentiles
+        if (
+            qs is not None
+            and include is None
+            and exclude is None
+            and len(frame)
+            and frame.num_cols
+            and all(
+                c.is_device and c.pandas_dtype.kind in "iuf"
+                for c in frame._columns
+            )
+            and all(0.0 <= q <= 1.0 for q in qs)
+        ):
+            from modin_tpu.ops.reductions import quantile_columns, reduce_columns
+
+            frame.materialize_device()
+            arrays = [c.raw for c in frame._columns]
+            n = len(frame)
+            stats = {}
+            for op in ("count", "mean", "std", "min", "max"):
+                vals = reduce_columns(op, arrays, n, skipna=True, ddof=1)
+                stats[op] = [float(np.asarray(v)) for v in vals]
+            qvals = quantile_columns(
+                [c.data for c in frame._columns], n, qs, "linear"
+            )
+            rows = ["count", "mean", "std", "min"]
+            data_rows = [stats["count"], stats["mean"], stats["std"], stats["min"]]
+            for j, q in enumerate(qs):
+                rows.append(f"{q * 100:g}%")
+                data_rows.append([float(v[j]) for v in qvals])
+            rows.append("max")
+            data_rows.append(stats["max"])
+            result = pandas.DataFrame(
+                np.asarray(data_rows, dtype=np.float64),
+                index=pandas.Index(rows),
+                columns=frame.columns,
+            )
+            return type(self).from_pandas(result)
+        return super().describe(
+            percentiles=percentiles, include=include, exclude=exclude
+        )
+
+    def setitem_bool(self, row_loc: Any, col_loc: Any, item: Any):
+        """``df.loc[mask, col] = scalar`` as one fused where-kernel.
+
+        pandas 3 never upcasts in loc-setitem (incompatible scalars RAISE),
+        so the device path takes only dtype-preserving assignments: int
+        scalars into int columns, int/float into float, bool into bool —
+        everything else falls back and reproduces pandas' error.  Census: 6
+        hits in the Kaggle banding pattern (loc[age <= 16, "Age"] = 0)."""
+        from modin_tpu.utils import hashable
+
+        frame = self._modin_frame
+        ok = (
+            isinstance(row_loc, TpuQueryCompiler)
+            and row_loc._modin_frame.num_cols == 1
+            and len(row_loc._modin_frame) == len(frame)
+            and len(frame) > 0
+            and self._fast_index_match(row_loc)
+            and hashable(col_loc)
+        )
+        if ok:
+            mcol = row_loc._modin_frame.get_column(0)
+            pos = frame.column_position(col_loc)
+            ok = (
+                mcol.is_device
+                and mcol.pandas_dtype == np.dtype(bool)
+                and len(pos) == 1
+                and pos[0] >= 0
+            )
+        if ok:
+            col = frame._columns[pos[0]]
+            kind = col.pandas_dtype.kind if col.is_device else ""
+            is_bool = isinstance(item, (bool, np.bool_))
+            if kind == "b":
+                ok = is_bool
+            elif kind in "iu":
+                ok = isinstance(item, (int, np.integer)) and not is_bool
+                if ok:
+                    info = np.iinfo(col.pandas_dtype)
+                    # out-of-range would wrap on device; pandas 3 raises
+                    ok = info.min <= int(item) <= info.max
+            elif kind == "f":
+                ok = (
+                    isinstance(item, (int, float, np.integer, np.floating))
+                    and not is_bool
+                )
+            else:
+                ok = False
+        if ok:
+            import jax.numpy as jnp
+
+            frame.materialize_device()
+            row_loc._modin_frame.materialize_device()
+            new_data = jnp.where(
+                mcol.data,
+                jnp.asarray(item, col.data.dtype),
+                col.data,
+            )
+            new_cols = list(frame._columns)
+            new_cols[pos[0]] = DeviceColumn(
+                new_data, col.pandas_dtype, length=len(frame)
+            )
+            return type(self)(
+                TpuDataframe(
+                    new_cols, frame._col_labels, frame._index, nrows=len(frame)
+                )
+            )
+        return super().setitem_bool(row_loc, col_loc, item)
+
+    def series_map(self, arg: Any, na_action: Any = None) -> "TpuQueryCompiler":
+        """dict-mapping a Series on device.
+
+        String/object columns translate their CATEGORIES through the mapping
+        (host, |categories| lookups) and gather the resulting numeric lookup
+        table by code on device — the Kaggle recode pattern
+        (``s.map({"male": 0, "female": 1})``) without materializing rows.
+        Numeric columns use one sorted-keys searchsorted kernel.  Object
+        outputs, NaN dict keys, and non-dict args keep the pandas fallback
+        (base census: 5 hits)."""
+        frame = self._modin_frame
+        col = frame.get_column(0) if frame.num_cols == 1 else None
+        if isinstance(arg, pandas.Series) and arg.index.is_unique:
+            arg = arg.to_dict()
+        numeric_types = (int, float, bool, np.integer, np.floating, np.bool_)
+
+        def _is_nan_key(k):
+            return isinstance(k, (float, np.floating)) and np.isnan(k)
+
+        if (
+            col is not None
+            and type(arg) is dict  # subclasses may define __missing__
+            and len(frame)
+            and not any(_is_nan_key(k) for k in arg)
+            and all(
+                v is None or isinstance(v, numeric_types) for v in arg.values()
+            )
+        ):
+            import jax.numpy as jnp
+
+            clean_vals = [v for v in arg.values() if v is not None]
+            all_bool = bool(clean_vals) and all(
+                isinstance(v, (bool, np.bool_)) for v in clean_vals
+            )
+            all_int = bool(clean_vals) and all(
+                isinstance(v, (int, bool, np.integer, np.bool_))
+                and not isinstance(v, (float, np.floating))
+                for v in clean_vals
+            )
+            data = None
+            if not col.is_device:
+                from modin_tpu.ops.dictionary import encode_host_column
+
+                enc = encode_host_column(col)
+                if enc is not None:
+                    lut = np.full(len(enc.categories) + 1, np.nan, np.float64)
+                    matched = np.zeros(len(enc.categories) + 1, bool)
+                    for i, c in enumerate(enc.categories):
+                        if c in arg:
+                            v = arg[c]
+                            lut[i] = np.nan if v is None else float(v)
+                            matched[i] = v is not None
+                    codes = enc.codes.data
+                    safe = jnp.where(jnp.isnan(codes), len(enc.categories), codes)
+                    safe = safe.astype(jnp.int32)
+                    data = jnp.take(jnp.asarray(lut), safe, mode="clip")
+                    fully = bool(matched[:-1].all()) and not enc.has_nan
+            elif col.is_device and col.pandas_dtype.kind in "biuf":
+                try:
+                    ks = np.asarray(sorted(arg.keys()))
+                except TypeError:
+                    ks = None
+                if ks is not None and ks.dtype.kind in "biuf" and len(ks):
+                    frame.materialize_device()
+                    vs = np.asarray(
+                        [
+                            np.nan if arg[k] is None else float(arg[k])
+                            for k in ks
+                        ],
+                        np.float64,
+                    )
+                    x = col.data.astype(jnp.float64)
+                    pos = jnp.clip(
+                        jnp.searchsorted(jnp.asarray(ks.astype(np.float64)), x),
+                        0,
+                        len(ks) - 1,
+                    )
+                    hit = jnp.asarray(ks.astype(np.float64))[pos] == x
+                    data = jnp.where(
+                        hit, jnp.take(jnp.asarray(vs), pos), jnp.nan
+                    )
+                    # int result only when every VALID row matched an int
+                    # value (pad rows must not veto)
+                    import jax as _jax
+
+                    valid = jnp.arange(x.shape[0]) < len(frame)
+                    fully = all_int and bool(
+                        _jax.device_get(jnp.all(hit | ~valid))
+                    )
+            if data is not None:
+                if all_bool and not fully:
+                    # pandas yields OBJECT True/False/NaN here, not floats
+                    return super().series_map(arg, na_action=na_action)
+                out_dtype = np.dtype(np.float64)
+                if all_bool and fully:
+                    data = data.astype(jnp.bool_)
+                    out_dtype = np.dtype(bool)
+                elif all_int and fully:
+                    data = data.astype(jnp.int64)
+                    out_dtype = np.dtype(np.int64)
+                result_col = DeviceColumn(data, out_dtype, length=len(frame))
+                result_frame = TpuDataframe(
+                    [result_col], frame._col_labels, frame._index,
+                    nrows=len(frame),
+                )
+                qc = type(self)(result_frame)
+                qc._shape_hint = "column"
+                return qc
+        return super().series_map(arg, na_action=na_action)
+
+    def reset_index(self, **kwargs: Any):
+        """drop=True is pure metadata (swap in a RangeIndex, zero device
+        work); drop=False prepends the index levels as columns (numeric
+        levels device_put, object levels stay host).  The top fallback in
+        the Kaggle-workflow census (13 hits) before this path existed."""
+        drop = kwargs.get("drop", False)
+        unsupported = any(
+            (
+                (k == "level" and v is not None)
+                or (k == "names" and v is not None)
+                or (k == "col_level" and v not in (0,))
+                or (k == "col_fill" and v not in ("",))
+                or (
+                    k == "allow_duplicates"
+                    and v is not False
+                    and v is not pandas.api.extensions.no_default
+                )
+            )
+            for k, v in kwargs.items()
+        )
+        frame = self._modin_frame
+        n = len(frame)
+        if unsupported or isinstance(frame.columns, pandas.MultiIndex):
+            return super().reset_index(**kwargs)
+        if drop:
+            return type(self)(
+                TpuDataframe(
+                    list(frame._columns),
+                    frame._col_labels,
+                    LazyIndex(pandas.RangeIndex(n), n),
+                    nrows=n,
+                )
+            )
+        idx = frame.index
+        if isinstance(idx, pandas.MultiIndex):
+            levels = [idx.get_level_values(i) for i in range(idx.nlevels)]
+            names = [
+                nm if nm is not None else f"level_{i}"
+                for i, nm in enumerate(idx.names)
+            ]
+        else:
+            levels = [idx]
+            names = [
+                idx.name
+                if idx.name is not None
+                else ("index" if "index" not in set(frame.columns) else "level_0")
+            ]
+        if any(nm in set(frame.columns) for nm in names):
+            return super().reset_index(**kwargs)  # pandas raises/renames
+        from modin_tpu.core.dataframe.tpu.dataframe import _is_device_dtype
+
+        new_cols: list = []
+        for lv in levels:
+            # decide by the LEVEL dtype, not to_numpy()'s: a categorical of
+            # int labels to_numpy()s as int64 and would lose its dtype
+            if isinstance(lv.dtype, np.dtype) and _is_device_dtype(lv.dtype):
+                new_cols.append(DeviceColumn.from_numpy(lv.to_numpy()))
+            else:
+                new_cols.append(HostColumn(lv.array.copy()))
+        new_cols.extend(frame._columns)
+        labels = pandas.Index(list(names) + list(frame.columns))
+        return type(self)(
+            TpuDataframe(
+                new_cols, labels, LazyIndex(pandas.RangeIndex(n), n), nrows=n
+            )
         )
 
     # Beyond this many resulting columns a transpose leaves the columnar
@@ -2502,6 +2895,16 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     agg_func, by, groupby_kwargs or {}, drop, series_groupby,
                     selection,
                 )
+        if (
+            result is None
+            and agg_func == "describe"
+            and axis == 0
+            and not agg_args
+            and not series_groupby
+        ):
+            result = self._try_device_groupby_describe(
+                by, groupby_kwargs or {}, agg_kwargs or {}, drop, selection
+            )
         if result is None and callable(agg_func) and axis == 0 and not series_groupby:
             result = self._try_shuffle_groupby_apply(
                 by, agg_func, groupby_kwargs or {}, agg_args, agg_kwargs or {},
@@ -2514,6 +2917,67 @@ class TpuQueryCompiler(BaseQueryCompiler):
             agg_args=agg_args, agg_kwargs=agg_kwargs, how=how, drop=drop,
             series_groupby=series_groupby, selection=selection,
         )
+
+    def _try_device_groupby_describe(
+        self, by, groupby_kwargs, agg_kwargs, drop, selection=None
+    ) -> Optional["TpuQueryCompiler"]:
+        """groupby.describe as a composition of eight device aggregations
+        (count/mean/std/min/quantiles/max — every piece an existing segment
+        or order kernel; the key factorization is memoized so the composite
+        costs one factorize + eight kernels).  Reference defaults the whole
+        thing to per-group pandas describe."""
+        if (
+            agg_kwargs.get("include") is not None
+            or agg_kwargs.get("exclude") is not None
+            or agg_kwargs.get("percentiles") is not None
+        ):
+            return None
+        stats_plan = [
+            ("count", {}),
+            ("mean", {}),
+            ("std", {}),
+            ("min", {}),
+            ("quantile", {"q": 0.25}),
+            ("quantile", {"q": 0.5}),
+            ("quantile", {"q": 0.75}),
+            ("max", {}),
+        ]
+        parts = []
+        for func, kw in stats_plan:
+            r = self._try_device_groupby(
+                by, func, 0, groupby_kwargs, (),
+                {"numeric_only": True, **kw}, drop, False, selection,
+            )
+            if r is None:
+                return None
+            parts.append(r)
+        stat_names = ["count", "mean", "std", "min", "25%", "50%", "75%", "max"]
+        frames = [p._modin_frame for p in parts]
+        vcols = list(frames[0].columns)
+        if any(list(f.columns) != vcols for f in frames):
+            return None
+        import jax.numpy as jnp
+
+        new_cols = []
+        tuples = []
+        f64 = np.dtype(np.float64)
+        for vi, vc in enumerate(vcols):
+            for si, st in enumerate(stat_names):
+                col = frames[si].get_column(vi)
+                if col.pandas_dtype != f64:
+                    # pandas' describe emits a uniformly float64 frame
+                    col = DeviceColumn(
+                        col.data.astype(jnp.float64), f64, length=col.length
+                    )
+                new_cols.append(col)
+                tuples.append((vc, st))
+        result_frame = TpuDataframe(
+            new_cols,
+            pandas.MultiIndex.from_tuples(tuples),
+            frames[0]._index,
+            nrows=len(frames[0]),
+        )
+        return type(self)(result_frame)
 
     def _try_shuffle_groupby_apply(
         self, by, agg_func, groupby_kwargs, agg_args, agg_kwargs, selection
@@ -3132,22 +3596,42 @@ class TpuQueryCompiler(BaseQueryCompiler):
         # back to labels when building the result index
         key_data_cols = []
         key_decoders: List[Any] = []
+        cat_encodings: List[Any] = []
         for c in key_cols:
             if c.is_device and c.pandas_dtype.kind in "biuf":
                 key_data_cols.append(c)
                 key_decoders.append(None)
                 continue
             if not c.is_device:
-                from modin_tpu.ops.dictionary import encode_host_column
+                if isinstance(c.pandas_dtype, pandas.CategoricalDtype):
+                    from modin_tpu.ops.dictionary import (
+                        encode_categorical_column,
+                    )
 
-                enc = encode_host_column(c)
-                if enc is not None:
-                    key_data_cols.append(enc[0])
-                    key_decoders.append(enc[1])
-                    continue
+                    enc = encode_categorical_column(c)
+                    if enc is not None:
+                        key_data_cols.append(enc.codes)
+                        key_decoders.append(("cat", c.pandas_dtype))
+                        cat_encodings.append(enc)
+                        continue
+                else:
+                    from modin_tpu.ops.dictionary import encode_host_column
+
+                    enc = encode_host_column(c)
+                    if enc is not None:
+                        key_data_cols.append(enc.codes)
+                        key_decoders.append(enc.categories)
+                        continue
             return None
         if len(frame) == 0:
             return None
+        if cat_encodings and not groupby_kwargs.get("observed", True):
+            # observed=False keeps UNOBSERVED categories in the result; the
+            # factorize only sees observed codes.  Take the device path only
+            # when there is nothing unobserved (single categorical key and a
+            # full category set) — the check runs after factorize below.
+            if len(key_cols) > 1:
+                return None
 
         # resolve value columns
         if selection is not None:
@@ -3193,6 +3677,11 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         if n_groups == 0:
             return None
+        if cat_encodings and not groupby_kwargs.get("observed", True):
+            enc = cat_encodings[0]
+            nan_groups = 1 if (not dropna and enc.has_nan) else 0
+            if n_groups - nan_groups < len(enc.categories):
+                return None  # unobserved categories: pandas keeps them
 
         # bool value columns aggregate as ints for sum/mean/... like pandas
         import jax.numpy as jnp
@@ -3245,13 +3734,22 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     out_dtypes.append(np.dtype(d.dtype))
 
         # build result index from group keys (dict-encoded levels translate
-        # their code values back to category labels)
+        # their code values back to labels; categorical levels rebuild their
+        # dtype so the result gets a CategoricalIndex like pandas)
         from modin_tpu.ops.dictionary import decode_codes
 
-        decoded_keys = [
-            decode_codes(vals, cats) if cats is not None else vals
-            for vals, cats in zip(group_keys, key_decoders)
-        ]
+        decoded_keys = []
+        for vals, dec in zip(group_keys, key_decoders):
+            if dec is None:
+                decoded_keys.append(vals)
+            elif isinstance(dec, tuple) and dec[0] == "cat":
+                vals = np.asarray(vals, dtype=np.float64)
+                int_codes = np.where(np.isnan(vals), -1, vals).astype(np.int64)
+                decoded_keys.append(
+                    pandas.Categorical.from_codes(int_codes, dtype=dec[1])
+                )
+            else:
+                decoded_keys.append(decode_codes(vals, dec))
         if len(key_labels) == 1:
             result_index = pandas.Index(decoded_keys[0], name=key_labels[0])
         else:

@@ -84,6 +84,35 @@ def encodable(col: Any) -> bool:
     return encode_host_column(col) is not None
 
 
+def encode_categorical_column(col: Any) -> Optional[DictEncoding]:
+    """Encoding for a host CATEGORICAL column: pandas already stores codes,
+    so this is a cast + device_put (cached).  Categories keep their CATEGORY
+    order (not lexicographic) — pandas sorts categorical groups by category
+    order, which is exactly ascending-code order.  Cached under
+    ``_cat_cache``, NEVER ``_dict_cache``: consumers of the sorted-category
+    encoding (isin/nunique/value_counts/sort) must not receive this
+    category-ordered one."""
+    cached = getattr(col, "_cat_cache", None)
+    if cached is not None:
+        return cached if cached is not False else None
+    from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+
+    try:
+        cat = col.data
+        codes = np.asarray(cat.codes)
+        categories = np.asarray(cat.categories)
+    except Exception:
+        col._cat_cache = False
+        return None
+    fcodes = codes.astype(np.float64)
+    has_nan = bool((codes == -1).any())
+    if has_nan:
+        fcodes[codes == -1] = np.nan
+    result = DictEncoding(DeviceColumn.from_numpy(fcodes), categories, has_nan)
+    col._cat_cache = result
+    return result
+
+
 def decode_codes(code_values: np.ndarray, categories: np.ndarray) -> np.ndarray:
     """Host object array for (possibly NaN) float code values."""
     out = np.empty(len(code_values), dtype=object)

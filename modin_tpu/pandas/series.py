@@ -393,6 +393,15 @@ class Series(BasePandasDataset):
     def map(self, arg: Any, na_action: Any = None, **kwargs: Any) -> "Series":
         if isinstance(arg, Series):
             arg = arg._to_pandas()
+        if not kwargs:
+            # dict mappings ride the QC (device translate for dict-encoded
+            # string columns / numeric lookup kernel); other args take the
+            # generated pandas default inside the same QC method
+            return Series(
+                query_compiler=self._query_compiler.series_map(
+                    arg, na_action=na_action
+                )
+            )
         return self._default_to_pandas("map", arg, na_action=na_action, **kwargs)
 
     def aggregate(self, func: Any = None, axis: Any = 0, *args: Any, **kwargs: Any):

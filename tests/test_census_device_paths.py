@@ -1,0 +1,290 @@
+"""Device paths for the top fallback ops from the Kaggle-workflow census
+(r5): reset_index, describe, setitem_bool (loc-mask banding), series_map.
+
+Differential vs pandas with path-taken assertions."""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import assert_no_fallback, create_test_dfs, df_equals, eval_general
+
+_rng = np.random.default_rng(59)
+
+
+class TestResetIndexDevice:
+    def test_drop_true_metadata_only(self):
+        md, pdf = create_test_dfs({"a": _rng.normal(size=40)})
+        got = assert_no_fallback(lambda: md.reset_index(drop=True))
+        df_equals(got, pdf.reset_index(drop=True))
+
+    def test_default_prepends_index(self):
+        md, pdf = create_test_dfs({"a": _rng.normal(size=40)})
+        got = assert_no_fallback(lambda: md.reset_index())
+        df_equals(got, pdf.reset_index())
+
+    def test_named_and_str_index(self):
+        for idx in (
+            pandas.Index([10, 20, 30], name="id"),
+            pandas.Index(["x", "y", "z"]),
+        ):
+            md = pd.DataFrame({"a": [1.0, 2.0, 3.0]}, index=idx)
+            pdf = pandas.DataFrame({"a": [1.0, 2.0, 3.0]}, index=idx)
+            got = assert_no_fallback(lambda: md.reset_index())
+            df_equals(got, pdf.reset_index())
+
+    def test_multiindex_levels_become_columns(self):
+        mi = pandas.MultiIndex.from_product([["p", "q"], ["r", "s"]], names=["u", None])
+        md = pd.DataFrame({"a": [1.0, 2, 3, 4]}, index=mi)
+        pdf = pandas.DataFrame({"a": [1.0, 2, 3, 4]}, index=mi)
+        got = assert_no_fallback(lambda: md.reset_index())
+        df_equals(got, pdf.reset_index())
+
+    def test_groupby_chain(self):
+        md, pdf = create_test_dfs(
+            {"k": _rng.integers(0, 5, 60), "v": _rng.normal(size=60)}
+        )
+        got = assert_no_fallback(lambda: md.groupby("k").sum().reset_index())
+        df_equals(got, pdf.groupby("k").sum().reset_index())
+
+    def test_conflicting_name_matches_pandas(self):
+        md, pdf = create_test_dfs({"index": [1, 2, 3]})
+        eval_general(md, pdf, lambda df: df.reset_index())
+
+    def test_level_kwarg_falls_back_correct(self):
+        mi = pandas.MultiIndex.from_product([["p", "q"], ["r", "s"]], names=["u", "w"])
+        md = pd.DataFrame({"a": [1.0, 2, 3, 4]}, index=mi)
+        pdf = pandas.DataFrame({"a": [1.0, 2, 3, 4]}, index=mi)
+        eval_general(md, pdf, lambda df: df.reset_index(level="u"))
+
+
+class TestDescribeDevice:
+    @pytest.fixture
+    def dfs(self):
+        n = 300
+        return create_test_dfs(
+            {
+                "a": _rng.normal(size=n),
+                "k": _rng.integers(0, 9, n),
+                "c": np.where(_rng.random(n) < 0.1, np.nan, _rng.uniform(0, 10, n)),
+            }
+        )
+
+    def test_default(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.describe())
+        df_equals(got, pdf.describe())
+
+    def test_custom_percentiles(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.describe(percentiles=[0.1, 0.9]))
+        df_equals(got, pdf.describe(percentiles=[0.1, 0.9]))
+
+    def test_mixed_frame_falls_back_correct(self):
+        md, pdf = create_test_dfs(
+            {
+                "a": _rng.normal(size=30),
+                "s": np.array(["x", "y"], dtype=object)[_rng.integers(0, 2, 30)],
+            }
+        )
+        eval_general(md, pdf, lambda df: df.describe())
+        eval_general(md, pdf, lambda df: df.describe(include="all"))
+
+
+class TestSetitemBoolDevice:
+    def test_float_banding_chain(self):
+        data = {"age": _rng.uniform(0, 80, 200).round(1)}
+        md, pdf = create_test_dfs(data)
+
+        def band(d):
+            d.loc[d["age"] <= 16, "age"] = 0
+            d.loc[(d["age"] > 16) & (d["age"] <= 32), "age"] = 1
+            d.loc[d["age"] > 32, "age"] = 2
+
+        assert_no_fallback(lambda: band(md))
+        band(pdf)
+        df_equals(md, pdf)
+
+    def test_int_scalar_and_nan(self):
+        data = {"k": _rng.integers(0, 9, 100), "f": _rng.normal(size=100)}
+        md, pdf = create_test_dfs(data)
+        for d in (md, pdf):
+            d.loc[d["k"] > 4, "k"] = 99
+            d.loc[d["f"] > 1, "f"] = np.nan
+        df_equals(md, pdf)
+
+    def test_incompatible_scalar_raises_like_pandas(self):
+        md, pdf = create_test_dfs({"k": [1, 2, 3]})
+
+        def set_bad(d):
+            d.loc[d["k"] > 1, "k"] = 2.5
+            return d
+
+        eval_general(md, pdf, set_bad)
+
+
+class TestSeriesMapDevice:
+    def test_str_recode_to_int(self):
+        sex = np.array(["male", "female"], dtype=object)[_rng.integers(0, 2, 300)]
+        md, ps = pd.Series(sex), pandas.Series(sex)
+        got = assert_no_fallback(lambda: md.map({"male": 0, "female": 1}))
+        df_equals(got, ps.map({"male": 0, "female": 1}))
+
+    def test_partial_and_nan_rows_give_float(self):
+        emb = np.array(["S", "C", "Q"], dtype=object)[_rng.integers(0, 3, 200)].copy()
+        emb[_rng.random(200) < 0.1] = np.nan
+        md, ps = pd.Series(emb), pandas.Series(emb)
+        got = assert_no_fallback(lambda: md.map({"S": 0, "C": 1}))
+        df_equals(got, ps.map({"S": 0, "C": 1}))
+
+    def test_numeric_keys_lookup(self):
+        ints = _rng.integers(0, 5, 200)
+        md, ps = pd.Series(ints), pandas.Series(ints)
+        full = {i: i * 10 for i in range(5)}
+        got = assert_no_fallback(lambda: md.map(full))
+        df_equals(got, ps.map(full))
+        eval_general(md, ps, lambda s: s.map({0: 10, 2: 12}))
+
+    def test_bool_values_keep_bool_dtype(self):
+        sex = np.array(["male", "female"], dtype=object)[_rng.integers(0, 2, 100)]
+        md, ps = pd.Series(sex), pandas.Series(sex)
+        got = md.map({"male": True, "female": False})
+        df_equals(got, ps.map({"male": True, "female": False}))
+
+    def test_object_values_fall_back_correct(self):
+        sex = np.array(["male", "female"], dtype=object)[_rng.integers(0, 2, 100)]
+        md, ps = pd.Series(sex), pandas.Series(sex)
+        eval_general(md, ps, lambda s: s.map({"male": "M", "female": "F"}))
+
+    def test_callable_fall_back_correct(self):
+        md, ps = pd.Series(np.arange(20)), pandas.Series(np.arange(20))
+        eval_general(md, ps, lambda s: s.map(lambda x: x + 1))
+
+
+class TestCategoricalKeyGroupBy:
+    """cut/qcut-produced categorical keys groupby on device via their
+    existing codes (ops/dictionary.encode_categorical_column)."""
+
+    @pytest.fixture
+    def dfs(self):
+        n = 400
+        age = _rng.uniform(0, 99, n)
+        md = pd.DataFrame({"age": age, "v": _rng.normal(size=n), "o": _rng.integers(0, 2, n)})
+        pdf = pandas.DataFrame({"age": age, "v": np.asarray(md["v"]._to_pandas()), "o": np.asarray(md["o"]._to_pandas())})
+        md["grp"] = pd.cut(md["age"], bins=[0, 30, 60, 100], labels=["y", "m", "o"])
+        pdf["grp"] = pandas.cut(pdf["age"], bins=[0, 30, 60, 100], labels=["y", "m", "o"])
+        return md, pdf
+
+    @pytest.mark.parametrize("observed", [True, False])
+    def test_mean_categorical_index(self, dfs, observed):
+        md, pdf = dfs
+        got = assert_no_fallback(
+            lambda: md.groupby("grp", observed=observed)["v"].mean()
+        )
+        df_equals(got, pdf.groupby("grp", observed=observed)["v"].mean())
+
+    def test_multi_with_numeric(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(
+            lambda: md.groupby(["grp", "o"], observed=True)["v"].sum()
+        )
+        df_equals(got, pdf.groupby(["grp", "o"], observed=True)["v"].sum())
+
+    def test_unobserved_categories_fall_back_correct(self, dfs):
+        md, pdf = dfs
+        md2, pdf2 = md[md["age"] < 55], pdf[pdf["age"] < 55]
+        eval_general(
+            md2, pdf2,
+            lambda df: df.groupby("grp", observed=False)["v"].mean(),
+        )
+
+    def test_interval_categories_external_key(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(
+            lambda: md.groupby(pd.cut(md["age"], 4), observed=False)["o"].mean()
+        )
+        df_equals(
+            got, pdf.groupby(pandas.cut(pdf["age"], 4), observed=False)["o"].mean()
+        )
+
+
+class TestFillnaMapping:
+    @pytest.fixture
+    def dfs(self):
+        n = 300
+        return create_test_dfs(
+            {
+                "a": np.where(_rng.random(n) < 0.2, np.nan, _rng.normal(size=n)),
+                "b": _rng.integers(0, 9, n),
+                "c": np.where(_rng.random(n) < 0.1, np.nan, _rng.uniform(size=n)),
+            }
+        )
+
+    def test_fillna_mean_series(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.fillna(md.mean()))
+        df_equals(got, pdf.fillna(pdf.mean()))
+
+    def test_fillna_dict(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.fillna({"a": 0.0, "c": 9.5}))
+        df_equals(got, pdf.fillna({"a": 0.0, "c": 9.5}))
+
+    def test_fillna_dict_str_value_falls_back_correct(self):
+        md, pdf = create_test_dfs(
+            {"s": np.array(["x", None, "y"], dtype=object), "a": [1.0, np.nan, 3.0]}
+        )
+        eval_general(md, pdf, lambda df: df.fillna({"s": "zz"}))
+
+
+class TestConcatAxis1Device:
+    def test_aligned_frames_and_series(self):
+        n = 200
+        d1 = {"a": _rng.normal(size=n), "b": _rng.integers(0, 5, n)}
+        d2 = {"c": _rng.normal(size=n)}
+        md1, pdf1 = create_test_dfs(d1)
+        md2, pdf2 = create_test_dfs(d2)
+        got = assert_no_fallback(lambda: pd.concat([md1, md2], axis=1))
+        df_equals(got, pandas.concat([pdf1, pdf2], axis=1))
+        got2 = assert_no_fallback(lambda: pd.concat([md1["a"], md2["c"]], axis=1))
+        df_equals(got2, pandas.concat([pdf1["a"], pdf2["c"]], axis=1))
+
+    def test_misaligned_falls_back_correct(self):
+        md1, pdf1 = create_test_dfs({"a": [1.0, 2, 3]})
+        md2, pdf2 = create_test_dfs({"z": [1.0, 2]})
+        eval_general(
+            md1, pdf1,
+            lambda df: pd.concat([df, md2], axis=1)
+            if df is md1
+            else pandas.concat([df, pdf2], axis=1),
+        )
+
+
+class TestGroupbyDescribeDevice:
+    def test_composite_device(self):
+        n = 400
+        md, pdf = create_test_dfs(
+            {
+                "k": _rng.integers(0, 6, n),
+                "v": np.where(_rng.random(n) < 0.15, np.nan, _rng.normal(size=n)),
+                "w": _rng.integers(0, 40, n),
+            }
+        )
+        got = assert_no_fallback(lambda: md.groupby("k").describe())
+        df_equals(got, pdf.groupby("k").describe())
+
+    def test_str_key(self):
+        n = 300
+        s = np.array(["a", "b", "c"], dtype=object)[_rng.integers(0, 3, n)]
+        md, pdf = create_test_dfs({"s": s, "v": _rng.normal(size=n)})
+        got = assert_no_fallback(lambda: md.groupby("s").describe())
+        df_equals(got, pdf.groupby("s").describe())
+
+    def test_custom_percentiles_falls_back_correct(self):
+        md, pdf = create_test_dfs(
+            {"k": _rng.integers(0, 4, 100), "v": _rng.normal(size=100)}
+        )
+        eval_general(
+            md, pdf, lambda df: df.groupby("k").describe(percentiles=[0.1])
+        )

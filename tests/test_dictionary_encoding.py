@@ -12,7 +12,13 @@ import pandas
 import pytest
 
 import modin_tpu.pandas as pd
-from tests.utils import assert_no_fallback, create_test_dfs, df_equals, eval_general
+from tests.utils import (
+    assert_no_fallback,
+    create_test_dfs,
+    df_equals,
+    eval_general,
+    require_tpu_execution,
+)
 
 _rng = np.random.default_rng(41)
 _CITIES = np.array(
@@ -83,6 +89,7 @@ class TestDictGroupBy:
         eval_general(md, pdf, lambda df: df.groupby("k")["v"].sum())
 
     def test_encoding_cached_across_aggs(self):
+        require_tpu_execution()
         md, pdf = create_test_dfs(_str_frame())
         col = md._query_compiler._modin_frame.get_column(0)
         assert_no_fallback(lambda: md.groupby("city").sum())
@@ -169,6 +176,7 @@ class TestDictMerge:
 
 class TestDictEncodingUnit:
     def test_codes_order_isomorphic(self):
+        require_tpu_execution()
         from modin_tpu.ops.dictionary import encode_host_column
 
         md, _ = create_test_dfs({"s": np.array(["b", "a", "c", "a"], dtype=object)})
@@ -190,6 +198,7 @@ class TestDictEncodingUnit:
         assert lm.tolist() == [0.0, 2.0] and rm.tolist() == [1.0, 2.0]
 
     def test_non_string_column_not_encoded(self):
+        require_tpu_execution()
         from modin_tpu.ops.dictionary import encode_host_column
 
         md, _ = create_test_dfs({"x": pandas.array([1, 2, None], dtype="Int64")})

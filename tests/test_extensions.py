@@ -172,15 +172,17 @@ def test_accessor_class_cached(_clean_registry):
 
 
 def test_post_op_switch_moves_small_result(_clean_registry):
+    # melt has no TpuQC override, so the post-op point re-prices its small
+    # fallback result and hands it to the in-process backend (describe, the
+    # op used before r05, grew a device kernel whose zero stay-cost keeps
+    # results on-device)
     register_function_for_post_op_switch(
-        class_name=None, backend="Tpu", method="describe"
+        class_name=None, backend="Tpu", method="melt"
     )
     df = pd.DataFrame({"a": np.arange(100.0)})
-    out = df.describe()
-    # describe shrinks 100 rows -> 8; the post-op point re-prices the result
-    # and hands it to the in-process backend
+    out = df.melt()
     assert type(out._query_compiler).__name__ == "NativeQueryCompiler"
-    expected = pandas.DataFrame({"a": np.arange(100.0)}).describe()
+    expected = pandas.DataFrame({"a": np.arange(100.0)}).melt()
     pandas.testing.assert_frame_equal(out._to_pandas(), expected)
 
 
