@@ -16,6 +16,10 @@ from .utils import (
 
 
 class TimeArithmetic:
+    """Full reference op set (reference benchmarks.py:383-433): sum, count,
+    median, nunique, apply, mean, mode, add, mul, mod, abs, aggregate,
+    isin, transpose — each under both axis params like the reference."""
+
     params = [UNARY_SHAPES, [0, 1]]
     param_names = ["shape", "axis"]
 
@@ -29,17 +33,41 @@ class TimeArithmetic:
     def time_count(self, shape, axis):
         execute(self.df.count(axis=axis))
 
-    def time_mean(self, shape, axis):
-        execute(self.df.mean(axis=axis))
-
     def time_median(self, shape, axis):
         execute(self.df.median(axis=axis))
 
+    def time_nunique(self, shape, axis):
+        execute(self.df.nunique(axis=axis))
+
+    def time_apply(self, shape, axis):
+        execute(self.df.apply(lambda df: df.sum(), axis=axis))
+
+    def time_mean(self, shape, axis):
+        execute(self.df.mean(axis=axis))
+
+    def time_mode(self, shape, axis):
+        execute(self.df.mode(axis=axis))
+
     def time_add(self, shape, axis):
-        execute(self.df + self.df)
+        execute(self.df.add(2, axis=axis))
+
+    def time_mul(self, shape, axis):
+        execute(self.df.mul(2, axis=axis))
+
+    def time_mod(self, shape, axis):
+        execute(self.df.mod(2, axis=axis))
 
     def time_abs(self, shape, axis):
         execute(self.df.abs())
+
+    def time_aggregate(self, shape, axis):
+        execute(self.df.aggregate(lambda df: df.sum(), axis=axis))
+
+    def time_is_in(self, shape, axis):
+        execute(self.df.isin([0, 2]))
+
+    def time_transpose(self, shape, axis):
+        execute(self.df.transpose())
 
 
 class TimeGroupByDefaultAggregations:

@@ -29,6 +29,8 @@ from typing import Any, Callable, List, Optional, Sequence, Union
 import numpy as np
 import pandas
 
+from pandas.api.types import is_object_dtype as _is_object_dtype
+
 from modin_tpu.core.dataframe.base.dataframe import BaseDataframe
 from modin_tpu.core.dataframe.tpu.metadata import LazyIndex, ensure_index
 from modin_tpu.logging import ClassLogger
@@ -242,18 +244,31 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
                 values = series.to_numpy()
                 columns.append(DeviceColumn.from_numpy(values))
             else:
-                columns.append(HostColumn(series.array.copy()))
+                arr = series.array.copy()
+                if isinstance(arr, pandas.arrays.NumpyExtensionArray):
+                    # store the raw ndarray: NumpyEADtype('object') fails ==
+                    # against np.dtype(object) and would leak to users as a
+                    # different-looking dtype
+                    arr = np.asarray(arr)
+                columns.append(HostColumn(arr))
         return cls(columns, df.columns, df.index, nrows=len(df))
 
     def to_pandas(self) -> pandas.DataFrame:
         self.materialize_device()
+        idx = self.index
         data = {}
         for i, col in enumerate(self._columns):
             if col.is_device:
                 data[i] = col.to_numpy()
             else:
-                data[i] = col.to_pandas_array()
-        df = pandas.DataFrame(data, index=self.index, copy=False)
+                arr = col.to_pandas_array()
+                if _is_object_dtype(getattr(arr, "dtype", None)):
+                    # pandas 3 infers str for plain object string arrays;
+                    # an explicit-dtype Series is the only construction
+                    # that round-trips object EXACTLY
+                    arr = pandas.Series(arr, index=idx, dtype=object)
+                data[i] = arr
+        df = pandas.DataFrame(data, index=idx, copy=False)
         df.columns = self._col_labels
         return df
 

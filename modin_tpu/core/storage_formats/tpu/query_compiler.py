@@ -1224,6 +1224,90 @@ class TpuQueryCompiler(BaseQueryCompiler):
             )
         return super().setitem_bool(row_loc, col_loc, item)
 
+    def _try_str_lut(self, name: str, args: tuple, kwargs: dict):
+        """String predicates/measures through the dictionary encoding: the
+        pandas op runs once per CATEGORY (host, tiny), and the result lookup
+        table gathers by code on device — ``.str.len()`` & co. never touch
+        the n rows.  Missing rows take whatever pandas produces for a NaN
+        probe of the column's dtype (bool fill for str-dtype/na= kwargs,
+        NaN for numeric ops); a NaN probe yielding NaN under a bool op means
+        pandas' object-mixed output, which stays on the fallback."""
+        frame = self._modin_frame
+        col = frame.get_column(0) if frame.num_cols == 1 else None
+        if col is None or col.is_device or not len(frame):
+            return None
+        from modin_tpu.ops.dictionary import encode_host_column
+
+        enc = encode_host_column(col)
+        if enc is None:
+            return None
+        try:
+            cats = pandas.Series(enc.categories, dtype=col.pandas_dtype)
+            lut_ser = getattr(cats.str, name)(*args, **kwargs)
+            na_probe = None
+            if enc.has_nan:
+                na_probe = getattr(
+                    pandas.Series([np.nan], dtype=col.pandas_dtype).str, name
+                )(*args, **kwargs).iloc[0]
+        except Exception:
+            return None
+        if (
+            not isinstance(lut_ser, pandas.Series)
+            or len(lut_ser) != len(enc.categories)
+        ):
+            return None
+        import jax.numpy as jnp
+
+        kind = getattr(lut_ser.dtype, "kind", "")
+        cast = None
+        if kind == "b":
+            if enc.has_nan:
+                if not isinstance(na_probe, (bool, np.bool_)):
+                    return None  # NaN-mixed object output
+                fill = float(bool(na_probe))
+            else:
+                fill = 0.0
+            lut = np.append(lut_ser.to_numpy().astype(np.float64), fill)
+            out_dtype = np.dtype(bool)
+            cast = jnp.bool_
+        elif kind in "iuf":
+            vals = lut_ser.to_numpy().astype(np.float64)
+            if enc.has_nan:
+                if na_probe is None or (
+                    isinstance(na_probe, (float, np.floating))
+                    and np.isnan(na_probe)
+                ):
+                    fill = np.nan
+                elif isinstance(
+                    na_probe, (int, float, np.integer, np.floating)
+                ):
+                    fill = float(na_probe)
+                else:
+                    return None
+            else:
+                fill = np.nan  # unreachable slot
+            lut = np.append(vals, fill)
+            if kind in "iu" and not np.isnan(lut[: len(vals) + int(enc.has_nan)]).any():
+                out_dtype = np.dtype(np.int64)
+                cast = jnp.int64
+            else:
+                out_dtype = np.dtype(np.float64)
+        else:
+            return None  # string/object outputs stay host
+        codes = enc.codes.data
+        safe = jnp.where(jnp.isnan(codes), len(enc.categories), codes)
+        data = jnp.take(jnp.asarray(lut), safe.astype(jnp.int32), mode="clip")
+        if cast is not None:
+            data = data.astype(cast)
+        result_col = DeviceColumn(data, out_dtype, length=len(frame))
+        qc = type(self)(
+            TpuDataframe(
+                [result_col], frame._col_labels, frame._index, nrows=len(frame)
+            )
+        )
+        qc._shape_hint = "column"
+        return qc
+
     def series_map(self, arg: Any, na_action: Any = None) -> "TpuQueryCompiler":
         """dict-mapping a Series on device.
 
@@ -1698,7 +1782,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
                         if (
                             missing_vals
                             and enc.has_nan
-                            and c.pandas_dtype == object
+                            and pandas.api.types.is_object_dtype(c.pandas_dtype)
                         ):
                             plans = None
                             break
@@ -2135,7 +2219,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         for fr in (lframe, rframe):
             for c in fr._columns:
                 if not c.is_device and not (
-                    c.pandas_dtype == object
+                    pandas.api.types.is_object_dtype(c.pandas_dtype)
                     or isinstance(c.pandas_dtype, pandas.StringDtype)
                 ):
                     return None
@@ -2260,7 +2344,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         def _restore_host_dtype(arr, dtype):
             # assembly works on plain object arrays; str-dtype (pandas>=3
             # default for strings) columns convert back at the end
-            if dtype == object:
+            if pandas.api.types.is_object_dtype(dtype):
                 return arr
             try:
                 return pandas.array(arr, dtype=dtype)
@@ -4080,6 +4164,34 @@ for _op in _EWM_OPS:
     setattr(TpuQueryCompiler, f"ewm_{_op}", _make_ewm_override(_op))
 for _op in RESAMPLE_DEVICE_OPS:
     setattr(TpuQueryCompiler, f"resample_{_op}", _make_resample_override(_op))
+
+
+# string predicates/measures whose per-category results gather by dictionary
+# code on device (_try_str_lut); string-OUTPUT ops (lower/strip/replace/...)
+# stay host-side by design
+_STR_LUT_METHODS = [
+    "len", "count", "contains", "startswith", "endswith", "match",
+    "fullmatch", "find", "rfind", "isalnum", "isalpha", "isdigit",
+    "isspace", "islower", "isupper", "istitle", "isnumeric", "isdecimal",
+]
+
+
+def _make_str_lut_override(name: str):
+    base = getattr(BaseQueryCompiler, f"str_{name}")
+
+    def method(self: TpuQueryCompiler, *args: Any, **kwargs: Any):
+        result = self._try_str_lut(name, args, kwargs)
+        if result is not None:
+            return result
+        return base(self, *args, **kwargs)
+
+    method.__name__ = f"str_{name}"
+    return method
+
+
+for _op in _STR_LUT_METHODS:
+    if getattr(BaseQueryCompiler, f"str_{_op}", None) is not None:
+        setattr(TpuQueryCompiler, f"str_{_op}", _make_str_lut_override(_op))
 
 # the generated overrides above were installed after __init_subclass__ ran,
 # so they need the backend-caster wrap applied explicitly

@@ -16,8 +16,11 @@ nunique, value_counts, drop_duplicates) through a lazy, cached factorization:
 This is the staged design SURVEY §7 calls for (codes on device, categories
 on host); the reference instead ships whole object partitions to workers
 (modin/core/storage_formats/pandas/query_compiler.py groupby/merge on
-object keys).  Str METHODS (.str.*) stay host-side — phase 1 covers the
-equality/order ops only.
+object keys).  The encoding also powers the ``.str`` PREDICATE/MEASURE ops
+(len/contains/startswith/is*/count/find/match — TpuQueryCompiler's
+``_try_str_lut`` runs the pandas op once per category and gathers the
+lookup table by code on device); only string-OUTPUT str ops
+(lower/strip/replace/...) stay host-side.
 
 Encoding is lazy (first use) and cached on the column, so unused string
 columns cost nothing and a repeated ``df.groupby("city")`` factorizes once.
@@ -59,9 +62,13 @@ def encode_host_column(col: Any) -> Optional[DictEncoding]:
 def _encode(col: Any) -> Optional[DictEncoding]:
     from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
 
+    from pandas.api.types import is_object_dtype
+
     dtype = col.pandas_dtype
+    # NOTE: NumpyEADtype("object") != np.dtype(object) under ==, so the
+    # object check must go through is_object_dtype
     if not (
-        dtype == object
+        is_object_dtype(dtype)
         or (hasattr(pandas, "StringDtype") and isinstance(dtype, pandas.StringDtype))
     ):
         return None

@@ -288,3 +288,85 @@ class TestGroupbyDescribeDevice:
         eval_general(
             md, pdf, lambda df: df.groupby("k").describe(percentiles=[0.1])
         )
+
+
+class TestStrLutOps:
+    """String predicates/measures via the dictionary LUT (_try_str_lut):
+    the pandas op runs once per category, results gather by code on device.
+    String-output ops (.str.lower etc) stay host by design."""
+
+    _WORDS = np.array(["Tokyo", "oslo9", "LIMA", "ca iro", "  x", "77"], dtype=object)
+
+    @pytest.fixture
+    def clean(self):
+        vals = self._WORDS[_rng.integers(0, 6, 400)]
+        return pd.Series(vals), pandas.Series(vals)
+
+    @pytest.fixture
+    def dirty(self):
+        vals = self._WORDS[_rng.integers(0, 6, 400)].copy()
+        vals[_rng.random(400) < 0.12] = np.nan
+        return pd.Series(vals), pandas.Series(vals)
+
+    @pytest.mark.parametrize(
+        "op",
+        [
+            lambda s: s.str.len(),
+            lambda s: s.str.contains("o"),
+            lambda s: s.str.contains(r"\d", regex=True),
+            lambda s: s.str.startswith("T"),
+            lambda s: s.str.endswith("o"),
+            lambda s: s.str.count("o"),
+            lambda s: s.str.isdigit(),
+            lambda s: s.str.isupper(),
+            lambda s: s.str.match(r"[A-Z]"),
+            lambda s: s.str.find("o"),
+        ],
+    )
+    def test_clean_device(self, clean, op):
+        md, ps = clean
+        got = assert_no_fallback(lambda: op(md))
+        df_equals(got, op(ps))
+
+    @pytest.mark.parametrize(
+        "op",
+        [
+            lambda s: s.str.len(),
+            lambda s: s.str.contains("o"),
+            lambda s: s.str.contains("o", na=False),
+            lambda s: s.str.endswith("o"),
+            lambda s: s.str.isupper(),
+        ],
+    )
+    def test_nan_rows(self, dirty, op):
+        md, ps = dirty
+        got = op(md)
+        df_equals(got, op(ps))
+
+    def test_object_dtype_nan_mixed_output_falls_back_correct(self):
+        s = pandas.Series(["ab", np.nan, "cd"], dtype=object)
+        md = pd.Series(s)
+        eval_general(md, s, lambda x: x.str.contains("a"))
+        eval_general(md, s, lambda x: x.str.len())
+
+    def test_string_output_ops_stay_correct(self, clean):
+        md, ps = clean
+        eval_general(md, ps, lambda s: s.str.lower())
+        eval_general(md, ps, lambda s: s.str.strip())
+
+
+class TestObjectDtypeRoundTrip:
+    """pandas 3 infers str for plain object string arrays; to_pandas must
+    reconstruct object columns as OBJECT (NumpyEADtype('object') also fails
+    == np.dtype(object), so gates go through is_object_dtype)."""
+
+    def test_object_series_round_trip(self):
+        s = pandas.Series(["ab", np.nan, "cd"], dtype=object)
+        md = pd.Series(s)
+        assert md.dtype == s.dtype
+        pandas.testing.assert_series_equal(md._to_pandas(), s)
+
+    def test_object_mixed_bool_nan_result(self):
+        s = pandas.Series([True, np.nan, False], dtype=object)
+        md = pd.Series(s)
+        pandas.testing.assert_series_equal(md._to_pandas(), s)
