@@ -1224,6 +1224,75 @@ class TpuQueryCompiler(BaseQueryCompiler):
             )
         return super().setitem_bool(row_loc, col_loc, item)
 
+    def series_get_dummies(
+        self,
+        prefix: Any = None,
+        prefix_sep: str = "_",
+        dummy_na: bool = False,
+        drop_first: bool = False,
+        dtype: Any = None,
+    ):
+        """One-hot encode a string/categorical Series on device: one
+        ``codes == k`` kernel per category (bounded at 256), columns in
+        pandas' order (sorted uniques for strings, category order — with
+        unobserved categories — for categoricals).  Returns None when not
+        applicable so the caller can fall back."""
+        frame = self._modin_frame
+        col = frame.get_column(0) if frame.num_cols == 1 else None
+        if col is None or col.is_device or not len(frame):
+            return None
+        if isinstance(col.pandas_dtype, pandas.CategoricalDtype):
+            from modin_tpu.ops.dictionary import encode_categorical_column
+
+            enc = encode_categorical_column(col)
+        else:
+            from modin_tpu.ops.dictionary import encode_host_column
+
+            enc = encode_host_column(col)
+        if enc is None or not (0 < len(enc.categories) <= 256):
+            return None
+        out_dtype = np.dtype(bool) if dtype is None else np.dtype(dtype)
+        if out_dtype.kind not in "biuf":
+            return None
+        import jax.numpy as jnp
+
+        codes = enc.codes.data
+        labels: list = []
+        cols: list = []
+        cats = list(enc.categories)
+        start = 1 if drop_first else 0
+        for k, cat in enumerate(cats):
+            if k < start:
+                continue
+            data = codes == float(k)
+            if out_dtype != np.dtype(bool):
+                data = data.astype(jnp.dtype(out_dtype.name))
+            cols.append(DeviceColumn(data, out_dtype, length=len(frame)))
+            labels.append(
+                f"{prefix}{prefix_sep}{cat}" if prefix is not None else cat
+            )
+        if dummy_na:
+            data = jnp.isnan(codes)
+            if out_dtype != np.dtype(bool):
+                data = data.astype(jnp.dtype(out_dtype.name))
+            cols.append(DeviceColumn(data, out_dtype, length=len(frame)))
+            labels.append(
+                f"{prefix}{prefix_sep}nan" if prefix is not None else np.nan
+            )
+        if not cols:
+            return None
+        if isinstance(col.pandas_dtype, pandas.CategoricalDtype) and prefix is None:
+            # pandas labels categorical dummies with a CategoricalIndex
+            # (the dummy_na column's NaN label is the -1 code)
+            label_index: pandas.Index = pandas.CategoricalIndex(
+                labels, dtype=col.pandas_dtype
+            )
+        else:
+            label_index = pandas.Index(labels)
+        return type(self)(
+            TpuDataframe(cols, label_index, frame._index, nrows=len(frame))
+        )
+
     def _try_str_lut(self, name: str, args: tuple, kwargs: dict):
         """String predicates/measures through the dictionary encoding: the
         pandas op runs once per CATEGORY (host, tiny), and the result lookup
@@ -1235,6 +1304,13 @@ class TpuQueryCompiler(BaseQueryCompiler):
         frame = self._modin_frame
         col = frame.get_column(0) if frame.num_cols == 1 else None
         if col is None or col.is_device or not len(frame):
+            return None
+        if (
+            isinstance(col.pandas_dtype, pandas.StringDtype)
+            and col.pandas_dtype.na_value is pandas.NA
+        ):
+            # NA-backed 'string' dtype: pandas emits Int64/boolean EXTENSION
+            # results here, not numpy int64/bool — keep the pandas fallback
             return None
         from modin_tpu.ops.dictionary import encode_host_column
 

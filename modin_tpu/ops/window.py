@@ -177,17 +177,65 @@ def expanding_reduce(
 # x {min_periods} x {bias} grid (1920 checks, rtol 1e-9).
 
 
+def _scan_combine(x, y):
+    """Associative composition of first-order maps: ((a1,b1) then (a2,b2))
+    -> (a1*a2, a2*b1 + b2)."""
+    ax, bx = x
+    ay, by = y
+    return ax * ay, ay * bx + by
+
+
+# Within-block scan length for the two-level formulation below.  jax's
+# associative_scan does O(n log n) combine work; blocking caps the log factor
+# at log(block) (12 for 4096 vs 27 at 1e8 rows) — the VERDICT-r4 concern
+# about the ewm scan's work term at north-star scale.
+_SCAN_BLOCK = 4096
+# None -> auto (blocked on accelerators only).  Measured on the CPU
+# substrate the flat scan WINS (3.7s vs 6.8s at 1e7x5: XLA:CPU lowers
+# associative_scan to a sequential O(n) loop, and the blocked form only
+# adds reshape traffic); the log-factor reduction targets accelerator
+# backends where the flat scan's depth passes over HBM dominate.
+_USE_BLOCKED_SCAN = None
+
+
+def _blocked_scan_enabled() -> bool:
+    if _USE_BLOCKED_SCAN is not None:
+        return _USE_BLOCKED_SCAN
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 def _linear_scan(a, b):
-    """y_t = a_t * y_{t-1} + b_t with y_{-1} = 0, via associative map
-    composition ((a1,b1) then (a2,b2)) -> (a1*a2, a2*b1 + b2)."""
+    """y_t = a_t * y_{t-1} + b_t with y_{-1} = 0.
+
+    Two-level blocked scan: (1) independent within-block scans over rows
+    reshaped to (B, C); (2) one tiny scan over the B block summaries to get
+    each block's incoming carry; (3) y[i,j] = A_prefix[i,j]*carry[i] + y_local.
+    Work drops from O(n log n) to O(n log C + B log B + n) with identical
+    results (map composition is exact, no reordering of the b terms).
+    Short arrays and CPU backends use the flat scan."""
     import jax.lax as lax
+    import jax.numpy as jnp
 
-    def combine(x, y):
-        ax, bx = x
-        ay, by = y
-        return ax * ay, ay * bx + by
-
-    return lax.associative_scan(combine, (a, b))[1]
+    P = a.shape[0]
+    C = _SCAN_BLOCK
+    if P <= 2 * C or not _blocked_scan_enabled():
+        return lax.associative_scan(_scan_combine, (a, b))[1]
+    B = -(-P // C)
+    pad = B * C - P
+    if pad:
+        # identity elements (a=1, b=0) extend the tail without changing any
+        # prefix value
+        a = jnp.concatenate([a, jnp.ones(pad, a.dtype)])
+        b = jnp.concatenate([b, jnp.zeros(pad, b.dtype)])
+    a2 = a.reshape(B, C)
+    b2 = b.reshape(B, C)
+    aw, bw = lax.associative_scan(_scan_combine, (a2, b2), axis=1)
+    _, carry_scan = lax.associative_scan(_scan_combine, (aw[:, -1], bw[:, -1]))
+    carry = jnp.concatenate([jnp.zeros(1, b.dtype), carry_scan[:-1]])
+    y = aw * carry[:, None] + bw
+    return y.reshape(-1)[:P]
 
 
 def _one_ewm(op: str, c, n: int, alpha, adjust: bool, ignore_na: bool,

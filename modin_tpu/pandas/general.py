@@ -248,6 +248,16 @@ def get_dummies(
             )
         )
     if isinstance(data, Series):
+        # string/categorical series one-hot on device through the dictionary
+        # codes (one equality kernel per category)
+        fast = getattr(data._query_compiler, "series_get_dummies", None)
+        if fast is not None:
+            qc = fast(
+                prefix=prefix, prefix_sep=prefix_sep, dummy_na=dummy_na,
+                drop_first=drop_first, dtype=dtype,
+            )
+            if qc is not None:
+                return DataFrame(query_compiler=qc)
         # pandas encodes a Series regardless of dtype; go through the Series
         # kernel directly so numeric series are one-hot encoded too
         return _wrap(

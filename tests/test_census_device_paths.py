@@ -370,3 +370,70 @@ class TestObjectDtypeRoundTrip:
         s = pandas.Series([True, np.nan, False], dtype=object)
         md = pd.Series(s)
         pandas.testing.assert_series_equal(md._to_pandas(), s)
+
+
+class TestGetDummiesDevice:
+    """Series one-hot via dictionary/categorical codes (one equality kernel
+    per category); numeric series keep the pandas path."""
+
+    _CITIES3 = np.array(["tokyo", "oslo", "lima"], dtype=object)
+
+    def _mk(self, nan=False, n=400):
+        vals = self._CITIES3[_rng.integers(0, 3, n)].copy()
+        if nan:
+            vals[_rng.random(n) < 0.1] = np.nan
+        return vals
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {},
+            {"prefix": "c"},
+            {"drop_first": True},
+            {"dtype": np.int64},
+        ],
+    )
+    def test_str_series(self, kw):
+        vals = self._mk()
+        got = assert_no_fallback(lambda: pd.get_dummies(pd.Series(vals), **kw))
+        df_equals(got, pandas.get_dummies(pandas.Series(vals), **kw))
+
+    @pytest.mark.parametrize("dummy_na", [False, True])
+    def test_nan_rows(self, dummy_na):
+        vals = self._mk(nan=True)
+        got = assert_no_fallback(
+            lambda: pd.get_dummies(pd.Series(vals), dummy_na=dummy_na)
+        )
+        df_equals(got, pandas.get_dummies(pandas.Series(vals), dummy_na=dummy_na))
+
+    def test_categorical_includes_unobserved(self):
+        cat = pandas.Categorical(
+            self._mk(), categories=["tokyo", "oslo", "lima", "unused"]
+        )
+        got = assert_no_fallback(lambda: pd.get_dummies(pd.Series(cat)))
+        df_equals(got, pandas.get_dummies(pandas.Series(cat)))
+
+    def test_numeric_series_correct(self):
+        ints = np.asarray(_rng.integers(0, 3, 60))
+        df_equals(
+            pd.get_dummies(pd.Series(ints)),
+            pandas.get_dummies(pandas.Series(ints)),
+        )
+
+
+class TestStrLutExtensionDtypes:
+    def test_na_backed_string_dtype_keeps_extension_results(self):
+        # 'string' (NA-backed) produces Int64/boolean EXTENSION dtypes in
+        # pandas; the LUT path must defer (r5 review)
+        s = pandas.Series(["ab", "c"], dtype="string")
+        md = pd.Series(s)
+        df_equals(md.str.len(), s.str.len())
+        assert md.str.len().dtype == s.str.len().dtype
+        df_equals(md.str.contains("a"), s.str.contains("a"))
+
+    def test_categorical_dummy_na_categorical_columns(self):
+        cat = pandas.Categorical(["a", "b", None, "a"], categories=["a", "b", "u"])
+        m = pd.get_dummies(pd.Series(cat), dummy_na=True)
+        p = pandas.get_dummies(pandas.Series(cat), dummy_na=True)
+        df_equals(m, p)
+        assert type(m.columns).__name__ == type(p.columns).__name__
