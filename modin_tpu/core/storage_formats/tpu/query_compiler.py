@@ -895,7 +895,12 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         # column selection
         allowed = "biuf"
+        # string/object columns join min/max/count through their dictionary
+        # codes (sorted categories: code min/max IS the lexicographic one);
+        # decoders[i] carries the categories for result translation
+        dict_ok = op in ("min", "max", "count") and axis in (0, None)
         positions = []
+        decoders: dict = {}
         for i, col in enumerate(frame._columns):
             ok = col.is_device and col.pandas_dtype.kind in allowed
             if numeric_only:
@@ -907,11 +912,28 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     return None  # numeric column we can't run on device
             else:
                 if not ok:
+                    if dict_ok and not col.is_device and not isinstance(
+                        col.pandas_dtype, pandas.CategoricalDtype
+                    ):
+                        from modin_tpu.ops.dictionary import encode_host_column
+
+                        enc = encode_host_column(col)
+                        # empty categories = all-missing column; pandas'
+                        # reduction quirks there (None vs nan) stay with it
+                        if enc is not None and len(enc.categories):
+                            decoders[i] = enc.categories
+                            positions.append(i)
+                            continue
                     return None
                 positions.append(i)
         if not positions:
             return None
-        sel_cols = [frame._columns[i] for i in positions]
+        sel_cols = [
+            frame._columns[i]
+            if i not in decoders
+            else frame._columns[i]._dict_cache.codes
+            for i in positions
+        ]
         labels = frame.columns[positions]
         # raw: lazy elementwise producers fuse into the reduction tail
         arrays = [c.raw for c in sel_cols]
@@ -937,9 +959,31 @@ class TpuQueryCompiler(BaseQueryCompiler):
         values = reductions.reduce_columns(
             op, arrays, len(frame), skipna=skipna, ddof=ddof, cast_bool=cast_bool
         )
-        result = pandas.Series(
-            [v.item() if v.ndim == 0 else v for v in values], index=labels
-        )
+        out_values = []
+        for pos, v in zip(positions, values):
+            v = v.item() if v.ndim == 0 else v
+            if pos in decoders and op in ("min", "max"):
+                from modin_tpu.ops.dictionary import decode_codes
+
+                v = decode_codes(np.asarray([v], np.float64), decoders[pos])[0]
+            out_values.append(v)
+        if decoders and op in ("min", "max"):
+            # pandas dtype rules: a pure string-column frame keeps the string
+            # dtype (even when every result is NaN); any mix is object
+            if len(decoders) == len(positions):
+                col_dts = {
+                    str(frame._columns[i].pandas_dtype) for i in positions
+                }
+                dtype_arg = (
+                    frame._columns[positions[0]].pandas_dtype
+                    if len(col_dts) == 1
+                    else object
+                )
+            else:
+                dtype_arg = object
+            result = pandas.Series(out_values, index=labels, dtype=dtype_arg)
+        else:
+            result = pandas.Series(out_values, index=labels)
         if op in ("any", "all"):
             result = result.astype(bool)
         elif op == "count":
@@ -1223,6 +1267,45 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 )
             )
         return super().setitem_bool(row_loc, col_loc, item)
+
+    def unique(self, **kwargs: Any):
+        """String-series unique via the dictionary encoding: categories are
+        the distinct values; APPEARANCE order (pandas' contract) comes from a
+        device segment-min of first positions per code."""
+        frame = self._modin_frame
+        col = frame.get_column(0) if frame.num_cols == 1 else None
+        if col is not None and not col.is_device and len(frame) and not kwargs:
+            from modin_tpu.ops.dictionary import decode_codes, encode_host_column
+
+            enc = encode_host_column(col)
+            if enc is not None:
+                import jax
+
+                from modin_tpu.ops import groupby as gb_ops
+
+                try:
+                    codes, n_groups, group_keys, _ = gb_ops.factorize_keys_cached(
+                        [enc.codes.data], len(frame), dropna=False
+                    )
+                except gb_ops._TooManyGroups:
+                    return super().unique(**kwargs)
+                first_dev = gb_ops.groupby_first_position(codes, n_groups)
+                first = np.asarray(jax.device_get(first_dev))[:n_groups]
+                order = np.argsort(first, kind="stable")
+                values = decode_codes(
+                    np.asarray(group_keys[0], np.float64)[order], enc.categories
+                )
+                if isinstance(col.pandas_dtype, pandas.StringDtype):
+                    # NA-backed string series surface pd.NA, not np.nan
+                    result = pandas.Series(
+                        pandas.array(values, dtype=col.pandas_dtype)
+                    )
+                else:
+                    result = pandas.Series(values, dtype=object)
+                return type(self).from_pandas(
+                    result.to_frame(MODIN_UNNAMED_SERIES_LABEL)
+                )
+        return super().unique(**kwargs)
 
     def series_get_dummies(
         self,
@@ -1774,16 +1857,30 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 if len(matches) != 1 or matches[0] < 0:
                     return None  # missing/duplicate label: pandas raises
                 positions.append(matches[0])
-        if not positions or not all(
-            frame._columns[i].is_device
-            and frame._columns[i].pandas_dtype.kind in "biuf"
-            for i in positions
-        ):
+        if not positions:
+            return None
+        key_datas = []
+        for i in positions:
+            c = frame._columns[i]
+            if c.is_device and c.pandas_dtype.kind in "biuf":
+                key_datas.append(None)  # resolved after materialize
+                continue
+            if not c.is_device:
+                # string/object keys compare by dictionary code (NaN codes
+                # rank together like pandas' NaN==NaN duplicate rule)
+                from modin_tpu.ops.dictionary import encode_host_column
+
+                enc = encode_host_column(c)
+                if enc is not None:
+                    key_datas.append(enc.codes.data)
+                    continue
             return None
         frame.materialize_device()
-        return duplicated_mask(
-            [frame._columns[i].data for i in positions], len(frame), keep
-        )
+        key_datas = [
+            frame._columns[i].data if d is None else d
+            for i, d in zip(positions, key_datas)
+        ]
+        return duplicated_mask(key_datas, len(frame), keep)
 
     def duplicated(self, subset: Any = None, keep: Any = "first", **kwargs: Any):
         mask = (
@@ -3806,8 +3903,15 @@ class TpuQueryCompiler(BaseQueryCompiler):
             value_positions = [
                 i for i in range(frame.num_cols) if i not in key_positions
             ]
+        # string/object VALUE columns participate through their dictionary
+        # codes for the order/equality-shaped aggregations (sorted categories
+        # make code min/max the lexicographic min/max; count/nunique/first/
+        # last are code-agnostic); value_decoders[j] holds (categories,
+        # source dtype) for columns whose per-group results decode back
+        _DICT_VALUE_AGGS = ("min", "max", "first", "last", "count", "nunique")
         value_cols = []
         value_labels = []
+        value_decoders: List[Any] = []
         for i in value_positions:
             col = frame._columns[i]
             # NOTE: datetime device columns are excluded — NaT is the int64-min
@@ -3815,6 +3919,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             if col.is_device and col.pandas_dtype.kind in "biuf":
                 value_cols.append(col)
                 value_labels.append(frame.columns[i])
+                value_decoders.append(None)
                 continue
             if numeric_only:
                 from pandas.api.types import is_numeric_dtype
@@ -3822,6 +3927,21 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 if is_numeric_dtype(col.pandas_dtype):
                     return None  # numeric but not device-computable: fall back
                 continue  # genuinely non-numeric: pandas would drop it too
+            if (
+                not col.is_device
+                and agg_func in _DICT_VALUE_AGGS
+                and not isinstance(col.pandas_dtype, pandas.CategoricalDtype)
+            ):
+                from modin_tpu.ops.dictionary import encode_host_column
+
+                enc = encode_host_column(col)
+                # empty categories = all-missing column; pandas' None-vs-nan
+                # quirks there stay with the fallback
+                if enc is not None and len(enc.categories):
+                    value_cols.append(enc.codes)
+                    value_labels.append(frame.columns[i])
+                    value_decoders.append((enc.categories, col.pandas_dtype))
+                    continue
             if agg_func == "size":
                 continue
             return None
@@ -3917,9 +4037,27 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 decoded_keys, names=key_labels
             )
 
-        new_cols = [
-            DeviceColumn(d, dt, length=n_groups) for d, dt in zip(datas, out_dtypes)
-        ]
+        new_cols: list = []
+        for j, (d, dt) in enumerate(zip(datas, out_dtypes)):
+            dec = (
+                value_decoders[j]
+                if agg_func != "size" and j < len(value_decoders)
+                else None
+            )
+            if dec is not None and agg_func in ("min", "max", "first", "last"):
+                # dict value column: the per-group result is a CODE — decode
+                # to labels (host, n_groups values) with the source dtype
+                cats, src_dtype = dec
+                import jax as _jax
+
+                decoded = decode_codes(
+                    np.asarray(_jax.device_get(d))[:n_groups], cats
+                )
+                if isinstance(src_dtype, pandas.StringDtype):
+                    decoded = pandas.array(decoded, dtype=src_dtype)
+                new_cols.append(HostColumn(decoded))
+            else:
+                new_cols.append(DeviceColumn(d, dt, length=n_groups))
         result_frame = TpuDataframe(
             new_cols, pandas.Index(value_labels), result_index, nrows=n_groups
         )

@@ -124,6 +124,11 @@ def decode_codes(code_values: np.ndarray, categories: np.ndarray) -> np.ndarray:
     """Host object array for (possibly NaN) float code values."""
     out = np.empty(len(code_values), dtype=object)
     codes = np.asarray(code_values, dtype=np.float64)
+    if len(categories) == 0:
+        # an all-missing column factorizes to empty categories; every code
+        # is NaN
+        out[:] = np.nan
+        return out
     nan_mask = np.isnan(codes)
     idx = np.where(nan_mask, 0, codes).astype(np.int64)
     out[:] = categories[idx]

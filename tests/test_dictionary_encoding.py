@@ -330,3 +330,109 @@ class TestIsinNoneVsNan:
         for vals in ([np.nan], [None], ["a", np.nan]):
             got = md["s"].isin(vals)
             df_equals(got, pdf["s"].isin(vals))
+
+
+class TestDictValueColumns:
+    """String VALUE columns in aggregations via codes: groupby
+    min/max/first/last/count/nunique, frame-level min/max/count, and
+    appearance-ordered Series.unique (r5 batch)."""
+
+    @pytest.fixture
+    def dfs(self):
+        rng = np.random.default_rng(23)
+        n = 900
+        vals = _CITIES[rng.integers(0, 4, n)].copy()
+        vals[rng.random(n) < 0.1] = np.nan
+        return create_test_dfs(
+            {"k": rng.integers(0, 7, n), "s": vals, "v": rng.normal(size=n)}
+        )
+
+    @pytest.mark.parametrize("agg", ["min", "max", "first", "last", "count", "nunique"])
+    def test_groupby_str_values(self, dfs, agg):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: getattr(md.groupby("k"), agg)())
+        df_equals(got, getattr(pdf.groupby("k"), agg)())
+
+    def test_groupby_str_key_and_values(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.groupby("s").first())
+        df_equals(got, pdf.groupby("s").first())
+
+    @pytest.mark.parametrize("op", ["min", "max", "count"])
+    def test_frame_reduce_mixed(self, dfs, op):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: getattr(md, op)())
+        df_equals(got, getattr(pdf, op)())
+
+    def test_frame_min_skipna_false_object_dtype(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df[["s", "v"]].min(skipna=False))
+        eval_general(md, pdf, lambda df: df[["s"]].min(skipna=False))
+
+    def test_sum_with_str_falls_back_correct(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df.sum())
+
+    def test_unique_appearance_order(self):
+        vals = np.array(
+            ["oslo", "tokyo", "lima", "oslo", np.nan, "cairo", "tokyo"],
+            dtype=object,
+        )
+        md, ps = pd.Series(vals), pandas.Series(vals)
+        got = assert_no_fallback(lambda: md.unique())
+        want = np.asarray(ps.unique(), dtype=object)
+        assert [str(x) for x in got] == [str(x) for x in want]
+
+
+class TestDictDuplicated:
+    @pytest.fixture
+    def dfs(self):
+        rng = np.random.default_rng(29)
+        n = 600
+        vals = _CITIES[rng.integers(0, 3, n)].copy()
+        vals[rng.random(n) < 0.08] = np.nan
+        return create_test_dfs(
+            {"s": vals, "k": rng.integers(0, 4, n), "v": rng.normal(size=n)}
+        )
+
+    @pytest.mark.parametrize("keep", ["first", "last", False])
+    def test_duplicated_str_keys(self, dfs, keep):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.duplicated(subset=["s", "k"], keep=keep))
+        df_equals(got, pdf.duplicated(subset=["s", "k"], keep=keep))
+
+    def test_drop_duplicates_str_subset(self, dfs):
+        md, pdf = dfs
+        got = assert_no_fallback(lambda: md.drop_duplicates(subset=["s"]))
+        df_equals(got, pdf.drop_duplicates(subset=["s"]))
+        eval_general(
+            md, pdf,
+            lambda df: df.drop_duplicates(subset="s", ignore_index=True),
+        )
+
+    def test_nan_keys_count_as_duplicates(self, dfs):
+        md, pdf = dfs
+        eval_general(md, pdf, lambda df: df.duplicated(subset="s"))
+
+
+class TestAllMissingAndNAEdges:
+    """r5 review: all-missing object columns (empty categories) and
+    NA-backed string dtypes through the dict value paths."""
+
+    def test_all_nan_object_column_reductions(self):
+        s = pandas.Series([np.nan] * 5, dtype=object)
+        md, pdf = pd.DataFrame({"s": s}), pandas.DataFrame({"s": s})
+        eval_general(md, pdf, lambda df: df.min())
+        eval_general(md, pdf, lambda df: df.count())
+        assert len(pd.Series(s).unique()) == len(pandas.Series(s).unique())
+
+    def test_all_nan_groupby_first(self):
+        data = {"k": [1, 1, 2], "s": pandas.Series([np.nan] * 3, dtype=object)}
+        md, pdf = pd.DataFrame(data), pandas.DataFrame(data)
+        eval_general(md, pdf, lambda df: df.groupby("k").first())
+
+    def test_string_na_unique_preserved(self):
+        ss = pandas.Series(["a", pandas.NA, "a"], dtype="string")
+        got = pd.Series(ss).unique()
+        want = np.asarray(pandas.Series(ss).unique(), dtype=object)
+        assert [repr(x) for x in got] == [repr(x) for x in want]
