@@ -921,7 +921,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
                         # empty categories = all-missing column; pandas'
                         # reduction quirks there (None vs nan) stay with it
                         if enc is not None and len(enc.categories):
-                            decoders[i] = enc.categories
+                            decoders[i] = enc
                             positions.append(i)
                             continue
                     return None
@@ -929,9 +929,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         if not positions:
             return None
         sel_cols = [
-            frame._columns[i]
-            if i not in decoders
-            else frame._columns[i]._dict_cache.codes
+            frame._columns[i] if i not in decoders else decoders[i].codes
             for i in positions
         ]
         labels = frame.columns[positions]
@@ -965,7 +963,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
             if pos in decoders and op in ("min", "max"):
                 from modin_tpu.ops.dictionary import decode_codes
 
-                v = decode_codes(np.asarray([v], np.float64), decoders[pos])[0]
+                v = decode_codes(
+                    np.asarray([v], np.float64), decoders[pos].categories
+                )[0]
             out_values.append(v)
         if decoders and op in ("min", "max"):
             # pandas dtype rules: a pure string-column frame keeps the string
@@ -1375,6 +1375,40 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return type(self)(
             TpuDataframe(cols, label_index, frame._index, nrows=len(frame))
         )
+
+    def _try_dt_component(self, name: str, args: tuple, kwargs: dict):
+        """Calendar components of a datetime64 Series as one device kernel
+        (ops/datetime_parts.py — branchless civil-date decomposition over
+        the int64 ticks; the reference extracts host-side via pandas tslib
+        per partition).  Naive datetimes only; tz-aware stay host."""
+        if args or kwargs:
+            return None
+        frame = self._modin_frame
+        col = frame.get_column(0) if frame.num_cols == 1 else None
+        if (
+            col is None
+            or not col.is_device
+            or col.pandas_dtype.kind != "M"
+            or not len(frame)
+        ):
+            return None
+        from modin_tpu.ops.datetime_parts import COMPONENT_NAMES, dt_component
+
+        if name not in COMPONENT_NAMES:
+            return None
+        unit = np.datetime_data(col.pandas_dtype)[0]
+        if unit not in ("s", "ms", "us", "ns"):
+            return None
+        frame.materialize_device()
+        data, out_dtype = dt_component(name, col.data, unit, len(frame))
+        result_col = DeviceColumn(data, out_dtype, length=len(frame))
+        qc = type(self)(
+            TpuDataframe(
+                [result_col], frame._col_labels, frame._index, nrows=len(frame)
+            )
+        )
+        qc._shape_hint = "column"
+        return qc
 
     def _try_str_lut(self, name: str, args: tuple, kwargs: dict):
         """String predicates/measures through the dictionary encoding: the
@@ -4406,6 +4440,30 @@ def _make_str_lut_override(name: str):
 for _op in _STR_LUT_METHODS:
     if getattr(BaseQueryCompiler, f"str_{_op}", None) is not None:
         setattr(TpuQueryCompiler, f"str_{_op}", _make_str_lut_override(_op))
+
+
+def _make_dt_component_override(name: str):
+    base = getattr(BaseQueryCompiler, f"dt_{name}")
+
+    def method(self: TpuQueryCompiler, *args: Any, **kwargs: Any):
+        result = self._try_dt_component(name, args, kwargs)
+        if result is not None:
+            return result
+        return base(self, *args, **kwargs)
+
+    method.__name__ = f"dt_{name}"
+    return method
+
+
+from modin_tpu.ops.datetime_parts import (  # noqa: E402
+    COMPONENT_NAMES as _DT_COMPONENTS,
+)
+
+for _op in _DT_COMPONENTS:
+    if getattr(BaseQueryCompiler, f"dt_{_op}", None) is not None:
+        setattr(
+            TpuQueryCompiler, f"dt_{_op}", _make_dt_component_override(_op)
+        )
 
 # the generated overrides above were installed after __init_subclass__ ran,
 # so they need the backend-caster wrap applied explicitly

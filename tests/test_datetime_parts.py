@@ -1,0 +1,101 @@
+"""Device datetime component extraction (ops/datetime_parts.py): the full
+``.dt`` calendar-component surface differential vs pandas, with NaT
+upcasting (int32 -> float64) and predicate (bool, NaT=False) semantics.
+
+Reference extracts these host-side through pandas tslib per partition
+(DateTimeDefault); here it is one branchless integer kernel per column.
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from tests.utils import assert_no_fallback, df_equals
+
+_rng = np.random.default_rng(61)
+
+_COMPONENTS = [
+    "year", "month", "day", "hour", "minute", "second", "microsecond",
+    "nanosecond", "dayofweek", "weekday", "day_of_week", "dayofyear",
+    "day_of_year", "quarter", "daysinmonth", "days_in_month",
+    "is_leap_year", "is_month_start", "is_month_end", "is_quarter_start",
+    "is_quarter_end", "is_year_start", "is_year_end",
+]
+
+
+def _ts_series(n=600, nat_frac=0.0):
+    base = pandas.to_datetime("1970-01-01")
+    s = pandas.Series(
+        base
+        + pandas.to_timedelta(
+            _rng.integers(-3_000_000_000, 3_000_000_000, n), unit="s"
+        )
+    )
+    if nat_frac:
+        s = s.copy()
+        s[_rng.random(n) < nat_frac] = pandas.NaT
+    return s
+
+
+@pytest.mark.parametrize("name", _COMPONENTS)
+def test_component_clean(name):
+    s = _ts_series()
+    md = pd.Series(s)
+    got = assert_no_fallback(lambda: getattr(md.dt, name))
+    df_equals(got, getattr(s.dt, name))
+
+
+@pytest.mark.parametrize(
+    "name", ["year", "hour", "dayofweek", "quarter", "is_month_end", "is_leap_year"]
+)
+def test_component_with_nat(name):
+    s = _ts_series(nat_frac=0.07)
+    md = pd.Series(s)
+    got = assert_no_fallback(lambda: getattr(md.dt, name))
+    df_equals(got, getattr(s.dt, name))
+
+
+@pytest.mark.parametrize("unit", ["s", "ms", "us", "ns"])
+def test_units(unit):
+    s = pandas.Series(
+        pandas.to_datetime(
+            ["2021-03-05 13:45:12", "1950-11-30 00:00:01", "2000-02-29 23:59:59"]
+        ).as_unit(unit)
+    )
+    md = pd.Series(s)
+    for name in ("year", "second", "microsecond", "is_leap_year", "daysinmonth"):
+        df_equals(getattr(md.dt, name), getattr(s.dt, name))
+
+
+def test_century_boundaries():
+    # leap rules: 1900 (no), 2000 (yes), 2100 (no); era boundaries negative
+    s = pandas.Series(
+        pandas.to_datetime(
+            [
+                "1900-02-28", "1900-03-01", "2000-02-29", "2100-02-28",
+                "1899-12-31", "0099-01-01", "2400-02-29",
+            ],
+            format="mixed",
+        )
+    )
+    md = pd.Series(s)
+    for name in ("year", "month", "day", "dayofyear", "is_leap_year"):
+        df_equals(getattr(md.dt, name), getattr(s.dt, name))
+
+
+def test_tz_aware_falls_back_correct():
+    s = pandas.Series(
+        pandas.to_datetime(["2021-01-01 12:00", "2021-06-01 12:00"]).tz_localize(
+            "US/Eastern"
+        )
+    )
+    md = pd.Series(s)
+    df_equals(md.dt.hour, s.dt.hour)
+
+
+def test_methods_still_fall_back_correct():
+    s = _ts_series(n=40)
+    md = pd.Series(s)
+    df_equals(md.dt.normalize(), s.dt.normalize())
+    df_equals(md.dt.month_name(), s.dt.month_name())
