@@ -486,6 +486,62 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     # ------------------------------- binary --------------------------- #
 
+    def _try_dict_compare(self, op: str, other: str) -> Optional["TpuQueryCompiler"]:
+        """String-scalar comparisons on dictionary-encoded columns: sorted
+        categories turn every comparison into a CODE-threshold test (one
+        searchsorted on the tiny category array host-side, one device
+        compare).  pandas semantics verified: missing rows are False for
+        eq/lt/le/gt/ge and True for ne."""
+        import jax.numpy as jnp
+
+        from modin_tpu.ops.dictionary import encode_host_column
+
+        frame = self._modin_frame
+        datas = []
+        for c in frame._columns:
+            if c.is_device or isinstance(c.pandas_dtype, pandas.CategoricalDtype):
+                return None
+            if (
+                isinstance(c.pandas_dtype, pandas.StringDtype)
+                and c.pandas_dtype.na_value is pandas.NA
+            ):
+                # NA-backed 'string' comparisons yield a boolean EXTENSION
+                # dtype with NA propagation — keep the pandas fallback
+                return None
+            enc = encode_host_column(c)
+            if enc is None:
+                return None
+            try:
+                pos = int(np.searchsorted(enc.categories, other))
+            except TypeError:
+                return None
+            exact = bool(
+                pos < len(enc.categories) and enc.categories[pos] == other
+            )
+            codes = enc.codes.data
+            if op in ("eq", "ne"):
+                eqmask = (
+                    codes == float(pos)
+                    if exact
+                    else jnp.zeros(codes.shape, bool)
+                )
+                # NaN codes compare unequal -> ne True, matching pandas
+                out = eqmask if op == "eq" else ~eqmask
+            elif op == "lt":
+                out = codes < float(pos)
+            elif op == "le":
+                out = codes < float(pos + (1 if exact else 0))
+            elif op == "gt":
+                out = codes >= float(pos + (1 if exact else 0))
+            elif op == "ge":
+                out = codes >= float(pos)
+            else:
+                return None
+            datas.append(out)
+        return self._wrap_device_result(
+            datas, dtypes=[np.dtype(bool)] * len(datas)
+        )
+
     def _try_device_binary(self, op: str, other: Any, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         from modin_tpu.ops import elementwise
 
@@ -494,6 +550,10 @@ class TpuQueryCompiler(BaseQueryCompiler):
         frame = self._modin_frame
         if frame.num_cols == 0 or len(frame) == 0:
             return None
+        if op in self._CMP_OPS and isinstance(other, str):
+            result = self._try_dict_compare(op, other)
+            if result is not None:
+                return result
         cols = self._device_raw()
         if cols is None:
             return None
