@@ -1470,6 +1470,42 @@ class TpuQueryCompiler(BaseQueryCompiler):
         qc._shape_hint = "column"
         return qc
 
+    def _try_td_component(self, name: str, args: tuple, kwargs: dict):
+        """Timedelta fields (days/seconds/microseconds/nanoseconds,
+        total_seconds) over the int64 ticks — same design as
+        _try_dt_component for datetime columns."""
+        if args or kwargs:
+            return None
+        frame = self._modin_frame
+        col = frame.get_column(0) if frame.num_cols == 1 else None
+        if (
+            col is None
+            or not col.is_device
+            or col.pandas_dtype.kind != "m"
+            or not len(frame)
+        ):
+            return None
+        from modin_tpu.ops.datetime_parts import (
+            TIMEDELTA_COMPONENT_NAMES,
+            td_component,
+        )
+
+        if name not in TIMEDELTA_COMPONENT_NAMES:
+            return None
+        unit = np.datetime_data(col.pandas_dtype)[0]
+        if unit not in ("s", "ms", "us", "ns"):
+            return None
+        frame.materialize_device()
+        data, out_dtype = td_component(name, col.data, unit, len(frame))
+        result_col = DeviceColumn(data, out_dtype, length=len(frame))
+        qc = type(self)(
+            TpuDataframe(
+                [result_col], frame._col_labels, frame._index, nrows=len(frame)
+            )
+        )
+        qc._shape_hint = "column"
+        return qc
+
     def _try_str_lut(self, name: str, args: tuple, kwargs: dict):
         """String predicates/measures through the dictionary encoding: the
         pandas op runs once per CATEGORY (host, tiny), and the result lookup
@@ -4517,12 +4553,33 @@ def _make_dt_component_override(name: str):
 
 from modin_tpu.ops.datetime_parts import (  # noqa: E402
     COMPONENT_NAMES as _DT_COMPONENTS,
+    TIMEDELTA_COMPONENT_NAMES as _TD_COMPONENTS,
 )
 
 for _op in _DT_COMPONENTS:
     if getattr(BaseQueryCompiler, f"dt_{_op}", None) is not None:
         setattr(
             TpuQueryCompiler, f"dt_{_op}", _make_dt_component_override(_op)
+        )
+
+
+def _make_td_component_override(name: str):
+    base = getattr(BaseQueryCompiler, f"dt_{name}")
+
+    def method(self: TpuQueryCompiler, *args: Any, **kwargs: Any):
+        result = self._try_td_component(name, args, kwargs)
+        if result is not None:
+            return result
+        return base(self, *args, **kwargs)
+
+    method.__name__ = f"dt_{name}"
+    return method
+
+
+for _op in _TD_COMPONENTS:
+    if getattr(BaseQueryCompiler, f"dt_{_op}", None) is not None:
+        setattr(
+            TpuQueryCompiler, f"dt_{_op}", _make_td_component_override(_op)
         )
 
 # the generated overrides above were installed after __init_subclass__ ran,

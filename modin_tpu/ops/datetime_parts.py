@@ -51,6 +51,65 @@ _BOOL_COMPONENTS = frozenset(
     n for n in COMPONENT_NAMES if n.startswith("is_")
 )
 
+# timedelta64 columns: pandas Timedelta field semantics (days floors toward
+# -inf; seconds/microseconds/nanoseconds are the NON-NEGATIVE remainders)
+TIMEDELTA_COMPONENT_NAMES = (
+    "days", "seconds", "microseconds", "nanoseconds", "total_seconds",
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_td_component(name: str, unit: str, n: int, want_float: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    tps = _TPS[unit]
+    day_ticks = 86400 * tps
+
+    def fn(ticks):
+        valid = (jnp.arange(ticks.shape[0]) < n) & (ticks != _NAT)
+        t = jnp.where(valid, ticks, 0)
+        days = jnp.floor_divide(t, day_ticks)
+        rem = t - days * day_ticks  # [0, day_ticks)
+        if name == "days":
+            out = days
+        elif name == "seconds":
+            out = rem // tps
+        elif name == "microseconds":
+            out = ((rem % tps) * (10**9 // tps)) // 1000
+        elif name == "nanoseconds":
+            out = ((rem % tps) * (10**9 // tps)) % 1000
+        elif name == "total_seconds":
+            out = t.astype(jnp.float64) / tps
+        else:  # pragma: no cover - gated by TIMEDELTA_COMPONENT_NAMES
+            raise AssertionError(name)
+        has_nat = jnp.any((jnp.arange(ticks.shape[0]) < n) & (ticks == _NAT))
+        if name == "total_seconds" or want_float:
+            return (
+                jnp.where(valid, out.astype(jnp.float64), jnp.nan),
+                has_nat,
+            )
+        dtype = jnp.int64 if name == "days" else jnp.int32
+        return jnp.where(valid, out, 0).astype(dtype), has_nat
+
+    return jax.jit(fn)
+
+
+def td_component(name: str, ticks: Any, unit: str, n: int) -> Tuple[Any, Any]:
+    """(device result, out_dtype) for one timedelta field; int64 days /
+    int32 remainders upcast to float64+NaN exactly when NaT is present,
+    total_seconds is float64 always."""
+    import jax
+
+    if name == "total_seconds":
+        out, _ = _jit_td_component(name, unit, int(n))(ticks)
+        return out, np.dtype(np.float64)
+    out_i, has_nat = _jit_td_component(name, unit, int(n))(ticks)
+    if bool(jax.device_get(has_nat)):
+        out_f, _ = _jit_td_component(name, unit, int(n), want_float=True)(ticks)
+        return out_f, np.dtype(np.float64)
+    return out_i, np.dtype(np.int64 if name == "days" else np.int32)
+
 
 def is_bool_component(name: str) -> bool:
     return name in _BOOL_COMPONENTS

@@ -99,3 +99,41 @@ def test_methods_still_fall_back_correct():
     md = pd.Series(s)
     df_equals(md.dt.normalize(), s.dt.normalize())
     df_equals(md.dt.month_name(), s.dt.month_name())
+
+
+class TestTimedeltaComponents:
+    """Timedelta fields on device (ops/datetime_parts.td_component): days
+    floors toward -inf, remainders are non-negative, NaT upcasts, and
+    total_seconds is float64 always — pandas Timedelta field semantics."""
+
+    def _td(self, nat=False, n=500):
+        s = pandas.Series(
+            pandas.to_timedelta(
+                _rng.uniform(-1e6, 1e6, n).round(3), unit="s"
+            )
+        )
+        if nat:
+            s = s.copy()
+            s[_rng.random(n) < 0.05] = pandas.NaT
+        return s
+
+    @pytest.mark.parametrize("name", ["days", "seconds", "microseconds", "nanoseconds"])
+    @pytest.mark.parametrize("nat", [False, True])
+    def test_fields(self, name, nat):
+        s = self._td(nat=nat)
+        md = pd.Series(s)
+        got = assert_no_fallback(lambda: getattr(md.dt, name))
+        df_equals(got, getattr(s.dt, name))
+
+    @pytest.mark.parametrize("nat", [False, True])
+    def test_total_seconds(self, nat):
+        s = self._td(nat=nat)
+        md = pd.Series(s)
+        got = assert_no_fallback(lambda: md.dt.total_seconds())
+        df_equals(got, s.dt.total_seconds())
+
+    def test_negative_floor_semantics(self):
+        s = pandas.Series(pandas.to_timedelta([-3.25, -86400.5, 90061.5], unit="s"))
+        md = pd.Series(s)
+        for name in ("days", "seconds", "microseconds"):
+            df_equals(getattr(md.dt, name), getattr(s.dt, name))
