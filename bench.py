@@ -30,13 +30,21 @@ is the first round measuring the full reference op set, on int64, with
 flex add/mul/mod matching the reference's scalar form and cold groupby
 numbers.  Compare rounds per-op, not by aggregate.
 
-Prints ONE json line: {"metric", "value" (modin_tpu headline wall-sec),
-"unit", "vs_baseline" (pandas_sec / modin_tpu_sec, higher is better),
-"detail" (per-op + per-section), ...}.
+Output protocol (streaming; r06 reworked after round-5's rc=124-with-empty-
+output failure): one ``{"section": name, ...}`` json line is printed and
+flushed AS EACH SECTION COMPLETES, each section runs under its own
+``BENCH_SECTION_TIMEOUT_S`` wall-clock budget (SIGALRM; a section that
+overruns is reported as ``{"section": name, "error": "timeout..."}`` and the
+run continues), and the final line is the aggregate
+{"metric", "value" (modin_tpu headline wall-sec), "unit", "vs_baseline"
+(pandas_sec / modin_tpu_sec, higher is better), "detail", "sections", ...}.
+An outer kill can therefore truncate the tail but never erase completed
+sections.
 """
 
 import json
 import os
+import signal
 import sys
 import time
 
@@ -74,6 +82,66 @@ NGROUPS = 100
 REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
 # a single rep past this long is its own answer; don't repeat it
 SLOW_OP_S = float(os.environ.get("BENCH_SLOW_OP_S", 10.0))
+# wall-clock budget per section; 0 disables the alarm
+SECTION_TIMEOUT_S = float(os.environ.get("BENCH_SECTION_TIMEOUT_S", 1500.0))
+
+
+class SectionTimeout(BaseException):
+    """A benchmark section overran its wall-clock budget.
+
+    BaseException on purpose: section bodies contain broad ``except
+    Exception`` handlers (per-mode subprocess wrappers) that must not be
+    able to swallow the section's own alarm."""
+
+
+def _emit_line(payload: dict) -> None:
+    """One flushed json line — partial progress survives an outer kill."""
+    print(json.dumps(payload), flush=True)
+
+
+def run_section(name: str, fn, timeout_s: float = None):
+    """Run one section under a SIGALRM budget; stream its json line.
+
+    Returns the section's result dict, or None if it timed out / raised —
+    either way a ``{"section": name, ...}`` line has been printed and the
+    caller continues with the remaining sections (round-5's failure mode was
+    the inverse: one hung section killed the process with rc=124 and ZERO
+    output).
+    """
+    budget = SECTION_TIMEOUT_S if timeout_s is None else timeout_s
+    t0 = time.perf_counter()
+
+    def on_alarm(signum, frame):
+        raise SectionTimeout(name)
+
+    previous = None
+    if budget > 0:
+        previous = signal.signal(signal.SIGALRM, on_alarm)
+        signal.setitimer(signal.ITIMER_REAL, budget)
+    try:
+        result = fn()
+    except SectionTimeout:
+        _emit_line({
+            "section": name,
+            "error": f"timeout after {budget:g}s (BENCH_SECTION_TIMEOUT_S)",
+        })
+        return None
+    except Exception as exc:
+        _emit_line({
+            "section": name,
+            "error": f"{type(exc).__name__}: {exc}"[:300],
+        })
+        return None
+    finally:
+        if budget > 0:
+            signal.setitimer(signal.ITIMER_REAL, 0.0)
+            signal.signal(signal.SIGALRM, previous)
+    _emit_line({
+        "section": name,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+        **result,
+    })
+    return result
 
 
 AXIS0_OPS = [
@@ -326,95 +394,126 @@ def main() -> None:
 
     detail = {}
     sections = {}
+    frames = {}  # headline frames, shared with the ewm section
 
     # ---- axis0 (headline) + groupby, 1e8 x (5 + key) int64 ---- #
-    data = {f"c{i}": rng.integers(0, 100, ROWS) for i in range(COLS)}
-    data["key"] = rng.integers(0, NGROUPS, ROWS)
-    pdf = pandas.DataFrame(data)
-    mdf = pd.DataFrame(data)
-    mdf._query_compiler.execute()
-    del data
+    def headline_section():
+        data = {f"c{i}": rng.integers(0, 100, ROWS) for i in range(COLS)}
+        data["key"] = rng.integers(0, NGROUPS, ROWS)
+        pdf = pandas.DataFrame(data)
+        mdf = pd.DataFrame(data)
+        mdf._query_compiler.execute()
+        del data
+        frames["mdf"], frames["pdf"] = mdf, pdf
 
-    ax0_m, ax0_p = _section(mdf, pdf, AXIS0_OPS, repeats, detail)
+        ax0_m, ax0_p = _section(mdf, pdf, AXIS0_OPS, repeats, detail)
 
-    # groupby COLD: the factorize memo is cleared inside every timed rep, so
-    # the number includes the key factorization (r04's warm-only gb_size was
-    # a 0.8ms memo lookup billed as a 1e8-row kernel — VERDICT r4 weak #1)
-    gbc_m, gbc_p = _section(
-        mdf, pdf, GROUPBY_OPS, repeats, detail,
-        pre_rep=_clear_groupby_memo,
-    )
-    # groupby WARM (memo present): the product's steady-state behavior,
-    # reported under *_warm, excluded from the headline
-    warm_detail = {}
-    gbw_m, gbw_p = _section(
-        mdf, pdf, GROUPBY_OPS, repeats, warm_detail
-    )
-    for opname, _ in GROUPBY_OPS:
-        detail[opname + "_warm"] = warm_detail[opname]
+        # groupby COLD: the factorize memo is cleared inside every timed rep,
+        # so the number includes the key factorization (r04's warm-only
+        # gb_size was a 0.8ms memo lookup billed as a 1e8-row kernel —
+        # VERDICT r4 weak #1)
+        gbc_m, gbc_p = _section(
+            mdf, pdf, GROUPBY_OPS, repeats, detail,
+            pre_rep=_clear_groupby_memo,
+        )
+        # groupby WARM (memo present): the product's steady-state behavior,
+        # reported under *_warm, excluded from the headline
+        warm_detail = {}
+        gbw_m, gbw_p = _section(mdf, pdf, GROUPBY_OPS, repeats, warm_detail)
+        for opname, _ in GROUPBY_OPS:
+            detail[opname + "_warm"] = warm_detail[opname]
 
-    headline_m = ax0_m + gbc_m
-    headline_p = ax0_p + gbc_p
-    sections["headline_axis0_plus_groupby_cold"] = {
-        "modin_tpu_s": round(headline_m, 4),
-        "pandas_s": round(headline_p, 4),
-        "speedup": round(headline_p / max(headline_m, 1e-9), 2),
-    }
-    sections["groupby_warm"] = {
-        "modin_tpu_s": round(gbw_m, 4),
-        "pandas_s": round(gbw_p, 4),
-        "speedup": round(gbw_p / max(gbw_m, 1e-9), 2),
-    }
+        headline_m = ax0_m + gbc_m
+        headline_p = ax0_p + gbc_p
+        sections["headline_axis0_plus_groupby_cold"] = {
+            "modin_tpu_s": round(headline_m, 4),
+            "pandas_s": round(headline_p, 4),
+            "speedup": round(headline_p / max(headline_m, 1e-9), 2),
+        }
+        sections["groupby_warm"] = {
+            "modin_tpu_s": round(gbw_m, 4),
+            "pandas_s": round(gbw_p, 4),
+            "speedup": round(gbw_p / max(gbw_m, 1e-9), 2),
+        }
+        return sections["headline_axis0_plus_groupby_cold"]
+
+    run_section("headline_axis0_plus_groupby_cold", headline_section)
 
     # ---- ewm, same 1e8 frame, separate section ---- #
-    ewm_m, ewm_p = _section(mdf, pdf, EWM_OPS, repeats, detail)
-    sections["ewm"] = {
-        "modin_tpu_s": round(ewm_m, 4),
-        "pandas_s": round(ewm_p, 4),
-        "speedup": round(ewm_p / max(ewm_m, 1e-9), 2),
-    }
+    def ewm_section():
+        ewm_m, ewm_p = _section(
+            frames["mdf"], frames["pdf"], EWM_OPS, repeats, detail
+        )
+        sections["ewm"] = {
+            "modin_tpu_s": round(ewm_m, 4),
+            "pandas_s": round(ewm_p, 4),
+            "speedup": round(ewm_p / max(ewm_m, 1e-9), 2),
+        }
+        return sections["ewm"]
 
-    del mdf, pdf
+    if frames:
+        run_section("ewm", ewm_section)
+    else:
+        _emit_line({"section": "ewm", "error": "skipped: headline frames unavailable"})
+    frames.clear()
 
     # ---- axis1 at the reference's big shape (1e6 x 10 int) ---- #
-    data1 = {f"c{i}": rng.integers(0, 100, AXIS1_ROWS) for i in range(10)}
-    pdf1 = pandas.DataFrame(data1)
-    mdf1 = pd.DataFrame(data1)
-    mdf1._query_compiler.execute()
-    del data1
-    ax1_m, ax1_p = _section(mdf1, pdf1, AXIS1_OPS, repeats, detail)
-    sections["axis1"] = {
-        "modin_tpu_s": round(ax1_m, 4),
-        "pandas_s": round(ax1_p, 4),
-        "speedup": round(ax1_p / max(ax1_m, 1e-9), 2),
-    }
-    del mdf1, pdf1
+    def axis1_section():
+        data1 = {f"c{i}": rng.integers(0, 100, AXIS1_ROWS) for i in range(10)}
+        pdf1 = pandas.DataFrame(data1)
+        mdf1 = pd.DataFrame(data1)
+        mdf1._query_compiler.execute()
+        del data1
+        ax1_m, ax1_p = _section(mdf1, pdf1, AXIS1_OPS, repeats, detail)
+        sections["axis1"] = {
+            "modin_tpu_s": round(ax1_m, 4),
+            "pandas_s": round(ax1_p, 4),
+            "speedup": round(ax1_p / max(ax1_m, 1e-9), 2),
+        }
+        return sections["axis1"]
+
+    run_section("axis1", axis1_section)
 
     # ---- host UDF + structural at the reference's small shape ---- #
-    datau = {f"c{i}": rng.integers(0, 100, UDF_ROWS) for i in range(10)}
-    pdfu = pandas.DataFrame(datau)
-    mdfu = pd.DataFrame(datau)
-    mdfu._query_compiler.execute()
-    del datau
-    udf_m, udf_p = _section(mdfu, pdfu, UDF_OPS, repeats, detail)
-    sections["host_udf"] = {
-        "modin_tpu_s": round(udf_m, 4),
-        "pandas_s": round(udf_p, 4),
-        "speedup": round(udf_p / max(udf_m, 1e-9), 2),
-    }
-    del mdfu, pdfu
+    def host_udf_section():
+        datau = {f"c{i}": rng.integers(0, 100, UDF_ROWS) for i in range(10)}
+        pdfu = pandas.DataFrame(datau)
+        mdfu = pd.DataFrame(datau)
+        mdfu._query_compiler.execute()
+        del datau
+        udf_m, udf_p = _section(mdfu, pdfu, UDF_OPS, repeats, detail)
+        sections["host_udf"] = {
+            "modin_tpu_s": round(udf_m, 4),
+            "pandas_s": round(udf_p, 4),
+            "speedup": round(udf_p / max(udf_m, 1e-9), 2),
+        }
+        return sections["host_udf"]
+
+    run_section("host_udf", host_udf_section)
 
     # ---- groupby-apply: shuffle vs cliff on the virtual mesh ---- #
-    sections["shuffle_apply_virtual_mesh"] = _shuffle_apply_section()
+    def shuffle_apply() -> dict:
+        sections["shuffle_apply_virtual_mesh"] = _shuffle_apply_section()
+        return sections["shuffle_apply_virtual_mesh"]
 
+    # subprocess timeouts inside already bound this; the alarm is a backstop
+    run_section("shuffle_apply_virtual_mesh", shuffle_apply)
+
+    headline = sections.get("headline_axis0_plus_groupby_cold")
+    headline_m = headline["modin_tpu_s"] if headline else None
+    headline_p = headline["pandas_s"] if headline else None
     payload = {
         "metric": (
             "TimeArithmetic(axis0)+TimeGroupByDefaultAggregations(cold) "
             "wall-sec (1e8 rows int64)"
         ),
-        "value": round(headline_m, 4),
+        "value": round(headline_m, 4) if headline_m is not None else None,
         "unit": "seconds",
-        "vs_baseline": round(headline_p / max(headline_m, 1e-9), 2),
+        "vs_baseline": (
+            round(headline_p / max(headline_m, 1e-9), 2)
+            if headline_m is not None
+            else None
+        ),
         "detail": detail,
         "sections": sections,
         "rows": ROWS,
@@ -425,9 +524,13 @@ def main() -> None:
             "float64), groupby timed cold (memo cleared per rep; r01-r04 "
             "groupby numbers were warm), ewm/axis1/host_udf in separate "
             "sections outside the headline.  NOT directly comparable to "
-            "any earlier round's aggregate; compare per-op."
+            "any earlier round's aggregate; compare per-op.  r06: streamed "
+            "per-section json lines + per-section timeouts (this aggregate "
+            "line is LAST; a killed run keeps its completed sections)."
         ),
     }
+    if headline is None:
+        payload["error"] = "headline section failed or timed out; see section lines"
     if not on_tpu:
         payload["note"] = (
             "No TPU at bench time (platform above); these are CPU-substrate "

@@ -447,6 +447,99 @@ class CompilationCacheDir(EnvironmentVariable, type=ExactStr):
         return str(pathlib.Path.home() / ".cache" / "modin_tpu" / "jax_cache")
 
 
+class ResilienceMode(EnvironmentVariable, type=str):
+    """Fault-tolerant device execution (retry/backoff, per-path breakers).
+
+    Enable (default): device failures at the engine seam are classified
+    (DeviceOOM / DeviceLost / TransientDeviceError), transient ones retried
+    with backoff, and each ``_try_*`` device path is guarded by a circuit
+    breaker that degrades it to the pandas fallback when unhealthy.
+    Disable: raw runtime errors propagate exactly as before.
+    """
+
+    varname = "MODIN_TPU_RESILIENCE_MODE"
+    choices = ("Enable", "Disable")
+    default = "Enable"
+
+    @classmethod
+    def enable(cls):
+        cls.put("Enable")
+
+    @classmethod
+    def disable(cls):
+        cls.put("Disable")
+
+
+class ResilienceRetries(EnvironmentVariable, type=int):
+    """Max retries for a TransientDeviceError at the engine seam."""
+
+    varname = "MODIN_TPU_RESILIENCE_RETRIES"
+    default = 2
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(f"Resilience retries should be >= 0, passed value {value}")
+        super().put(value)
+
+
+class ResilienceBackoffS(EnvironmentVariable, type=float):
+    """Base of the exponential retry backoff, seconds (doubles per attempt)."""
+
+    varname = "MODIN_TPU_RESILIENCE_BACKOFF_S"
+    default = 0.05
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value < 0:
+            raise ValueError(f"Resilience backoff should be >= 0, passed value {value}")
+        super().put(value)
+
+
+class ResilienceWatchdogS(EnvironmentVariable, type=float):
+    """Wall-clock watchdog on materialize/wait, seconds (0 disables).
+
+    A device fetch that outlives the watchdog raises WatchdogTimeout (a
+    DeviceLost) instead of hanging the query on a wedged tunnel forever.
+    Off by default: every watched call costs one daemon-thread handoff.
+    """
+
+    varname = "MODIN_TPU_RESILIENCE_WATCHDOG_S"
+    default = 0.0
+
+
+class ResilienceBreakerThreshold(EnvironmentVariable, type=int):
+    """Consecutive strikes (failures or latency violations) that trip a
+    device-path circuit breaker open."""
+
+    varname = "MODIN_TPU_RESILIENCE_BREAKER_THRESHOLD"
+    default = 5
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Breaker threshold should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class ResilienceBreakerCooldownS(EnvironmentVariable, type=float):
+    """Seconds an open breaker waits before admitting a half-open probe."""
+
+    varname = "MODIN_TPU_RESILIENCE_BREAKER_COOLDOWN_S"
+    default = 30.0
+
+
+class ResilienceLatencyBudgetS(EnvironmentVariable, type=float):
+    """Per-call latency budget for guarded device paths, seconds (0 = no
+    budget).  A call that completes but overruns the budget strikes its
+    breaker: a pathologically slow kernel degrades like a failing one."""
+
+    varname = "MODIN_TPU_RESILIENCE_LATENCY_BUDGET_S"
+    default = 0.0
+
+
 class DocModule(EnvironmentVariable, type=ExactStr):
     """Alternate module to source API docstrings from (reference: envvars.py:1338)."""
 

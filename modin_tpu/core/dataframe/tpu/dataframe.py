@@ -236,13 +236,25 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
 
     @classmethod
     def from_pandas(cls, df: pandas.DataFrame) -> "TpuDataframe":
+        from modin_tpu.core.execution.resilience import DeviceFailure
+
         columns: List[Column] = []
         for i in range(df.shape[1]):
             series = df.iloc[:, i]
             dtype = series.dtype
             if isinstance(dtype, np.dtype) and _is_device_dtype(dtype):
                 values = series.to_numpy()
-                columns.append(DeviceColumn.from_numpy(values))
+                try:
+                    columns.append(DeviceColumn.from_numpy(values))
+                except DeviceFailure:
+                    # upload failed (device OOM / lost): keep the column on
+                    # host — every device path declines host columns and the
+                    # pandas defaults answer, so ingest degrades instead of
+                    # crashing (the engine seam already emitted the metric).
+                    # The raw ndarray, NOT series.array: a
+                    # NumpyExtensionArray's NumpyEADtype compares unequal to
+                    # the np.dtype every dispatch check expects.
+                    columns.append(HostColumn(values))
             else:
                 arr = series.array.copy()
                 if isinstance(arr, pandas.arrays.NumpyExtensionArray):

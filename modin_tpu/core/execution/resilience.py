@@ -1,0 +1,413 @@
+"""Fault-tolerant device execution: taxonomy, retry, and circuit breakers.
+
+The engine contract (``JaxWrapper.deploy/put/materialize/wait``,
+modin_tpu/parallel/engine.py) is the single seam between the framework and
+the accelerator runtime.  Everything that can go wrong on the other side of
+that seam — device OOM, a wedged TPU tunnel, a transient XLA runtime error —
+used to surface as a raw ``XlaRuntimeError`` that either crashed the query or
+was swallowed by a broad ``except Exception`` and misread as a semantic
+"not supported on device" fallback.  This module makes the failure mode a
+first-class, observable runtime decision (the design argued for by
+"Towards Scalable Dataframe Systems", arXiv:2001.00888, and the adaptive
+per-operator routing of Xorbits, arXiv:2401.00865):
+
+1. **Failure taxonomy** — ``classify_device_error`` maps low-level runtime
+   errors onto ``DeviceOOM`` (RESOURCE_EXHAUSTED), ``DeviceLost`` (tunnel /
+   device failure, including watchdog expiry), and ``TransientDeviceError``
+   (everything retryable).  These are *infrastructure* failures, disjoint
+   from the semantic fallback signals (``ShuffleSkewError``,
+   ``_TooManyGroups``, ``ModinAssumptionError``) which mean "the optimized
+   path does not apply", not "the device is unhealthy".
+
+2. **Bounded retry with exponential backoff** — ``engine_call`` wraps every
+   engine-seam invocation; transient errors are retried up to
+   ``ResilienceRetries`` times with ``ResilienceBackoffS`` exponential
+   backoff.  ``materialize``/``wait`` additionally run under a wall-clock
+   watchdog (``ResilienceWatchdogS``): a fetch that outlives it raises
+   ``WatchdogTimeout`` (a ``DeviceLost``) instead of hanging the query
+   forever on a dead tunnel.
+
+3. **Per-device-path circuit breaker** — every ``_try_*`` family in the
+   TPU query compiler is wrapped by ``device_path(family)``.  Each family
+   owns a named breaker that counts device failures and latency-budget
+   violations; after ``ResilienceBreakerThreshold`` consecutive strikes the
+   breaker trips OPEN and the family short-circuits to the pandas fallback
+   without touching the device.  After ``ResilienceBreakerCooldownS`` it
+   lets one HALF_OPEN probe through; a clean probe closes the breaker, a
+   failed probe re-opens it.  A wedged tunnel or pathologically slow kernel
+   therefore degrades the *path*, never the *answer*.
+
+All state transitions, retries, and fallbacks are published through
+``emit_metric`` (modin_tpu/logging/metrics.py) as
+``modin_tpu.resilience.*`` counters.  The deterministic fault-injection
+harness lives in modin_tpu/testing/faults.py; knobs are the
+``MODIN_TPU_RESILIENCE_*`` parameters in modin_tpu/config/envvars.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from modin_tpu.logging.metrics import emit_metric
+
+# test seams: the suite patches these to run breaker-cooldown / backoff
+# scenarios without wall-clock sleeps
+_now = time.monotonic
+_sleep = time.sleep
+
+# fault-injection seam: modin_tpu.testing.faults installs a callable here;
+# it runs inside every engine-seam attempt (under the watchdog, before the
+# real work) so injected faults traverse the same classify/retry/breaker
+# machinery a real device failure would
+_fault_hook: Optional[Callable[[str], None]] = None
+
+
+# ---------------------------------------------------------------------- #
+# 1. Failure taxonomy
+# ---------------------------------------------------------------------- #
+
+
+class DeviceFailure(RuntimeError):
+    """Base for classified infrastructure failures at the engine seam.
+
+    Disjoint from the semantic fallback signals (ShuffleSkewError,
+    _TooManyGroups, ModinAssumptionError): a DeviceFailure means the device
+    runtime misbehaved, not that the optimized path declined the inputs.
+    """
+
+    kind = "device_failure"
+
+
+class DeviceOOM(DeviceFailure):
+    """Device memory exhausted (XLA RESOURCE_EXHAUSTED).  Not retried: the
+    same program over the same buffers will exhaust the same HBM."""
+
+    kind = "oom"
+
+
+class DeviceLost(DeviceFailure):
+    """The device or its transport is gone (tunnel drop, device reset).
+    Not retried: recovery needs the breaker cooldown, not a tight loop."""
+
+    kind = "device_lost"
+
+
+class WatchdogTimeout(DeviceLost):
+    """A materialize/wait outlived the configured wall-clock watchdog.
+    Treated as DeviceLost: a fetch that never returns is a dead transport."""
+
+    kind = "watchdog_timeout"
+
+
+class TransientDeviceError(DeviceFailure):
+    """A retryable runtime hiccup (DEADLINE_EXCEEDED, ABORTED, INTERNAL...)."""
+
+    kind = "transient"
+
+
+# message fragments -> classification, checked in order (first match wins).
+# XLA surfaces absl status codes in the message text; the tunnel transport
+# adds socket/connection wording of its own.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "OOM", "Out of memory")
+_LOST_MARKERS = (
+    "DEVICE_LOST",
+    "device lost",
+    "UNAVAILABLE",
+    "socket closed",
+    "connection reset",
+    "connection refused",
+    "tunnel",
+    "heartbeat",
+    "NOT_FOUND: device",
+)
+_RUNTIME_ERROR_TYPE_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
+
+
+def is_device_runtime_error(exc: BaseException) -> bool:
+    """True if ``exc`` is the accelerator runtime's error type (by name, so
+    the check works against any jaxlib version and the fault harness's
+    stand-in without importing either)."""
+    return any(
+        t.__name__ in _RUNTIME_ERROR_TYPE_NAMES for t in type(exc).__mro__
+    )
+
+
+def classify_device_error(exc: BaseException) -> Optional[DeviceFailure]:
+    """Map ``exc`` onto the taxonomy, or None if it is not a device failure.
+
+    None means the exception is the caller's problem (a semantic signal or a
+    genuine bug) and must propagate — classification never swallows it.
+    """
+    if isinstance(exc, DeviceFailure):
+        return exc
+    if not is_device_runtime_error(exc):
+        return None
+    msg = str(exc)
+    if any(m in msg for m in _OOM_MARKERS):
+        return DeviceOOM(msg)
+    if any(m in msg for m in _LOST_MARKERS):
+        return DeviceLost(msg)
+    # unknown runtime error: assume transient so it gets a bounded retry and
+    # then strikes the breaker rather than crashing the query
+    return TransientDeviceError(msg)
+
+
+# ---------------------------------------------------------------------- #
+# 2. Engine-seam wrapper: retry with backoff + watchdog
+# ---------------------------------------------------------------------- #
+
+
+def _run_with_watchdog(op: str, thunk: Callable[[], Any], timeout_s: float) -> Any:
+    """Run ``thunk`` bounded by ``timeout_s`` wall-clock seconds.
+
+    A daemon thread (NOT ThreadPoolExecutor: its atexit hook would join a
+    wedged worker and hang interpreter shutdown — same rationale as the
+    device probe in modin_tpu/utils/show_versions) does the blocking call;
+    expiry raises WatchdogTimeout and abandons the thread.
+    """
+    result_q: "queue.Queue" = queue.Queue()
+
+    def runner() -> None:
+        try:
+            result_q.put((True, thunk()))
+        except BaseException as err:  # noqa: BLE001 - relayed to caller
+            result_q.put((False, err))
+
+    thread = threading.Thread(
+        target=runner, daemon=True, name=f"modin-tpu-watchdog-{op}"
+    )
+    thread.start()
+    try:
+        ok, payload = result_q.get(timeout=timeout_s)
+    except queue.Empty:
+        emit_metric(f"resilience.watchdog.{op}.timeout", 1)
+        raise WatchdogTimeout(
+            f"{op} exceeded the {timeout_s:g}s resilience watchdog "
+            "(MODIN_TPU_RESILIENCE_WATCHDOG_S); treating the device path "
+            "as lost"
+        ) from None
+    if ok:
+        return payload
+    raise payload
+
+
+def engine_call(op: str, thunk: Callable[[], Any], watchdog: bool = False) -> Any:
+    """Run one engine-seam invocation under the resilience policy.
+
+    Transient failures retry up to ``ResilienceRetries`` times with
+    exponential backoff; OOM / device-lost raise immediately as their
+    classified type.  ``watchdog=True`` (materialize/wait — the blocking
+    fetches) additionally bounds each attempt by ``ResilienceWatchdogS``.
+    """
+    from modin_tpu.config import (
+        ResilienceBackoffS,
+        ResilienceMode,
+        ResilienceRetries,
+        ResilienceWatchdogS,
+    )
+
+    def attempt_once() -> Any:
+        hook = _fault_hook
+        if hook is not None:
+            hook(op)
+        return thunk()
+
+    if ResilienceMode.get() == "Disable":
+        return attempt_once()
+
+    timeout_s = float(ResilienceWatchdogS.get()) if watchdog else 0.0
+    retries = int(ResilienceRetries.get())
+    backoff_s = float(ResilienceBackoffS.get())
+    attempt = 0
+    while True:
+        try:
+            if timeout_s > 0:
+                return _run_with_watchdog(op, attempt_once, timeout_s)
+            return attempt_once()
+        except Exception as err:
+            failure = classify_device_error(err)
+            if failure is None:
+                raise
+            emit_metric(f"resilience.engine.{op}.{failure.kind}", 1)
+            if not isinstance(failure, TransientDeviceError) or attempt >= retries:
+                raise failure from err
+            attempt += 1
+            emit_metric(f"resilience.engine.{op}.retry", 1)
+            _sleep(backoff_s * (2 ** (attempt - 1)))
+
+
+# ---------------------------------------------------------------------- #
+# 3. Per-device-path circuit breaker
+# ---------------------------------------------------------------------- #
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    """Consecutive-strike breaker guarding one named device path.
+
+    CLOSED: calls flow; every device failure or latency-budget violation is
+    a strike, every clean call resets the count.  ``threshold`` strikes trip
+    it OPEN: calls short-circuit to the fallback for ``cooldown_s`` seconds.
+    Then one HALF_OPEN probe is admitted — success closes, failure re-opens
+    (with a fresh cooldown).  Thresholds are read from config at trip-check
+    time so tests and operators can retune a live process.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.state = CLOSED
+        self.strikes = 0
+        self.opened_at = 0.0
+        self._lock = threading.Lock()
+
+    # -- config ------------------------------------------------------- #
+
+    @staticmethod
+    def _threshold() -> int:
+        from modin_tpu.config import ResilienceBreakerThreshold
+
+        return int(ResilienceBreakerThreshold.get())
+
+    @staticmethod
+    def _cooldown_s() -> float:
+        from modin_tpu.config import ResilienceBreakerCooldownS
+
+        return float(ResilienceBreakerCooldownS.get())
+
+    def _transition(self, state: str) -> None:
+        self.state = state
+        emit_metric(f"resilience.breaker.{self.name}.{state}", 1)
+
+    # -- protocol ------------------------------------------------------ #
+
+    def allow(self) -> bool:
+        """May the guarded path run right now?"""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                if _now() - self.opened_at >= self._cooldown_s():
+                    self._transition(HALF_OPEN)
+                    return True
+                return False
+            # HALF_OPEN: one probe is already in flight this cooldown; hold
+            # further calls on the fallback until it reports
+            return False
+
+    def record_success(self, latency_s: float = 0.0) -> None:
+        from modin_tpu.config import ResilienceLatencyBudgetS
+
+        budget = float(ResilienceLatencyBudgetS.get())
+        if budget > 0 and latency_s > budget:
+            emit_metric(f"resilience.breaker.{self.name}.slow", 1)
+            self._strike()
+            return
+        with self._lock:
+            self.strikes = 0
+            if self.state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        self._strike()
+
+    def abort_probe(self) -> None:
+        """The in-flight HALF_OPEN probe ended without a health verdict
+        (an unclassified exception escaped).  Return to OPEN with a fresh
+        cooldown — staying HALF_OPEN would short-circuit the family forever,
+        since only a probe can leave that state."""
+        with self._lock:
+            if self.state == HALF_OPEN:
+                self.opened_at = _now()
+                self._transition(OPEN)
+
+    def _strike(self) -> None:
+        with self._lock:
+            self.strikes += 1
+            emit_metric(f"resilience.breaker.{self.name}.strike", 1)
+            if self.state == HALF_OPEN:
+                # failed probe: straight back to OPEN, fresh cooldown
+                self.opened_at = _now()
+                self._transition(OPEN)
+            elif self.state == CLOSED and self.strikes >= self._threshold():
+                self.opened_at = _now()
+                self._transition(OPEN)
+
+
+_BREAKERS: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def get_breaker(name: str) -> CircuitBreaker:
+    with _breakers_lock:
+        breaker = _BREAKERS.get(name)
+        if breaker is None:
+            breaker = _BREAKERS[name] = CircuitBreaker(name)
+        return breaker
+
+
+def breaker_snapshot() -> Dict[str, str]:
+    """{family: state} for introspection / debugging."""
+    with _breakers_lock:
+        return {name: b.state for name, b in _BREAKERS.items()}
+
+
+def reset_breakers() -> None:
+    """Forget all breaker state (tests; operator escape hatch)."""
+    with _breakers_lock:
+        _BREAKERS.clear()
+
+
+def device_path(family: str) -> Callable:
+    """Decorator for ``TpuQueryCompiler._try_*`` methods: per-family breaker.
+
+    The wrapped method keeps its contract — return a result, or None for
+    "use the pandas fallback".  The wrapper adds the infrastructure leg:
+
+    - breaker OPEN  -> return None immediately (short-circuit, no device
+      contact) and count it;
+    - a classified DeviceFailure raised anywhere inside the call -> strike
+      the breaker, count the fallback, return None (the caller's pandas
+      default produces the answer);
+    - anything unclassified (semantic signals handled inside the method,
+      genuine bugs) propagates untouched;
+    - a clean call reports its latency so budget violations strike too.
+    """
+
+    def decorator(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(self: Any, *args: Any, **kwargs: Any) -> Any:
+            from modin_tpu.config import ResilienceMode
+
+            if ResilienceMode.get() == "Disable":
+                return fn(self, *args, **kwargs)
+            breaker = get_breaker(family)
+            if not breaker.allow():
+                emit_metric(f"resilience.breaker.{family}.short_circuit", 1)
+                return None
+            start = _now()
+            try:
+                result = fn(self, *args, **kwargs)
+            except Exception as err:
+                failure = classify_device_error(err)
+                if failure is None:
+                    # not the device's fault — but if this call was the
+                    # HALF_OPEN probe, the breaker must not wait forever for
+                    # a verdict that will never come: re-open it so the next
+                    # cooldown admits a fresh probe
+                    breaker.abort_probe()
+                    raise
+                breaker.record_failure()
+                emit_metric(f"resilience.fallback.{family}.{failure.kind}", 1)
+                return None
+            breaker.record_success(_now() - start)
+            return result
+
+        wrapper._resilience_family = family
+        return wrapper
+
+    return decorator

@@ -21,6 +21,7 @@ strategy (SURVEY.md §7 stage 2).
 
 from __future__ import annotations
 
+import re
 from typing import Any, Hashable, List, Optional
 
 import numpy as np
@@ -33,6 +34,7 @@ from modin_tpu.core.dataframe.tpu.dataframe import (
     TpuDataframe,
 )
 from modin_tpu.core.dataframe.tpu.metadata import LazyIndex
+from modin_tpu.core.execution.resilience import device_path
 from modin_tpu.core.storage_formats.base.query_compiler import (
     BaseQueryCompiler,
     QCCoercionCost,
@@ -41,6 +43,9 @@ from modin_tpu.utils import MODIN_UNNAMED_SERIES_LABEL
 
 # below this, one host gather is cheaper than the shuffle + chunked fetches
 _SHUFFLE_APPLY_MIN_ROWS = 1 << 19
+
+
+from modin_tpu.parallel.engine import materialize as _engine_materialize
 
 
 class TpuQueryCompiler(BaseQueryCompiler):
@@ -391,7 +396,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 labels.extend(of.columns)
             try:
                 label_index = pandas.Index(labels)
-            except Exception:
+            except (TypeError, ValueError):
+                # mixed unorderable label types: pandas' own concat figures
+                # out the result index; device failures can't occur here
                 return super().concat(
                     axis, other, join=join, ignore_index=ignore_index,
                     sort=sort, **kwargs
@@ -542,6 +549,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             datas, dtypes=[np.dtype(bool)] * len(datas)
         )
 
+    @device_path("binary")
     def _try_device_binary(self, op: str, other: Any, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         from modin_tpu.ops import elementwise
 
@@ -940,6 +948,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
          "sem", "skew", "kurt", "any", "all"]
     )
 
+    @device_path("reduce")
     def _try_device_reduce(
         self, op: str, axis: Any, skipna: bool, numeric_only: bool, kwargs: dict
     ) -> Optional["TpuQueryCompiler"]:
@@ -1350,7 +1359,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 except gb_ops._TooManyGroups:
                     return super().unique(**kwargs)
                 first_dev = gb_ops.groupby_first_position(codes, n_groups)
-                first = np.asarray(jax.device_get(first_dev))[:n_groups]
+                first = np.asarray(_engine_materialize(first_dev))[:n_groups]
                 order = np.argsort(first, kind="stable")
                 values = decode_codes(
                     np.asarray(group_keys[0], np.float64)[order], enc.categories
@@ -1436,6 +1445,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             TpuDataframe(cols, label_index, frame._index, nrows=len(frame))
         )
 
+    @device_path("dt_component")
     def _try_dt_component(self, name: str, args: tuple, kwargs: dict):
         """Calendar components of a datetime64 Series as one device kernel
         (ops/datetime_parts.py — branchless civil-date decomposition over
@@ -1470,6 +1480,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         qc._shape_hint = "column"
         return qc
 
+    @device_path("dt_component")
     def _try_td_component(self, name: str, args: tuple, kwargs: dict):
         """Timedelta fields (days/seconds/microseconds/nanoseconds,
         total_seconds) over the int64 ticks — same design as
@@ -1506,6 +1517,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         qc._shape_hint = "column"
         return qc
 
+    @device_path("str_lut")
     def _try_str_lut(self, name: str, args: tuple, kwargs: dict):
         """String predicates/measures through the dictionary encoding: the
         pandas op runs once per CATEGORY (host, tiny), and the result lookup
@@ -1538,7 +1550,17 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 na_probe = getattr(
                     pandas.Series([np.nan], dtype=col.pandas_dtype).str, name
                 )(*args, **kwargs).iloc[0]
-        except Exception:
+        except (
+            TypeError,
+            ValueError,
+            AttributeError,
+            NotImplementedError,
+            KeyError,
+            re.error,
+        ):
+            # the semantic "pandas declined this str op / these kwargs"
+            # family only — a device failure during the later gather must
+            # reach the resilience layer, not read as a silent fallback
             return None
         if (
             not isinstance(lut_ser, pandas.Series)
@@ -1684,7 +1706,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
                     valid = jnp.arange(x.shape[0]) < len(frame)
                     fully = all_int and bool(
-                        _jax.device_get(jnp.all(hit | ~valid))
+                        _engine_materialize(jnp.all(hit | ~valid))
                     )
             if data is not None:
                 if all_bool and not fully:
@@ -1851,6 +1873,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             interpolation=interpolation, method=method, **kwargs,
         )
 
+    @device_path("top_k")
     def _try_device_top_k(self, n: int, column_pos: int, largest: bool, keep: str):
         from modin_tpu.ops.sort import top_k_positions
 
@@ -2151,6 +2174,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             )
         return super().isin(values, ignore_indices=ignore_indices, **kwargs)
 
+    @device_path("corr_cov")
     def _try_device_corr_cov(
         self, method: str, min_periods: int, ddof: int, numeric_only: bool
     ) -> Optional["TpuQueryCompiler"]:
@@ -2246,6 +2270,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     # ---------------------------- shift/diff --------------------------- #
 
+    @device_path("shift")
     def _try_shift_like(self, kernel, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         periods = kwargs.get("periods", 1)
         if (
@@ -2394,7 +2419,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             first_dev = gb_ops.groupby_first_position(codes, n_groups)
             counts, first_pos = (
                 np.asarray(v)
-                for v in jax.device_get((counts_dev, first_dev))
+                for v in _engine_materialize((counts_dev, first_dev))
             )
             counts = counts[:n_groups]
             if decoder is not None:
@@ -2434,6 +2459,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return result
         return super().merge(right, **kwargs)
 
+    @device_path("merge")
     def _try_device_merge(self, right: Any, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         from modin_tpu.ops.join import (
             composite_key_codes,
@@ -2631,7 +2657,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             key_ = (id(arr), count)
             if key_ not in _pos_fetch_cache:
                 _pos_fetch_cache[key_] = np.asarray(
-                    _jax.device_get(arr)
+                    _engine_materialize(arr)
                 )[:count].astype(np.int64)
             return _pos_fetch_cache[key_]
 
@@ -2651,7 +2677,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 return arr
             try:
                 return pandas.array(arr, dtype=dtype)
-            except Exception:
+            except (TypeError, ValueError):
+                # join-introduced NaNs a strict extension dtype rejects:
+                # keep the object array, matching pandas' merge upcasting
                 return arr
 
         l_dev_positions = [
@@ -2848,7 +2876,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
                     )
                 else:
                     if perm_h is None:
-                        perm_h = np.asarray(_jax.device_get(perm))[:n_total]
+                        perm_h = np.asarray(_engine_materialize(perm))[:n_total]
                     resorted.append(HostColumn(c.data[perm_h]))
             final_cols = resorted
 
@@ -2862,6 +2890,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     # ----------------------------- rolling ---------------------------- #
 
+    @device_path("rolling")
     def _try_device_rolling(self, op: str, rolling_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         from modin_tpu.ops.window import rolling_reduce
 
@@ -2954,6 +2983,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             a = float(alpha)
         return a, bool(adjust), bool(ignore_na), int(min_periods)
 
+    @device_path("ewm")
     def _try_device_ewm(self, op: str, ewm_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         """Exponentially weighted windows as associative linear-recurrence
         scans (ops/window.py ewm_reduce).  Reference surface:
@@ -2990,6 +3020,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
         return self._wrap_device_result(datas)
 
+    @device_path("ewm")
     def _try_device_ewm_pair(
         self, op: str, ewm_kwargs: dict, kwargs: dict
     ) -> Optional["TpuQueryCompiler"]:
@@ -3088,6 +3119,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return result
         return super().ewm_corr(ewm_kwargs, *args, **kwargs)
 
+    @device_path("resample")
     def _try_device_resample(self, op: str, resample_kwargs: dict, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         """Fixed-frequency resample as time-bucket codes + segment aggregation.
 
@@ -3141,7 +3173,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 offset=resample_kwargs.get("offset"),
             )
             _binner, bins, bin_labels = grouper._get_time_bins(index)
-        except Exception:
+        except (TypeError, ValueError):
+            # rules/kwargs pandas' binner rejects (host-only work: device
+            # failures can't occur inside _get_time_bins)
             return None
         n_groups = len(bin_labels)
         if n_groups == 0 or n_groups > (1 << 24):
@@ -3216,6 +3250,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             qc._shape_hint = "column"
         return qc
 
+    @device_path("expanding")
     def _try_device_expanding(self, op: str, expanding_args: list, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         from modin_tpu.ops.window import expanding_reduce
 
@@ -3305,6 +3340,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             series_groupby=series_groupby, selection=selection,
         )
 
+    @device_path("groupby")
     def _try_device_groupby_describe(
         self, by, groupby_kwargs, agg_kwargs, drop, selection=None
     ) -> Optional["TpuQueryCompiler"]:
@@ -3366,6 +3402,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
         return type(self)(result_frame)
 
+    @device_path("shuffle_apply")
     def _try_shuffle_groupby_apply(
         self, by, agg_func, groupby_kwargs, agg_args, agg_kwargs, selection
     ) -> Optional["TpuQueryCompiler"]:
@@ -3475,7 +3512,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         # dropna=True gives NaN-key rows overflow codes; they must not reach
         # the chunks (an all-dropped chunk yields an empty apply result that
         # poisons the concat's index metadata)
-        n_overflow = int(jax.device_get(jnp.sum(codes[: n] >= n_groups)))
+        n_overflow = int(_engine_materialize(jnp.sum(codes[: n] >= n_groups)))
         if n_overflow:
             shuffled_codes = np.asarray(key_out)[:n]
             keep = shuffled_codes < n_groups
@@ -3579,7 +3616,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             import jax
 
             first_pos = np.asarray(
-                jax.device_get(
+                _engine_materialize(
                     jnp.full(n_groups, n, jnp.int64)
                     .at[jnp.where(iota < n, codes, n_groups)]
                     .min(jnp.minimum(iota, n), mode="drop")
@@ -3659,6 +3696,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             series_groupby=series_groupby, selection=selection,
         )
 
+    @device_path("groupby")
     def _try_device_groupby_transform(
         self, by, agg_func, groupby_kwargs, drop, series_groupby, selection
     ) -> Optional["TpuQueryCompiler"]:
@@ -3769,6 +3807,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         return value_positions, codes, n_groups, sizes
 
+    @device_path("groupby")
     def _try_device_groupby_cum(
         self, op, by, groupby_kwargs, drop, series_groupby, selection
     ) -> Optional["TpuQueryCompiler"]:
@@ -3812,6 +3851,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             qc._shape_hint = "column"
         return qc
 
+    @device_path("groupby")
     def _try_device_groupby_multi(
         self, by, agg_func, axis, groupby_kwargs, agg_args, agg_kwargs, drop,
         series_groupby, selection,
@@ -3890,6 +3930,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return type(self)(result_frame)
         return None
 
+    @device_path("groupby")
     def _try_device_groupby(
         self, by, agg_func, axis, groupby_kwargs, agg_args, agg_kwargs, drop,
         series_groupby, selection,
@@ -4181,7 +4222,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 import jax as _jax
 
                 decoded = decode_codes(
-                    np.asarray(_jax.device_get(d))[:n_groups], cats
+                    np.asarray(_engine_materialize(d))[:n_groups], cats
                 )
                 if isinstance(src_dtype, pandas.StringDtype):
                     decoded = pandas.array(decoded, dtype=src_dtype)
@@ -4201,6 +4242,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     # ------------------------------- sort ----------------------------- #
 
+    @device_path("sort_shuffle")
     def _try_range_partition_sort(self, columns: Any, ascending: Any, kwargs: dict) -> Optional["TpuQueryCompiler"]:
         """Explicit sample->pivots->all_to_all shuffle sort (RangePartitioning).
 

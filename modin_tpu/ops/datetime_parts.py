@@ -58,6 +58,9 @@ TIMEDELTA_COMPONENT_NAMES = (
 )
 
 
+from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_td_component(name: str, unit: str, n: int, want_float: bool = False):
     import jax
@@ -105,7 +108,7 @@ def td_component(name: str, ticks: Any, unit: str, n: int) -> Tuple[Any, Any]:
         out, _ = _jit_td_component(name, unit, int(n))(ticks)
         return out, np.dtype(np.float64)
     out_i, has_nat = _jit_td_component(name, unit, int(n))(ticks)
-    if bool(jax.device_get(has_nat)):
+    if bool(_engine_materialize(has_nat)):
         out_f, _ = _jit_td_component(name, unit, int(n), want_float=True)(ticks)
         return out_f, np.dtype(np.float64)
     return out_i, np.dtype(np.int64 if name == "days" else np.int32)
@@ -219,7 +222,7 @@ def dt_component(name: str, ticks: Any, unit: str, n: int) -> Tuple[Any, Any]:
     # the clean (no-NaT) path runs ONE int32 kernel; only a NaT column pays
     # for the float64 variant (pandas upcasts exactly then)
     out_i, has_nat = fn(ticks)
-    if bool(jax.device_get(has_nat)):
+    if bool(_engine_materialize(has_nat)):
         out_f, _ = _jit_component(name, unit, int(n), want_float=True)(ticks)
         return out_f, np.dtype(np.float64)
     return out_i, np.dtype(np.int32)

@@ -39,6 +39,9 @@ ORDER_AGGS = {"median", "quantile", "nunique", "first", "last"}
 _RANGE_LIMIT = 1 << 22  # max direct-range width before falling back to unique
 
 
+from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+
 class _TooManyGroups(Exception):
     pass
 
@@ -211,11 +214,11 @@ def factorize_keys(
         kdt = k.dtype
         if jnp.issubdtype(kdt, jnp.integer) or kdt == jnp.bool_:
             k64 = k.astype(jnp.int64)
-            kmin, kmax = (int(v) for v in jax.device_get(_jit_key_minmax(n)(k64)))
+            kmin, kmax = (int(v) for v in _engine_materialize(_jit_key_minmax(n)(k64)))
             width = kmax - kmin + 1
             if width <= _RANGE_LIMIT:
                 ids = _jit_range_ids(n, width)(k64, jnp.int64(kmin))
-                counts = np.asarray(jax.device_get(_count_ids(ids, width)))
+                counts = np.asarray(_engine_materialize(_count_ids(ids, width)))
                 present = np.nonzero(counts)[0]
                 remap = np.full(width, len(present), dtype=np.int64)
                 remap[present] = np.arange(len(present))
@@ -233,13 +236,13 @@ def factorize_keys(
             uniques, codes = jnp.unique(k_prepped, return_inverse=True)
             n_groups = int(uniques.shape[0])
             codes = _jit_mask_codes(n, n_groups)(codes)
-            uniques_host = np.asarray(jax.device_get(uniques)).astype(np.dtype(str(kdt)))
+            uniques_host = np.asarray(_engine_materialize(uniques)).astype(np.dtype(str(kdt)))
             return codes, n_groups, [uniques_host], None
         if jnp.issubdtype(kdt, jnp.floating):
             k_prepped, has_nan = _jit_float_prep(n)(k)
             has_nan = bool(has_nan)
             uniques, codes = jnp.unique(k_prepped, return_inverse=True)
-            uniques_host = np.asarray(jax.device_get(uniques))
+            uniques_host = np.asarray(_engine_materialize(uniques))
             n_valid = int(np.sum(~np.isnan(uniques_host)))
             # jnp.unique sorts NaN last; every NaN row (and pad) got a code
             # >= n_valid — clamp them to one bucket
@@ -266,7 +269,7 @@ def factorize_keys(
     if total > _RANGE_LIMIT * 4:
         raise _TooManyGroups()
     composite = _jit_composite(tuple(n_groups_each), n, total)(tuple(level_codes))
-    counts = np.asarray(jax.device_get(_jit_bincount(total)(composite)))
+    counts = np.asarray(_engine_materialize(_jit_bincount(total)(composite)))
     present = np.nonzero(counts)[0]
     remap = np.full(total + 1, len(present), dtype=np.int64)
     remap[present] = np.arange(len(present))

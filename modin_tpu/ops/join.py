@@ -29,6 +29,9 @@ import numpy as np
 from modin_tpu.ops.structural import float_total_order as _total_order
 
 
+from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_composite_codes(n_levels: int, float_flags: Tuple[bool, ...]):
     """Fold multi-column join keys into one int64 code per side.
@@ -169,7 +172,7 @@ def sort_merge_positions(
         int(n_left), int(n_right)
     )(left_key, right_key)
     inner_count, left_count = (
-        int(v) for v in jax.device_get((total_inner, total_left))
+        int(v) for v in _engine_materialize((total_inner, total_left))
     )
     n_out = left_count if how == "left" else inner_count
     # a left-join miss exists iff some left row matched nothing
@@ -215,7 +218,7 @@ def right_only_positions(right_pos, p_right: int, n_right: int, n_out: int):
     import jax
 
     order, m = _jit_right_only(int(p_right), int(n_right), int(n_out))(right_pos)
-    return order, int(jax.device_get(m))
+    return order, int(_engine_materialize(m))
 
 
 @functools.lru_cache(maxsize=None)

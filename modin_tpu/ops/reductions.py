@@ -20,6 +20,9 @@ from typing import Any, List, Tuple
 import numpy as np
 
 
+from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+
 def _masked(c, n, neutral):
     import jax.numpy as jnp
 
@@ -265,8 +268,9 @@ def _reduce_adaptive_sharded(op: str, c, n: int):
     """
     import jax.lax as lax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from modin_tpu.parallel.jax_compat import shard_map
 
     from modin_tpu.parallel.mesh import get_mesh
 
@@ -397,7 +401,7 @@ def reduce_columns(
         tail_key=("reduce", op_name, n, skipna, ddof, bool(cast_bool), n_shards),
         tail_builder=tail,
     )
-    return [np.asarray(r) for r in jax.device_get(results)]
+    return [np.asarray(r) for r in _engine_materialize(results)]
 
 
 @functools.lru_cache(maxsize=None)
@@ -505,7 +509,7 @@ def idx_minmax(op_name: str, cols: List[Any], n: int, skipna: bool = True):
     import jax
 
     positions, counts = _jit_idx_minmax(op_name, len(cols), int(n))(tuple(cols))
-    fetched = jax.device_get((positions, counts))
+    fetched = _engine_materialize((positions, counts))
     return [int(r) for r in fetched[0]], [int(c) for c in fetched[1]]
 
 
@@ -567,7 +571,7 @@ def nunique_columns(cols: List[Any], n: int, dropna: bool = True) -> list:
     import jax
 
     fn = _jit_nunique(len(cols), int(n), bool(dropna))
-    return [int(v) for v in jax.device_get(fn(tuple(cols)))]
+    return [int(v) for v in _engine_materialize(fn(tuple(cols)))]
 
 
 @functools.lru_cache(maxsize=None)
@@ -628,7 +632,7 @@ def quantile_columns(
 
     fn = _jit_quantile(len(cols), int(n), len(qs), str(interpolation))
     results = fn(tuple(cols), jnp.asarray(qs, jnp.float64))
-    return [np.asarray(r) for r in jax.device_get(results)]
+    return [np.asarray(r) for r in _engine_materialize(results)]
 
 
 @functools.lru_cache(maxsize=None)
@@ -681,7 +685,7 @@ def mode_columns(cols: List[Any], n: int, k_bound: int = 1024) -> list:
     import jax
 
     fn = _jit_mode(len(cols), int(n), int(k_bound))
-    fetched = jax.device_get(fn(tuple(cols)))
+    fetched = _engine_materialize(fn(tuple(cols)))
     out = []
     for vals, m in fetched:
         m = int(m)
@@ -794,5 +798,5 @@ def mode_axis1(cols: List[Any], n: int) -> Tuple[Any, Any, int, bool]:
     vals, vals_f, m_max, uniform = _jit_mode_axis1(len(cols), int(n))(
         tuple(cols)
     )
-    m_max, uniform = jax.device_get((m_max, uniform))
+    m_max, uniform = _engine_materialize((m_max, uniform))
     return vals, vals_f, int(m_max), bool(uniform)

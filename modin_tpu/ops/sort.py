@@ -19,6 +19,9 @@ from typing import Any, List, Tuple
 import numpy as np
 
 
+from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+
 def _pad_sentinel(dtype, ascending: bool):
     import jax.numpy as jnp
 
@@ -156,7 +159,7 @@ def top_k_positions(col, n: int, k: int, largest: bool):
     fn = _jit_top_k(
         int(n), k, bool(largest), bool(is_float), bool(is_int64), bool(is_signed)
     )
-    positions, nan_positions, n_valid = jax.device_get(fn(col))
+    positions, nan_positions, n_valid = _engine_materialize(fn(col))
     n_valid = int(n_valid)
     if k <= n_valid:
         return np.asarray(positions[:k], np.int64), n_valid

@@ -24,6 +24,9 @@ from typing import Any, List, Tuple
 import numpy as np
 
 
+from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_corr_cov(
     method: str, n_cols: int, n: int, ddof: int, min_periods: int
@@ -76,5 +79,5 @@ def corr_cov_matrix(
 
     fn = _jit_corr_cov(method, len(cols), int(n), int(ddof), int(min_periods))
     out, counts = fn(tuple(cols))
-    out_h, counts_h = jax.device_get((out, counts))
+    out_h, counts_h = _engine_materialize((out, counts))
     return np.asarray(out_h), np.asarray(counts_h)

@@ -4,6 +4,11 @@
 (``types``, ``indexers``, ``typing``, ``executors``) pass through unchanged.
 """
 
-from pandas.api import executors, indexers, types, typing  # noqa: F401
+from pandas.api import indexers, types, typing  # noqa: F401
+
+try:  # pandas >= 3 only; older hosts simply lack the namespace
+    from pandas.api import executors  # noqa: F401
+except ImportError:  # pragma: no cover - depends on host pandas
+    executors = None
 
 from modin_tpu.pandas.api import extensions, interchange  # noqa: F401

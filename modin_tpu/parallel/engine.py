@@ -14,6 +14,12 @@ equivalents (SURVEY.md §5 "Distributed communication backend"):
 Collectives (psum/all_gather/ppermute/all_to_all over ICI) are emitted by XLA
 from sharded jnp programs; the shuffle subsystem uses them explicitly via
 shard_map (modin_tpu/parallel/shuffle.py).
+
+Every method runs under the resilience policy
+(modin_tpu/core/execution/resilience.py): raw runtime errors are classified
+into the DeviceOOM / DeviceLost / TransientDeviceError taxonomy, transient
+ones retry with exponential backoff, and the blocking fetches
+(materialize/wait) are bounded by the configurable wall-clock watchdog.
 """
 
 from __future__ import annotations
@@ -22,6 +28,7 @@ import functools
 from typing import Any, Callable, Iterable, Optional
 
 from modin_tpu.config import BenchmarkMode, DeviceCount
+from modin_tpu.core.execution.resilience import engine_call
 from modin_tpu.logging import ClassLogger
 
 
@@ -64,7 +71,7 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
     def deploy(cls, func: Callable, f_args: tuple = (), f_kwargs: Optional[dict] = None, num_returns: int = 1) -> Any:
         """Run ``func`` (usually jit-compiled); returns device buffers (futures:
         jax arrays are async until materialized)."""
-        result = func(*f_args, **(f_kwargs or {}))
+        result = engine_call("deploy", lambda: func(*f_args, **(f_kwargs or {})))
         if BenchmarkMode.get():
             cls.wait(result)
         return result
@@ -78,14 +85,16 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
             from modin_tpu.parallel.mesh import row_sharding
 
             sharding = row_sharding()
-        return jax.device_put(data, sharding)
+        return engine_call("put", lambda: jax.device_put(data, sharding))
 
     @classmethod
     def materialize(cls, obj_refs: Any) -> Any:
         """Device -> host (blocks until the value is computed and fetched)."""
         import jax
 
-        return jax.device_get(obj_refs)
+        return engine_call(
+            "materialize", lambda: jax.device_get(obj_refs), watchdog=True
+        )
 
     @classmethod
     def wait(cls, obj_refs: Any) -> None:
@@ -96,10 +105,20 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
         """
         import jax
 
-        jax.block_until_ready(obj_refs)
+        engine_call("wait", lambda: jax.block_until_ready(obj_refs), watchdog=True)
 
     @classmethod
     def is_future(cls, item: Any) -> bool:
         import jax
 
         return isinstance(item, jax.Array)
+
+
+def materialize(obj_refs: Any) -> Any:
+    """Engine-seam host fetch as a free function.
+
+    Kernel modules fetch scalars/counts through this instead of raw
+    ``jax.device_get`` so every host sync traverses the resilience policy
+    (classification, retry, watchdog) exactly once, defined in one place.
+    """
+    return JaxWrapper.materialize(obj_refs)

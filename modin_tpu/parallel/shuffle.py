@@ -31,6 +31,9 @@ from typing import Any, List, Tuple
 import numpy as np
 
 
+from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+
 class ShuffleSkewError(RuntimeError):
     """Capacity-slack retries exhausted by pathologically skewed keys.
 
@@ -52,10 +55,8 @@ def _jit_sample(step: int):
 
 def sample_pivots(key: Any, n: int, num_partitions: int, num_samples: int = 4096) -> np.ndarray:
     """Quantile pivots from a strided device sample (one small fetch)."""
-    import jax
-
     step = max(1, key.shape[0] // num_samples)
-    sample = np.asarray(jax.device_get(_jit_sample(step)(key)))
+    sample = np.asarray(_engine_materialize(_jit_sample(step)(key)))
     positions = np.arange(0, key.shape[0], step)
     sample = sample[positions[: len(sample)] < n]
     if sample.dtype.kind == "f":
@@ -72,8 +73,9 @@ def _jit_shuffle(n_cols: int, capacity: int, n: int, descending: bool, local_sor
     """shard_map kernel: local bucketize+pack, all_to_all, local compaction."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from modin_tpu.parallel.jax_compat import shard_map
 
     from modin_tpu.parallel.mesh import get_mesh
 
@@ -170,16 +172,22 @@ def range_shuffle(
     descending: bool = False,
     slack: float = 1.6,
     local_sort: bool = False,
+    max_slack: float = 64.0,
 ) -> Tuple[Any, List[Any], np.ndarray, np.ndarray]:
     """Redistribute rows so shard s holds the s-th key range.
 
     Returns (key_out, cols_out, shard_counts, pivots): padded device columns
     in the framework layout (logical length n), range-partitioned over the
     mesh; rows within a shard keep arrival order (callers sort locally).
+
+    Capacity slack doubles on overflow up to ``max_slack``; past that the
+    keys are pathologically skewed and ShuffleSkewError tells the caller to
+    take its non-shuffle path (a semantic fallback signal, NOT a device
+    failure — see modin_tpu/core/execution/resilience.py's taxonomy).
     """
-    import jax
     import jax.numpy as jnp
 
+    from modin_tpu.logging.metrics import emit_metric
     from modin_tpu.ops.structural import gather_columns
     from modin_tpu.parallel.mesh import num_row_shards
 
@@ -196,12 +204,14 @@ def range_shuffle(
         out = fn(pivots_dev, key, row_valid, *cols)
         counts_r, overflow_r = out[0], out[1]
         payload = list(out[2:])
-        overflow = int(np.sum(np.asarray(jax.device_get(overflow_r))))
+        overflow = int(np.sum(np.asarray(_engine_materialize(overflow_r))))
         if overflow == 0:
-            counts = np.asarray(jax.device_get(counts_r))
+            counts = np.asarray(_engine_materialize(counts_r))
             break
         slack *= 2.0
-        if slack > 64:
+        emit_metric("resilience.shuffle.slack_retry", 1)
+        if slack > max_slack:
+            emit_metric("resilience.shuffle.skew_fallback", 1)
             raise ShuffleSkewError("range_shuffle: pathological key skew")
 
     assert int(counts.sum()) == n, (counts, n)

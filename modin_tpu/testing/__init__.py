@@ -1,0 +1,7 @@
+"""Test-support utilities shipped with the library (fault injection, ...)."""
+
+from modin_tpu.testing.faults import (  # noqa: F401
+    FaultInjector,
+    inject_faults,
+    make_device_error,
+)

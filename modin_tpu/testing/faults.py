@@ -1,0 +1,152 @@
+"""Deterministic fault injection at the ``JaxWrapper`` engine seam.
+
+The resilience layer (modin_tpu/core/execution/resilience.py) is only
+trustworthy if its failure handling can be exercised on demand, on any
+substrate, without a real device OOM or a yanked TPU tunnel.  This harness
+installs a hook at the engine seam — it fires inside every
+``JaxWrapper.deploy/put/materialize/wait`` attempt, *under* the resilience
+wrapper — raising synthetic but *real-typed* ``XlaRuntimeError``s, or
+stalling (slow-kernel), on a deterministic schedule:
+
+    from modin_tpu.testing import inject_faults
+
+    with inject_faults("oom", ops=("materialize",), times=3) as inj:
+        df.nlargest(5, "a")          # device path strikes, pandas answers
+    assert inj.injected == 3
+
+Because the hook runs inside the attempt, an injected transient fault is
+retried by the real backoff loop, a slow-kernel stall trips the real
+watchdog, and an OOM strikes the real breaker — the full production path,
+minus the hardware.  Faults fire on the first ``times`` matching calls
+(after ``skip`` clean ones); no randomness, so a failing sequence replays
+exactly.  When the host jaxlib exposes ``XlaRuntimeError`` the harness
+raises that very type; otherwise a stand-in with the same name is raised,
+which the taxonomy's name-based classification treats identically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+from modin_tpu.core.execution import resilience
+
+_FAULT_MESSAGES = {
+    "oom": (
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "9437184000 bytes. [injected by modin_tpu.testing.faults]"
+    ),
+    "device_lost": (
+        "UNAVAILABLE: device lost: tunnel heartbeat missed, socket closed "
+        "[injected by modin_tpu.testing.faults]"
+    ),
+    "transient": (
+        "DEADLINE_EXCEEDED: operation timed out after 60s "
+        "[injected by modin_tpu.testing.faults]"
+    ),
+}
+
+_ENGINE_OPS = ("deploy", "put", "materialize", "wait")
+
+
+def _runtime_error_type() -> type:
+    """The host runtime's XlaRuntimeError, or a same-named stand-in."""
+    try:
+        from jax._src.lib import xla_client
+
+        return xla_client.XlaRuntimeError
+    except Exception:  # pragma: no cover - depends on host jaxlib
+        return type("XlaRuntimeError", (RuntimeError,), {})
+
+
+def make_device_error(kind: str) -> BaseException:
+    """A real-typed runtime error whose message classifies as ``kind``
+    (one of 'oom', 'device_lost', 'transient')."""
+    if kind not in _FAULT_MESSAGES:
+        raise ValueError(
+            f"unknown fault kind {kind!r}; expected one of "
+            f"{sorted(_FAULT_MESSAGES)} or 'slow_kernel'"
+        )
+    return _runtime_error_type()(_FAULT_MESSAGES[kind])
+
+
+class FaultInjector:
+    """Context manager: fault ``JaxWrapper`` attempts deterministically.
+
+    Parameters
+    ----------
+    kind : 'oom' | 'device_lost' | 'transient' | 'slow_kernel'
+        What each injected fault does.  'slow_kernel' sleeps ``slow_s``
+        inside the attempt (completing, but late — visible to the watchdog
+        and the breaker's latency budget).
+    ops : iterable of {'deploy', 'put', 'materialize', 'wait'}
+        Which engine methods the schedule watches.
+    times : int or None
+        How many matching attempts fault (None = every one while active).
+    skip : int
+        Matching attempts to let through cleanly before the first fault.
+    slow_s : float
+        Stall duration for 'slow_kernel'.
+
+    Attributes: ``injected`` (faults fired), ``calls`` (matching attempts
+    seen).  Only one injector may be active at a time — deterministic
+    schedules do not compose.
+    """
+
+    def __init__(
+        self,
+        kind: str = "transient",
+        ops: Iterable[str] = _ENGINE_OPS,
+        times: Optional[int] = 1,
+        skip: int = 0,
+        slow_s: float = 0.05,
+    ):
+        if kind != "slow_kernel" and kind not in _FAULT_MESSAGES:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        unknown = set(ops) - set(_ENGINE_OPS)
+        if unknown:
+            raise ValueError(f"unknown engine ops {sorted(unknown)}")
+        self.kind = kind
+        self.ops = frozenset(ops)
+        self.times = times
+        self.skip = skip
+        self.slow_s = slow_s
+        self.injected = 0
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def _hook(self, op: str) -> None:
+        if op not in self.ops:
+            return
+        with self._lock:
+            self.calls += 1
+            if self.calls <= self.skip:
+                return
+            if self.times is not None and self.injected >= self.times:
+                return
+            self.injected += 1
+        if self.kind == "slow_kernel":
+            time.sleep(self.slow_s)
+            return
+        raise make_device_error(self.kind)
+
+    def __enter__(self) -> "FaultInjector":
+        if resilience._fault_hook is not None:
+            raise RuntimeError("another FaultInjector is already active")
+        resilience._fault_hook = self._hook
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        resilience._fault_hook = None
+
+
+def inject_faults(
+    kind: str = "transient",
+    ops: Iterable[str] = _ENGINE_OPS,
+    times: Optional[int] = 1,
+    skip: int = 0,
+    slow_s: float = 0.05,
+) -> FaultInjector:
+    """Sugar for ``FaultInjector(...)`` — see its docstring."""
+    return FaultInjector(kind=kind, ops=ops, times=times, skip=skip, slow_s=slow_s)

@@ -1,0 +1,49 @@
+"""Tier-1 wiring for scripts/check_exception_hygiene.py.
+
+Broad ``except Exception`` around device dispatch swallows XlaRuntimeError
+and misreads infrastructure failures as semantic fallbacks (the round-5
+failure class).  The lint walks modin_tpu/core/ and modin_tpu/parallel/ and
+fails on any broad handler not in its vetted allowlist.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+SCRIPT = REPO_ROOT / "scripts" / "check_exception_hygiene.py"
+
+
+def test_no_new_broad_exception_handlers():
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, (
+        "exception-hygiene violations (narrow the handler to the semantic "
+        "types, or vet + allowlist it in the script):\n" + proc.stdout
+    )
+
+
+def test_allowlist_entries_still_exist():
+    """Dead allowlist entries hide future violations — prune them."""
+    sys.path.insert(0, str(SCRIPT.parent))
+    try:
+        import check_exception_hygiene as lint
+    finally:
+        sys.path.pop(0)
+    import ast
+
+    for (rel, func), _reason in lint.ALLOWLIST.items():
+        path = REPO_ROOT / rel
+        assert path.exists(), f"allowlisted file no longer exists: {rel}"
+        tree = ast.parse(path.read_text())
+        owner = lint._enclosing_function(tree)
+        broad_owners = {
+            owner.get(node)
+            for node in ast.walk(tree)
+            if isinstance(node, ast.ExceptHandler) and lint._is_broad(node)
+        }
+        assert func in broad_owners, (
+            f"allowlist entry ({rel}, {func}) matches no broad handler "
+            "anymore — remove it"
+        )

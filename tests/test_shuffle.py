@@ -156,3 +156,56 @@ def test_range_shuffle_descending_nan_last():
         [np.sort(keys[~np.isnan(keys)])[::-1], [np.nan] * n_nan]
     )
     np.testing.assert_array_equal(k, expected)
+
+
+def test_range_shuffle_exhausted_slack_raises_skew_error():
+    # Real exhaustion (not a faked error): all-equal keys route every row to
+    # one shard, so per-destination capacity can never fit them under a
+    # clamped max_slack — the retry loop must double, give up, and raise the
+    # SEMANTIC ShuffleSkewError (never a raw RuntimeError, never a
+    # DeviceFailure), with the retry/fallback counters emitted.
+    from modin_tpu.logging import add_metric_handler, clear_metric_handler
+    from modin_tpu.ops.structural import pad_host
+    from modin_tpu.parallel.engine import JaxWrapper
+    from modin_tpu.parallel.shuffle import ShuffleSkewError, range_shuffle
+
+    seen = []
+
+    def handler(name, value):
+        seen.append(name)
+
+    add_metric_handler(handler)
+    try:
+        n = 2048
+        keys = np.full(n, 3.0)
+        key_dev = JaxWrapper.put(pad_host(keys))
+        with pytest.raises(ShuffleSkewError):
+            range_shuffle(key_dev, [], n, max_slack=2.0)
+    finally:
+        clear_metric_handler(handler)
+    assert "modin_tpu.resilience.shuffle.slack_retry" in seen
+    assert "modin_tpu.resilience.shuffle.skew_fallback" in seen
+
+
+def test_sort_values_real_skew_exhaustion_falls_back(monkeypatch):
+    # End-to-end satellite check: the REAL range_shuffle runs, really
+    # exhausts its capacity-slack retries on pathologically skewed keys
+    # (max_slack clamped low), and sort_values degrades to the non-shuffle
+    # global-argsort path with pandas-identical results.
+    import functools
+
+    import modin_tpu.parallel.shuffle as shuffle_mod
+
+    real = shuffle_mod.range_shuffle
+    monkeypatch.setattr(
+        shuffle_mod, "range_shuffle", functools.partial(real, max_slack=2.0)
+    )
+    md, pdf = create_test_dfs({"a": np.full(2048, 3.0), "b": np.arange(2048.0)})
+    with RangePartitioning.context(True):
+        df_equals(
+            md.sort_values("a", kind="stable"), pdf.sort_values("a", kind="stable")
+        )
+        df_equals(
+            md.sort_values("a", ascending=False, kind="stable", ignore_index=True),
+            pdf.sort_values("a", ascending=False, kind="stable", ignore_index=True),
+        )
