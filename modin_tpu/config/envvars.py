@@ -429,6 +429,20 @@ class Float64Policy(EnvironmentVariable, type=str):
     default = "Native"
 
 
+class CacheDir(EnvironmentVariable, type=ExactStr):
+    """Directory for host-side build artifacts (the native CSV chunker's
+    compiled .so cache).  Distinct from CompilationCacheDir, which holds
+    XLA executables."""
+
+    varname = "MODIN_TPU_CACHE_DIR"
+
+    @classmethod
+    def _get_default(cls) -> str:
+        import pathlib
+
+        return str(pathlib.Path.home() / ".cache" / "modin_tpu")
+
+
 class CompilationCacheDir(EnvironmentVariable, type=ExactStr):
     """Directory for jax's persistent compilation cache ('' disables).
 

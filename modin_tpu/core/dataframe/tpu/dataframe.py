@@ -442,7 +442,12 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
         eager (synchronizing) operation — the reference has the same property
         via lazy row-length caches (dataframe.py:242-343)."""
         from modin_tpu.ops.structural import pad_len
+        from modin_tpu.parallel.engine import JaxWrapper
 
+        if JaxWrapper.is_future(mask):
+            # device-produced mask: fetch through the seam so the blocking
+            # transfer gets the resilience policy (classify/retry/watchdog)
+            mask = JaxWrapper.materialize(mask)
         mask_np = np.asarray(mask)
         n = len(self)
         if len(mask_np) == pad_len(n):

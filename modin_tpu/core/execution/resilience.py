@@ -174,7 +174,7 @@ def _run_with_watchdog(op: str, thunk: Callable[[], Any], timeout_s: float) -> A
     def runner() -> None:
         try:
             result_q.put((True, thunk()))
-        except BaseException as err:  # noqa: BLE001 - relayed to caller
+        except BaseException as err:  # noqa: BLE001 - relayed to caller  # graftlint: disable=EXC-HYGIENE -- watchdog thread relays ANY exception to the waiting caller verbatim
             result_q.put((False, err))
 
     thread = threading.Thread(
@@ -228,7 +228,7 @@ def engine_call(op: str, thunk: Callable[[], Any], watchdog: bool = False) -> An
             if timeout_s > 0:
                 return _run_with_watchdog(op, attempt_once, timeout_s)
             return attempt_once()
-        except Exception as err:
+        except Exception as err:  # graftlint: disable=EXC-HYGIENE -- the classification point: catches broadly, re-raises non-device errors
             failure = classify_device_error(err)
             if failure is None:
                 raise
@@ -243,6 +243,33 @@ def engine_call(op: str, thunk: Callable[[], Any], watchdog: bool = False) -> An
 # ---------------------------------------------------------------------- #
 # 3. Per-device-path circuit breaker
 # ---------------------------------------------------------------------- #
+
+#: Every breaker family a ``@device_path`` decorator in the TPU query
+#: compiler may use.  This is the operator-facing catalog: docs, dashboards,
+#: and ``breaker_snapshot`` consumers key off these names, and graftlint's
+#: FALLBACK-PARITY rule cross-checks it both ways (an undeclared family in
+#: the compiler, or a declared family with no ``_try_*`` user, is drift).
+#: Tests may still create ad-hoc families (e.g. "probe_unit") at runtime;
+#: only the query compiler's production paths are held to the registry.
+DEVICE_PATH_FAMILIES = frozenset(
+    {
+        "binary",
+        "reduce",
+        "dt_component",
+        "str_lut",
+        "top_k",
+        "corr_cov",
+        "shift",
+        "merge",
+        "rolling",
+        "ewm",
+        "resample",
+        "expanding",
+        "groupby",
+        "shuffle_apply",
+        "sort_shuffle",
+    }
+)
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
@@ -392,7 +419,7 @@ def device_path(family: str) -> Callable:
             start = _now()
             try:
                 result = fn(self, *args, **kwargs)
-            except Exception as err:
+            except Exception as err:  # graftlint: disable=EXC-HYGIENE -- device_path classification point: unclassified exceptions propagate
                 failure = classify_device_error(err)
                 if failure is None:
                     # not the device's fault — but if this call was the

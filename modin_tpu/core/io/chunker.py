@@ -33,9 +33,9 @@ def _build_library() -> Optional[ctypes.CDLL]:
         _build_failed = True
         return None
     digest = hashlib.sha256(src_bytes).hexdigest()[:16]
-    cache_dir = pathlib.Path(
-        os.environ.get("MODIN_TPU_CACHE_DIR", os.path.expanduser("~/.cache/modin_tpu"))
-    )
+    from modin_tpu.config import CacheDir
+
+    cache_dir = pathlib.Path(CacheDir.get())
     so_path = cache_dir / f"chunker_{digest}.so"
     if not so_path.exists():
         try:

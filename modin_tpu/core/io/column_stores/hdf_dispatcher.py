@@ -31,7 +31,7 @@ def _pytables_available() -> bool:
         import tables  # noqa: F401
 
         return True
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- pytables raises library-private types during its import probe
         return False
 
 
@@ -52,7 +52,7 @@ class HDFDispatcher(FileDispatcher):
                 if storer is None or not getattr(storer, "is_table", False):
                     return None
                 return int(storer.nrows)
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- same pytables surface; failure falls back to a full read
             return None
 
     @classmethod

@@ -72,7 +72,7 @@ class ParquetDispatcher(FileDispatcher):
                 cls.get_path(path), columns, filters
             )
             df = table.to_pandas(split_blocks=True, self_destruct=True)
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- metadata fast path is advisory; falls back to a full read
             df = pandas.read_parquet(path, engine=engine, columns=columns, **kwargs)
         return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
 
@@ -228,7 +228,7 @@ class FeatherDispatcher(FileDispatcher):
             try:
                 df = cls._read_ipc_batch_parallel(cls.get_path(path), columns)
                 return cls.query_compiler_cls.from_pandas(df, cls.frame_cls)
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-HYGIENE -- metadata fast path is advisory; falls back to a full read
                 pass  # legacy feather v1 / unreadable-as-IPC: pandas path
         df = pandas.read_feather(
             cls.get_path(path) if isinstance(path, str) else path,
@@ -296,7 +296,7 @@ class FeatherDispatcher(FileDispatcher):
 
         try:
             options = pa.ipc.IpcWriteOptions(compression="lz4")
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- best-effort cleanup of a partially written dataset
             options = None
         n_rows = qc.get_axis_len(0)
         writer = None

@@ -53,7 +53,7 @@ class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
             return cls._read_fallback(path, kwargs)
         try:
             return cls._read_parallel(path, kwargs)
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- fsspec/credential probing; a failed probe means 'not readable here'
             return cls._read_fallback(path, kwargs)
 
     @classmethod

@@ -110,7 +110,7 @@ def _evictable(col: Any) -> bool:
         return False  # materialization may still want the exact source
     try:
         device_dtype = col.raw.dtype
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- best-effort eviction probe; any failure means 'not evictable'
         return False
     if col.pandas_dtype.kind == "f" and str(device_dtype) != str(col.pandas_dtype):
         return False  # Downcast policy: the cache IS the exact copy

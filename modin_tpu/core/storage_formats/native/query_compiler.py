@@ -97,6 +97,6 @@ class NativeQueryCompiler(BaseQueryCompiler):
             # small frames are exactly what in-process pandas is best at
             if other_qc.get_axis_len(0) <= NativePandasMaxRows.get():
                 return QCCoercionCost.COST_ZERO
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- host-only cost estimate on the in-process backend; advisory
             pass
         return QCCoercionCost.COST_MEDIUM

@@ -493,6 +493,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     # ------------------------------- binary --------------------------- #
 
+    @device_path("binary")
     def _try_dict_compare(self, op: str, other: str) -> Optional["TpuQueryCompiler"]:
         """String-scalar comparisons on dictionary-encoded columns: sorted
         categories turn every comparison into a CODE-threshold test (one

@@ -222,7 +222,9 @@ class NativeBooster:
                 bins, tree.feature, tree.threshold, tree.is_split,
                 tree.leaf_value, jnp.float32(0.0),
             )
-        return np.asarray(out, dtype=np.float64)
+        from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+        return np.asarray(_engine_materialize(out), dtype=np.float64)
 
     def predict(self, data: Any, **kwargs: Any):
         from modin_tpu.experimental.xgboost import DMatrix

@@ -1,0 +1,52 @@
+"""graftlint — AST-based invariant checkers for the device/host seam.
+
+PR 1 established three codebase-wide invariants by hand review: every host
+sync goes through the ``JaxWrapper.materialize`` seam, every ``_try_*``
+device family has a pandas fallback behind a named circuit breaker, and no
+broad ``except Exception`` may mask a device fault as a semantic fallback.
+This package turns those (and two registry-drift invariants that grew out of
+them) into permanent static tooling, in the spirit of Dias
+(arXiv:2303.16146): pandas-style code is regular enough for precise AST-level
+analysis, and the lazy/eager (device/host) boundary a dataframe system lives
+or dies by ("Towards Scalable Dataframe Systems", arXiv:2001.00888) is
+exactly the kind of seam a checker can pin down.
+
+Usage::
+
+    python -m modin_tpu.lint modin_tpu/            # CLI; exit 1 on findings
+    python -m modin_tpu.lint --list-rules
+
+    from modin_tpu.lint import run_lint
+    result = run_lint(["modin_tpu/"], root=repo_root)
+    assert not result.findings
+
+Rules live in ``modin_tpu/lint/rules/``; the framework (finding objects,
+pragma + baseline suppression, per-file AST contexts with parent/scope
+tracking) in ``modin_tpu/lint/framework.py``.  See docs/linting.md for the
+rule catalog and the baseline burn-down workflow.
+"""
+
+from modin_tpu.lint.framework import (  # noqa: F401
+    Finding,
+    FileContext,
+    LintResult,
+    Project,
+    Rule,
+    all_rules,
+    register_rule,
+    run_lint,
+)
+
+# importing the package registers every built-in rule
+import modin_tpu.lint.rules  # noqa: E402,F401
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "LintResult",
+    "Project",
+    "Rule",
+    "all_rules",
+    "register_rule",
+    "run_lint",
+]

@@ -1,0 +1,126 @@
+"""graftlint CLI: ``python -m modin_tpu.lint [paths...]``.
+
+Exit status: 0 clean (pragma/baseline suppressions are fine), 1 on any
+non-baselined finding or stale baseline entry.  Findings print one per line
+as ``path:line: RULE message`` so editors and CI logs make them clickable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from modin_tpu.lint import all_rules, run_lint
+from modin_tpu.lint.framework import _detect_root, write_baseline
+
+DEFAULT_BASELINE = ".graftlint-baseline"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m modin_tpu.lint",
+        description="AST invariant checks for the device/host seam "
+        "(see docs/linting.md)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["modin_tpu"],
+        help="files or directories to lint (default: modin_tpu)",
+    )
+    parser.add_argument(
+        "--root",
+        type=Path,
+        default=None,
+        help="repo root for relative paths / baseline / docs "
+        "(default: nearest ancestor with pyproject.toml)",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help=f"baseline file (default: <root>/{DEFAULT_BASELINE})",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline file entirely",
+    )
+    parser.add_argument(
+        "--baseline-write",
+        action="store_true",
+        help="rewrite the baseline to accept every current finding "
+        "(intentional burn-down checkpoints only) and exit 0",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="findings only, no summary"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid}: {rule.description}")
+        return 0
+
+    # resolve the root up front so --baseline defaults land next to
+    # pyproject.toml regardless of the caller's cwd
+    root = args.root if args.root else _detect_root([Path(p) for p in args.paths])
+    baseline = args.baseline if args.baseline else root / DEFAULT_BASELINE
+    select = (
+        [r.strip() for r in args.select.split(",") if r.strip()]
+        if args.select
+        else None
+    )
+
+    try:
+        result = run_lint(
+            args.paths,
+            root=root,
+            baseline=None if args.no_baseline else baseline,
+            select=select,
+        )
+    except ValueError as err:  # unknown --select rule id
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+
+    if args.baseline_write:
+        write_baseline(baseline, result.findings + result.baselined)
+        print(
+            f"wrote {len(result.findings) + len(result.baselined)} baseline "
+            f"entr{'y' if len(result.findings) + len(result.baselined) == 1 else 'ies'} "
+            f"to {baseline}"
+        )
+        return 0
+
+    for finding in result.findings:
+        print(finding.render())
+    for key in result.stale_baseline:
+        print(f"{baseline}:1: GL-STALE-BASELINE dead entry {key} — remove it")
+
+    if not args.quiet:
+        per_rule: dict = {}
+        for f in result.findings:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        breakdown = ", ".join(f"{r}={n}" for r, n in sorted(per_rule.items()))
+        print(
+            f"graftlint: {len(result.findings)} finding(s)"
+            + (f" [{breakdown}]" if breakdown else "")
+            + f", {len(result.suppressed)} pragma-suppressed,"
+            f" {len(result.baselined)} baselined,"
+            f" {len(result.stale_baseline)} stale baseline entr"
+            f"{'y' if len(result.stale_baseline) == 1 else 'ies'}"
+        )
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

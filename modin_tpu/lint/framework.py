@@ -1,0 +1,403 @@
+"""graftlint framework: findings, file contexts, pragmas, baseline, registry.
+
+Design:
+
+- a ``Rule`` walks parsed files and yields ``Finding`` objects carrying
+  ``path:line``, the rule id, a message, and a fix hint;
+- ``FileContext`` owns one file's AST with parent links, dotted scope names
+  (``Class.method.inner``), and the ``# graftlint: disable=RULE`` pragma map;
+- ``Project`` owns the scanned file set plus cross-file lookups (rules like
+  FALLBACK-PARITY and REGISTRY-DRIFT check one file against a registry
+  declared in another);
+- suppression is two-layer: inline pragmas for *vetted* violations (the
+  reason lives next to the code), and a baseline file for *pre-existing*
+  violations being burned down incrementally.  Baseline keys deliberately
+  contain no line numbers (``path::rule::scope::symbol``) so they survive
+  unrelated edits; a key that no longer matches any finding is *stale* and
+  fails the run — dead suppressions hide future violations, same rationale
+  as the old allowlist-pruning test this framework subsumes.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+
+#: rule id the framework itself emits for disable-pragmas that suppressed
+#: nothing (the inline analogue of a dead allowlist entry)
+UNUSED_PRAGMA_RULE = "GL-PRAGMA-UNUSED"
+
+
+@dataclass
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str  # root-relative, posix separators
+    line: int
+    rule: str
+    message: str
+    fix_hint: str = ""
+    scope: str = "<module>"  # dotted enclosing Class.function chain
+    symbol: str = ""  # stable token distinguishing findings within a scope
+
+    @property
+    def baseline_key(self) -> str:
+        """Line-number-free identity used by the baseline file."""
+        return f"{self.path}::{self.rule}::{self.scope}::{self.symbol}"
+
+    def render(self) -> str:
+        """``path:line: RULE message`` — clickable in editors/CI logs."""
+        text = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.fix_hint:
+            text += f" (fix: {self.fix_hint})"
+        return text
+
+
+class FileContext:
+    """One parsed source file with parent links, scopes, and pragmas."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=rel)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.scopes: Dict[ast.AST, str] = {self.tree: "<module>"}
+        self._build_maps()
+        self.pragmas: Dict[int, Set[str]] = self._parse_pragmas(source)
+        self._used_pragma_lines: Set[int] = set()
+
+    def _build_maps(self) -> None:
+        def visit(node: ast.AST, scope: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                child_scope = scope
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    child_scope = (
+                        child.name if scope == "<module>" else f"{scope}.{child.name}"
+                    )
+                self.scopes[child] = child_scope
+                visit(child, child_scope)
+
+        visit(self.tree, "<module>")
+
+    @staticmethod
+    def _parse_pragmas(source: str) -> Dict[int, Set[str]]:
+        """{lineno: {rule ids}} from ``# graftlint: disable=A,B`` comments.
+
+        Tokenized (not regex-over-lines) so pragma text inside string
+        literals can't masquerade as a suppression.
+        """
+        pragmas: Dict[int, Set[str]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = PRAGMA_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                    pragmas.setdefault(tok.start[0], set()).update(rules)
+        except tokenize.TokenError:  # unterminated strings etc.: no pragmas
+            pass
+        return pragmas
+
+    # -- queries rules use --------------------------------------------- #
+
+    def parent_of(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def scope_of(self, node: ast.AST) -> str:
+        return self.scopes.get(node, "<module>")
+
+    def enclosing_function_name(self, node: ast.AST) -> str:
+        """Nearest enclosing function's bare name ('<module>' at top level)."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = self.parents.get(cur)
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur.name
+        return "<module>"
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        """Pragma on the finding's line, or on the line directly above it."""
+        for line in (finding.line, finding.line - 1):
+            rules = self.pragmas.get(line)
+            if rules and (finding.rule in rules or "all" in rules):
+                self._used_pragma_lines.add(line)
+                return True
+        return False
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)  # actionable
+    suppressed: List[Finding] = field(default_factory=list)  # pragma'd
+    baselined: List[Finding] = field(default_factory=list)  # baseline hits
+    stale_baseline: List[str] = field(default_factory=list)  # dead entries
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.stale_baseline) else 0
+
+
+class Project:
+    """The scanned file set plus cross-file lookups and repo-level text."""
+
+    def __init__(self, root: Path, files: List[FileContext]):
+        self.root = root
+        self.files = files
+        self._by_rel = {f.rel: f for f in files}
+
+    def file(self, rel: str) -> Optional[FileContext]:
+        return self._by_rel.get(rel)
+
+    def files_matching(self, suffix: str) -> List[FileContext]:
+        """Scanned files whose root-relative path ends with ``suffix``.
+
+        Rules reference registry files this way (e.g.
+        ``core/execution/resilience.py``) so unit tests can mirror the layout
+        under a tmp root without the real package.
+        """
+        return [f for f in self.files if f.rel.endswith(suffix)]
+
+    def docs_text(self) -> str:
+        """Concatenated ``docs/*.md`` under the root ('' when absent)."""
+        docs_dir = self.root / "docs"
+        if not docs_dir.is_dir():
+            return ""
+        return "\n".join(
+            p.read_text(encoding="utf-8", errors="replace")
+            for p in sorted(docs_dir.glob("*.md"))
+        )
+
+    def has_docs(self) -> bool:
+        return (self.root / "docs").is_dir()
+
+
+class Rule:
+    """Base class: subclass, set ``id``/``description``, implement a check.
+
+    Override ``check_file`` for per-file rules; override ``check_project``
+    when the rule needs cross-file context (registries, call graphs).
+    """
+
+    id: str = ""
+    description: str = ""
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for ctx in project.files:
+            yield from self.check_file(ctx, project)
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    """Class decorator: instantiate and register a Rule by its id."""
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------- #
+# baseline file
+# ---------------------------------------------------------------------- #
+
+
+def load_baseline(path: Path) -> Set[str]:
+    """Baseline keys, one per line; '#' comments and blanks ignored."""
+    if not path.exists():
+        return set()
+    keys = set()
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            keys.add(line)
+    return keys
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    keys = sorted({f.baseline_key for f in findings})
+    lines = [
+        "# graftlint baseline — pre-existing violations being burned down.",
+        "# One key per line: path::RULE::scope::symbol (no line numbers, so",
+        "# keys survive unrelated edits).  Remove entries as you fix them;",
+        "# stale entries fail the lint.  Regenerate: python -m modin_tpu.lint",
+        "# --baseline-write <paths>.  Prefer fixing over baselining; prefer a",
+        "# reasoned '# graftlint: disable=RULE' pragma for vetted exceptions.",
+    ]
+    lines += keys
+    path.write_text("\n".join(lines) + "\n")
+
+
+# ---------------------------------------------------------------------- #
+# driver
+# ---------------------------------------------------------------------- #
+
+
+def _collect_py_files(root: Path, paths: Sequence[Path]) -> List[Tuple[Path, str]]:
+    seen: Set[Path] = set()
+    out: List[Tuple[Path, str]] = []
+    for p in paths:
+        p = p if p.is_absolute() else root / p
+        candidates = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for c in candidates:
+            c = c.resolve()
+            if c in seen:
+                continue
+            seen.add(c)
+            try:
+                rel = c.relative_to(root.resolve()).as_posix()
+            except ValueError:
+                rel = c.as_posix()
+            out.append((c, rel))
+    return out
+
+
+def build_project(
+    paths: Sequence, root: Optional[Path] = None
+) -> Tuple[Project, List[Finding]]:
+    """Parse every .py under ``paths`` into a Project.
+
+    Returns (project, parse_failures): a file that doesn't parse becomes a
+    GL-PARSE finding instead of crashing the whole run.
+    """
+    paths = [Path(p) for p in paths]
+    if root is None:
+        root = _detect_root(paths)
+    root = Path(root)
+    files: List[FileContext] = []
+    failures: List[Finding] = []
+    for path, rel in _collect_py_files(root, paths):
+        source = path.read_text(encoding="utf-8", errors="replace")
+        try:
+            ctx = FileContext(path, rel, source)
+        except SyntaxError as err:
+            failures.append(
+                Finding(
+                    path=rel,
+                    line=err.lineno or 1,
+                    rule="GL-PARSE",
+                    message=f"file does not parse: {err.msg}",
+                    symbol="parse",
+                )
+            )
+            continue
+        files.append(ctx)
+    return Project(root, files), failures
+
+
+def _detect_root(paths: Sequence[Path]) -> Path:
+    """Walk up from the first path looking for pyproject.toml; else cwd."""
+    start = paths[0] if paths else Path.cwd()
+    start = start if start.is_absolute() else Path.cwd() / start
+    cur = start if start.is_dir() else start.parent
+    for candidate in [cur, *cur.parents]:
+        if (candidate / "pyproject.toml").exists():
+            return candidate
+    return Path.cwd()
+
+
+def run_lint(
+    paths: Sequence,
+    root: Optional[Path] = None,
+    baseline: Optional[Path] = None,
+    select: Optional[Iterable[str]] = None,
+) -> LintResult:
+    """Run the registered rules (or the ``select`` subset) over ``paths``."""
+    project, failures = build_project(paths, root=root)
+    rules = all_rules()
+    if select is not None:
+        select = set(select)
+        unknown = select - set(rules)
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+        rules = {rid: r for rid, r in rules.items() if rid in select}
+
+    raw: List[Finding] = list(failures)
+    for rule in rules.values():
+        raw.extend(rule.check_project(project))
+
+    # pass 1 — pragma suppression (also marks which pragma lines earned
+    # their keep, which the unused-pragma sweep below needs)
+    result = LintResult()
+    unsuppressed: List[Finding] = []
+    for finding in raw:
+        ctx = project.file(finding.path)
+        if ctx is not None and ctx.is_suppressed(finding):
+            result.suppressed.append(finding)
+        else:
+            unsuppressed.append(finding)
+
+    # pass 2 — a disable-pragma that suppressed nothing is itself a finding:
+    # dead suppressions hide the next real violation.  Only on full runs (a
+    # --select run legitimately skips other rules' pragmas), and BEFORE the
+    # baseline filter so these findings baseline like any other.
+    if select is None:
+        known = set(all_rules()) | {"all"}
+        for ctx in project.files:
+            for line, prules in sorted(ctx.pragmas.items()):
+                if line in ctx._used_pragma_lines:
+                    continue
+                if not (prules & known):
+                    continue  # pragma for a rule this build doesn't know
+                unsuppressed.append(
+                    Finding(
+                        path=ctx.rel,
+                        line=line,
+                        rule=UNUSED_PRAGMA_RULE,
+                        message=(
+                            "disable pragma suppresses nothing "
+                            f"({', '.join(sorted(prules))}) — remove it"
+                        ),
+                        scope="<module>",
+                        symbol=f"pragma-{'-'.join(sorted(prules))}",
+                    )
+                )
+
+    # pass 3 — baseline filter
+    baseline_keys = load_baseline(baseline) if baseline else set()
+    matched_keys: Set[str] = set()
+    for finding in unsuppressed:
+        if finding.baseline_key in baseline_keys:
+            matched_keys.add(finding.baseline_key)
+            result.baselined.append(finding)
+        else:
+            result.findings.append(finding)
+
+    # stale-entry detection is only sound when the run could have matched
+    # the entry: all rules active AND the entry's file inside the scanned
+    # set.  A --select or subset-path run must not cry stale over entries
+    # it never had a chance to regenerate.
+    if select is None:
+        scanned = {ctx.rel for ctx in project.files}
+        result.stale_baseline = sorted(
+            key
+            for key in baseline_keys - matched_keys
+            if key.split("::", 1)[0] in scanned
+        )
+
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

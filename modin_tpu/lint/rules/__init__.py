@@ -1,0 +1,19 @@
+"""Built-in graftlint rules.  Importing this package registers them all.
+
+Each module defines one rule guarding one PR-1 invariant (or a registry
+invariant that grew out of it) — see docs/linting.md for the catalog:
+
+- HOST-SYNC        every device->host sync goes through the materialize seam
+- JIT-HAZARD       jitted functions don't trace Python control flow / shapes
+- FALLBACK-PARITY  every _try_* device path has a breaker + pandas fallback
+- EXC-HYGIENE      no broad except around device dispatch
+- REGISTRY-DRIFT   metrics and MODIN_TPU_* env vars are declared + documented
+"""
+
+from modin_tpu.lint.rules import (  # noqa: F401
+    exc_hygiene,
+    fallback_parity,
+    host_sync,
+    jit_hazard,
+    registry_drift,
+)

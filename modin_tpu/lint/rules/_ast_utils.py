@@ -1,0 +1,61 @@
+"""Small AST helpers shared by the graftlint rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+#: attribute accesses on a device array that yield *host* metadata, not a
+#: device value — safe in Python control flow and shape positions
+STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "devices", "sharding"})
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.numpy.sum' for a Name/Attribute chain, None for anything else."""
+    parts = dotted_parts(node)
+    return ".".join(parts) if parts else None
+
+
+def dotted_parts(node: ast.AST) -> Optional[List[str]]:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def assigned_names(target: ast.AST) -> List[str]:
+    """Plain names bound by an assignment target (tuples flattened)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(assigned_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return assigned_names(target.value)
+    return []
+
+
+def first_line(node: ast.AST) -> int:
+    return getattr(node, "lineno", 1)
+
+
+def is_docstring(ctx_parents, node: ast.Constant) -> bool:
+    """Is this string constant a docstring (first stmt of a def/class/module)?"""
+    parent = ctx_parents.get(node)
+    if not isinstance(parent, ast.Expr):
+        return False
+    grand = ctx_parents.get(parent)
+    if not isinstance(
+        grand, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Module)
+    ):
+        return False
+    body = grand.body
+    return bool(body) and body[0] is parent

@@ -1,0 +1,79 @@
+"""EXC-HYGIENE: no broad exception handlers around device dispatch.
+
+Port of (and replacement for) the standalone ``scripts/
+check_exception_hygiene.py`` from PR 1.  A bare ``except:`` or ``except
+Exception:`` in the audited trees swallows jax ``XlaRuntimeError`` device
+failures and misreads them as semantic "not supported on device" fallbacks —
+the exact bug class the resilience layer exists to eliminate.  Handlers must
+name the semantic exception types they mean (``TypeError``, ``ValueError``,
+``ShuffleSkewError``, ...) so infrastructure failures propagate to the
+classify/retry/breaker machinery.
+
+Vetted broad handlers (host-only work where the library surface raises too
+many types to enumerate, or the resilience layer itself — the one place
+whose JOB is to catch broadly, classify, and re-raise) carry an inline
+``# graftlint: disable=EXC-HYGIENE -- <reason>`` pragma on the handler line,
+replacing the old script's central allowlist: the justification now lives
+next to the code it excuses, and the framework flags any pragma whose
+handler has been fixed or deleted (GL-PRAGMA-UNUSED) the way the old
+``test_allowlist_entries_still_exist`` pruned dead allowlist entries.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from modin_tpu.lint.framework import FileContext, Finding, Project, Rule, register_rule
+
+#: trees where device dispatch lives; the pandas API layer and experimental
+#: integrations legitimately wrap third-party surfaces broadly
+AUDITED_PREFIXES = (
+    "modin_tpu/core/",
+    "modin_tpu/parallel/",
+    "modin_tpu/ops/",
+)
+
+
+def is_broad(handler: ast.ExceptHandler) -> bool:
+    """bare ``except:`` or any clause naming Exception/BaseException."""
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+@register_rule
+class ExcHygieneRule(Rule):
+    id = "EXC-HYGIENE"
+    description = (
+        "no bare except / except Exception in device-dispatch trees — name "
+        "the semantic types so device failures reach the resilience layer"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterator[Finding]:
+        if not ctx.rel.startswith(AUDITED_PREFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler) or not is_broad(node):
+                continue
+            func = ctx.enclosing_function_name(node)
+            yield Finding(
+                path=ctx.rel,
+                line=node.lineno,
+                rule=self.id,
+                message=f"broad exception handler in {func}() swallows "
+                "device failures as semantic fallbacks",
+                fix_hint="name the semantic exception types (TypeError, "
+                "ValueError, ShuffleSkewError, ...); if genuinely vetted, "
+                "add `# graftlint: disable=EXC-HYGIENE -- <reason>` on the "
+                "handler line",
+                scope=ctx.scope_of(node),
+                symbol=f"broad-except-{func}",
+            )

@@ -1,0 +1,273 @@
+"""FALLBACK-PARITY: every ``_try_*`` device path degrades, never crashes.
+
+The PR-1 invariant: a ``_try_*`` method in the TPU query compiler is an
+*optimized attempt*, not an obligation.  Its contract is (a) return a result,
+or (b) return None meaning "use the pandas fallback" — and the resilience
+layer adds leg (c): when the breaker for its family is open, None comes back
+without touching the device.  Three things must therefore hold:
+
+1. every ``_try_*`` method carries ``@device_path("<family>")`` so it owns a
+   named circuit breaker (an unguarded ``_try_*`` crashes on device failure
+   instead of striking a breaker and falling back);
+2. the family name is declared in ``DEVICE_PATH_FAMILIES`` in
+   ``core/execution/resilience.py`` (the registry the docs, metrics, and
+   operators key off), and every declared family is actually used — drift in
+   either direction is flagged;
+3. every call site reaches a pandas fallback: the caller must None-check the
+   result in the same function (or itself be a ``_try_*``/forwarder whose
+   *own* callers check) — otherwise a breaker-open short-circuit returns
+   None straight to user code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from modin_tpu.lint.framework import FileContext, Finding, Project, Rule, register_rule
+from modin_tpu.lint.rules._ast_utils import dotted_parts
+
+RESILIENCE_SUFFIX = "core/execution/resilience.py"
+FAMILY_REGISTRY_NAME = "DEVICE_PATH_FAMILIES"
+
+
+def _device_path_family(fn: ast.FunctionDef) -> Optional[str]:
+    """The family string from a @device_path("...") decorator, if any."""
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            parts = dotted_parts(dec.func)
+            if parts and parts[-1] == "device_path" and dec.args:
+                arg = dec.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    return arg.value
+                return "<dynamic>"
+    return None
+
+
+def _registry_families(ctx: FileContext) -> Optional[Set[str]]:
+    """Strings in ``DEVICE_PATH_FAMILIES = frozenset({...})`` (None if absent)."""
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if FAMILY_REGISTRY_NAME in names:
+                return {
+                    c.value
+                    for c in ast.walk(node.value)
+                    if isinstance(c, ast.Constant) and isinstance(c.value, str)
+                }
+    return None
+
+
+def _none_checked_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names compared against None anywhere in the function."""
+    checked: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            for side in [node.left, *node.comparators]:
+                if isinstance(side, ast.Name):
+                    checked.add(side.id)
+    return checked
+
+
+@register_rule
+class FallbackParityRule(Rule):
+    id = "FALLBACK-PARITY"
+    description = (
+        "_try_* device paths need @device_path with a family declared in "
+        "DEVICE_PATH_FAMILIES, and every call site must reach the pandas "
+        "fallback via a None check"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        registry: Optional[Set[str]] = None
+        registry_ctx: Optional[FileContext] = None
+        for ctx in project.files_matching(RESILIENCE_SUFFIX):
+            registry = _registry_families(ctx)
+            registry_ctx = ctx
+            if registry is not None:
+                break
+
+        used_families: Set[str] = set()
+        for ctx in project.files:
+            if "query_compiler" not in ctx.rel:
+                continue
+            yield from self._check_compiler_file(ctx, registry, used_families)
+
+        # declared-but-unused families are drift too (a renamed family keeps
+        # its dead registry entry and the docs/operators key off a ghost)
+        if registry is not None and registry_ctx is not None and used_families:
+            for family in sorted(registry - used_families):
+                yield Finding(
+                    path=registry_ctx.rel,
+                    line=self._registry_line(registry_ctx),
+                    rule=self.id,
+                    message=f"family '{family}' is declared in "
+                    f"{FAMILY_REGISTRY_NAME} but no _try_* method uses it",
+                    fix_hint="remove the dead entry or restore the "
+                    "@device_path usage",
+                    scope="<module>",
+                    symbol=f"unused-family-{family}",
+                )
+
+    def _registry_line(self, ctx: FileContext) -> int:
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == FAMILY_REGISTRY_NAME
+                for t in node.targets
+            ):
+                return node.lineno
+        return 1
+
+    def _check_compiler_file(
+        self,
+        ctx: FileContext,
+        registry: Optional[Set[str]],
+        used_families: Set[str],
+    ) -> Iterator[Finding]:
+        # collect methods per class: _try_* defs and their decorators
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods: Dict[str, ast.FunctionDef] = {
+                n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)
+            }
+            try_methods = {
+                name: fn for name, fn in methods.items() if name.startswith("_try_")
+            }
+            if not try_methods:
+                continue
+
+            # 1+2: decorator present, family registered
+            for name, fn in sorted(try_methods.items()):
+                family = _device_path_family(fn)
+                if family is None:
+                    yield Finding(
+                        path=ctx.rel,
+                        line=fn.lineno,
+                        rule=self.id,
+                        message=f"{cls.name}.{name} has no @device_path "
+                        "decorator — no circuit breaker guards this device "
+                        "path",
+                        fix_hint='decorate with @device_path("<family>") and '
+                        f"declare the family in {FAMILY_REGISTRY_NAME}",
+                        scope=ctx.scope_of(fn),
+                        symbol=f"undec-{name}",
+                    )
+                else:
+                    used_families.add(family)
+                    if registry is not None and family not in registry:
+                        yield Finding(
+                            path=ctx.rel,
+                            line=fn.lineno,
+                            rule=self.id,
+                            message=f"{cls.name}.{name} uses breaker family "
+                            f"'{family}' which is not declared in "
+                            f"{FAMILY_REGISTRY_NAME} "
+                            f"(core/execution/resilience.py)",
+                            fix_hint="add the family to the registry so "
+                            "operators/docs/metrics can enumerate it",
+                            scope=ctx.scope_of(fn),
+                            symbol=f"unregistered-{name}",
+                        )
+
+            # 3: every call site None-checks (or forwards to one that does).
+            # Forwarders: methods that `return self._try_x(...)` directly may
+            # propagate None to *their* callers, which must then check.
+            propagators = set(try_methods)
+            changed = True
+            while changed:
+                changed = False
+                for name, fn in methods.items():
+                    if name in propagators:
+                        continue
+                    for node in ast.walk(fn):
+                        if (
+                            isinstance(node, ast.Return)
+                            and isinstance(node.value, ast.Call)
+                            and isinstance(node.value.func, ast.Attribute)
+                            and node.value.func.attr in propagators
+                        ):
+                            propagators.add(name)
+                            changed = True
+                            break
+
+            for name, fn in sorted(methods.items()):
+                checked = _none_checked_names(fn)
+                for node in ast.walk(fn):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in propagators
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                    ):
+                        continue
+                    callee = node.func.attr
+                    if name in propagators and self._is_direct_return(fn, node):
+                        continue  # forwarder: its callers carry the check
+                    if self._call_result_checked(ctx, node, checked):
+                        continue
+                    yield Finding(
+                        path=ctx.rel,
+                        line=node.lineno,
+                        rule=self.id,
+                        message=f"result of self.{callee}() is not checked "
+                        "against None — a breaker-open short-circuit would "
+                        "leak None to the caller instead of reaching the "
+                        "pandas fallback",
+                        fix_hint="assign the result and fall back via "
+                        "`if result is not None: return result` + the "
+                        "pandas default",
+                        scope=ctx.scope_of(node),
+                        symbol=f"unchecked-{name}-{callee}",
+                    )
+
+    @staticmethod
+    def _is_direct_return(fn: ast.FunctionDef, call: ast.Call) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is call:
+                return True
+        return False
+
+    def _call_result_checked(
+        self, ctx: FileContext, call: ast.Call, checked_names: Set[str]
+    ) -> bool:
+        """Is this call's result bound to a None-checked name, or used in a
+        None comparison / boolean-ish guard directly?"""
+        parent = ctx.parent_of(call)
+        # climb through conditional wrappers: `x = (call if cond else None)`
+        # still binds the result to a (checked) name
+        while isinstance(parent, ast.IfExp):
+            parent = ctx.parent_of(parent)
+        # result = self._try_x(...)  ->  name must be None-checked
+        if isinstance(parent, ast.Assign):
+            names = [
+                n
+                for t in parent.targets
+                if isinstance(t, ast.Name)
+                for n in [t.id]
+            ]
+            return any(n in checked_names for n in names)
+        if isinstance(parent, (ast.AnnAssign,)) and isinstance(
+            parent.target, ast.Name
+        ):
+            return parent.target.id in checked_names
+        # (self._try_x(...) is None) / `or` chains with a None-checked result
+        if isinstance(parent, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+        ):
+            return True
+        if isinstance(parent, ast.BoolOp):
+            return True  # `self._try_x(...) or fallback` keeps the fallback
+        # walrus: (result := self._try_x(...)) is None
+        if isinstance(parent, ast.NamedExpr):
+            grand = ctx.parent_of(parent)
+            if isinstance(grand, ast.Compare):
+                return True
+            return (
+                isinstance(parent.target, ast.Name)
+                and parent.target.id in checked_names
+            )
+        return False

@@ -1,0 +1,257 @@
+"""REGISTRY-DRIFT: metrics and env vars must be declared and documented.
+
+Two quiet ways observability rots:
+
+1. **metrics** — an ``emit_metric("some.new.counter", 1)`` call ships
+   without anyone updating dashboards or docs; months later nobody knows
+   what feeds it.  Every emitted metric name (f-string placeholders become
+   ``*`` wildcards) must match a pattern declared in ``METRICS`` in
+   ``modin_tpu/logging/metrics.py``, every declared pattern must have a
+   live emit site, and each pattern's stable dotted prefix must appear in
+   ``docs/``.
+
+2. **env vars** — a ``MODIN_TPU_*`` variable read via raw ``os.environ``
+   bypasses ``config/envvars.py`` entirely: no default, no type checking,
+   no ``_check_vars`` typo warning, no docs.  Every ``MODIN_TPU_*`` literal
+   in the package must be a declared ``varname`` in ``config/envvars.py``,
+   and every declared varname must be mentioned in ``docs/``.
+
+Docstrings are exempt from the literal scan (prose references a knob by
+name legitimately); docs checks are skipped when the scanned tree has no
+``docs/`` directory (snippet unit tests, vendored subsets).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from modin_tpu.lint.framework import FileContext, Finding, Project, Rule, register_rule
+from modin_tpu.lint.rules._ast_utils import is_docstring
+
+METRICS_SUFFIX = "logging/metrics.py"
+ENVVARS_SUFFIX = "config/envvars.py"
+METRIC_REGISTRY_NAME = "METRICS"
+
+#: MODIN_TPU_* env var literal; the lookbehind keeps internal tokens like
+#: ``__MODIN_TPU_BT_0__`` (eval.py backtick mangling) out of the scan
+ENVVAR_RE = re.compile(r"(?<![A-Za-z0-9_])MODIN_TPU_[A-Z0-9_]+")
+
+
+def _metric_name_pattern(arg: ast.AST) -> Optional[str]:
+    """The emitted metric name with f-string placeholders as ``*``."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        out: List[str] = []
+        for piece in arg.values:
+            if isinstance(piece, ast.Constant) and isinstance(piece.value, str):
+                out.append(piece.value)
+            else:
+                out.append("*")
+        return "".join(out)
+    return None  # dynamically built name: can't check statically
+
+
+def _declared_metric_patterns(ctx: FileContext) -> Optional[Dict[str, int]]:
+    """{pattern: lineno} from ``METRICS = (("pattern", "why"), ...)``."""
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == METRIC_REGISTRY_NAME
+            for t in node.targets
+        ):
+            patterns: Dict[str, int] = {}
+            value = node.value
+            if isinstance(value, (ast.Tuple, ast.List)):
+                for entry in value.elts:
+                    if (
+                        isinstance(entry, (ast.Tuple, ast.List))
+                        and entry.elts
+                        and isinstance(entry.elts[0], ast.Constant)
+                        and isinstance(entry.elts[0].value, str)
+                    ):
+                        patterns[entry.elts[0].value] = entry.lineno
+            return patterns
+    return None
+
+
+def _declared_envvars(ctx: FileContext) -> Dict[str, int]:
+    """{varname: lineno} from ``varname = "MODIN_TPU_X"`` class attributes."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "varname"
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.value.value] = node.lineno
+    return out
+
+
+def _doc_mention_key(pattern: str) -> str:
+    """The stable dotted prefix of a metric pattern that docs must mention.
+
+    ``resilience.engine.*.*`` -> ``resilience.engine``; a fully static name
+    is its own key.
+    """
+    parts = pattern.split(".")
+    stable: List[str] = []
+    for part in parts:
+        if "*" in part:
+            break
+        stable.append(part)
+    return ".".join(stable) if stable else pattern
+
+
+@register_rule
+class RegistryDriftRule(Rule):
+    id = "REGISTRY-DRIFT"
+    description = (
+        "every emit_metric name must match the METRICS registry and every "
+        "MODIN_TPU_* env var must be declared in config/envvars.py; both "
+        "must be mentioned in docs/"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        yield from self._check_metrics(project)
+        yield from self._check_envvars(project)
+
+    # -- metrics -------------------------------------------------------- #
+
+    def _check_metrics(self, project: Project) -> Iterator[Finding]:
+        registry: Optional[Dict[str, int]] = None
+        registry_ctx: Optional[FileContext] = None
+        for ctx in project.files_matching(METRICS_SUFFIX):
+            registry = _declared_metric_patterns(ctx)
+            registry_ctx = ctx
+            if registry is not None:
+                break
+
+        emitted: List[Tuple[FileContext, ast.Call, str]] = []
+        for ctx in project.files:
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "emit_metric"
+                    and node.args
+                ):
+                    name = _metric_name_pattern(node.args[0])
+                    if name is not None:
+                        emitted.append((ctx, node, name))
+
+        if registry is None:
+            if registry_ctx is not None and emitted:
+                yield Finding(
+                    path=registry_ctx.rel,
+                    line=1,
+                    rule=self.id,
+                    message=f"no {METRIC_REGISTRY_NAME} registry found in "
+                    "the metrics module",
+                    fix_hint=f'declare {METRIC_REGISTRY_NAME} = (("pattern", '
+                    '"description"), ...) covering every emitted name',
+                    symbol="no-metric-registry",
+                )
+            return
+
+        matched_patterns: Set[str] = set()
+        for ctx, node, name in emitted:
+            hits = [p for p in registry if fnmatch.fnmatchcase(name, p)]
+            if hits:
+                matched_patterns.update(hits)
+                continue
+            yield Finding(
+                path=ctx.rel,
+                line=node.lineno,
+                rule=self.id,
+                message=f"metric '{name}' matches no pattern in "
+                f"{METRIC_REGISTRY_NAME} ({METRICS_SUFFIX})",
+                fix_hint="declare the metric (pattern, description) in the "
+                "registry and document it",
+                scope=ctx.scope_of(node),
+                symbol=f"undeclared-metric-{name}",
+            )
+
+        docs = project.docs_text() if project.has_docs() else None
+        for pattern, lineno in sorted(registry.items()):
+            if pattern not in matched_patterns:
+                yield Finding(
+                    path=registry_ctx.rel,
+                    line=lineno,
+                    rule=self.id,
+                    message=f"metric pattern '{pattern}' is declared but no "
+                    "emit_metric call matches it",
+                    fix_hint="remove the dead registry entry or restore the "
+                    "emit site",
+                    symbol=f"dead-metric-{pattern}",
+                )
+            if docs is not None and _doc_mention_key(pattern) not in docs:
+                yield Finding(
+                    path=registry_ctx.rel,
+                    line=lineno,
+                    rule=self.id,
+                    message=f"metric '{pattern}' (prefix "
+                    f"'{_doc_mention_key(pattern)}') is not mentioned in "
+                    "docs/",
+                    fix_hint="document the metric family "
+                    "(docs/configuration.md has the catalog)",
+                    symbol=f"undocumented-metric-{pattern}",
+                )
+
+    # -- env vars ------------------------------------------------------- #
+
+    def _check_envvars(self, project: Project) -> Iterator[Finding]:
+        declared: Optional[Dict[str, int]] = None
+        envvars_ctx: Optional[FileContext] = None
+        for ctx in project.files_matching(ENVVARS_SUFFIX):
+            declared = _declared_envvars(ctx)
+            envvars_ctx = ctx
+            break
+        if declared is None:
+            return  # no envvars module in this tree: nothing to check against
+
+        for ctx in project.files:
+            if ctx is envvars_ctx:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (
+                    isinstance(node, ast.Constant) and isinstance(node.value, str)
+                ):
+                    continue
+                if is_docstring(ctx.parents, node):
+                    continue
+                for var in ENVVAR_RE.findall(node.value):
+                    if var not in declared:
+                        yield Finding(
+                            path=ctx.rel,
+                            line=node.lineno,
+                            rule=self.id,
+                            message=f"env var '{var}' is read/written but "
+                            f"not declared in {ENVVARS_SUFFIX}",
+                            fix_hint="add an EnvironmentVariable subclass "
+                            "with this varname (default, type, docstring) "
+                            "and read it through the config layer",
+                            scope=ctx.scope_of(node),
+                            symbol=f"undeclared-envvar-{var}",
+                        )
+
+        if project.has_docs():
+            docs = project.docs_text()
+            for var, lineno in sorted(declared.items()):
+                if var not in docs:
+                    yield Finding(
+                        path=envvars_ctx.rel,
+                        line=lineno,
+                        rule=self.id,
+                        message=f"declared env var '{var}' is not mentioned "
+                        "in docs/",
+                        fix_hint="add it to the configuration reference "
+                        "(docs/configuration.md)",
+                        symbol=f"undocumented-envvar-{var}",
+                    )

@@ -13,6 +13,48 @@ from modin_tpu.config import MetricsMode
 _metric_handlers: list = []
 _metric_name_pattern = re.compile(r"^[a-zA-Z0-9\-_\.]+$")
 
+#: Registry of every metric family this package emits (name pattern, what it
+#: counts).  ``*`` stands for a runtime-interpolated segment (an engine op,
+#: a breaker family, a failure kind).  graftlint's REGISTRY-DRIFT rule
+#: cross-checks this both ways — an ``emit_metric`` name matching no pattern,
+#: or a pattern with no live emit site, fails the lint — and requires each
+#: family's stable prefix to appear in docs/ (see docs/configuration.md).
+METRICS = (
+    (
+        "resilience.engine.*.*",
+        "engine-seam outcomes per op: oom / device_lost / transient / "
+        "watchdog_timeout classifications and retry attempts",
+    ),
+    (
+        "resilience.watchdog.*.timeout",
+        "materialize/wait attempts killed by the wall-clock watchdog",
+    ),
+    (
+        "resilience.breaker.*.*",
+        "circuit-breaker lifecycle per device-path family: state "
+        "transitions (open/half_open/closed), strikes, latency-budget "
+        "violations (slow), and open-breaker short_circuits",
+    ),
+    (
+        "resilience.fallback.*.*",
+        "device failures converted to pandas fallbacks, per family and "
+        "failure kind",
+    ),
+    (
+        "resilience.shuffle.slack_retry",
+        "range_shuffle capacity overflows retried with doubled slack",
+    ),
+    (
+        "resilience.shuffle.skew_fallback",
+        "range_shuffle giving up on pathologically skewed keys "
+        "(ShuffleSkewError -> non-shuffle fallback)",
+    ),
+    (
+        "pandas-api.*",
+        "wall-clock seconds per public pandas-API call (logging layer)",
+    ),
+)
+
 
 def emit_metric(name: str, value: Union[int, float]) -> None:
     """Send ``modin_tpu.<name> = value`` to every registered handler."""

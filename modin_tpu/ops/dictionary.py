@@ -108,7 +108,7 @@ def encode_categorical_column(col: Any) -> Optional[DictEncoding]:
         cat = col.data
         codes = np.asarray(cat.codes)
         categories = np.asarray(cat.categories)
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- host pandas Categorical probe; any failure means 'not encodable'
         col._cat_cache = False
         return None
     fcodes = codes.astype(np.float64)

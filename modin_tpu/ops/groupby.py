@@ -20,6 +20,7 @@ when ``dropna=True``.
 from __future__ import annotations
 
 import functools
+from types import MappingProxyType
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -240,7 +241,9 @@ def factorize_keys(
             return codes, n_groups, [uniques_host], None
         if jnp.issubdtype(kdt, jnp.floating):
             k_prepped, has_nan = _jit_float_prep(n)(k)
-            has_nan = bool(has_nan)
+            # the nan flag is a device scalar: fetch it through the seam so a
+            # device failure here classifies/retries instead of surfacing raw
+            has_nan = bool(_engine_materialize(has_nan))
             uniques, codes = jnp.unique(k_prepped, return_inverse=True)
             uniques_host = np.asarray(_engine_materialize(uniques))
             n_valid = int(np.sum(~np.isnan(uniques_host)))
@@ -820,16 +823,15 @@ def _jit_masked_scan_smc(
     return jax.jit(fn)
 
 
-_INT_MAXES = {
-    k: np.iinfo(k).max
-    for k in ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
-}
-_INT_MAXES["bool"] = True
-_INT_MINS = {
-    k: np.iinfo(k).min
-    for k in ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
-}
-_INT_MINS["bool"] = False
+# read from inside jitted bodies (masked-scan min/max neutrals): immutable so
+# tracing can't bake in contents that a later mutation would silently miss
+_INT_KINDS = ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
+_INT_MAXES = MappingProxyType(
+    {**{k: np.iinfo(k).max for k in _INT_KINDS}, "bool": True}
+)
+_INT_MINS = MappingProxyType(
+    {**{k: np.iinfo(k).min for k in _INT_KINDS}, "bool": False}
+)
 
 
 @functools.lru_cache(maxsize=None)

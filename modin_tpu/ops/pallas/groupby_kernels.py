@@ -116,6 +116,6 @@ def bincount_supported(codes: Any, num_groups: int) -> bool:
         return False
     try:
         platform = next(iter(codes.devices())).platform
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- device-platform probe; any failure means 'no pallas path'
         return False
     return platform == "tpu"

@@ -60,7 +60,7 @@ def initialize_jax() -> None:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        except Exception:  # pragma: no cover - cache is best-effort
+        except Exception:  # pragma: no cover - cache is best-effort  # graftlint: disable=EXC-HYGIENE -- persistent-compile-cache setup is best-effort; failure = no cache
             pass
 
 

@@ -6,6 +6,10 @@
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
+#   0. graftlint: AST invariant checks (device/host seam, jit hazards,
+#      fallback parity, exception hygiene, registry drift) — exits nonzero
+#      on any new finding, printed as clickable path:line: RULE lines.
+#      Intentional burn-downs: python -m modin_tpu.lint --baseline-write
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -29,6 +33,7 @@ run_gate() {
   fi
 }
 
+run_gate "graftlint"       python -m modin_tpu.lint modin_tpu/
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -38,4 +43,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL FOUR GATES GREEN"
+echo "ALL FIVE GATES GREEN"

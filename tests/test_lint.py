@@ -1,0 +1,596 @@
+"""Tier-1 acceptance for graftlint (modin_tpu/lint/).
+
+Two layers:
+
+1. the real tree: ``python -m modin_tpu.lint modin_tpu/`` must be clean —
+   zero non-baselined findings with all five rules active (the PR-1 seam
+   invariants are enforced, not aspirational);
+2. each rule is unit-tested against small positive AND negative snippets in
+   throwaway trees mirroring the package layout, plus the framework's
+   pragma and baseline suppression behavior.
+"""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from modin_tpu.lint import all_rules, run_lint
+from modin_tpu.lint.framework import write_baseline
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+BASELINE = REPO_ROOT / ".graftlint-baseline"
+
+ALL_RULE_IDS = {
+    "HOST-SYNC",
+    "JIT-HAZARD",
+    "FALLBACK-PARITY",
+    "EXC-HYGIENE",
+    "REGISTRY-DRIFT",
+}
+
+
+def lint_tree(tmp_path, files, select=None, baseline=None):
+    """Materialize ``{relpath: source}`` under tmp_path and lint it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_lint([tmp_path], root=tmp_path, select=select, baseline=baseline)
+
+
+def rules_hit(result):
+    return {f.rule for f in result.findings}
+
+
+# ---------------------------------------------------------------------- #
+# the real tree
+# ---------------------------------------------------------------------- #
+
+
+def test_all_five_rules_registered():
+    assert ALL_RULE_IDS <= set(all_rules())
+
+
+def test_full_tree_is_clean():
+    result = run_lint(["modin_tpu"], root=REPO_ROOT, baseline=BASELINE)
+    rendered = "\n".join(f.render() for f in result.findings)
+    assert not result.findings, (
+        "graftlint violations in modin_tpu/ (fix them, pragma them with a "
+        "reason, or — for intentional burn-downs only — baseline them):\n"
+        + rendered
+    )
+    assert not result.stale_baseline, (
+        "stale baseline entries (the violation is gone; remove the line): "
+        f"{result.stale_baseline}"
+    )
+
+
+def test_cli_runs_clean_and_prints_summary():
+    proc = subprocess.run(
+        [sys.executable, "-m", "modin_tpu.lint", "modin_tpu/"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftlint: 0 finding(s)" in proc.stdout
+
+
+# ---------------------------------------------------------------------- #
+# HOST-SYNC
+# ---------------------------------------------------------------------- #
+
+
+def test_host_sync_flags_raw_seam_primitives(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def fetch(x):
+                jax.block_until_ready(x).block_until_ready()
+                return jax.device_get(x)
+            """
+        },
+        select=["HOST-SYNC"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "device_get" in symbols
+    assert "block_until_ready" in symbols
+
+
+def test_host_sync_flags_device_value_coercion(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(col, n):
+                total = jnp.sum(col)
+                flag = _jit_prep(n)(col)
+                a = float(total)          # BAD: device scalar coercion
+                b = bool(flag)            # BAD: jit-output coercion
+                c = np.asarray(jnp.cumsum(col))   # BAD: direct asarray
+                d = total.item()          # BAD: item() sync
+                return a, b, c, d
+            """
+        },
+        select=["HOST-SYNC"],
+    )
+    lines = sorted(f.line for f in result.findings)
+    assert len(result.findings) == 4, [f.render() for f in result.findings]
+    assert lines == [8, 9, 10, 11]
+
+
+def test_host_sync_negative_materialized_and_metadata(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax.numpy as jnp
+            import numpy as np
+            from modin_tpu.parallel.engine import materialize as _engine_materialize
+
+            def f(col, n):
+                total = jnp.sum(col)
+                host = _engine_materialize(total)
+                a = float(host)                  # ok: host value
+                b = int(total.shape[0])          # ok: static metadata
+                positions, counts = _engine_materialize(_jit_k(n)(col))
+                c = np.asarray(positions[: 3])   # ok: materialized upstream
+                is_f = jnp.issubdtype(col.dtype, jnp.floating)
+                d = bool(is_f)                   # ok: issubdtype is host
+                return a, b, c, d
+            """
+        },
+        select=["HOST-SYNC"],
+    )
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_host_sync_exempts_seam_modules(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/parallel/engine.py": """
+            import jax
+
+            def materialize(refs):
+                return jax.device_get(refs)
+            """
+        },
+        select=["HOST-SYNC"],
+    )
+    assert not result.findings
+
+
+# ---------------------------------------------------------------------- #
+# JIT-HAZARD
+# ---------------------------------------------------------------------- #
+
+
+def test_jit_hazard_positive_all_four_classes(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            _TABLE = {"a": 1}
+
+            def make():
+                def fn(x, k):
+                    if k > 0:                 # BAD: traced control flow
+                        x = x + 1
+                    out = jnp.zeros(k)        # BAD: traced shape
+                    m = jnp.sum(x)
+                    for i in range(m):        # BAD: traced range
+                        out = out + i
+                    return out + _TABLE["a"]  # BAD: mutable closure
+                return jax.jit(fn)
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert {"fn-branch-if", "fn-shape-zeros", "fn-shape-range", "fn-closure-_TABLE"} <= symbols
+
+
+def test_jit_hazard_negative_statics_and_metadata(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+            import jax.numpy as jnp
+            from functools import partial
+
+            def make(n, width):
+                def fn(x, k):
+                    if width > 4:                # ok: closure constant
+                        x = x * 2
+                    L = x.shape[0]               # ok: static metadata
+                    out = jnp.zeros(L) + jnp.zeros(k)   # ok: k is static
+                    if jnp.issubdtype(x.dtype, jnp.floating):  # ok: dtype
+                        out = out + 1
+                    flag = jnp.isnan(x) if n else None
+                    if flag is not None:         # ok: identity vs None
+                        out = out + flag
+                    g = jnp.broadcast_to(x[:, None], out.shape)  # ok: .shape
+                    return out, g
+                return jax.jit(fn, static_argnums=(1,))
+
+            @partial(jax.jit, static_argnames=("k",))
+            def decorated(x, k):
+                return jnp.zeros(k) + x          # ok: static by name
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_jit_hazard_sees_through_shard_map(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def make(mesh):
+                def local_fn(shard, k):
+                    if shard > 0:     # BAD: traced branch inside shard_map
+                        shard = -shard
+                    return shard
+                return jax.jit(shard_map(local_fn, mesh=mesh))
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    assert {f.symbol for f in result.findings} == {"local_fn-branch-if"}
+
+
+# ---------------------------------------------------------------------- #
+# FALLBACK-PARITY
+# ---------------------------------------------------------------------- #
+
+_RESILIENCE_STUB = """
+DEVICE_PATH_FAMILIES = frozenset({"binary", "reduce", "ghost"})
+"""
+
+
+def test_fallback_parity_positive(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/core/execution/resilience.py": _RESILIENCE_STUB,
+            "modin_tpu/core/storage_formats/tpu/query_compiler.py": """
+            class TpuQueryCompiler:
+                def _try_naked(self):          # BAD: no decorator
+                    return None
+
+                @device_path("unheard_of")     # BAD: family not registered
+                def _try_rogue(self):
+                    return None
+
+                @device_path("binary")
+                def _try_binary(self, op):
+                    return None
+
+                def add(self, other):
+                    return self._try_binary("add")   # BAD: no None check,
+                                                     # not a forwarder-only use
+                """,
+        },
+        select=["FALLBACK-PARITY"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "undec-_try_naked" in symbols
+    assert "unregistered-_try_rogue" in symbols
+    # declared-but-unused family in the registry is drift too
+    assert "unused-family-ghost" in symbols
+    assert "unused-family-unheard_of" not in symbols
+
+
+def test_fallback_parity_negative_checked_and_forwarded(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/core/execution/resilience.py": """
+            DEVICE_PATH_FAMILIES = frozenset({"binary", "reduce"})
+            """,
+            "modin_tpu/core/storage_formats/tpu/query_compiler.py": """
+            class TpuQueryCompiler:
+                @device_path("binary")
+                def _try_binary(self, op):
+                    return None
+
+                @device_path("reduce")
+                def _try_reduce(self, op):
+                    r = self._try_binary(op)     # ok: _try_ -> _try_ checked
+                    if r is not None:
+                        return r
+                    return None
+
+                def _dispatch(self, op):
+                    return self._try_reduce(op)  # ok: forwarder (direct return)
+
+                def sum(self, op):
+                    result = self._try_reduce(op)
+                    if result is not None:       # ok: checked
+                        return result
+                    return "pandas"
+
+                def mean(self, op):
+                    result = (
+                        self._try_reduce(op) if op else None
+                    )
+                    if result is not None:       # ok: checked through IfExp
+                        return result
+                    return "pandas"
+
+                def max_(self, op):
+                    result = self._dispatch(op)  # ok: forwarder's caller checks
+                    if result is not None:
+                        return result
+                    return "pandas"
+                """,
+        },
+        select=["FALLBACK-PARITY"],
+    )
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+# ---------------------------------------------------------------------- #
+# EXC-HYGIENE
+# ---------------------------------------------------------------------- #
+
+
+def test_exc_hygiene_positive_and_scope(tmp_path):
+    files = {
+        "modin_tpu/core/thing.py": """
+        def f():
+            try:
+                g()
+            except Exception:      # BAD: audited tree
+                pass
+            try:
+                g()
+            except (ValueError, TypeError):   # ok: named semantic types
+                pass
+        """,
+        "modin_tpu/pandas/api.py": """
+        def f():
+            try:
+                g()
+            except Exception:      # ok: pandas layer is out of scope
+                pass
+        """,
+    }
+    result = lint_tree(tmp_path, files, select=["EXC-HYGIENE"])
+    assert [f.path for f in result.findings] == ["modin_tpu/core/thing.py"]
+    assert result.findings[0].symbol == "broad-except-f"
+
+
+def test_exc_hygiene_pragma_suppresses(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/core/thing.py": """
+            def probe():
+                try:
+                    g()
+                except Exception:  # graftlint: disable=EXC-HYGIENE -- probe
+                    return None
+            """
+        },
+        select=["EXC-HYGIENE"],
+    )
+    assert not result.findings
+    assert len(result.suppressed) == 1
+
+
+# ---------------------------------------------------------------------- #
+# REGISTRY-DRIFT
+# ---------------------------------------------------------------------- #
+
+_METRICS_STUB = """
+METRICS = (
+    ("app.good.*", "a documented family"),
+    ("app.dead.counter", "declared but never emitted"),
+)
+"""
+
+_ENVVARS_STUB = """
+class Alpha:
+    varname = "MODIN_TPU_ALPHA"
+
+class Undocumented:
+    varname = "MODIN_TPU_GHOST_KNOB"
+"""
+
+
+def test_registry_drift_positive(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/logging/metrics.py": _METRICS_STUB,
+            "modin_tpu/config/envvars.py": _ENVVARS_STUB,
+            "docs/ref.md": "app.good and MODIN_TPU_ALPHA are documented.",
+            "modin_tpu/work.py": """
+            import os
+
+            def f(op):
+                emit_metric(f"app.good.{op}", 1)       # ok
+                emit_metric("app.unknown.name", 1)     # BAD: undeclared
+                return os.environ.get("MODIN_TPU_MYSTERY")   # BAD: undeclared
+            """,
+        },
+        select=["REGISTRY-DRIFT"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "undeclared-metric-app.unknown.name" in symbols
+    assert "dead-metric-app.dead.counter" in symbols
+    assert "undeclared-envvar-MODIN_TPU_MYSTERY" in symbols
+    assert "undocumented-envvar-MODIN_TPU_GHOST_KNOB" in symbols
+    # dead pattern is also undocumented; the good family + ALPHA are fine
+    assert "undocumented-metric-app.good.*" not in symbols
+    assert "undocumented-envvar-MODIN_TPU_ALPHA" not in symbols
+
+
+def test_registry_drift_negative_docstrings_and_internal_tokens(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/config/envvars.py": """
+            class Alpha:
+                varname = "MODIN_TPU_ALPHA"
+            """,
+            "modin_tpu/work.py": '''
+            """Module docstring naming MODIN_TPU_NOT_A_READ is fine."""
+
+            def f(i):
+                return f"__MODIN_TPU_BT_{i}__"   # mangling token, not a var
+            ''',
+        },
+        select=["REGISTRY-DRIFT"],
+    )
+    # no docs/ dir -> doc checks skip; no undeclared-var findings either
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+# ---------------------------------------------------------------------- #
+# framework: pragmas and baseline
+# ---------------------------------------------------------------------- #
+
+
+def test_pragma_on_preceding_line_suppresses(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def f(x):
+                # graftlint: disable=HOST-SYNC
+                return jax.device_get(x)
+            """
+        },
+        select=["HOST-SYNC"],
+    )
+    assert not result.findings
+    assert len(result.suppressed) == 1
+
+
+def test_unused_pragma_is_flagged_on_full_runs(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            def f():
+                # graftlint: disable=HOST-SYNC
+                return 1
+            """
+        },
+    )
+    assert rules_hit(result) == {"GL-PRAGMA-UNUSED"}
+
+
+def test_baseline_suppresses_then_goes_stale(tmp_path):
+    files = {
+        "pkg/mod.py": """
+        import jax
+
+        def f(x):
+            return jax.device_get(x)
+        """
+    }
+    first = lint_tree(tmp_path, files)
+    assert len(first.findings) == 1
+
+    baseline = tmp_path / ".graftlint-baseline"
+    write_baseline(baseline, first.findings)
+    second = run_lint([tmp_path], root=tmp_path, baseline=baseline)
+    assert not second.findings
+    assert len(second.baselined) == 1
+    assert second.exit_code == 0
+
+    # a --select run never regenerates the entry: it must NOT cry stale
+    selected = run_lint(
+        [tmp_path], root=tmp_path, select=["JIT-HAZARD"], baseline=baseline
+    )
+    assert not selected.stale_baseline
+    assert selected.exit_code == 0
+
+    # fix the violation: the baseline entry is now stale and fails the run
+    (tmp_path / "pkg" / "mod.py").write_text("def f(x):\n    return x\n")
+    third = run_lint([tmp_path], root=tmp_path, baseline=baseline)
+    assert not third.findings
+    assert len(third.stale_baseline) == 1
+    assert third.exit_code == 1
+
+
+def test_unused_pragma_can_be_baselined(tmp_path):
+    """--baseline-write must produce a baseline the very next run accepts,
+    including GL-PRAGMA-UNUSED findings."""
+    files = {
+        "pkg/mod.py": """
+        def f():
+            # graftlint: disable=HOST-SYNC
+            return 1
+        """
+    }
+    first = lint_tree(tmp_path, files)
+    assert rules_hit(first) == {"GL-PRAGMA-UNUSED"}
+    baseline = tmp_path / ".graftlint-baseline"
+    write_baseline(baseline, first.findings)
+    second = run_lint([tmp_path], root=tmp_path, baseline=baseline)
+    assert not second.findings
+    assert not second.stale_baseline
+    assert second.exit_code == 0
+
+
+def test_cli_baseline_write_roundtrip(tmp_path):
+    mod = tmp_path / "pkg" / "mod.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text("import jax\n\ndef f(x):\n    return jax.device_get(x)\n")
+    (tmp_path / "pyproject.toml").write_text("")
+    baseline = tmp_path / "bl"
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "modin_tpu.lint", str(tmp_path),
+             "--root", str(tmp_path), "--baseline", str(baseline), *args],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+
+    red = cli()
+    assert red.returncode == 1
+    # clickable path:line: RULE format
+    assert "pkg/mod.py:4: HOST-SYNC" in red.stdout
+
+    wrote = cli("--baseline-write")
+    assert wrote.returncode == 0
+    assert baseline.exists()
+
+    green = cli()
+    assert green.returncode == 0, green.stdout
+
+
+def test_parse_failure_is_a_finding_not_a_crash(tmp_path):
+    result = lint_tree(tmp_path, {"pkg/bad.py": "def f(:\n"})
+    assert rules_hit(result) == {"GL-PARSE"}
+
+
+def test_unknown_select_rule_raises():
+    with pytest.raises(ValueError, match="NO-SUCH-RULE"):
+        run_lint(["modin_tpu"], root=REPO_ROOT, select=["NO-SUCH-RULE"])
