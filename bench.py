@@ -99,6 +99,13 @@ def _emit_line(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+# Optional graftscope attribution: BENCH_TRACE_DIR=<dir> writes one
+# chrome://tracing-loadable {section}.trace.json per section next to its
+# timing line, so a BENCH_*.json delta comes with host/device/compile
+# attribution instead of a bare number.
+TRACE_DIR = os.environ.get("BENCH_TRACE_DIR", "")
+
+
 def run_section(name: str, fn, timeout_s: float = None):
     """Run one section under a SIGALRM budget; stream its json line.
 
@@ -114,12 +121,25 @@ def run_section(name: str, fn, timeout_s: float = None):
     def on_alarm(signum, frame):
         raise SectionTimeout(name)
 
+    import contextlib
+
+    trace_extra = {}
+    if TRACE_DIR:
+        import modin_tpu.observability as _graftscope
+
+        profile_cm = _graftscope.profile()
+    else:
+        profile_cm = contextlib.nullcontext()
+
     previous = None
     if budget > 0:
         previous = signal.signal(signal.SIGALRM, on_alarm)
         signal.setitimer(signal.ITIMER_REAL, budget)
+    prof = None
     try:
-        result = fn()
+        with profile_cm as prof:
+            result = fn()
+        elapsed = time.perf_counter() - t0
     except SectionTimeout:
         _emit_line({
             "section": name,
@@ -136,9 +156,29 @@ def run_section(name: str, fn, timeout_s: float = None):
         if budget > 0:
             signal.setitimer(signal.ITIMER_REAL, 0.0)
             signal.signal(signal.SIGALRM, previous)
+    # export AFTER the alarm is disarmed and elapsed is captured: a slow
+    # trace write must neither trip the section's timeout nor inflate its
+    # reported number
+    if TRACE_DIR and prof is not None:
+        try:
+            path = prof.export_chrome_trace(
+                os.path.join(TRACE_DIR, f"{name}.trace.json")
+            )
+            rollup = prof.rollup()
+            trace_extra = {
+                "trace_artifact": path,
+                "trace_rollup": {
+                    k: round(v, 4)
+                    for k, v in rollup.items()
+                    if isinstance(v, (int, float))
+                },
+            }
+        except Exception as exc:
+            trace_extra = {"trace_error": f"{type(exc).__name__}: {exc}"[:200]}
     _emit_line({
         "section": name,
-        "elapsed_s": round(time.perf_counter() - t0, 1),
+        "elapsed_s": round(elapsed, 1),
+        **trace_extra,
         **result,
     })
     return result

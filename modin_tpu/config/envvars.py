@@ -554,6 +554,51 @@ class ResilienceLatencyBudgetS(EnvironmentVariable, type=float):
     default = 0.0
 
 
+class TraceEnabled(EnvironmentVariable, type=bool):
+    """graftscope structured tracing: spans at the API / query-compiler /
+    engine-seam / shuffle-IO layers, the compile ledger's hit accounting,
+    and the flight-recorder ring.
+
+    Off by default: the disabled mode costs one module-attribute check per
+    instrumented call and allocates no span objects.  ``profile()``
+    activates collection for its block regardless of this switch.
+    """
+
+    varname = "MODIN_TPU_TRACE"
+    default = False
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+
+class TraceFlightRecorderSize(EnvironmentVariable, type=int):
+    """How many recent spans the flight-recorder ring buffer retains while
+    tracing is on (0 disables the ring and its fault dumps)."""
+
+    varname = "MODIN_TPU_TRACE_FLIGHT_RECORDER_SIZE"
+    default = 1024
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Flight recorder size should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class TraceDir(EnvironmentVariable, type=ExactStr):
+    """Directory flight-recorder trace dumps are written to."""
+
+    varname = "MODIN_TPU_TRACE_DIR"
+    default = ".modin_tpu/traces"
+
+
 class DocModule(EnvironmentVariable, type=ExactStr):
     """Alternate module to source API docstrings from (reference: envvars.py:1338)."""
 

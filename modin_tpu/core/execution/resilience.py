@@ -53,6 +53,8 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import spans as graftscope
+from modin_tpu.observability.flight_recorder import dump_flight_record
 
 # test seams: the suite patches these to run breaker-cooldown / backoff
 # scenarios without wall-clock sleeps
@@ -170,8 +172,14 @@ def _run_with_watchdog(op: str, thunk: Callable[[], Any], timeout_s: float) -> A
     expiry raises WatchdogTimeout and abandons the thread.
     """
     result_q: "queue.Queue" = queue.Queue()
+    # propagate span context onto the worker: spans/compile-attribution in
+    # the thunk nest under the caller's call chain instead of floating
+    # parentless
+    parent_stack = graftscope.snapshot_stack() if graftscope.TRACE_ON else None
 
     def runner() -> None:
+        if parent_stack is not None:
+            graftscope.seed_thread(parent_stack)
         try:
             result_q.put((True, thunk()))
         except BaseException as err:  # noqa: BLE001 - relayed to caller  # graftlint: disable=EXC-HYGIENE -- watchdog thread relays ANY exception to the waiting caller verbatim
@@ -224,20 +232,63 @@ def engine_call(op: str, thunk: Callable[[], Any], watchdog: bool = False) -> An
     backoff_s = float(ResilienceBackoffS.get())
     attempt = 0
     while True:
+        sp = compiles_before = None
+        if graftscope.TRACE_ON:
+            sp = graftscope.start_span(
+                f"engine.{op}.attempt",
+                layer="JAX-ENGINE",
+                attrs={"op": op, "attempt": attempt},
+            )
+            if op == "deploy":
+                from modin_tpu.observability.compile_ledger import (
+                    compiles_on_this_thread,
+                )
+
+                compiles_before = compiles_on_this_thread()
         try:
             if timeout_s > 0:
-                return _run_with_watchdog(op, attempt_once, timeout_s)
-            return attempt_once()
+                result = _run_with_watchdog(op, attempt_once, timeout_s)
+            else:
+                result = attempt_once()
         except Exception as err:  # graftlint: disable=EXC-HYGIENE -- the classification point: catches broadly, re-raises non-device errors
             failure = classify_device_error(err)
+            if sp is not None:
+                sp.attrs["failure_kind"] = (
+                    failure.kind if failure is not None else type(err).__name__
+                )
+                graftscope.finish_span(sp, status="error")
             if failure is None:
                 raise
             emit_metric(f"resilience.engine.{op}.{failure.kind}", 1)
             if not isinstance(failure, TransientDeviceError) or attempt >= retries:
+                # terminal for this call: preserve the trace that led here
+                if dump_flight_record(f"terminal_{failure.kind}", detail=op):
+                    emit_metric("trace.flight_dump", 1)
                 raise failure from err
             attempt += 1
             emit_metric(f"resilience.engine.{op}.retry", 1)
             _sleep(backoff_s * (2 ** (attempt - 1)))
+            continue
+        except BaseException:  # graftlint: disable=EXC-HYGIENE -- span-stack unwind only (KeyboardInterrupt, bench SIGALRM); re-raised immediately
+            # a non-Exception unwind (Ctrl-C, SectionTimeout) must still pop
+            # the attempt span or every later span on this thread parents
+            # under a stale entry
+            if sp is not None:
+                graftscope.finish_span(sp, status="error")
+            raise
+        if sp is not None:
+            if compiles_before is not None:
+                from modin_tpu.observability.compile_ledger import (
+                    compiles_on_this_thread,
+                    get_compile_ledger,
+                )
+
+                get_compile_ledger().record_dispatch(
+                    graftscope.attribution_signature(),
+                    compiled=compiles_on_this_thread() > compiles_before,
+                )
+            graftscope.finish_span(sp)
+        return result
 
 
 # ---------------------------------------------------------------------- #
@@ -306,9 +357,20 @@ class CircuitBreaker:
 
         return float(ResilienceBreakerCooldownS.get())
 
-    def _transition(self, state: str) -> None:
+    def _transition(self, state: str) -> bool:
+        """Record the state change; returns True when it opened (the caller
+        dumps the flight record AFTER releasing the breaker lock — disk IO
+        under the lock would stall every thread short-circuiting on it)."""
         self.state = state
         emit_metric(f"resilience.breaker.{self.name}.{state}", 1)
+        return state == OPEN
+
+    def _dump_open(self) -> None:
+        """Flight-record a trip to OPEN: the spans that led up to the
+        degradation (no-op unless tracing is on; rate-limited; never
+        raises).  Must be called WITHOUT the breaker lock held."""
+        if dump_flight_record(f"breaker_open_{self.name}"):
+            emit_metric("trace.flight_dump", 1)
 
     # -- protocol ------------------------------------------------------ #
 
@@ -347,22 +409,28 @@ class CircuitBreaker:
         (an unclassified exception escaped).  Return to OPEN with a fresh
         cooldown — staying HALF_OPEN would short-circuit the family forever,
         since only a probe can leave that state."""
+        opened = False
         with self._lock:
             if self.state == HALF_OPEN:
                 self.opened_at = _now()
-                self._transition(OPEN)
+                opened = self._transition(OPEN)
+        if opened:
+            self._dump_open()
 
     def _strike(self) -> None:
+        opened = False
         with self._lock:
             self.strikes += 1
             emit_metric(f"resilience.breaker.{self.name}.strike", 1)
             if self.state == HALF_OPEN:
                 # failed probe: straight back to OPEN, fresh cooldown
                 self.opened_at = _now()
-                self._transition(OPEN)
+                opened = self._transition(OPEN)
             elif self.state == CLOSED and self.strikes >= self._threshold():
                 self.opened_at = _now()
-                self._transition(OPEN)
+                opened = self._transition(OPEN)
+        if opened:
+            self._dump_open()
 
 
 _BREAKERS: Dict[str, CircuitBreaker] = {}
@@ -415,6 +483,14 @@ def device_path(family: str) -> Callable:
             breaker = get_breaker(family)
             if not breaker.allow():
                 emit_metric(f"resilience.breaker.{family}.short_circuit", 1)
+                if graftscope.TRACE_ON:
+                    graftscope.finish_span(
+                        graftscope.start_span(
+                            f"fallback.{family}",
+                            layer="QUERY-COMPILER",
+                            attrs={"family": family, "reason": "short_circuit"},
+                        )
+                    )
                 return None
             start = _now()
             try:
@@ -430,6 +506,14 @@ def device_path(family: str) -> Callable:
                     raise
                 breaker.record_failure()
                 emit_metric(f"resilience.fallback.{family}.{failure.kind}", 1)
+                if graftscope.TRACE_ON:
+                    graftscope.finish_span(
+                        graftscope.start_span(
+                            f"fallback.{family}",
+                            layer="QUERY-COMPILER",
+                            attrs={"family": family, "reason": failure.kind},
+                        )
+                    )
                 return None
             breaker.record_success(_now() - start)
             return result

@@ -12,6 +12,7 @@ import os
 from typing import Any, Optional
 
 from modin_tpu.logging import ClassLogger
+from modin_tpu.observability import spans as graftscope
 
 NOT_IMPLEMENTED_MESSAGE = "Implement in children classes!"
 
@@ -28,8 +29,9 @@ class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
         file descriptors (reference guard: modin/config/envvars.py:893)."""
         from modin_tpu.utils.file_leaks import track_file_leaks
 
-        with track_file_leaks():
-            return cls._read(*args, **kwargs)
+        with graftscope.span("io.read", layer="CORE-IO", dispatcher=cls.__name__):
+            with track_file_leaks():
+                return cls._read(*args, **kwargs)
 
     @classmethod
     def _read(cls, *args: Any, **kwargs: Any):

@@ -1,6 +1,6 @@
-"""REGISTRY-DRIFT: metrics and env vars must be declared and documented.
+"""REGISTRY-DRIFT: metrics, spans, and env vars must be declared/documented.
 
-Two quiet ways observability rots:
+Three quiet ways observability rots:
 
 1. **metrics** — an ``emit_metric("some.new.counter", 1)`` call ships
    without anyone updating dashboards or docs; months later nobody knows
@@ -10,7 +10,15 @@ Two quiet ways observability rots:
    live emit site, and each pattern's stable dotted prefix must appear in
    ``docs/``.
 
-2. **env vars** — a ``MODIN_TPU_*`` variable read via raw ``os.environ``
+2. **spans** — graftscope's statically-named span emissions
+   (``graftscope.span("...")`` / ``graftscope.start_span("...")``) are held
+   to the same contract against the ``SPANS`` registry in
+   ``modin_tpu/observability/spans.py``: undeclared span name, dead
+   registry pattern, or undocumented family all fail.  Runtime-built names
+   go through ``layer_span`` and are exempt (they are covered by the
+   layer-tag taxonomy, not the registry).
+
+3. **env vars** — a ``MODIN_TPU_*`` variable read via raw ``os.environ``
    bypasses ``config/envvars.py`` entirely: no default, no type checking,
    no ``_check_vars`` typo warning, no docs.  Every ``MODIN_TPU_*`` literal
    in the package must be a declared ``varname`` in ``config/envvars.py``,
@@ -32,8 +40,14 @@ from modin_tpu.lint.framework import FileContext, Finding, Project, Rule, regist
 from modin_tpu.lint.rules._ast_utils import is_docstring
 
 METRICS_SUFFIX = "logging/metrics.py"
+SPANS_SUFFIX = "observability/spans.py"
 ENVVARS_SUFFIX = "config/envvars.py"
 METRIC_REGISTRY_NAME = "METRICS"
+SPAN_REGISTRY_NAME = "SPANS"
+
+#: function names whose first string argument is a registry-checked span
+#: name (the dynamic-name emitter ``layer_span`` is deliberately absent)
+SPAN_EMITTER_NAMES = frozenset({"span", "start_span"})
 
 #: MODIN_TPU_* env var literal; the lookbehind keeps internal tokens like
 #: ``__MODIN_TPU_BT_0__`` (eval.py backtick mangling) out of the scan
@@ -55,11 +69,13 @@ def _metric_name_pattern(arg: ast.AST) -> Optional[str]:
     return None  # dynamically built name: can't check statically
 
 
-def _declared_metric_patterns(ctx: FileContext) -> Optional[Dict[str, int]]:
-    """{pattern: lineno} from ``METRICS = (("pattern", "why"), ...)``."""
+def _declared_patterns(
+    ctx: FileContext, registry_name: str
+) -> Optional[Dict[str, int]]:
+    """{pattern: lineno} from ``<NAME> = (("pattern", "why"), ...)``."""
     for node in ctx.tree.body:
         if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == METRIC_REGISTRY_NAME
+            isinstance(t, ast.Name) and t.id == registry_name
             for t in node.targets
         ):
             patterns: Dict[str, int] = {}
@@ -113,22 +129,59 @@ def _doc_mention_key(pattern: str) -> str:
 class RegistryDriftRule(Rule):
     id = "REGISTRY-DRIFT"
     description = (
-        "every emit_metric name must match the METRICS registry and every "
-        "MODIN_TPU_* env var must be declared in config/envvars.py; both "
-        "must be mentioned in docs/"
+        "every emit_metric name must match the METRICS registry, every "
+        "graftscope span/start_span name must match the SPANS registry, "
+        "and every MODIN_TPU_* env var must be declared in "
+        "config/envvars.py; all must be mentioned in docs/"
     )
 
     def check_project(self, project: Project) -> Iterator[Finding]:
-        yield from self._check_metrics(project)
+        yield from self._check_name_registry(
+            project,
+            suffix=METRICS_SUFFIX,
+            registry_name=METRIC_REGISTRY_NAME,
+            kind="metric",
+            emit_desc="emit_metric",
+            is_emitter=self._is_metric_emitter,
+        )
+        yield from self._check_name_registry(
+            project,
+            suffix=SPANS_SUFFIX,
+            registry_name=SPAN_REGISTRY_NAME,
+            kind="span",
+            emit_desc="span/start_span",
+            is_emitter=self._is_span_emitter,
+        )
         yield from self._check_envvars(project)
 
-    # -- metrics -------------------------------------------------------- #
+    # -- named-emission registries (metrics, spans) ---------------------- #
 
-    def _check_metrics(self, project: Project) -> Iterator[Finding]:
+    @staticmethod
+    def _is_metric_emitter(node: ast.Call) -> bool:
+        return isinstance(node.func, ast.Name) and node.func.id == "emit_metric"
+
+    @staticmethod
+    def _is_span_emitter(node: ast.Call) -> bool:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return func.id in SPAN_EMITTER_NAMES
+        if isinstance(func, ast.Attribute):
+            return func.attr in SPAN_EMITTER_NAMES
+        return False
+
+    def _check_name_registry(
+        self,
+        project: Project,
+        suffix: str,
+        registry_name: str,
+        kind: str,
+        emit_desc: str,
+        is_emitter,
+    ) -> Iterator[Finding]:
         registry: Optional[Dict[str, int]] = None
         registry_ctx: Optional[FileContext] = None
-        for ctx in project.files_matching(METRICS_SUFFIX):
-            registry = _declared_metric_patterns(ctx)
+        for ctx in project.files_matching(suffix):
+            registry = _declared_patterns(ctx, registry_name)
             registry_ctx = ctx
             if registry is not None:
                 break
@@ -136,12 +189,7 @@ class RegistryDriftRule(Rule):
         emitted: List[Tuple[FileContext, ast.Call, str]] = []
         for ctx in project.files:
             for node in ast.walk(ctx.tree):
-                if (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id == "emit_metric"
-                    and node.args
-                ):
+                if isinstance(node, ast.Call) and is_emitter(node) and node.args:
                     name = _metric_name_pattern(node.args[0])
                     if name is not None:
                         emitted.append((ctx, node, name))
@@ -152,11 +200,11 @@ class RegistryDriftRule(Rule):
                     path=registry_ctx.rel,
                     line=1,
                     rule=self.id,
-                    message=f"no {METRIC_REGISTRY_NAME} registry found in "
-                    "the metrics module",
-                    fix_hint=f'declare {METRIC_REGISTRY_NAME} = (("pattern", '
+                    message=f"no {registry_name} registry found in "
+                    f"the {kind}s module",
+                    fix_hint=f'declare {registry_name} = (("pattern", '
                     '"description"), ...) covering every emitted name',
-                    symbol="no-metric-registry",
+                    symbol=f"no-{kind}-registry",
                 )
             return
 
@@ -170,12 +218,12 @@ class RegistryDriftRule(Rule):
                 path=ctx.rel,
                 line=node.lineno,
                 rule=self.id,
-                message=f"metric '{name}' matches no pattern in "
-                f"{METRIC_REGISTRY_NAME} ({METRICS_SUFFIX})",
-                fix_hint="declare the metric (pattern, description) in the "
+                message=f"{kind} '{name}' matches no pattern in "
+                f"{registry_name} ({suffix})",
+                fix_hint=f"declare the {kind} (pattern, description) in the "
                 "registry and document it",
                 scope=ctx.scope_of(node),
-                symbol=f"undeclared-metric-{name}",
+                symbol=f"undeclared-{kind}-{name}",
             )
 
         docs = project.docs_text() if project.has_docs() else None
@@ -185,23 +233,24 @@ class RegistryDriftRule(Rule):
                     path=registry_ctx.rel,
                     line=lineno,
                     rule=self.id,
-                    message=f"metric pattern '{pattern}' is declared but no "
-                    "emit_metric call matches it",
+                    message=f"{kind} pattern '{pattern}' is declared but no "
+                    f"{emit_desc} call matches it",
                     fix_hint="remove the dead registry entry or restore the "
                     "emit site",
-                    symbol=f"dead-metric-{pattern}",
+                    symbol=f"dead-{kind}-{pattern}",
                 )
             if docs is not None and _doc_mention_key(pattern) not in docs:
                 yield Finding(
                     path=registry_ctx.rel,
                     line=lineno,
                     rule=self.id,
-                    message=f"metric '{pattern}' (prefix "
+                    message=f"{kind} '{pattern}' (prefix "
                     f"'{_doc_mention_key(pattern)}') is not mentioned in "
                     "docs/",
-                    fix_hint="document the metric family "
-                    "(docs/configuration.md has the catalog)",
-                    symbol=f"undocumented-metric-{pattern}",
+                    fix_hint=f"document the {kind} family "
+                    "(docs/configuration.md and docs/observability.md hold "
+                    "the catalogs)",
+                    symbol=f"undocumented-{kind}-{pattern}",
                 )
 
     # -- env vars ------------------------------------------------------- #

@@ -24,6 +24,14 @@ from modin_tpu.config import LogFileSize, LogMemoryInterval, LogMode
 
 __LOGGER_CONFIGURED__: bool = False
 
+# configure_logging claims idempotence; without the lock two threads racing
+# through get_logger's "not configured yet" check would both configure —
+# duplicate handlers on the trace logger AND two daemon memory-sampler
+# threads.  The handle to the (single) sampler thread is kept for
+# introspection and tests.
+_configure_lock = threading.Lock()
+_mem_sampler: "threading.Thread | None" = None
+
 
 class ModinFormatter(logging.Formatter):
     """Microsecond-resolution UTC timestamps."""
@@ -65,39 +73,47 @@ def _create_logger(
 
 
 def configure_logging() -> None:
-    """Create the trace logger and start the memory sampler (idempotent)."""
-    global __LOGGER_CONFIGURED__
-    logger = logging.getLogger("modin_tpu.logger")
-    job_id = uuid.uuid4().hex
-    log_filename = f"trace__{platform.node()}"
+    """Create the trace logger and start the memory sampler (idempotent:
+    concurrent first calls configure exactly once, under the module lock)."""
+    global __LOGGER_CONFIGURED__, _mem_sampler
+    with _configure_lock:
+        if __LOGGER_CONFIGURED__:
+            return
+        job_id = uuid.uuid4().hex
+        log_filename = f"trace__{platform.node()}"
 
-    log_level = logging.INFO if LogMode.get() == "Enable_Api_Only" else logging.DEBUG
-    logger = _create_logger("modin_tpu.logger", job_id, log_filename, log_level)
-
-    logger.info(f"OS Version: {platform.platform()}")
-    logger.info(f"Python Version: {platform.python_version()}")
-    logger.info(f"Modin-TPU Version: {modin_tpu.__version__}")
-    logger.info(f"Pandas Version: {pandas.__version__}")
-    logger.info(f"Numpy Version: {numpy.__version__}")
-    try:
-        import jax
-
-        logger.info(f"JAX Version: {jax.__version__}")
-        logger.info(f"Devices: {[str(d) for d in jax.devices()]}")
-    except Exception:
-        pass
-
-    if LogMode.get() != "Enable_Api_Only":
-        mem_sleep = LogMemoryInterval.get()
-        mem = _create_logger(
-            "modin_tpu_memory.logger", job_id, "memory", logging.DEBUG
+        log_level = (
+            logging.INFO if LogMode.get() == "Enable_Api_Only" else logging.DEBUG
         )
-        mem_sampler = threading.Thread(
-            target=memory_thread, args=[mem, mem_sleep], daemon=True
-        )
-        mem_sampler.start()
+        logger = _create_logger("modin_tpu.logger", job_id, log_filename, log_level)
 
-    __LOGGER_CONFIGURED__ = True
+        logger.info(f"OS Version: {platform.platform()}")
+        logger.info(f"Python Version: {platform.python_version()}")
+        logger.info(f"Modin-TPU Version: {modin_tpu.__version__}")
+        logger.info(f"Pandas Version: {pandas.__version__}")
+        logger.info(f"Numpy Version: {numpy.__version__}")
+        try:
+            import jax
+
+            logger.info(f"JAX Version: {jax.__version__}")
+            logger.info(f"Devices: {[str(d) for d in jax.devices()]}")
+        except Exception:
+            pass
+
+        if LogMode.get() != "Enable_Api_Only":
+            mem_sleep = LogMemoryInterval.get()
+            mem = _create_logger(
+                "modin_tpu_memory.logger", job_id, "memory", logging.DEBUG
+            )
+            _mem_sampler = threading.Thread(
+                target=memory_thread,
+                args=[mem, mem_sleep],
+                daemon=True,
+                name="modin-tpu-memory-sampler",
+            )
+            _mem_sampler.start()
+
+        __LOGGER_CONFIGURED__ = True
 
 
 def memory_thread(logger: logging.Logger, sleep_time: int) -> None:

@@ -16,6 +16,7 @@ from typing import Any, Callable, Optional, Union
 from modin_tpu.config import LogMode, MetricsMode
 from modin_tpu.logging.config import get_logger
 from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import spans as graftscope
 
 _MODIN_LOGGER_NOWRAP = "__modin_logging_nowrap__"
 
@@ -65,7 +66,7 @@ def enable_logging(
         log_name = name or getattr(obj, "__qualname__", repr(obj))
         log_name = re.sub(r"[^a-zA-Z0-9\-_\.]", "_", log_name)
         full_name = f"{modin_layer}::{log_name}"
-        is_api_layer = modin_layer.upper() in ("PANDAS-API", "NUMPY-API", "POLARS-API")
+        is_api_layer = modin_layer.upper() in graftscope.API_LAYERS
 
         @wraps(obj)
         def run_and_log(*args: Any, **kwargs: Any) -> Any:
@@ -85,6 +86,14 @@ def enable_logging(
         # positionals collided with wrapped calls whose own kwargs include
         # e.g. ``mode`` (pandas read_hdf/to_hdf/to_csv all have one)
         def _run_inner(_log_state: tuple, *args: Any, **kwargs: Any) -> Any:
+            # graftscope seam: independent of LogMode — one module-attribute
+            # check when tracing is off, a nested layer-tagged span when on
+            if not graftscope.TRACE_ON:
+                return _run_logged(_log_state, *args, **kwargs)
+            with graftscope.layer_span(log_name, modin_layer):
+                return _run_logged(_log_state, *args, **kwargs)
+
+        def _run_logged(_log_state: tuple, *args: Any, **kwargs: Any) -> Any:
             mode, metrics_on = _log_state
             if mode == "Disable" and not metrics_on:
                 return obj(*args, **kwargs)

@@ -53,6 +53,11 @@ METRICS = (
         "pandas-api.*",
         "wall-clock seconds per public pandas-API call (logging layer)",
     ),
+    (
+        "trace.flight_dump",
+        "graftscope flight-recorder ring dumps written on a breaker-open "
+        "or terminal device failure",
+    ),
 )
 
 

@@ -1,0 +1,101 @@
+"""Flight recorder: dump the span ring buffer when the system degrades.
+
+While tracing is on, every finished span also lands in a bounded ring
+buffer (``spans._RING``, sized by ``MODIN_TPU_TRACE_FLIGHT_RECORDER_SIZE``).
+When the resilience layer decides something is seriously wrong — a circuit
+breaker trips OPEN, or a device failure is classified terminal (OOM,
+device-lost, retries exhausted) — it calls ``dump_flight_record`` and the
+last N spans are written as a chrome://tracing-loadable JSON file under
+``MODIN_TPU_TRACE_DIR``: the trace that *led up to* the failure, tying the
+PR-1 failure taxonomy to its preceding query activity.
+
+The dump is strictly best-effort: it never raises into the query path, it
+does nothing while tracing is off (so the default-off mode keeps its
+near-zero overhead), and consecutive dumps are rate-limited so a flapping
+breaker cannot fill a disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+import threading
+import time
+from typing import List, Optional
+
+from modin_tpu.observability import spans as _spans
+from modin_tpu.observability.chrome_trace import to_chrome_trace
+
+#: minimum seconds between dumps (module-level so tests can lower it)
+MIN_DUMP_INTERVAL_S = 5.0
+
+_last_dump = 0.0
+_dump_lock = threading.Lock()
+
+_REASON_SANITIZE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def flight_snapshot() -> List[object]:
+    """The spans currently in the ring (oldest first); empty when off."""
+    ring = _spans._RING
+    return list(ring) if ring is not None else []
+
+
+def reset_for_tests() -> None:
+    """Clear the ring and the rate limiter (test isolation)."""
+    global _last_dump
+    ring = _spans._RING
+    if ring is not None:
+        ring.clear()
+    _last_dump = 0.0
+
+
+def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
+    """Write the ring to a trace file; returns the path or None.
+
+    None means "nothing dumped" — tracing off, empty ring, rate-limited,
+    or the write failed.  Never raises: the caller is the failure path
+    itself and must stay failure-free.
+    """
+    global _last_dump
+    if not _spans.TRACE_ON:
+        return None
+    ring = _spans._RING
+    if not ring:
+        return None
+    with _dump_lock:
+        now = time.monotonic()
+        if now - _last_dump < MIN_DUMP_INTERVAL_S:
+            return None
+        _last_dump = now  # claim the window (concurrent callers back off)
+        snapshot = list(ring)
+    try:
+        from modin_tpu.config import TraceDir
+
+        outdir = pathlib.Path(TraceDir.get())
+        outdir.mkdir(parents=True, exist_ok=True)
+        safe_reason = _REASON_SANITIZE.sub("_", reason) or "fault"
+        path = outdir / (
+            f"flightrec_{safe_reason}_{os.getpid()}_{int(time.time() * 1e3)}"
+            ".trace.json"
+        )
+        trace = to_chrome_trace(
+            snapshot,
+            other_data={
+                "reason": reason,
+                "detail": detail,
+                "spans": len(snapshot),
+            },
+        )
+        path.write_text(json.dumps(trace))
+        return str(path)
+    except Exception:
+        # best-effort by contract: a failed dump must not worsen the fault —
+        # and must not consume the rate-limit window (a transiently
+        # unwritable TraceDir would otherwise suppress the next, possibly
+        # successful, dump of the real fault)
+        with _dump_lock:
+            _last_dump = 0.0
+        return None

@@ -1,0 +1,498 @@
+"""graftscope span core: nestable spans with thread-local context propagation.
+
+The query path crosses four seams — pandas API entry, the TPU query
+compiler, the ``JaxWrapper`` engine seam, and shuffle/IO — and until now the
+only record of a query's life was a flat START/STOP line log plus API timing
+counters.  This module is the structured replacement: every instrumented
+call becomes a **span** (name, layer tag, span id, parent id, wall-clock
+interval, attributes), spans nest via a thread-local stack, and finished
+spans are delivered to any active collectors (``profile()``) and to the
+flight-recorder ring buffer (modin_tpu/observability/flight_recorder.py).
+
+Layer tags reuse the ``modin_layer`` taxonomy the ``ClassLogger`` mixin
+already stamps on every subsystem (``PANDAS-API``, ``QUERY-COMPILER``,
+``JAX-ENGINE``, ``CORE-IO``, ...) plus ``SHUFFLE`` for the range-partition
+shuffle, so a profile slices the same way the trace log always has.
+
+Disabled-mode contract (the default, ``MODIN_TPU_TRACE=0``): the only cost
+an instrumented call pays is one module-attribute check of ``TRACE_ON`` —
+no span object is ever allocated (``span_alloc_count()`` lets tests assert
+exactly that), and ``span()`` returns a shared no-op context manager
+singleton.  ``TRACE_ON`` flips when the ``TraceEnabled`` config parameter
+changes (pubsub subscription) or while any ``profile()`` is active.
+
+Span names emitted with static (or f-string) names are declared in the
+``SPANS`` registry below, cross-checked both ways by graftlint's
+REGISTRY-DRIFT rule exactly like ``emit_metric`` names are against
+``METRICS`` — an undeclared span name, a dead registry pattern, or an
+undocumented family fails the lint.  The per-method spans emitted through
+``layer_span`` by the logging decorator carry runtime-built names
+(``<Class>.<method>``) and are documented as the layer taxonomy instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+#: Module-level fast path.  Instrumentation sites check this ONE attribute
+#: before doing anything else; while it is False no span object is ever
+#: allocated.  Flipped by the TraceEnabled config subscription and by
+#: profile() activation — never written anywhere else.
+TRACE_ON: bool = False
+
+#: Registry of every span family emitted with a statically-known name
+#: (``*`` stands for a runtime-interpolated segment, exactly like
+#: logging/metrics.py:METRICS).  graftlint's REGISTRY-DRIFT rule
+#: cross-checks this both ways — a ``span(...)``/``start_span(...)`` call
+#: whose name matches no pattern, or a pattern with no live emit site,
+#: fails the lint — and requires each family's stable prefix to appear in
+#: docs/ (see docs/observability.md).  Per-method spans from the logging
+#: decorator (``layer_span``) have runtime names and are covered by the
+#: layer-tag taxonomy instead.
+SPANS = (
+    (
+        "engine.*.attempt",
+        "one engine-seam attempt (deploy/put/materialize/wait) under the "
+        "resilience policy; retries appear as sibling attempt spans with "
+        "attempt/failure_kind attributes, XLA compile time attributed via "
+        "compile_s",
+    ),
+    (
+        "fallback.*",
+        "a device-path family declining to the pandas fallback: reason is "
+        "the classified failure kind, or short_circuit when the family's "
+        "breaker is open",
+    ),
+    (
+        "shuffle.sample_pivots",
+        "device key sample + host quantile pivot computation preceding a "
+        "range shuffle",
+    ),
+    (
+        "shuffle.range_shuffle",
+        "the all_to_all range-partition shuffle: bucketize/pack, collective, "
+        "compaction; slack retries recorded in attributes",
+    ),
+    (
+        "io.read",
+        "one FileDispatcher read (format dispatcher class in attributes)",
+    ),
+)
+
+_EPOCH_PERF = time.perf_counter()
+_EPOCH_WALL = time.time()
+
+_span_ids = itertools.count(1)
+_alloc_count = 0  # Span objects ever constructed (the zero-alloc assertion)
+
+_tls = threading.local()
+
+_collectors: List[list] = []  # active profile() collectors
+_state_lock = threading.Lock()
+
+#: bounded ring of recently finished spans (the flight recorder's memory);
+#: created/resized by _refresh_enabled from TraceFlightRecorderSize
+_RING: Optional[deque] = None
+
+_env_enabled = False
+
+
+class Span:
+    """One timed, attributed interval on the query path."""
+
+    __slots__ = (
+        "name",
+        "layer",
+        "span_id",
+        "parent_id",
+        "start_us",
+        "dur_us",
+        "wall_start_s",
+        "attrs",
+        "thread_id",
+        "thread_name",
+        "status",
+    )
+
+    def __init__(self, name: str, layer: str, attrs: Optional[dict], parent_id: Optional[int]):
+        t = threading.current_thread()
+        self.name = name
+        self.layer = layer
+        self.span_id = next(_span_ids)
+        self.parent_id = parent_id
+        self.start_us = (time.perf_counter() - _EPOCH_PERF) * 1e6
+        self.wall_start_s = _EPOCH_WALL + self.start_us / 1e6
+        self.dur_us = 0.0
+        self.attrs = attrs if attrs is not None else {}
+        self.thread_id = t.ident or 0
+        self.thread_name = t.name
+        self.status = "open"
+
+    def __repr__(self) -> str:  # debugging aid, not part of the export
+        return (
+            f"<Span {self.name} [{self.layer}] id={self.span_id} "
+            f"parent={self.parent_id} dur={self.dur_us / 1e3:.3f}ms "
+            f"{self.status}>"
+        )
+
+
+# ---------------------------------------------------------------------- #
+# enable/disable plumbing
+# ---------------------------------------------------------------------- #
+
+
+def _refresh_enabled() -> None:
+    """Recompute TRACE_ON (and size the ring) from config + collectors."""
+    global TRACE_ON, _RING
+    on = _env_enabled or bool(_collectors)
+    if on:
+        from modin_tpu.config import TraceFlightRecorderSize
+
+        size = int(TraceFlightRecorderSize.get())
+        if size <= 0:
+            _RING = None
+        elif _RING is None or _RING.maxlen != size:
+            # retune a live process: keep the newest spans that still fit
+            _RING = deque(_RING or (), maxlen=size)
+    TRACE_ON = on
+
+
+def _on_trace_param(param: Any) -> None:
+    global _env_enabled
+    _env_enabled = bool(param.get())
+    _refresh_enabled()
+    if _env_enabled:
+        try:
+            from modin_tpu.observability.compile_ledger import ensure_listener
+        except ImportError:
+            # subscription fired during the package's own import (env sets
+            # MODIN_TPU_TRACE=1) while compile_ledger is mid-initialization;
+            # observability/__init__ installs the listener right after
+            return
+        ensure_listener()
+
+
+def trace_enabled() -> bool:
+    """Is span collection active right now (config switch or a profile)?"""
+    return TRACE_ON
+
+
+def span_alloc_count() -> int:
+    """How many Span objects this process has ever constructed.
+
+    The disabled-mode contract is *zero new allocations*; tests snapshot
+    this counter around a workload run with tracing off.
+    """
+    return _alloc_count
+
+
+# ---------------------------------------------------------------------- #
+# the span stack
+# ---------------------------------------------------------------------- #
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def current_span() -> Optional[Span]:
+    """Innermost open span on this thread, or None."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def snapshot_stack() -> Optional[list]:
+    """Copy of this thread's open-span stack (outermost first), or None."""
+    stack = getattr(_tls, "stack", None)
+    return list(stack) if stack else None
+
+
+def seed_thread(stack: Optional[list]) -> None:
+    """Adopt a snapshot of another thread's span stack as ambient context.
+
+    Worker threads (the resilience watchdog) call this so spans they start
+    — and compile-time attribution — nest under the call chain that spawned
+    the work instead of floating parentless.  The seeded spans are owned
+    and finished by their original thread; this thread only reads them.
+    """
+    if stack:
+        _tls.stack = list(stack)
+
+
+def attribution_signature() -> str:
+    """The op signature compile time should be billed to.
+
+    Innermost QUERY-COMPILER span if one is open on this thread (the
+    per-operator granularity the compile ledger wants), else the innermost
+    span of any layer, else ``<untraced>``.
+    """
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return "<untraced>"
+    for sp in reversed(stack):
+        if sp.layer == "QUERY-COMPILER":
+            return sp.name
+    return stack[-1].name
+
+
+# ---------------------------------------------------------------------- #
+# span lifecycle
+# ---------------------------------------------------------------------- #
+
+
+def start_span(
+    name: str,
+    layer: str = "APP",
+    attrs: Optional[dict] = None,
+    parent_id: Optional[int] = None,
+) -> Span:
+    """Open a span and push it on this thread's stack.
+
+    Callers on hot paths must check ``TRACE_ON`` first; this function
+    allocates unconditionally (that is its job).
+    """
+    global _alloc_count
+    stack = _stack()
+    if parent_id is None and stack:
+        parent_id = stack[-1].span_id
+    sp = Span(name, layer, attrs, parent_id)
+    _alloc_count += 1
+    stack.append(sp)
+    return sp
+
+
+def finish_span(sp: Span, status: str = "ok") -> None:
+    """Close a span, pop it, and deliver it to collectors + the ring."""
+    sp.dur_us = (time.perf_counter() - _EPOCH_PERF) * 1e6 - sp.start_us
+    sp.status = status
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        if stack[-1] is sp:
+            stack.pop()
+        else:  # out-of-order finish (escaped generator etc.): best effort
+            try:
+                stack.remove(sp)
+            except ValueError:
+                pass
+    _deliver(sp)
+
+
+def _deliver(sp: Span) -> None:
+    ring = _RING
+    if ring is not None:
+        ring.append(sp)
+    if _collectors:
+        with _state_lock:
+            for collector in _collectors:
+                collector.append(sp)
+
+
+class _SpanHandle:
+    """Context manager over one open span; yields the Span for attributes."""
+
+    __slots__ = ("_span",)
+
+    def __init__(self, sp: Span):
+        self._span = sp
+
+    def __enter__(self) -> Span:
+        return self._span
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        if exc_type is not None:
+            self._span.attrs.setdefault("exc", exc_type.__name__)
+            finish_span(self._span, status="error")
+        else:
+            finish_span(self._span)
+        return False
+
+
+class _NullHandle:
+    """Shared no-op context manager returned while tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc_info: Any) -> bool:
+        return False
+
+
+_NULL_HANDLE = _NullHandle()
+
+
+def span(name: str, layer: str = "APP", **attrs: Any) -> Any:
+    """Open a named span as a context manager (no-op when tracing is off).
+
+    Statically-named call sites are cross-checked against the ``SPANS``
+    registry by graftlint's REGISTRY-DRIFT rule; use ``layer_span`` for
+    runtime-built names (the logging decorator's per-method spans).
+    """
+    if not TRACE_ON:
+        return _NULL_HANDLE
+    return _SpanHandle(start_span(name, layer, attrs or None))
+
+
+def layer_span(name: str, layer: str) -> Any:
+    """``span`` variant for runtime-built names (exempt from the registry)."""
+    if not TRACE_ON:
+        return _NULL_HANDLE
+    return _SpanHandle(start_span(name, layer, None))
+
+
+# ---------------------------------------------------------------------- #
+# profiles
+# ---------------------------------------------------------------------- #
+
+#: the user-facing entry layers; shared with the logging decorator's
+#: is_api_layer check so the list cannot drift between the two subsystems
+API_LAYERS = frozenset({"PANDAS-API", "NUMPY-API", "POLARS-API"})
+
+
+class Profile:
+    """The spans collected by one ``profile()`` block, plus rollups."""
+
+    def __init__(self) -> None:
+        self.spans: List[Span] = []
+
+    # -- structure ----------------------------------------------------- #
+
+    def tree(self) -> List[dict]:
+        """Nested {span, children} dicts rooted at spans with no collected
+        parent, in start order."""
+        by_id: Dict[int, dict] = {
+            sp.span_id: {"span": sp, "children": []} for sp in self.spans
+        }
+        roots: List[dict] = []
+        for sp in sorted(self.spans, key=lambda s: s.start_us):
+            node = by_id[sp.span_id]
+            parent = by_id.get(sp.parent_id) if sp.parent_id else None
+            if parent is not None:
+                parent["children"].append(node)
+            else:
+                roots.append(node)
+        return roots
+
+    def find(self, prefix: str) -> List[Span]:
+        """Collected spans whose name starts with ``prefix``."""
+        return [sp for sp in self.spans if sp.name.startswith(prefix)]
+
+    def ancestors(self, sp: Span) -> List[Span]:
+        """Chain of collected ancestors of ``sp``, innermost first."""
+        by_id = {s.span_id: s for s in self.spans}
+        out: List[Span] = []
+        cur = by_id.get(sp.parent_id) if sp.parent_id else None
+        while cur is not None:
+            out.append(cur)
+            cur = by_id.get(cur.parent_id) if cur.parent_id else None
+        return out
+
+    # -- rollups -------------------------------------------------------- #
+
+    def rollup(self) -> dict:
+        """Host / device / compile wall-clock attribution.
+
+        - ``wall_s``: summed duration of root spans (no collected parent);
+        - ``engine_s``: time inside engine-seam attempts (device dispatch,
+          transfers, blocking fetches — includes any XLA compiles that
+          happened there);
+        - ``compile_s``: XLA compile wall time attributed to collected spans
+          by the compile ledger's monitoring listener;
+        - ``device_s``: ``engine_s`` minus the compile time spent inside the
+          engine attempts (pure device/runtime time);
+        - ``host_s``: everything else (``wall_s - engine_s``), the
+          framework + pandas-fallback share;
+        - ``by_layer_self_s``: per-layer *self* time (each span's duration
+          minus its collected children's) — where the time actually went.
+        """
+        spans = self.spans
+        by_id = {sp.span_id: sp for sp in spans}
+        child_us: Dict[int, float] = {}
+        for sp in spans:
+            if sp.parent_id in by_id:
+                child_us[sp.parent_id] = child_us.get(sp.parent_id, 0.0) + sp.dur_us
+        wall_us = sum(sp.dur_us for sp in spans if sp.parent_id not in by_id)
+        engine_attempts = [
+            sp
+            for sp in spans
+            if sp.name.startswith("engine.") and sp.name.endswith(".attempt")
+        ]
+        engine_us = sum(sp.dur_us for sp in engine_attempts)
+        compile_s = sum(sp.attrs.get("compile_s", 0.0) for sp in spans)
+        engine_compile_s = sum(
+            sp.attrs.get("compile_s", 0.0) for sp in engine_attempts
+        )
+        by_layer: Dict[str, float] = {}
+        for sp in spans:
+            self_us = max(sp.dur_us - child_us.get(sp.span_id, 0.0), 0.0)
+            by_layer[sp.layer] = by_layer.get(sp.layer, 0.0) + self_us
+        return {
+            "wall_s": wall_us / 1e6,
+            "engine_s": engine_us / 1e6,
+            "device_s": max(engine_us / 1e6 - engine_compile_s, 0.0),
+            "compile_s": compile_s,
+            "host_s": max((wall_us - engine_us) / 1e6, 0.0),
+            "spans": len(spans),
+            "by_layer_self_s": {
+                layer: round(us / 1e6, 6) for layer, us in sorted(by_layer.items())
+            },
+        }
+
+    # -- export --------------------------------------------------------- #
+
+    def to_chrome_trace(self) -> dict:
+        from modin_tpu.observability.chrome_trace import to_chrome_trace
+
+        return to_chrome_trace(self.spans, other_data={"rollup": self.rollup()})
+
+    def export_chrome_trace(self, path: Any) -> str:
+        from modin_tpu.observability.chrome_trace import export_chrome_trace
+
+        return export_chrome_trace(
+            self.spans, path, other_data={"rollup": self.rollup()}
+        )
+
+
+@contextlib.contextmanager
+def profile() -> Iterator[Profile]:
+    """Collect every span finished while the block runs.
+
+    Activates tracing for the duration even when ``MODIN_TPU_TRACE`` is off
+    (that is the point: an ad-hoc profile without a process restart), and
+    installs the XLA compile listener so compile time is attributed.
+    """
+    from modin_tpu.observability.compile_ledger import ensure_listener
+
+    ensure_listener()
+    prof = Profile()
+    with _state_lock:
+        _collectors.append(prof.spans)
+    _refresh_enabled()
+    try:
+        yield prof
+    finally:
+        with _state_lock:
+            try:
+                _collectors.remove(prof.spans)
+            except ValueError:
+                pass
+        _refresh_enabled()
+
+
+# wire the config switches (each fires immediately with its current value)
+from modin_tpu.config import (  # noqa: E402
+    TraceEnabled as _TraceEnabled,
+    TraceFlightRecorderSize as _TraceFlightRecorderSize,
+)
+
+_TraceEnabled.subscribe(_on_trace_param)
+_TraceFlightRecorderSize.subscribe(lambda _param: _refresh_enabled())

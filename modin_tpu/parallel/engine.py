@@ -45,6 +45,12 @@ def initialize_jax() -> None:
 
     get_mesh()
 
+    # compile observability: count every backend compile from process start
+    # (the listener is idle-free; recompile storms are invisible otherwise)
+    from modin_tpu.observability.compile_ledger import ensure_listener
+
+    ensure_listener()
+
     from modin_tpu.config import CompilationCacheDir
 
     cache_dir = CompilationCacheDir.get()

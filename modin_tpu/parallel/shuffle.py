@@ -31,6 +31,7 @@ from typing import Any, List, Tuple
 import numpy as np
 
 
+from modin_tpu.observability import spans as graftscope
 from modin_tpu.parallel.engine import materialize as _engine_materialize
 
 
@@ -55,17 +56,20 @@ def _jit_sample(step: int):
 
 def sample_pivots(key: Any, n: int, num_partitions: int, num_samples: int = 4096) -> np.ndarray:
     """Quantile pivots from a strided device sample (one small fetch)."""
-    step = max(1, key.shape[0] // num_samples)
-    sample = np.asarray(_engine_materialize(_jit_sample(step)(key)))
-    positions = np.arange(0, key.shape[0], step)
-    sample = sample[positions[: len(sample)] < n]
-    if sample.dtype.kind == "f":
-        sample = sample[~np.isnan(sample)]
-    if len(sample) == 0:
-        return np.zeros(max(num_partitions - 1, 1), dtype=sample.dtype)
-    qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
-    pivots = np.quantile(sample, qs, method="inverted_cdf")
-    return np.asarray(pivots, dtype=sample.dtype)
+    with graftscope.span(
+        "shuffle.sample_pivots", layer="SHUFFLE", rows=int(n), shards=num_partitions
+    ):
+        step = max(1, key.shape[0] // num_samples)
+        sample = np.asarray(_engine_materialize(_jit_sample(step)(key)))
+        positions = np.arange(0, key.shape[0], step)
+        sample = sample[positions[: len(sample)] < n]
+        if sample.dtype.kind == "f":
+            sample = sample[~np.isnan(sample)]
+        if len(sample) == 0:
+            return np.zeros(max(num_partitions - 1, 1), dtype=sample.dtype)
+        qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
+        pivots = np.quantile(sample, qs, method="inverted_cdf")
+        return np.asarray(pivots, dtype=sample.dtype)
 
 
 @functools.lru_cache(maxsize=None)
@@ -191,34 +195,48 @@ def range_shuffle(
     from modin_tpu.ops.structural import gather_columns
     from modin_tpu.parallel.mesh import num_row_shards
 
-    S = num_row_shards()
-    P_len = key.shape[0]
-    L = P_len // S
-    pivots = sample_pivots(key, n, S)
-    pivots_dev = jnp.asarray(pivots)
-    row_valid = (jnp.arange(P_len) < n)[:, None]
+    with graftscope.span(
+        "shuffle.range_shuffle",
+        layer="SHUFFLE",
+        rows=int(n),
+        n_cols=len(cols),
+        local_sort=bool(local_sort),
+    ) as _sp:
+        S = num_row_shards()
+        P_len = key.shape[0]
+        L = P_len // S
+        pivots = sample_pivots(key, n, S)
+        pivots_dev = jnp.asarray(pivots)
+        row_valid = (jnp.arange(P_len) < n)[:, None]
 
-    while True:
-        capacity = int(max(8, int(L / max(S, 1) * slack)))
-        fn = _jit_shuffle(len(cols), capacity, n, bool(descending), bool(local_sort))
-        out = fn(pivots_dev, key, row_valid, *cols)
-        counts_r, overflow_r = out[0], out[1]
-        payload = list(out[2:])
-        overflow = int(np.sum(np.asarray(_engine_materialize(overflow_r))))
-        if overflow == 0:
-            counts = np.asarray(_engine_materialize(counts_r))
-            break
-        slack *= 2.0
-        emit_metric("resilience.shuffle.slack_retry", 1)
-        if slack > max_slack:
-            emit_metric("resilience.shuffle.skew_fallback", 1)
-            raise ShuffleSkewError("range_shuffle: pathological key skew")
+        slack_retries = 0
+        while True:
+            capacity = int(max(8, int(L / max(S, 1) * slack)))
+            fn = _jit_shuffle(len(cols), capacity, n, bool(descending), bool(local_sort))
+            out = fn(pivots_dev, key, row_valid, *cols)
+            counts_r, overflow_r = out[0], out[1]
+            payload = list(out[2:])
+            overflow = int(np.sum(np.asarray(_engine_materialize(overflow_r))))
+            if overflow == 0:
+                counts = np.asarray(_engine_materialize(counts_r))
+                break
+            slack *= 2.0
+            slack_retries += 1
+            emit_metric("resilience.shuffle.slack_retry", 1)
+            if slack > max_slack:
+                emit_metric("resilience.shuffle.skew_fallback", 1)
+                raise ShuffleSkewError("range_shuffle: pathological key skew")
 
-    assert int(counts.sum()) == n, (counts, n)
-    # positions of each shard's valid prefix within the [S * S*capacity] layout
-    block = S * capacity
-    positions = np.concatenate(
-        [s * block + np.arange(c, dtype=np.int64) for s, c in enumerate(counts)]
-    ) if len(counts) else np.zeros(0, np.int64)
-    compacted, _ = gather_columns(payload, positions)
-    return compacted[0], compacted[1:], counts, pivots
+        if _sp is not None:
+            _sp.attrs["shards"] = S
+            _sp.attrs["capacity"] = capacity
+            _sp.attrs["slack_retries"] = slack_retries
+
+        assert int(counts.sum()) == n, (counts, n)
+        # positions of each shard's valid prefix within the [S * S*capacity] layout
+        block = S * capacity
+        positions = np.concatenate(
+            [s * block + np.arange(c, dtype=np.int64) for s, c in enumerate(counts)]
+        ) if len(counts) else np.zeros(0, np.int64)
+        compacted, _ = gather_columns(payload, positions)
+        return compacted[0], compacted[1:], counts, pivots

@@ -1,0 +1,98 @@
+"""graftscope smoke gate: trace a tiny workload, validate the export.
+
+Run by scripts/check_all.sh.  Executes a groupby + merge + range-partition
+sort on the 8-device virtual CPU mesh under ``profile()``, exports the
+Chrome Trace Event JSON, and asserts that:
+
+1. the file parses and is schema-shaped (``traceEvents`` of complete
+   events with name/cat/ph/ts/dur/pid/tid);
+2. spans from all four instrumented layers are present — pandas API entry,
+   query compiler, engine seam, and shuffle;
+3. the rollup reports host/device/compile attribution.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def main() -> int:
+    import modin_tpu.observability as graftscope
+    import modin_tpu.pandas as pd
+    from modin_tpu.config import RangePartitioning
+
+    n = 4096
+    with graftscope.profile() as prof:
+        df = pd.DataFrame(
+            {
+                "k": [i % 31 for i in range(n)],
+                "v": [float(i % 97) for i in range(n)],
+            }
+        )
+        dim = pd.DataFrame({"k": list(range(31)), "w": [i * 0.5 for i in range(31)]})
+        merged = df.merge(dim, on="k", how="left")
+        agg = merged.groupby("k").sum()
+        agg._query_compiler.execute()
+        with RangePartitioning.context(True):
+            s = df.sort_values("v")
+            s._query_compiler.execute()
+
+    out = os.path.join(tempfile.mkdtemp(prefix="graftscope_smoke_"), "smoke.trace.json")
+    prof.export_chrome_trace(out)
+
+    with open(out) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents")
+    assert isinstance(events, list) and events, "no traceEvents in export"
+    complete = [e for e in events if e.get("ph") == "X"]
+    assert complete, "no complete ('X') events"
+    for e in complete:
+        for field in ("name", "cat", "ts", "dur", "pid", "tid"):
+            assert field in e, f"event missing {field}: {e}"
+        assert isinstance(e["ts"], (int, float)) and isinstance(e["dur"], (int, float))
+
+    layers = {e["cat"] for e in complete}
+    required = {"PANDAS-API", "QUERY-COMPILER", "JAX-ENGINE", "SHUFFLE"}
+    missing = required - layers
+    assert not missing, (
+        f"layers missing from the trace: {sorted(missing)}; got {sorted(layers)}"
+    )
+    assert any(
+        e["name"].startswith("engine.") and e["name"].endswith(".attempt")
+        for e in complete
+    ), "no engine-seam attempt spans"
+    assert any(e["name"] == "shuffle.range_shuffle" for e in complete), (
+        "no range-shuffle span (did the sort take the fallback path?)"
+    )
+
+    rollup = trace.get("otherData", {}).get("rollup", {})
+    for key in ("wall_s", "host_s", "device_s", "compile_s"):
+        assert key in rollup, f"rollup missing {key}"
+
+    print(
+        f"graftscope smoke OK: {len(complete)} spans, layers={sorted(layers)}, "
+        f"rollup host={rollup['host_s']:.3f}s device={rollup['device_s']:.3f}s "
+        f"compile={rollup['compile_s']:.3f}s ({out})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(f"graftscope smoke FAILED: {err}", file=sys.stderr)
+        sys.exit(1)

@@ -445,6 +445,70 @@ def test_registry_drift_positive(tmp_path):
     assert "undocumented-envvar-MODIN_TPU_ALPHA" not in symbols
 
 
+_SPANS_STUB = """
+SPANS = (
+    ("trace.good.*", "a documented span family"),
+    ("trace.dead", "declared but never emitted"),
+)
+"""
+
+
+def test_registry_drift_spans_positive(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/observability/spans.py": _SPANS_STUB,
+            "docs/ref.md": "trace.good is documented here.",
+            "modin_tpu/work.py": """
+            from modin_tpu.observability import spans as graftscope
+
+            def f(op):
+                with graftscope.span(f"trace.good.{op}"):     # ok (wildcard)
+                    pass
+                sp = graftscope.start_span("trace.unknown")   # BAD: undeclared
+                with span("trace.also_unknown"):              # BAD: bare name too
+                    pass
+                with graftscope.layer_span(op, "PANDAS-API"): # exempt emitter
+                    pass
+            """,
+        },
+        select=["REGISTRY-DRIFT"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "undeclared-span-trace.unknown" in symbols
+    assert "undeclared-span-trace.also_unknown" in symbols
+    assert "dead-span-trace.dead" in symbols
+    # the dead pattern is also undocumented; the good family is fine
+    assert "undocumented-span-trace.dead" in symbols
+    assert "undocumented-span-trace.good.*" not in symbols
+
+
+def test_registry_drift_spans_negative(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/observability/spans.py": """
+            SPANS = (
+                ("trace.good", "documented"),
+            )
+            """,
+            "docs/ref.md": "trace.good is documented.",
+            "modin_tpu/work.py": """
+            from modin_tpu.observability import spans as graftscope
+
+            def f(name):
+                with graftscope.span("trace.good"):
+                    pass
+                with graftscope.span(name):   # dynamic name: not checkable
+                    pass
+                obj.ewm(span=7)               # keyword arg, not an emitter
+            """,
+        },
+        select=["REGISTRY-DRIFT"],
+    )
+    assert not result.findings, [f.render() for f in result.findings]
+
+
 def test_registry_drift_negative_docstrings_and_internal_tokens(tmp_path):
     result = lint_tree(
         tmp_path,
