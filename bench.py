@@ -531,6 +531,80 @@ def main() -> None:
 
     run_section("host_udf", host_udf_section)
 
+    # ---- graftguard: lineage overhead + spill/restore throughput ---- #
+    def recovery_section():
+        """Steady-state cost of lineage recording (must be ~0: no failure
+        occurs in this workload) and spill/restore throughput of the
+        device-memory admission path."""
+        import time as _time
+
+        from modin_tpu.config import RecoveryMode
+        from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        n = int(os.environ.get("BENCH_RECOVERY_ROWS", 2_000_000))
+        datar = {f"c{i}": rng.integers(0, 100, n) for i in range(3)}
+        reps = max(repeats, 3)
+
+        def workload():
+            mdf = pd.DataFrame(datar)
+            mdf._query_compiler.execute()
+            for _ in range(8):
+                execute_modin(mdf.add(2))
+                execute_modin(mdf.sum())
+
+        mode_before = RecoveryMode.get()
+
+        def best_of(mode):
+            RecoveryMode.put(mode)
+            try:
+                workload()  # warm compiles outside the timer
+                best = float("inf")
+                for _ in range(reps):
+                    t0 = _time.perf_counter()
+                    workload()
+                    best = min(best, _time.perf_counter() - t0)
+                return best
+            finally:
+                RecoveryMode.put(mode_before)
+
+        off_s = best_of("Disable")
+        on_s = best_of("Enable")
+        overhead_pct = (on_s - off_s) / max(off_s, 1e-9) * 100.0
+
+        # spill/restore throughput: one big column, host cache dropped so
+        # the spill pays the real device->host fetch
+        values = rng.integers(0, 100, n)  # n * 8 bytes
+        col = DeviceColumn.from_numpy(values)
+        JaxWrapper.wait(col.raw)
+        col.host_cache = None
+        t0 = _time.perf_counter()
+        freed = col.spill()
+        spill_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        JaxWrapper.wait(col.raw)  # touching .raw restores the buffer
+        restore_s = _time.perf_counter() - t0
+        mb = freed / 2**20
+        sections["recovery"] = {
+            "lineage_on_s": round(on_s, 4),
+            "lineage_off_s": round(off_s, 4),
+            "lineage_overhead_pct": round(overhead_pct, 2),
+            # the acceptance assertion: steady-state lineage recording is
+            # negligible (<10% even in CPU-substrate noise; ~0 expected)
+            "lineage_overhead_ok": overhead_pct < 10.0,
+            "spill_mb": round(mb, 1),
+            "spill_mb_s": round(mb / max(spill_s, 1e-9), 1),
+            "restore_mb_s": round(mb / max(restore_s, 1e-9), 1),
+        }
+        if not sections["recovery"]["lineage_overhead_ok"]:
+            sections["recovery"]["error"] = (
+                f"lineage overhead {overhead_pct:.1f}% exceeds the 10% "
+                "steady-state budget"
+            )
+        return sections["recovery"]
+
+    run_section("recovery", recovery_section)
+
     # ---- groupby-apply: shuffle vs cliff on the virtual mesh ---- #
     def shuffle_apply() -> dict:
         sections["shuffle_apply_virtual_mesh"] = _shuffle_apply_section()

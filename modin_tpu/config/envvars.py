@@ -554,6 +554,94 @@ class ResilienceLatencyBudgetS(EnvironmentVariable, type=float):
     default = 0.0
 
 
+class RecoveryMode(EnvironmentVariable, type=str):
+    """Lineage-based device-column recovery (graftguard).
+
+    Enable (default): every DeviceColumn carries a lineage record
+    (host-materialization / io-source / op-replay); on DeviceLost the
+    recovery manager re-seats lost columns on a fresh device and the
+    failed engine call is retried, and DeviceOOM gets an evict-then-retry
+    leg before any pandas fallback.  Disable: PR-1 behavior (DeviceLost is
+    terminal for resident columns, OOM falls straight back).
+    """
+
+    varname = "MODIN_TPU_RECOVERY_MODE"
+    choices = ("Enable", "Disable")
+    default = "Enable"
+
+    @classmethod
+    def enable(cls):
+        cls.put("Enable")
+
+    @classmethod
+    def disable(cls):
+        cls.put("Disable")
+
+
+class DeviceMemoryBudget(EnvironmentVariable, type=int):
+    """Device-memory budget (bytes) for resident column buffers (unset =
+    no budget).  When set, the pre-flight admission controller at the
+    ``deploy`` seam spills cold columns to host before a dispatch that
+    would overflow the budget, instead of eating a reactive OOM."""
+
+    varname = "MODIN_TPU_DEVICE_MEMORY_BUDGET"
+    default = None
+
+    @classmethod
+    def get(cls):  # like Memory: legitimately unset means "no budget"
+        try:
+            return super().get()
+        except TypeError:
+            return None
+
+
+class LineageMaxDepth(EnvironmentVariable, type=int):
+    """Max op-replay chain length a lineage record may carry.  A column
+    whose chain would exceed it is host-checkpointed at creation (exact
+    host copy fetched once), cutting the chain to depth 0."""
+
+    varname = "MODIN_TPU_LINEAGE_MAX_DEPTH"
+    default = 8
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Lineage max depth should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class SpillRetries(EnvironmentVariable, type=int):
+    """How many evict-then-retry rounds a DeviceOOM gets at the engine
+    seam before the failure is treated as terminal (0 disables the leg)."""
+
+    varname = "MODIN_TPU_SPILL_RETRIES"
+    default = 1
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(f"Spill retries should be >= 0, passed value {value}")
+        super().put(value)
+
+
+class SpillTargetFraction(EnvironmentVariable, type=float):
+    """Fraction of resident device bytes one OOM-eviction round tries to
+    spill (cold-first).  1.0 spills everything spillable."""
+
+    varname = "MODIN_TPU_SPILL_TARGET_FRACTION"
+    default = 0.5
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if not 0.0 < value <= 1.0:
+            raise ValueError(
+                f"Spill target fraction should be in (0, 1], passed value {value}"
+            )
+        super().put(value)
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

@@ -49,6 +49,30 @@ def _is_device_dtype(dtype: Any) -> bool:
     return dtype.kind in "mM" and dtype.itemsize == 8
 
 
+def _device_put_values(values: np.ndarray, sharding: Any = None) -> Any:
+    """Host values -> padded device buffer under the dtype policy.
+
+    The transform ``from_numpy`` applies (datetime int64 view, Downcast
+    float32 policy, contiguity, shard padding), shared with the graftguard
+    spill-restore and lineage re-seat paths so a recovered buffer is
+    byte-identical to the original upload.
+    """
+    from modin_tpu.config import Float64Policy
+    from modin_tpu.ops.structural import pad_host
+    from modin_tpu.parallel.engine import JaxWrapper
+
+    device_values = values.view("int64") if values.dtype.kind in "mM" else values
+    if device_values.dtype == np.float64 and Float64Policy.get() == "Downcast":
+        # f64 on TPU is double-float emulated (~2x the FLOPs, half the
+        # VPU/MXU rate); the Downcast policy stores f32 on device while
+        # the logical dtype and host_cache keep exact float64 — the user
+        # opts into f32 compute precision for device kernels.
+        device_values = device_values.astype(np.float32)
+    if not device_values.flags.c_contiguous:
+        device_values = np.ascontiguousarray(device_values)
+    return JaxWrapper.put(pad_host(device_values), sharding)
+
+
 class DeviceColumn:
     """One column as a padded 1-D jax.Array sharded over the mesh rows axis.
 
@@ -61,10 +85,18 @@ class DeviceColumn:
     ~2^-49 relative precision with a float32 exponent range) and lets the
     default-to-pandas path skip the device->host transfer entirely.  Any
     computed column drops the cache.
+
+    graftguard state (core/execution/recovery.py, core/memory.py):
+    ``lineage`` is the creation-time provenance record; ``_device_epoch``
+    stamps which device incarnation the buffer belongs to; ``_dev_key``
+    is the device-memory ledger handle.  A **spilled** column has
+    ``_data is None`` and an exact ``host_cache`` — the buffer restores
+    transparently on the next ``raw``/``data`` access.
     """
 
     __slots__ = (
         "_data", "pandas_dtype", "length", "host_cache", "_ledger_key",
+        "lineage", "_device_epoch", "_dev_key",
         "__weakref__",
     )
     is_device = True
@@ -84,23 +116,41 @@ class DeviceColumn:
         self.length = int(length) if length is not None else int(data.shape[0])
         self.host_cache = host_cache
         self._ledger_key = None
+        self.lineage = None
+        self._device_epoch = 0
+        self._dev_key = None
         if host_cache is not None:
             # host caches count against the Memory spill budget (core/memory.py)
             from modin_tpu.core.memory import ledger
 
             ledger.register(self)
+        from modin_tpu.ops.lazy import LazyExpr
+
+        if data is not None and not isinstance(data, LazyExpr):
+            # a LazyExpr (even a memoized one) registers on materialization;
+            # only a concrete device buffer belongs in the ledgers
+            self._register_device()
+            from modin_tpu.core.execution import recovery
+
+            recovery.attach_lineage(self)
 
     @property
     def data(self) -> Any:
         from modin_tpu.ops.lazy import LazyExpr, materialize
 
+        if self._data is None:
+            self._restore()
         if isinstance(self._data, LazyExpr):
             self._data = materialize(self._data)
+            self._on_materialized()
         return self._data
 
     @property
     def raw(self) -> Any:
-        """The underlying array or deferred expression, unmaterialized."""
+        """The underlying array or deferred expression, unmaterialized
+        (a spilled column transparently restores its device buffer)."""
+        if self._data is None:
+            self._restore()
         return self._data
 
     @property
@@ -109,29 +159,97 @@ class DeviceColumn:
 
         return is_lazy(self._data)
 
+    @property
+    def is_spilled(self) -> bool:
+        """Device buffer dropped; host_cache is the (exact) only copy."""
+        return self._data is None
+
+    # -- graftguard: ledger registration, spill/restore, re-seat -------- #
+
+    def _register_device(self) -> None:
+        """Track the concrete buffer in the device-memory ledger and stamp
+        the current device epoch (recovery provenance indexing rides on
+        the same registration)."""
+        from modin_tpu.core.execution import recovery
+        from modin_tpu.core.memory import device_ledger
+
+        device_ledger.register(self)
+        self._device_epoch = recovery.current_epoch()
+        recovery.note_column_data(self)
+
+    def _on_materialized(self) -> None:
+        """A deferred expression just became a concrete device buffer."""
+        from modin_tpu.core.execution import recovery
+
+        self._register_device()
+        recovery.attach_lineage(self)
+
+    def spill(self) -> int:
+        """Drop the device buffer, keeping an exact host copy; returns the
+        device bytes freed (0 = not spillable right now)."""
+        if self._data is None or self.is_lazy:
+            return 0
+        cache = self.host_cache
+        if cache is None:
+            # to_numpy round-trips the logical dtype exactly (and under
+            # Downcast the f32 device value widens losslessly), so the
+            # host copy reproduces the device buffer bit-for-bit
+            cache = self.to_numpy()
+        from modin_tpu.core.memory import device_ledger
+
+        freed = device_ledger.deregister(self)
+        # drop the buffer BEFORE registering the cache: is_spilled must be
+        # True when the host ledger's enforce() runs, or a tight Memory
+        # budget could evict the sole copy the moment it is registered
+        self._data = None
+        if self.host_cache is None:
+            self.adopt_host_cache(cache)
+        return freed
+
+    def _restore(self) -> None:
+        """Re-seat a spilled column's device buffer from its host copy."""
+        if self.host_cache is None:
+            raise RuntimeError(
+                "spilled DeviceColumn has no host copy to restore from"
+            )
+        self.reseat_from_host()
+        from modin_tpu.logging.metrics import emit_metric
+
+        emit_metric("memory.device.restore", 1)
+
+    def reseat_from_host(self) -> None:
+        """Upload the exact host copy as a fresh device buffer (lineage
+        kind 'host'; also the spill-restore path)."""
+        values = self.host_cache  # single read: eviction may race us
+        if values is None:
+            raise RuntimeError("no host copy to re-seat from")
+        self._data = _device_put_values(np.asarray(values))
+        self._register_device()
+
+    def adopt_reseated(self, data: Any) -> None:
+        """Adopt a lineage-replayed device buffer (op-replay recovery)."""
+        self._data = data
+        self._register_device()
+
+    def adopt_host_cache(self, values: np.ndarray) -> None:
+        """Take ``values`` as the exact host copy (registered against the
+        host-memory budget like every other cache)."""
+        self.host_cache = values
+        from modin_tpu.core.memory import ledger
+
+        ledger.register(self)
+
+    def host_checkpoint(self) -> None:
+        """Pin the exact host copy (lineage depth cut-point): one fetch now
+        makes this column depth-0 recoverable forever after."""
+        if self.host_cache is None:
+            self.adopt_host_cache(self.to_numpy())
+
     @classmethod
     def from_numpy(cls, values: np.ndarray, sharding: Any = None) -> "DeviceColumn":
-        from modin_tpu.config import Float64Policy
-        from modin_tpu.ops.structural import pad_host
-        from modin_tpu.parallel.engine import JaxWrapper
-
-        pandas_dtype = values.dtype
-        device_values = values.view("int64") if values.dtype.kind in "mM" else values
-        if (
-            device_values.dtype == np.float64
-            and Float64Policy.get() == "Downcast"
-        ):
-            # f64 on TPU is double-float emulated (~2x the FLOPs, half the
-            # VPU/MXU rate); the Downcast policy stores f32 on device while
-            # the logical dtype and host_cache keep exact float64 — the user
-            # opts into f32 compute precision for device kernels.
-            device_values = device_values.astype(np.float32)
-        if not device_values.flags.c_contiguous:
-            device_values = np.ascontiguousarray(device_values)
-        padded = pad_host(device_values)
         return cls(
-            JaxWrapper.put(padded, sharding),
-            pandas_dtype,
+            _device_put_values(values, sharding),
+            values.dtype,
             length=len(values),
             host_cache=values,
         )
@@ -145,7 +263,15 @@ class DeviceColumn:
 
             ledger.touch(self)
             return cache
-        values = np.asarray(JaxWrapper.materialize(self.data))[: self.length]
+        try:
+            values = np.asarray(JaxWrapper.materialize(self.data))[: self.length]
+        except Exception as err:  # graftlint: disable=EXC-HYGIENE -- recovery gate: recover_for_read re-seats only on a classified DeviceLost and this re-raises otherwise
+            from modin_tpu.core.execution.recovery import recover_for_read
+
+            if not recover_for_read(self, err):
+                raise
+            # the column was re-seated from lineage: one fetch retry
+            values = np.asarray(JaxWrapper.materialize(self.data))[: self.length]
         if self.pandas_dtype.kind in "mM":
             values = values.view(self.pandas_dtype)
         elif values.dtype != self.pandas_dtype:
@@ -350,6 +476,7 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
         results = materialize_exprs([c.raw for c in lazy_cols])
         for col, value in zip(lazy_cols, results):
             col._data = value
+            col._on_materialized()
 
     def finalize(self) -> None:
         """Block until device work for this frame completes (one sync).

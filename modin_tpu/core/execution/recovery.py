@@ -1,0 +1,629 @@
+"""graftguard: lineage-based partition recovery for device columns.
+
+The reference Modin delegates fault tolerance to its engine — Ray rebuilds a
+lost object from the task lineage it recorded when the object was created
+("Towards Scalable Dataframe Systems", arXiv:2001.00888, names fault
+tolerance a core requirement the dataframe layer must inherit or provide).
+Our JAX engine keeps no such substrate: before this module, a ``DeviceLost``
+at the engine seam poisoned every ``DeviceColumn`` resident on the device —
+the resilience layer (resilience.py) could only degrade the *current* op to
+pandas, and every later op touching a dead buffer died too.
+
+This module is the missing recovery substrate.  Every ``DeviceColumn``
+carries a **lineage record** attached at creation time, one of three
+provenance kinds:
+
+- ``host`` (host-materialization) — the column's ``host_cache`` is an exact
+  host copy; recovery is one ``JaxWrapper.put``.
+- ``io`` (io-source) — the column came from a file read; the record holds
+  the dispatcher + call args and re-reads the column on demand
+  (modin_tpu/core/io/file_dispatcher.py attaches these).
+- ``op`` (op-replay) — the column is the output of a device computation;
+  the engine seam recorded the ``(func, args)`` of the ``deploy`` that
+  produced it (weakly referencing the input buffers, so lineage never pins
+  HBM), and recovery replays the op over recursively-recovered inputs.
+  Replay depth is bounded by ``MODIN_TPU_LINEAGE_MAX_DEPTH``: a column
+  whose chain would exceed it is **host-checkpointed at creation** (exact
+  host copy fetched once, cutting the chain to depth 0).
+
+On a ``DeviceLost`` (or a device-path breaker opening on one), the
+recovery manager bumps the global **device epoch** — marking every resident
+buffer suspect — and re-seats all live columns from their lineage on the
+(fresh) device, so the in-flight engine call can be retried and the query
+completes bit-exact instead of failing.  Everything is observable: the
+``recovery.*`` metric families, a ``recovery.reseat`` span per pass, and a
+flight-recorder dump tying the recovery to the spans that preceded it.
+
+The companion *admission control* half of graftguard lives in
+core/memory.py (``_DeviceLedger``) and parallel/engine.py (pre-flight
+budget check at ``deploy``); the ``DeviceOOM`` evict-then-retry loop that
+consumes :func:`evict_for_oom` is in resilience.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import spans as graftscope
+from modin_tpu.observability.flight_recorder import dump_flight_record
+
+#: Lineage provenance kinds (short forms used in metric names):
+#: ``host`` = host-materialization, ``io`` = io-source, ``op`` = op-replay,
+#: ``opaque`` = adopted foreign buffer with no recorded provenance.
+KIND_HOST = "host"
+KIND_IO = "io"
+KIND_OP = "op"
+KIND_OPAQUE = "opaque"
+
+#: module-level fast path, kept current by the RecoveryMode subscription —
+#: instrumented hot paths (column registration, deploy provenance) check
+#: this one attribute and pay nothing else while recovery is disabled
+RECOVERY_ON: bool = True
+
+_tls = threading.local()
+
+_epoch_lock = threading.Lock()
+_device_epoch = 0
+
+
+class Unrecoverable(Exception):
+    """A column's lineage cannot reproduce its device buffer (internal
+    signal; never escapes the recovery manager)."""
+
+
+class LineageRecord:
+    """Provenance of one device column, attached at creation time.
+
+    ``kind`` is one of the KIND_* constants; ``depth`` is the op-replay
+    chain length below this column (0 for host/io/opaque); ``replay`` is
+    the io-source re-read callable (returns the exact host values) and is
+    None for every other kind; ``detail`` is a human-readable provenance
+    note surfaced in debugging dumps (dispatcher name, op name).
+    """
+
+    __slots__ = ("kind", "depth", "replay", "detail")
+
+    def __init__(
+        self,
+        kind: str,
+        depth: int = 0,
+        replay: Optional[Callable[[], Any]] = None,
+        detail: str = "",
+    ):
+        self.kind = kind
+        self.depth = depth
+        self.replay = replay
+        self.detail = detail
+
+    def __repr__(self) -> str:
+        return f"<LineageRecord {self.kind} depth={self.depth} {self.detail}>"
+
+
+# ---------------------------------------------------------------------- #
+# provenance capture at the engine seam
+# ---------------------------------------------------------------------- #
+#
+# The engine wrapper (JaxWrapper.deploy/put) calls record_deploy /
+# record_put after every successful dispatch.  Records are keyed by
+# id(output array) with a weakref guard: the entry dies with the array
+# (no pinning, no id-reuse hazard).  Input buffers inside a deploy record
+# are held WEAKLY — lineage must never extend a buffer's lifetime, or the
+# admission controller's spills would free nothing.
+
+
+class _ArrRef:
+    """Weak placeholder for a device-array leaf inside recorded args."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, arr: Any):
+        self.ref = weakref.ref(arr)
+
+
+class _DeployCall:
+    """One recorded ``deploy`` invocation, shared by all its output leaves."""
+
+    __slots__ = ("func", "args", "kwargs", "depth")
+
+    def __init__(self, func: Callable, args: Any, kwargs: Optional[dict], depth: int):
+        self.func = func
+        self.args = args  # tree with array leaves replaced by _ArrRef
+        self.kwargs = kwargs
+        self.depth = depth
+
+
+class _Record:
+    """Provenance of one output array: how to replay it."""
+
+    __slots__ = ("ref", "call", "path", "put_ref", "depth")
+
+    def __init__(
+        self,
+        arr: Any,
+        on_dead: Callable,
+        call: Optional[_DeployCall] = None,
+        path: Tuple[int, ...] = (),
+        put_ref: Optional[weakref.ref] = None,
+    ):
+        self.ref = weakref.ref(arr, on_dead)
+        self.call = call
+        self.path = path
+        self.put_ref = put_ref  # weakref to the host values given to put
+        self.depth = call.depth if call is not None else 0
+
+
+_prov_lock = threading.RLock()
+_provenance: Dict[int, _Record] = {}
+#: id(device array) -> (weakref(owning DeviceColumn), weakref(the array));
+#: lets op replay resolve an input buffer back to its column (and that
+#: column's richer host/io lineage) instead of only the raw deploy chain.
+#: The array weakref guards id reuse AND keeps the mapping valid after the
+#: column re-seats onto a new buffer — which is exactly when a rebind
+#: needs "old buffer -> same column, fresh buffer".
+_columns_by_data: Dict[int, tuple] = {}
+
+
+def _forget_record(key: int) -> None:
+    with _prov_lock:
+        _provenance.pop(key, None)
+
+
+def _walk_leaves(tree: Any, path: Tuple[int, ...] = ()):
+    """Yield (path, leaf) for array leaves in a (possibly nested) result."""
+    if isinstance(tree, (tuple, list)):
+        for i, item in enumerate(tree):
+            yield from _walk_leaves(item, path + (i,))
+    else:
+        yield path, tree
+
+
+def _is_device_array(x: Any) -> bool:
+    from modin_tpu.parallel.engine import JaxWrapper
+
+    return JaxWrapper.is_future(x)
+
+
+def _encode_args(tree: Any) -> Any:
+    """Recorded-args form of ``tree``: array leaves become weak _ArrRefs."""
+    if isinstance(tree, (tuple, list)):
+        return type(tree)(_encode_args(a) for a in tree)
+    if _is_device_array(tree):
+        return _ArrRef(tree)
+    return tree
+
+
+def _args_depth(tree: Any) -> int:
+    """Max provenance depth over the array leaves of ``tree``.
+
+    A leaf owned by a column defers to the column's lineage depth — a
+    host-checkpointed column is depth 0 even though its raw deploy record
+    remembers the full chain, which is exactly how a checkpoint restarts
+    the chain below it.
+    """
+    depth = 0
+    for _path, leaf in _walk_leaves(tree):
+        if not _is_device_array(leaf):
+            continue
+        col = _lookup_column(leaf)
+        lin = getattr(col, "lineage", None) if col is not None else None
+        if lin is not None:
+            depth = max(depth, lin.depth)
+            continue
+        rec = _lookup_record(leaf)
+        if rec is not None and rec.call is not None:
+            depth = max(depth, rec.depth)
+    return depth
+
+
+def _lookup_record(arr: Any) -> Optional[_Record]:
+    with _prov_lock:
+        rec = _provenance.get(id(arr))
+    # identity check guards against id reuse racing the weakref callback
+    return rec if rec is not None and rec.ref() is arr else None
+
+
+def _lookup_column(arr: Any) -> Optional[Any]:
+    with _prov_lock:
+        entry = _columns_by_data.get(id(arr))
+    if entry is None:
+        return None
+    col_ref, data_ref = entry
+    if data_ref() is not arr:  # the keyed buffer died and its id was reused
+        return None
+    return col_ref()
+
+
+def record_deploy(func: Callable, f_args: tuple, f_kwargs: Optional[dict], result: Any) -> None:
+    """Record op-replay provenance for every array leaf of a deploy result."""
+    if not RECOVERY_ON:
+        return
+    try:
+        call = _DeployCall(
+            func, _encode_args(f_args), f_kwargs, depth=1 + _args_depth(f_args)
+        )
+        with _prov_lock:
+            for path, leaf in _walk_leaves(result):
+                if not _is_device_array(leaf):
+                    continue
+                key = id(leaf)
+
+                def _on_dead(_ref: Any, *, _key: int = key) -> None:
+                    _forget_record(_key)
+
+                _provenance[key] = _Record(leaf, _on_dead, call=call, path=path)
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- provenance capture is best-effort; a column without a record degrades to unrecoverable, never breaks the op
+        pass
+
+
+def record_put(host_values: Any, result: Any) -> None:
+    """Record host-origin provenance for a ``put`` output (weak host ref)."""
+    if not RECOVERY_ON:
+        return
+    try:
+        if not _is_device_array(result):
+            return
+        key = id(result)
+
+        def _on_dead(_ref: Any, *, _key: int = key) -> None:
+            _forget_record(_key)
+
+        with _prov_lock:
+            _provenance[key] = _Record(
+                result, _on_dead, put_ref=weakref.ref(host_values)
+            )
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- provenance capture is best-effort (e.g. a non-weakrefable host buffer); recovery just has one fewer path
+        pass
+
+
+def note_column_data(col: Any) -> None:
+    """Index ``col``'s concrete device buffer for input→column resolution."""
+    data = col._data
+    try:
+        entry = (weakref.ref(col), weakref.ref(data))
+    except TypeError:
+        return  # not a weakref-able device buffer (deferred wrapper etc.)
+    with _prov_lock:
+        _columns_by_data[id(data)] = entry
+        # bound the map: drop entries whose buffer or column died
+        if len(_columns_by_data) > 4096:
+            for k in [
+                k
+                for k, (col_ref, data_ref) in _columns_by_data.items()
+                if data_ref() is None or col_ref() is None
+            ]:
+                _columns_by_data.pop(k, None)
+
+
+# ---------------------------------------------------------------------- #
+# lineage attachment (called by DeviceColumn at creation time)
+# ---------------------------------------------------------------------- #
+
+
+def current_epoch() -> int:
+    return _device_epoch
+
+
+def in_recovery() -> bool:
+    return getattr(_tls, "active", False)
+
+
+def attach_lineage(col: Any) -> None:
+    """Attach the creation-time lineage record to ``col`` (and index its
+    buffer).  Chains deeper than ``MODIN_TPU_LINEAGE_MAX_DEPTH`` are cut by
+    an automatic host checkpoint: one exact host fetch now buys O(1)
+    recovery later and keeps replay recursion bounded.
+    """
+    if not RECOVERY_ON:
+        if col.lineage is None:
+            col.lineage = LineageRecord(KIND_OPAQUE)
+        return
+    if col.lineage is not None:  # io-source records survive re-attachment
+        return
+    if col.host_cache is not None:
+        col.lineage = LineageRecord(KIND_HOST)
+        return
+    rec = _lookup_record(col.raw)
+    if rec is not None and rec.call is not None:
+        from modin_tpu.config import LineageMaxDepth
+
+        if rec.depth > int(LineageMaxDepth.get()):
+            try:
+                col.host_checkpoint()
+                col.lineage = LineageRecord(
+                    KIND_HOST, detail=f"checkpoint-cut@{rec.depth}"
+                )
+                emit_metric("recovery.checkpoint_cut", 1)
+                return
+            except Exception:  # graftlint: disable=EXC-HYGIENE -- checkpoint fetch is an optimization; on failure the deep op-replay chain remains the lineage
+                pass
+        col.lineage = LineageRecord(KIND_OP, depth=rec.depth)
+        return
+    if rec is not None and rec.put_ref is not None:
+        col.lineage = LineageRecord(KIND_HOST, detail="put-origin")
+        return
+    col.lineage = LineageRecord(KIND_OPAQUE)
+
+
+def attach_io_lineage(col: Any, replay: Callable[[], Any], detail: str) -> None:
+    """Attach (or upgrade to) an io-source record: ``replay`` re-reads the
+    column's exact host values from its file source on demand."""
+    col.lineage = LineageRecord(KIND_IO, replay=replay, detail=detail)
+
+
+# ---------------------------------------------------------------------- #
+# recovery: re-seat columns from lineage
+# ---------------------------------------------------------------------- #
+
+
+def _replay_array(arr: Any, depth: int) -> Any:
+    """A live device buffer equivalent to ``arr`` (recovered if possible).
+
+    Resolution order: the owning column's lineage (host/io caches beat
+    replay), then the raw deploy-provenance chain, then — with no lineage
+    at all — the original reference (usable only if the runtime still
+    honors it; a truly lost buffer will fail the replayed dispatch and the
+    column counts as unrecoverable).
+    """
+    from modin_tpu.config import LineageMaxDepth
+
+    if depth > int(LineageMaxDepth.get()):
+        raise Unrecoverable(f"lineage deeper than LineageMaxDepth at {arr!r}")
+    col = _lookup_column(arr)
+    if col is not None:
+        recover_column(col, depth=depth)
+        fresh = col._data
+        if fresh is not None and not getattr(col, "is_lazy", False):
+            return fresh
+    rec = _lookup_record(arr)
+    if rec is not None:
+        return _replay_record(rec, depth)
+    return arr
+
+
+def _replay_record(rec: _Record, depth: int) -> Any:
+    from modin_tpu.parallel.engine import JaxWrapper
+
+    if rec.put_ref is not None:
+        host = rec.put_ref()
+        if host is None:
+            raise Unrecoverable("host origin of a put was garbage-collected")
+        return JaxWrapper.put(host)
+    call = rec.call
+    if call is None:
+        raise Unrecoverable("record has neither a put origin nor a deploy call")
+
+    def _decode(tree: Any) -> Any:
+        if isinstance(tree, (tuple, list)):
+            return type(tree)(_decode(a) for a in tree)
+        if isinstance(tree, _ArrRef):
+            old = tree.ref()
+            if old is None:
+                raise Unrecoverable("an input buffer of the replay is gone")
+            return _replay_array(old, depth + 1)
+        return tree
+
+    args = _decode(call.args)
+    result = JaxWrapper.deploy(call.func, args, call.kwargs)
+    for path, leaf in _walk_leaves(result):
+        if path == rec.path:
+            return leaf
+    raise Unrecoverable("replayed op did not reproduce the output slot")
+
+
+def recover_column(col: Any, depth: int = 0, force: bool = False) -> Optional[str]:
+    """Re-seat one column's device buffer from its lineage.
+
+    Returns the lineage kind used, or None when the column was already
+    fresh (current epoch, concrete buffer).  Raises :class:`Unrecoverable`
+    when no lineage can reproduce the buffer.
+    """
+    if getattr(col, "is_lazy", False):
+        return None  # nothing device-resident to lose yet
+    if col._data is None:
+        # spilled: nothing device-resident was lost; the host copy restores
+        # it on next access (and a spilled column always has one)
+        return None
+    if not force and col._device_epoch >= _device_epoch:
+        return None
+    if col.host_cache is not None:
+        col.reseat_from_host()
+        return KIND_HOST
+    lin = col.lineage
+    if lin is not None and lin.kind == KIND_IO and lin.replay is not None:
+        try:
+            values = lin.replay()
+        except Unrecoverable:
+            raise
+        except Exception as err:  # graftlint: disable=EXC-HYGIENE -- the io re-read hits filesystems/network; ANY failure means this lineage path is unusable, reported as Unrecoverable
+            raise Unrecoverable(f"io-source replay failed: {err}") from err
+        # the dead buffer goes first: while the re-read values are the sole
+        # copy, is_spilled shields them from the host ledger's eviction
+        col._data = None
+        col.adopt_host_cache(values)
+        col.reseat_from_host()
+        return KIND_IO
+    old = col._data
+    rec = _lookup_record(old) if old is not None else None
+    if rec is not None and (rec.call is not None or rec.put_ref is not None):
+        fresh = _replay_record(rec, depth + 1)
+        col.adopt_reseated(fresh)
+        return KIND_OP
+    raise Unrecoverable(
+        f"no lineage for column dtype={col.pandas_dtype} len={col.length}"
+    )
+
+
+#: io-source replayers holding a per-epoch memo of their re-read values;
+#: purged at the end of every recovery pass so one pass does not pin a
+#: full host copy of the source dataset indefinitely
+_io_replayers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def note_io_replayer(replayer: Any) -> None:
+    """Track ``replayer`` for end-of-pass cache purging."""
+    _io_replayers.add(replayer)
+
+
+def _purge_io_caches() -> None:
+    for replayer in list(_io_replayers):
+        try:
+            replayer.drop_cache()
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- purge is best-effort housekeeping at the end of a recovery pass
+            pass
+
+
+def reseat_all(reason: str) -> int:
+    """Bump the device epoch and re-seat every live device column.
+
+    Called on a terminal ``DeviceLost`` at the engine seam and on a
+    device-path breaker opening on one.  Returns how many columns were
+    re-seated; 0 means nothing was resident (or recovery is disabled) and
+    the caller should not bother retrying.
+    """
+    global _device_epoch
+    if not RECOVERY_ON or in_recovery():
+        return 0
+    from modin_tpu.core.memory import device_ledger
+
+    _tls.active = True
+    try:
+        with _epoch_lock:
+            _device_epoch += 1
+        emit_metric("recovery.device_lost", 1)
+        reseated = 0
+        with graftscope.span(
+            "recovery.reseat", layer="JAX-ENGINE", reason=reason
+        ):
+            for col in device_ledger.live_columns():
+                try:
+                    kind = recover_column(col)
+                except Unrecoverable:
+                    emit_metric("recovery.unrecoverable", 1)
+                    continue
+                except Exception:  # graftlint: disable=EXC-HYGIENE -- recovery is best-effort per column; one bad record must not abort the pass for every other column
+                    emit_metric("recovery.unrecoverable", 1)
+                    continue
+                if kind is not None:
+                    emit_metric(f"recovery.reseat.{kind}", 1)
+                    reseated += 1
+        if dump_flight_record("recovery_reseat", detail=reason):
+            emit_metric("trace.flight_dump", 1)
+        return reseated
+    finally:
+        _tls.active = False
+        _purge_io_caches()
+
+
+def recover_for_read(col: Any, err: BaseException) -> bool:
+    """Last-chance read-path recovery for one column's host fetch.
+
+    Called by ``DeviceColumn.to_numpy`` when its materialize raised through
+    the engine seam's own recovery: if ``err`` classifies as a DeviceLost
+    and the column has usable lineage, re-seat it and tell the caller to
+    retry the fetch.  False means "nothing recovered — re-raise".
+    """
+    from modin_tpu.core.execution.resilience import (
+        DeviceLost,
+        classify_device_error,
+    )
+
+    if not RECOVERY_ON or in_recovery():
+        return False
+    if not isinstance(classify_device_error(err), DeviceLost):
+        return False
+    _tls.active = True
+    try:
+        try:
+            kind = recover_column(col, force=True)
+        except Unrecoverable:
+            emit_metric("recovery.unrecoverable", 1)
+            return False
+        if kind is not None:
+            emit_metric(f"recovery.reseat.{kind}", 1)
+        return True
+    finally:
+        _tls.active = False
+        _purge_io_caches()
+
+
+def recover_args(tree: Any) -> Optional[Any]:
+    """``tree`` with every device-array leaf swapped for its recovered
+    incarnation, or None when nothing could be rebound.
+
+    The engine-seam retry after a re-seat re-runs a thunk whose closure
+    still references the OLD buffers; on a real device loss those are dead,
+    so ``JaxWrapper.deploy`` uses this to rebuild its argument tree against
+    the re-seated columns (or lineage replays) and dispatch once more over
+    live buffers.
+    """
+    if not RECOVERY_ON or in_recovery():
+        return None
+    _tls.active = True
+    try:
+
+        def rebind(node: Any) -> Any:
+            if isinstance(node, (tuple, list)):
+                return type(node)(rebind(a) for a in node)
+            if _is_device_array(node):
+                return _replay_array(node, 0)
+            return node
+
+        try:
+            return rebind(tree)
+        except Unrecoverable:
+            return None
+    finally:
+        _tls.active = False
+        _purge_io_caches()
+
+
+def evict_for_oom(op: str, exclude_ids: Any = None) -> int:
+    """Spill cold device columns to make room after a ``DeviceOOM``.
+
+    The evict-then-retry leg of resilience.py calls this before giving the
+    failed dispatch another chance; returns the bytes freed (0 = nothing
+    spillable, the caller should fall through to its existing handling).
+    ``exclude_ids`` carries the ``id()`` of the failing op's own input
+    buffers — spilling those frees nothing (the dispatch closure pins
+    them), so they stay resident.
+    """
+    if not RECOVERY_ON or in_recovery():
+        return 0
+    from modin_tpu.config import SpillTargetFraction
+    from modin_tpu.core.memory import device_ledger
+
+    _tls.active = True
+    try:
+        resident = device_ledger.total_bytes()
+        target = max(int(resident * float(SpillTargetFraction.get())), 1)
+        return device_ledger.spill_lru(target, exclude_ids=exclude_ids)
+    finally:
+        _tls.active = False
+
+
+# ---------------------------------------------------------------------- #
+# config wiring & test seams
+# ---------------------------------------------------------------------- #
+
+
+def _on_recovery_param(param: Any) -> None:
+    global RECOVERY_ON
+    RECOVERY_ON = param.get() == "Enable"
+
+
+def reset_for_tests() -> None:
+    """Forget provenance and epoch state (test isolation)."""
+    global _device_epoch
+    with _prov_lock:
+        _provenance.clear()
+        _columns_by_data.clear()
+    with _epoch_lock:
+        _device_epoch = 0
+
+
+from modin_tpu.config import RecoveryMode as _RecoveryMode  # noqa: E402
+
+_RecoveryMode.subscribe(_on_recovery_param)

@@ -203,20 +203,45 @@ def _run_with_watchdog(op: str, thunk: Callable[[], Any], timeout_s: float) -> A
     raise payload
 
 
-def engine_call(op: str, thunk: Callable[[], Any], watchdog: bool = False) -> Any:
+def engine_call(
+    op: str,
+    thunk: Callable[[], Any],
+    watchdog: bool = False,
+    protect_ids: Optional[set] = None,
+) -> Any:
     """Run one engine-seam invocation under the resilience policy.
 
     Transient failures retry up to ``ResilienceRetries`` times with
-    exponential backoff; OOM / device-lost raise immediately as their
-    classified type.  ``watchdog=True`` (materialize/wait — the blocking
-    fetches) additionally bounds each attempt by ``ResilienceWatchdogS``.
+    exponential backoff.  ``watchdog=True`` (materialize/wait — the
+    blocking fetches) additionally bounds each attempt by
+    ``ResilienceWatchdogS``.
+
+    graftguard (core/execution/recovery.py) upgrades the two formerly
+    terminal failure kinds:
+
+    - ``DeviceOOM`` gets up to ``SpillRetries`` **evict-then-retry**
+      rounds — spill the coldest device columns to host (never the ones
+      in ``protect_ids``: the failing op's own inputs, pinned by the
+      thunk closure), then re-dispatch — before the OOM is terminal;
+    - ``DeviceLost`` gets one **lineage re-seat**: every live device
+      column is rebuilt from its provenance on the (fresh) device and
+      the call retried.  The retry re-runs the SAME thunk — its closure
+      still references the pre-loss buffers, which an injected fault
+      leaves intact but a real loss kills; ``JaxWrapper.deploy`` adds the
+      rebind-and-redispatch leg for that case, and the pandas fallbacks
+      read the re-seated/host data either way.
+
+    Both legs are skipped while a recovery pass is itself on the stack
+    (no recursive recovery) and when ``MODIN_TPU_RECOVERY_MODE=Disable``.
     """
     from modin_tpu.config import (
         ResilienceBackoffS,
         ResilienceMode,
         ResilienceRetries,
         ResilienceWatchdogS,
+        SpillRetries,
     )
+    from modin_tpu.core.execution import recovery
 
     def attempt_once() -> Any:
         hook = _fault_hook
@@ -230,7 +255,10 @@ def engine_call(op: str, thunk: Callable[[], Any], watchdog: bool = False) -> An
     timeout_s = float(ResilienceWatchdogS.get()) if watchdog else 0.0
     retries = int(ResilienceRetries.get())
     backoff_s = float(ResilienceBackoffS.get())
+    spill_retries = int(SpillRetries.get())
     attempt = 0
+    oom_rounds = 0
+    reseat_spent = False
     while True:
         sp = compiles_before = None
         if graftscope.TRACE_ON:
@@ -260,6 +288,28 @@ def engine_call(op: str, thunk: Callable[[], Any], watchdog: bool = False) -> An
             if failure is None:
                 raise
             emit_metric(f"resilience.engine.{op}.{failure.kind}", 1)
+            if (
+                isinstance(failure, DeviceOOM)
+                and oom_rounds < spill_retries
+                and not recovery.in_recovery()
+                and recovery.evict_for_oom(op, exclude_ids=protect_ids) > 0
+            ):
+                # evict-then-retry: cold columns were spilled to host, so
+                # the same dispatch now has the HBM it asked for
+                oom_rounds += 1
+                emit_metric("recovery.retry.oom", 1)
+                continue
+            if (
+                isinstance(failure, DeviceLost)
+                and not reseat_spent
+                and not recovery.in_recovery()
+                and recovery.reseat_all(f"engine_{op}") > 0
+            ):
+                # lineage re-seat: resident columns were rebuilt on the
+                # fresh device; give the call one post-recovery retry
+                reseat_spent = True
+                emit_metric("recovery.retry.device_lost", 1)
+                continue
             if not isinstance(failure, TransientDeviceError) or attempt >= retries:
                 # terminal for this call: preserve the trace that led here
                 if dump_flight_record(f"terminal_{failure.kind}", detail=op):
@@ -505,6 +555,15 @@ def device_path(family: str) -> Callable:
                     breaker.abort_probe()
                     raise
                 breaker.record_failure()
+                if isinstance(failure, DeviceLost) and breaker.state == OPEN:
+                    # terminal breaker-open on a lost device: re-seat the
+                    # resident columns from lineage NOW so the pandas
+                    # fallbacks this family degrades to (and every other
+                    # family) read healthy buffers instead of poisoned ones
+                    from modin_tpu.core.execution import recovery
+
+                    if not recovery.in_recovery():
+                        recovery.reseat_all(f"breaker_open_{family}")
                 emit_metric(f"resilience.fallback.{family}.{failure.kind}", 1)
                 if graftscope.TRACE_ON:
                     graftscope.finish_span(

@@ -8,6 +8,7 @@ filesystem otherwise.
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Any, Optional
 
@@ -15,6 +16,55 @@ from modin_tpu.logging import ClassLogger
 from modin_tpu.observability import spans as graftscope
 
 NOT_IMPLEMENTED_MESSAGE = "Implement in children classes!"
+
+
+class _IoReplay:
+    """Re-run a dispatcher read and serve per-column exact host values.
+
+    The io-source lineage record (core/execution/recovery.py): holds only
+    the dispatcher class and the original call args — no data — and on
+    demand re-reads the source once per device epoch, memoizing the host
+    values so a recovery pass re-seating N columns costs one read, not N.
+    Recovered columns adopt the memoized arrays as ``host_cache``; the memo
+    itself is dropped at the end of every recovery pass (``drop_cache``,
+    called via the recovery manager's purge hook) so one pass never pins a
+    full host copy of the source dataset beyond its own duration.
+    """
+
+    def __init__(self, dispatcher: type, args: tuple, kwargs: dict):
+        self._dispatcher = dispatcher
+        self._args = args
+        self._kwargs = kwargs
+        self._cache: Optional[tuple] = None  # (epoch, [values per position])
+
+    def drop_cache(self) -> None:
+        self._cache = None
+
+    def values_for(self, pos: int) -> Any:
+        from modin_tpu.core.execution import recovery
+
+        epoch = recovery.current_epoch()
+        cache = self._cache
+        if cache is None or cache[0] != epoch:
+            result = self._dispatcher._read(*self._args, **self._kwargs)
+            frame = getattr(result, "_modin_frame", None)
+            columns = getattr(frame, "_columns", None)
+            if columns is None:
+                raise RuntimeError(
+                    f"{self._dispatcher.__name__} re-read produced no frame"
+                )
+            cache = (
+                epoch,
+                [c.to_numpy() if c.is_device else None for c in columns],
+            )
+            self._cache = cache
+            recovery.note_io_replayer(self)  # purged at end of pass
+        values = cache[1][pos] if pos < len(cache[1]) else None
+        if values is None:
+            raise RuntimeError(
+                f"column {pos} absent from the {self._dispatcher.__name__} re-read"
+            )
+        return values
 
 
 class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
@@ -26,12 +76,42 @@ class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
         """Template: normalize, dispatch to _read, postprocess.
 
         Under the ``TrackFileLeaks`` config every read is audited for leaked
-        file descriptors (reference guard: modin/config/envvars.py:893)."""
+        file descriptors (reference guard: modin/config/envvars.py:893).
+
+        Every device column of the result gets an **io-source lineage
+        record** (graftguard): if the device is lost — even after the
+        column's host cache was evicted under the ``Memory`` budget — the
+        recovery manager can rebuild it by re-running this read.
+        """
         from modin_tpu.utils.file_leaks import track_file_leaks
 
         with graftscope.span("io.read", layer="CORE-IO", dispatcher=cls.__name__):
             with track_file_leaks():
-                return cls._read(*args, **kwargs)
+                result = cls._read(*args, **kwargs)
+        cls._attach_io_lineage(result, args, kwargs)
+        return result
+
+    @classmethod
+    def _attach_io_lineage(cls, result: Any, args: tuple, kwargs: dict) -> None:
+        from modin_tpu.core.execution import recovery
+
+        if not recovery.RECOVERY_ON:
+            return
+        try:
+            frame = getattr(result, "_modin_frame", None)
+            columns = getattr(frame, "_columns", None)
+            if not columns:
+                return
+            replayer = _IoReplay(cls, args, kwargs)
+            for pos, col in enumerate(columns):
+                if getattr(col, "is_device", False):
+                    recovery.attach_io_lineage(
+                        col,
+                        replay=functools.partial(replayer.values_for, pos),
+                        detail=cls.__name__,
+                    )
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- lineage attachment is best-effort; a read result without the expected frame shape just keeps its host/op lineage
+            pass
 
     @classmethod
     def _read(cls, *args: Any, **kwargs: Any):

@@ -1,14 +1,27 @@
-"""Host-memory budget for device-column caches (spill policy).
+"""Memory ledgers for device-column storage (host spill + device admission).
 
-TPU-native analogue of the reference's ``Memory`` knob (reference:
-modin/config/envvars.py:188-ish ``Memory`` sizes the object-store /plasma
-spill budget for its engines).  Here the analogous host-RAM consumer is
-``DeviceColumn.host_cache`` — the exact host copy kept so device round-trips
-are bit-exact and fallbacks skip transfers.  When ``Memory`` (bytes) is set,
-a process-wide LRU ledger evicts the coldest caches once the total exceeds
-the budget; the device buffer remains authoritative, so eviction only drops
-a cache whose dtype round-trips exactly from device (not logical float64
-stored as f32 under ``Float64Policy=Downcast``).
+Two budgets, two ledgers, one spill policy each way:
+
+- **Host side** (``_HostCacheLedger`` / the ``Memory`` knob): TPU-native
+  analogue of the reference's ``Memory`` parameter (reference:
+  modin/config/envvars.py:188-ish sizes the object-store/plasma spill
+  budget for its engines).  The host-RAM consumer here is
+  ``DeviceColumn.host_cache`` — the exact host copy kept so device
+  round-trips are bit-exact and fallbacks skip transfers.  Over budget, the
+  coldest caches are dropped; the device buffer remains authoritative, so
+  eviction only drops a cache whose dtype round-trips exactly from device
+  (not logical float64 stored as f32 under ``Float64Policy=Downcast``, and
+  never the sole copy of a spilled column).
+
+- **Device side** (``_DeviceLedger`` / the ``DeviceMemoryBudget`` knob,
+  new in graftguard): mirrors the host ledger for *device*-resident bytes.
+  Every concrete ``DeviceColumn`` buffer is registered with its padded
+  byte size; the pre-flight admission controller at the ``deploy`` seam
+  (parallel/engine.py) and the ``DeviceOOM`` evict-then-retry leg
+  (resilience.py via recovery.evict_for_oom) spill the coldest columns to
+  host — drop the device buffer, keep an exact host copy — *before* XLA
+  has to raise RESOURCE_EXHAUSTED (the proactive memory-aware admission
+  Xorbits, arXiv:2401.00865, shows distributed dataframes need at scale).
 """
 
 from __future__ import annotations
@@ -16,7 +29,10 @@ from __future__ import annotations
 import threading
 import weakref
 from collections import OrderedDict
-from typing import Any, Optional
+from typing import Any, List, Optional
+
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import spans as graftscope
 
 
 class _HostCacheLedger:
@@ -106,6 +122,8 @@ def _evictable(col: Any) -> bool:
     cache = col.host_cache
     if cache is None:
         return False
+    if getattr(col, "is_spilled", False):
+        return False  # spilled column: the host copy is the ONLY copy
     if col.is_lazy:
         return False  # materialization may still want the exact source
     try:
@@ -123,3 +141,170 @@ ledger = _HostCacheLedger()
 def host_cache_bytes() -> int:
     """Total host bytes currently pinned by device-column caches."""
     return ledger.total_bytes()
+
+
+# ---------------------------------------------------------------------- #
+# device-memory ledger (graftguard admission control)
+# ---------------------------------------------------------------------- #
+
+#: cached budget, kept current by the DeviceMemoryBudget subscription so
+#: the admission check on the deploy hot path is one attribute read
+_DEVICE_BUDGET: Optional[int] = None
+
+
+class _DeviceLedger:
+    """LRU accounting of device-resident bytes across all device columns.
+
+    Mirrors ``_HostCacheLedger`` with the roles flipped: the tracked
+    resource is the column's *device* buffer (padded physical size), and
+    "eviction" is a **spill** — materialize an exact host copy, drop the
+    device buffer, and let the column transparently restore on next device
+    access.  Insertion order is the LRU order; ``touch`` refreshes it.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()  # weakref callbacks may re-enter
+        self._entries: "OrderedDict[int, tuple]" = OrderedDict()
+        self._total = 0
+        self._next_id = 0
+        self._spill_events = 0
+
+    # -- registration -------------------------------------------------- #
+
+    def register(self, col: Any) -> None:
+        """Track ``col``'s concrete device buffer (idempotent per buffer)."""
+        data = col.raw
+        nbytes = getattr(data, "nbytes", None)
+        if nbytes is None:
+            return
+        nbytes = int(nbytes)
+        with self._lock:
+            old_key = getattr(col, "_dev_key", None)
+            if old_key is not None:
+                entry = self._entries.pop(old_key, None)
+                if entry is not None:
+                    self._total -= entry[1]
+            key = self._next_id
+            self._next_id += 1
+
+            def _on_dead(_ref: Any, *, _key: int = key) -> None:
+                self._forget(_key)
+
+            self._entries[key] = (weakref.ref(col, _on_dead), nbytes)
+            col._dev_key = key
+            self._total += nbytes
+
+    def deregister(self, col: Any) -> int:
+        """Stop tracking ``col`` (its buffer was dropped); returns bytes."""
+        key = getattr(col, "_dev_key", None)
+        if key is None:
+            return 0
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            col._dev_key = None
+            if entry is None:
+                return 0
+            self._total -= entry[1]
+            return entry[1]
+
+    def _forget(self, key: int) -> None:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._total -= entry[1]
+
+    def touch(self, col: Any) -> None:
+        key = getattr(col, "_dev_key", None)
+        if key is None:
+            return
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+
+    # -- introspection -------------------------------------------------- #
+
+    def total_bytes(self) -> int:
+        return self._total
+
+    def budget(self) -> Optional[int]:
+        return _DEVICE_BUDGET
+
+    def spill_count(self) -> int:
+        """Spill events since process start (the OOM-burst fault injector
+        keys off this to model 'pressure cleared by eviction')."""
+        return self._spill_events
+
+    def live_columns(self) -> List[Any]:
+        """Snapshot of tracked live columns, coldest first (recovery walks
+        this to re-seat everything after a device loss)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return [col for ref, _ in entries if (col := ref()) is not None]
+
+    # -- spill policy --------------------------------------------------- #
+
+    def spill_lru(self, target_bytes: int, exclude_ids: Any = None) -> int:
+        """Spill coldest columns until ``target_bytes`` freed; returns bytes.
+
+        ``exclude_ids`` is a set of ``id(buffer)`` the caller is about to
+        dispatch over: spilling an op's own inputs frees nothing (the
+        dispatch closure pins them), so admission skips them.
+        """
+        with self._lock:
+            candidates = list(self._entries.items())
+        freed = 0
+        spilled = 0
+        with graftscope.span(
+            "memory.device.spill", layer="JAX-ENGINE", target=target_bytes
+        ):
+            for _key, (ref, _nbytes) in candidates:
+                if freed >= target_bytes:
+                    break
+                col = ref()
+                if col is None or getattr(col, "is_lazy", False):
+                    continue
+                if exclude_ids is not None and id(col.raw) in exclude_ids:
+                    continue
+                try:
+                    got = col.spill()
+                except Exception:  # graftlint: disable=EXC-HYGIENE -- a column that cannot fetch its exact host copy simply stays resident; spill is best-effort by design
+                    continue
+                if got > 0:
+                    freed += got
+                    spilled += 1
+        if spilled:
+            with self._lock:
+                self._spill_events += spilled
+            emit_metric("memory.device.spill", spilled)
+            emit_metric("memory.device.spill_bytes", freed)
+        return freed
+
+    def admit(self, estimate_bytes: int, exclude_ids: Any = None) -> None:
+        """Pre-flight admission: make room for an op projected to allocate
+        ``estimate_bytes`` on device, spilling cold columns if the budget
+        would overflow.  No budget set = no-op (one attribute read)."""
+        budget = _DEVICE_BUDGET
+        if budget is None:
+            return
+        projected = self._total + max(int(estimate_bytes), 0)
+        if projected <= budget:
+            return
+        self.spill_lru(projected - budget, exclude_ids=exclude_ids)
+
+
+device_ledger = _DeviceLedger()
+
+
+def device_resident_bytes() -> int:
+    """Total bytes currently resident on device across tracked columns."""
+    return device_ledger.total_bytes()
+
+
+def _on_device_budget(param: Any) -> None:
+    global _DEVICE_BUDGET
+    _DEVICE_BUDGET = param.get()
+
+
+from modin_tpu.config import DeviceMemoryBudget as _DeviceMemoryBudget  # noqa: E402
+
+_DeviceMemoryBudget.subscribe(_on_device_budget)

@@ -50,6 +50,46 @@ METRICS = (
         "(ShuffleSkewError -> non-shuffle fallback)",
     ),
     (
+        "recovery.device_lost",
+        "device-lost events entering the graftguard lineage-recovery "
+        "manager (engine-seam terminal DeviceLost or a breaker opening "
+        "on one)",
+    ),
+    (
+        "recovery.reseat.*",
+        "device columns re-seated from lineage, per provenance kind "
+        "(host / io / op)",
+    ),
+    (
+        "recovery.unrecoverable",
+        "live device columns whose lineage could not reproduce their "
+        "buffer during a recovery pass",
+    ),
+    (
+        "recovery.checkpoint_cut",
+        "op-replay lineage chains cut by an automatic host checkpoint at "
+        "MODIN_TPU_LINEAGE_MAX_DEPTH",
+    ),
+    (
+        "recovery.retry.*",
+        "engine-seam attempts retried after a recovery action: "
+        "device_lost (lineage re-seat), oom (evict-then-retry), or rebind "
+        "(deploy re-dispatched over rebuilt argument buffers)",
+    ),
+    (
+        "memory.device.spill",
+        "device columns spilled to host by admission control or the OOM "
+        "evict-then-retry leg",
+    ),
+    (
+        "memory.device.spill_bytes",
+        "device bytes freed by spills (exact host copies retained)",
+    ),
+    (
+        "memory.device.restore",
+        "spilled columns transparently re-seated on device on access",
+    ),
+    (
         "pandas-api.*",
         "wall-clock seconds per public pandas-API call (logging layer)",
     ),

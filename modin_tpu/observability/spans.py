@@ -82,6 +82,16 @@ SPANS = (
         "io.read",
         "one FileDispatcher read (format dispatcher class in attributes)",
     ),
+    (
+        "recovery.reseat",
+        "one graftguard lineage-recovery pass re-seating lost device "
+        "columns after a DeviceLost (reason in attributes)",
+    ),
+    (
+        "memory.device.spill",
+        "one admission-control / evict-then-retry spill pass dropping "
+        "cold device buffers to host (byte target in attributes)",
+    ),
 )
 
 _EPOCH_PERF = time.perf_counter()

@@ -227,7 +227,12 @@ def run_fused(
         fn = jax.jit(execute)
         _FUSED_CACHE[key] = fn
 
-    result = fn(tuple(leaves), tuple(scalars))
+    # dispatch through the engine seam: the fused call gets the resilience
+    # policy (classify/retry/recovery) and op-replay lineage provenance
+    # exactly like every other device computation
+    from modin_tpu.parallel.engine import JaxWrapper
+
+    result = JaxWrapper.deploy(fn, (tuple(leaves), tuple(scalars)))
     if tail_builder is not None:
         return result
     for root, value in zip(roots, result):

@@ -109,9 +109,14 @@ def _jit_trim(n_cols: int, p_out: int):
 
 
 def trim_columns(cols: List[Any], p_out: int) -> List[Any]:
+    from modin_tpu.parallel.engine import JaxWrapper
+
     if not cols or cols[0].shape[0] == p_out:
         return list(cols)
-    return list(_jit_trim(len(cols), int(p_out))(tuple(cols)))
+    # through the seam: resilience policy + op-replay lineage provenance
+    return list(
+        JaxWrapper.deploy(_jit_trim(len(cols), int(p_out)), (tuple(cols),))
+    )
 
 
 def gather_columns(cols: List[Any], positions: np.ndarray) -> Tuple[List[Any], int]:
@@ -125,12 +130,23 @@ def gather_columns(cols: List[Any], positions: np.ndarray) -> Tuple[List[Any], i
     n_out = len(positions)
     padded = pad_host(np.asarray(positions, dtype=np.int64), n_out)
     device_positions = JaxWrapper.put(padded)
-    return list(_jit_gather(len(cols))(tuple(cols), device_positions)), n_out
+    return (
+        list(
+            JaxWrapper.deploy(
+                _jit_gather(len(cols)), (tuple(cols), device_positions)
+            )
+        ),
+        n_out,
+    )
 
 
 def gather_columns_device(cols: List[Any], device_positions: Any) -> List[Any]:
     """Gather with an already-padded device positions array."""
-    return list(_jit_gather(len(cols))(tuple(cols), device_positions))
+    from modin_tpu.parallel.engine import JaxWrapper
+
+    return list(
+        JaxWrapper.deploy(_jit_gather(len(cols)), (tuple(cols), device_positions))
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -163,9 +179,11 @@ def _jit_concat(n_parts: int, n_cols: int, lengths: Tuple[int, ...], p_out: int)
 
 def concat_columns(parts: List[List[Any]], lengths: List[int]) -> Tuple[List[Any], int]:
     """Row-concat column sets (each padded), producing padded outputs."""
+    from modin_tpu.parallel.engine import JaxWrapper
+
     n_out = sum(lengths)
     p_out = pad_len(n_out)
     fn = _jit_concat(len(parts), len(parts[0]), tuple(lengths), p_out)
-    return list(fn(tuple(tuple(p) for p in parts))), n_out
+    return list(JaxWrapper.deploy(fn, (tuple(tuple(p) for p in parts),))), n_out
 
 

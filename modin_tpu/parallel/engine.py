@@ -28,8 +28,35 @@ import functools
 from typing import Any, Callable, Iterable, Optional
 
 from modin_tpu.config import BenchmarkMode, DeviceCount
+from modin_tpu.core import memory as _memory
+from modin_tpu.core.execution import recovery as _recovery
 from modin_tpu.core.execution.resilience import engine_call
 from modin_tpu.logging import ClassLogger
+
+
+def _estimate_deploy_bytes(f_args: tuple) -> tuple:
+    """(projected output bytes, {id(buffer)} of the op's own inputs).
+
+    The admission controller needs a pre-dispatch size estimate; without
+    tracing the program we take the conservative elementwise bound — the
+    output is at most the size of the device inputs combined (reductions
+    come in far under it, which only makes admission spill early, never
+    late).  The input ids let the spill pass skip buffers the dispatch
+    closure pins anyway.
+    """
+    import jax
+
+    total = 0
+    ids = set()
+    stack = list(f_args)
+    while stack:
+        item = stack.pop()
+        if isinstance(item, (tuple, list)):
+            stack.extend(item)
+        elif isinstance(item, jax.Array):
+            total += int(item.nbytes)
+            ids.add(id(item))
+    return total, ids
 
 
 def initialize_jax() -> None:
@@ -76,8 +103,44 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
     @classmethod
     def deploy(cls, func: Callable, f_args: tuple = (), f_kwargs: Optional[dict] = None, num_returns: int = 1) -> Any:
         """Run ``func`` (usually jit-compiled); returns device buffers (futures:
-        jax arrays are async until materialized)."""
-        result = engine_call("deploy", lambda: func(*f_args, **(f_kwargs or {})))
+        jax arrays are async until materialized).
+
+        graftguard wraps the dispatch three ways: pre-flight **admission**
+        (when ``MODIN_TPU_DEVICE_MEMORY_BUDGET`` is set, cold columns are
+        spilled to host *before* a dispatch projected to overflow the
+        budget), post-hoc **provenance** (the (func, args) of every
+        successful deploy is recorded weakly so op-replay lineage can
+        rebuild the outputs after a device loss), and a **rebind retry**:
+        when the seam's own post-re-seat retry still fails with DeviceLost
+        — on real hardware the retried thunk closes over the dead input
+        buffers — the argument tree is rebuilt against the re-seated
+        columns and dispatched once more over live buffers.
+        """
+        from modin_tpu.core.execution.resilience import DeviceLost
+        from modin_tpu.logging.metrics import emit_metric
+
+        input_ids = None
+        if _memory._DEVICE_BUDGET is not None or _recovery.RECOVERY_ON:
+            estimate, input_ids = _estimate_deploy_bytes(f_args)
+            if _memory._DEVICE_BUDGET is not None:
+                _memory.device_ledger.admit(estimate, exclude_ids=input_ids)
+        try:
+            result = engine_call(
+                "deploy",
+                lambda: func(*f_args, **(f_kwargs or {})),
+                protect_ids=input_ids,
+            )
+        except DeviceLost:
+            fresh_args = _recovery.recover_args(f_args)
+            if fresh_args is None:
+                raise
+            emit_metric("recovery.retry.rebind", 1)
+            result = engine_call(
+                "deploy", lambda: func(*fresh_args, **(f_kwargs or {}))
+            )
+            f_args = fresh_args  # provenance must describe the live inputs
+        if _recovery.RECOVERY_ON:
+            _recovery.record_deploy(func, f_args, f_kwargs, result)
         if BenchmarkMode.get():
             cls.wait(result)
         return result
@@ -91,7 +154,10 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
             from modin_tpu.parallel.mesh import row_sharding
 
             sharding = row_sharding()
-        return engine_call("put", lambda: jax.device_put(data, sharding))
+        result = engine_call("put", lambda: jax.device_put(data, sharding))
+        if _recovery.RECOVERY_ON:
+            _recovery.record_put(data, result)
+        return result
 
     @classmethod
     def materialize(cls, obj_refs: Any) -> Any:

@@ -2,6 +2,10 @@
 
 from modin_tpu.testing.faults import (  # noqa: F401
     FaultInjector,
+    OomBurstInjector,
+    SequencedFaultInjector,
     inject_faults,
     make_device_error,
+    midquery_device_loss,
+    oom_burst_until_eviction,
 )

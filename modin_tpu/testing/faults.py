@@ -150,3 +150,130 @@ def inject_faults(
 ) -> FaultInjector:
     """Sugar for ``FaultInjector(...)`` — see its docstring."""
     return FaultInjector(kind=kind, ops=ops, times=times, skip=skip, slow_s=slow_s)
+
+
+# ---------------------------------------------------------------------- #
+# sequenced injectors (the graftguard chaos suite)
+# ---------------------------------------------------------------------- #
+
+
+class SequencedFaultInjector(FaultInjector):
+    """Scripted multi-phase fault schedule at the engine seam.
+
+    ``steps`` is an ordered list of ``(kind, count)`` pairs; ``kind`` is
+    ``'clean'`` (let the attempt through) or any FaultInjector kind, and
+    each step consumes ``count`` matching attempts before the schedule
+    advances.  After the last step everything runs clean — exactly the
+    shape of a real incident: healthy, then a failure window, then healed.
+
+        # DeviceLost mid-query: 4 good deploys, then the device vanishes
+        # for 2 dispatches, then the replacement device answers
+        with SequencedFaultInjector(
+            [("clean", 4), ("device_lost", 2)], ops=("deploy",)
+        ) as inj:
+            ...
+
+    ``injected`` counts faults fired, ``calls`` matching attempts seen.
+    """
+
+    def __init__(
+        self,
+        steps: Iterable[tuple],
+        ops: Iterable[str] = _ENGINE_OPS,
+        slow_s: float = 0.05,
+    ):
+        super().__init__(kind="transient", ops=ops, times=0, slow_s=slow_s)
+        self.steps = [(str(kind), int(count)) for kind, count in steps]
+        for kind, count in self.steps:
+            if kind != "clean" and kind != "slow_kernel" and kind not in _FAULT_MESSAGES:
+                raise ValueError(f"unknown fault kind {kind!r} in steps")
+            if count < 0:
+                raise ValueError(f"negative step count {count} for {kind!r}")
+        self._step = 0
+        self._step_used = 0
+
+    def _hook(self, op: str) -> None:
+        if op not in self.ops:
+            return
+        with self._lock:
+            self.calls += 1
+            while (
+                self._step < len(self.steps)
+                and self._step_used >= self.steps[self._step][1]
+            ):
+                self._step += 1
+                self._step_used = 0
+            if self._step >= len(self.steps):
+                return  # schedule exhausted: healed
+            kind = self.steps[self._step][0]
+            self._step_used += 1
+            if kind == "clean":
+                return
+            self.injected += 1
+        if kind == "slow_kernel":
+            time.sleep(self.slow_s)
+            return
+        raise make_device_error(kind)
+
+
+def midquery_device_loss(
+    after_deploys: int, times: int = 1, ops: Iterable[str] = ("deploy",)
+) -> SequencedFaultInjector:
+    """DeviceLost mid-query: after ``after_deploys`` successful dispatches
+    the next ``times`` attempts raise UNAVAILABLE, then the (replacement)
+    device answers — the recovery manager's acceptance scenario."""
+    return SequencedFaultInjector(
+        [("clean", after_deploys), ("device_lost", times)], ops=ops
+    )
+
+
+class OomBurstInjector(FaultInjector):
+    """RESOURCE_EXHAUSTED burst that clears once eviction frees memory.
+
+    Matching attempts raise OOM while the device-memory ledger has
+    recorded fewer than ``spills`` new spill events since ``__enter__`` —
+    the moment evict-then-retry (or admission control) actually spills,
+    the modeled memory pressure is gone and every later attempt runs
+    clean.  ``max_faults`` bounds the burst as a test-hang backstop.
+    """
+
+    def __init__(
+        self,
+        ops: Iterable[str] = ("deploy",),
+        spills: int = 1,
+        max_faults: Optional[int] = 25,
+    ):
+        super().__init__(kind="oom", ops=ops, times=max_faults)
+        if spills <= 0:
+            raise ValueError(f"spills must be > 0, got {spills}")
+        self.spills = spills
+        self._baseline = 0
+
+    def __enter__(self) -> "OomBurstInjector":
+        from modin_tpu.core.memory import device_ledger
+
+        self._baseline = device_ledger.spill_count()
+        return super().__enter__()
+
+    def _hook(self, op: str) -> None:
+        if op not in self.ops:
+            return
+        from modin_tpu.core.memory import device_ledger
+
+        with self._lock:
+            self.calls += 1
+            if device_ledger.spill_count() - self._baseline >= self.spills:
+                return  # eviction freed the memory: pressure cleared
+            if self.times is not None and self.injected >= self.times:
+                return
+            self.injected += 1
+        raise make_device_error("oom")
+
+
+def oom_burst_until_eviction(
+    ops: Iterable[str] = ("deploy",),
+    spills: int = 1,
+    max_faults: Optional[int] = 25,
+) -> OomBurstInjector:
+    """Sugar for ``OomBurstInjector(...)`` — see its docstring."""
+    return OomBurstInjector(ops=ops, spills=spills, max_faults=max_faults)

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all six gates, fail on any red
+#   ./scripts/check_all.sh            # all seven gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -13,6 +13,10 @@
 #   0b. graftscope smoke: a tiny traced workload must export a
 #       chrome://tracing-loadable JSON with spans from all four layers
 #       (API, query compiler, engine seam, shuffle) and a rollup
+#   0c. graftguard chaos smoke: a traced groupby+merge under an injected
+#       mid-query DeviceLost must complete bit-exact with recovery.*
+#       metrics > 0, and a RESOURCE_EXHAUSTED burst must be absorbed by
+#       evict-then-retry without any pandas fallback
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -38,6 +42,7 @@ run_gate() {
 
 run_gate "graftlint"       python -m modin_tpu.lint modin_tpu/
 run_gate "graftscope"      python scripts/trace_smoke.py
+run_gate "graftguard"      python scripts/chaos_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -47,4 +52,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL SIX GATES GREEN"
+echo "ALL SEVEN GATES GREEN"

@@ -1,0 +1,239 @@
+"""Direct unit coverage for the memory ledgers (core/memory.py).
+
+The host-cache ledger was previously exercised only indirectly through
+frame workloads; these tests pin its contracts down in isolation —
+weakref-callback reentrancy, LRU eviction order under a budget shrink, and
+the ``Float64Policy=Downcast`` no-evict guard — plus the graftguard
+device ledger's registration, LRU spill, and admission arithmetic.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+from modin_tpu.config import Float64Policy
+from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+from modin_tpu.core.memory import (
+    _DeviceLedger,
+    _HostCacheLedger,
+    _evictable,
+)
+
+
+class _StubRaw:
+    """Stands in for a device buffer: just a dtype and a byte size."""
+
+    def __init__(self, dtype, nbytes=0):
+        self.dtype = np.dtype(dtype)
+        self.nbytes = nbytes
+
+
+class _StubCol:
+    """Minimal column satisfying both ledgers' protocols."""
+
+    def __init__(self, nbytes, device_dtype="int64", pandas_dtype="int64"):
+        self.host_cache = np.zeros(nbytes, dtype=np.uint8)
+        self._data = _StubRaw(device_dtype, nbytes)
+        self.pandas_dtype = np.dtype(pandas_dtype)
+        self.is_lazy = False
+        self._ledger_key = None
+        self._dev_key = None
+        self.spilled_calls = 0
+
+    @property
+    def raw(self):
+        return self._data
+
+    @property
+    def is_spilled(self):
+        return self._data is None
+
+    def spill(self):
+        if self._data is None:
+            return 0
+        freed = self._data.nbytes
+        self._data = None
+        self.spilled_calls += 1
+        return freed
+
+
+def _ledger_with_budget(monkeypatch, budget):
+    ledger = _HostCacheLedger()
+    monkeypatch.setattr(type(ledger), "budget", lambda self: budget)
+    return ledger
+
+
+# ====================================================================== #
+# _HostCacheLedger
+# ====================================================================== #
+
+
+class TestHostCacheLedger:
+    def test_register_and_total(self, monkeypatch):
+        ledger = _ledger_with_budget(monkeypatch, None)
+        cols = [_StubCol(100), _StubCol(50)]
+        for c in cols:
+            ledger.register(c)
+        assert ledger.total_bytes() == 150
+
+    def test_weakref_callback_reentrancy(self, monkeypatch):
+        """A GC-fired callback runs ``_forget`` on the SAME thread that may
+        already hold the ledger lock — the RLock must let it through, and
+        the accounting must come out right."""
+        ledger = _ledger_with_budget(monkeypatch, None)
+        keep = _StubCol(100)
+        doomed = _StubCol(70)
+        ledger.register(keep)
+        ledger.register(doomed)
+        with ledger._lock:  # simulate "inside a ledger operation"
+            del doomed
+            gc.collect()  # fires the weakref callback -> _forget -> RLock
+        assert ledger.total_bytes() == 100
+
+    def test_eviction_order_under_budget_shrink(self, monkeypatch):
+        """Insertion order is the LRU order; ``touch`` refreshes it, and a
+        shrunk budget evicts the coldest evictable caches first."""
+        budget = {"value": 1000}
+        ledger = _HostCacheLedger()
+        monkeypatch.setattr(
+            type(ledger), "budget", lambda self: budget["value"]
+        )
+        a, b, c = _StubCol(100), _StubCol(100), _StubCol(100)
+        for col in (a, b, c):
+            ledger.register(col)
+        ledger.touch(a)  # a is now the HOTTEST despite being oldest
+        budget["value"] = 250  # shrink: ~one cache must go
+        ledger.enforce()
+        assert b.host_cache is None  # coldest evicted first
+        assert a.host_cache is not None
+        assert c.host_cache is not None
+        budget["value"] = 150  # shrink again
+        ledger.enforce()
+        assert c.host_cache is None
+        assert a.host_cache is not None  # the touched one survives longest
+        assert ledger.total_bytes() == 100
+
+    def test_downcast_no_evict_guard(self):
+        """A logical float64 stored f32 on device (Float64Policy=Downcast)
+        must never lose its host cache: the cache IS the exact copy."""
+        with Float64Policy.context("Downcast"):
+            col = DeviceColumn.from_numpy(
+                np.random.default_rng(0).normal(size=64)
+            )
+            assert str(col.raw.dtype) == "float32"
+            assert col.pandas_dtype == np.float64
+            assert _evictable(col) is False
+        # exact round-trip columns ARE evictable
+        int_col = DeviceColumn.from_numpy(np.arange(64, dtype=np.int64))
+        assert _evictable(int_col) is True
+
+    def test_spilled_column_cache_is_never_evicted(self, monkeypatch):
+        """After a graftguard spill the host copy is the ONLY copy —
+        dropping it would lose data, budget pressure or not."""
+        ledger = _ledger_with_budget(monkeypatch, 10)
+        col = _StubCol(100)
+        col._data = None  # spilled
+        ledger.register(col)
+        ledger.enforce()
+        assert col.host_cache is not None
+
+    def test_lazy_column_not_evicted(self, monkeypatch):
+        ledger = _ledger_with_budget(monkeypatch, 10)
+        col = _StubCol(100)
+        col.is_lazy = True
+        ledger.register(col)
+        ledger.enforce()
+        assert col.host_cache is not None
+
+    def test_no_budget_never_evicts(self, monkeypatch):
+        ledger = _ledger_with_budget(monkeypatch, None)
+        cols = [_StubCol(10**6) for _ in range(3)]
+        for c in cols:
+            ledger.register(c)
+        ledger.enforce()
+        assert all(c.host_cache is not None for c in cols)
+
+
+# ====================================================================== #
+# _DeviceLedger (graftguard)
+# ====================================================================== #
+
+
+class TestDeviceLedger:
+    def test_register_deregister_accounting(self):
+        ledger = _DeviceLedger()
+        col = _StubCol(4096)
+        ledger.register(col)
+        assert ledger.total_bytes() == 4096
+        assert ledger.deregister(col) == 4096
+        assert ledger.total_bytes() == 0
+        assert ledger.deregister(col) == 0  # idempotent
+
+    def test_reregistration_replaces_entry(self):
+        ledger = _DeviceLedger()
+        col = _StubCol(100)
+        ledger.register(col)
+        col._data = _StubRaw("int64", 300)  # buffer replaced (restore/reseat)
+        ledger.register(col)
+        assert ledger.total_bytes() == 300  # not 400
+
+    def test_entry_dies_with_column(self):
+        ledger = _DeviceLedger()
+        col = _StubCol(512)
+        ledger.register(col)
+        del col
+        gc.collect()
+        assert ledger.total_bytes() == 0
+
+    def test_spill_lru_cold_first_and_counts(self):
+        ledger = _DeviceLedger()
+        a, b, c = _StubCol(100), _StubCol(100), _StubCol(100)
+        for col in (a, b, c):
+            ledger.register(col)
+        ledger.touch(a)
+        freed = ledger.spill_lru(150)  # needs two spills, coldest first
+        assert freed == 200
+        assert b.spilled_calls == 1 and c.spilled_calls == 1
+        assert a.spilled_calls == 0
+        assert ledger.spill_count() == 2
+
+    def test_spill_lru_excludes_op_inputs(self):
+        ledger = _DeviceLedger()
+        cold = _StubCol(100)
+        pinned = _StubCol(100)
+        ledger.register(cold)
+        ledger.register(pinned)
+        freed = ledger.spill_lru(10**9, exclude_ids={id(pinned.raw)})
+        assert cold.spilled_calls == 1
+        assert pinned.spilled_calls == 0
+        assert freed == 100
+
+    def test_admission_spills_only_on_projected_overflow(self, monkeypatch):
+        import modin_tpu.core.memory as memory_mod
+
+        ledger = _DeviceLedger()
+        col = _StubCol(1000)
+        ledger.register(col)
+        monkeypatch.setattr(memory_mod, "_DEVICE_BUDGET", 2000)
+        ledger.admit(500)  # 1000 + 500 fits
+        assert col.spilled_calls == 0
+        ledger.admit(1500)  # 1000 + 1500 overflows by 500
+        assert col.spilled_calls == 1
+
+    def test_admission_noop_without_budget(self, monkeypatch):
+        import modin_tpu.core.memory as memory_mod
+
+        ledger = _DeviceLedger()
+        col = _StubCol(1000)
+        ledger.register(col)
+        monkeypatch.setattr(memory_mod, "_DEVICE_BUDGET", None)
+        ledger.admit(10**12)
+        assert col.spilled_calls == 0
+
+    def test_live_columns_snapshot(self):
+        ledger = _DeviceLedger()
+        cols = [_StubCol(10) for _ in range(3)]
+        for c in cols:
+            ledger.register(c)
+        assert set(map(id, ledger.live_columns())) == set(map(id, cols))

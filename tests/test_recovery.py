@@ -1,0 +1,510 @@
+"""graftguard chaos suite: lineage recovery + device-memory admission.
+
+Acceptance bar (ISSUE 4): an injected mid-query ``DeviceLost`` recovers via
+lineage (bit-exact vs the fault-free run), an injected RESOURCE_EXHAUSTED
+burst is absorbed by evict-then-retry without falling back to pandas, and
+the admission controller spills cold columns *before* an over-budget
+dispatch.  Unit layers below the chaos scenarios: lineage attachment kinds,
+spill/restore round-trips, depth cut-points, and the sequenced injectors.
+"""
+
+import gc
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import (
+    DeviceMemoryBudget,
+    LineageMaxDepth,
+    RecoveryMode,
+    ResilienceBackoffS,
+    ResilienceBreakerThreshold,
+    ResilienceMode,
+    ResilienceRetries,
+    SpillRetries,
+    SpillTargetFraction,
+)
+from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+from modin_tpu.core.execution import recovery, resilience
+from modin_tpu.core.execution.resilience import (
+    DeviceOOM,
+    engine_call,
+    reset_breakers,
+)
+from modin_tpu.core.memory import device_ledger, device_resident_bytes
+from modin_tpu.logging import add_metric_handler, clear_metric_handler
+from modin_tpu.testing import (
+    OomBurstInjector,
+    SequencedFaultInjector,
+    inject_faults,
+    make_device_error,
+    midquery_device_loss,
+    oom_burst_until_eviction,
+)
+
+from tests.utils import df_equals
+
+_SAVED_PARAMS = (
+    RecoveryMode,
+    ResilienceMode,
+    ResilienceRetries,
+    ResilienceBackoffS,
+    ResilienceBreakerThreshold,
+    LineageMaxDepth,
+    SpillRetries,
+    SpillTargetFraction,
+)
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu_backend():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("graftguard chaos tests require the TpuOnJax execution")
+
+
+@pytest.fixture(autouse=True)
+def _clean_recovery_state():
+    """Recovery on, fresh breakers/epoch, zero backoff, knobs restored."""
+    saved = [(p, p.get()) for p in _SAVED_PARAMS]
+    reset_breakers()
+    recovery.reset_for_tests()
+    ResilienceBackoffS.put(0.0)
+    RecoveryMode.put("Enable")
+    yield
+    for p, v in saved:
+        p.put(v)
+    reset_breakers()
+    recovery.reset_for_tests()
+
+
+@pytest.fixture
+def metrics():
+    seen = []
+
+    def handler(name, value):
+        seen.append((name, value))
+
+    add_metric_handler(handler)
+    yield seen
+    clear_metric_handler(handler)
+
+
+def _names(metrics):
+    return [n for n, _ in metrics]
+
+
+_N = 512
+
+
+def _frames(seed=0):
+    rng = np.random.default_rng(seed)
+    data = {
+        "a": rng.normal(size=_N),
+        "b": rng.integers(0, 1000, _N).astype(np.int64),
+        "key": rng.integers(0, 7, _N).astype(np.int64),
+    }
+    pdf = pandas.DataFrame(data)
+    mdf = pd.DataFrame(data)
+    mdf._query_compiler.execute()  # ingest outside any fault window
+    return mdf, pdf
+
+
+def _col(values):
+    return DeviceColumn.from_numpy(np.asarray(values))
+
+
+# ====================================================================== #
+# lineage records
+# ====================================================================== #
+
+
+class TestLineageAttachment:
+    def test_host_materialization_kind(self):
+        col = _col(np.arange(32, dtype=np.int64))
+        assert col.lineage is not None
+        assert col.lineage.kind == recovery.KIND_HOST
+
+    def test_op_replay_kind_for_deployed_output(self):
+        import jax
+
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        base = _col(np.arange(64, dtype=np.int64))
+        out = JaxWrapper.deploy(jax.jit(lambda x: x * 2), (base.raw,))
+        col = DeviceColumn(out, np.dtype(np.int64), length=64)
+        assert col.lineage.kind == recovery.KIND_OP
+        assert col.lineage.depth == 1
+
+    def test_lazy_column_gets_lineage_on_materialization(self):
+        mdf, _ = _frames(seed=3)
+        result = mdf["a"] + mdf["b"]
+        qc = result._query_compiler
+        frame = qc._modin_frame
+        frame.materialize_device()
+        cols = [c for c in frame._columns if c.is_device]
+        assert cols and all(c.lineage is not None for c in cols)
+
+    def test_depth_cut_point_host_checkpoints(self, metrics):
+        import jax
+
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        LineageMaxDepth.put(2)
+        fn = jax.jit(lambda x: x + 1)
+        arr = _col(np.arange(64, dtype=np.int64)).raw
+        kinds = []
+        for _ in range(4):
+            arr = JaxWrapper.deploy(fn, (arr,))
+            col = DeviceColumn(arr, np.dtype(np.int64), length=64)
+            kinds.append(col.lineage.kind)
+            arr = col.raw
+        # chain depths 1,2 stay op-replay; 3 would exceed the bound and is
+        # host-checkpointed — which restarts the chain, so the NEXT link
+        # is op-replay at depth 1 again
+        assert kinds == [
+            recovery.KIND_OP,
+            recovery.KIND_OP,
+            recovery.KIND_HOST,
+            recovery.KIND_OP,
+        ]
+        assert "modin_tpu.recovery.checkpoint_cut" in _names(metrics)
+
+    def test_io_source_lineage_from_read(self, tmp_path):
+        path = tmp_path / "lineage.csv"
+        src = pandas.DataFrame(
+            {"x": np.arange(100, dtype=np.int64), "y": np.linspace(0, 1, 100)}
+        )
+        src.to_csv(path, index=False)
+        mdf = pd.read_csv(path)
+        frame = mdf._query_compiler._modin_frame
+        device_cols = [c for c in frame._columns if c.is_device]
+        assert device_cols
+        assert all(c.lineage.kind == recovery.KIND_IO for c in device_cols)
+        # the io record can rebuild the exact values even with the host
+        # cache gone (evicted under the Memory budget)
+        col = device_cols[0]
+        expected = col.to_numpy().copy()
+        col.host_cache = None
+        kind = recovery.recover_column(col, force=True)
+        assert kind == recovery.KIND_IO
+        assert np.array_equal(col.to_numpy(), expected)
+
+
+# ====================================================================== #
+# re-seat from lineage
+# ====================================================================== #
+
+
+class TestReseat:
+    def test_reseat_all_is_bit_exact(self, metrics):
+        values = np.random.default_rng(5).normal(size=256)
+        col = _col(values)
+        old = col._data
+        assert recovery.reseat_all("unit") >= 1
+        assert col._data is not old  # a genuinely fresh buffer
+        assert np.array_equal(col.to_numpy(), values)
+        assert "modin_tpu.recovery.reseat.host" in _names(metrics)
+
+    def test_op_replay_reseat_without_host_cache(self):
+        import jax
+
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        base = _col(np.arange(128, dtype=np.int64))
+        out = JaxWrapper.deploy(jax.jit(lambda x: x * 3 + 1), (base.raw,))
+        col = DeviceColumn(out, np.dtype(np.int64), length=128)
+        assert col.host_cache is None
+        old = col._data
+        kind = recovery.recover_column(col, force=True)
+        assert kind == recovery.KIND_OP
+        assert col._data is not old
+        assert np.array_equal(
+            col.to_numpy()[:128], np.arange(128, dtype=np.int64) * 3 + 1
+        )
+
+    def test_unrecoverable_without_lineage(self, metrics):
+        import jax.numpy as jnp
+
+        from modin_tpu.ops.structural import pad_host
+
+        RecoveryMode.put("Disable")  # adopt a buffer with no provenance
+        arr = jnp.asarray(pad_host(np.arange(32, dtype=np.int64)))
+        col = DeviceColumn(arr, np.dtype(np.int64), length=32)
+        RecoveryMode.put("Enable")
+        with pytest.raises(recovery.Unrecoverable):
+            recovery.recover_column(col, force=True)
+
+    def test_recovery_disabled_is_noop(self):
+        RecoveryMode.put("Disable")
+        _col(np.arange(8))
+        assert recovery.reseat_all("unit") == 0
+
+
+# ====================================================================== #
+# chaos: mid-query DeviceLost
+# ====================================================================== #
+
+
+class TestMidQueryDeviceLost:
+    def test_groupby_merge_recovers_bit_exact(self, metrics):
+        mdf, pdf = _frames(seed=11)
+        expected = pdf.groupby("key").sum().merge(
+            pdf.groupby("key").mean(), on="key", suffixes=("_s", "_m")
+        )
+        with midquery_device_loss(
+            after_deploys=2, times=1, ops=("deploy", "materialize")
+        ) as inj:
+            got = mdf.groupby("key").sum().merge(
+                mdf.groupby("key").mean(), on="key", suffixes=("_s", "_m")
+            )
+            df_equals(got, expected)
+        assert inj.injected == 1, "the loss never fired mid-query"
+        names = _names(metrics)
+        assert "modin_tpu.recovery.device_lost" in names
+        assert any(n.startswith("modin_tpu.recovery.reseat.") for n in names)
+
+    def test_retry_after_reseat_absorbs_the_loss(self, metrics):
+        """When the engine retry after a re-seat succeeds, the device path
+        answers — no pandas fallback at all."""
+        ResilienceBreakerThreshold.put(50)
+        mdf, pdf = _frames(seed=13)
+        # an elementwise chain materializes through ONE fused deploy: the
+        # loss lands exactly on it, the re-seat + retry answer on device
+        with midquery_device_loss(after_deploys=0, times=1) as inj:
+            df_equals(mdf["a"] * 2 + mdf["b"], pdf["a"] * 2 + pdf["b"])
+        assert inj.injected == 1
+        names = _names(metrics)
+        assert "modin_tpu.recovery.retry.device_lost" in names
+        assert not any(".fallback." in n for n in names)
+
+    def test_sequenced_losses_across_phases(self, metrics):
+        """Two separate loss windows in one query sequence: each recovers."""
+        mdf, pdf = _frames(seed=17)
+        with SequencedFaultInjector(
+            [("clean", 1), ("device_lost", 1), ("clean", 2), ("device_lost", 1)],
+            ops=("deploy", "materialize"),
+        ) as inj:
+            df_equals(mdf.sum(numeric_only=True), pdf.sum(numeric_only=True))
+            df_equals(
+                mdf.groupby("key").sum(), pdf.groupby("key").sum()
+            )
+        assert inj.injected >= 1
+        assert "modin_tpu.recovery.device_lost" in _names(metrics)
+
+
+# ====================================================================== #
+# chaos: RESOURCE_EXHAUSTED absorbed by evict-then-retry
+# ====================================================================== #
+
+
+class TestOomEvictThenRetry:
+    def test_engine_call_evicts_and_retries(self, metrics):
+        # something spillable must be resident (kept referenced so the
+        # evictor has at least this column to free)
+        col = _col(np.random.default_rng(0).normal(size=4096))
+        spills_before = device_ledger.spill_count()
+        with oom_burst_until_eviction(ops=("deploy",)) as inj:
+            result = engine_call("deploy", lambda: "computed")
+        assert result == "computed"
+        assert inj.injected >= 1
+        assert device_ledger.spill_count() > spills_before
+        names = _names(metrics)
+        assert "modin_tpu.recovery.retry.oom" in names
+        assert "modin_tpu.memory.device.spill" in names
+        assert np.array_equal(col.to_numpy(), col.to_numpy())  # still readable
+
+    def test_query_absorbs_burst_without_fallback(self, metrics):
+        ResilienceBreakerThreshold.put(50)
+        mdf, pdf = _frames(seed=23)
+        # cold ballast the evictor can spill (the query's own inputs would
+        # not free anything mid-dispatch)
+        ballast_values = np.random.default_rng(1).normal(size=8192)
+        ballast = _col(ballast_values)
+        with oom_burst_until_eviction(
+            ops=("deploy", "materialize")
+        ) as inj:
+            df_equals(
+                (mdf["a"] * 2 + mdf["b"]).sum(), (pdf["a"] * 2 + pdf["b"]).sum()
+            )
+        assert inj.injected >= 1
+        names = _names(metrics)
+        assert "modin_tpu.recovery.retry.oom" in names
+        assert not any(".fallback." in n for n in names)
+        assert np.array_equal(ballast.to_numpy(), ballast_values)  # exact
+
+    def test_spill_retries_zero_keeps_oom_terminal(self, metrics):
+        SpillRetries.put(0)
+        _col(np.arange(1024, dtype=np.float64))
+
+        def oom():
+            raise make_device_error("oom")
+
+        with pytest.raises(DeviceOOM):
+            engine_call("deploy", oom)
+        assert "modin_tpu.recovery.retry.oom" not in _names(metrics)
+
+
+# ====================================================================== #
+# admission control & the device ledger
+# ====================================================================== #
+
+
+class TestAdmissionControl:
+    def test_deploy_spills_cold_columns_before_dispatch(self, metrics):
+        import jax
+
+        from modin_tpu.parallel.engine import JaxWrapper
+
+        cold = _col(np.arange(20_000, dtype=np.int64))  # 160 KB, coldest
+        hot = _col(np.arange(20_000, dtype=np.int64))
+        with DeviceMemoryBudget.context(device_resident_bytes() + 8_000):
+            # projected output (~160 KB) overflows: admission must spill
+            # the cold column but never the op's own input
+            out = JaxWrapper.deploy(jax.jit(lambda x: x + 1), (hot.raw,))
+            assert cold.is_spilled
+            assert not hot.is_spilled
+            assert "modin_tpu.memory.device.spill" in _names(metrics)
+            assert np.array_equal(
+                np.asarray(out)[:20_000], np.arange(20_000) + 1
+            )
+        # a host read is served straight from the exact host copy ...
+        assert np.array_equal(cold.to_numpy(), np.arange(20_000))
+        assert "modin_tpu.memory.device.restore" not in _names(metrics)
+        # ... and the next DEVICE access transparently re-seats the buffer
+        assert cold.raw is not None
+        assert not cold.is_spilled
+        assert "modin_tpu.memory.device.restore" in _names(metrics)
+
+    def test_ledger_tracks_registration_and_death(self):
+        before = device_resident_bytes()
+        col = _col(np.arange(4096, dtype=np.int64))
+        assert device_resident_bytes() > before
+        del col
+        gc.collect()
+        assert device_resident_bytes() <= before + 1  # entry died with it
+
+    def test_spill_restore_roundtrip_float64_downcast(self):
+        from modin_tpu.config import Float64Policy
+
+        with Float64Policy.context("Downcast"):
+            values = np.random.default_rng(2).normal(size=512)
+            col = _col(values)
+            assert str(col.raw.dtype) == "float32"
+            col.host_cache = None  # drop the ingest cache: spill must fetch
+            assert col.spill() > 0
+            # the fetched host copy widened losslessly; restore downcasts
+            # back to the identical f32 buffer
+            assert np.array_equal(
+                col.to_numpy(), values.astype(np.float32).astype(np.float64)
+            )
+            assert str(col.raw.dtype) == "float32"
+
+
+# ====================================================================== #
+# review regressions: spill safety, input protection, arg rebind, io purge
+# ====================================================================== #
+
+
+class TestRecoveryEdges:
+    def test_spill_under_tight_host_budget_keeps_sole_copy(self, monkeypatch):
+        """Registering the fetched host copy must not let the host ledger
+        evict it before the device buffer is dropped (the copy is the SOLE
+        copy the moment spill completes)."""
+        from modin_tpu.core.memory import _HostCacheLedger
+
+        monkeypatch.setattr(_HostCacheLedger, "budget", lambda self: 1)
+        values = np.arange(1024, dtype=np.int64)
+        col = _col(values)
+        col.host_cache = None  # spill must fetch, register, and survive
+        assert col.spill() > 0
+        assert col.host_cache is not None
+        assert np.array_equal(col.to_numpy(), values)
+
+    def test_evict_for_oom_protects_op_inputs(self):
+        cold = _col(np.arange(4096, dtype=np.int64))
+        hot = _col(np.arange(4096, dtype=np.int64))
+        SpillTargetFraction.put(1.0)
+        freed = recovery.evict_for_oom("deploy", exclude_ids={id(hot._data)})
+        assert freed > 0
+        assert cold.is_spilled
+        assert not hot.is_spilled
+
+    def test_recover_args_rebinds_to_reseated_buffers(self):
+        """After a re-seat the old arrays are stale; recover_args must hand
+        back the columns' fresh buffers for a re-dispatch."""
+        values = np.arange(256, dtype=np.int64)
+        col = _col(values)
+        old = col._data
+        assert recovery.reseat_all("unit") >= 1
+        fresh_args = recovery.recover_args(((old,), 2.0))
+        assert fresh_args is not None
+        (leaf,), scalar = fresh_args
+        assert scalar == 2.0
+        assert leaf is col._data and leaf is not old
+
+    def test_io_replay_cache_purged_after_pass(self, tmp_path):
+        path = tmp_path / "purge.csv"
+        pandas.DataFrame({"x": np.arange(64, dtype=np.int64)}).to_csv(
+            path, index=False
+        )
+        mdf = pd.read_csv(path)
+        frame = mdf._query_compiler._modin_frame
+        col = next(c for c in frame._columns if c.is_device)
+        col.host_cache = None
+        assert recovery.recover_column(col, force=True) == recovery.KIND_IO
+        replayer = col.lineage.replay.func.__self__
+        recovery._purge_io_caches()
+        assert replayer._cache is None
+        assert np.array_equal(col.to_numpy(), np.arange(64))
+
+
+# ====================================================================== #
+# sequenced injectors
+# ====================================================================== #
+
+
+class TestSequencedInjectors:
+    def test_schedule_orders_and_exhausts(self):
+        inj = SequencedFaultInjector(
+            [("clean", 2), ("transient", 1), ("clean", 1)], ops=("deploy",)
+        )
+        fired = []
+        with inj:
+            for i in range(6):
+                try:
+                    resilience._fault_hook("deploy")
+                    fired.append("clean")
+                except Exception:
+                    fired.append("fault")
+        assert fired == ["clean", "clean", "fault", "clean", "clean", "clean"]
+        assert inj.injected == 1 and inj.calls == 6
+
+    def test_non_matching_ops_pass_through(self):
+        with midquery_device_loss(after_deploys=0, times=1) as inj:
+            resilience._fault_hook("materialize")  # not a deploy: clean
+        assert inj.injected == 0
+
+    def test_rejects_unknown_kinds(self):
+        with pytest.raises(ValueError):
+            SequencedFaultInjector([("nonsense", 1)])
+
+    def test_oom_burst_clears_after_spill(self):
+        col = _col(np.arange(2048, dtype=np.int64))
+        with OomBurstInjector(ops=("deploy",), spills=1) as inj:
+            with pytest.raises(Exception):
+                resilience._fault_hook("deploy")
+            # the eviction the burst waits for (everything spillable)
+            assert device_ledger.spill_lru(10**12) > 0
+            assert col.is_spilled
+            resilience._fault_hook("deploy")  # pressure cleared: clean
+        assert inj.injected == 1
+
+    def test_exclusive_with_plain_injector(self):
+        with inject_faults("oom"):
+            with pytest.raises(RuntimeError):
+                with midquery_device_loss(after_deploys=1):
+                    pass

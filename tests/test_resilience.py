@@ -16,6 +16,7 @@ import pytest
 import modin_tpu.pandas as pd
 from modin_tpu.config import (
     RangePartitioning,
+    RecoveryMode,
     ResilienceBackoffS,
     ResilienceBreakerCooldownS,
     ResilienceBreakerThreshold,
@@ -50,15 +51,24 @@ _RESILIENCE_PARAMS = (
     ResilienceBreakerThreshold,
     ResilienceBreakerCooldownS,
     ResilienceLatencyBudgetS,
+    RecoveryMode,
 )
 
 
 @pytest.fixture(autouse=True)
 def _clean_resilience_state():
-    """Fresh breakers, zero backoff sleeps, restored knobs around each test."""
+    """Fresh breakers, zero backoff sleeps, restored knobs around each test.
+
+    RecoveryMode is pinned Disable: this suite asserts the PR-1 retry /
+    breaker / fallback semantics in isolation — lineage re-seat and
+    evict-then-retry would otherwise absorb the injected faults
+    nondeterministically (whatever columns older tests left alive would be
+    re-seated first).  The recovery legs are covered by tests/test_recovery.py.
+    """
     saved = [(p, p.get()) for p in _RESILIENCE_PARAMS]
     reset_breakers()
     ResilienceBackoffS.put(0.0)
+    RecoveryMode.put("Disable")
     yield
     for p, v in saved:
         p.put(v)
