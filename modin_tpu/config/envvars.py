@@ -642,6 +642,77 @@ class SpillTargetFraction(EnvironmentVariable, type=float):
         super().put(value)
 
 
+class KernelRouterMode(EnvironmentVariable, type=str):
+    """Substrate-aware routing of the sort-shaped reduction families
+    (median / quantile / nunique / mode) between the device kernels and the
+    pandas host kernels (graftsort).
+
+    Auto (default): a calibrated cost model picks whichever side is
+    predicted faster at the observed (rows, strategy, substrate); frames
+    below ``KernelRouterMinRows`` always stay on device (the decision is
+    noise there and device residency is worth more).  Device: always run
+    the device kernels (pre-router behavior).  Host: always decline to the
+    pandas fallback (operator escape hatch for a substrate where the
+    device sort is known-bad).
+    """
+
+    varname = "MODIN_TPU_KERNEL_ROUTER"
+    choices = ("Auto", "Device", "Host")
+    default = "Auto"
+
+
+class KernelRouterMinRows(EnvironmentVariable, type=int):
+    """Row count below which ``auto`` routing always picks the device
+    kernel without consulting (or running) the calibration: at small n the
+    host/device gap is measurement noise and keeping results device-resident
+    is worth more than the crossover."""
+
+    varname = "MODIN_TPU_KERNEL_ROUTER_MIN_ROWS"
+    default = 1 << 20
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Router min rows should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class KernelRouterHistBound(EnvironmentVariable, type=int):
+    """Largest value range (max - min + 1) for which an integer /
+    dictionary-coded column takes the O(n) segment-sum histogram fast path
+    for ``nunique``/``mode`` instead of the O(n log n) sort kernel."""
+
+    varname = "MODIN_TPU_KERNEL_ROUTER_HIST_BOUND"
+    default = 1 << 20
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Histogram bound should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class KernelRouterCalibrationRows(EnvironmentVariable, type=int):
+    """Rows the one-shot router calibration times its micro-kernels at.
+    The calibration result is cached to ``CacheDir`` per substrate, so the
+    cost is paid once per machine, not once per process."""
+
+    varname = "MODIN_TPU_KERNEL_ROUTER_CALIBRATION_ROWS"
+    default = 1 << 18
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Calibration rows should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

@@ -96,7 +96,7 @@ class DeviceColumn:
 
     __slots__ = (
         "_data", "pandas_dtype", "length", "host_cache", "_ledger_key",
-        "lineage", "_device_epoch", "_dev_key",
+        "lineage", "_device_epoch", "_dev_key", "_sorted_rep",
         "__weakref__",
     )
     is_device = True
@@ -119,6 +119,7 @@ class DeviceColumn:
         self.lineage = None
         self._device_epoch = 0
         self._dev_key = None
+        self._sorted_rep = None  # graftsort: cached (sorted, n_valid) rep
         if host_cache is not None:
             # host caches count against the Memory spill budget (core/memory.py)
             from modin_tpu.core.memory import ledger
@@ -181,14 +182,26 @@ class DeviceColumn:
         """A deferred expression just became a concrete device buffer."""
         from modin_tpu.core.execution import recovery
 
+        self._invalidate_sorted()
         self._register_device()
         recovery.attach_lineage(self)
+
+    def _invalidate_sorted(self) -> None:
+        """Drop the cached sorted representation — the buffer this column
+        answers for is about to change (spill / re-seat / materialize)."""
+        if self._sorted_rep is not None:
+            from modin_tpu.ops.sorted_cache import invalidate
+
+            invalidate(self)
 
     def spill(self) -> int:
         """Drop the device buffer, keeping an exact host copy; returns the
         device bytes freed (0 = not spillable right now)."""
         if self._data is None or self.is_lazy:
             return 0
+        # a sorted rep derived from the buffer being dropped must not
+        # outlive it (and holding it would defeat the spill anyway)
+        self._invalidate_sorted()
         cache = self.host_cache
         if cache is None:
             # to_numpy round-trips the logical dtype exactly (and under
@@ -223,11 +236,13 @@ class DeviceColumn:
         values = self.host_cache  # single read: eviction may race us
         if values is None:
             raise RuntimeError("no host copy to re-seat from")
+        self._invalidate_sorted()
         self._data = _device_put_values(np.asarray(values))
         self._register_device()
 
     def adopt_reseated(self, data: Any) -> None:
         """Adopt a lineage-replayed device buffer (op-replay recovery)."""
+        self._invalidate_sorted()
         self._data = data
         self._register_device()
 

@@ -420,6 +420,12 @@ def recover_column(col: Any, depth: int = 0, force: bool = False) -> Optional[st
     fresh (current epoch, concrete buffer).  Raises :class:`Unrecoverable`
     when no lineage can reproduce the buffer.
     """
+    if getattr(col, "is_derived_cache", False):
+        # graftsort sorted-representation rep (ops/sorted_cache.py): derived
+        # data is disposable, never unrecoverable — drop it; the owning
+        # column rebuilds it from its (recovered) buffer on next use
+        col.drop()
+        return None
     if getattr(col, "is_lazy", False):
         return None  # nothing device-resident to lose yet
     if col._data is None:

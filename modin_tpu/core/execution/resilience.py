@@ -369,6 +369,9 @@ DEVICE_PATH_FAMILIES = frozenset(
         "groupby",
         "shuffle_apply",
         "sort_shuffle",
+        # graftsort: the sort-shaped reduction family (median / quantile /
+        # nunique / mode) behind the kernel router (ops/router.py)
+        "sort_reduce",
     }
 )
 

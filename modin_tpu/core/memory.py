@@ -22,6 +22,13 @@ Two budgets, two ledgers, one spill policy each way:
   host — drop the device buffer, keep an exact host copy — *before* XLA
   has to raise RESOURCE_EXHAUSTED (the proactive memory-aware admission
   Xorbits, arXiv:2401.00865, shows distributed dataframes need at scale).
+
+The device ledger tracks two kinds of entries under one spill protocol:
+column buffers (``DeviceColumn`` — spill keeps an exact host copy) and
+graftsort sorted-representation reps (``ops/sorted_cache.SortedRep``,
+marked ``is_derived_cache`` — spill just drops them; derived data is
+rebuilt on demand, so reclaiming a rep is the cheapest spill available
+and LRU order naturally prefers cold reps over cold columns).
 """
 
 from __future__ import annotations

@@ -1024,9 +1024,31 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return qc
         if axis not in (0, None):
             return None
-        values = reductions.reduce_columns(
-            op, arrays, len(frame), skipna=skipna, ddof=ddof, cast_bool=cast_bool
-        )
+        if (
+            op == "median"
+            and not decoders
+            and all(not c.is_lazy for c in sel_cols)
+        ):
+            # graftsort: concrete columns take the shared-sorted-
+            # representation median (one sort amortized across the whole
+            # sort-shaped family, correct skipna=False semantics),
+            # router-gated; lazy chains keep the fused nanmedian tail
+            from modin_tpu.ops import sorted_cache
+            from modin_tpu.ops.router import decide
+
+            strategies = [
+                "cached" if sorted_cache.peek(c) else "sort" for c in sel_cols
+            ]
+            if decide("median", len(frame), strategies) == "host":
+                return None
+            values = reductions.median_columns(
+                sel_cols, len(frame), skipna=skipna
+            )
+        else:
+            values = reductions.reduce_columns(
+                op, arrays, len(frame), skipna=skipna, ddof=ddof,
+                cast_bool=cast_bool,
+            )
         out_values = []
         for pos, v in zip(positions, values):
             v = v.item() if v.ndim == 0 else v
@@ -1062,50 +1084,84 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return type(self).from_pandas(result.to_frame(name))
 
     # ---------------- sort/search-shaped device reductions ---------------- #
+    # graftsort: the axis-0 families below plan a per-column strategy
+    # (dictionary O(1) / O(n) histogram / shared sorted representation —
+    # ops/reductions.plan_sort_reduce), then ask the kernel router
+    # (ops/router.py) whether the device plan or the pandas host kernel is
+    # predicted faster on this substrate; "host" declines through the
+    # @device_path("sort_reduce") fallback seam.
+
+    def _sort_reduce_specs(
+        self, numeric_only: bool = False
+    ) -> Optional[Tuple[list, dict]]:
+        """(specs for plan_sort_reduce, {position: DictEncoding}) over all
+        columns, or None when some column can join neither as a numeric
+        device column nor through its dictionary encoding."""
+        frame = self._modin_frame
+        specs: list = []
+        decoders: dict = {}
+        for i, c in enumerate(frame._columns):
+            if c.is_device and c.pandas_dtype.kind in "biuf":
+                specs.append({"col": c})
+                continue
+            if (
+                numeric_only
+                or c.is_device
+                or isinstance(c.pandas_dtype, pandas.CategoricalDtype)
+            ):
+                return None
+            from modin_tpu.ops.dictionary import encode_host_column
+
+            enc = encode_host_column(c)
+            if enc is None:
+                return None
+            decoders[i] = enc
+            specs.append(
+                {
+                    "col": enc.codes,
+                    "n_categories": len(enc.categories),
+                    "has_nan": enc.has_nan,
+                }
+            )
+        return specs, decoders
+
+    @device_path("sort_reduce")
+    def _try_sort_reduce_nunique(
+        self, dropna: bool
+    ) -> Optional["TpuQueryCompiler"]:
+        """Distinct count per column: dictionary encodings answer O(1)
+        (categories ARE the distinct non-missing values), bounded-range
+        ints via one O(n) histogram, the rest via the shared sorted
+        representation; router-gated."""
+        from modin_tpu.ops import reductions
+        from modin_tpu.ops.router import decide, forced_host
+
+        frame = self._modin_frame
+        if not frame.num_cols:
+            return None
+        if forced_host("nunique", len(frame)):
+            return None  # before any device work (materialize, range probe)
+        got = self._sort_reduce_specs()
+        if got is None:
+            return None
+        specs, _ = got
+        frame.materialize_device()
+        n = len(frame)
+        plans = reductions.plan_sort_reduce("nunique", specs, n)
+        if decide("nunique", n, [p.strategy for p in plans]) == "host":
+            return None
+        counts = reductions.nunique_planned(plans, n, bool(dropna))
+        result = pandas.Series(counts, index=frame.columns, dtype=np.int64)
+        return type(self).from_pandas(
+            result.to_frame(MODIN_UNNAMED_SERIES_LABEL)
+        )
 
     def nunique(self, axis: int = 0, dropna: bool = True, **kwargs: Any):
         frame = self._modin_frame
         if axis == 0 and not kwargs and len(frame):
-            # numeric device columns -> sort-based kernel; object/str columns
-            # read their distinct count straight off the dictionary encoding
-            # (categories are the distinct non-missing values)
-            dev_positions = []
-            dict_counts: dict = {}
-            ok = bool(frame.num_cols)
-            for i, c in enumerate(frame._columns):
-                if c.is_device and c.pandas_dtype.kind in "biuf":
-                    dev_positions.append(i)
-                    continue
-                if not c.is_device:
-                    from modin_tpu.ops.dictionary import encode_host_column
-
-                    enc = encode_host_column(c)
-                    if enc is not None:
-                        dict_counts[i] = len(enc.categories) + (
-                            0 if dropna else int(enc.has_nan)
-                        )
-                        continue
-                ok = False
-                break
-            if ok:
-                from modin_tpu.ops.reductions import nunique_columns
-
-                frame.materialize_device()
-                dev_counts = nunique_columns(
-                    [frame._columns[i].data for i in dev_positions],
-                    len(frame),
-                    bool(dropna),
-                )
-                by_pos = dict(zip(dev_positions, dev_counts))
-                by_pos.update(dict_counts)
-                result = pandas.Series(
-                    [by_pos[i] for i in range(frame.num_cols)],
-                    index=frame.columns,
-                    dtype=np.int64,
-                )
-                return type(self).from_pandas(
-                    result.to_frame(MODIN_UNNAMED_SERIES_LABEL)
-                )
+            result = self._try_sort_reduce_nunique(bool(dropna))
+            if result is not None:
+                return result
         if (
             axis == 1
             and not kwargs
@@ -1133,6 +1189,71 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return qc
         return super().nunique(axis=axis, dropna=dropna, **kwargs)
 
+    @device_path("sort_reduce")
+    def _try_sort_reduce_mode(
+        self, numeric_only: bool, dropna: bool
+    ) -> Optional["TpuQueryCompiler"]:
+        """Modal values per column: bounded-range ints and dictionary codes
+        via O(n) histograms (no sort, and no ``k_bound`` cap — every modal
+        value falls out of the bin mask), the rest via the shared sorted
+        representation's run-length kernel; router-gated.
+
+        Parity surface: pandas ``DataFrame.mode`` (reference defaults it to
+        a full-column fold, modin/core/storage_formats/pandas/
+        query_compiler.py).  ``dropna=False`` (NaN competes for the max
+        count) is supported only where every column planned "hist" — the
+        sorted kernel stays dropna-only."""
+        from modin_tpu.ops import reductions
+        from modin_tpu.ops.router import decide, forced_host
+
+        frame = self._modin_frame
+        if forced_host("mode", len(frame)):
+            return None  # before any device work (materialize, range probe)
+        got = self._sort_reduce_specs(numeric_only=bool(numeric_only))
+        if got is None:
+            return None
+        specs, decoders = got
+        frame.materialize_device()
+        n = len(frame)
+        plans = reductions.plan_sort_reduce("mode", specs, n)
+        if not dropna and any(p.strategy != "hist" for p in plans):
+            return None  # NaN-counting mode needs the histogram everywhere
+        if decide("mode", n, [p.strategy for p in plans]) == "host":
+            return None
+        per_col = reductions.mode_planned(plans, n, bool(dropna))
+        if any(v is None for v in per_col):
+            return None
+        pieces = []
+        for i, (got_col, col, label) in enumerate(
+            zip(per_col, frame._columns, frame.columns)
+        ):
+            values, nan_modal = got_col
+            if i in decoders:
+                cats = decoders[i].categories
+                idx = np.asarray(values).astype(np.int64)
+                decoded = list(cats[idx]) if len(idx) else []
+                if nan_modal:
+                    # pandas keeps the column's OWN first missing object
+                    # (None stays None, np.nan stays np.nan), sorted last
+                    host_vals = np.asarray(col.data, dtype=object)
+                    na_pos = np.flatnonzero(pandas.isna(host_vals))
+                    decoded.append(
+                        host_vals[na_pos[0]] if len(na_pos) else np.nan
+                    )
+                pieces.append(
+                    pandas.Series(decoded, dtype=col.pandas_dtype, name=label)
+                )
+            else:
+                pieces.append(
+                    pandas.Series(
+                        np.asarray(values).astype(col.pandas_dtype, copy=False),
+                        name=label,
+                    )
+                )
+        result = pandas.concat(pieces, axis=1)
+        result.columns = frame.columns
+        return type(self).from_pandas(result)
+
     def mode(
         self,
         axis: int = 0,
@@ -1140,13 +1261,11 @@ class TpuQueryCompiler(BaseQueryCompiler):
         dropna: bool = True,
         **kwargs: Any,
     ):
-        """Modal values via sorted run-length kernels (ops/reductions.py).
-
-        Parity surface: pandas ``DataFrame.mode`` (reference defaults it to a
-        full-column fold, modin/core/storage_formats/pandas/
-        query_compiler.py).  Gates: ``dropna=True`` (NaN-counting modes keep
-        the pandas fallback), numeric device columns only."""
         frame = self._modin_frame
+        if axis == 0 and not kwargs and len(frame) and frame.num_cols:
+            result = self._try_sort_reduce_mode(bool(numeric_only), bool(dropna))
+            if result is not None:
+                return result
         device_ok = (
             dropna
             and not kwargs
@@ -1157,27 +1276,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 for c in frame._columns
             )
         )
-        if device_ok and axis == 0:
-            from modin_tpu.ops.reductions import mode_columns
-
-            frame.materialize_device()
-            per_col = mode_columns(
-                [c.data for c in frame._columns], len(frame)
-            )
-            if all(v is not None for v in per_col):
-                pieces = [
-                    pandas.Series(
-                        np.asarray(v).astype(col.pandas_dtype, copy=False),
-                        name=label,
-                    )
-                    for v, col, label in zip(
-                        per_col, frame._columns, frame.columns
-                    )
-                ]
-                result = pandas.concat(pieces, axis=1)
-                result.columns = frame.columns
-                return type(self).from_pandas(result)
-        elif device_ok and axis == 1 and frame.num_cols <= 64:
+        if device_ok and axis == 1 and frame.num_cols <= 64:
             from modin_tpu.ops.reductions import mode_axis1
 
             frame.materialize_device()
@@ -1240,6 +1339,11 @@ class TpuQueryCompiler(BaseQueryCompiler):
                 for c in frame._columns
             )
             and all(0.0 <= q <= 1.0 for q in qs)
+            # the quantile leg is a sort-shaped kernel: the same router
+            # verdict that gates quantile() gates describe's device path
+            # (a substrate where the device sort loses must not pay it
+            # here either)
+            and self._describe_routed_device()
         ):
             from modin_tpu.ops.reductions import quantile_columns, reduce_columns
 
@@ -1250,9 +1354,9 @@ class TpuQueryCompiler(BaseQueryCompiler):
             for op in ("count", "mean", "std", "min", "max"):
                 vals = reduce_columns(op, arrays, n, skipna=True, ddof=1)
                 stats[op] = [float(np.asarray(v)) for v in vals]
-            qvals = quantile_columns(
-                [c.data for c in frame._columns], n, qs, "linear"
-            )
+            # columns, not raw arrays: the quantiles consume (and seed) the
+            # shared sorted representation alongside the other stats
+            qvals = quantile_columns(list(frame._columns), n, qs, "linear")
             rows = ["count", "mean", "std", "min"]
             data_rows = [stats["count"], stats["mean"], stats["std"], stats["min"]]
             for j, q in enumerate(qs):
@@ -1269,6 +1373,22 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return super().describe(
             percentiles=percentiles, include=include, exclude=exclude
         )
+
+    def _describe_routed_device(self) -> bool:
+        """Kernel-router verdict for describe's quantile leg (the
+        sort-shaped piece; the count/mean/std/min/max reductions are
+        cheap either way)."""
+        from modin_tpu.ops import sorted_cache
+        from modin_tpu.ops.router import decide, forced_host
+
+        frame = self._modin_frame
+        if forced_host("quantile", len(frame)):
+            return False
+        strategies = [
+            "cached" if sorted_cache.peek(c) else "sort"
+            for c in frame._columns
+        ]
+        return decide("quantile", len(frame), strategies) == "device"
 
     def setitem_bool(self, row_loc: Any, col_loc: Any, item: Any):
         """``df.loc[mask, col] = scalar`` as one fused where-kernel.
@@ -1836,43 +1956,60 @@ class TpuQueryCompiler(BaseQueryCompiler):
             and all(0 <= float(v) <= 1 for v in qs)
         )
         if device_ok:
-            positions = []
-            for i, col in enumerate(frame._columns):
-                # bool columns: pandas quantile RAISES on them — fallback
-                if col.is_device and col.pandas_dtype.kind in "iuf":
-                    positions.append(i)
-                elif numeric_only and col.pandas_dtype.kind not in "biufc":
-                    continue  # pandas drops it
-                else:
-                    device_ok = False
-                    break
-        if device_ok and positions:
-            from modin_tpu.ops.reductions import quantile_columns
-
-            frame.materialize_device()
-            vals = quantile_columns(
-                [frame._columns[i].data for i in positions],
-                len(frame),
-                [float(v) for v in qs],
-                interpolation,
+            result = self._try_sort_reduce_quantile(
+                q, [float(v) for v in qs], str(interpolation),
+                bool(numeric_only), bool(is_list_like(q)),
             )
-            labels = frame.columns[positions]
-            if is_list_like(q):
-                # positional dict first: duplicate labels must survive
-                result = pandas.DataFrame(
-                    dict(enumerate(vals)),
-                    index=pandas.Index([float(v) for v in qs]),
-                )
-                result.columns = labels
-                return type(self).from_pandas(result)
-            result = pandas.Series(
-                [arr[0] for arr in vals], index=labels, name=q
-            )
-            return type(self).from_pandas(result.to_frame())
+            if result is not None:
+                return result
         return super().quantile(
             q=q, axis=axis, numeric_only=numeric_only,
             interpolation=interpolation, method=method, **kwargs,
         )
+
+    @device_path("sort_reduce")
+    def _try_sort_reduce_quantile(
+        self, q: Any, qs: list, interpolation: str, numeric_only: bool,
+        list_like: bool,
+    ) -> Optional["TpuQueryCompiler"]:
+        """Quantiles over the shared sorted representation (one sort per
+        column amortized across the whole sort-shaped family); router-gated."""
+        from modin_tpu.ops import sorted_cache
+        from modin_tpu.ops.reductions import quantile_columns
+        from modin_tpu.ops.router import decide, forced_host
+
+        frame = self._modin_frame
+        if forced_host("quantile", len(frame)):
+            return None  # before any device work (materialization)
+        positions = []
+        for i, col in enumerate(frame._columns):
+            # bool columns: pandas quantile RAISES on them — fallback
+            if col.is_device and col.pandas_dtype.kind in "iuf":
+                positions.append(i)
+            elif numeric_only and col.pandas_dtype.kind not in "biufc":
+                continue  # pandas drops it
+            else:
+                return None
+        if not positions:
+            return None
+        frame.materialize_device()
+        cols = [frame._columns[i] for i in positions]
+        strategies = [
+            "cached" if sorted_cache.peek(c) else "sort" for c in cols
+        ]
+        if decide("quantile", len(frame), strategies) == "host":
+            return None
+        vals = quantile_columns(cols, len(frame), qs, interpolation)
+        labels = frame.columns[positions]
+        if list_like:
+            # positional dict first: duplicate labels must survive
+            result = pandas.DataFrame(
+                dict(enumerate(vals)), index=pandas.Index(qs)
+            )
+            result.columns = labels
+            return type(self).from_pandas(result)
+        result = pandas.Series([arr[0] for arr in vals], index=labels, name=q)
+        return type(self).from_pandas(result.to_frame())
 
     @device_path("top_k")
     def _try_device_top_k(self, n: int, column_pos: int, largest: bool, keep: str):

@@ -90,6 +90,23 @@ METRICS = (
         "spilled columns transparently re-seated on device on access",
     ),
     (
+        "router.*.*",
+        "graftsort kernel-router decisions per sort-shaped op family "
+        "(median/quantile/nunique/mode): device vs host choice counts",
+    ),
+    (
+        "router.calibrate",
+        "one-shot kernel-router micro-benchmark calibrations (cold "
+        "CacheDir for this substrate)",
+    ),
+    (
+        "sortcache.*",
+        "sorted-representation cache lifecycle: build (one shared sort "
+        "paid), hit (a later sort-shaped op consumed it), invalidate "
+        "(buffer mutation / spill / re-seat dropped it), spill (the "
+        "device-memory ledger reclaimed it under pressure)",
+    ),
+    (
         "pandas-api.*",
         "wall-clock seconds per public pandas-API call (logging layer)",
     ),

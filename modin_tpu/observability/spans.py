@@ -92,6 +92,23 @@ SPANS = (
         "one admission-control / evict-then-retry spill pass dropping "
         "cold device buffers to host (byte target in attributes)",
     ),
+    (
+        "router.decide",
+        "one graftsort kernel-router decision: op family, rows, planned "
+        "per-column strategies, predicted device/host costs and the "
+        "chosen side in attributes",
+    ),
+    (
+        "router.calibrate",
+        "the one-shot kernel-router micro-benchmark pass seeding the "
+        "cost model for this substrate (cached to CacheDir)",
+    ),
+    (
+        "sortcache.build",
+        "one batched sorted-representation build (the shared sort the "
+        "rest of the sort-shaped family amortizes); column count in "
+        "attributes",
+    ),
 )
 
 _EPOCH_PERF = time.perf_counter()

@@ -15,7 +15,7 @@ passed statically).
 from __future__ import annotations
 
 import functools
-from typing import Any, List, Tuple
+from typing import Any, List, NamedTuple, Tuple
 
 import numpy as np
 
@@ -514,31 +514,47 @@ def idx_minmax(op_name: str, cols: List[Any], n: int, skipna: bool = True):
 
 
 # --------------------------------------------------------------------- #
-# Distinct counts and quantiles (sort-based single-column reductions)
+# graftsort: sort-shaped reductions (median / quantile / nunique / mode)
+# over shared sorted representations and O(n) histogram fast paths
 # --------------------------------------------------------------------- #
+#
+# Three execution strategies per column, planned before dispatch:
+#
+# - "dict":   the answer is already on the host (dictionary-encoding
+#             categories; ops/dictionary.py) — zero device work;
+# - "hist":   bounded-range ints and dictionary codes count occurrences
+#             with one O(n) scatter-add histogram — no sort, and mode's
+#             k_bound cap is dead code here (every modal value falls out
+#             of the bin mask);
+# - "cached"/"sort": the classic sorted path, but the (sorted, n_valid)
+#             prefix is built once per column via ops/sort.sorted_valid
+#             and cached on the DeviceColumn (ops/sorted_cache.py), so
+#             median + quantile + nunique + mode on one column pay ONE
+#             O(n log n) sort, not four.
+#
+# The substrate-aware choice between running any of this on device and
+# declining to the pandas fallback belongs to ops/router.py; the query
+# compiler consults it with the planned strategies before calling the
+# executors below.
 
 
-def _sorted_valid(c, n):
-    """(sorted values, n_valid): NaN/pad rows sort to the tail as +inf/NaN
-    surrogates so the first n_valid entries are exactly the clean data."""
-    import jax.numpy as jnp
+class ColumnPlan(NamedTuple):
+    col: Any  # DeviceColumn carrying the values (dictionary codes included)
+    strategy: str  # ops/router.py STRATEGIES member
+    span: int  # histogram value-bin count (hist strategy only)
+    base: int  # histogram base value: bin = value - base (0 for codes)
+    n_categories: int  # dict strategy: distinct non-missing count
+    has_nan: bool  # dict/code columns: encoding has missing rows
 
-    is_f = jnp.issubdtype(c.dtype, jnp.floating)
-    valid = _valid_mask(c, n) if c.shape[0] != n else None
-    if is_f:
-        nanm = jnp.isnan(c) if valid is None else (jnp.isnan(c) | ~valid)
-        x = jnp.where(nanm, jnp.inf, c)
-        n_valid = (n if valid is None else jnp.sum(valid)) - jnp.sum(
-            jnp.isnan(c) if valid is None else (jnp.isnan(c) & valid)
-        )
-    else:
-        x = c if valid is None else jnp.where(valid, c, _int_max(c.dtype))
-        n_valid = jnp.asarray(n, jnp.int64)
-    return jnp.sort(x), n_valid
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 1).bit_length()
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_nunique(n_cols: int, n: int, dropna: bool):
+def _jit_minmax(n_cols: int, n: int):
+    """Per-column (min, max) over valid rows — the O(n) histogram
+    eligibility probe for bounded-range int columns."""
     import jax
 
     def fn(cols: Tuple):
@@ -548,105 +564,208 @@ def _jit_nunique(n_cols: int, n: int, dropna: bool):
         for c in cols:
             if c.dtype == jnp.bool_:
                 c = c.astype(jnp.int8)
-            is_f = jnp.issubdtype(c.dtype, jnp.floating)
-            xs, n_valid = _sorted_valid(c, n)
-            idx = jnp.arange(xs.shape[0])
-            firsts = jnp.concatenate(
-                [jnp.ones(1, bool), xs[1:] != xs[:-1]]
+            if c.shape[0] == n:
+                out.append((jnp.min(c), jnp.max(c)))
+            else:
+                valid = _valid_mask(c, n)
+                out.append(
+                    (
+                        jnp.min(jnp.where(valid, c, _int_max(c.dtype))),
+                        jnp.max(jnp.where(valid, c, _int_min(c.dtype))),
+                    )
+                )
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
+def plan_sort_reduce(op: str, specs: List[dict], n: int) -> List[ColumnPlan]:
+    """One :class:`ColumnPlan` per column spec for a sort-shaped ``op``.
+
+    ``specs`` entries are ``{"col": DeviceColumn}`` for numeric columns or
+    ``{"col": codes, "n_categories": k, "has_nan": b}`` for
+    dictionary-encoded ones.  Bounded-range int columns are probed (one
+    fused min/max jit + one scalar fetch) for histogram eligibility under
+    ``MODIN_TPU_KERNEL_ROUTER_HIST_BOUND``; columns with a live sorted
+    representation plan as "cached".
+    """
+    from modin_tpu.config import KernelRouterHistBound
+    from modin_tpu.ops import sorted_cache
+
+    hist_bound = int(KernelRouterHistBound.get())
+    hist_ok = op in ("nunique", "mode")
+    plans: List[Any] = [None] * len(specs)
+    probe: List[int] = []
+    for i, spec in enumerate(specs):
+        col = spec["col"]
+        if "n_categories" in spec:
+            k = int(spec["n_categories"])
+            has_nan = bool(spec["has_nan"])
+            if op == "nunique":
+                plans[i] = ColumnPlan(col, "dict", 0, 0, k, has_nan)
+            elif hist_ok and k + 2 <= hist_bound:
+                # span floor 1: an all-missing column factorizes to empty
+                # categories (k=0), and a zero-size value-bin slice would
+                # make the kernel's max reduction trace-fail
+                plans[i] = ColumnPlan(col, "hist", max(k, 1), 0, k, has_nan)
+            elif sorted_cache.peek(col):
+                plans[i] = ColumnPlan(col, "cached", 0, 0, k, has_nan)
+            else:
+                plans[i] = ColumnPlan(col, "sort", 0, 0, k, has_nan)
+            continue
+        if sorted_cache.peek(col):
+            plans[i] = ColumnPlan(col, "cached", 0, 0, 0, False)
+        elif hist_ok and col.pandas_dtype.kind in "biu":
+            probe.append(i)
+        else:
+            plans[i] = ColumnPlan(col, "sort", 0, 0, 0, False)
+    if probe:
+        ranges = _engine_materialize(
+            _jit_minmax(len(probe), int(n))(
+                tuple(specs[i]["col"].data for i in probe)
             )
+        )
+        for i, (cmin, cmax) in zip(probe, ranges):
+            cmin, cmax = int(cmin), int(cmax)
+            span = cmax - cmin + 1
+            if 0 < span <= hist_bound:
+                plans[i] = ColumnPlan(
+                    specs[i]["col"], "hist", span, cmin, 0, False
+                )
+            else:
+                plans[i] = ColumnPlan(specs[i]["col"], "sort", 0, 0, 0, False)
+    return plans
+
+
+def _sorted_inputs(plans: List[ColumnPlan], n: int) -> dict:
+    """{plan index: (sorted values, n_valid)} for every sorted-strategy
+    plan; missing representations are built in ONE batched jit and cached
+    on their columns."""
+    from modin_tpu.observability import spans as graftscope
+    from modin_tpu.ops import sorted_cache
+    from modin_tpu.ops.sort import sorted_valid_columns
+
+    reps: dict = {}
+    missing: List[Tuple[int, Any]] = []
+    for i, p in enumerate(plans):
+        if p.strategy not in ("cached", "sort"):
+            continue
+        got = sorted_cache.get(p.col)
+        if got is None:
+            missing.append((i, p.col))
+        else:
+            reps[i] = got
+    if missing:
+        with graftscope.span(
+            "sortcache.build", layer="QUERY-COMPILER", cols=len(missing)
+        ):
+            built = sorted_valid_columns(
+                [c.data for _, c in missing], int(n)
+            )
+        for (i, col), pair in zip(missing, built):
+            sorted_cache.attach(col, pair[0], pair[1])
+            reps[i] = pair
+    return reps
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_nunique_sorted(n_pairs: int, n: int, dropna: bool):
+    import jax
+
+    def fn(pairs: Tuple):
+        import jax.numpy as jnp
+
+        out = []
+        for xs, n_valid in pairs:
+            is_f = jnp.issubdtype(xs.dtype, jnp.floating)
+            idx = jnp.arange(xs.shape[0])
+            firsts = jnp.concatenate([jnp.ones(1, bool), xs[1:] != xs[:-1]])
             count = jnp.sum(firsts & (idx < n_valid))
             if is_f and not dropna:
-                had_nan = n_valid < (
-                    n if c.shape[0] == n else jnp.sum(_valid_mask(c, n))
-                )
-                count = count + had_nan.astype(count.dtype)
+                count = count + (n_valid < n).astype(count.dtype)
             out.append(count)
         return tuple(out)
 
     return jax.jit(fn)
 
 
-def nunique_columns(cols: List[Any], n: int, dropna: bool = True) -> list:
-    """Distinct-count per padded column: sort + adjacent-difference."""
-    import jax
+def _quantile_from_sorted(xs, n_valid, qs, interpolation: str):
+    """Quantiles of one column's (sorted, n_valid) representation — the
+    single interpolation implementation behind both the quantile and the
+    median kernels."""
+    import jax.numpy as jnp
 
-    fn = _jit_nunique(len(cols), int(n), bool(dropna))
-    return [int(v) for v in _engine_materialize(fn(tuple(cols)))]
+    is_f = jnp.issubdtype(xs.dtype, jnp.floating)
+    # fractional position of each q over the valid prefix
+    pos = qs * jnp.maximum(n_valid - 1, 0).astype(jnp.float64)
+    lo = jnp.floor(pos).astype(jnp.int64)
+    hi = jnp.ceil(pos).astype(jnp.int64)
+    if interpolation in ("lower", "higher", "nearest"):
+        # pandas keeps the ORIGINAL dtype value exactly (int64 results
+        # stay int64) — select without a float cast
+        if interpolation == "lower":
+            idx = lo
+        elif interpolation == "higher":
+            idx = hi
+        else:  # nearest: numpy half-to-even
+            idx = jnp.round(pos).astype(jnp.int64)
+        v = jnp.take(xs, idx)
+        if is_f:
+            v = jnp.where(n_valid > 0, v, jnp.nan)
+        return v
+    xs64 = xs.astype(jnp.float64)
+    vlo = jnp.take(xs64, lo)
+    vhi = jnp.take(xs64, hi)
+    if interpolation == "linear":
+        v = vlo + (vhi - vlo) * (pos - lo)
+    else:  # midpoint
+        v = (vlo + vhi) / 2.0
+    return jnp.where(n_valid > 0, v, jnp.nan)
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_quantile(n_cols: int, n: int, n_q: int, interpolation: str):
+def _jit_quantile_sorted(n_pairs: int, n_q: int, interpolation: str):
     import jax
 
-    element_select = interpolation in ("lower", "higher", "nearest")
+    def fn(pairs: Tuple, qs):
+        return tuple(
+            _quantile_from_sorted(xs, n_valid, qs, interpolation)
+            for xs, n_valid in pairs
+        )
 
-    def fn(cols: Tuple, qs):
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_median_sorted(n_pairs: int, n: int, skipna: bool):
+    import jax
+
+    def fn(pairs: Tuple):
         import jax.numpy as jnp
 
+        qs = jnp.asarray([0.5], jnp.float64)
         out = []
-        for c in cols:
-            is_f = jnp.issubdtype(c.dtype, jnp.floating)
-            xs, n_valid = _sorted_valid(c, n)
-            # fractional position of each q over the valid prefix
-            pos = qs * jnp.maximum(n_valid - 1, 0).astype(jnp.float64)
-            lo = jnp.floor(pos).astype(jnp.int64)
-            hi = jnp.ceil(pos).astype(jnp.int64)
-            if element_select:
-                # pandas keeps the ORIGINAL dtype value exactly (int64
-                # results stay int64) — select without a float cast
-                if interpolation == "lower":
-                    idx = lo
-                elif interpolation == "higher":
-                    idx = hi
-                else:  # nearest: numpy half-to-even
-                    idx = jnp.round(pos).astype(jnp.int64)
-                v = jnp.take(xs, idx)
-                if is_f:
-                    v = jnp.where(n_valid > 0, v, jnp.nan)
-                out.append(v)
-                continue
-            xs64 = xs.astype(jnp.float64)
-            vlo = jnp.take(xs64, lo)
-            vhi = jnp.take(xs64, hi)
-            if interpolation == "linear":
-                v = vlo + (vhi - vlo) * (pos - lo)
-            else:  # midpoint
-                v = (vlo + vhi) / 2.0
-            out.append(jnp.where(n_valid > 0, v, jnp.nan))
+        for xs, n_valid in pairs:
+            v = _quantile_from_sorted(xs, n_valid, qs, "linear")[0]
+            v = v.astype(jnp.float64)
+            if not skipna:
+                # pandas: median(skipna=False) is NaN when any NaN present
+                v = jnp.where(n_valid < n, jnp.nan, v)
+            out.append(v)
         return tuple(out)
 
     return jax.jit(fn)
 
 
-def quantile_columns(
-    cols: List[Any], n: int, qs: List[float], interpolation: str = "linear"
-) -> list:
-    """Quantiles per padded column -> list of (n_q,) host arrays, one per
-    column, each in its pandas result dtype: float64 for 'linear'/'midpoint',
-    the column's own dtype for the element-selecting interpolations
-    ('lower'/'higher'/'nearest' — pandas keeps int64 exact there).  An
-    all-NaN/empty int column cannot carry NaN; the QC gate guarantees n>0
-    and int columns are never NaN."""
-    import jax
-    import jax.numpy as jnp
-
-    fn = _jit_quantile(len(cols), int(n), len(qs), str(interpolation))
-    results = fn(tuple(cols), jnp.asarray(qs, jnp.float64))
-    return [np.asarray(r) for r in _engine_materialize(results)]
-
-
 @functools.lru_cache(maxsize=None)
-def _jit_mode(n_cols: int, n: int, k_bound: int):
+def _jit_mode_sorted(n_pairs: int, k_bound: int):
     import jax
 
-    def fn(cols: Tuple):
+    def fn(pairs: Tuple):
         import jax.numpy as jnp
 
         outs = []
-        for c in cols:
-            if c.dtype == jnp.bool_:
-                c = c.astype(jnp.int8)
-            xs, n_valid = _sorted_valid(c, n)
+        for xs, n_valid in pairs:
             idx = jnp.arange(xs.shape[0])
             valid = idx < n_valid
             firsts = (
@@ -663,34 +782,187 @@ def _jit_mode(n_cols: int, n: int, k_bound: int):
             # gather the modal values (already ascending) into k_bound slots
             pos = jnp.cumsum(is_modal) - 1
             slot = jnp.where(is_modal, pos, k_bound)
-            vals = (
-                jnp.zeros(k_bound, xs.dtype).at[slot].set(xs, mode="drop")
-            )
+            vals = jnp.zeros(k_bound, xs.dtype).at[slot].set(xs, mode="drop")
             outs.append((vals, m))
         return tuple(outs)
 
     return jax.jit(fn)
 
 
-def mode_columns(cols: List[Any], n: int, k_bound: int = 1024) -> list:
-    """Per-column modal values (``dropna=True`` semantics): sort +
-    run-length + max-count.  Returns one host array per column holding that
-    column's modes in ascending order (pandas' order), or ``None`` in a slot
-    whose mode set exceeded ``k_bound`` or is empty (all-NaN column) — the
-    caller falls back for those.
-
-    Mirrors the reference's TreeReduce-based ``mode`` behavior
-    (modin/core/storage_formats/pandas/query_compiler.py) with a single
-    fused sort-based kernel per column instead of a partition map-reduce."""
+@functools.lru_cache(maxsize=None)
+def _jit_hist(n_cols: int, span_pad: int, n: int, want_mode: bool, dropna: bool):
+    """O(n) histogram kernel over ``span_pad`` bins (a shared power of two,
+    so data-dependent value ranges cause at most log2(HIST_BOUND)
+    recompiles).  Bin layout: [0, span_pad-2) value bins, span_pad-2 the
+    NaN bin (dictionary codes / float code columns), span_pad-1 the
+    dead-row bin (pads)."""
     import jax
 
-    fn = _jit_mode(len(cols), int(n), int(k_bound))
-    fetched = _engine_materialize(fn(tuple(cols)))
-    out = []
-    for vals, m in fetched:
-        m = int(m)
-        out.append(np.asarray(vals[:m]) if 0 < m <= int(k_bound) else None)
-    return out
+    nan_slot = span_pad - 2
+    dead_slot = span_pad - 1
+
+    def fn(cols: Tuple, bases: Tuple):
+        import jax.numpy as jnp
+
+        outs = []
+        for c, base in zip(cols, bases):
+            if c.dtype == jnp.bool_:
+                c = c.astype(jnp.int8)
+            is_f = jnp.issubdtype(c.dtype, jnp.floating)
+            if is_f:
+                # dictionary codes: float64 in [0, k) with NaN for missing
+                nanm = jnp.isnan(c)
+                bins = jnp.where(
+                    nanm, nan_slot, jnp.where(nanm, 0.0, c).astype(jnp.int32)
+                )
+            else:
+                bins = (c - base).astype(jnp.int32)
+            if c.shape[0] != n:
+                bins = jnp.where(_valid_mask(c, n), bins, dead_slot)
+            counts = jnp.zeros(span_pad, jnp.int64).at[bins].add(1)
+            value_counts = counts[:nan_slot]
+            nan_count = counts[nan_slot]
+            if not want_mode:
+                cnt = jnp.sum(value_counts > 0)
+                if is_f and not dropna:
+                    cnt = cnt + (nan_count > 0).astype(cnt.dtype)
+                outs.append(cnt)
+                continue
+            max_val = jnp.max(value_counts)
+            max_all = (
+                max_val if dropna else jnp.maximum(max_val, nan_count)
+            )
+            mask = (value_counts == max_all) & (value_counts > 0)
+            nan_modal = (
+                jnp.zeros((), bool)
+                if dropna
+                else (nan_count == max_all) & (nan_count > 0)
+            )
+            outs.append((mask, max_all, nan_modal))
+        return tuple(outs)
+
+    return jax.jit(fn)
+
+
+def _hist_groups(plans: List[ColumnPlan]):
+    """(indices, span_pad, cols, bases) for the histogram-strategy plans."""
+    import jax.numpy as jnp
+
+    idxs = [i for i, p in enumerate(plans) if p.strategy == "hist"]
+    if not idxs:
+        return idxs, 0, (), ()
+    span_pad = _next_pow2(max(plans[i].span for i in idxs) + 2)
+    cols = tuple(plans[i].col.data for i in idxs)
+    bases = tuple(jnp.asarray(int(plans[i].base)) for i in idxs)
+    return idxs, span_pad, cols, bases
+
+
+def nunique_planned(
+    plans: List[ColumnPlan], n: int, dropna: bool = True
+) -> List[int]:
+    """Distinct-count per planned column: O(1) for dict columns, one O(n)
+    histogram for bounded-range ints, sorted adjacent-difference (shared
+    sorted rep) for the rest."""
+    n, dropna = int(n), bool(dropna)
+    results: List[Any] = [None] * len(plans)
+    for i, p in enumerate(plans):
+        if p.strategy == "dict":
+            results[i] = p.n_categories + (0 if dropna else int(p.has_nan))
+    sorted_is = [
+        i for i, p in enumerate(plans) if p.strategy in ("cached", "sort")
+    ]
+    if sorted_is:
+        reps = _sorted_inputs(plans, n)
+        vals = _jit_nunique_sorted(len(sorted_is), n, dropna)(
+            tuple(reps[i] for i in sorted_is)
+        )
+        for i, v in zip(sorted_is, _engine_materialize(vals)):
+            results[i] = int(v)
+    hist_is, span_pad, cols, bases = _hist_groups(plans)
+    if hist_is:
+        vals = _jit_hist(len(hist_is), span_pad, n, False, dropna)(cols, bases)
+        for i, v in zip(hist_is, _engine_materialize(vals)):
+            results[i] = int(v)
+    return results
+
+
+def mode_planned(
+    plans: List[ColumnPlan], n: int, dropna: bool = True, k_bound: int = 1024
+) -> List[Any]:
+    """Per-column modal values, ascending (pandas' order).
+
+    Returns per column either ``(values, nan_modal)`` — a host array of the
+    modal values (code indices for dictionary columns; the caller decodes)
+    plus whether NaN ties the max count (dropna=False histogram path only)
+    — or ``None`` when the column's mode is unrepresentable on device (the
+    sorted path's empty/over-``k_bound`` mode set); the caller falls back.
+    The histogram path has no such cap: modal values fall out of the bin
+    mask, so ``k_bound`` is dead code there.
+    """
+    n, dropna = int(n), bool(dropna)
+    results: List[Any] = [None] * len(plans)
+    sorted_is = [
+        i for i, p in enumerate(plans) if p.strategy in ("cached", "sort")
+    ]
+    if sorted_is:
+        reps = _sorted_inputs(plans, n)
+        fetched = _engine_materialize(
+            _jit_mode_sorted(len(sorted_is), int(k_bound))(
+                tuple(reps[i] for i in sorted_is)
+            )
+        )
+        for i, (vals, m) in zip(sorted_is, fetched):
+            m = int(m)
+            if 0 < m <= int(k_bound):
+                results[i] = (np.asarray(vals[:m]), False)
+    hist_is, span_pad, cols, bases = _hist_groups(plans)
+    if hist_is:
+        fetched = _engine_materialize(
+            _jit_hist(len(hist_is), span_pad, n, True, dropna)(cols, bases)
+        )
+        for i, (mask, max_all, nan_modal) in zip(hist_is, fetched):
+            nan_modal = bool(nan_modal)
+            if int(max_all) <= 0 and not nan_modal:
+                # all-missing under dropna: empty mode set, like the
+                # sorted path — the caller falls back to pandas
+                continue
+            values = np.nonzero(np.asarray(mask))[0].astype(np.int64) + int(
+                plans[i].base
+            )
+            results[i] = (values, nan_modal)
+    return results
+
+
+def quantile_columns(
+    cols: List[Any], n: int, qs: List[float], interpolation: str = "linear"
+) -> list:
+    """Quantiles per device COLUMN (not raw array: the shared sorted
+    representation caches on the column) -> list of (n_q,) host arrays,
+    each in its pandas result dtype: float64 for 'linear'/'midpoint', the
+    column's own dtype for the element-selecting interpolations
+    ('lower'/'higher'/'nearest' — pandas keeps int64 exact there).  An
+    all-NaN/empty int column cannot carry NaN; the QC gate guarantees n>0
+    and int columns are never NaN."""
+    import jax.numpy as jnp
+
+    plans = [ColumnPlan(c, "sort", 0, 0, 0, False) for c in cols]
+    reps = _sorted_inputs(plans, int(n))
+    fn = _jit_quantile_sorted(len(cols), len(qs), str(interpolation))
+    results = fn(
+        tuple(reps[i] for i in range(len(cols))), jnp.asarray(qs, jnp.float64)
+    )
+    return [np.asarray(r) for r in _engine_materialize(results)]
+
+
+def median_columns(cols: List[Any], n: int, skipna: bool = True) -> list:
+    """Median per device column over the shared sorted representation;
+    pandas semantics including ``skipna=False`` (any NaN -> NaN)."""
+    plans = [ColumnPlan(c, "sort", 0, 0, 0, False) for c in cols]
+    reps = _sorted_inputs(plans, int(n))
+    results = _jit_median_sorted(len(cols), int(n), bool(skipna))(
+        tuple(reps[i] for i in range(len(cols)))
+    )
+    return [np.asarray(r) for r in _engine_materialize(results)]
 
 
 def _axis1_matrix(cols, n):

@@ -1,0 +1,288 @@
+"""graftsort kernel router: substrate-aware device/host dispatch for the
+sort-shaped reduction families (median / quantile / nunique / mode).
+
+VERDICT r5 measured the device sort-shaped kernels losing 13-23x to pandas
+on the CPU substrate (an XLA:CPU single-core sort against pandas' optimized
+selection/hash kernels) while the framework happily ran them anyway: device
+paths were gated on dtype/shape, never on *where the kernel would run*.
+This module is the repo's per-op analogue of the reference's backend cost
+calculator (QCCoercionCost, reference
+modin/core/storage_formats/base/query_compiler.py:116) and the cost-aware
+rewriting Dias argues for (PAPERS.md): each sort-shaped ``_try_*`` family
+asks ``decide()`` whether the device kernel or the pandas host kernel is
+predicted faster at the observed (rows, per-column strategy, substrate),
+and declines to the existing ``device_path`` fallback seam when the host
+wins.
+
+The model is seeded by a **one-shot calibration**: four device micro-kernels
+(sort, sorted-consume, histogram) and four host kernels (pandas median /
+quantile / nunique / mode) are timed at ``KernelRouterCalibrationRows`` and
+the per-row coefficients cached to ``CacheDir`` per substrate, so the cost
+is paid once per machine.  Scaling: sorts grow n·log n, everything else
+linearly.  Decisions are observable: every ``decide()`` emits a
+``router.<op>.<choice>`` metric and a ``router.decide`` span carrying the
+predicted costs, so a graftscope trace shows *why* a path was chosen.
+
+Knobs (config/envvars.py): ``MODIN_TPU_KERNEL_ROUTER`` (auto|device|host),
+``MODIN_TPU_KERNEL_ROUTER_MIN_ROWS`` (below it, auto == device and the
+calibration never runs — unit-test frames stay on device, deterministic),
+``MODIN_TPU_KERNEL_ROUTER_HIST_BOUND``,
+``MODIN_TPU_KERNEL_ROUTER_CALIBRATION_ROWS``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import spans as graftscope
+
+#: column strategies a sort-shaped plan may carry (see plan_strategies in
+#: ops/reductions.py): "dict" costs ~0 (host categories already known),
+#: "cached" consumes an existing sorted representation, "hist" is the O(n)
+#: segment-sum path, "sort" pays the full O(n log n) device sort
+STRATEGIES = ("dict", "cached", "hist", "sort")
+
+#: predicted device-minus-host savings (seconds) the host side must clear
+#: before auto routing declines a device path: below this the decision is
+#: noise and device residency wins ties
+MIN_SAVINGS_S = 0.05
+
+_CAL_VERSION = 2
+
+_lock = threading.Lock()
+#: None = not yet resolved; False = calibration failed (route device);
+#: dict = live table
+_calibration: Any = None
+
+
+def set_calibration(table: Optional[Dict[str, float]]) -> None:
+    """Force the calibration table (tests) or reset to lazy (None)."""
+    global _calibration
+    with _lock:
+        _calibration = table if table is not None else None
+
+
+def _platform() -> str:
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- no backend at all: calibration is meaningless, the caller records a failed table and routes device
+        return "unknown"
+
+
+def _cache_path(platform: str) -> str:
+    from modin_tpu.config import CacheDir
+
+    return os.path.join(
+        CacheDir.get(), f"kernel_router_{platform}_v{_CAL_VERSION}.json"
+    )
+
+
+def _time_best(fn, reps: int = 2) -> float:
+    """Best-of wall time of ``fn()`` after one untimed warmup (compile)."""
+    fn()
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _measure() -> Dict[str, float]:
+    """Time the per-family micro-kernels at the calibration size.
+
+    Host kernels are timed in BOTH cardinality regimes: pandas'
+    hash-based nunique/mode are up to ~40x faster per row on
+    low-cardinality data (exactly the columns the device answers with a
+    histogram) than on all-distinct data (the columns that need a sort),
+    so one coefficient per op would systematically mis-predict one regime.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pandas
+
+    from modin_tpu.config import KernelRouterCalibrationRows
+
+    rows = int(KernelRouterCalibrationRows.get())
+    rng = np.random.default_rng(0)
+    wide = rng.integers(0, 1 << 40, rows)  # ~all-distinct: the sort case
+    narrow = rng.integers(0, 1024, rows)  # low-cardinality: the hist case
+
+    dev_wide = jnp.asarray(wide)
+    dev_narrow_idx = jnp.asarray(narrow.astype(np.int32))
+
+    sort_fn = jax.jit(jnp.sort)
+    consume_fn = jax.jit(
+        lambda xs: jnp.sum(
+            jnp.concatenate([jnp.ones(1, bool), xs[1:] != xs[:-1]])
+        )
+    )
+    hist_fn = jax.jit(
+        lambda idx: jnp.zeros(1025, jnp.int64).at[idx].add(1)
+    )
+
+    sorted_dev = sort_fn(dev_wide)
+    table = {
+        "version": _CAL_VERSION,
+        "platform": _platform(),
+        "rows": rows,
+        "device_sort_s": _time_best(
+            lambda: np.asarray(sort_fn(dev_wide))
+        ),
+        "device_consume_s": _time_best(
+            lambda: np.asarray(consume_fn(sorted_dev))
+        ),
+        "device_hist_s": _time_best(
+            lambda: np.asarray(hist_fn(dev_narrow_idx))
+        ),
+    }
+    for regime, values in (("high", wide), ("low", narrow)):
+        host = pandas.Series(values)
+        table[f"host_median_{regime}_s"] = _time_best(lambda: host.median())
+        table[f"host_quantile_{regime}_s"] = _time_best(
+            lambda: host.quantile(0.5)
+        )
+        table[f"host_nunique_{regime}_s"] = _time_best(lambda: host.nunique())
+        table[f"host_mode_{regime}_s"] = _time_best(lambda: host.mode())
+    return table
+
+
+def get_calibration() -> Optional[Dict[str, float]]:
+    """The calibration table: memory -> CacheDir -> one-shot measurement.
+
+    Returns None when calibration is impossible (the caller routes device,
+    the pre-router behavior); the failure is remembered so a broken
+    substrate is probed once, not per decision.
+    """
+    global _calibration
+    with _lock:
+        if _calibration is not None:
+            return _calibration if _calibration is not False else None
+        platform = _platform()
+        path = _cache_path(platform)
+        try:
+            with open(path) as f:
+                table = json.load(f)
+            if (
+                table.get("version") == _CAL_VERSION
+                and table.get("platform") == platform
+            ):
+                _calibration = table
+                return table
+        except (OSError, ValueError):
+            pass
+        try:
+            with graftscope.span(
+                "router.calibrate", layer="QUERY-COMPILER", platform=platform
+            ):
+                table = _measure()
+            emit_metric("router.calibrate", 1)
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- calibration is an optimization probe; ANY failure (no backend, OOM at micro size) must leave routing on the pre-router device default
+            _calibration = False
+            return None
+        _calibration = table
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(table, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # unwritable CacheDir: recalibrate next process
+        return table
+
+
+def predicted_costs(
+    op: str, n: int, strategies: List[str], table: Dict[str, float]
+) -> Dict[str, float]:
+    """Predicted {device_s, host_s} for ``op`` over ``n`` rows with the
+    given per-column strategies.  Linear scaling for everything except the
+    sort term, which grows n*log2(n)."""
+    cal_rows = max(int(table["rows"]), 2)
+    scale = n / cal_rows
+    logscale = (n * math.log2(max(n, 2))) / (cal_rows * math.log2(cal_rows))
+    consume = table["device_consume_s"] * scale
+    per_strategy = {
+        "dict": 0.0,
+        "cached": consume,
+        "hist": table["device_hist_s"] * scale,
+        "sort": table["device_sort_s"] * logscale + consume,
+    }
+    device_s = sum(per_strategy[s] for s in strategies)
+    # host cost is cardinality-sensitive: hist/dict columns are the
+    # low-cardinality regime pandas hashes fast, sort columns the slow one
+    host_s = sum(
+        table[
+            f"host_{op}_{'low' if s in ('hist', 'dict') else 'high'}_s"
+        ]
+        for s in strategies
+    ) * scale
+    return {"device_s": device_s, "host_s": host_s}
+
+
+def forced_host(op: str, n: int) -> bool:
+    """True when routing is forced to Host: callers check this BEFORE any
+    planning work (device materialization, the min/max histogram probe) so
+    a substrate the operator declared device-bad pays zero device
+    dispatches on the way to the pandas fallback.  Records the decision
+    like any other (empty strategy list)."""
+    from modin_tpu.config import KernelRouterMode
+
+    if KernelRouterMode.get().lower() != "host":
+        return False
+    decide(op, n, [])
+    return True
+
+
+def decide(op: str, n: int, strategies: List[str]) -> str:
+    """"device" or "host" for one sort-shaped op over ``n`` rows.
+
+    ``op`` is the host-kernel family (median / quantile / nunique / mode);
+    ``strategies`` carries one STRATEGIES entry per participating column.
+    The decision is emitted as a ``router.<op>.<choice>`` metric and a
+    ``router.decide`` span with the predicted costs.
+    """
+    from modin_tpu.config import KernelRouterMinRows, KernelRouterMode
+
+    mode = KernelRouterMode.get().lower()
+    costs: Dict[str, float] = {}
+    if mode in ("device", "host"):
+        choice, reason = mode, "forced"
+    elif n < int(KernelRouterMinRows.get()):
+        choice, reason = "device", "below_min_rows"
+    else:
+        table = get_calibration()
+        if table is None:
+            choice, reason = "device", "uncalibrated"
+        else:
+            costs = predicted_costs(op, n, strategies, table)
+            if costs["device_s"] - costs["host_s"] > MIN_SAVINGS_S:
+                choice, reason = "host", "cost_model"
+            else:
+                choice, reason = "device", "cost_model"
+    emit_metric(f"router.{op}.{choice}", 1)
+    if graftscope.TRACE_ON:
+        graftscope.finish_span(
+            graftscope.start_span(
+                "router.decide",
+                layer="QUERY-COMPILER",
+                attrs={
+                    "op": op,
+                    "n": n,
+                    "choice": choice,
+                    "reason": reason,
+                    "strategies": ",".join(strategies),
+                    **{k: round(v, 6) for k, v in costs.items()},
+                },
+            )
+        )
+    return choice

@@ -90,6 +90,55 @@ def lexsort_permutation(
     return fn(tuple(keys))
 
 
+def sorted_valid(c, n):
+    """(sorted values, n_valid): NaN/pad rows sort to the tail as +inf/max
+    surrogates so the first ``n_valid`` entries are exactly the clean data.
+
+    The shared prefix of every sort-shaped reduction (median, quantile,
+    nunique, mode) — graftsort caches its output per column
+    (ops/sorted_cache.py) so consecutive ops on one column pay one sort.
+    """
+    import jax.numpy as jnp
+
+    from modin_tpu.ops.reductions import _int_max, _valid_mask
+
+    if c.dtype == jnp.bool_:
+        c = c.astype(jnp.int8)  # XLA sort keys; 0/1 round-trips any caller
+    is_f = jnp.issubdtype(c.dtype, jnp.floating)
+    valid = _valid_mask(c, n) if c.shape[0] != n else None
+    if is_f:
+        nanm = jnp.isnan(c) if valid is None else (jnp.isnan(c) | ~valid)
+        x = jnp.where(nanm, jnp.inf, c)
+        n_valid = (n if valid is None else jnp.sum(valid)) - jnp.sum(
+            jnp.isnan(c) if valid is None else (jnp.isnan(c) & valid)
+        )
+    else:
+        x = c if valid is None else jnp.where(valid, c, _int_max(c.dtype))
+        n_valid = jnp.asarray(n, jnp.int64)
+    return jnp.sort(x), n_valid
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_sorted_valid_multi(n_cols: int, n: int):
+    import jax
+
+    def fn(cols: Tuple):
+        return tuple(sorted_valid(c, n) for c in cols)
+
+    return jax.jit(fn)
+
+
+def sorted_valid_columns(arrays: List[Any], n: int) -> List[Tuple[Any, Any]]:
+    """Batched sorted-representation build: one jit sorting every column.
+
+    Returns one (sorted values, n_valid) pair per input column; callers
+    cache the pairs on their columns via ops/sorted_cache.attach.
+    """
+    if not arrays:
+        return []
+    return list(_jit_sorted_valid_multi(len(arrays), int(n))(tuple(arrays)))
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_top_k(n: int, k: int, largest: bool, is_float: bool, is_int64: bool, is_signed: bool):
     import jax

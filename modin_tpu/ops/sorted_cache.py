@@ -1,0 +1,125 @@
+"""Per-column sorted-representation cache (graftsort).
+
+The sort-shaped reductions (median, quantile, nunique, mode) all begin with
+the same prefix: sort the column with NaN/pad rows collapsed to the tail and
+count the valid prefix (``ops/sort.py sorted_valid_columns``).  Before this
+cache, that prefix was recomputed inside every op's own jit — four ops on
+one column paid four O(n log n) sorts.  Now the first op attaches the
+``(sorted values, n_valid)`` pair to its ``DeviceColumn`` as a
+:class:`SortedRep` and every later op consumes it with an O(n) pass.
+
+Correctness contract:
+
+- **Identity**: a rep is valid only while the column still holds the exact
+  buffer it was computed from (``source_id == id(col._data)``) in the
+  current device epoch.  Every mutation of the column's buffer — spill,
+  spill-restore, lineage re-seat, lazy materialization — additionally drops
+  the rep eagerly (``DeviceColumn._invalidate_sorted``), so the identity
+  check is belt-and-braces, not the only line of defense.
+- **Memory**: the rep's device buffer is registered in the
+  ``_DeviceLedger`` (core/memory.py) like any column buffer, so admission
+  control and the OOM evict-then-retry leg can reclaim it.  "Spilling" a
+  rep just drops it — derived data needs no host copy; the next sort-shaped
+  op rebuilds it.
+- **Recovery**: after a device loss the graftguard reseat pass walks the
+  same ledger; a rep is recognized (``is_derived_cache``) and dropped
+  instead of replayed — it is disposable, never unrecoverable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from modin_tpu.logging.metrics import emit_metric
+
+
+class SortedRep:
+    """One column's cached sorted representation, device-ledger-tracked."""
+
+    __slots__ = ("_data", "n_valid", "source_id", "epoch", "_dev_key", "__weakref__")
+
+    #: recovery marker: reseat passes drop derived caches instead of
+    #: replaying lineage for them (core/execution/recovery.py)
+    is_derived_cache = True
+    is_lazy = False
+
+    def __init__(self, data: Any, n_valid: Any, source_id: int, epoch: int):
+        self._data = data
+        self.n_valid = n_valid
+        self.source_id = source_id
+        self.epoch = epoch
+        self._dev_key = None
+
+    @property
+    def raw(self) -> Any:
+        return self._data
+
+    def drop(self) -> int:
+        """Release the device buffer; returns bytes freed."""
+        if self._data is None:
+            return 0
+        from modin_tpu.core.memory import device_ledger
+
+        freed = device_ledger.deregister(self)
+        self._data = None
+        self.n_valid = None
+        return freed
+
+    def spill(self) -> int:
+        """Ledger spill protocol: derived data is dropped, not copied out."""
+        freed = self.drop()
+        if freed:
+            emit_metric("sortcache.spill", 1)
+        return freed
+
+
+def _live_rep(col: Any) -> Optional[SortedRep]:
+    rep = getattr(col, "_sorted_rep", None)
+    if rep is None or rep._data is None:
+        return None
+    from modin_tpu.core.execution import recovery
+
+    if rep.epoch != recovery.current_epoch() or rep.source_id != id(col._data):
+        invalidate(col)
+        return None
+    return rep
+
+
+def peek(col: Any) -> bool:
+    """Whether ``col`` has a live, current rep (no metrics, no LRU touch —
+    the router's planning probe)."""
+    return _live_rep(col) is not None
+
+
+def get(col: Any) -> Optional[Tuple[Any, Any]]:
+    """``(sorted values, n_valid)`` if ``col`` has a live, current rep."""
+    rep = _live_rep(col)
+    if rep is None:
+        return None
+    from modin_tpu.core.memory import device_ledger
+
+    device_ledger.touch(rep)
+    emit_metric("sortcache.hit", 1)
+    return rep._data, rep.n_valid
+
+
+def attach(col: Any, xs: Any, n_valid: Any) -> None:
+    """Cache ``(xs, n_valid)`` as ``col``'s sorted representation."""
+    from modin_tpu.core.execution import recovery
+    from modin_tpu.core.memory import device_ledger
+
+    invalidate(col)
+    rep = SortedRep(xs, n_valid, id(col._data), recovery.current_epoch())
+    device_ledger.register(rep)
+    col._sorted_rep = rep
+    emit_metric("sortcache.build", 1)
+
+
+def invalidate(col: Any) -> None:
+    """Drop ``col``'s cached rep (buffer mutation, spill, re-seat)."""
+    rep = getattr(col, "_sorted_rep", None)
+    if rep is None:
+        return
+    col._sorted_rep = None
+    if rep.drop():
+        emit_metric("sortcache.invalidate", 1)

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""bench_smoke — the check_all.sh gate that makes round-5's failure mode
+(bench.py times out under the driver and ships ZERO perf evidence)
+structurally impossible to repeat.
+
+Runs ``python bench.py`` at reduced scale in a subprocess under a HARD
+timeout and fails unless:
+
+- the process exits 0 inside the budget,
+- every expected section emitted one valid JSON line that actually ran
+  (``elapsed_s`` present — not an error, not a deadline skip: at smoke
+  scale nothing may legitimately skip),
+- the final aggregate line parses with a non-null headline ``value``.
+
+A bench that cannot finish, hangs a section, or silently drops one can
+therefore never ship again.  Reference analogue: asv smoke runs in the
+reference CI (modin .github/workflows/ci.yml).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+TIMEOUT_S = int(os.environ.get("BENCH_SMOKE_TIMEOUT_S", 600))
+
+EXPECTED_SECTIONS = (
+    "headline_axis0_plus_groupby_cold",
+    "ewm",
+    "axis1",
+    "host_udf",
+    "graftsort",
+    "recovery",
+    "shuffle_apply_virtual_mesh",
+)
+
+SMOKE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_FORCE_CPU": "1",
+    "BENCH_ROWS": "200000",
+    "BENCH_AXIS1_ROWS": "50000",
+    "BENCH_MODE1_ROWS": "20000",
+    "BENCH_UDF_ROWS": "2000",
+    "BENCH_SORT_ROWS": "120000",
+    "BENCH_RECOVERY_ROWS": "150000",
+    # the 10% lineage-overhead acceptance belongs to full-scale runs; at
+    # smoke scale the workload is ~10ms and scheduler noise alone flakes it
+    "BENCH_RECOVERY_OVERHEAD_PCT": "100",
+    "BENCH_APPLY_ROWS": "150000",
+    "BENCH_REPEATS": "1",
+    "BENCH_SECTION_TIMEOUT_S": "150",
+    "BENCH_DEADLINE": str(TIMEOUT_S - 60),
+}
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(SMOKE_ENV)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py")],
+            capture_output=True,
+            text=True,
+            timeout=TIMEOUT_S,
+            env=env,
+            cwd=repo,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench_smoke: FAIL — bench.py exceeded the {TIMEOUT_S}s hard timeout")
+        return 1
+    if proc.returncode != 0:
+        print(f"bench_smoke: FAIL — rc={proc.returncode}")
+        print(proc.stderr[-2000:])
+        return 1
+    lines = []
+    for raw in proc.stdout.splitlines():
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            lines.append(json.loads(raw))
+        except ValueError:
+            print(f"bench_smoke: FAIL — non-JSON output line: {raw[:200]}")
+            return 1
+    by_section = {d["section"]: d for d in lines if "section" in d}
+    failures = []
+    for name in EXPECTED_SECTIONS:
+        line = by_section.get(name)
+        if line is None:
+            failures.append(f"section '{name}' emitted no line")
+        elif "error" in line:
+            failures.append(f"section '{name}' errored: {line['error']}")
+        elif "skipped" in line:
+            failures.append(f"section '{name}' skipped at smoke scale: {line['skipped']}")
+        elif "elapsed_s" not in line:
+            failures.append(f"section '{name}' line carries no elapsed_s")
+    finals = [d for d in lines if "section" not in d]
+    if len(finals) != 1:
+        failures.append(f"expected exactly one aggregate line, got {len(finals)}")
+    elif finals[0].get("value") is None:
+        failures.append("aggregate line has a null headline value")
+    if failures:
+        print("bench_smoke: FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    agg = finals[0]
+    print(
+        f"bench_smoke: OK — {len(by_section)} sections, headline "
+        f"{agg['value']}s (vs_baseline {agg.get('vs_baseline')}), "
+        f"platform {agg.get('platform')}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all seven gates, fail on any red
+#   ./scripts/check_all.sh            # all eight gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -17,6 +17,10 @@
 #       mid-query DeviceLost must complete bit-exact with recovery.*
 #       metrics > 0, and a RESOURCE_EXHAUSTED burst must be absorbed by
 #       evict-then-retry without any pandas fallback
+#   0d. bench smoke: a reduced-scale `python bench.py` must exit 0 under a
+#       hard timeout with one valid JSON line per section and a parseable
+#       aggregate — a bench that cannot finish can never ship again
+#       (round-5's rc=124-with-empty-output failure mode)
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -43,6 +47,7 @@ run_gate() {
 run_gate "graftlint"       python -m modin_tpu.lint modin_tpu/
 run_gate "graftscope"      python scripts/trace_smoke.py
 run_gate "graftguard"      python scripts/chaos_smoke.py
+run_gate "bench_smoke"     python scripts/bench_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -52,4 +57,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL SEVEN GATES GREEN"
+echo "ALL EIGHT GATES GREEN"

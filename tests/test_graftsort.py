@@ -1,0 +1,403 @@
+"""graftsort acceptance suite: the shared sorted-representation cache, the
+O(n) histogram fast paths for nunique/mode, and the substrate-aware kernel
+router.
+
+Covers the PR's satellite checklist:
+
+- sorted-cache invalidation under every buffer mutation (setitem-style
+  column replacement, recovery re-seat, spill + restore, ledger spill),
+  with results staying bit-exact vs pandas after each;
+- dictionary-encoded nunique/mode parity vs pandas (NaN handling, dropna
+  both ways, multi-column mixed frames);
+- router unit tests with a FORCED calibration table asserting the
+  device/host choice flips at the predicted crossover.
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import KernelRouterMinRows, KernelRouterMode
+from modin_tpu.logging.metrics import add_metric_handler, clear_metric_handler
+from modin_tpu.ops import router, sorted_cache
+
+from tests.utils import assert_no_fallback, df_equals, eval_general
+
+
+@pytest.fixture
+def metric_log():
+    events = []
+
+    def handler(name, value):
+        events.append((name, value))
+
+    add_metric_handler(handler)
+    yield events
+    clear_metric_handler(handler)
+
+
+@pytest.fixture
+def router_auto():
+    """Pin router mode to Auto and restore afterwards."""
+    before = KernelRouterMode.get()
+    KernelRouterMode.put("Auto")
+    yield
+    KernelRouterMode.put(before)
+
+
+def _count(events, name):
+    return sum(1 for n, _ in events if n == f"modin_tpu.{name}")
+
+
+def _device_col(mdf, label):
+    frame = mdf._query_compiler._modin_frame
+    return frame.get_column(list(frame.columns).index(label))
+
+
+# --------------------------------------------------------------------- #
+# sorted-representation cache
+# --------------------------------------------------------------------- #
+
+
+class TestSortedCache:
+    def _frame(self, n=400):
+        rng = np.random.default_rng(7)
+        pdf = pandas.DataFrame(
+            {
+                "a": rng.integers(-(1 << 40), 1 << 40, n),
+                "b": np.where(
+                    rng.random(n) < 0.2, np.nan, rng.normal(size=n)
+                ),
+            }
+        )
+        mdf = pd.DataFrame(pdf)
+        mdf._query_compiler.execute()
+        return mdf, pdf
+
+    def test_one_sort_amortized_across_family(self, metric_log, router_auto):
+        mdf, pdf = self._frame()
+        got = assert_no_fallback(lambda: mdf.median())
+        df_equals(got, pdf.median())
+        builds_after_first = _count(metric_log, "sortcache.build")
+        assert builds_after_first >= 1
+        # quantile + nunique on the same columns consume the cached rep
+        got = assert_no_fallback(lambda: mdf.quantile(0.25))
+        df_equals(got, pdf.quantile(0.25))
+        got = assert_no_fallback(lambda: mdf.nunique())
+        df_equals(got, pdf.nunique())
+        assert _count(metric_log, "sortcache.build") == builds_after_first
+        assert _count(metric_log, "sortcache.hit") >= 2
+
+    def test_invalidate_on_setitem(self, router_auto):
+        mdf, pdf = self._frame()
+        assert_no_fallback(lambda: mdf.median())
+        mdf["b"] = mdf["b"] * 2.0
+        pdf["b"] = pdf["b"] * 2.0
+        # the replaced column must not serve the stale sorted rep
+        eval_general(mdf, pdf, lambda df: df.median())
+        eval_general(mdf, pdf, lambda df: df.quantile([0.1, 0.9]))
+
+    def test_invalidate_on_spill_restore(self, router_auto):
+        mdf, pdf = self._frame()
+        assert_no_fallback(lambda: mdf.median())
+        col = _device_col(mdf, "a")
+        assert sorted_cache.peek(col)
+        assert col.spill() > 0
+        assert not sorted_cache.peek(col), "spill must drop the sorted rep"
+        assert col.raw is not None  # transparent restore
+        assert not sorted_cache.peek(col), "restored buffer != cached source"
+        eval_general(mdf, pdf, lambda df: df.median())
+        eval_general(mdf, pdf, lambda df: df.nunique())
+
+    def test_invalidate_on_reseat(self, router_auto):
+        mdf, pdf = self._frame()
+        assert_no_fallback(lambda: mdf.quantile(0.5))
+        col = _device_col(mdf, "a")
+        assert sorted_cache.peek(col)
+        col.reseat_from_host()  # the recovery re-seat path
+        assert not sorted_cache.peek(col), "re-seat must drop the sorted rep"
+        eval_general(mdf, pdf, lambda df: df.quantile(0.5))
+
+    def test_device_ledger_reclaims_rep(self, router_auto):
+        from modin_tpu.core.memory import device_ledger
+
+        mdf, pdf = self._frame()
+        assert_no_fallback(lambda: mdf.median())
+        col = _device_col(mdf, "a")
+        rep = col._sorted_rep
+        assert rep is not None and rep._dev_key is not None
+        freed = rep.spill()  # what spill_lru invokes on the ledger entry
+        assert freed > 0
+        assert not sorted_cache.peek(col)
+        # rebuilt transparently on the next sort-shaped op, still exact
+        eval_general(mdf, pdf, lambda df: df.median())
+        assert sorted_cache.peek(col)
+        assert device_ledger.deregister(rep) == 0  # already deregistered
+
+    def test_recovery_pass_drops_derived_cache(self, router_auto):
+        from modin_tpu.core.execution import recovery
+
+        mdf, pdf = self._frame()
+        assert_no_fallback(lambda: mdf.median())
+        col = _device_col(mdf, "a")
+        rep = col._sorted_rep
+        assert rep is not None
+        # a reseat pass walks the device ledger: derived caches are dropped,
+        # never counted unrecoverable
+        assert recovery.recover_column(rep) is None
+        assert rep._data is None
+        assert not sorted_cache.peek(col)
+        eval_general(mdf, pdf, lambda df: df.median())
+
+
+# --------------------------------------------------------------------- #
+# O(n) histogram fast paths
+# --------------------------------------------------------------------- #
+
+
+class TestHistogramPaths:
+    def test_bounded_int_nunique_mode_parity(self, router_auto):
+        rng = np.random.default_rng(3)
+        pdf = pandas.DataFrame(
+            {
+                "x": rng.integers(0, 50, 500),
+                "y": rng.integers(-20, 5, 500),
+                "z": rng.random(500) < 0.5,
+            }
+        )
+        mdf = pd.DataFrame(pdf)
+        for dropna in (True, False):
+            got = assert_no_fallback(lambda d=dropna: mdf.nunique(dropna=d))
+            df_equals(got, pdf.nunique(dropna=dropna))
+        got = assert_no_fallback(lambda: mdf.mode())
+        df_equals(got, pdf.mode())
+        got = assert_no_fallback(lambda: mdf.mode(dropna=False))
+        df_equals(got, pdf.mode(dropna=False))
+
+    def test_mode_k_bound_dead_on_hist_path(self, router_auto):
+        # 2000 distinct values, each once: every value is modal.  The sorted
+        # kernel's k_bound=1024 cap would decline this; the histogram path
+        # has no cap — the op must stay on device and match pandas exactly.
+        values = np.arange(2000, dtype=np.int64)
+        rng = np.random.default_rng(0)
+        rng.shuffle(values)
+        pdf = pandas.DataFrame({"v": values})
+        mdf = pd.DataFrame(pdf)
+        got = assert_no_fallback(lambda: mdf.mode())
+        df_equals(got, pdf.mode())
+
+    def test_wide_range_int_keeps_sorted_path(self, router_auto):
+        # range >> HIST_BOUND: planner must fall back to the sort strategy
+        rng = np.random.default_rng(4)
+        pdf = pandas.DataFrame({"w": rng.integers(0, 1 << 50, 300)})
+        mdf = pd.DataFrame(pdf)
+        got = assert_no_fallback(lambda: mdf.nunique())
+        df_equals(got, pdf.nunique())
+        got = assert_no_fallback(lambda: mdf.mode())
+        df_equals(got, pdf.mode())
+
+
+# --------------------------------------------------------------------- #
+# dictionary-encoded nunique / mode
+# --------------------------------------------------------------------- #
+
+
+class TestDictEncoded:
+    def _frames(self):
+        pdf = pandas.DataFrame(
+            {
+                "city": ["lima", "oslo", None, "lima", "oslo", "lima", None],
+                "tag": ["b", "a", "a", "b", None, "a", "b"],
+                "n": np.array([3, 1, 1, 3, 2, 1, 3], np.int64),
+            }
+        )
+        mdf = pd.DataFrame(pdf)
+        return mdf, pdf
+
+    def test_nunique_dropna_both_ways(self, router_auto):
+        mdf, pdf = self._frames()
+        for dropna in (True, False):
+            got = assert_no_fallback(lambda d=dropna: mdf.nunique(dropna=d))
+            df_equals(got, pdf.nunique(dropna=dropna))
+
+    def test_mode_multi_column_mixed(self, router_auto):
+        mdf, pdf = self._frames()
+        got = assert_no_fallback(lambda: mdf.mode())
+        df_equals(got, pdf.mode())
+
+    def test_mode_dropna_false_nan_ties(self, router_auto):
+        # NaN count ties the max: pandas keeps NaN in the result, sorted
+        # last.  2x lima, 2x None, 1x oslo -> modes [lima, NaN].
+        pdf = pandas.DataFrame(
+            {"c": ["lima", None, "oslo", "lima", None]}
+        )
+        mdf = pd.DataFrame(pdf)
+        got = assert_no_fallback(lambda: mdf.mode(dropna=False))
+        df_equals(got, pdf.mode(dropna=False))
+        got = assert_no_fallback(lambda: mdf.mode(dropna=True))
+        df_equals(got, pdf.mode(dropna=True))
+
+    def test_mode_string_only_frame(self, router_auto):
+        pdf = pandas.DataFrame(
+            {
+                "a": ["x", "y", "x", "z", "y", "x"],
+                "b": ["q", "q", "r", "r", "q", "r"],
+            }
+        )
+        mdf = pd.DataFrame(pdf)
+        got = assert_no_fallback(lambda: mdf.mode())
+        df_equals(got, pdf.mode())
+        # ragged mode counts across columns: concat NaN-pads like pandas
+        pdf2 = pandas.DataFrame(
+            {"a": ["x", "x", "y"], "b": ["p", "q", "r"]}
+        )
+        mdf2 = pd.DataFrame(pdf2)
+        got = assert_no_fallback(lambda: mdf2.mode())
+        df_equals(got, pdf2.mode())
+
+
+# --------------------------------------------------------------------- #
+# kernel router
+# --------------------------------------------------------------------- #
+
+
+#: forced calibration: device sort is 100x slower per row than any host
+#: kernel, histogram 10x faster — crossovers land where arithmetic says
+_FORCED_TABLE = {
+    "version": router._CAL_VERSION,
+    "platform": "test",
+    "rows": 1000,
+    "device_sort_s": 1.0,
+    "device_consume_s": 0.001,
+    "device_hist_s": 0.0001,
+    "host_median_high_s": 0.01,
+    "host_median_low_s": 0.01,
+    "host_quantile_high_s": 0.01,
+    "host_quantile_low_s": 0.01,
+    "host_nunique_high_s": 0.01,
+    "host_nunique_low_s": 0.001,
+    "host_mode_high_s": 0.01,
+    "host_mode_low_s": 0.001,
+}
+
+
+class TestKernelRouter:
+    @pytest.fixture(autouse=True)
+    def _forced_calibration(self):
+        min_rows_before = KernelRouterMinRows.get()
+        mode_before = KernelRouterMode.get()
+        router.set_calibration(dict(_FORCED_TABLE))
+        KernelRouterMode.put("Auto")
+        yield
+        router.set_calibration(None)
+        KernelRouterMinRows.put(min_rows_before)
+        KernelRouterMode.put(mode_before)
+
+    def test_choice_flips_at_crossover(self):
+        KernelRouterMinRows.put(1)
+        # device sort costs ~1s/1000 rows vs host median 0.01s/1000 rows:
+        # host wins once the absolute gap clears MIN_SAVINGS_S
+        assert router.decide("median", 10, ["sort"]) == "device"  # gap tiny
+        assert router.decide("median", 100_000, ["sort"]) == "host"
+        # histogram strategy: device is 10x cheaper than even the fast
+        # low-cardinality host kernel — device keeps it at any size
+        assert router.decide("nunique", 10_000_000, ["hist"]) == "device"
+        # a cached rep turns the sort into a consume: device wins
+        assert router.decide("median", 100_000, ["cached"]) == "device"
+        # dict columns are free on device
+        assert router.decide("nunique", 10_000_000, ["dict"]) == "device"
+
+    def test_min_rows_short_circuits(self):
+        KernelRouterMinRows.put(1_000_000)
+        # below the floor the decision is device even where the model
+        # would say host (and no calibration would ever be consulted)
+        assert router.decide("median", 100_000, ["sort"]) == "device"
+
+    def test_forced_modes_override_model(self):
+        KernelRouterMinRows.put(1)
+        KernelRouterMode.put("Host")
+        assert router.decide("nunique", 10, ["hist"]) == "host"
+        KernelRouterMode.put("Device")
+        assert router.decide("median", 100_000_000, ["sort"]) == "device"
+
+    def test_uncalibrated_routes_device(self):
+        KernelRouterMinRows.put(1)
+        router.set_calibration(None)
+        router._calibration = False  # remembered calibration failure
+        try:
+            assert router.decide("median", 100_000_000, ["sort"]) == "device"
+        finally:
+            router.set_calibration(dict(_FORCED_TABLE))
+
+    def test_decision_metrics_emitted(self, metric_log):
+        KernelRouterMinRows.put(1)
+        router.decide("median", 100_000, ["sort"])
+        assert _count(metric_log, "router.median.host") == 1
+        router.decide("median", 100_000, ["cached"])
+        assert _count(metric_log, "router.median.device") == 1
+
+    def test_forced_host_skips_planning_probe(self, monkeypatch):
+        # Host-forced routing must decline BEFORE any device work: if the
+        # planner (device materialize + min/max range probe) ran, this
+        # poisoned stand-in would raise
+        from modin_tpu.ops import reductions
+
+        KernelRouterMode.put("Host")
+
+        def boom(*a, **k):
+            raise AssertionError("planner ran under forced-Host routing")
+
+        monkeypatch.setattr(reductions, "plan_sort_reduce", boom)
+        rng = np.random.default_rng(5)
+        pdf = pandas.DataFrame({"v": rng.integers(0, 9, 64)})
+        mdf = pd.DataFrame(pdf)
+        eval_general(mdf, pdf, lambda df: df.nunique())
+        eval_general(mdf, pdf, lambda df: df.mode())
+
+    def test_forced_host_gates_describe(self, metric_log):
+        # describe's quantile leg is sort-shaped: the router verdict that
+        # gates quantile() must gate it too
+        KernelRouterMode.put("Host")
+        rng = np.random.default_rng(6)
+        pdf = pandas.DataFrame({"v": rng.normal(size=128)})
+        mdf = pd.DataFrame(pdf)
+        eval_general(mdf, pdf, lambda df: df.describe())
+        assert _count(metric_log, "router.quantile.host") >= 1
+        assert _count(metric_log, "sortcache.build") == 0
+
+    def test_forced_host_end_to_end_stays_exact(self):
+        # Host-forced routing must decline every sort-shaped device path
+        # and still produce pandas-exact answers through the fallback
+        KernelRouterMode.put("Host")
+        rng = np.random.default_rng(11)
+        pdf = pandas.DataFrame({"v": rng.integers(0, 30, 200)})
+        mdf = pd.DataFrame(pdf)
+        eval_general(mdf, pdf, lambda df: df.median())
+        eval_general(mdf, pdf, lambda df: df.nunique())
+        eval_general(mdf, pdf, lambda df: df.mode())
+        eval_general(mdf, pdf, lambda df: df.quantile(0.75))
+
+
+# --------------------------------------------------------------------- #
+# median over the sorted rep: skipna semantics
+# --------------------------------------------------------------------- #
+
+
+class TestMedianSorted:
+    def test_median_skipna_false_with_nan(self, router_auto):
+        pdf = pandas.DataFrame(
+            {
+                "a": [1.0, np.nan, 3.0, 5.0],
+                "b": [2.0, 4.0, 6.0, 8.0],
+            }
+        )
+        mdf = pd.DataFrame(pdf)
+        eval_general(mdf, pdf, lambda df: df.median(skipna=False))
+        eval_general(mdf, pdf, lambda df: df.median(skipna=True))
+
+    def test_median_int_exact(self, router_auto):
+        pdf = pandas.DataFrame({"a": np.array([5, 1, 9, 3], np.int64)})
+        mdf = pd.DataFrame(pdf)
+        got = assert_no_fallback(lambda: mdf.median())
+        df_equals(got, pdf.median())
