@@ -612,6 +612,88 @@ def main() -> None:
         }
         return sections["graftsort"]
 
+    # ---- graftplan: whole-query deferred planning vs eager ---- #
+    def graftplan_section():
+        """The acceptance pipeline read_csv(...).query(...)[cols].agg(...)
+        planned (MODIN_TPU_PLAN=Auto: deferred scan, projection pushed into
+        the reader, <= 2 device dispatches) vs eager (Plan=Off: full-width
+        parse, one dispatch per op) vs plain pandas, plus the compile-ledger
+        dispatch counts for both modes."""
+        import tempfile as _tempfile
+
+        from modin_tpu.config import PlanMode, TraceEnabled
+        from modin_tpu.observability.compile_ledger import get_compile_ledger
+
+        n = int(os.environ.get("BENCH_PLAN_ROWS", 2_000_000))
+        csv_path = os.path.join(
+            _tempfile.mkdtemp(prefix="graftplan_bench_"), "plan.csv"
+        )
+        pandas.DataFrame(
+            {
+                "a": rng.integers(-50, 50, n),
+                "b": rng.uniform(0, 1, n),
+                "c": rng.uniform(-1, 1, n),
+                "d": rng.integers(0, 1000, n),
+                "e": rng.uniform(0, 100, n),
+                "f": rng.integers(0, 2, n),
+            }
+        ).to_csv(csv_path, index=False)
+
+        def pipeline_modin():
+            out = pd.read_csv(csv_path).query("a > 0")[["b", "c"]].agg("sum")
+            execute_modin(out)
+
+        ledger = get_compile_ledger()
+        mode_before = PlanMode.get()
+        trace_before = TraceEnabled.get()
+        timings = {}
+        dispatch_counts = {}
+        TraceEnabled.put(True)  # dispatch billing needs the ledger listener
+        try:
+            for mode in ("Off", "Auto"):
+                PlanMode.put(mode)
+                pipeline_modin()  # warm compiles outside the timer
+                best = float("inf")
+                for _ in range(max(repeats, 2)):
+                    ledger.reset()
+                    t0 = time.perf_counter()
+                    pipeline_modin()
+                    best = min(best, time.perf_counter() - t0)
+                snap = ledger.snapshot()
+                dispatch_counts[mode] = sum(
+                    e["dispatches"] for e in snap["signatures"].values()
+                )
+                timings[mode] = best
+        finally:
+            PlanMode.put(mode_before)
+            TraceEnabled.put(trace_before)
+
+        best_pandas = float("inf")
+        for _ in range(max(repeats, 2)):
+            t0 = time.perf_counter()
+            pandas.read_csv(csv_path).query("a > 0")[["b", "c"]].agg("sum")
+            best_pandas = min(best_pandas, time.perf_counter() - t0)
+
+        import shutil
+
+        shutil.rmtree(os.path.dirname(csv_path), ignore_errors=True)
+        sections["graftplan"] = {
+            "rows": n,
+            "planned_s": round(timings["Auto"], 4),
+            "eager_s": round(timings["Off"], 4),
+            "pandas_s": round(best_pandas, 4),
+            "planned_vs_eager_x": round(
+                timings["Off"] / max(timings["Auto"], 1e-9), 2
+            ),
+            "speedup_vs_pandas": round(
+                best_pandas / max(timings["Auto"], 1e-9), 2
+            ),
+            "dispatches_planned": dispatch_counts["Auto"],
+            "dispatches_eager": dispatch_counts["Off"],
+            "dispatch_budget_ok": dispatch_counts["Auto"] <= 2,
+        }
+        return sections["graftplan"]
+
     # ---- graftguard: lineage overhead + spill/restore throughput ---- #
     def recovery_section():
         """Steady-state cost of lineage recording (must be ~0: no failure
@@ -698,6 +780,7 @@ def main() -> None:
         ("axis1", axis1_section),
         ("host_udf", host_udf_section),
         ("graftsort", graftsort_section),
+        ("graftplan", graftplan_section),
         ("recovery", recovery_section),
         ("shuffle_apply_virtual_mesh", shuffle_apply),
     ]

@@ -713,6 +713,62 @@ class KernelRouterCalibrationRows(EnvironmentVariable, type=int):
         super().put(value)
 
 
+class PlanMode(EnvironmentVariable, type=str):
+    """graftplan whole-query deferred planning.
+
+    Auto (default): supported reads (local plain-file read_csv/read_table)
+    defer into a logical plan; chained plan-capable calls (project / filter /
+    elementwise map / reduce / groupby_agg / sort) extend the plan, and any
+    materialization point (repr, to_pandas, index access, an op with no plan
+    node) optimizes the plan (dead-column pruning, projection pushdown into
+    the byte-range readers, filter pushdown, CSE, map->reduce fusion) and
+    lowers it through the eager seams.  Off: never defer — today's eager
+    behavior exactly.  Force: Auto plus re-entering planning for
+    plan-capable calls on already-materialized TPU frames (Source-rooted
+    plans), so rewrites keep applying after materialization points.
+    """
+
+    varname = "MODIN_TPU_PLAN"
+    choices = ("Auto", "Off", "Force")
+    default = "Auto"
+
+
+class PlanMaxPasses(EnvironmentVariable, type=int):
+    """Rewrite-pass budget for graftplan's fixpoint rule engine: each pass
+    applies the whole rule catalog once, and optimization stops at fixpoint
+    or after this many passes — a misbehaving rule cannot wedge a query."""
+
+    varname = "MODIN_TPU_PLAN_MAX_PASSES"
+    default = 8
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Plan pass budget should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class FusedCacheSize(EnvironmentVariable, type=int):
+    """Bound on the fused-executable cache in ops/lazy.py (entries, LRU).
+
+    Each entry pins a jitted XLA executable; long sessions with varying
+    expression shapes previously grew the cache without limit.  0 disables
+    the bound (the pre-LRU behavior)."""
+
+    varname = "MODIN_TPU_FUSED_CACHE_SIZE"
+    default = 256
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Fused cache size should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

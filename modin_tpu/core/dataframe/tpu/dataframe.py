@@ -614,7 +614,8 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
         from modin_tpu.ops.structural import compact_rows
         from modin_tpu.parallel.engine import JaxWrapper
 
-        from modin_tpu.ops.structural import pad_len, trim_columns
+        from modin_tpu.ops.lazy import lazy_op
+        from modin_tpu.ops.structural import pad_len
 
         device_idx = [i for i, c in enumerate(self._columns) if c.is_device]
         datas, count, perm = compact_rows(
@@ -622,8 +623,16 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
         )
         n_out = int(JaxWrapper.materialize(count))
         # restore the padded-column invariant (physical size = pad_len(n)):
-        # compaction kept the input's physical size, so trim to the output's
-        datas = trim_columns(datas, pad_len(n_out))
+        # compaction kept the input's physical size, so trim to the output's.
+        # The trim stays DEFERRED (one LazyExpr node per column): a consuming
+        # reduction fuses it into its own program, so a filter->agg pipeline
+        # costs two dispatches total (compact, fused trim+reduce) instead of
+        # three; any other consumer batch-materializes the trims in one jit.
+        p_out = pad_len(n_out)
+        if datas and datas[0].shape[0] != p_out:
+            datas = [
+                lazy_op("trim", d, static=(("p_out", int(p_out)),)) for d in datas
+            ]
         new_columns: List[Column] = list(self._columns)
         for i, d in zip(device_idx, datas):
             col = self._columns[i]

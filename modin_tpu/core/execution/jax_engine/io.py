@@ -75,10 +75,23 @@ class TpuOnJaxIO(BaseIO):
 
     @classmethod
     def read_csv(cls, **kwargs: Any):
+        # graftplan: a deferrable read becomes a Scan-rooted plan; the file
+        # is parsed at the first materialization point, with any projection
+        # the rewrite rules pushed down merged into the reader kwargs
+        from modin_tpu.plan import runtime as graftplan
+
+        deferred = graftplan.defer_read(TpuCSVDispatcher, kwargs)
+        if deferred is not None:
+            return deferred
         return TpuCSVDispatcher.read(**kwargs)
 
     @classmethod
     def read_table(cls, **kwargs: Any):
+        from modin_tpu.plan import runtime as graftplan
+
+        deferred = graftplan.defer_read(TpuTableDispatcher, kwargs)
+        if deferred is not None:
+            return deferred
         return TpuTableDispatcher.read(**kwargs)
 
     @classmethod

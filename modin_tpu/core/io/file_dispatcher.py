@@ -160,6 +160,13 @@ class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
         return file_path
 
     @classmethod
+    def normalize_read_kwargs(cls, kwargs: dict) -> dict:
+        """Canonicalize reader kwargs (e.g. default separators) so the
+        eager read and graftplan's deferred Scan agree on one source of
+        truth.  Subclasses override; the base is the identity."""
+        return kwargs
+
+    @classmethod
     def is_local_plain_file(cls, path: Any) -> bool:
         """Whether the path is a plain local uncompressed file we can mmap."""
         if not isinstance(path, (str, os.PathLike)):

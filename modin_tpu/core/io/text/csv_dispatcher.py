@@ -223,7 +223,13 @@ class TableDispatcher(CSVDispatcher):
     """read_table: CSV with tab separator default."""
 
     @classmethod
-    def _read(cls, filepath_or_buffer: Any = None, **kwargs: Any):
+    def normalize_read_kwargs(cls, kwargs: dict) -> dict:
         if kwargs.get("sep") in (None, pandas.api.extensions.no_default):
-            kwargs["sep"] = "\t"
-        return super()._read(filepath_or_buffer, **kwargs)
+            kwargs = {**kwargs, "sep": "\t"}
+        return kwargs
+
+    @classmethod
+    def _read(cls, filepath_or_buffer: Any = None, **kwargs: Any):
+        return super()._read(
+            filepath_or_buffer, **cls.normalize_read_kwargs(kwargs)
+        )

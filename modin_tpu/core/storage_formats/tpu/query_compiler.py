@@ -46,18 +46,60 @@ _SHUFFLE_APPLY_MIN_ROWS = 1 << 19
 
 
 from modin_tpu.parallel.engine import materialize as _engine_materialize
+from modin_tpu.plan import explain as graftplan_explain
+from modin_tpu.plan import runtime as graftplan
 
 
 class TpuQueryCompiler(BaseQueryCompiler):
-    """Query compiler over a TpuDataframe (sharded jax.Array columns)."""
+    """Query compiler over a TpuDataframe (sharded jax.Array columns).
+
+    graftplan deferred mode: a compiler built by :meth:`from_plan` carries a
+    pending logical plan (``_plan``) instead of a frame.  Plan-capable
+    methods carry a one-line guard that extends the plan; every other method
+    reaches ``_modin_frame``, whose property getter materializes the plan
+    (optimize + lower through the eager seams) on first touch — so "any op
+    with no plan node" is a materialization point by construction, and
+    ``MODIN_TPU_PLAN=Off`` (no plans ever built) is bit-for-bit today's
+    eager behavior.
+    """
 
     storage_format = property(lambda self: "Tpu")
     engine = property(lambda self: "Jax")
 
     def __init__(self, frame: TpuDataframe, shape_hint: Optional[str] = None):
         assert isinstance(frame, TpuDataframe), type(frame)
-        self._modin_frame = frame
+        self._frame = frame
+        self._plan = None
         self._shape_hint = shape_hint
+
+    @classmethod
+    def from_plan(cls, plan: Any, shape_hint: Optional[str] = None) -> "TpuQueryCompiler":
+        """Build a deferred compiler over a pending graftplan node."""
+        self = cls.__new__(cls)
+        self._frame = None
+        self._plan = plan
+        self._shape_hint = shape_hint
+        return self
+
+    @property
+    def _modin_frame(self) -> TpuDataframe:
+        frame = self._frame
+        if frame is None:
+            frame = graftplan.force(self)
+        return frame
+
+    @_modin_frame.setter
+    def _modin_frame(self, frame: TpuDataframe) -> None:
+        self._frame = frame
+        self._plan = None
+
+    def eager_snapshot(self) -> "TpuQueryCompiler":
+        """An eager compiler over this one's (materialized) frame."""
+        return TpuQueryCompiler(self._modin_frame, self._shape_hint)
+
+    def explain(self) -> str:
+        """graftplan EXPLAIN: the logical plan before/after rewrite."""
+        return graftplan_explain.explain_qc(self)
 
     # ------------------------------------------------------------------ #
     # Data exchange
@@ -89,9 +131,19 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
 
     def copy(self) -> "TpuQueryCompiler":
+        if self._plan is not None:
+            # plans are immutable; a copy shares the pending plan
+            return type(self).from_plan(self._plan, self._shape_hint)
         return type(self)(self._modin_frame.copy(), self._shape_hint)
 
     def free(self) -> None:
+        if self._plan is not None:
+            # drop the plan: a Source leaf (Force mode / defer_frame) holds
+            # an eager snapshot sharing the original frame's live buffers —
+            # those must not be freed here, only dereferenced — and scan-
+            # level lowered-read caches release with the node graph
+            self._plan = None
+            return
         self._modin_frame.free()
 
     def finalize(self) -> None:
@@ -118,6 +170,8 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return self._modin_frame.index
 
     def get_columns(self) -> pandas.Index:
+        if self._plan is not None:
+            return graftplan.plan_columns(self)
         return self._modin_frame.columns
 
     def _set_index(self, value: Any) -> None:
@@ -133,9 +187,15 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     @property
     def dtypes(self) -> pandas.Series:
+        if self._plan is not None:
+            known = graftplan.plan_dtypes(self)
+            if known is not None:
+                return known
         return self._modin_frame.dtypes
 
     def get_axis_len(self, axis: int) -> int:
+        if axis and self._plan is not None:
+            return len(graftplan.plan_columns(self))
         return self._modin_frame.num_cols if axis else len(self._modin_frame)
 
     # ------------------------------------------------------------------ #
@@ -179,6 +239,10 @@ class TpuQueryCompiler(BaseQueryCompiler):
     # ------------------------------------------------------------------ #
 
     def getitem_column_array(self, key: Any, numeric: bool = False, ignore_order: bool = False) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_project(self, key, numeric)
+            if planned is not None:
+                return planned
         frame = self._modin_frame
         if numeric:
             positions = [int(k) for k in key]
@@ -221,6 +285,13 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return type(self)(frame)
 
     def getitem_array(self, key: Any) -> "TpuQueryCompiler":
+        if (
+            (self._plan is not None or graftplan.FORCE_ON)
+            and isinstance(key, TpuQueryCompiler)
+        ):
+            planned = graftplan.defer_filter(self, key)
+            if planned is not None:
+                return planned
         if isinstance(key, TpuQueryCompiler):
             mask_frame = key._modin_frame
             if (
@@ -409,6 +480,13 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return super().concat(axis, other, join=join, ignore_index=ignore_index, sort=sort, **kwargs)
 
     def columnarize(self) -> "TpuQueryCompiler":
+        if self._plan is not None and len(self.get_columns()) == 1:
+            # reduce results (the 1-row unnamed-series transpose case) are
+            # always materialized, so a pending single-column plan only needs
+            # the Series tag
+            result = self.copy()
+            result._shape_hint = "column"
+            return result
         result = super().columnarize()
         return result
 
@@ -695,6 +773,10 @@ class TpuQueryCompiler(BaseQueryCompiler):
     )
 
     def unary_math(self, op_name: str) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_unary(self, "unary_math", (op_name,))
+            if planned is not None:
+                return planned
         from modin_tpu.ops import elementwise
 
         if op_name in self._MATH_UNARY:
@@ -710,6 +792,10 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return super().unary_math(op_name)
 
     def abs(self) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_unary(self, "abs")
+            if planned is not None:
+                return planned
         from modin_tpu.ops import elementwise
 
         result = self._map_device_host(
@@ -720,6 +806,10 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return result if result is not None else super().abs()
 
     def negative(self) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_unary(self, "negative")
+            if planned is not None:
+                return planned
         from modin_tpu.ops import elementwise
 
         result = self._map_device_host(
@@ -730,6 +820,10 @@ class TpuQueryCompiler(BaseQueryCompiler):
         return result if result is not None else super().negative()
 
     def invert(self) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_unary(self, "invert")
+            if planned is not None:
+                return planned
         from modin_tpu.ops import elementwise
 
         result = self._map_device_host(
@@ -759,14 +853,30 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
 
     def isna(self) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_unary(self, "isna", bool_out=True)
+            if planned is not None:
+                return planned
         result = self._isna_like(negate=False)
         return result if result is not None else super().isna()
 
     def notna(self) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_unary(self, "notna", bool_out=True)
+            if planned is not None:
+                return planned
         result = self._isna_like(negate=True)
         return result if result is not None else super().notna()
 
     def round(self, decimals: int = 0, **kwargs: Any) -> "TpuQueryCompiler":
+        if (self._plan is not None or graftplan.FORCE_ON) and isinstance(
+            decimals, int
+        ):
+            planned = graftplan.defer_unary(
+                self, "round", (), dict(decimals=decimals, **kwargs)
+            )
+            if planned is not None:
+                return planned
         from modin_tpu.ops import elementwise
 
         if not isinstance(decimals, (int, np.integer)):
@@ -3431,6 +3541,24 @@ class TpuQueryCompiler(BaseQueryCompiler):
         series_groupby: bool = False,
         selection: Any = None,
     ) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.run_groupby_agg(
+                self,
+                by,
+                agg_func,
+                dict(
+                    axis=axis,
+                    groupby_kwargs=groupby_kwargs,
+                    agg_args=agg_args,
+                    agg_kwargs=agg_kwargs,
+                    how=how,
+                    drop=drop,
+                    series_groupby=series_groupby,
+                    selection=selection,
+                ),
+            )
+            if planned is not None:
+                return planned
         result = self._try_device_groupby(
             by, agg_func, axis, groupby_kwargs or {}, agg_args, agg_kwargs or {},
             drop, series_groupby, selection,
@@ -4446,6 +4574,10 @@ class TpuQueryCompiler(BaseQueryCompiler):
         )
 
     def sort_rows_by_column_values(self, columns: Any, ascending: Any = True, **kwargs: Any) -> "TpuQueryCompiler":
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_sort(self, columns, ascending, kwargs)
+            if planned is not None:
+                return planned
         from modin_tpu.ops import sort as sort_ops
 
         range_result = self._try_range_partition_sort(columns, ascending, kwargs)
@@ -4540,6 +4672,10 @@ def _make_binary_override(op: str):
     base_method = getattr(BaseQueryCompiler, op)
 
     def method(self: TpuQueryCompiler, other: Any, **kwargs: Any):
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.defer_binary(self, op, other, kwargs)
+            if planned is not None:
+                return planned
         result = self._try_device_binary(op, other, kwargs)
         if result is not None:
             return result
@@ -4568,6 +4704,14 @@ def _make_reduce_override(op: str):
         numeric_only: bool = False,
         **kwargs: Any,
     ):
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.run_reduce(
+                self,
+                op,
+                dict(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs),
+            )
+            if planned is not None:
+                return planned
         result = self._try_device_reduce(op, axis, skipna, numeric_only, kwargs)
         if result is not None:
             return result
@@ -4589,6 +4733,14 @@ def _make_nonskipna_reduce_override(op: str):
     def method(self: TpuQueryCompiler, axis: Any = 0, **kwargs: Any):
         skipna = kwargs.pop("skipna", True)
         numeric_only = kwargs.pop("numeric_only", False)
+        if self._plan is not None or graftplan.FORCE_ON:
+            planned = graftplan.run_reduce(
+                self,
+                op,
+                dict(axis=axis, skipna=skipna, numeric_only=numeric_only, **kwargs),
+            )
+            if planned is not None:
+                return planned
         result = self._try_device_reduce(op, axis, skipna, numeric_only, kwargs)
         if result is not None:
             return result

@@ -107,6 +107,38 @@ METRICS = (
         "device-memory ledger reclaimed it under pressure)",
     ),
     (
+        "plan.defer.scan",
+        "reads deferred into graftplan Scan-rooted logical plans instead "
+        "of parsing at the call site",
+    ),
+    (
+        "plan.optimize.passes",
+        "rewrite passes run to fixpoint (bounded by "
+        "MODIN_TPU_PLAN_MAX_PASSES) per plan materialization",
+    ),
+    (
+        "plan.rule.*",
+        "graftplan rewrite-rule applications per rule (pushdown-filter / "
+        "cse / prune-columns / pushdown-project-into-scan / "
+        "fuse-map-reduce)",
+    ),
+    (
+        "plan.lower.nodes",
+        "distinct plan nodes lowered per materialization (shared subtrees "
+        "count once — the one-scan guarantee is this number)",
+    ),
+    (
+        "plan.scan.pruned_columns",
+        "columns never parsed because projection pushdown narrowed the "
+        "reader (per physical pruned read; scans served from a prior "
+        "materialization's cache emit nothing)",
+    ),
+    (
+        "fusion.cache.evict",
+        "fused-executable LRU evictions under MODIN_TPU_FUSED_CACHE_SIZE "
+        "(ops/lazy.py)",
+    ),
+    (
         "pandas-api.*",
         "wall-clock seconds per public pandas-API call (logging layer)",
     ),

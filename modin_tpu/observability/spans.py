@@ -109,6 +109,18 @@ SPANS = (
         "rest of the sort-shaped family amortizes); column count in "
         "attributes",
     ),
+    (
+        "plan.optimize",
+        "one graftplan rewrite pass to fixpoint over a pending logical "
+        "plan (node count in attributes; applied rules become plan.rule.* "
+        "metrics)",
+    ),
+    (
+        "plan.lower",
+        "one graftplan lowering pass: optimized plan nodes replayed "
+        "through the eager dispatcher / query-compiler / engine seams "
+        "(node count in attributes)",
+    ),
 )
 
 _EPOCH_PERF = time.perf_counter()

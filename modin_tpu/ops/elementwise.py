@@ -23,6 +23,16 @@ from typing import Any, Callable, List, Optional, Tuple
 import numpy as np
 
 
+def _trim(x, p_out):
+    """Slice a padded column down to a smaller padded size, keeping the
+    rows axis sharded (a bare slice can come back replicated)."""
+    import jax
+
+    from modin_tpu.parallel.mesh import row_sharding
+
+    return jax.lax.with_sharding_constraint(x[:p_out], row_sharding())
+
+
 def _floordiv(x, y):
     import jax.numpy as jnp
 
@@ -109,6 +119,9 @@ def _build_ops() -> dict:
         "cumprod": lambda x: _nan_skipping_cum(x, jnp.cumprod, 1),
         "cummax": lambda x: _nan_skipping_cum(x, jax_lax_cummax, -jnp.inf),
         "cummin": lambda x: _nan_skipping_cum(x, jax_lax_cummin, jnp.inf),
+        # physical resize to the padded-output invariant after a device
+        # compaction (ops/structural.py); p_out is compiled into the program
+        "trim": _trim,
         "round": lambda x, decimals: (
             jnp.round(x, decimals) if jnp.issubdtype(x.dtype, jnp.floating) else x
         ),

@@ -29,6 +29,7 @@ Design notes:
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -37,8 +38,47 @@ _MAX_NODES = 160
 
 _SCALAR_TYPES = (int, float, bool, np.integer, np.floating, np.bool_)
 
-# fingerprint -> jitted executable
-_FUSED_CACHE: Dict[Any, Any] = {}
+# fingerprint -> jitted executable, LRU-bounded by MODIN_TPU_FUSED_CACHE_SIZE
+# (each entry pins an XLA executable; a long session with varying expression
+# shapes previously grew this without limit)
+_FUSED_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
+_evictions = 0
+
+
+def _fused_cache_get(key: Any) -> Optional[Any]:
+    fn = _FUSED_CACHE.get(key)
+    if fn is not None:
+        _FUSED_CACHE.move_to_end(key)
+    return fn
+
+
+def _fused_cache_put(key: Any, fn: Any) -> None:
+    global _evictions
+    from modin_tpu.config import FusedCacheSize
+
+    _FUSED_CACHE[key] = fn
+    _FUSED_CACHE.move_to_end(key)
+    limit = FusedCacheSize.get()
+    if limit <= 0:
+        return
+    evicted = 0
+    while len(_FUSED_CACHE) > limit:
+        _FUSED_CACHE.popitem(last=False)
+        evicted += 1
+    if evicted:
+        _evictions += evicted
+        from modin_tpu.logging.metrics import emit_metric
+
+        emit_metric("fusion.cache.evict", evicted)
+
+
+def fused_cache_evictions() -> int:
+    """Process-lifetime count of fused executables evicted by the LRU."""
+    return _evictions
+
+
+def fused_cache_len() -> int:
+    return len(_FUSED_CACHE)
 
 
 class LazyExpr:
@@ -202,7 +242,7 @@ def run_fused(
 
     nodes, out_refs, leaves, scalars, fingerprint = _linearize(roots)
     key = (fingerprint, tail_key)
-    fn = _FUSED_CACHE.get(key)
+    fn = _fused_cache_get(key)
     if fn is None:
         from modin_tpu.ops.elementwise import get_op
 
@@ -225,7 +265,7 @@ def run_fused(
             return tail_builder(outs) if tail_builder is not None else tuple(outs)
 
         fn = jax.jit(execute)
-        _FUSED_CACHE[key] = fn
+        _fused_cache_put(key, fn)
 
     # dispatch through the engine seam: the fused call gets the resilience
     # policy (classify/retry/recovery) and op-replay lineage provenance

@@ -91,34 +91,6 @@ def compact_rows(cols: List[Any], mask: Any, n: int) -> Tuple[List[Any], Any, An
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _jit_trim(n_cols: int, p_out: int):
-    """Slice padded columns down to a smaller padded size, keeping the rows
-    axis sharded (a bare slice can come back replicated)."""
-    import jax
-
-    from modin_tpu.parallel.mesh import row_sharding
-
-    def fn(cols: Tuple):
-        sh = row_sharding()
-        return tuple(
-            jax.lax.with_sharding_constraint(c[:p_out], sh) for c in cols
-        )
-
-    return jax.jit(fn)
-
-
-def trim_columns(cols: List[Any], p_out: int) -> List[Any]:
-    from modin_tpu.parallel.engine import JaxWrapper
-
-    if not cols or cols[0].shape[0] == p_out:
-        return list(cols)
-    # through the seam: resilience policy + op-replay lineage provenance
-    return list(
-        JaxWrapper.deploy(_jit_trim(len(cols), int(p_out)), (tuple(cols),))
-    )
-
-
 def gather_columns(cols: List[Any], positions: np.ndarray) -> Tuple[List[Any], int]:
     """Gather logical positions from padded columns.
 

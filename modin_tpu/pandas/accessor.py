@@ -46,6 +46,14 @@ class ModinAPI(ClassLogger, modin_layer="PANDAS-API"):
         """Move to the in-process pandas backend."""
         return self.set_backend("Pandas", inplace=inplace)
 
+    def explain(self) -> str:
+        """graftplan EXPLAIN: the deferred logical plan before/after rewrite
+        with per-rule attribution, or a note that execution is eager."""
+        qc = self._data._query_compiler
+        if hasattr(qc, "explain"):
+            return qc.explain()
+        return f"status: eager ({type(qc).__name__} has no deferred planner)"
+
     def repartition(self, axis: Any = None):
         """Rebalance the on-device sharding (no-op for host backends)."""
         return self._data._create_or_update_from_compiler(

@@ -634,7 +634,24 @@ class DataFrame(BasePandasDataset):
         # removed in pandas 3; kept for compatibility with older user code
         return self.map(func, na_action=na_action, **kwargs)
 
+    _AGG_REDUCTIONS = frozenset(
+        ["sum", "mean", "min", "max", "prod", "product", "count", "median",
+         "std", "var", "sem", "skew", "kurt", "any", "all"]
+    )
+
     def aggregate(self, func: Any = None, axis: Any = 0, *args: Any, **kwargs: Any):
+        # a bare named reduction IS that reduction (pandas applies the same
+        # Series method per column): route it through the reduction surface
+        # so the device kernels — and a pending graftplan — serve it instead
+        # of a host materialization
+        if (
+            isinstance(func, str)
+            and func in self._AGG_REDUCTIONS
+            and not args
+            and not kwargs
+            and self._get_axis_number(axis) == 0
+        ):
+            return getattr(self, func)()
         return self._default_to_pandas("agg", func, axis, *args, **kwargs)
 
     agg = aggregate

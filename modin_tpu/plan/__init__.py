@@ -1,0 +1,45 @@
+"""graftplan — the whole-query deferred planner.
+
+Layered ABOVE the elementwise fusion DAG (:mod:`modin_tpu.ops.lazy`): where
+``LazyExpr`` batches chained *elementwise* ops into one XLA program, graftplan
+batches chained *query operators* — scan / project / filter / map / reduce /
+groupby_agg / sort — into a logical plan, rewrites the plan (dead-column
+pruning, projection pushdown into the byte-range readers, filter pushdown,
+common-subexpression elimination, map→reduce fusion), and only then lowers it
+through the existing eager seams.  The acceptance shape::
+
+    read_csv(...).query(...)[cols].agg(...)
+
+executes as ONE scan that never parses dropped columns plus one fused device
+program, instead of one dispatch (and one full-width parse) per op.
+
+Module map:
+
+- :mod:`~modin_tpu.plan.ir`        — immutable plan nodes + DAG utilities
+- :mod:`~modin_tpu.plan.rules`     — rewrite rules (pure ``Plan -> Plan | None``)
+  applied to fixpoint under a bounded pass budget
+- :mod:`~modin_tpu.plan.lowering`  — plan -> eager TpuQueryCompiler through
+  the existing dispatcher / run_fused / JaxWrapper.deploy seams
+- :mod:`~modin_tpu.plan.runtime`   — the glue the query compiler's deferral
+  guards call (mode gate, scan sniff, node builders, force)
+- :mod:`~modin_tpu.plan.explain`   — the EXPLAIN surface (before/after plan
+  rendering with per-rule attribution)
+
+The mode knob is ``MODIN_TPU_PLAN`` (Auto | Off | Force) — see
+docs/configuration.md.
+"""
+
+from modin_tpu.plan.ir import (  # noqa: F401
+    Filter,
+    GroupbyAgg,
+    Map,
+    PlanNode,
+    Project,
+    Reduce,
+    Scan,
+    Sort,
+    Source,
+)
+from modin_tpu.plan.rules import RULES, optimize  # noqa: F401
+from modin_tpu.plan.runtime import defer_frame, plan_mode  # noqa: F401
+from modin_tpu.plan.explain import explain_qc, render  # noqa: F401

@@ -1,0 +1,83 @@
+"""graftplan EXPLAIN: human-readable plan rendering with rule attribution.
+
+``df.modin.explain()`` (or ``qc.explain()``) prints the logical plan before
+and after the rewrite pass, plus which rules fired on which pass — enough to
+debug a plan regression ("why did pushdown stop firing?") from a terminal,
+without loading a trace viewer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from modin_tpu.plan.ir import PlanNode
+from modin_tpu.plan.rules import optimize
+
+
+def render(root: PlanNode) -> str:
+    """ASCII tree of a plan; shared (diamond) nodes render once and are
+    referenced as ``^N`` afterwards."""
+    lines: List[str] = []
+    ids: dict = {}
+
+    def visit(node: PlanNode, depth: int) -> None:
+        indent = "  " * depth
+        seen = ids.get(id(node))
+        if seen is not None:
+            lines.append(f"{indent}^{seen} (shared {node.kind})")
+            return
+        ids[id(node)] = len(ids) + 1
+        lines.append(f"{indent}#{ids[id(node)]} {node.label()}")
+        for child in node.children:
+            visit(child, depth + 1)
+
+    visit(root, 0)
+    return "\n".join(lines)
+
+
+def render_attribution(applied: List[Tuple[str, int]]) -> str:
+    if not applied:
+        return "rewrites: none (plan already optimal)"
+    by_rule: dict = {}
+    for name, pass_index in applied:
+        by_rule.setdefault(name, []).append(pass_index)
+    lines = ["rewrites:"]
+    for name, passes in by_rule.items():
+        shown = ", ".join(str(p) for p in passes)
+        lines.append(f"  - {name}: {len(passes)} application(s) (pass {shown})")
+    return "\n".join(lines)
+
+
+def explain_plan(
+    root: PlanNode,
+    optimized: Optional[PlanNode] = None,
+    applied: Optional[List[Tuple[str, int]]] = None,
+) -> str:
+    if optimized is None:
+        optimized, applied = optimize(root)
+    parts = [
+        "== logical plan (before rewrite) ==",
+        render(root),
+        "",
+        "== logical plan (after rewrite) ==",
+        render(optimized),
+        "",
+        render_attribution(applied or []),
+    ]
+    return "\n".join(parts)
+
+
+def explain_qc(qc: Any) -> str:
+    """EXPLAIN for a query compiler: pending plan, last-materialized plan,
+    or a note that execution is eager."""
+    plan = getattr(qc, "_plan", None)
+    if plan is not None:
+        return "status: deferred (not yet materialized)\n" + explain_plan(plan)
+    history = getattr(qc, "_plan_explain", None)
+    if history is not None:
+        root, optimized, applied = history
+        return "status: materialized\n" + explain_plan(root, optimized, applied)
+    return (
+        "status: eager (no deferred plan; set MODIN_TPU_PLAN=Auto and start "
+        "from a deferrable read, or use modin_tpu.plan.defer_frame)"
+    )

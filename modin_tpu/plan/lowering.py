@@ -1,0 +1,204 @@
+"""graftplan lowering: optimized plan -> eager query compiler.
+
+Every node lowers through the seam the eager mode already uses — scans call
+the format dispatcher's ``read`` (io lineage, spans, file-leak tracking all
+intact), maps call the eager QC methods (whose device paths build deferred
+``LazyExpr`` columns), filters ride ``getitem_array``'s mask-fusing gather,
+and reductions consume the lazy columns through ``run_fused``'s tail — so
+resilience retry/backoff, graftguard lineage recovery, and the device-memory
+ledger see planned execution exactly as they see eager execution.
+
+The walk memoizes per node id: a subtree shared between the filter mask and
+the main spine (or merged by CSE) is computed ONCE — the "one scan" half of
+the acceptance shape is structural, not an optimization.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Tuple
+
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import spans as graftscope
+from modin_tpu.plan.ir import (
+    Filter,
+    GroupbyAgg,
+    Map,
+    PlanNode,
+    Project,
+    Reduce,
+    Ref,
+    Scan,
+    Sort,
+    Source,
+    count_nodes,
+)
+
+_tls = threading.local()
+
+#: Materialized reads retained per scan origin (one per distinct projection,
+#: FIFO-evicted): each entry pins a full query compiler's buffers, so the
+#: cache must stay small — a long-lived deferred frame forced under many
+#: different projections re-reads rather than hoard every width it ever saw.
+_SCAN_CACHE_MAX = 4
+
+
+def in_lowering() -> bool:
+    """Whether a lowering pass is running on this thread.
+
+    The Force-mode deferral guards consult this: lowering replays plan
+    nodes through the same guarded eager methods, and re-entering planning
+    there would wrap Source nodes forever.
+    """
+    return getattr(_tls, "lowering", False)
+
+
+def lower(root: PlanNode) -> Any:
+    """Lower an (optimized) plan to an eager query compiler."""
+    return lower_traced(root)[0]
+
+
+def lower_traced(root: PlanNode) -> Tuple[Any, Dict[int, Any]]:
+    """Lower a plan; also returns the node-id -> lowered-compiler memo
+    (the materialization path uses it to adopt a reduction's input)."""
+    memo: Dict[int, Any] = {}
+    was_lowering = in_lowering()
+    _tls.lowering = True
+    try:
+        with graftscope.span(
+            "plan.lower", layer="QUERY-COMPILER", nodes=count_nodes(root)
+        ):
+            result = _lower(root, memo)
+    finally:
+        _tls.lowering = was_lowering
+    emit_metric("plan.lower.nodes", len(memo))
+    return result, memo
+
+
+def _lower(node: PlanNode, memo: Dict[int, Any]) -> Any:
+    hit = memo.get(id(node))
+    if hit is not None:
+        return hit
+    try:
+        result = _LOWERERS[type(node)](node, memo)
+    except Exception as exc:
+        # deferral moves eager-mode errors (e.g. `df["s"] > 3` on a string
+        # column) from the call site to the materialization point; name the
+        # failing node so the traceback points back at the logical op
+        if (
+            not getattr(exc, "_graftplan_node", None)
+            and exc.args
+            and isinstance(exc.args[0], str)
+        ):
+            exc._graftplan_node = node.label()
+            exc.args = (
+                f"{exc.args[0]} [while materializing deferred plan node "
+                f"{node.label()}]",
+            ) + exc.args[1:]
+        raise
+    memo[id(node)] = result
+    return result
+
+
+def _lower_scan(node: Scan, memo: Dict[int, Any]) -> Any:
+    origin = node.origin
+    need = (
+        tuple(node.columns)
+        if node.pushed and node.pruned is not None
+        else None
+    )
+    # serve from a prior materialization of this source when it covers the
+    # need: a scan shared by several plans (or re-forced after a reduction)
+    # must not re-parse the file per force()
+    for key, cached in (origin.cache or {}).items():
+        if key is None and need is None:
+            return cached
+        if need is not None and (key is None or set(need) <= set(key)):
+            return cached.getitem_column_array(list(need))
+    kwargs = scan_read_kwargs(node)
+    if need is not None:
+        emit_metric(
+            "plan.scan.pruned_columns", len(node.all_columns) - len(node.pruned)
+        )
+    qc = node.dispatcher.read(**kwargs)
+    if origin.cache is not None:
+        while len(origin.cache) >= _SCAN_CACHE_MAX:
+            origin.cache.pop(next(iter(origin.cache)))
+        origin.cache[need] = qc
+    return qc
+
+
+def scan_read_kwargs(node: Scan) -> dict:
+    """The reader kwargs for a scan, with the pushed projection merged in."""
+    kwargs = dict(node.read_kwargs)
+    if node.pushed and node.pruned is not None:
+        keep = [c for c in node.all_columns if c in set(node.pruned)]
+        kwargs[node.colarg] = keep
+        dtype = kwargs.get("dtype")
+        if isinstance(dtype, dict):
+            # per-column dtype entries for never-parsed columns would make
+            # some parsers complain; the surviving subset is all that matters
+            kwargs["dtype"] = {k: v for k, v in dtype.items() if k in set(keep)}
+    return kwargs
+
+
+def _lower_source(node: Source, memo: Dict[int, Any]) -> Any:
+    return node.qc
+
+
+def _lower_project(node: Project, memo: Dict[int, Any]) -> Any:
+    child = _lower(node.children[0], memo)
+    qc = child.getitem_column_array(list(node.keys), numeric=node.numeric)
+    if node.out_hint is not None:
+        qc._shape_hint = node.out_hint
+    return qc
+
+
+def _lower_filter(node: Filter, memo: Dict[int, Any]) -> Any:
+    child = _lower(node.children[0], memo)
+    mask = _lower(node.children[1], memo)
+    return child.getitem_array(mask)
+
+
+def _lower_map(node: Map, memo: Dict[int, Any]) -> Any:
+    receiver = _lower(node.children[0], memo)
+    args = tuple(
+        _lower(node.children[a.index], memo) if isinstance(a, Ref) else a
+        for a in node.args
+    )
+    qc = getattr(receiver, node.method)(*args, **node.kwargs)
+    if node.out_hint is not None:
+        qc._shape_hint = node.out_hint
+    return qc
+
+
+def _lower_reduce(node: Reduce, memo: Dict[int, Any]) -> Any:
+    child = _lower(node.children[0], memo)
+    return getattr(child, node.method)(**node.call_kwargs)
+
+
+def _lower_groupby(node: GroupbyAgg, memo: Dict[int, Any]) -> Any:
+    child = _lower(node.children[0], memo)
+    by = node.by
+    if isinstance(by, Ref):
+        by = _lower(node.children[by.index], memo)
+    return child.groupby_agg(by, node.agg_func, **node.call_kwargs)
+
+
+def _lower_sort(node: Sort, memo: Dict[int, Any]) -> Any:
+    child = _lower(node.children[0], memo)
+    return child.sort_rows_by_column_values(
+        node.sort_columns, node.ascending, **node.call_kwargs
+    )
+
+
+_LOWERERS = {
+    Scan: _lower_scan,
+    Source: _lower_source,
+    Project: _lower_project,
+    Filter: _lower_filter,
+    Map: _lower_map,
+    Reduce: _lower_reduce,
+    GroupbyAgg: _lower_groupby,
+    Sort: _lower_sort,
+}

@@ -32,6 +32,7 @@ EXPECTED_SECTIONS = (
     "axis1",
     "host_udf",
     "graftsort",
+    "graftplan",
     "recovery",
     "shuffle_apply_virtual_mesh",
 )
@@ -44,6 +45,7 @@ SMOKE_ENV = {
     "BENCH_MODE1_ROWS": "20000",
     "BENCH_UDF_ROWS": "2000",
     "BENCH_SORT_ROWS": "120000",
+    "BENCH_PLAN_ROWS": "120000",
     "BENCH_RECOVERY_ROWS": "150000",
     # the 10% lineage-overhead acceptance belongs to full-scale runs; at
     # smoke scale the workload is ~10ms and scheduler noise alone flakes it

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all eight gates, fail on any red
+#   ./scripts/check_all.sh            # all nine gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -21,6 +21,10 @@
 #       hard timeout with one valid JSON line per section and a parseable
 #       aggregate — a bench that cannot finish can never ship again
 #       (round-5's rc=124-with-empty-output failure mode)
+#   0e. graftplan smoke: read_csv(...).query(...)[cols].agg(...) under
+#       MODIN_TPU_PLAN=Auto must be bit-exact vs eager and pandas, take
+#       <= 2 compile-ledger dispatches for the device leg, and provably
+#       never parse pruned columns (reader spy)
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -48,6 +52,7 @@ run_gate "graftlint"       python -m modin_tpu.lint modin_tpu/
 run_gate "graftscope"      python scripts/trace_smoke.py
 run_gate "graftguard"      python scripts/chaos_smoke.py
 run_gate "bench_smoke"     python scripts/bench_smoke.py
+run_gate "graftplan"       python scripts/plan_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -57,4 +62,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL EIGHT GATES GREEN"
+echo "ALL NINE GATES GREEN"

@@ -210,3 +210,130 @@ def test_bool_chain_through_fusion(dfs):
         (md["a"] > 0) & (md["b"] < 0) | (md["c"] > 1.5),
         (pdf["a"] > 0) & (pdf["b"] < 0) | (pdf["c"] > 1.5),
     )
+
+
+# ---------------------------------------------------------------------- #
+# graftplan satellite: edge cases the deferred planner leans on
+# ---------------------------------------------------------------------- #
+
+
+def test_diamond_fingerprint_stability(dfs):
+    """Structurally identical graphs (built twice, including a diamond)
+    linearize to the SAME fingerprint over the same leaves — the property
+    the planner's CSE and the executable cache both rely on."""
+    md, _ = dfs
+    qc = md._query_compiler
+
+    def build():
+        col_a = qc._modin_frame._columns[0].raw
+        col_b = qc._modin_frame._columns[1].raw
+        shared = lazy.lazy_op("mul", col_a, col_b)
+        return lazy.lazy_op("add", shared, shared)
+
+    nodes1, out1, leaves1, _, fp1 = lazy._linearize([build()])
+    nodes2, out2, leaves2, _, fp2 = lazy._linearize([build()])
+    assert fp1 == fp2
+    # diamond: the shared mul node appears once, referenced twice
+    assert [n[0] for n in nodes1] == ["mul", "add"]
+    add_refs = nodes1[1][1]
+    assert add_refs[0] == add_refs[1] == ("n", 0)
+
+
+def test_max_nodes_diamond_not_overcounted(dfs):
+    """A diamond-heavy graph whose cheap ``size`` upper bound overflows
+    _MAX_NODES but whose DISTINCT node count does not must stay lazy:
+    lazy_op re-measures with _distinct_size before materializing."""
+    md, _ = dfs
+    qc = md._query_compiler
+    col = qc._modin_frame._columns[0].raw
+    expr = lazy.lazy_op("add", col, 0.0)
+    # doubling a diamond k times gives size ~2^k but only k+1 distinct nodes
+    for _ in range(lazy._MAX_NODES.bit_length() + 4):
+        expr = lazy.lazy_op("add", expr, expr)
+    assert lazy.is_lazy(expr), "diamond sharing was double-counted"
+    assert lazy._distinct_size(expr) <= lazy._MAX_NODES
+
+
+def test_max_nodes_overflow_materializes_midchain(dfs):
+    """A genuinely deep chain crosses _MAX_NODES and materializes the
+    overflowing expression immediately (bounding XLA program size), and the
+    final result stays correct."""
+    md, pdf = dfs
+    s, ps = md["a"], pdf["a"]
+    for i in range(lazy._MAX_NODES + 5):
+        s = s + float(i % 3)
+        ps = ps + float(i % 3)
+    # somewhere mid-chain an expression was forced: the current column is
+    # within the fresh window, not one giant graph
+    col = _col(s)
+    if col.is_lazy:
+        assert lazy._distinct_size(col.raw) <= lazy._MAX_NODES
+    df_equals(s, ps)
+
+
+def test_scalar_weak_typing_distinguishes_int_and_float(dfs):
+    """df*2 and df*3 share one executable (scalars are runtime args), but
+    df*2 and df*2.0 must NOT: jax weak-types Python scalars by class, and
+    conflating them would change promotion semantics."""
+    md, _ = dfs
+    lazy._FUSED_CACHE.clear()  # isolate from shapes cached by earlier tests
+    _ = (md["a"] * 2).to_numpy()
+    before = len(lazy._FUSED_CACHE)
+    _ = (md["a"] * 3).to_numpy()
+    assert len(lazy._FUSED_CACHE) == before  # int scalar shares
+    _ = (md["a"] * 2.5).to_numpy()
+    assert len(lazy._FUSED_CACHE) == before + 1  # float scalar does not
+
+
+def test_fused_cache_lru_bound_and_eviction_metric():
+    """The fused-executable cache respects MODIN_TPU_FUSED_CACHE_SIZE as an
+    LRU bound, counts evictions, and recompiles evicted shapes correctly."""
+    from modin_tpu.config import FusedCacheSize
+    from modin_tpu.logging.metrics import add_metric_handler, clear_metric_handler
+
+    md, pdf = create_test_dfs({"a": np.arange(64, dtype=np.float64)})
+    seen = {}
+
+    def on_metric(name, value):
+        seen[name] = seen.get(name, 0) + value
+
+    add_metric_handler(on_metric)
+    evictions_before = lazy.fused_cache_evictions()
+    try:
+        with FusedCacheSize.context(2):
+            # distinct chain shapes so each pipeline is its own cache entry
+            pipelines = [
+                lambda df: df["a"] * 2.0,
+                lambda df: df["a"] + 1.5,
+                lambda df: (df["a"] * 2.0) + 1.5,
+                lambda df: df["a"].abs() * 0.5,
+            ]
+            for fn in pipelines:
+                fn(md).to_numpy()
+                assert lazy.fused_cache_len() <= 2
+            assert lazy.fused_cache_evictions() > evictions_before
+            assert seen.get("modin_tpu.fusion.cache.evict", 0) > 0
+            # an evicted shape recompiles and stays correct
+            df_equals(pipelines[0](md), pipelines[0](pdf))
+    finally:
+        clear_metric_handler(on_metric)
+
+
+def test_fused_cache_lru_recency_order():
+    """Re-hitting an entry refreshes its recency: the least-recently USED
+    entry is evicted, not the least-recently inserted."""
+    from modin_tpu.config import FusedCacheSize
+
+    md, _ = create_test_dfs({"a": np.arange(32, dtype=np.float64)})
+    with FusedCacheSize.context(0):  # unbounded while we seed
+        lazy._FUSED_CACHE.clear()
+        (md["a"] * 2.0).to_numpy()   # shape A
+        (md["a"] + 1.0).to_numpy()   # shape B
+        assert lazy.fused_cache_len() == 2
+        keys = list(lazy._FUSED_CACHE)
+        (md["a"] * 5.0).to_numpy()   # hit shape A -> A becomes most recent
+        assert list(lazy._FUSED_CACHE)[-1] == keys[0]
+    with FusedCacheSize.context(2):
+        (md["a"].abs()).to_numpy()   # shape C: evicts B (LRU), keeps A
+        assert keys[0] in lazy._FUSED_CACHE
+        assert keys[1] not in lazy._FUSED_CACHE

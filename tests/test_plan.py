@@ -1,0 +1,638 @@
+"""graftplan tests: IR, rewrite rules, deferred execution, and parity.
+
+Three layers of coverage:
+
+1. **IR mechanics** — node schema answers, DAG sharing through transform,
+   structural keys (the CSE merge criterion).
+2. **Rewrite rules** — each rule's positive and negative cases as pure
+   ``Plan -> Plan | None`` functions, plus the fixpoint engine's pass budget.
+3. **End-to-end parity** — deferred pipelines over a real CSV must be
+   bit-exact against ``MODIN_TPU_PLAN=Off`` (eager) and plain pandas, across
+   materialization points (repr, index, to_pandas, unplanned ops), pushdown
+   gates, Force-mode Source re-planning, and the EXPLAIN surface.
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import PlanMaxPasses, PlanMode
+from modin_tpu.plan import ir, rules
+from modin_tpu.plan import runtime as plan_runtime
+from tests.utils import df_equals
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu_backend():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("graftplan rides the TpuOnJax query compiler")
+
+
+_rng = np.random.default_rng(11)
+
+
+@pytest.fixture
+def csv_path(tmp_path):
+    n = 4000
+    pandas.DataFrame(
+        {
+            "a": _rng.integers(-10, 10, n),
+            "b": _rng.uniform(0, 1, n),
+            "c": _rng.uniform(-1, 1, n),
+            "d": _rng.integers(0, 7, n),
+            "e": _rng.uniform(0, 100, n),
+        }
+    ).to_csv(tmp_path / "plan.csv", index=False)
+    return str(tmp_path / "plan.csv")
+
+
+def _scan(columns=("a", "b", "c")):
+    from modin_tpu.core.execution.jax_engine.io import TpuCSVDispatcher
+
+    return ir.Scan(
+        TpuCSVDispatcher, {"filepath_or_buffer": "x.csv"}, pandas.Index(columns)
+    )
+
+
+# ---------------------------------------------------------------------- #
+# IR mechanics
+# ---------------------------------------------------------------------- #
+
+
+def test_ir_schema_and_row_keys():
+    scan = _scan()
+    proj = ir.Project(scan, ("a",), out_hint="column")
+    mask = ir.Map((proj,), "gt", (0,), out_columns=proj.columns, bool_out=True)
+    filt = ir.Filter(scan, mask)
+    assert list(filt.columns) == ["a", "b", "c"]
+    assert list(mask.columns) == ["a"]
+    assert mask.known_dtypes().iloc[0] == bool
+    assert scan.known_dtypes() is None  # only a full parse could know
+    # projects/maps preserve row lineage; filters/sorts break it
+    assert proj.row_key() == scan.row_key() == mask.row_key()
+    assert filt.row_key() != scan.row_key()
+    assert ir.Sort(scan, "a", True, {}).row_key() != scan.row_key()
+
+
+def test_transform_preserves_diamond_sharing():
+    scan = _scan()
+    left = ir.Project(scan, ("a",))
+    right = ir.Project(scan, ("b",))
+    mask = ir.Map((right,), "gt", (0,), out_columns=right.columns, bool_out=True)
+    root = ir.Filter(left, mask)
+    rebuilt, changes = ir.transform(
+        root, lambda n: None
+    )
+    assert changes == 0 and rebuilt is root
+    # a rewrite that touches the shared scan rewrites it ONCE
+    new_scan = _scan(("a", "b", "c"))
+
+    def swap(node):
+        return new_scan if isinstance(node, ir.Scan) else None
+
+    rebuilt, changes = ir.transform(root, swap)
+    assert changes == 1
+    assert rebuilt.children[0].children[0] is rebuilt.children[1].children[0].children[0]
+
+
+def test_structural_key_identity_vs_structure():
+    scan = _scan()
+    p1 = ir.Project(scan, ("a",))
+    p2 = ir.Project(scan, ("a",))
+    p3 = ir.Project(scan, ("b",))
+    memo = {}
+    assert ir.structural_key(p1, memo) == ir.structural_key(p2, memo)
+    assert ir.structural_key(p1, memo) != ir.structural_key(p3, memo)
+    # different leaves never merge
+    other = ir.Project(_scan(), ("a",))
+    assert ir.structural_key(other, memo) != ir.structural_key(p1, memo)
+
+
+# ---------------------------------------------------------------------- #
+# Rewrite rules
+# ---------------------------------------------------------------------- #
+
+
+def test_rule_push_filter_below_project_and_map():
+    scan = _scan()
+    mask = ir.Map(
+        (ir.Project(scan, ("a",)),), "gt", (0,),
+        out_columns=pandas.Index(["a"]), bool_out=True,
+    )
+    root = ir.Filter(ir.Project(scan, ("b", "c")), mask)
+    new_root = rules.push_filter_down(root)
+    assert isinstance(new_root, ir.Project)
+    assert isinstance(new_root.children[0], ir.Filter)
+    assert new_root.children[0].children[0] is scan
+    # single-input maps commute too
+    mroot = ir.Filter(
+        ir.Map((scan,), "abs", out_columns=scan.columns), mask
+    )
+    new_mroot = rules.push_filter_down(mroot)
+    assert isinstance(new_mroot, ir.Map)
+    assert isinstance(new_mroot.children[0], ir.Filter)
+    # a filter already on the scan is a no-op
+    assert rules.push_filter_down(ir.Filter(scan, mask)) is None
+
+
+def test_rule_cse_merges_identical_subtrees():
+    scan = _scan()
+    m1 = ir.Map(
+        (ir.Project(scan, ("a",)),), "gt", (0,),
+        out_columns=pandas.Index(["a"]), bool_out=True,
+    )
+    m2 = ir.Map(
+        (ir.Project(scan, ("a",)),), "gt", (0,),
+        out_columns=pandas.Index(["a"]), bool_out=True,
+    )
+    root = ir.Map((m1, m2), "__and__", (ir.Ref(1),), out_columns=m1.columns)
+    new_root = rules.common_subexpression_elimination(root)
+    assert new_root is not None
+    assert new_root.children[0] is new_root.children[1]
+    # different payloads never merge
+    m3 = ir.Map(
+        (ir.Project(scan, ("a",)),), "gt", (1,),
+        out_columns=pandas.Index(["a"]), bool_out=True,
+    )
+    root2 = ir.Map((m1, m3), "__and__", (ir.Ref(1),), out_columns=m1.columns)
+    merged = rules.common_subexpression_elimination(root2)
+    if merged is not None:  # the two projects still merge
+        assert merged.children[0] is not merged.children[1]
+
+
+def test_rule_prune_columns_unions_all_consumers():
+    scan = _scan(("a", "b", "c", "d", "e"))
+    mask = ir.Map(
+        (ir.Project(scan, ("a",)),), "gt", (0,),
+        out_columns=pandas.Index(["a"]), bool_out=True,
+    )
+    root = ir.Reduce(
+        ir.Project(ir.Filter(scan, mask), ("b", "c")), "sum", {}
+    )
+    new_root = rules.prune_dead_columns(root)
+    assert new_root is not None
+    pruned_scan = new_root.children[0].children[0].children[0]
+    assert set(pruned_scan.pruned) == {"a", "b", "c"}
+    # the mask branch shares the SAME pruned scan node
+    assert new_root.children[0].children[0].children[1].children[0].children[0] is pruned_scan
+    # a plan whose root is the scan itself requires everything: no pruning
+    assert rules.prune_dead_columns(scan) is None
+
+
+def test_rule_pushdown_gate_blocks_unsafe_kwargs():
+    from modin_tpu.core.execution.jax_engine.io import TpuCSVDispatcher
+
+    safe = ir.Scan(
+        TpuCSVDispatcher, {"filepath_or_buffer": "x.csv"},
+        pandas.Index(["a", "b"]), pruned=("a",),
+    )
+    assert plan_runtime.scan_supports_pushdown(safe)
+    for blocker in (
+        {"index_col": "a"},
+        {"converters": {"a": int}},
+        {"parse_dates": ["a"]},
+        {"usecols": lambda c: True},
+        {"names": ["x", "y"]},
+        {"skipfooter": 2},
+    ):
+        scan = ir.Scan(
+            TpuCSVDispatcher, {"filepath_or_buffer": "x.csv", **blocker},
+            pandas.Index(["a", "b"]), pruned=("a",),
+        )
+        assert not plan_runtime.scan_supports_pushdown(scan), blocker
+
+
+def test_rule_fuse_map_reduce_counts_chain():
+    scan = _scan()
+    m1 = ir.Map((scan,), "add", (1,), out_columns=scan.columns)
+    m2 = ir.Map((m1,), "mul", (2,), out_columns=scan.columns)
+    root = ir.Reduce(m2, "sum", {})
+    fused = rules.fuse_map_reduce(root)
+    assert fused is not None and fused.fused and fused.fused_maps == 2
+    assert rules.fuse_map_reduce(fused) is None  # idempotent
+    assert rules.fuse_map_reduce(ir.Reduce(scan, "sum", {})) is None
+
+
+def test_optimize_respects_pass_budget():
+    calls = []
+
+    def hungry_rule(root):
+        calls.append(1)
+        # always "improves": without the budget this would never stop
+        return ir.Project(root, tuple(root.columns))
+
+    scan = _scan()
+    original_rules = rules.RULES
+    rules.RULES = (("hungry", hungry_rule),)
+    try:
+        optimized, applied = rules.optimize(scan, max_passes=3)
+        assert len(applied) == 3
+        assert len(calls) == 3
+    finally:
+        rules.RULES = original_rules
+    with PlanMaxPasses.context(2):
+        root, applied = rules.optimize(scan)
+        assert applied == []  # real catalog: scan-only plan is a fixpoint
+
+
+# ---------------------------------------------------------------------- #
+# End-to-end: deferral, parity, materialization points
+# ---------------------------------------------------------------------- #
+
+
+def _pandas_frame(csv_path):
+    return pandas.read_csv(csv_path)
+
+
+def test_read_defers_and_metadata_stays_cheap(csv_path):
+    md = pd.read_csv(csv_path)
+    qc = md._query_compiler
+    assert qc._plan is not None
+    # columns come from the header sniff without materializing
+    assert list(md.columns) == ["a", "b", "c", "d", "e"]
+    assert qc._plan is not None, "columns access must not force the plan"
+    # row count is NOT derivable from the plan: it forces
+    assert len(md) == len(_pandas_frame(csv_path))
+    assert qc._plan is None
+
+
+def test_acceptance_pipeline_bit_exact(csv_path):
+    planned = pd.read_csv(csv_path).query("a > 0")[["b", "c"]].agg("sum")
+    with PlanMode.context("Off"):
+        eager = pd.read_csv(csv_path).query("a > 0")[["b", "c"]].agg("sum")
+    reference = _pandas_frame(csv_path).query("a > 0")[["b", "c"]].agg("sum")
+    pandas.testing.assert_series_equal(planned.modin.to_pandas(), reference)
+    pandas.testing.assert_series_equal(eager.modin.to_pandas(), reference)
+
+
+@pytest.mark.parametrize(
+    "pipeline",
+    [
+        lambda df: df[["b", "e"]],
+        lambda df: df.query("a > 2 and e < 50.0"),
+        lambda df: df.query("a > 0")[["b"]].mean(),
+        lambda df: (df[["b", "c"]] * 2.0).sum(),
+        lambda df: df[df["a"] > 0][["c"]].abs().sum(),
+        lambda df: df.query("a > 0").sort_values("e")[["b", "c"]],
+        lambda df: df[["a", "b"]].count(),
+        lambda df: df.query("d in [1, 2, 3]")[["e"]].max(),
+    ],
+    ids=["project", "filter", "filter-project-mean", "map-sum", "mask-abs-sum",
+         "filter-sort-project", "count", "isin-max"],
+)
+def test_deferred_pipelines_match_eager_and_pandas(csv_path, pipeline):
+    planned = pipeline(pd.read_csv(csv_path))
+    with PlanMode.context("Off"):
+        eager = pipeline(pd.read_csv(csv_path))
+    reference = pipeline(_pandas_frame(csv_path))
+    df_equals(planned, reference)
+    df_equals(eager, reference)
+
+
+def test_materialization_points_force(csv_path):
+    pdf = _pandas_frame(csv_path)
+    # repr
+    md = pd.read_csv(csv_path)
+    repr(md)
+    assert md._query_compiler._plan is None
+    # index access
+    md = pd.read_csv(csv_path)
+    assert list(md.index[:3]) == [0, 1, 2]
+    assert md._query_compiler._plan is None
+    # an op with no plan node (head -> row_slice)
+    md = pd.read_csv(csv_path)
+    df_equals(md.head(7), pdf.head(7))
+    # scan dtypes are unknowable without a parse: .dtypes forces
+    md = pd.read_csv(csv_path)
+    assert md._query_compiler._plan is not None
+    pandas.testing.assert_series_equal(md.dtypes, pdf.dtypes)
+    assert md._query_compiler._plan is None
+
+
+def test_mask_dtype_answered_without_forcing(csv_path):
+    md = pd.read_csv(csv_path)
+    mask = md["a"] > 0
+    assert mask.dtype == np.dtype(bool)
+    assert mask._query_compiler._plan is not None, (
+        "a comparison's dtype is exactly known; it must not force"
+    )
+    filtered = md[mask]
+    assert filtered._query_compiler._plan is not None
+    df_equals(filtered, _pandas_frame(csv_path)[_pandas_frame(csv_path)["a"] > 0])
+
+
+def test_compound_mask_stays_deferred(csv_path):
+    md = pd.read_csv(csv_path)
+    out = md[(md["a"] > 0) & (md["e"] < 75.0)][["b", "d"]]
+    assert out._query_compiler._plan is not None
+    pdf = _pandas_frame(csv_path)
+    df_equals(out, pdf[(pdf["a"] > 0) & (pdf["e"] < 75.0)][["b", "d"]])
+
+
+def test_groupby_agg_through_plan(csv_path):
+    md = pd.read_csv(csv_path)[["d", "b"]]
+    assert md._query_compiler._plan is not None
+    out = md.groupby("d").sum()
+    df_equals(out, _pandas_frame(csv_path)[["d", "b"]].groupby("d").sum())
+
+
+def test_pushdown_composes_with_user_usecols(csv_path):
+    planned = pd.read_csv(csv_path, usecols=["a", "b", "c"]).query("a > 0")[
+        ["b"]
+    ].sum()
+    reference = pandas.read_csv(csv_path, usecols=["a", "b", "c"]).query(
+        "a > 0"
+    )[["b"]].sum()
+    pandas.testing.assert_series_equal(planned.modin.to_pandas(), reference)
+
+
+def test_unsafe_kwargs_skip_pushdown_but_stay_correct(csv_path):
+    # index_col blocks reader-level pruning; the pipeline must still be exact
+    planned = pd.read_csv(csv_path, index_col="d")[["b", "c"]].sum()
+    reference = pandas.read_csv(csv_path, index_col="d")[["b", "c"]].sum()
+    pandas.testing.assert_series_equal(planned.modin.to_pandas(), reference)
+
+
+def test_off_mode_never_defers(csv_path):
+    with PlanMode.context("Off"):
+        md = pd.read_csv(csv_path)
+        assert md._query_compiler._plan is None
+        s = md["a"] > 0
+        assert s._query_compiler._plan is None
+
+
+def test_force_mode_replans_after_materialization(csv_path):
+    with PlanMode.context("Force"):
+        md = pd.read_csv(csv_path)
+        len(md)  # materialization point
+        qc = md._query_compiler
+        assert qc._plan is None
+        out = md[["b", "c"]]
+        # Force re-entered planning from a Source leaf
+        assert out._query_compiler._plan is not None
+        explain = out._query_compiler.explain()
+        assert "source" in explain
+        df_equals(out, _pandas_frame(csv_path)[["b", "c"]])
+
+
+def test_defer_frame_helper(csv_path):
+    with PlanMode.context("Off"):
+        md = pd.read_csv(csv_path)  # eager
+    deferred = plan_runtime.defer_frame(md)
+    assert deferred._query_compiler._plan is not None
+    out = deferred.query("a > 0")[["b"]].sum()
+    reference = _pandas_frame(csv_path).query("a > 0")[["b"]].sum()
+    pandas.testing.assert_series_equal(out.modin.to_pandas(), reference)
+
+
+def test_planned_meets_eager_falls_back_correctly(csv_path):
+    md = pd.read_csv(csv_path)
+    with PlanMode.context("Off"):
+        eager = pd.read_csv(csv_path)
+    # mixing a planned frame with an eager one is not plannable: it must
+    # materialize and produce the eager result
+    out = md[["b"]] + eager[["b"]]
+    pdf = _pandas_frame(csv_path)
+    df_equals(out, pdf[["b"]] + pdf[["b"]])
+
+
+def test_explain_lifecycle(csv_path):
+    md = pd.read_csv(csv_path).query("a > 0")[["b", "c"]]
+    before = md.modin.explain()
+    assert "status: deferred" in before
+    assert "scan[" in before and "filter" in before
+    md._query_compiler.execute()
+    after = md.modin.explain()
+    assert "status: materialized" in after
+    assert "pruned" in after and "rewrites:" in after
+    with PlanMode.context("Off"):
+        eager = pd.read_csv(csv_path)
+        assert "status: eager" in eager.modin.explain()
+
+
+def test_second_reduce_reuses_adopted_frame(csv_path, monkeypatch):
+    """After one reduction materializes, the compiler keeps the lowered
+    input frame — a second aggregation must not re-read the file."""
+    import modin_tpu.core.io.text.csv_dispatcher as disp
+
+    reads = {"n": 0}
+    orig = disp.CSVDispatcher.read_fn
+
+    def counting(*args, **kwargs):
+        if kwargs.get("nrows") != 0:
+            reads["n"] += 1
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(disp.CSVDispatcher, "read_fn", staticmethod(counting))
+    md = pd.read_csv(csv_path)[["b", "c"]]
+    first = md.sum()
+    second = md.mean()
+    assert reads["n"] == 1
+    pdf = _pandas_frame(csv_path)[["b", "c"]]
+    pandas.testing.assert_series_equal(first.modin.to_pandas(), pdf.sum())
+    pandas.testing.assert_series_equal(second.modin.to_pandas(), pdf.mean())
+
+
+def test_sniff_failure_declines_to_eager(tmp_path):
+    missing = str(tmp_path / "missing.csv")
+    with pytest.raises(FileNotFoundError):
+        pd.read_csv(missing)
+
+
+def test_deep_chain_hits_depth_cap_not_recursion(csv_path):
+    """A pathological op loop must materialize at MAX_PLAN_DEPTH (exactly
+    like ops/lazy.py's _MAX_NODES window), never RecursionError."""
+    from modin_tpu.plan.ir import MAX_PLAN_DEPTH
+
+    s = pd.read_csv(csv_path)["b"]
+    ps = _pandas_frame(csv_path)["b"]
+    for _ in range(MAX_PLAN_DEPTH + 50):
+        s = s + 1.0
+        ps = ps + 1.0
+    plan = s._query_compiler._plan
+    if plan is not None:
+        assert plan.depth <= MAX_PLAN_DEPTH
+    pandas.testing.assert_series_equal(s.modin.to_pandas(), ps)
+
+
+def test_extension_dtype_requests_stay_eager(csv_path):
+    """dtype={'a': 'Int64'} (like dtype_backend) declines deferral: the IR
+    cannot claim plain-bool comparisons over extension columns."""
+    md = pd.read_csv(csv_path, dtype={"a": "Int64"})
+    assert md._query_compiler._plan is None
+    mask = md["a"] > 0
+    with PlanMode.context("Off"):
+        eager_mask = pd.read_csv(csv_path, dtype={"a": "Int64"})["a"] > 0
+    assert str(mask.dtype) == str(eager_mask.dtype) == "boolean"
+
+
+def test_force_mode_extension_frame_keeps_exact_dtype():
+    """Under Force, a Source over an Int64 frame knows its dtypes exactly:
+    the comparison must not claim plain bool."""
+    with PlanMode.context("Force"):
+        md = pd.DataFrame({"x": pandas.array([1, 2, None], dtype="Int64")})
+        mask = md["x"] > 1
+        with PlanMode.context("Off"):
+            eager = pd.DataFrame(
+                {"x": pandas.array([1, 2, None], dtype="Int64")}
+            )["x"] > 1
+        assert str(mask.dtype) == str(eager.dtype)
+        df_equals(mask, eager)
+
+
+def test_index_col_zero_blocks_pushdown_and_stays_exact(csv_path):
+    """index_col=0 is NOT 'no index column' — pandas resolves positional
+    index_col within the usecols subset, so pushdown must be blocked."""
+    from modin_tpu.core.execution.jax_engine.io import TpuCSVDispatcher
+
+    scan = ir.Scan(
+        TpuCSVDispatcher, {"filepath_or_buffer": "x.csv", "index_col": 0},
+        pandas.Index(["a", "b"]), pruned=("b",),
+    )
+    assert not plan_runtime.scan_supports_pushdown(scan)
+    planned = pd.read_csv(csv_path, index_col=0)[["b", "c"]].sum()
+    reference = pandas.read_csv(csv_path, index_col=0)[["b", "c"]].sum()
+    pandas.testing.assert_series_equal(planned.modin.to_pandas(), reference)
+
+
+def test_multiindex_header_never_pushes_tuple_usecols(tmp_path):
+    """Tuple labels from a MultiIndex header cannot go into usecols; the
+    pipeline must still match eager/pandas exactly."""
+    path = str(tmp_path / "mi.csv")
+    frame = pandas.DataFrame(
+        _rng.uniform(0, 1, (50, 4)),
+        columns=pandas.MultiIndex.from_product([["a", "b"], ["x", "y"]]),
+    )
+    frame.to_csv(path, index=False)
+    planned = pd.read_csv(path, header=[0, 1])[[("a", "x")]].sum()
+    reference = pandas.read_csv(path, header=[0, 1])[[("a", "x")]].sum()
+    pandas.testing.assert_series_equal(planned.modin.to_pandas(), reference)
+
+
+def test_branching_reads_parse_once_per_projection(csv_path, monkeypatch):
+    """Two materializations branching off one deferred read must serve from
+    the scan's lowered-read cache, not re-parse the file."""
+    import modin_tpu.core.io.text.csv_dispatcher as disp
+
+    reads = []
+    orig = disp.CSVDispatcher.read_fn
+
+    def counting(*args, **kwargs):
+        if kwargs.get("nrows") != 0:
+            reads.append(kwargs.get("usecols"))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(disp.CSVDispatcher, "read_fn", staticmethod(counting))
+    md = pd.read_csv(csv_path)
+    first = md["b"].sum()
+    second = md["c"].sum()
+    # the first reduce pruned to {b}; the second needs {c}: at most one
+    # parse per distinct projection, and identical projections are free
+    assert len(reads) <= 2
+    third = md["b"].mean()  # covered by the cached {b} parse
+    assert len(reads) <= 2
+    # the guarantee is planned == eager (bit-exact); pandas may differ in
+    # the last ulp because the device reduction order differs
+    with PlanMode.context("Off"):
+        eager = pd.read_csv(csv_path)
+        assert float(first) == float(eager["b"].sum())
+        assert float(second) == float(eager["c"].sum())
+        assert float(third) == float(eager["b"].mean())
+
+
+def test_numeric_projection_out_of_range_declines(csv_path):
+    md = pd.read_csv(csv_path)
+    qc = md._query_compiler
+    assert plan_runtime.defer_project(qc, [99], numeric=True) is None
+    assert plan_runtime.defer_project(qc, [1], numeric=True) is not None
+
+
+def test_free_on_pending_plan_drops_and_errors_clearly(csv_path):
+    md = pd.read_csv(csv_path)
+    qc = md._query_compiler
+    assert qc._plan is not None
+    qc.free()
+    assert qc._plan is None
+    with pytest.raises(RuntimeError, match="after free"):
+        qc.to_pandas()
+
+
+def test_scan_read_cache_is_bounded(csv_path):
+    """A long-lived deferred read forced under many distinct projections
+    must not hoard one materialized compiler per projection forever."""
+    from modin_tpu.plan.lowering import _SCAN_CACHE_MAX
+
+    md = pd.read_csv(csv_path)
+    scan = md._query_compiler._plan
+    assert isinstance(scan, ir.Scan)
+    results = {c: float(md[c].sum()) for c in ("a", "b", "c", "d", "e")}
+    assert scan.origin.cache is not None
+    assert len(scan.origin.cache) <= _SCAN_CACHE_MAX
+    with PlanMode.context("Off"):
+        eager = pd.read_csv(csv_path)
+        for c, value in results.items():
+            assert value == float(eager[c].sum())
+
+
+def test_lowering_error_names_the_plan_node(tmp_path):
+    """Deferral moves eager call-site errors to the materialization point;
+    the surfaced exception must name the failing logical node."""
+    path = tmp_path / "strings.csv"
+    pandas.DataFrame({"s": ["x", "y", "z"], "n": [1, 2, 3]}).to_csv(
+        path, index=False
+    )
+    md = pd.read_csv(str(path))
+    assert md._query_compiler._plan is not None
+    mask = md["s"] > 3  # eager raises TypeError here; deferred at force time
+    with pytest.raises(TypeError, match="materializing deferred plan node"):
+        mask.modin.to_pandas()
+
+
+def test_positional_dtype_keys_block_pushdown_and_stay_exact(
+    csv_path, monkeypatch
+):
+    """pandas resolves int dtype-dict keys positionally against the FULL
+    column set; the pushed projection filters that dict by label, so such
+    reads must keep the full-width parse (and stay bit-exact vs eager)."""
+    import modin_tpu.core.io.text.csv_dispatcher as disp
+
+    body_usecols = []
+    orig = disp.CSVDispatcher.read_fn
+
+    def spying(*args, **kwargs):
+        if kwargs.get("nrows") != 0:
+            body_usecols.append(kwargs.get("usecols"))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(disp.CSVDispatcher, "read_fn", staticmethod(spying))
+    md = pd.read_csv(csv_path, dtype={1: "float32"})
+    assert md._query_compiler._plan is not None
+    planned = float(md[md["a"] > 0]["b"].sum())
+    assert all(u is None for u in body_usecols), body_usecols
+    with PlanMode.context("Off"):
+        eager = pd.read_csv(csv_path, dtype={1: "float32"})
+        assert planned == float(eager[eager["a"] > 0]["b"].sum())
+
+
+def test_force_mode_defers_filters_and_binaries():
+    """Force-mode guards must hand every consumer of one compiler the same
+    Source leaf, or identity row keys never match and filters/series-series
+    binaries silently stay eager."""
+    src = pandas.DataFrame(
+        {"a": [1.0, -2.0, 3.0, -4.0], "b": [4.0, 5.0, 6.0, 7.0]}
+    )
+    with PlanMode.context("Force"):
+        md = pd.DataFrame(src)
+        mask = md["a"] > 0
+        assert mask._query_compiler._plan is not None
+        filtered = md[mask]
+        assert filtered._query_compiler._plan is not None
+        added = md["a"] + md["b"]
+        assert added._query_compiler._plan is not None
+        df_equals(filtered, src[src["a"] > 0])
+        pandas.testing.assert_series_equal(
+            added.modin.to_pandas(), src["a"] + src["b"]
+        )
