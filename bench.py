@@ -120,6 +120,39 @@ def _emit_line(payload: dict) -> None:
 # attribution instead of a bare number.
 TRACE_DIR = os.environ.get("BENCH_TRACE_DIR", "")
 
+# graftmeter: aggregate the emit_metric stream per section and attach the
+# headline rollup (dispatches/compiles/bytes parsed/cache hits) to every
+# streamed line, so a BENCH_*.json delta carries its efficiency counters,
+# not just wall time.  BENCH_METERS=0 opts out (bare-metal timing).
+METERS = os.environ.get("BENCH_METERS", "1").lower() not in ("0", "false", "")
+
+
+def _meters_begin() -> None:
+    """Enable + reset graftmeter aggregation for one section (best-effort)."""
+    if not METERS:
+        return
+    try:
+        from modin_tpu.config import MetersEnabled
+        from modin_tpu.observability import meters as graftmeter
+
+        if not MetersEnabled.get():
+            MetersEnabled.put(True)
+        graftmeter.reset()
+    except Exception:
+        pass
+
+
+def _meters_rollup() -> dict:
+    """``{"meter_rollup": {...}}`` for the section line (best-effort)."""
+    if not METERS:
+        return {}
+    try:
+        from modin_tpu.observability.exposition import meter_rollup
+
+        return {"meter_rollup": meter_rollup()}
+    except Exception as exc:
+        return {"meter_error": f"{type(exc).__name__}: {exc}"[:200]}
+
 
 def run_section(name: str, fn, timeout_s: float = None):
     """Run one section under a SIGALRM budget; stream its json line.
@@ -152,6 +185,7 @@ def run_section(name: str, fn, timeout_s: float = None):
         signal.setitimer(signal.ITIMER_REAL, budget)
     prof = None
     try:
+        _meters_begin()
         with profile_cm as prof:
             result = fn()
         elapsed = time.perf_counter() - t0
@@ -159,12 +193,14 @@ def run_section(name: str, fn, timeout_s: float = None):
         _emit_line({
             "section": name,
             "error": f"timeout after {budget:g}s (BENCH_SECTION_TIMEOUT_S)",
+            **_meters_rollup(),
         })
         return None
     except Exception as exc:
         _emit_line({
             "section": name,
             "error": f"{type(exc).__name__}: {exc}"[:300],
+            **_meters_rollup(),
         })
         return None
     finally:
@@ -194,6 +230,7 @@ def run_section(name: str, fn, timeout_s: float = None):
         "section": name,
         "elapsed_s": round(elapsed, 1),
         **trace_extra,
+        **_meters_rollup(),
         **result,
     })
     return result

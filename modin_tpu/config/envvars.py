@@ -769,6 +769,50 @@ class FusedCacheSize(EnvironmentVariable, type=int):
         super().put(value)
 
 
+class MetersEnabled(EnvironmentVariable, type=bool):
+    """graftmeter in-process metric aggregation: counters, gauges, and
+    fixed-bucket histograms over the ``emit_metric`` stream, with
+    ``snapshot()``/``reset()`` and Prometheus/JSON exposition
+    (modin_tpu/observability/meters.py + exposition.py).
+
+    Off by default: the disabled mode costs one module-attribute check per
+    ``emit_metric`` call and allocates no aggregation objects
+    (``meter_alloc_count()`` asserts exactly that, graftscope-style).
+    ``query_stats()`` / ``explain(analyze=True)`` activate per-query
+    accounting for their scope regardless of this switch.
+    """
+
+    varname = "MODIN_TPU_METERS"
+    default = False
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+
+class MetersMaxSeries(EnvironmentVariable, type=int):
+    """Cap on distinct aggregated metric names the graftmeter registry will
+    hold (cardinality guard: runaway interpolated segments cannot grow the
+    registry without bound).  Names past the cap are dropped and counted in
+    the snapshot: ``dropped_series`` (distinct refused names) and
+    ``dropped_observations`` (refused emissions)."""
+
+    varname = "MODIN_TPU_METERS_MAX_SERIES"
+    default = 2048
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Meter series cap should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

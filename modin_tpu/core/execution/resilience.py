@@ -53,6 +53,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
 from modin_tpu.observability.flight_recorder import dump_flight_record
 
@@ -176,10 +177,20 @@ def _run_with_watchdog(op: str, thunk: Callable[[], Any], timeout_s: float) -> A
     # the thunk nest under the caller's call chain instead of floating
     # parentless
     parent_stack = graftscope.snapshot_stack() if graftscope.TRACE_ON else None
+    # same for query-stats scopes: compile events observed inside the thunk
+    # emit on THIS worker thread, and the owning query's rollup must see
+    # them (QueryStats routing is lock-guarded and terminal at scope close,
+    # so a worker abandoned by a watchdog timeout can race the owner's
+    # retry — or outlive the scope — without corrupting the rollup)
+    parent_scopes = (
+        graftmeter.snapshot_scopes() if graftmeter.ACCOUNTING_ON else None
+    )
 
     def runner() -> None:
         if parent_stack is not None:
             graftscope.seed_thread(parent_stack)
+        if parent_scopes is not None:
+            graftmeter.seed_thread_scopes(parent_scopes)
         try:
             result_q.put((True, thunk()))
         except BaseException as err:  # noqa: BLE001 - relayed to caller  # graftlint: disable=EXC-HYGIENE -- watchdog thread relays ANY exception to the waiting caller verbatim
@@ -250,7 +261,13 @@ def engine_call(
         return thunk()
 
     if ResilienceMode.get() == "Disable":
-        return attempt_once()
+        result = attempt_once()
+        # accounting still owes the dispatch count under the bypass knob —
+        # EXPLAIN ANALYZE / the metrics_smoke ceilings must not go blind
+        # just because resilience is off
+        if op == "deploy" and graftmeter.ACCOUNTING_ON:
+            graftmeter.note_dispatch()
+        return result
 
     timeout_s = float(ResilienceWatchdogS.get()) if watchdog else 0.0
     retries = int(ResilienceRetries.get())
@@ -267,12 +284,12 @@ def engine_call(
                 layer="JAX-ENGINE",
                 attrs={"op": op, "attempt": attempt},
             )
-            if op == "deploy":
-                from modin_tpu.observability.compile_ledger import (
-                    compiles_on_this_thread,
-                )
+        if op == "deploy" and sp is not None:
+            from modin_tpu.observability.compile_ledger import (
+                compiles_on_this_thread,
+            )
 
-                compiles_before = compiles_on_this_thread()
+            compiles_before = compiles_on_this_thread()
         try:
             if timeout_s > 0:
                 result = _run_with_watchdog(op, attempt_once, timeout_s)
@@ -326,17 +343,19 @@ def engine_call(
             if sp is not None:
                 graftscope.finish_span(sp, status="error")
             raise
-        if sp is not None:
-            if compiles_before is not None:
-                from modin_tpu.observability.compile_ledger import (
-                    compiles_on_this_thread,
-                    get_compile_ledger,
-                )
+        if compiles_before is not None:
+            from modin_tpu.observability.compile_ledger import (
+                compiles_on_this_thread,
+                get_compile_ledger,
+            )
 
-                get_compile_ledger().record_dispatch(
-                    graftscope.attribution_signature(),
-                    compiled=compiles_on_this_thread() > compiles_before,
-                )
+            compiled = compiles_on_this_thread() > compiles_before
+            get_compile_ledger().record_dispatch(
+                graftscope.attribution_signature(), compiled=compiled
+            )
+        if op == "deploy" and graftmeter.ACCOUNTING_ON:
+            graftmeter.note_dispatch()
+        if sp is not None:
             graftscope.finish_span(sp)
         return result
 

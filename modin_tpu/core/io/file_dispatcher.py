@@ -13,6 +13,8 @@ import os
 from typing import Any, Optional
 
 from modin_tpu.logging import ClassLogger
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
 
 NOT_IMPLEMENTED_MESSAGE = "Implement in children classes!"
@@ -88,8 +90,24 @@ class FileDispatcher(ClassLogger, modin_layer="CORE-IO"):
         with graftscope.span("io.read", layer="CORE-IO", dispatcher=cls.__name__):
             with track_file_leaks():
                 result = cls._read(*args, **kwargs)
+        if graftmeter.ACCOUNTING_ON:
+            cls._note_read_bytes(args, kwargs)
         cls._attach_io_lineage(result, args, kwargs)
         return result
+
+    @classmethod
+    def _note_read_bytes(cls, args: tuple, kwargs: dict) -> None:
+        """Bill this read's source bytes to graftmeter (best-effort)."""
+        try:
+            path = kwargs.get("filepath_or_buffer") or kwargs.get("path") or (
+                args[0] if args else None
+            )
+            if isinstance(path, str):
+                path = cls.get_path(path)
+            if cls.is_local_plain_file(path):
+                emit_metric("io.read.bytes", cls.file_size(path))
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- byte accounting is best-effort; an exotic path simply goes unbilled
+            pass
 
     @classmethod
     def _attach_io_lineage(cls, result: Any, args: tuple, kwargs: dict) -> None:

@@ -284,6 +284,10 @@ class _DeviceLedger:
                 self._spill_events += spilled
             emit_metric("memory.device.spill", spilled)
             emit_metric("memory.device.spill_bytes", freed)
+            # residency gauges: observed after every spill pass so graftmeter
+            # snapshots carry the post-pressure footprint of both ledgers
+            emit_metric("memory.device.resident_bytes", self._total)
+            emit_metric("memory.host.cache_bytes", ledger.total_bytes())
         return freed
 
     def admit(self, estimate_bytes: int, exclude_ids: Any = None) -> None:

@@ -97,9 +97,15 @@ class TpuQueryCompiler(BaseQueryCompiler):
         """An eager compiler over this one's (materialized) frame."""
         return TpuQueryCompiler(self._modin_frame, self._shape_hint)
 
-    def explain(self) -> str:
-        """graftplan EXPLAIN: the logical plan before/after rewrite."""
-        return graftplan_explain.explain_qc(self)
+    def explain(self, analyze: bool = False) -> str:
+        """graftplan EXPLAIN: the logical plan before/after rewrite.
+
+        ``analyze=True`` (EXPLAIN ANALYZE) executes the plan — a pending
+        plan materializes into this compiler, bit-exact vs plain execution
+        — and annotates every node with measured wall time, rows, bytes,
+        and dispatch count, followed by the graftmeter per-query rollup.
+        """
+        return graftplan_explain.explain_qc(self, analyze=analyze)
 
     # ------------------------------------------------------------------ #
     # Data exchange

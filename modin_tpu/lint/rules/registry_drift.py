@@ -8,7 +8,13 @@ Three quiet ways observability rots:
    ``*`` wildcards) must match a pattern declared in ``METRICS`` in
    ``modin_tpu/logging/metrics.py``, every declared pattern must have a
    live emit site, and each pattern's stable dotted prefix must appear in
-   ``docs/``.
+   ``docs/``.  graftmeter adds the **kind** leg: every ``METRICS`` entry
+   must declare a valid meter kind (``counter`` / ``gauge`` /
+   ``histogram``) in position 1, and the histogram declarations are
+   cross-checked both ways against ``HISTOGRAM_BUCKETS`` in
+   ``modin_tpu/observability/meters.py`` — a histogram family without a
+   bucket spec would silently aggregate as a counter, and a bucket spec
+   without a histogram family is dead configuration.
 
 2. **spans** — graftscope's statically-named span emissions
    (``graftscope.span("...")`` / ``graftscope.start_span("...")``) are held
@@ -41,9 +47,15 @@ from modin_tpu.lint.rules._ast_utils import is_docstring
 
 METRICS_SUFFIX = "logging/metrics.py"
 SPANS_SUFFIX = "observability/spans.py"
+METERS_SUFFIX = "observability/meters.py"
 ENVVARS_SUFFIX = "config/envvars.py"
 METRIC_REGISTRY_NAME = "METRICS"
 SPAN_REGISTRY_NAME = "SPANS"
+BUCKETS_NAME = "HISTOGRAM_BUCKETS"
+
+#: meter kinds graftmeter can aggregate (meters.VALID_KINDS, restated here
+#: so the lint tree does not import runtime modules)
+VALID_METER_KINDS = frozenset({"counter", "gauge", "histogram"})
 
 #: function names whose first string argument is a registry-checked span
 #: name (the dynamic-name emitter ``layer_span`` is deliberately absent)
@@ -69,27 +81,99 @@ def _metric_name_pattern(arg: ast.AST) -> Optional[str]:
     return None  # dynamically built name: can't check statically
 
 
-def _declared_patterns(
+def _registry_entries(
     ctx: FileContext, registry_name: str
-) -> Optional[Dict[str, int]]:
-    """{pattern: lineno} from ``<NAME> = (("pattern", "why"), ...)``."""
+) -> Optional[List[ast.expr]]:
+    """The entry nodes of ``<NAME> = ((...), ...)`` — one walk shared by the
+    name and kind legs, so a change to how the registry is declared cannot
+    fix one leg and silently blind the other.  None when the file has no
+    such assignment."""
     for node in ctx.tree.body:
         if isinstance(node, ast.Assign) and any(
             isinstance(t, ast.Name) and t.id == registry_name
             for t in node.targets
         ):
-            patterns: Dict[str, int] = {}
             value = node.value
             if isinstance(value, (ast.Tuple, ast.List)):
-                for entry in value.elts:
-                    if (
-                        isinstance(entry, (ast.Tuple, ast.List))
-                        and entry.elts
-                        and isinstance(entry.elts[0], ast.Constant)
-                        and isinstance(entry.elts[0].value, str)
-                    ):
-                        patterns[entry.elts[0].value] = entry.lineno
-            return patterns
+                return list(value.elts)
+            return []
+    return None
+
+
+def _entry_pattern(entry: ast.expr) -> Optional[Tuple[str, int]]:
+    """``(pattern, lineno)`` when the entry is a tuple/list whose position 0
+    is a string constant; None for any other shape."""
+    if (
+        isinstance(entry, (ast.Tuple, ast.List))
+        and entry.elts
+        and isinstance(entry.elts[0], ast.Constant)
+        and isinstance(entry.elts[0].value, str)
+    ):
+        return entry.elts[0].value, entry.lineno
+    return None
+
+
+def _declared_patterns(
+    ctx: FileContext, registry_name: str
+) -> Optional[Dict[str, int]]:
+    """{pattern: lineno} from ``<NAME> = (("pattern", "why"), ...)``."""
+    entries = _registry_entries(ctx, registry_name)
+    if entries is None:
+        return None
+    patterns: Dict[str, int] = {}
+    for entry in entries:
+        named = _entry_pattern(entry)
+        if named is not None:
+            patterns[named[0]] = named[1]
+    return patterns
+
+
+def _declared_kinds(ctx: FileContext) -> Dict[str, Tuple[Optional[str], int]]:
+    """{pattern: (declared kind or None, lineno)} from the METRICS registry.
+
+    The kind is entry position 1 — ``("pattern", "kind", "description")``.
+    A 2-tuple entry (the pre-graftmeter shape) or a non-constant kind maps
+    to None, which the kind check flags.
+    """
+    out: Dict[str, Tuple[Optional[str], int]] = {}
+    for entry in _registry_entries(ctx, METRIC_REGISTRY_NAME) or ():
+        named = _entry_pattern(entry)
+        if named is None:
+            continue
+        kind: Optional[str] = None
+        if (
+            len(entry.elts) >= 3
+            and isinstance(entry.elts[1], ast.Constant)
+            and isinstance(entry.elts[1].value, str)
+        ):
+            kind = entry.elts[1].value
+        out[named[0]] = (kind, named[1])
+    return out
+
+
+def _declared_buckets(ctx: FileContext) -> Optional[Dict[str, int]]:
+    """{pattern: lineno} from the ``HISTOGRAM_BUCKETS`` dict literal in
+    observability/meters.py (plain or annotated assignment)."""
+    for node in ctx.tree.body:
+        target = None
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == BUCKETS_NAME:
+                    target = node.value
+        elif isinstance(node, ast.AnnAssign):
+            if (
+                isinstance(node.target, ast.Name)
+                and node.target.id == BUCKETS_NAME
+            ):
+                target = node.value
+        if target is None:
+            continue
+        if isinstance(target, ast.Dict):
+            return {
+                key.value: key.lineno
+                for key in target.keys
+                if isinstance(key, ast.Constant) and isinstance(key.value, str)
+            }
     return None
 
 
@@ -129,10 +213,12 @@ def _doc_mention_key(pattern: str) -> str:
 class RegistryDriftRule(Rule):
     id = "REGISTRY-DRIFT"
     description = (
-        "every emit_metric name must match the METRICS registry, every "
-        "graftscope span/start_span name must match the SPANS registry, "
-        "and every MODIN_TPU_* env var must be declared in "
-        "config/envvars.py; all must be mentioned in docs/"
+        "every emit_metric name must match the METRICS registry (with a "
+        "valid meter kind, histogram families cross-checked against "
+        "HISTOGRAM_BUCKETS both ways), every graftscope span/start_span "
+        "name must match the SPANS registry, and every MODIN_TPU_* env var "
+        "must be declared in config/envvars.py; all must be mentioned in "
+        "docs/"
     )
 
     def check_project(self, project: Project) -> Iterator[Finding]:
@@ -144,6 +230,7 @@ class RegistryDriftRule(Rule):
             emit_desc="emit_metric",
             is_emitter=self._is_metric_emitter,
         )
+        yield from self._check_metric_kinds(project)
         yield from self._check_name_registry(
             project,
             suffix=SPANS_SUFFIX,
@@ -251,6 +338,77 @@ class RegistryDriftRule(Rule):
                     "(docs/configuration.md and docs/observability.md hold "
                     "the catalogs)",
                     symbol=f"undocumented-{kind}-{pattern}",
+                )
+
+    # -- meter kinds (graftmeter) ---------------------------------------- #
+
+    def _check_metric_kinds(self, project: Project) -> Iterator[Finding]:
+        """Every METRICS entry declares a valid meter kind; histogram
+        declarations and HISTOGRAM_BUCKETS specs match one-to-one."""
+        kinds: Optional[Dict[str, Tuple[Optional[str], int]]] = None
+        registry_ctx: Optional[FileContext] = None
+        for ctx in project.files_matching(METRICS_SUFFIX):
+            kinds = _declared_kinds(ctx)
+            registry_ctx = ctx
+            if kinds:
+                break
+        if not kinds:
+            return  # no METRICS registry in this tree: nothing to check
+
+        for pattern, (kind, lineno) in sorted(kinds.items()):
+            if kind not in VALID_METER_KINDS:
+                yield Finding(
+                    path=registry_ctx.rel,
+                    line=lineno,
+                    rule=self.id,
+                    message=f"metric '{pattern}' declares "
+                    + (
+                        f"invalid meter kind {kind!r}"
+                        if kind is not None
+                        else "no meter kind"
+                    )
+                    + " (position 1 must be counter/gauge/histogram)",
+                    fix_hint="declare the entry as (pattern, kind, "
+                    "description) with a kind graftmeter can aggregate",
+                    symbol=f"metric-kind-{pattern}",
+                )
+
+        buckets: Optional[Dict[str, int]] = None
+        buckets_ctx: Optional[FileContext] = None
+        for ctx in project.files_matching(METERS_SUFFIX):
+            buckets = _declared_buckets(ctx)
+            buckets_ctx = ctx
+            if buckets is not None:
+                break
+        if buckets is None:
+            return  # meters module absent (snippet trees): skip bucket legs
+
+        for pattern, (kind, lineno) in sorted(kinds.items()):
+            if kind == "histogram" and pattern not in buckets:
+                yield Finding(
+                    path=registry_ctx.rel,
+                    line=lineno,
+                    rule=self.id,
+                    message=f"histogram metric '{pattern}' has no bucket "
+                    f"spec in {METERS_SUFFIX}:{BUCKETS_NAME} (it would "
+                    "silently degrade to a counter)",
+                    fix_hint="add fixed bucket bounds for the family to "
+                    f"{BUCKETS_NAME}",
+                    symbol=f"histogram-without-buckets-{pattern}",
+                )
+        for pattern, lineno in sorted(buckets.items()):
+            declared = kinds.get(pattern)
+            if declared is None or declared[0] != "histogram":
+                yield Finding(
+                    path=buckets_ctx.rel,
+                    line=lineno,
+                    rule=self.id,
+                    message=f"{BUCKETS_NAME} declares buckets for "
+                    f"'{pattern}' but METRICS does not declare it as a "
+                    "histogram",
+                    fix_hint="remove the dead bucket spec or declare the "
+                    "family with kind 'histogram' in METRICS",
+                    symbol=f"buckets-without-histogram-{pattern}",
                 )
 
     # -- env vars ------------------------------------------------------- #

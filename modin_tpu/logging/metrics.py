@@ -1,106 +1,176 @@
 """API timing metrics: named-handler fan-out, isolated from handler failures.
 
 Reference design: /root/reference/modin/logging/metrics.py:33-70.
+
+graftmeter (modin_tpu/observability/meters.py) taps the same stream: while
+aggregation is active it installs ``_aggregate`` and every emitted metric is
+also folded into the in-process counter/gauge/histogram registry and the
+per-query ``QueryStats`` scopes.  While it is off (the default) the only
+cost here is one module-attribute read per call.
 """
 
 from __future__ import annotations
 
 import re
-from typing import Callable, Union
+from typing import Callable, Optional, Union
 
 from modin_tpu.config import MetricsMode
 
 _metric_handlers: list = []
 _metric_name_pattern = re.compile(r"^[a-zA-Z0-9\-_\.]+$")
 
-#: Registry of every metric family this package emits (name pattern, what it
-#: counts).  ``*`` stands for a runtime-interpolated segment (an engine op,
-#: a breaker family, a failure kind).  graftlint's REGISTRY-DRIFT rule
-#: cross-checks this both ways — an ``emit_metric`` name matching no pattern,
-#: or a pattern with no live emit site, fails the lint — and requires each
-#: family's stable prefix to appear in docs/ (see docs/configuration.md).
+#: graftmeter aggregation hook.  None while aggregation is off; set to
+#: ``meters._dispatch_metric`` by modin_tpu/observability/meters.py whenever
+#: ``MODIN_TPU_METERS`` is enabled or a ``query_stats()`` scope is active.
+#: emit_metric reads it once per call — the zero-overhead-when-off contract.
+_aggregate: Optional[Callable[[str, Union[int, float]], None]] = None
+
+#: Registry of every metric family this package emits: (name pattern,
+#: meter kind, what it counts).  ``*`` stands for a runtime-interpolated
+#: segment (an engine op, a breaker family, a failure kind).  The **kind**
+#: declares how graftmeter aggregates the family — ``counter`` (monotonic
+#: sum), ``gauge`` (last value + min/max), or ``histogram`` (fixed buckets
+#: declared in observability/meters.py:HISTOGRAM_BUCKETS, exposing
+#: p50/p95/p99).  graftlint's REGISTRY-DRIFT rule cross-checks all of it
+#: both ways — an ``emit_metric`` name matching no pattern, a pattern with
+#: no live emit site, a missing/invalid kind, or a histogram without (or a
+#: bucket spec without) its registry entry fails the lint — and requires
+#: each family's stable prefix to appear in docs/ (see
+#: docs/configuration.md).
 METRICS = (
     (
         "resilience.engine.*.*",
+        "counter",
         "engine-seam outcomes per op: oom / device_lost / transient / "
         "watchdog_timeout classifications and retry attempts",
     ),
     (
         "resilience.watchdog.*.timeout",
+        "counter",
         "materialize/wait attempts killed by the wall-clock watchdog",
     ),
     (
         "resilience.breaker.*.*",
+        "counter",
         "circuit-breaker lifecycle per device-path family: state "
         "transitions (open/half_open/closed), strikes, latency-budget "
         "violations (slow), and open-breaker short_circuits",
     ),
     (
         "resilience.fallback.*.*",
+        "counter",
         "device failures converted to pandas fallbacks, per family and "
         "failure kind",
     ),
     (
         "resilience.shuffle.slack_retry",
+        "counter",
         "range_shuffle capacity overflows retried with doubled slack",
     ),
     (
         "resilience.shuffle.skew_fallback",
+        "counter",
         "range_shuffle giving up on pathologically skewed keys "
         "(ShuffleSkewError -> non-shuffle fallback)",
     ),
     (
+        "engine.dispatch",
+        "counter",
+        "successful engine-seam deploys (device dispatches); emitted while "
+        "graftmeter accounting is active (meters on or a QueryStats scope)",
+    ),
+    (
+        "engine.compile",
+        "counter",
+        "XLA backend compiles observed by the jax.monitoring listener "
+        "while graftmeter accounting is active",
+    ),
+    (
+        "engine.compile_s",
+        "counter",
+        "XLA backend compile wall seconds (same gating as engine.compile)",
+    ),
+    (
+        "io.read.bytes",
+        "histogram",
+        "bytes parsed per FileDispatcher read (source file size, "
+        "best-effort; emitted while graftmeter accounting is active)",
+    ),
+    (
         "recovery.device_lost",
+        "counter",
         "device-lost events entering the graftguard lineage-recovery "
         "manager (engine-seam terminal DeviceLost or a breaker opening "
         "on one)",
     ),
     (
         "recovery.reseat.*",
+        "counter",
         "device columns re-seated from lineage, per provenance kind "
         "(host / io / op)",
     ),
     (
         "recovery.unrecoverable",
+        "counter",
         "live device columns whose lineage could not reproduce their "
         "buffer during a recovery pass",
     ),
     (
         "recovery.checkpoint_cut",
+        "counter",
         "op-replay lineage chains cut by an automatic host checkpoint at "
         "MODIN_TPU_LINEAGE_MAX_DEPTH",
     ),
     (
         "recovery.retry.*",
+        "counter",
         "engine-seam attempts retried after a recovery action: "
         "device_lost (lineage re-seat), oom (evict-then-retry), or rebind "
         "(deploy re-dispatched over rebuilt argument buffers)",
     ),
     (
         "memory.device.spill",
+        "counter",
         "device columns spilled to host by admission control or the OOM "
         "evict-then-retry leg",
     ),
     (
         "memory.device.spill_bytes",
+        "counter",
         "device bytes freed by spills (exact host copies retained)",
     ),
     (
         "memory.device.restore",
+        "counter",
         "spilled columns transparently re-seated on device on access",
     ),
     (
+        "memory.device.resident_bytes",
+        "gauge",
+        "device-resident bytes tracked by the device ledger, observed "
+        "after each spill pass",
+    ),
+    (
+        "memory.host.cache_bytes",
+        "gauge",
+        "host bytes pinned by device-column caches, observed after each "
+        "spill pass",
+    ),
+    (
         "router.*.*",
+        "counter",
         "graftsort kernel-router decisions per sort-shaped op family "
         "(median/quantile/nunique/mode): device vs host choice counts",
     ),
     (
         "router.calibrate",
+        "counter",
         "one-shot kernel-router micro-benchmark calibrations (cold "
         "CacheDir for this substrate)",
     ),
     (
         "sortcache.*",
+        "counter",
         "sorted-representation cache lifecycle: build (one shared sort "
         "paid), hit (a later sort-shaped op consumed it), invalidate "
         "(buffer mutation / spill / re-seat dropped it), spill (the "
@@ -108,42 +178,62 @@ METRICS = (
     ),
     (
         "plan.defer.scan",
+        "counter",
         "reads deferred into graftplan Scan-rooted logical plans instead "
         "of parsing at the call site",
     ),
     (
         "plan.optimize.passes",
+        "histogram",
         "rewrite passes run to fixpoint (bounded by "
         "MODIN_TPU_PLAN_MAX_PASSES) per plan materialization",
     ),
     (
         "plan.rule.*",
+        "counter",
         "graftplan rewrite-rule applications per rule (pushdown-filter / "
         "cse / prune-columns / pushdown-project-into-scan / "
         "fuse-map-reduce)",
     ),
     (
         "plan.lower.nodes",
+        "histogram",
         "distinct plan nodes lowered per materialization (shared subtrees "
         "count once — the one-scan guarantee is this number)",
     ),
     (
         "plan.scan.pruned_columns",
+        "counter",
         "columns never parsed because projection pushdown narrowed the "
         "reader (per physical pruned read; scans served from a prior "
         "materialization's cache emit nothing)",
     ),
     (
+        "plan.scan.cache_hit",
+        "counter",
+        "scans served from a prior materialization's read cache instead "
+        "of re-parsing the source",
+    ),
+    (
         "fusion.cache.evict",
+        "counter",
         "fused-executable LRU evictions under MODIN_TPU_FUSED_CACHE_SIZE "
         "(ops/lazy.py)",
     ),
     (
+        "fusion.cache.hit",
+        "counter",
+        "fused-executable cache hits (a fused forest re-dispatched without "
+        "re-jitting; emitted while graftmeter accounting is active)",
+    ),
+    (
         "pandas-api.*",
+        "histogram",
         "wall-clock seconds per public pandas-API call (logging layer)",
     ),
     (
         "trace.flight_dump",
+        "counter",
         "graftscope flight-recorder ring dumps written on a breaker-open "
         "or terminal device failure",
     ),
@@ -151,11 +241,24 @@ METRICS = (
 
 
 def emit_metric(name: str, value: Union[int, float]) -> None:
-    """Send ``modin_tpu.<name> = value`` to every registered handler."""
-    if MetricsMode.get() == "Disable":
+    """Send ``modin_tpu.<name> = value`` to every registered handler.
+
+    graftmeter aggregation is a separate consumer from the handler fan-out:
+    ``MODIN_TPU_METRICS_MODE=Disable`` silences the handlers but does NOT
+    turn off an active aggregator (meters on, or a ``query_stats()`` scope)
+    — ``explain(analyze=True)`` must account even in a process that muted
+    its metric handlers.
+    """
+    aggregate = _aggregate
+    handlers_on = MetricsMode.get() != "Disable"
+    if aggregate is None and not handlers_on:
         return
     if not _metric_name_pattern.fullmatch(name):
         raise KeyError(f"Metrics name is not in metric-name dot format, e.g. a.b.c : {name}")
+    if aggregate is not None:
+        aggregate(name, value)
+    if not handlers_on:
+        return
     for fn in list(_metric_handlers):
         try:
             fn(f"modin_tpu.{name}", value)

@@ -48,6 +48,7 @@ from modin_tpu.observability.spans import (  # noqa: F401
     SPANS,
     Profile,
     Span,
+    counter_samples,
     current_span,
     layer_span,
     profile,
@@ -56,6 +57,23 @@ from modin_tpu.observability.spans import (  # noqa: F401
     start_span,
     finish_span,
     trace_enabled,
+)
+from modin_tpu.observability.meters import (  # noqa: F401
+    HISTOGRAM_BUCKETS,
+    QueryStats,
+    meter_alloc_count,
+    meters_enabled,
+    query_stats,
+)
+from modin_tpu.observability.meters import (  # noqa: F401
+    reset as meters_reset,
+    snapshot as meters_snapshot,
+)
+from modin_tpu.observability.exposition import (  # noqa: F401
+    meter_rollup,
+    parse_prometheus,
+    to_json,
+    to_prometheus,
 )
 
 # MODIN_TPU_TRACE=1 at import: the config subscription fired while

@@ -7,6 +7,12 @@ span becomes one complete event (``"ph": "X"``) with microsecond ``ts`` /
 ``dur``, the span's layer as the category, and span/parent ids plus
 attributes under ``args``.  Thread-name metadata events (``"ph": "M"``)
 label each thread lane.
+
+Counter samples (``spans.counter_samples()``, one per span finish) become
+counter-track events (``"ph": "C"``): device-ledger resident bytes,
+host-cache bytes, and the live span count render as value tracks above the
+span lanes, so HBM pressure is visible on the Perfetto timeline alongside
+the spans that caused it.
 """
 
 from __future__ import annotations
@@ -23,8 +29,25 @@ def _json_safe(value: Any) -> Any:
     return str(value)
 
 
-def to_chrome_trace(spans: Iterable[Any], other_data: Optional[dict] = None) -> dict:
-    """Render finished spans as a chrome://tracing-loadable trace object."""
+#: counter-track names, in sample-tuple order (see spans.counter_samples)
+COUNTER_TRACKS = (
+    "memory.device.resident_bytes",
+    "memory.host.cache_bytes",
+    "spans.live",
+)
+
+
+def to_chrome_trace(
+    spans: Iterable[Any],
+    other_data: Optional[dict] = None,
+    counters: Optional[Iterable[tuple]] = None,
+) -> dict:
+    """Render finished spans as a chrome://tracing-loadable trace object.
+
+    ``counters`` is an iterable of ``(ts_us, (device_bytes, host_bytes,
+    live_spans))`` samples; each becomes one "C" event per
+    :data:`COUNTER_TRACKS` track.
+    """
     pid = os.getpid()
     events: List[dict] = []
     thread_names = {}
@@ -50,6 +73,17 @@ def to_chrome_trace(spans: Iterable[Any], other_data: Optional[dict] = None) -> 
                 "args": args,
             }
         )
+    for ts, values in counters or ():
+        for track, value in zip(COUNTER_TRACKS, values):
+            events.append(
+                {
+                    "name": track,
+                    "ph": "C",
+                    "ts": round(ts, 3),
+                    "pid": pid,
+                    "args": {"value": value},
+                }
+            )
     for tid, tname in sorted(thread_names.items()):
         events.append(
             {
@@ -75,10 +109,15 @@ def _json_safe_tree(value: Any) -> Any:
 
 
 def export_chrome_trace(
-    spans: Iterable[Any], path: Any, other_data: Optional[dict] = None
+    spans: Iterable[Any],
+    path: Any,
+    other_data: Optional[dict] = None,
+    counters: Optional[Iterable[tuple]] = None,
 ) -> str:
     """Write the trace JSON to ``path`` (parent dirs created); returns path."""
     p = pathlib.Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
-    p.write_text(json.dumps(to_chrome_trace(spans, other_data=other_data)))
+    p.write_text(
+        json.dumps(to_chrome_trace(spans, other_data=other_data, counters=counters))
+    )
     return str(p)

@@ -122,6 +122,10 @@ def _on_event_duration(event: str, duration: float, **kwargs: object) -> None:
             sp = _spans.current_span()
             if sp is not None:
                 sp.attrs["compile_s"] = sp.attrs.get("compile_s", 0.0) + duration
+        from modin_tpu.observability import meters as _meters
+
+        if _meters.ACCOUNTING_ON:
+            _meters.note_compile(duration)
     except Exception:
         # a broken listener must never break the compile it observes
         pass

@@ -7,7 +7,10 @@ breaker trips OPEN, or a device failure is classified terminal (OOM,
 device-lost, retries exhausted) — it calls ``dump_flight_record`` and the
 last N spans are written as a chrome://tracing-loadable JSON file under
 ``MODIN_TPU_TRACE_DIR``: the trace that *led up to* the failure, tying the
-PR-1 failure taxonomy to its preceding query activity.
+PR-1 failure taxonomy to its preceding query activity.  The dump also
+embeds the graftmeter metrics snapshot taken at dump time under
+``otherData.metrics`` (counter state used to die with the process) plus
+the counter-track samples (device/host residency, live spans).
 
 The dump is strictly best-effort: it never raises into the query path, it
 does nothing while tracing is off (so the default-off mode keeps its
@@ -44,11 +47,14 @@ def flight_snapshot() -> List[object]:
 
 
 def reset_for_tests() -> None:
-    """Clear the ring and the rate limiter (test isolation)."""
+    """Clear the ring, counter samples, and the rate limiter (test isolation)."""
     global _last_dump
     ring = _spans._RING
     if ring is not None:
         ring.clear()
+    counters = _spans._COUNTERS
+    if counters is not None:
+        counters.clear()
     _last_dump = 0.0
 
 
@@ -71,6 +77,17 @@ def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
             return None
         _last_dump = now  # claim the window (concurrent callers back off)
         snapshot = list(ring)
+        counters = list(_spans._COUNTERS or ())
+    try:
+        # counter state at dump time: breaker-open / terminal-failure
+        # forensics keep the aggregated metrics the process dies with
+        # (empty series while MODIN_TPU_METERS is off — still recorded, so
+        # the dump says "meters were off" rather than omitting the key)
+        from modin_tpu.observability import meters as _meters
+
+        metrics_snapshot = _meters.snapshot()
+    except Exception:
+        metrics_snapshot = None
     try:
         from modin_tpu.config import TraceDir
 
@@ -87,7 +104,9 @@ def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
                 "reason": reason,
                 "detail": detail,
                 "spans": len(snapshot),
+                "metrics": metrics_snapshot,
             },
+            counters=counters,
         )
         path.write_text(json.dumps(trace))
         return str(path)

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import sys
 import threading
 import time
 from collections import deque
@@ -138,6 +139,19 @@ _state_lock = threading.Lock()
 #: created/resized by _refresh_enabled from TraceFlightRecorderSize
 _RING: Optional[deque] = None
 
+#: bounded ring of counter samples ``(ts_us, (device_bytes, host_bytes,
+#: live_spans))`` taken at each span finish while tracing is on; rendered
+#: as Chrome-trace counter tracks ("C" events) so HBM pressure is visible
+#: on the Perfetto timeline alongside the spans that caused it
+_COUNTERS: Optional[deque] = None
+
+#: open spans across all threads right now (the third counter track);
+#: maintained only while the counter ring exists (zeroed on ring
+#: reconfiguration), read-modify-write only under _live_lock — threads
+#: finish spans concurrently and a lost update would drift the counter
+_live_spans = 0
+_live_lock = threading.Lock()
+
 _env_enabled = False
 
 
@@ -156,6 +170,7 @@ class Span:
         "thread_id",
         "thread_name",
         "status",
+        "_counted",
     )
 
     def __init__(self, name: str, layer: str, attrs: Optional[dict], parent_id: Optional[int]):
@@ -171,6 +186,7 @@ class Span:
         self.thread_id = t.ident or 0
         self.thread_name = t.name
         self.status = "open"
+        self._counted = False  # did this span increment _live_spans?
 
     def __repr__(self) -> str:  # debugging aid, not part of the export
         return (
@@ -187,7 +203,7 @@ class Span:
 
 def _refresh_enabled() -> None:
     """Recompute TRACE_ON (and size the ring) from config + collectors."""
-    global TRACE_ON, _RING
+    global TRACE_ON, _RING, _COUNTERS, _live_spans
     on = _env_enabled or bool(_collectors)
     if on:
         from modin_tpu.config import TraceFlightRecorderSize
@@ -195,9 +211,19 @@ def _refresh_enabled() -> None:
         size = int(TraceFlightRecorderSize.get())
         if size <= 0:
             _RING = None
+            _COUNTERS = None
+            with _live_lock:
+                _live_spans = 0
         elif _RING is None or _RING.maxlen != size:
+            if _RING is None:
+                # live-span bookkeeping only runs while the ring exists:
+                # restart the counter from zero on (re)enable rather than
+                # trust a value that missed the opens in between
+                with _live_lock:
+                    _live_spans = 0
             # retune a live process: keep the newest spans that still fit
             _RING = deque(_RING or (), maxlen=size)
+            _COUNTERS = deque(_COUNTERS or (), maxlen=size)
     TRACE_ON = on
 
 
@@ -298,20 +324,32 @@ def start_span(
     Callers on hot paths must check ``TRACE_ON`` first; this function
     allocates unconditionally (that is its job).
     """
-    global _alloc_count
+    global _alloc_count, _live_spans
     stack = _stack()
     if parent_id is None and stack:
         parent_id = stack[-1].span_id
     sp = Span(name, layer, attrs, parent_id)
-    _alloc_count += 1
+    _alloc_count += 1  # single-threaded assertion counter: no lock needed
+    if _COUNTERS is not None:
+        # the live-span counter track exists only while the ring does;
+        # don't serialize every traced thread on the lock otherwise
+        sp._counted = True
+        with _live_lock:
+            _live_spans += 1
     stack.append(sp)
     return sp
 
 
 def finish_span(sp: Span, status: str = "ok") -> None:
     """Close a span, pop it, and deliver it to collectors + the ring."""
+    global _live_spans
     sp.dur_us = (time.perf_counter() - _EPOCH_PERF) * 1e6 - sp.start_us
     sp.status = status
+    # only spans that incremented may decrement: a span opened before the
+    # counter ring existed must not consume the count of one opened after
+    if sp._counted and _COUNTERS is not None:
+        with _live_lock:
+            _live_spans = max(_live_spans - 1, 0)
     stack = getattr(_tls, "stack", None)
     if stack:
         if stack[-1] is sp:
@@ -328,10 +366,45 @@ def _deliver(sp: Span) -> None:
     ring = _RING
     if ring is not None:
         ring.append(sp)
+    counters = _COUNTERS
+    if counters is not None:
+        counters.append(
+            (sp.start_us + sp.dur_us, _ledger_bytes() + (_live_spans,))
+        )
     if _collectors:
         with _state_lock:
             for collector in _collectors:
                 collector.append(sp)
+
+
+def _ledger_bytes() -> tuple:
+    """(device-resident bytes, host-cache bytes) — 0s until core.memory is
+    imported (never imported from here: the ledgers import the metric
+    stream, and a sampling-time import could recurse through it)."""
+    memory = sys.modules.get("modin_tpu.core.memory")
+    if memory is None:
+        return (0, 0)
+    try:
+        return (memory.device_ledger.total_bytes(), memory.host_cache_bytes())
+    except Exception:
+        return (0, 0)
+
+
+def counter_samples(
+    start_us: Optional[float] = None, end_us: Optional[float] = None
+) -> List[tuple]:
+    """Counter samples ``(ts_us, (device_bytes, host_bytes, live_spans))``
+    currently in the ring, optionally clipped to a time window (a profile
+    exports only the samples its own spans cover)."""
+    counters = _COUNTERS
+    if counters is None:
+        return []
+    out = list(counters)
+    if start_us is not None:
+        out = [s for s in out if s[0] >= start_us]
+    if end_us is not None:
+        out = [s for s in out if s[0] <= end_us]
+    return out
 
 
 class _SpanHandle:
@@ -488,16 +561,32 @@ class Profile:
 
     # -- export --------------------------------------------------------- #
 
+    def _counter_window(self) -> List[tuple]:
+        """Counter samples covered by this profile's spans."""
+        if not self.spans:
+            return []
+        return counter_samples(
+            start_us=min(sp.start_us for sp in self.spans),
+            end_us=max(sp.start_us + sp.dur_us for sp in self.spans),
+        )
+
     def to_chrome_trace(self) -> dict:
         from modin_tpu.observability.chrome_trace import to_chrome_trace
 
-        return to_chrome_trace(self.spans, other_data={"rollup": self.rollup()})
+        return to_chrome_trace(
+            self.spans,
+            other_data={"rollup": self.rollup()},
+            counters=self._counter_window(),
+        )
 
     def export_chrome_trace(self, path: Any) -> str:
         from modin_tpu.observability.chrome_trace import export_chrome_trace
 
         return export_chrome_trace(
-            self.spans, path, other_data={"rollup": self.rollup()}
+            self.spans,
+            path,
+            other_data={"rollup": self.rollup()},
+            counters=self._counter_window(),
         )
 
 

@@ -34,6 +34,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import meters as graftmeter
+
 _MAX_NODES = 160
 
 _SCALAR_TYPES = (int, float, bool, np.integer, np.floating, np.bool_)
@@ -49,6 +52,8 @@ def _fused_cache_get(key: Any) -> Optional[Any]:
     fn = _FUSED_CACHE.get(key)
     if fn is not None:
         _FUSED_CACHE.move_to_end(key)
+        if graftmeter.ACCOUNTING_ON:
+            emit_metric("fusion.cache.hit", 1)
     return fn
 
 
@@ -67,8 +72,6 @@ def _fused_cache_put(key: Any, fn: Any) -> None:
         evicted += 1
     if evicted:
         _evictions += evicted
-        from modin_tpu.logging.metrics import emit_metric
-
         emit_metric("fusion.cache.evict", evicted)
 
 

@@ -46,12 +46,18 @@ class ModinAPI(ClassLogger, modin_layer="PANDAS-API"):
         """Move to the in-process pandas backend."""
         return self.set_backend("Pandas", inplace=inplace)
 
-    def explain(self) -> str:
+    def explain(self, analyze: bool = False) -> str:
         """graftplan EXPLAIN: the deferred logical plan before/after rewrite
-        with per-rule attribution, or a note that execution is eager."""
+        with per-rule attribution, or a note that execution is eager.
+
+        ``analyze=True`` (EXPLAIN ANALYZE) executes the plan — bit-exact vs
+        plain execution — and annotates every node with its measured wall
+        time, rows, bytes, and engine dispatch count, plus the graftmeter
+        per-query resource rollup (compiles, bytes parsed, HBM high-water,
+        spills, cache hits)."""
         qc = self._data._query_compiler
         if hasattr(qc, "explain"):
-            return qc.explain()
+            return qc.explain(analyze=analyze)
         return f"status: eager ({type(qc).__name__} has no deferred planner)"
 
     def repartition(self, axis: Any = None):

@@ -14,9 +14,35 @@ from modin_tpu.plan.ir import PlanNode
 from modin_tpu.plan.rules import optimize
 
 
-def render(root: PlanNode) -> str:
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KiB"
+    return f"{n}B"
+
+
+def _actual_suffix(measured: Optional[dict]) -> str:
+    """``(actual: ...)`` annotation for one analyzed node."""
+    if measured is None:
+        return ""
+    rows = measured.get("rows")
+    return (
+        "  (actual: "
+        f"time={measured['total_s'] * 1e3:.3f}ms "
+        f"self={measured['self_s'] * 1e3:.3f}ms "
+        f"rows={'?' if rows is None else rows} "
+        f"bytes={_fmt_bytes(measured.get('bytes'))} "
+        f"dispatches={measured['dispatches']})"
+    )
+
+
+def render(root: PlanNode, actuals: Optional[dict] = None) -> str:
     """ASCII tree of a plan; shared (diamond) nodes render once and are
-    referenced as ``^N`` afterwards."""
+    referenced as ``^N`` afterwards.  ``actuals`` (EXPLAIN ANALYZE) maps
+    ``id(node)`` to its measured entry from the instrumented lowering."""
     lines: List[str] = []
     ids: dict = {}
 
@@ -27,7 +53,8 @@ def render(root: PlanNode) -> str:
             lines.append(f"{indent}^{seen} (shared {node.kind})")
             return
         ids[id(node)] = len(ids) + 1
-        lines.append(f"{indent}#{ids[id(node)]} {node.label()}")
+        suffix = _actual_suffix(actuals.get(id(node))) if actuals else ""
+        lines.append(f"{indent}#{ids[id(node)]} {node.label()}{suffix}")
         for child in node.children:
             visit(child, depth + 1)
 
@@ -67,9 +94,47 @@ def explain_plan(
     return "\n".join(parts)
 
 
-def explain_qc(qc: Any) -> str:
+def explain_analyze_qc(qc: Any) -> str:
+    """EXPLAIN ANALYZE: run the plan instrumented and render actuals.
+
+    The plan executes for real (a pending plan materializes into the
+    compiler, exactly as touching ``_modin_frame`` would — results are
+    bit-exact vs plain execution); every executed node is annotated with
+    its measured wall time, result rows/bytes, and engine dispatch count,
+    and the per-query resource rollup (dispatches, compiles, bytes parsed,
+    HBM high-water, spills, recoveries, cache hits) follows the tree.
+    """
+    from modin_tpu.plan import runtime
+
+    analyzed = runtime.explain_analyze(qc)
+    if analyzed is None:
+        return (
+            "status: eager (nothing to analyze; set MODIN_TPU_PLAN=Auto and "
+            "start from a deferrable read, or use modin_tpu.plan.defer_frame)"
+        )
+    stats, actuals, (root, optimized, applied) = analyzed
+    parts = [
+        "status: analyzed (plan executed with per-node measurement)",
+        "== logical plan (before rewrite) ==",
+        render(root),
+        "",
+        "== logical plan (after rewrite, with actuals) ==",
+        render(optimized, actuals=actuals),
+        "",
+        render_attribution(applied or []),
+        "",
+        "== query rollup ==",
+        stats.summary(),
+    ]
+    return "\n".join(parts)
+
+
+def explain_qc(qc: Any, analyze: bool = False) -> str:
     """EXPLAIN for a query compiler: pending plan, last-materialized plan,
-    or a note that execution is eager."""
+    or a note that execution is eager.  ``analyze=True`` additionally
+    executes the plan and annotates every node with measured actuals."""
+    if analyze:
+        return explain_analyze_qc(qc)
     plan = getattr(qc, "_plan", None)
     if plan is not None:
         return "status: deferred (not yet materialized)\n" + explain_plan(plan)

@@ -16,9 +16,11 @@ the acceptance shape is structural, not an optimization.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Tuple
+import time
+from typing import Any, Dict, Optional, Tuple
 
 from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
 from modin_tpu.plan.ir import (
     Filter,
@@ -58,12 +60,24 @@ def lower(root: PlanNode) -> Any:
     return lower_traced(root)[0]
 
 
-def lower_traced(root: PlanNode) -> Tuple[Any, Dict[int, Any]]:
+def lower_traced(
+    root: PlanNode, instrument: Optional[Dict[int, dict]] = None
+) -> Tuple[Any, Dict[int, Any]]:
     """Lower a plan; also returns the node-id -> lowered-compiler memo
-    (the materialization path uses it to adopt a reduction's input)."""
+    (the materialization path uses it to adopt a reduction's input).
+
+    ``instrument`` (EXPLAIN ANALYZE) is a dict filled in place with one
+    entry per lowered node id: measured total/self wall seconds, engine
+    dispatches attributed to the node, and the lowered result's rows/bytes.
+    Shared (memoized) subtrees bill their cost to the first consumer, which
+    is also how the work actually happened.
+    """
     memo: Dict[int, Any] = {}
     was_lowering = in_lowering()
     _tls.lowering = True
+    if instrument is not None:
+        _tls.instrument = instrument
+        _tls.inst_stack = []
     try:
         with graftscope.span(
             "plan.lower", layer="QUERY-COMPILER", nodes=count_nodes(root)
@@ -71,6 +85,9 @@ def lower_traced(root: PlanNode) -> Tuple[Any, Dict[int, Any]]:
             result = _lower(root, memo)
     finally:
         _tls.lowering = was_lowering
+        if instrument is not None:
+            _tls.instrument = None
+            _tls.inst_stack = None
     emit_metric("plan.lower.nodes", len(memo))
     return result, memo
 
@@ -79,6 +96,68 @@ def _lower(node: PlanNode, memo: Dict[int, Any]) -> Any:
     hit = memo.get(id(node))
     if hit is not None:
         return hit
+    instrument = getattr(_tls, "instrument", None)
+    if instrument is None:
+        return _lower_node(node, memo)
+    # EXPLAIN ANALYZE: time the node's lowering and attribute engine
+    # dispatches; parent frames accumulate child totals so self = total -
+    # children even though each lowerer recurses internally
+    stack = _tls.inst_stack
+    frame = {"child_s": 0.0, "child_disp": 0}
+    stack.append(frame)
+    t0 = time.perf_counter()
+    d0 = graftmeter.thread_dispatches()
+    try:
+        result = _lower_node(node, memo)
+    finally:
+        stack.pop()
+        total_s = time.perf_counter() - t0
+        total_disp = graftmeter.thread_dispatches() - d0
+        if stack:
+            parent = stack[-1]
+            parent["child_s"] += total_s
+            parent["child_disp"] += total_disp
+    instrument[id(node)] = {
+        "total_s": total_s,
+        "self_s": max(total_s - frame["child_s"], 0.0),
+        "dispatches": max(total_disp - frame["child_disp"], 0),
+        "total_dispatches": total_disp,
+        "rows": _result_rows(result),
+        "bytes": _result_bytes(result),
+    }
+    return result
+
+
+def _result_rows(qc: Any) -> Optional[int]:
+    """Row count of a lowered compiler, without forcing anything."""
+    try:
+        frame = qc._frame
+        return len(frame) if frame is not None else None
+    except Exception:
+        return None
+
+
+def _result_bytes(qc: Any) -> Optional[int]:
+    """Concrete bytes held by a lowered compiler's columns (device buffers
+    plus host arrays; deferred/lazy columns are skipped, never forced)."""
+    try:
+        frame = qc._frame
+        if frame is None:
+            return None
+        total = 0
+        for col in frame._columns:
+            if getattr(col, "is_device", False):
+                if col.is_lazy or col._data is None:
+                    continue
+                total += int(getattr(col._data, "nbytes", 0) or 0)
+            else:
+                total += int(getattr(col.data, "nbytes", 0) or 0)
+        return total
+    except Exception:
+        return None
+
+
+def _lower_node(node: PlanNode, memo: Dict[int, Any]) -> Any:
     try:
         result = _LOWERERS[type(node)](node, memo)
     except Exception as exc:
@@ -112,8 +191,10 @@ def _lower_scan(node: Scan, memo: Dict[int, Any]) -> Any:
     # must not re-parse the file per force()
     for key, cached in (origin.cache or {}).items():
         if key is None and need is None:
+            emit_metric("plan.scan.cache_hit", 1)
             return cached
         if need is not None and (key is None or set(need) <= set(key)):
+            emit_metric("plan.scan.cache_hit", 1)
             return cached.getitem_column_array(list(need))
     kwargs = scan_read_kwargs(node)
     if need is not None:

@@ -430,7 +430,9 @@ def defer_sort(
 # ---------------------------------------------------------------------- #
 
 
-def _optimize_and_lower(qc: Any, root: PlanNode) -> Tuple[Any, dict]:
+def _optimize_and_lower(
+    qc: Any, root: PlanNode, instrument: Optional[dict] = None
+) -> Tuple[Any, dict]:
     """One optimize+lower pass; records EXPLAIN attribution on ``qc``."""
     from modin_tpu.plan.ir import count_nodes
 
@@ -442,9 +444,49 @@ def _optimize_and_lower(qc: Any, root: PlanNode) -> Tuple[Any, dict]:
     emit_metric("plan.optimize.passes", passes)
     for name, _pass_index in applied:
         emit_metric(f"plan.rule.{name}", 1)
-    result, memo = lowering.lower_traced(optimized)
+    result, memo = lowering.lower_traced(optimized, instrument=instrument)
     qc._plan_explain = (root, optimized, applied)
     return result, memo
+
+
+def explain_analyze(qc: Any) -> Optional[Tuple[Any, dict, Any]]:
+    """EXPLAIN ANALYZE: execute ``qc``'s plan with per-node instrumentation.
+
+    Returns ``(stats, instrument, (root, optimized, applied))`` — the
+    :class:`~modin_tpu.observability.meters.QueryStats` rollup, the node-id
+    -> measured-actuals dict, and the plan history of this run (the
+    actuals key off ``id()`` of nodes in the returned ``optimized`` tree)
+    — or None when there is nothing to analyze (a plain eager compiler
+    with no plan history).
+
+    A *pending* plan is executed and its frame adopted, exactly like
+    :func:`force` (so a later op on the compiler continues from the
+    materialized result, and the analyze run IS the query's execution — the
+    bit-exactness contract).  An already-materialized compiler with plan
+    history re-executes the recorded plan (scans may be served from the
+    scan cache; the annotations say so via their measured bytes/time) and
+    the re-run result is discarded.
+    """
+    from modin_tpu.observability import meters as graftmeter
+
+    # tolerate non-graftplan compilers the way the analyze=False branch
+    # does: report "nothing to analyze" instead of AttributeError
+    plan = getattr(qc, "_plan", None)
+    pending = plan is not None
+    if pending:
+        root = plan
+    else:
+        history = getattr(qc, "_plan_explain", None)
+        if history is None:
+            return None
+        root = history[0]
+    instrument: dict = {}
+    with graftmeter.query_stats("explain.analyze") as stats:
+        result, _memo = _optimize_and_lower(qc, root, instrument=instrument)
+    if pending:
+        qc._frame = result._modin_frame
+        qc._plan = None
+    return stats, instrument, qc._plan_explain
 
 
 def force(qc: Any):

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all nine gates, fail on any red
+#   ./scripts/check_all.sh            # all ten gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -25,6 +25,12 @@
 #       MODIN_TPU_PLAN=Auto must be bit-exact vs eager and pandas, take
 #       <= 2 compile-ledger dispatches for the device leg, and provably
 #       never parse pruned columns (reader spy)
+#   0f. graftmeter smoke: explain(analyze=True) on the plan_smoke pipeline
+#       must be bit-exact with every plan node annotated, the
+#       Prometheus/JSON exposition must parse, and the measured efficiency
+#       counters (dispatches/compiles/reads/bytes/pruned columns) must
+#       hold against scripts/metrics_baseline.json — re-record intentional
+#       changes with `python scripts/metrics_smoke.py --record`
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -53,6 +59,7 @@ run_gate "graftscope"      python scripts/trace_smoke.py
 run_gate "graftguard"      python scripts/chaos_smoke.py
 run_gate "bench_smoke"     python scripts/bench_smoke.py
 run_gate "graftplan"       python scripts/plan_smoke.py
+run_gate "graftmeter"      python scripts/metrics_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -62,4 +69,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL NINE GATES GREEN"
+echo "ALL TEN GATES GREEN"

@@ -1,0 +1,217 @@
+"""graftmeter smoke gate: exposition, EXPLAIN ANALYZE, efficiency invariants.
+
+Run by scripts/check_all.sh (tenth gate).  Executes the graftplan smoke
+pipeline (``read_csv(6 cols).query("a > 0")[["b","c"]].agg(...)``) under
+``MODIN_TPU_PLAN=Auto`` with ``MODIN_TPU_METERS=1`` and asserts the
+graftmeter contract:
+
+1. **EXPLAIN ANALYZE is the execution**: ``df.modin.explain(analyze=True)``
+   executes the pending plan, annotates every optimized-plan node with
+   measured wall time / rows / bytes / dispatch count, and the subsequent
+   aggregation result is bit-exact vs ``MODIN_TPU_PLAN=Off`` and pandas.
+2. **The exposition parses**: the Prometheus text rendering of the meter
+   snapshot round-trips through the validating parser, and the JSON
+   rendering round-trips through ``json.loads``.
+3. **Efficiency invariants hold**: the pipeline's measured counters
+   (engine dispatches, XLA compiles, physical reads, bytes parsed, pruned
+   columns) are checked against the recorded baseline in
+   ``scripts/metrics_baseline.json`` — a refactor that silently doubles
+   dispatches, re-reads the file, or stops pruning columns turns this gate
+   red.  Re-record an intentional change with
+   ``python scripts/metrics_smoke.py --record``.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+
+The invariant helpers (``load_baseline`` / ``check_invariants``) are
+importable without side effects — tests/test_meters.py uses them to prove
+the gate actually fails on an inflated dispatch count.
+"""
+
+import json
+import os
+import sys
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "metrics_baseline.json")
+
+#: measured-vs-baseline slack: exact for counts, 2% for bytes (float
+#: formatting wiggle across library versions changes the CSV's size)
+TOLERANCE = {"bytes_parsed": 0.02}
+
+
+def load_baseline(path: str = BASELINE_PATH) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def check_invariants(measured: dict, baseline: dict) -> list:
+    """Failure messages for every efficiency invariant ``measured`` breaks.
+
+    ``baseline["max"]`` are cost ceilings (dispatches, compiles, reads,
+    bytes): measured may not exceed them.  ``baseline["min"]`` are benefit
+    floors (pruned columns): measured may not fall below.  An empty return
+    means the gate is green.
+    """
+    failures = []
+    for key, ceiling in baseline.get("max", {}).items():
+        got = measured.get(key)
+        if got is None:
+            failures.append(f"invariant '{key}' was not measured")
+            continue
+        slack = TOLERANCE.get(key, 0.0)
+        if got > ceiling * (1 + slack):
+            failures.append(
+                f"efficiency regression: {key} = {got} exceeds the recorded "
+                f"baseline {ceiling}"
+                + (f" (+{slack:.0%} slack)" if slack else "")
+            )
+    for key, floor in baseline.get("min", {}).items():
+        got = measured.get(key)
+        if got is None:
+            failures.append(f"invariant '{key}' was not measured")
+            continue
+        if got < floor:
+            failures.append(
+                f"efficiency regression: {key} = {got} fell below the "
+                f"recorded baseline {floor}"
+            )
+    return failures
+
+
+def main(record: bool = False) -> int:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MODIN_TPU_PLAN"] = "Auto"
+    os.environ["MODIN_TPU_METERS"] = "1"
+
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+    import pandas
+
+    import modin_tpu.pandas as pd
+    from modin_tpu.config import PlanMode
+    from modin_tpu.observability import meters
+    from modin_tpu.observability.exposition import (
+        meter_rollup,
+        parse_prometheus,
+        to_json,
+        to_prometheus,
+    )
+
+    n_rows = 50_000
+    path = os.path.join(tempfile.mkdtemp(prefix="graftmeter_smoke_"), "smoke.csv")
+    rng = np.random.default_rng(7)
+    pandas.DataFrame(
+        {
+            "a": rng.integers(-50, 50, n_rows),
+            "b": rng.uniform(0.0, 1.0, n_rows),
+            "c": rng.uniform(-1.0, 1.0, n_rows),
+            "d": rng.integers(0, 1000, n_rows),
+            "e": rng.uniform(0.0, 100.0, n_rows),
+            "f": rng.integers(0, 2, n_rows),
+        }
+    ).to_csv(path, index=False)
+
+    assert meters.METERS_ON, "MODIN_TPU_METERS=1 did not enable aggregation"
+    meters.reset()
+
+    # ---- the pipeline, executed BY explain(analyze=True) --------------- #
+    md = pd.read_csv(path)
+    assert md._query_compiler._plan is not None, "read_csv did not defer"
+    md3 = md.query("a > 0")[["b", "c"]]
+    analyzed = md3.modin.explain(analyze=True)
+    assert "status: analyzed" in analyzed, analyzed.splitlines()[0]
+    planned = md3.agg("sum").modin.to_pandas()
+    # snapshot NOW: the baseline must reflect the planned pipeline alone,
+    # not the eager control run below
+    snapshot = meters.snapshot()
+
+    # every optimized-plan node carries measured actuals
+    after = analyzed.split("== logical plan (after rewrite, with actuals) ==")[1]
+    after = after.split("rewrites:")[0]
+    node_lines = [
+        ln for ln in after.splitlines() if ln.strip().startswith("#")
+    ]
+    unannotated = [ln for ln in node_lines if "(actual:" not in ln]
+    assert node_lines and not unannotated, (
+        f"plan nodes missing actuals: {unannotated or 'no nodes rendered'}"
+    )
+    for field in ("time=", "rows=", "bytes=", "dispatches="):
+        assert all(field in ln for ln in node_lines), (
+            f"annotation missing {field!r}: {node_lines}"
+        )
+    assert "== query rollup ==" in analyzed, "no QueryStats rollup block"
+
+    # ---- bit-exact: analyze-mode pipeline == eager (Off) == pandas ----- #
+    with PlanMode.context("Off"):
+        eager = (
+            pd.read_csv(path).query("a > 0")[["b", "c"]].agg("sum").modin.to_pandas()
+        )
+    reference = pandas.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+    pandas.testing.assert_series_equal(planned, reference)
+    pandas.testing.assert_series_equal(eager, reference)
+
+    # ---- exposition parses --------------------------------------------- #
+    assert snapshot["series"], "meters captured nothing"
+    prom = to_prometheus(snapshot)
+    parsed = parse_prometheus(prom)
+    assert parsed, "prometheus exposition parsed to nothing"
+    assert any(v["type"] == "histogram" for v in parsed.values()), (
+        "no histogram family in the exposition"
+    )
+    round_tripped = json.loads(to_json(snapshot))
+    assert round_tripped["series"].keys() == snapshot["series"].keys()
+
+    # ---- efficiency invariants vs the recorded baseline ---------------- #
+    rollup = meter_rollup(snapshot)
+    series = snapshot["series"]
+    measured = {
+        "dispatches": rollup["dispatches"],
+        "compiles": rollup["compiles"],
+        "io_reads": rollup["io_reads"],
+        "bytes_parsed": rollup["bytes_parsed"],
+        "pruned_columns": series.get("plan.scan.pruned_columns", {}).get(
+            "total", 0
+        ),
+    }
+    if record:
+        baseline = {
+            "pipeline": "read_csv(6 cols).query('a > 0')[['b','c']]"
+            ".explain(analyze=True) + .agg('sum')  [plan_smoke shape]",
+            "max": {
+                key: measured[key]
+                for key in ("dispatches", "compiles", "io_reads", "bytes_parsed")
+            },
+            "min": {"pruned_columns": measured["pruned_columns"]},
+        }
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"metrics baseline recorded: {measured} -> {BASELINE_PATH}")
+        return 0
+    baseline = load_baseline()
+    failures = check_invariants(measured, baseline)
+    assert not failures, "; ".join(failures)
+
+    print(
+        "graftmeter smoke OK: analyze bit-exact, every node annotated, "
+        f"exposition parses ({len(parsed)} families), invariants hold "
+        f"({measured})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main(record="--record" in sys.argv[1:]))
+    except AssertionError as err:
+        print(f"graftmeter smoke FAILED: {err}", file=sys.stderr)
+        sys.exit(1)

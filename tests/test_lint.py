@@ -403,8 +403,8 @@ def test_exc_hygiene_pragma_suppresses(tmp_path):
 
 _METRICS_STUB = """
 METRICS = (
-    ("app.good.*", "a documented family"),
-    ("app.dead.counter", "declared but never emitted"),
+    ("app.good.*", "counter", "a documented family"),
+    ("app.dead.counter", "counter", "declared but never emitted"),
 )
 """
 
@@ -443,6 +443,73 @@ def test_registry_drift_positive(tmp_path):
     # dead pattern is also undocumented; the good family + ALPHA are fine
     assert "undocumented-metric-app.good.*" not in symbols
     assert "undocumented-envvar-MODIN_TPU_ALPHA" not in symbols
+
+
+def test_registry_drift_metric_kinds(tmp_path):
+    """graftmeter leg: kinds must be valid, histogram declarations and
+    HISTOGRAM_BUCKETS specs must match one-to-one."""
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/logging/metrics.py": """
+            METRICS = (
+                ("app.ok.counter", "counter", "fine"),
+                ("app.ok.hist", "histogram", "fine, has buckets"),
+                ("app.kindless", "an entry still in the 2-tuple shape"),
+                ("app.bad.kind", "sketch", "not a meter kind"),
+                ("app.hist.nobuckets", "histogram", "no bucket spec"),
+            )
+            """,
+            "modin_tpu/observability/meters.py": """
+            HISTOGRAM_BUCKETS = {
+                "app.ok.hist": (0.1, 1.0, 10.0),
+                "app.orphan.buckets": (1, 2, 4),
+            }
+            """,
+            "modin_tpu/work.py": """
+            def f():
+                emit_metric("app.ok.counter", 1)
+                emit_metric("app.ok.hist", 0.5)
+                emit_metric("app.kindless", 1)
+                emit_metric("app.bad.kind", 1)
+                emit_metric("app.hist.nobuckets", 1)
+            """,
+        },
+        select=["REGISTRY-DRIFT"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "metric-kind-app.kindless" in symbols
+    assert "metric-kind-app.bad.kind" in symbols
+    assert "histogram-without-buckets-app.hist.nobuckets" in symbols
+    assert "buckets-without-histogram-app.orphan.buckets" in symbols
+    # well-declared entries are clean on the kind leg
+    assert "metric-kind-app.ok.counter" not in symbols
+    assert "metric-kind-app.ok.hist" not in symbols
+    assert "histogram-without-buckets-app.ok.hist" not in symbols
+    assert "buckets-without-histogram-app.ok.hist" not in symbols
+
+
+def test_registry_drift_metric_kinds_skip_without_meters_module(tmp_path):
+    """A snippet tree without observability/meters.py skips the bucket
+    cross-check but still validates kinds."""
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/logging/metrics.py": """
+            METRICS = (
+                ("app.hist", "histogram", "buckets live elsewhere"),
+            )
+            """,
+            "modin_tpu/work.py": """
+            def f():
+                emit_metric("app.hist", 1)
+            """,
+        },
+        select=["REGISTRY-DRIFT"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "histogram-without-buckets-app.hist" not in symbols
+    assert "metric-kind-app.hist" not in symbols
 
 
 _SPANS_STUB = """

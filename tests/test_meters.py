@@ -1,0 +1,611 @@
+"""graftmeter acceptance: aggregation, per-query accounting, exposition.
+
+Acceptance bar (ISSUE 7): counters stay exact under multi-threaded
+increments; histogram percentiles are accurate on known distributions;
+``QueryStats`` scopes are isolated across interleaved queries on two
+threads; disabled mode (``MODIN_TPU_METERS=0``) allocates ZERO aggregation
+objects across a real workload; the Prometheus/JSON exposition round-trips
+through its validating parser; the metrics_smoke efficiency gate actually
+fails on an inflated dispatch count; flight-recorder dumps embed a metrics
+snapshot (including on the rate-limited path); and
+``explain(analyze=True)`` annotates every executed plan node while staying
+bit-exact.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import MetersEnabled, MetersMaxSeries, TraceDir, TraceEnabled
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import exposition, flight_recorder, meters
+from modin_tpu.observability.chrome_trace import COUNTER_TRACKS, to_chrome_trace
+
+
+@pytest.fixture(autouse=True)
+def _meters_off_between_tests():
+    """Every test starts and ends with meters off and an empty registry."""
+    MetersEnabled.put(False)
+    meters.reset()
+    yield
+    MetersEnabled.put(False)
+    meters.reset()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _collect_cyclic_residue():
+    """The analyze tests build plan graphs whose reference cycles keep dead
+    frames (and their device-ledger entries) alive until a full gc pass;
+    collect at module teardown so later suites see an empty ledger."""
+    yield
+    import gc
+
+    gc.collect()
+
+
+def _require_tpu_on_jax():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("planned execution requires the TpuOnJax execution")
+
+
+def _smoke_module():
+    """Import scripts/metrics_smoke.py (not a package) for its helpers."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "metrics_smoke.py",
+    )
+    spec = importlib.util.spec_from_file_location("metrics_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ====================================================================== #
+# meter correctness
+# ====================================================================== #
+
+
+class TestCounters:
+    def test_multithreaded_increments_are_exact(self):
+        MetersEnabled.put(True)
+        threads, per_thread = 8, 5000
+        barrier = threading.Barrier(threads)
+
+        def worker():
+            barrier.wait()
+            for _ in range(per_thread):
+                emit_metric("resilience.shuffle.slack_retry", 1)
+
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        series = meters.snapshot()["series"]["resilience.shuffle.slack_retry"]
+        assert series["kind"] == "counter"
+        assert series["total"] == threads * per_thread
+        assert series["count"] == threads * per_thread
+
+    def test_kind_resolution_from_registry(self):
+        MetersEnabled.put(True)
+        emit_metric("resilience.engine.deploy.oom", 1)  # counter (wildcard)
+        emit_metric("io.read.bytes", 2048)  # histogram
+        emit_metric("memory.device.resident_bytes", 512)  # gauge
+        emit_metric("some.adhoc.test.name", 3)  # undeclared -> counter
+        series = meters.snapshot()["series"]
+        assert series["resilience.engine.deploy.oom"]["kind"] == "counter"
+        assert series["io.read.bytes"]["kind"] == "histogram"
+        assert series["memory.device.resident_bytes"]["kind"] == "gauge"
+        assert series["some.adhoc.test.name"]["kind"] == "counter"
+
+    def test_max_series_cardinality_guard(self):
+        MetersEnabled.put(True)
+        old = MetersMaxSeries.get()
+        MetersMaxSeries.put(4)
+        try:
+            for i in range(10):
+                emit_metric(f"cardinality.burst.k{i}", 1)
+            emit_metric("cardinality.burst.k9", 1)  # repeat a dropped name
+            snap = meters.snapshot()
+            assert len(snap["series"]) == 4
+            # distinct refused names vs raw refused emissions
+            assert snap["dropped_series"] == 6
+            assert snap["dropped_observations"] == 7
+        finally:
+            MetersMaxSeries.put(old)
+
+    def test_reset_clears_registry(self):
+        MetersEnabled.put(True)
+        emit_metric("sortcache.hit", 1)
+        assert meters.snapshot()["series"]
+        meters.reset()
+        assert meters.snapshot()["series"] == {}
+
+
+class TestGauge:
+    def test_last_value_min_max(self):
+        gauge = meters.Gauge()
+        for v in (5, 1, 9, 3):
+            gauge.add(v)
+        snap = gauge.snapshot()
+        assert snap == {"kind": "gauge", "value": 3, "min": 1, "max": 9, "count": 4}
+
+
+class TestHistogram:
+    def test_percentiles_on_known_uniform(self):
+        bounds = tuple(float(b) for b in range(100, 1100, 100))
+        hist = meters.Histogram(bounds)
+        for v in range(1, 1001):  # exact uniform over (0, 1000]
+            hist.add(v)
+        snap = hist.snapshot()
+        assert snap["count"] == 1000
+        assert snap["sum"] == sum(range(1, 1001))
+        assert snap["min"] == 1 and snap["max"] == 1000
+        # linear interpolation inside 100-wide buckets: within one bucket
+        assert abs(snap["p50"] - 500) <= 100
+        assert abs(snap["p95"] - 950) <= 100
+        assert abs(snap["p99"] - 990) <= 100
+        # cumulative bucket counts are monotone and end at count
+        cums = [c for _b, c in snap["buckets"]]
+        assert cums == sorted(cums) and cums[-1] == 1000
+
+    def test_overflow_bucket_and_percentile_clamp(self):
+        hist = meters.Histogram((1.0, 2.0))
+        for v in (0.5, 1.5, 10.0, 20.0):
+            hist.add(v)
+        snap = hist.snapshot()
+        assert snap["count"] == 4
+        # overflow values pull the high percentiles above the last bound
+        assert snap["p99"] > 2.0
+        assert snap["p99"] <= 20.0
+
+    def test_empty_percentile_is_none(self):
+        hist = meters.Histogram((1.0,))
+        assert hist.percentile(0.5) is None
+        assert hist.snapshot()["p50"] is None
+
+    def test_single_value_percentiles_degenerate(self):
+        hist = meters.Histogram((1.0, 10.0))
+        hist.add(5.0)
+        assert hist.snapshot()["p50"] == pytest.approx(5.0)
+
+
+# ====================================================================== #
+# disabled-mode contract
+# ====================================================================== #
+
+
+class TestDisabledMode:
+    def test_zero_alloc_without_meters(self):
+        df = pd.DataFrame({"a": np.arange(64.0), "b": np.arange(64.0)})
+        _ = (df + 1).sum().modin.to_pandas()  # warm every code path
+        before = meters.meter_alloc_count()
+        df2 = pd.DataFrame({"a": np.arange(64.0), "b": np.arange(64.0)})
+        _ = (df2 * 2).sum().modin.to_pandas()
+        _ = df2.shape
+        assert meters.meter_alloc_count() == before
+        # the hook itself is uninstalled, not just inert
+        from modin_tpu.logging import metrics as metrics_mod
+
+        assert metrics_mod._aggregate is None
+        assert not meters.ACCOUNTING_ON
+
+    def test_enable_disable_flips_fast_path(self):
+        assert not meters.ACCOUNTING_ON
+        MetersEnabled.put(True)
+        assert meters.ACCOUNTING_ON and meters.METERS_ON
+        MetersEnabled.put(False)
+        assert not meters.ACCOUNTING_ON and not meters.METERS_ON
+
+
+# ====================================================================== #
+# per-query accounting
+# ====================================================================== #
+
+
+class TestQueryStats:
+    def test_scope_accounts_without_meters_enabled(self):
+        assert not meters.METERS_ON
+        with meters.query_stats("adhoc") as qs:
+            assert meters.ACCOUNTING_ON  # scope flips the fast path
+            emit_metric("engine.dispatch", 1)
+            emit_metric("engine.compile", 1)
+            emit_metric("engine.compile_s", 0.25)
+            emit_metric("io.read.bytes", 4096)
+            emit_metric("fusion.cache.hit", 1)
+            emit_metric("recovery.device_lost", 1)
+        assert not meters.ACCOUNTING_ON  # restored on exit
+        assert qs.dispatches == 1
+        assert qs.compiles == 1
+        assert qs.compile_s == pytest.approx(0.25)
+        assert qs.bytes_parsed == 4096 and qs.io_reads == 1
+        assert qs.cache_hits["fused"] == 1
+        assert qs.recoveries == 1
+        assert qs.wall_s > 0
+        # the ad-hoc scope left nothing in the (disabled) registry
+        assert meters.snapshot()["series"] == {}
+
+    def test_nested_scopes_both_account(self):
+        with meters.query_stats("outer") as outer:
+            emit_metric("engine.dispatch", 1)
+            with meters.query_stats("inner") as inner:
+                emit_metric("engine.dispatch", 1)
+        assert outer.dispatches == 2
+        assert inner.dispatches == 1
+
+    def test_isolation_across_interleaved_threads(self):
+        """Two queries interleaved on two threads never cross-bill."""
+        results = {}
+        b1, b2 = threading.Barrier(2), threading.Barrier(2)
+
+        def query(name, dispatches, read_bytes):
+            with meters.query_stats(name) as qs:
+                b1.wait()  # both scopes open before either emits
+                for _ in range(dispatches):
+                    emit_metric("engine.dispatch", 1)
+                emit_metric("io.read.bytes", read_bytes)
+                b2.wait()  # both emitted before either scope closes
+            results[name] = qs
+
+        t1 = threading.Thread(target=query, args=("q1", 3, 100))
+        t2 = threading.Thread(target=query, args=("q2", 5, 999))
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        assert results["q1"].dispatches == 3
+        assert results["q1"].bytes_parsed == 100
+        assert results["q2"].dispatches == 5
+        assert results["q2"].bytes_parsed == 999
+
+    def test_watchdog_worker_thread_bills_owning_scope(self):
+        """Metrics emitted on the resilience watchdog's daemon thread roll
+        into the query_stats scope open on the calling thread (the compile
+        listener fires inside the watched thunk, i.e. on the worker)."""
+        from modin_tpu.core.execution.resilience import _run_with_watchdog
+
+        def thunk():
+            emit_metric("engine.compile", 1)
+            emit_metric("engine.compile_s", 0.5)
+            return "ok"
+
+        with meters.query_stats("watched") as qs:
+            assert _run_with_watchdog("materialize", thunk, 30.0) == "ok"
+        assert qs.compiles == 1
+        assert qs.compile_s == pytest.approx(0.5)
+
+    def test_abandoned_worker_cannot_mutate_closed_scope(self):
+        """A seeded worker the owner abandoned (watchdog timeout) emits
+        after the scope closed: the late emission must not land in the
+        rollup the owner already read."""
+        MetersEnabled.put(True)  # keep the emit hook installed post-close
+        release, seeded = threading.Event(), threading.Event()
+
+        def worker(scopes):
+            meters.seed_thread_scopes(scopes)
+            seeded.set()
+            release.wait(5)
+            emit_metric("engine.compile", 1)  # fires after scope exit
+
+        with meters.query_stats("abandoned") as qs:
+            emit_metric("engine.compile", 1)
+            t = threading.Thread(
+                target=worker, args=(meters.snapshot_scopes(),), daemon=True
+            )
+            t.start()
+            assert seeded.wait(5)
+        release.set()
+        t.join(5)
+        # the registry saw both compiles; the closed scope only the first
+        assert meters.snapshot()["series"]["engine.compile"]["total"] == 2
+        assert qs.compiles == 1
+
+    def test_as_dict_and_summary_are_complete(self):
+        with meters.query_stats("q") as qs:
+            emit_metric("engine.dispatch", 1)
+        d = qs.as_dict()
+        for key in (
+            "wall_s",
+            "dispatches",
+            "compiles",
+            "compile_s",
+            "bytes_parsed",
+            "spills",
+            "restores",
+            "recoveries",
+            "cache_hits",
+            "hbm_high_water",
+        ):
+            assert key in d
+        text = qs.summary()
+        assert "device dispatches: 1" in text
+
+
+# ====================================================================== #
+# exposition
+# ====================================================================== #
+
+
+class TestExposition:
+    def _snapshot_with_all_kinds(self):
+        MetersEnabled.put(True)
+        emit_metric("sortcache.hit", 2)  # counter
+        emit_metric("memory.device.resident_bytes", 1024)  # gauge
+        emit_metric("io.read.bytes", 4096)  # histogram
+        emit_metric("io.read.bytes", 1 << 22)
+        return meters.snapshot()
+
+    def test_prometheus_round_trip(self):
+        snap = self._snapshot_with_all_kinds()
+        text = exposition.to_prometheus(snap)
+        parsed = exposition.parse_prometheus(text)
+        assert parsed["modin_tpu_sortcache_hit"]["type"] == "counter"
+        assert parsed["modin_tpu_sortcache_hit"]["samples"][
+            "modin_tpu_sortcache_hit"
+        ] == 2
+        assert parsed["modin_tpu_memory_device_resident_bytes"]["type"] == "gauge"
+        hist = parsed["modin_tpu_io_read_bytes"]
+        assert hist["type"] == "histogram"
+        assert hist["samples"]["modin_tpu_io_read_bytes_count"] == 2
+        assert hist["samples"]["modin_tpu_io_read_bytes_sum"] == 4096 + (1 << 22)
+        assert any("_bucket" in k for k in hist["samples"])
+
+    def test_json_round_trip(self):
+        snap = self._snapshot_with_all_kinds()
+        loaded = json.loads(exposition.to_json(snap))
+        assert loaded["series"].keys() == snap["series"].keys()
+        assert loaded["series"]["io.read.bytes"]["p50"] is not None
+
+    @pytest.mark.parametrize(
+        "bad_text",
+        [
+            "not a metric line at all {",
+            "# TYPE modin_tpu_x sketchy\nmodin_tpu_x 1",
+            "modin_tpu_orphan 1",  # sample before TYPE declaration
+            # non-cumulative histogram buckets
+            "# TYPE modin_tpu_h histogram\n"
+            'modin_tpu_h_bucket{le="1"} 5\n'
+            'modin_tpu_h_bucket{le="2"} 3\n',
+        ],
+    )
+    def test_parser_rejects_malformed(self, bad_text):
+        with pytest.raises(ValueError):
+            exposition.parse_prometheus(bad_text)
+
+    def test_meter_rollup_schema_stable_on_empty(self):
+        rollup = exposition.meter_rollup({"series": {}})
+        assert rollup["dispatches"] == 0
+        assert rollup["bytes_parsed"] == 0
+        assert rollup["cache_hits"] == {"fused": 0, "sorted_rep": 0, "plan_scan": 0}
+
+    def test_meter_rollup_reads_series(self):
+        snap = self._snapshot_with_all_kinds()
+        rollup = exposition.meter_rollup(snap)
+        assert rollup["bytes_parsed"] == 4096 + (1 << 22)
+        assert rollup["io_reads"] == 2
+        assert rollup["cache_hits"]["sorted_rep"] == 2
+
+
+# ====================================================================== #
+# the efficiency-invariant gate
+# ====================================================================== #
+
+
+class TestMetricsSmokeGate:
+    def test_gate_fails_on_inflated_dispatch_count(self):
+        """The acceptance demonstration: a refactor that silently doubles
+        the pipeline's dispatch count turns the gate red."""
+        smoke = _smoke_module()
+        baseline = {
+            "max": {"dispatches": 2, "compiles": 2, "io_reads": 1},
+            "min": {"pruned_columns": 3},
+        }
+        ok = {"dispatches": 2, "compiles": 2, "io_reads": 1, "pruned_columns": 3}
+        assert smoke.check_invariants(ok, baseline) == []
+        inflated = dict(ok, dispatches=4)
+        failures = smoke.check_invariants(inflated, baseline)
+        assert failures and "dispatches" in failures[0]
+
+    def test_gate_fails_on_lost_pruning_and_missing_keys(self):
+        smoke = _smoke_module()
+        baseline = {"max": {"dispatches": 2}, "min": {"pruned_columns": 3}}
+        failures = smoke.check_invariants(
+            {"dispatches": 2, "pruned_columns": 0}, baseline
+        )
+        assert any("pruned_columns" in f for f in failures)
+        failures = smoke.check_invariants({"pruned_columns": 3}, baseline)
+        assert any("not measured" in f for f in failures)
+
+    def test_bytes_tolerance_is_applied(self):
+        smoke = _smoke_module()
+        baseline = {"max": {"bytes_parsed": 1000}, "min": {}}
+        assert smoke.check_invariants({"bytes_parsed": 1015}, baseline) == []
+        assert smoke.check_invariants({"bytes_parsed": 1100}, baseline)
+
+    def test_recorded_baseline_exists_and_is_wellformed(self):
+        smoke = _smoke_module()
+        baseline = smoke.load_baseline()
+        assert set(baseline["max"]) == {
+            "dispatches",
+            "compiles",
+            "io_reads",
+            "bytes_parsed",
+        }
+        assert baseline["min"]["pruned_columns"] >= 1
+
+
+# ====================================================================== #
+# counter tracks + flight recorder embedding
+# ====================================================================== #
+
+
+class TestCounterTracks:
+    def test_chrome_trace_counter_events_from_samples(self):
+        samples = [(10.0, (111, 222, 3)), (20.0, (444, 555, 6))]
+        trace = to_chrome_trace([], counters=samples)
+        cevents = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+        assert len(cevents) == len(samples) * len(COUNTER_TRACKS)
+        by_name = {}
+        for e in cevents:
+            by_name.setdefault(e["name"], []).append(e["args"]["value"])
+        assert by_name["memory.device.resident_bytes"] == [111, 444]
+        assert by_name["memory.host.cache_bytes"] == [222, 555]
+        assert by_name["spans.live"] == [3, 6]
+
+    def test_profile_export_carries_counter_tracks(self):
+        import modin_tpu.observability as graftscope
+
+        flight_recorder.reset_for_tests()
+        with graftscope.profile() as prof:
+            df = pd.DataFrame({"k": [i % 5 for i in range(128)], "v": np.arange(128.0)})
+            agg = df.groupby("k").sum()
+            agg._query_compiler.execute()
+        trace = prof.to_chrome_trace()
+        tracks = {e["name"] for e in trace["traceEvents"] if e["ph"] == "C"}
+        assert set(COUNTER_TRACKS) <= tracks
+        json.dumps(trace)  # loadable
+
+
+class TestFlightRecorderMetricsSnapshot:
+    @pytest.fixture(autouse=True)
+    def _tracing_reset(self):
+        TraceEnabled.put(False)
+        flight_recorder.reset_for_tests()
+        yield
+        TraceEnabled.put(False)
+        flight_recorder.reset_for_tests()
+
+    def _arm_and_span(self, tmp_path):
+        TraceDir.put(str(tmp_path))
+        TraceEnabled.put(True)
+        from modin_tpu.observability import spans as graftscope_spans
+
+        with graftscope_spans.span("io.read", layer="CORE-IO"):
+            pass
+
+    def test_dump_embeds_metrics_snapshot(self, tmp_path):
+        MetersEnabled.put(True)
+        emit_metric("sortcache.hit", 7)
+        self._arm_and_span(tmp_path)
+        path = flight_recorder.dump_flight_record("unit_metrics")
+        assert path is not None
+        data = json.loads(open(path).read())
+        embedded = data["otherData"]["metrics"]
+        assert embedded["enabled"] is True
+        assert embedded["series"]["sortcache.hit"]["total"] == 7
+
+    def test_rate_limited_path_regression(self, tmp_path):
+        """The metrics embedding must not break rate limiting: the second
+        dump inside the window stays suppressed, and the limiter window is
+        still released on a failed write."""
+        MetersEnabled.put(True)
+        emit_metric("sortcache.hit", 1)
+        self._arm_and_span(tmp_path)
+        first = flight_recorder.dump_flight_record("unit_rate")
+        assert first is not None
+        assert flight_recorder.dump_flight_record("unit_rate") is None
+        # outside the window it dumps again, still with the snapshot
+        flight_recorder._last_dump = 0.0
+        second = flight_recorder.dump_flight_record("unit_rate2")
+        assert second is not None and second != first
+        assert "metrics" in json.loads(open(second).read())["otherData"]
+
+    def test_dump_with_meters_off_records_disabled_snapshot(self, tmp_path):
+        self._arm_and_span(tmp_path)
+        path = flight_recorder.dump_flight_record("unit_off")
+        assert path is not None
+        embedded = json.loads(open(path).read())["otherData"]["metrics"]
+        assert embedded["enabled"] is False
+
+
+# ====================================================================== #
+# EXPLAIN ANALYZE
+# ====================================================================== #
+
+
+class TestExplainAnalyze:
+    def _csv(self, tmp_path, rows=200):
+        path = str(tmp_path / "t.csv")
+        rng = np.random.default_rng(3)
+        pandas.DataFrame(
+            {
+                "a": rng.integers(-10, 10, rows),
+                "b": rng.uniform(0, 1, rows),
+                "c": rng.uniform(0, 1, rows),
+                "d": rng.integers(0, 5, rows),
+            }
+        ).to_csv(path, index=False)
+        return path
+
+    def test_analyze_annotates_every_node_and_stays_bit_exact(self, tmp_path):
+        _require_tpu_on_jax()
+        from modin_tpu.config import PlanMode
+
+        path = self._csv(tmp_path)
+        with PlanMode.context("Auto"):
+            md = pd.read_csv(path).query("a > 0")[["b", "c"]]
+            if md._query_compiler._plan is None:
+                pytest.skip("read did not defer under this configuration")
+            text = md.modin.explain(analyze=True)
+            result = md.agg("sum").modin.to_pandas()
+        assert "status: analyzed" in text
+        after = text.split("with actuals) ==")[1].split("rewrites:")[0]
+        node_lines = [ln for ln in after.splitlines() if ln.strip().startswith("#")]
+        assert node_lines
+        for ln in node_lines:
+            assert "(actual:" in ln, ln
+            for field in ("time=", "rows=", "bytes=", "dispatches="):
+                assert field in ln, ln
+        assert "== query rollup ==" in text
+        reference = pandas.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+        pandas.testing.assert_series_equal(result, reference)
+
+    def test_analyze_attributes_dispatches_and_wall_time(self, tmp_path):
+        _require_tpu_on_jax()
+        from modin_tpu.config import PlanMode
+        from modin_tpu.plan import runtime
+
+        path = self._csv(tmp_path)
+        with PlanMode.context("Auto"):
+            md = pd.read_csv(path).query("a > 0")[["b", "c"]]
+            if md._query_compiler._plan is None:
+                pytest.skip("read did not defer under this configuration")
+            analyzed = runtime.explain_analyze(md._query_compiler)
+        assert analyzed is not None
+        stats, actuals, (_root, _optimized, _applied) = analyzed
+        assert stats.dispatches >= 1
+        assert stats.wall_s > 0
+        assert stats.bytes_parsed > 0
+        # dispatch attribution: per-node self dispatches sum to the rollup
+        assert sum(m["dispatches"] for m in actuals.values()) == stats.dispatches
+        # every actual entry has a measured time
+        assert all(m["total_s"] >= m["self_s"] >= 0 for m in actuals.values())
+
+    def test_analyze_on_plain_eager_compiler_reports_eager(self):
+        df = pd.DataFrame({"a": [1, 2, 3]})
+        text = df.modin.explain(analyze=True)
+        assert text.startswith("status: eager")
+
+    def test_analyze_tolerates_non_graftplan_compiler(self):
+        """A compiler without _plan/_plan_explain (any non-Tpu backend) gets
+        the eager note, not an AttributeError — same as analyze=False."""
+        from modin_tpu.plan import runtime
+        from modin_tpu.plan.explain import explain_qc
+
+        assert runtime.explain_analyze(object()) is None
+        assert explain_qc(object(), analyze=True).startswith("status: eager")
+
+    def test_alloc_free_when_analyze_not_used(self, tmp_path):
+        """explain(analyze=False) keeps the old contract: no QueryStats."""
+        df = pd.DataFrame({"a": [1, 2, 3]})
+        before = meters.meter_alloc_count()
+        _ = df.modin.explain()
+        assert meters.meter_alloc_count() == before

@@ -311,6 +311,13 @@ class TestFlightRecorder:
     def _fast_dumps(self, monkeypatch):
         monkeypatch.setattr(flight_recorder, "MIN_DUMP_INTERVAL_S", 0.0)
         reset_breakers()
+        # cyclic garbage from earlier suites (plan graphs pin compilers until
+        # a full gc pass) can leave columns in the device ledger, and a
+        # resident ledger turns the injected terminal OOM below into a
+        # successful evict-then-retry — collect so the injection is terminal
+        import gc
+
+        gc.collect()
         yield
         reset_breakers()
 
