@@ -95,6 +95,12 @@ _RUN_T0 = time.monotonic()
 MODE1_ROWS = int(os.environ.get("BENCH_MODE1_ROWS", 100_000))
 # graftsort section shape (the VERDICT r5 regression shape: 1e7 x 5 int64)
 SORT_ROWS = int(os.environ.get("BENCH_SORT_ROWS", 10_000_000))
+# graftplan / recovery / shuffle-apply section shapes (single source: the
+# run-provenance scale record keys the perf-history regression gate, so the
+# recorded value and the value the section actually uses must be one)
+PLAN_ROWS = int(os.environ.get("BENCH_PLAN_ROWS", 2_000_000))
+RECOVERY_ROWS = int(os.environ.get("BENCH_RECOVERY_ROWS", 2_000_000))
+APPLY_ROWS = int(os.environ.get("BENCH_APPLY_ROWS", 10_000_000))
 # lineage steady-state overhead budget, percent: 10% is the full-scale
 # acceptance number; reduced-scale smoke runs loosen it (a ~10ms workload
 # at BENCH_RECOVERY_ROWS=1.5e5 flakes on scheduler noise alone)
@@ -109,8 +115,67 @@ class SectionTimeout(BaseException):
     able to swallow the section's own alarm."""
 
 
+# Only run the named (comma-separated) sections; everything else emits an
+# explicit {"skipped": "sections-filter"} line so the accounting invariant
+# (every section accounted for, always) survives the filter.  Used by
+# scripts/perf_history_smoke.py to fold a fast subset into the ledger.
+SECTION_FILTER = {
+    s.strip() for s in os.environ.get("BENCH_SECTIONS", "").split(",") if s.strip()
+}
+
+# run provenance attached to every streamed line (git SHA, substrate,
+# library versions, row-scale config) so each BENCH stream is
+# self-identifying when folded into PERF_HISTORY.json; filled in by main()
+# once the platform is known
+_PROVENANCE: dict = {}
+
+
+def _git_sha() -> str:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _run_provenance(platform: str) -> dict:
+    import jax
+    import pandas
+
+    return {
+        "git_sha": _git_sha(),
+        "substrate": platform,
+        "jax": jax.__version__,
+        "pandas": pandas.__version__,
+        "python": ".".join(str(v) for v in sys.version_info[:3]),
+        "scale": {
+            "rows": ROWS,
+            "axis1_rows": AXIS1_ROWS,
+            "mode1_rows": MODE1_ROWS,
+            "udf_rows": UDF_ROWS,
+            "sort_rows": SORT_ROWS,
+            "plan_rows": PLAN_ROWS,
+            "recovery_rows": RECOVERY_ROWS,
+            "apply_rows": APPLY_ROWS,
+            "repeats": REPEATS,
+            "meters": METERS,
+        },
+    }
+
+
 def _emit_line(payload: dict) -> None:
     """One flushed json line — partial progress survives an outer kill."""
+    if _PROVENANCE:
+        payload = {**payload, "run_provenance": _PROVENANCE}
     print(json.dumps(payload), flush=True)
 
 
@@ -432,6 +497,9 @@ def _shuffle_apply_section() -> dict:
         env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
     env["JAX_PLATFORMS"] = "cpu"
+    # the snippet reads BENCH_APPLY_ROWS itself; pin it so the recorded
+    # provenance scale and the subprocess workload cannot disagree
+    env["BENCH_APPLY_ROWS"] = str(APPLY_ROWS)
     out = {}
     for mode in ("shuffle", "cliff", "pandas"):
         try:
@@ -478,6 +546,10 @@ def main() -> None:
     # CPU-substrate runs are flagged non-comparable anyway; don't spend 20+
     # extra minutes of driver time perfecting them
     repeats = REPEATS if on_tpu else 1
+
+    # every streamed line from here on is self-identifying (sha, substrate,
+    # versions, scale) — PERF_HISTORY.json folds need no side channel
+    _PROVENANCE.update(_run_provenance(platform))
 
     rng = np.random.default_rng(0)
 
@@ -661,7 +733,7 @@ def main() -> None:
         from modin_tpu.config import PlanMode, TraceEnabled
         from modin_tpu.observability.compile_ledger import get_compile_ledger
 
-        n = int(os.environ.get("BENCH_PLAN_ROWS", 2_000_000))
+        n = PLAN_ROWS
         csv_path = os.path.join(
             _tempfile.mkdtemp(prefix="graftplan_bench_"), "plan.csv"
         )
@@ -742,7 +814,7 @@ def main() -> None:
         from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
         from modin_tpu.parallel.engine import JaxWrapper
 
-        n = int(os.environ.get("BENCH_RECOVERY_ROWS", 2_000_000))
+        n = RECOVERY_ROWS
         datar = {f"c{i}": rng.integers(0, 100, n) for i in range(3)}
         reps = max(repeats, 3)
 
@@ -822,6 +894,9 @@ def main() -> None:
         ("shuffle_apply_virtual_mesh", shuffle_apply),
     ]
     for name, fn in section_list:
+        if SECTION_FILTER and name not in SECTION_FILTER:
+            _emit_line({"section": name, "skipped": "sections-filter"})
+            continue
         remaining = (
             DEADLINE_S - (time.monotonic() - _RUN_T0)
             if DEADLINE_S > 0
@@ -891,7 +966,7 @@ def main() -> None:
             "to the >=5x TPU target. See BENCH_r03.json for the last "
             "real-TPU run (7.34x on the r03 op subset)."
         )
-    print(json.dumps(payload), flush=True)
+    _emit_line(payload)
 
 
 if __name__ == "__main__":

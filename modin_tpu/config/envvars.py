@@ -813,6 +813,49 @@ class MetersMaxSeries(EnvironmentVariable, type=int):
         super().put(value)
 
 
+class CostCapture(EnvironmentVariable, type=str):
+    """graftcost XLA cost-model capture (modin_tpu/observability/costs.py):
+    per-signature flops/bytes/transcendentals from ``cost_analysis()``,
+    padding-waste accounting at the device padding sites, and the achieved
+    FLOP/s / bandwidth / roofline join in ``query_stats()`` and
+    ``explain(analyze=True)``.
+
+    - ``Auto`` (default): capture is active exactly while graftmeter
+      accounting is (``MODIN_TPU_METERS=1`` or an open ``query_stats()``
+      scope) — zero overhead otherwise;
+    - ``On``: always capture (cost_analysis via the compile-free AOT
+      ``lower()`` path);
+    - ``Full``: also capture ``memory_analysis()`` (peak/temp/argument
+      bytes) — pays one extra AOT backend compile per billed compile, with
+      the compile-ledger listener suppressed so the extra compile is never
+      billed as workload;
+    - ``Off``: never capture, even while accounting is on.
+    """
+
+    varname = "MODIN_TPU_COST_CAPTURE"
+    default = "Auto"
+    choices = ("Auto", "On", "Full", "Off")
+
+
+class PerfGateTolerance(EnvironmentVariable, type=float):
+    """Regression tolerance for the perf-history gate
+    (scripts/perf_history.py): a new bench run whose op wall exceeds the
+    best recorded same-(op, substrate, rows) wall by more than this factor
+    fails the gate.  1.5 absorbs CPU-substrate scheduler noise while still
+    rejecting a 2x regression outright."""
+
+    varname = "MODIN_TPU_PERF_GATE_TOLERANCE"
+    default = 1.5
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value < 1.0:
+            raise ValueError(
+                f"Perf gate tolerance should be >= 1.0, passed value {value}"
+            )
+        super().put(value)
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

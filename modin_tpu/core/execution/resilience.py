@@ -219,6 +219,7 @@ def engine_call(
     thunk: Callable[[], Any],
     watchdog: bool = False,
     protect_ids: Optional[set] = None,
+    cost_cb: Optional[Callable[[bool, Any, float], None]] = None,
 ) -> Any:
     """Run one engine-seam invocation under the resilience policy.
 
@@ -244,6 +245,14 @@ def engine_call(
 
     Both legs are skipped while a recovery pass is itself on the stack
     (no recursive recovery) and when ``MODIN_TPU_RECOVERY_MODE=Disable``.
+
+    ``cost_cb`` (graftcost, deploy only) runs on the dispatching thread
+    after a successful attempt with ``(compiled, attempt_span,
+    attempt_wall_s)`` — while the ``engine.<op>.attempt`` span is still
+    open, so static cost attributes land on the span that did the work,
+    and with the wall of the successful attempt alone (retries/backoff
+    excluded).  It is pre-guarded (never raises) and only passed while
+    ``costs.COST_ON``.
     """
     from modin_tpu.config import (
         ResilienceBackoffS,
@@ -261,12 +270,29 @@ def engine_call(
         return thunk()
 
     if ResilienceMode.get() == "Disable":
+        compiles_before = None
+        if op == "deploy" and cost_cb is not None:
+            from modin_tpu.observability.compile_ledger import (
+                compiles_on_this_thread,
+            )
+
+            compiles_before = compiles_on_this_thread()
+        attempt_t0 = time.perf_counter()
         result = attempt_once()
+        attempt_wall = time.perf_counter() - attempt_t0
         # accounting still owes the dispatch count under the bypass knob —
         # EXPLAIN ANALYZE / the metrics_smoke ceilings must not go blind
         # just because resilience is off
         if op == "deploy" and graftmeter.ACCOUNTING_ON:
             graftmeter.note_dispatch()
+        if compiles_before is not None:
+            from modin_tpu.observability.compile_ledger import (
+                compiles_on_this_thread,
+            )
+
+            cost_cb(
+                compiles_on_this_thread() > compiles_before, None, attempt_wall
+            )
         return result
 
     timeout_s = float(ResilienceWatchdogS.get()) if watchdog else 0.0
@@ -284,12 +310,13 @@ def engine_call(
                 layer="JAX-ENGINE",
                 attrs={"op": op, "attempt": attempt},
             )
-        if op == "deploy" and sp is not None:
+        if op == "deploy" and (sp is not None or cost_cb is not None):
             from modin_tpu.observability.compile_ledger import (
                 compiles_on_this_thread,
             )
 
             compiles_before = compiles_on_this_thread()
+        attempt_t0 = time.perf_counter()
         try:
             if timeout_s > 0:
                 result = _run_with_watchdog(op, attempt_once, timeout_s)
@@ -350,9 +377,14 @@ def engine_call(
             )
 
             compiled = compiles_on_this_thread() > compiles_before
-            get_compile_ledger().record_dispatch(
-                graftscope.attribution_signature(), compiled=compiled
-            )
+            if sp is not None:
+                get_compile_ledger().record_dispatch(
+                    graftscope.attribution_signature(), compiled=compiled
+                )
+            if cost_cb is not None:
+                # the SUCCESSFUL attempt's wall: failed attempts and the
+                # backoff sleeps between them are never billed as dispatch
+                cost_cb(compiled, sp, time.perf_counter() - attempt_t0)
         if op == "deploy" and graftmeter.ACCOUNTING_ON:
             graftmeter.note_dispatch()
         if sp is not None:

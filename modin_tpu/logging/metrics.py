@@ -91,6 +91,45 @@ METRICS = (
         "XLA backend compile wall seconds (same gating as engine.compile)",
     ),
     (
+        "engine.cost.flops",
+        "counter",
+        "XLA-estimated floating-point operations per dispatched program "
+        "(graftcost static capture; compiles capture fresh, cache hits "
+        "re-bill the memoized estimate)",
+    ),
+    (
+        "engine.cost.bytes",
+        "counter",
+        "XLA-estimated bytes accessed (HBM traffic) per dispatched "
+        "program, same capture/re-bill gating as engine.cost.flops",
+    ),
+    (
+        "engine.cost.transcendentals",
+        "counter",
+        "XLA-estimated transcendental-function evaluations per dispatched "
+        "program (emitted only when nonzero)",
+    ),
+    (
+        "engine.cost.peak_bytes",
+        "gauge",
+        "memory_analysis peak bytes of the dispatched executable "
+        "(argument+output+temp fallback when the backend reports no "
+        "explicit peak; MODIN_TPU_COST_CAPTURE=Full only)",
+    ),
+    (
+        "engine.cost.padded_bytes",
+        "counter",
+        "physical bytes of padded device allocations observed at the "
+        "padding sites (shard-multiple, pow2 histogram bins, groupby "
+        "output buckets, sort sentinels)",
+    ),
+    (
+        "engine.cost.padding_waste_bytes",
+        "counter",
+        "the pad share of engine.cost.padded_bytes: physical minus "
+        "logical bytes — arithmetic/traffic spent on rows no one reads",
+    ),
+    (
         "io.read.bytes",
         "histogram",
         "bytes parsed per FileDispatcher read (source file size, "

@@ -75,6 +75,13 @@ from modin_tpu.observability.exposition import (  # noqa: F401
     to_json,
     to_prometheus,
 )
+from modin_tpu.observability.costs import (  # noqa: F401
+    CostLedger,
+    get_cost_ledger,
+    note_padding,
+    roofline_fraction,
+    substrate_peaks,
+)
 
 # MODIN_TPU_TRACE=1 at import: the config subscription fired while
 # compile_ledger was still initializing and deferred the listener install —

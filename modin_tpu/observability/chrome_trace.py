@@ -29,11 +29,18 @@ def _json_safe(value: Any) -> Any:
     return str(value)
 
 
-#: counter-track names, in sample-tuple order (see spans.counter_samples)
+#: counter-track names, in sample-tuple order (see spans.counter_samples).
+#: zip() pairs tracks with sample values and stops at the shorter side, so
+#: samples recorded before a track existed simply omit it.
 COUNTER_TRACKS = (
     "memory.device.resident_bytes",
     "memory.host.cache_bytes",
     "spans.live",
+    # graftcost: cumulative padding-waste bytes and the most recent
+    # achieved-bandwidth sample (bytes/s) — roofline pressure next to the
+    # HBM tracks on the same Perfetto timeline
+    "engine.cost.padding_waste_bytes",
+    "engine.cost.achieved_bw_bytes_s",
 )
 
 
@@ -45,8 +52,8 @@ def to_chrome_trace(
     """Render finished spans as a chrome://tracing-loadable trace object.
 
     ``counters`` is an iterable of ``(ts_us, (device_bytes, host_bytes,
-    live_spans))`` samples; each becomes one "C" event per
-    :data:`COUNTER_TRACKS` track.
+    live_spans, padding_waste_bytes, achieved_bw))`` samples; each becomes
+    one "C" event per :data:`COUNTER_TRACKS` track.
     """
     pid = os.getpid()
     events: List[dict] = []

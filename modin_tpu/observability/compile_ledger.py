@@ -28,14 +28,32 @@ when XLA actually compiles); it is installed at engine startup
 
 from __future__ import annotations
 
+import contextlib
 import threading
-from typing import Dict, Optional
+from typing import Dict, Iterator, Optional
 
 from modin_tpu.observability import spans as _spans
 
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _tls = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_listener() -> Iterator[None]:
+    """Hide compile events fired on this thread from the ledger.
+
+    graftcost's ``Full`` capture mode AOT-compiles a program the engine
+    already compiled (``memory_analysis()`` needs the executable); without
+    suppression that duplicate backend compile would be billed as workload
+    — doubling ``engine.compile`` counts and poisoning the cache-hit
+    accounting the metrics gate checks.
+    """
+    _tls.suppress = getattr(_tls, "suppress", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.suppress -= 1
 
 
 class CompileLedger:
@@ -57,6 +75,16 @@ class CompileLedger:
                 "cache_hits": 0,
             }
         return entry
+
+    def record_cost(self, signature: str, cost: dict) -> None:
+        """Attach graftcost static-cost fields (flops / bytes accessed /
+        transcendentals, memory sizes under Full capture) to a signature's
+        entry.  Unknown fields stay ``"unknown"`` — never absent-by-crash."""
+        from modin_tpu.observability.costs import _merge_known
+
+        with self._lock:
+            entry = self._entry(signature)
+            _merge_known(entry.setdefault("cost", {}), cost)
 
     def record_compile(self, signature: str, duration_s: float) -> None:
         with self._lock:
@@ -115,6 +143,8 @@ def compiles_on_this_thread() -> int:
 def _on_event_duration(event: str, duration: float, **kwargs: object) -> None:
     if event != COMPILE_EVENT:
         return
+    if getattr(_tls, "suppress", 0):
+        return  # graftcost's own AOT capture compile: not workload
     try:
         _tls.compiles = getattr(_tls, "compiles", 0) + 1
         _LEDGER.record_compile(_spans.attribution_signature(), duration)

@@ -180,4 +180,14 @@ def meter_rollup(snapshot: Optional[dict] = None) -> dict:
             "plan_scan": total("plan.scan.cache_hit"),
         },
         "api_calls": api_calls,
+        # graftcost: estimated work + padding waste (0 when cost capture
+        # was off or the section dispatched nothing)
+        "cost": {
+            "est_flops": float(total("engine.cost.flops")),
+            "est_bytes": float(total("engine.cost.bytes")),
+            "padded_bytes": int(total("engine.cost.padded_bytes")),
+            "padding_waste_bytes": int(
+                total("engine.cost.padding_waste_bytes")
+            ),
+        },
     }

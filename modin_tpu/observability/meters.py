@@ -357,6 +357,12 @@ def _refresh_enabled() -> None:
         from modin_tpu.logging import metrics  # noqa: F811
     if metrics is not None:
         metrics._aggregate = _dispatch_metric if on else None
+    # graftcost Auto mode piggybacks on ACCOUNTING_ON; only poke the module
+    # if something already imported it (same no-import rule as the ledger
+    # sampling seam) — costs recomputes on ITS import/config path otherwise
+    costs = sys.modules.get("modin_tpu.observability.costs")
+    if costs is not None:
+        costs._refresh()
 
 
 def _on_meters_param(param: Any) -> None:
@@ -453,6 +459,10 @@ class QueryStats:
         "cache_hits",
         "hbm_high_water",
         "api_calls",
+        "est_flops",
+        "est_bytes",
+        "padded_bytes",
+        "padding_waste_bytes",
         "_t0",
         "_lock",
         "_closed",
@@ -483,6 +493,12 @@ class QueryStats:
         self.cache_hits = {"fused": 0, "sorted_rep": 0, "plan_scan": 0}
         self.hbm_high_water = 0
         self.api_calls = 0
+        # graftcost: estimated hardware cost + padding waste (0 until the
+        # cost-capture seams observe work under this scope)
+        self.est_flops = 0.0
+        self.est_bytes = 0.0
+        self.padded_bytes = 0
+        self.padding_waste_bytes = 0
         self._t0 = time.perf_counter()
 
     # -- stream routing -------------------------------------------------- #
@@ -512,6 +528,14 @@ class QueryStats:
         elif name == "memory.device.restore":
             self.restores += int(value)
             self._sample_hbm()
+        elif name == "engine.cost.flops":
+            self.est_flops += value
+        elif name == "engine.cost.bytes":
+            self.est_bytes += value
+        elif name == "engine.cost.padded_bytes":
+            self.padded_bytes += int(value)
+        elif name == "engine.cost.padding_waste_bytes":
+            self.padding_waste_bytes += int(value)
         elif name == "sortcache.hit":
             self.cache_hits["sorted_rep"] += int(value)
         elif name == "fusion.cache.hit":
@@ -547,6 +571,10 @@ class QueryStats:
             "cache_hits": dict(self.cache_hits),
             "hbm_high_water": self.hbm_high_water,
             "api_calls": self.api_calls,
+            "est_flops": self.est_flops,
+            "est_bytes": self.est_bytes,
+            "padded_bytes": self.padded_bytes,
+            "padding_waste_bytes": self.padding_waste_bytes,
         }
 
     def summary(self) -> str:
@@ -561,8 +589,35 @@ class QueryStats:
             f"{self.spills} ({self.spill_bytes} bytes), restores: "
             f"{self.restores}, recoveries: {self.recoveries}",
             f"cache hits: {hits}",
+            self._cost_line(),
         ]
         return "\n".join(lines)
+
+    def _cost_line(self) -> str:
+        """The graftcost rollup line: estimated work, padding share, and
+        the achieved roofline fraction joined to this scope's wall."""
+        from modin_tpu.observability import costs as _costs
+
+        pad_pct = (
+            f"{self.padding_waste_bytes / self.padded_bytes:.0%}"
+            if self.padded_bytes > 0
+            else "?"
+        )
+        roofline = "?"
+        try:
+            fraction = _costs.roofline_fraction(
+                self.est_flops or None, self.est_bytes or None, self.wall_s
+            )
+            if fraction is not None:
+                roofline = f"{fraction:.1%}"
+        except Exception:
+            pass
+        return (
+            f"est cost: {self.est_flops:.3g} flops, "
+            f"{self.est_bytes:.3g} bytes moved; padding waste: "
+            f"{self.padding_waste_bytes} of {self.padded_bytes} padded "
+            f"bytes ({pad_pct}); roofline: {roofline}"
+        )
 
 
 def snapshot_scopes() -> Optional[List["QueryStats"]]:

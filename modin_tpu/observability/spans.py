@@ -369,7 +369,10 @@ def _deliver(sp: Span) -> None:
     counters = _COUNTERS
     if counters is not None:
         counters.append(
-            (sp.start_us + sp.dur_us, _ledger_bytes() + (_live_spans,))
+            (
+                sp.start_us + sp.dur_us,
+                _ledger_bytes() + (_live_spans,) + _cost_samples(),
+            )
         )
     if _collectors:
         with _state_lock:
@@ -390,12 +393,26 @@ def _ledger_bytes() -> tuple:
         return (0, 0)
 
 
+def _cost_samples() -> tuple:
+    """(total padding-waste bytes, last achieved bandwidth) from graftcost —
+    0s until observability.costs is imported (same no-import rule as
+    :func:`_ledger_bytes`: sampling must never trigger an import chain)."""
+    costs = sys.modules.get("modin_tpu.observability.costs")
+    if costs is None:
+        return (0, 0)
+    try:
+        return costs.counter_sample()
+    except Exception:
+        return (0, 0)
+
+
 def counter_samples(
     start_us: Optional[float] = None, end_us: Optional[float] = None
 ) -> List[tuple]:
-    """Counter samples ``(ts_us, (device_bytes, host_bytes, live_spans))``
-    currently in the ring, optionally clipped to a time window (a profile
-    exports only the samples its own spans cover)."""
+    """Counter samples ``(ts_us, (device_bytes, host_bytes, live_spans,
+    padding_waste_bytes, achieved_bw))`` currently in the ring, optionally
+    clipped to a time window (a profile exports only the samples its own
+    spans cover)."""
     counters = _COUNTERS
     if counters is None:
         return []

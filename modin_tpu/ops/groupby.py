@@ -874,10 +874,29 @@ def groupby_reduce(
     import jax
     import jax.numpy as jnp
 
+    from modin_tpu.observability import costs as _costs
     from modin_tpu.ops.structural import pad_host, pad_len
 
     ns = num_groups + 1
     p_out = pad_len(num_groups)
+    if _costs.COST_ON:
+        # input leg: value columns + codes carry (P - n) pad rows each;
+        # output leg: every result column is padded from num_groups to the
+        # shard multiple (plus the sliced-off overflow bucket slot)
+        in_padded = sum(
+            int(c.shape[0]) * c.dtype.itemsize for c in value_cols
+        ) + int(codes.shape[0]) * codes.dtype.itemsize
+        in_valid = (
+            sum(int(n) * c.dtype.itemsize for c in value_cols)
+            + int(n) * codes.dtype.itemsize
+        )
+        _costs.note_padding("groupby.reduce.rows", in_padded, in_valid)
+        out_width = max(len(value_cols), 1)
+        _costs.note_padding(
+            "groupby.reduce.groups",
+            out_width * max(ns, p_out) * 8,
+            out_width * num_groups * 8,
+        )
     if agg == "size":
         if sizes is not None:
             return [jnp.asarray(pad_host(np.asarray(sizes, np.int64), num_groups))]

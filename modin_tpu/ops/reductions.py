@@ -852,6 +852,15 @@ def _hist_groups(plans: List[ColumnPlan]):
     if not idxs:
         return idxs, 0, (), ()
     span_pad = _next_pow2(max(plans[i].span for i in idxs) + 2)
+    from modin_tpu.observability import costs as _costs
+
+    if _costs.COST_ON:
+        # pow2-padded histogram bins: span_pad slots per column vs the
+        # span + NaN + dead slots actually addressed (int64 counts)
+        valid = sum(int(plans[i].span) + 2 for i in idxs)
+        _costs.note_padding(
+            "reductions.hist_bins", len(idxs) * span_pad * 8, valid * 8
+        )
     cols = tuple(plans[i].col.data for i in idxs)
     bases = tuple(jnp.asarray(int(plans[i].base)) for i in idxs)
     return idxs, span_pad, cols, bases

@@ -84,6 +84,14 @@ def lexsort_permutation(
     keys: List[Any], n: int, ascending: List[bool], na_position: str = "last"
 ) -> Any:
     """Stable permutation ordering rows by the given padded keys."""
+    from modin_tpu.observability import costs as _costs
+
+    if _costs.COST_ON:
+        _costs.note_padding(
+            "sort.lexsort",
+            sum(int(k.shape[0]) * k.dtype.itemsize for k in keys),
+            sum(int(n) * k.dtype.itemsize for k in keys),
+        )
     fn = _jit_lexsort(
         len(keys), int(n), tuple(bool(a) for a in ascending), na_position == "last"
     )
@@ -136,6 +144,14 @@ def sorted_valid_columns(arrays: List[Any], n: int) -> List[Tuple[Any, Any]]:
     """
     if not arrays:
         return []
+    from modin_tpu.observability import costs as _costs
+
+    if _costs.COST_ON:
+        _costs.note_padding(
+            "sort.sorted_valid",
+            sum(int(c.shape[0]) * c.dtype.itemsize for c in arrays),
+            sum(int(n) * c.dtype.itemsize for c in arrays),
+        )
     return list(_jit_sorted_valid_multi(len(arrays), int(n))(tuple(arrays)))
 
 

@@ -14,6 +14,8 @@ from typing import Any, List, Tuple
 
 import numpy as np
 
+from modin_tpu.observability import costs as _costs
+
 
 def float_total_order(x):
     """Monotone float -> int64 mapping with a strict IEEE total order.
@@ -44,6 +46,12 @@ def pad_host(values: np.ndarray, n: int | None = None) -> np.ndarray:
     """Pad a host array with zeros to the sharded length."""
     n = len(values) if n is None else n
     p = pad_len(n)
+    if _costs.COST_ON:
+        _costs.note_padding(
+            "structural.pad_host",
+            p * values.dtype.itemsize,
+            len(values) * values.dtype.itemsize,
+        )
     if len(values) == p:
         return values
     pad_block = np.zeros(p - len(values), dtype=values.dtype)

@@ -32,6 +32,7 @@ from modin_tpu.core import memory as _memory
 from modin_tpu.core.execution import recovery as _recovery
 from modin_tpu.core.execution.resilience import engine_call
 from modin_tpu.logging import ClassLogger
+from modin_tpu.observability import costs as _costs
 
 
 def _estimate_deploy_bytes(f_args: tuple) -> tuple:
@@ -124,19 +125,37 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
             estimate, input_ids = _estimate_deploy_bytes(f_args)
             if _memory._DEVICE_BUDGET is not None:
                 _memory.device_ledger.admit(estimate, exclude_ids=input_ids)
+        # graftcost: one attribute check when off; while on, the recorder
+        # captures static flops/bytes on a billed compile (re-billing the
+        # memoized costs on cache hits) and joins the attempt wall
+        cost_cb = (
+            _costs.dispatch_recorder(func, f_args, f_kwargs)
+            if _costs.COST_ON
+            else None
+        )
         try:
             result = engine_call(
                 "deploy",
                 lambda: func(*f_args, **(f_kwargs or {})),
                 protect_ids=input_ids,
+                cost_cb=cost_cb,
             )
         except DeviceLost:
             fresh_args = _recovery.recover_args(f_args)
             if fresh_args is None:
                 raise
             emit_metric("recovery.retry.rebind", 1)
+            # a fresh recorder over the REBOUND args: the original closure
+            # would fingerprint (and AOT-lower over) the dead buffers
+            rebind_cb = (
+                _costs.dispatch_recorder(func, fresh_args, f_kwargs)
+                if _costs.COST_ON
+                else None
+            )
             result = engine_call(
-                "deploy", lambda: func(*fresh_args, **(f_kwargs or {}))
+                "deploy",
+                lambda: func(*fresh_args, **(f_kwargs or {})),
+                cost_cb=rebind_cb,
             )
             f_args = fresh_args  # provenance must describe the live inputs
         if _recovery.RECOVERY_ON:

@@ -24,6 +24,47 @@ def _fmt_bytes(n: Optional[int]) -> str:
     return f"{n}B"
 
 
+def _fmt_count(n: Optional[float]) -> str:
+    """Compact flop/byte-estimate rendering: 1234567 -> ``1.2M``."""
+    if n is None:
+        return "?"
+    n = float(n)
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if n >= scale:
+            return f"{n / scale:.1f}{suffix}"
+    return f"{n:.0f}"
+
+
+def _cost_suffix(measured: dict) -> str:
+    """The graftcost leg of a node annotation: estimated flops/bytes, the
+    padding share of the bytes the node's kernels physically touched, and
+    the achieved roofline fraction at the node's measured wall.  Empty when
+    cost capture was off for the run."""
+    if "est_flops" not in measured:
+        return ""
+    from modin_tpu.observability import costs as _costs
+
+    est_flops = measured["est_flops"]
+    est_bytes = measured["est_bytes"]
+    padded = measured.get("padded_bytes", 0)
+    waste = measured.get("padding_waste_bytes", 0)
+    pad_pct = f"{waste / padded:.0%}" if padded > 0 else "0%"
+    roofline = "?"
+    try:
+        fraction = _costs.roofline_fraction(
+            est_flops or None, est_bytes or None, measured["total_s"]
+        )
+        if fraction is not None:
+            roofline = f"{fraction:.1%}"
+    except Exception:
+        pass
+    return (
+        f" est_flops={_fmt_count(est_flops)} "
+        f"est_bytes={_fmt_bytes(int(est_bytes))} "
+        f"padding={pad_pct} roofline={roofline}"
+    )
+
+
 def _actual_suffix(measured: Optional[dict]) -> str:
     """``(actual: ...)`` annotation for one analyzed node."""
     if measured is None:
@@ -35,7 +76,8 @@ def _actual_suffix(measured: Optional[dict]) -> str:
         f"self={measured['self_s'] * 1e3:.3f}ms "
         f"rows={'?' if rows is None else rows} "
         f"bytes={_fmt_bytes(measured.get('bytes'))} "
-        f"dispatches={measured['dispatches']})"
+        f"dispatches={measured['dispatches']}"
+        f"{_cost_suffix(measured)})"
     )
 
 

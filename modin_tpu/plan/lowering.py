@@ -20,6 +20,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import costs as graftcost
 from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
 from modin_tpu.plan.ir import (
@@ -107,6 +108,11 @@ def _lower(node: PlanNode, memo: Dict[int, Any]) -> Any:
     stack.append(frame)
     t0 = time.perf_counter()
     d0 = graftmeter.thread_dispatches()
+    # one COST_ON read: a concurrent toggle must not leave c0 set with p0
+    # None (the epilogue derives both or neither)
+    cost_on = graftcost.COST_ON
+    c0 = graftcost.thread_cost() if cost_on else None
+    p0 = graftcost.thread_padding() if cost_on else None
     try:
         result = _lower_node(node, memo)
     finally:
@@ -117,7 +123,7 @@ def _lower(node: PlanNode, memo: Dict[int, Any]) -> Any:
             parent = stack[-1]
             parent["child_s"] += total_s
             parent["child_disp"] += total_disp
-    instrument[id(node)] = {
+    entry = {
         "total_s": total_s,
         "self_s": max(total_s - frame["child_s"], 0.0),
         "dispatches": max(total_disp - frame["child_disp"], 0),
@@ -125,6 +131,18 @@ def _lower(node: PlanNode, memo: Dict[int, Any]) -> Any:
         "rows": _result_rows(result),
         "bytes": _result_bytes(result),
     }
+    if c0 is not None:
+        # graftcost joins: estimated flops/bytes billed while lowering this
+        # node (subtree totals, like total_s — a shared subtree bills its
+        # first consumer), padding observed, and the roofline fraction at
+        # the node's own measured wall
+        c1 = graftcost.thread_cost()
+        p1 = graftcost.thread_padding()
+        entry["est_flops"] = c1[0] - c0[0]
+        entry["est_bytes"] = c1[1] - c0[1]
+        entry["padded_bytes"] = p1[0] - p0[0]
+        entry["padding_waste_bytes"] = p1[1] - p0[1]
+    instrument[id(node)] = entry
     return result
 
 

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all ten gates, fail on any red
+#   ./scripts/check_all.sh            # all eleven gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -31,6 +31,12 @@
 #       counters (dispatches/compiles/reads/bytes/pruned columns) must
 #       hold against scripts/metrics_baseline.json — re-record intentional
 #       changes with `python scripts/metrics_smoke.py --record`
+#   0g. perf-history smoke: PERF_HISTORY.json must re-seed byte-identically
+#       from the BENCH_r0*.json round files, PERF.md's per-op tables must
+#       regenerate byte-identically from the ledger, an honest reduced-scale
+#       bench run must fold through the regression gate green (with git-SHA/
+#       substrate/version provenance on every streamed line), and a 2x wall
+#       inflation of the same run must be rejected
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -60,6 +66,7 @@ run_gate "graftguard"      python scripts/chaos_smoke.py
 run_gate "bench_smoke"     python scripts/bench_smoke.py
 run_gate "graftplan"       python scripts/plan_smoke.py
 run_gate "graftmeter"      python scripts/metrics_smoke.py
+run_gate "perf_history"    python scripts/perf_history_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -69,4 +76,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL TEN GATES GREEN"
+echo "ALL ELEVEN GATES GREEN"

@@ -144,11 +144,17 @@ def main(record: bool = False) -> int:
     assert node_lines and not unannotated, (
         f"plan nodes missing actuals: {unannotated or 'no nodes rendered'}"
     )
-    for field in ("time=", "rows=", "bytes=", "dispatches="):
+    for field in (
+        "time=", "rows=", "bytes=", "dispatches=",
+        # graftcost: estimated work, padding share, and roofline fraction
+        # joined to the measured wall on every node
+        "est_flops=", "est_bytes=", "padding=", "roofline=",
+    ):
         assert all(field in ln for ln in node_lines), (
             f"annotation missing {field!r}: {node_lines}"
         )
     assert "== query rollup ==" in analyzed, "no QueryStats rollup block"
+    assert "est cost:" in analyzed, "no graftcost line in the rollup block"
 
     # ---- bit-exact: analyze-mode pipeline == eager (Off) == pandas ----- #
     with PlanMode.context("Off"):

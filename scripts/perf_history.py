@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""perf_history — fold bench runs into PERF_HISTORY.json and regenerate
+PERF.md's per-op tables from it (graftcost's trend ledger; the logic lives
+in modin_tpu/observability/perf_history.py).
+
+Usage:
+
+    python scripts/perf_history.py seed
+        (Re)build PERF_HISTORY.json from the BENCH_r0*.json round files
+        (provenance backfilled for the pre-ledger rounds), then regenerate
+        PERF.md.  Deterministic: same inputs, same bytes.
+
+    python scripts/perf_history.py fold STREAM [--run-id ID] [--no-gate]
+        Parse a streamed bench run (bench.py stdout, one JSON per line),
+        gate every op wall against the best recorded same-(op, substrate,
+        scale) number (tolerance: MODIN_TPU_PERF_GATE_TOLERANCE), append
+        the run to the ledger, regenerate PERF.md.  Exit 1 on a gate
+        failure — the run is still recorded, flagged ``gate_failures``,
+        so the regression is on the record rather than suppressed.
+
+    python scripts/perf_history.py check STREAM
+        Gate only: no ledger or PERF.md mutation.
+
+    python scripts/perf_history.py regen [--check]
+        Regenerate PERF.md's generated region from PERF_HISTORY.json.
+        ``--check`` writes nothing and exits 1 unless the committed
+        PERF.md is already byte-identical to the regeneration (the
+        perf_history_smoke determinism leg).
+
+``--ledger`` / ``--perf-md`` override the default repo-root paths
+(the smoke gate uses them to work on temp copies).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from modin_tpu.observability import perf_history as ph  # noqa: E402
+
+
+def _paths(args) -> tuple:
+    ledger_path = args.ledger or os.path.join(REPO_ROOT, "PERF_HISTORY.json")
+    perf_md_path = args.perf_md or os.path.join(REPO_ROOT, "PERF.md")
+    return ledger_path, perf_md_path
+
+
+def _regen(ledger: dict, perf_md_path: str, check: bool = False) -> int:
+    with open(perf_md_path) as f:
+        current = f.read()
+    regenerated = ph.regenerate_perf_md(ledger, current)
+    if check:
+        if regenerated != current:
+            print(
+                f"perf_history: {perf_md_path} is NOT byte-identical to its "
+                "regeneration from the ledger — run "
+                "`python scripts/perf_history.py regen` and commit",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"perf_history: {perf_md_path} matches the ledger (byte-identical)")
+        return 0
+    if regenerated != current:
+        with open(perf_md_path, "w") as f:
+            f.write(regenerated)
+        print(f"perf_history: regenerated tables in {perf_md_path}")
+    else:
+        print(f"perf_history: {perf_md_path} already up to date")
+    return 0
+
+
+def cmd_seed(args) -> int:
+    ledger_path, perf_md_path = _paths(args)
+    ledger = ph.seed_ledger(REPO_ROOT)
+    ph.save_ledger(ledger, ledger_path)
+    print(
+        f"perf_history: seeded {ledger_path} from "
+        f"{len(ledger['runs'])} round file(s)"
+    )
+    return _regen(ledger, perf_md_path)
+
+
+def cmd_fold(args) -> int:
+    ledger_path, perf_md_path = _paths(args)
+    ledger = ph.load_ledger(ledger_path)
+    with open(args.stream) as f:
+        run = ph.parse_bench_stream(f.read())
+    run_id = args.run_id or ph.next_run_id(ledger)
+    failures = ph.fold_run(ledger, run, run_id, gate=not args.no_gate)
+    ph.save_ledger(ledger, ledger_path)
+    rc = _regen(ledger, perf_md_path)
+    if failures:
+        print(f"perf_history: run {run_id} RECORDED but the gate is RED:")
+        for failure in failures:
+            print(f"  - {failure}")
+        return 1
+    print(
+        f"perf_history: folded run {run_id} "
+        f"({len(run.get('ops') or {})} op(s), "
+        f"substrate={ph.run_substrate(run)}) — gate green"
+    )
+    return rc
+
+
+def cmd_check(args) -> int:
+    ledger_path, _ = _paths(args)
+    ledger = ph.load_ledger(ledger_path)
+    with open(args.stream) as f:
+        run = ph.parse_bench_stream(f.read())
+    failures = ph.check_regression(ledger, run)
+    if failures:
+        print("perf_history: gate RED:")
+        for failure in failures:
+            print(f"  - {failure}")
+        return 1
+    print(
+        f"perf_history: gate green ({len(run.get('ops') or {})} op(s) vs "
+        f"{len(ledger['runs'])} recorded run(s))"
+    )
+    return 0
+
+
+def cmd_regen(args) -> int:
+    ledger_path, perf_md_path = _paths(args)
+    ledger = ph.load_ledger(ledger_path)
+    return _regen(ledger, perf_md_path, check=args.check)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--ledger", default=None, help="PERF_HISTORY.json path")
+    parser.add_argument("--perf-md", default=None, help="PERF.md path")
+    sub = parser.add_subparsers(dest="command", required=True)
+    sub.add_parser("seed")
+    fold = sub.add_parser("fold")
+    fold.add_argument("stream", help="streamed bench run (bench.py stdout)")
+    fold.add_argument("--run-id", default=None)
+    fold.add_argument("--no-gate", action="store_true")
+    check = sub.add_parser("check")
+    check.add_argument("stream")
+    regen = sub.add_parser("regen")
+    regen.add_argument("--check", action="store_true")
+    args = parser.parse_args(argv)
+    return {
+        "seed": cmd_seed,
+        "fold": cmd_fold,
+        "check": cmd_check,
+        "regen": cmd_regen,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

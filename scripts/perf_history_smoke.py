@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""perf_history_smoke — the check_all.sh gate for the perf-history ledger.
+
+Four legs, mirroring what the other smokes prove for their subsystems:
+
+1. **Seed determinism**: the committed ledger's *seeded* entries (the runs
+   carrying a ``source`` round file; folded runs carry none) must be
+   byte-identical to a fresh seed from the committed ``BENCH_r0*.json``
+   files — the backfill cannot drift from its sources, while folding new
+   runs (the ledger's whole point) stays legal.
+2. **Regen determinism**: the committed ``PERF.md`` must be byte-identical
+   to its regeneration from the committed ledger (the tables cannot drift
+   from the ledger).
+3. **Honest fold**: a real reduced-scale bench run (``BENCH_SECTIONS=
+   graftsort`` — the one section that contributes per-op detail at smoke
+   scale) folds into a working copy of the ledger with the regression gate
+   green, provenance (git SHA / substrate / jax / pandas) present on its
+   streamed lines, and the working PERF.md regenerating cleanly.
+4. **Gate sensitivity**: the same run with every op wall inflated 2x must
+   be REJECTED by the gate against the ledger that now holds the honest
+   numbers — a perf regression cannot fold in silently.
+
+Exit 0 on success; any failed leg prints a diagnostic and exits 1.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+TIMEOUT_S = int(os.environ.get("PERF_HISTORY_SMOKE_TIMEOUT_S", 420))
+
+SMOKE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_FORCE_CPU": "1",
+    "BENCH_SECTIONS": "graftsort",
+    "BENCH_SORT_ROWS": "120000",
+    "BENCH_REPEATS": "1",
+    "BENCH_SECTION_TIMEOUT_S": "150",
+    "BENCH_DEADLINE": str(max(TIMEOUT_S - 60, 120)),
+}
+
+
+def main() -> int:
+    from modin_tpu.observability import perf_history as ph
+
+    ledger_path = os.path.join(REPO_ROOT, "PERF_HISTORY.json")
+    perf_md_path = os.path.join(REPO_ROOT, "PERF.md")
+
+    # ---- leg 1: seed determinism ------------------------------------- #
+    committed_ledger = ph.load_ledger(ledger_path)
+    seeded_prefix = {
+        "schema": committed_ledger["schema"],
+        "runs": [r for r in committed_ledger["runs"] if r.get("source")],
+    }
+    reseeded = ph.dump_ledger(ph.seed_ledger(REPO_ROOT))
+    assert ph.dump_ledger(seeded_prefix) == reseeded, (
+        "the committed PERF_HISTORY.json's seeded entries are not "
+        "byte-identical to a fresh seed from the BENCH_r0*.json round "
+        "files — the backfill drifted; re-run `python "
+        "scripts/perf_history.py seed` on a clean ledger and re-fold"
+    )
+
+    # ---- leg 2: regen determinism ------------------------------------ #
+    with open(perf_md_path) as f:
+        perf_md = f.read()
+    regenerated = ph.regenerate_perf_md(ph.load_ledger(ledger_path), perf_md)
+    assert regenerated == perf_md, (
+        "PERF.md is not byte-identical to its regeneration from "
+        "PERF_HISTORY.json — run `python scripts/perf_history.py regen` "
+        "and commit"
+    )
+
+    # ---- leg 3: honest reduced-scale run folds green ------------------ #
+    env = dict(os.environ)
+    env.update(SMOKE_ENV)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+            capture_output=True,
+            text=True,
+            timeout=TIMEOUT_S,
+            env=env,
+            cwd=REPO_ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"perf_history_smoke: FAIL — bench.py exceeded the {TIMEOUT_S}s "
+            "hard timeout"
+        )
+        return 1
+    if proc.returncode != 0:
+        print(f"perf_history_smoke: FAIL — bench rc={proc.returncode}")
+        print(proc.stderr[-2000:])
+        return 1
+
+    run = ph.parse_bench_stream(proc.stdout)
+    assert run.get("ops"), (
+        f"reduced-scale run produced no per-op detail: "
+        f"{proc.stdout[-500:]}"
+    )
+    provenance = run.get("provenance") or {}
+    for field in ("git_sha", "substrate", "jax", "pandas"):
+        assert provenance.get(field), (
+            f"streamed lines carry no {field!r} provenance: {provenance}"
+        )
+    assert run.get("scale"), "streamed lines carry no row-scale config"
+
+    workdir = tempfile.mkdtemp(prefix="perf_history_smoke_")
+    try:
+        work_ledger = os.path.join(workdir, "PERF_HISTORY.json")
+        work_md = os.path.join(workdir, "PERF.md")
+        shutil.copyfile(ledger_path, work_ledger)
+        shutil.copyfile(perf_md_path, work_md)
+
+        ledger = ph.load_ledger(work_ledger)
+        failures = ph.fold_run(ledger, run, "smoke-001")
+        assert not failures, (
+            "honest reduced-scale run failed the regression gate: "
+            + "; ".join(failures)
+        )
+        ph.save_ledger(ledger, work_ledger)
+        with open(work_md) as f:
+            regenerated = ph.regenerate_perf_md(ledger, f.read())
+        with open(work_md, "w") as f:
+            f.write(regenerated)
+        for op in run["ops"]:
+            assert f"| {op} |" in regenerated, (
+                f"folded op {op!r} missing from the regenerated tables"
+            )
+        # regen is idempotent on the folded ledger too
+        assert ph.regenerate_perf_md(ledger, regenerated) == regenerated
+
+        # ---- leg 4: a 2x wall regression is rejected ------------------ #
+        inflated = copy.deepcopy(run)
+        for entry in inflated["ops"].values():
+            entry["modin_tpu_s"] = round(entry["modin_tpu_s"] * 2.0, 6)
+        failures = ph.check_regression(ledger, inflated)
+        assert failures, (
+            "the gate accepted a 2x wall regression vs the just-recorded "
+            "honest run"
+        )
+        rejected = {f.split()[2] for f in failures}
+        assert rejected == set(inflated["ops"]), (
+            f"gate rejected {rejected}, expected every inflated op "
+            f"{set(inflated['ops'])}"
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    print(
+        "perf_history_smoke: OK — seed + regen byte-identical, honest run "
+        f"folded green ({sorted(run['ops'])}, substrate="
+        f"{ph.run_substrate(run)}, sha={provenance['git_sha']}), 2x "
+        "regression rejected on every op"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(f"perf_history_smoke: FAIL — {err}", file=sys.stderr)
+        sys.exit(1)

@@ -449,7 +449,10 @@ class TestMetricsSmokeGate:
 
 class TestCounterTracks:
     def test_chrome_trace_counter_events_from_samples(self):
-        samples = [(10.0, (111, 222, 3)), (20.0, (444, 555, 6))]
+        samples = [
+            (10.0, (111, 222, 3, 40, 1000)),
+            (20.0, (444, 555, 6, 80, 2000)),
+        ]
         trace = to_chrome_trace([], counters=samples)
         cevents = [e for e in trace["traceEvents"] if e["ph"] == "C"]
         assert len(cevents) == len(samples) * len(COUNTER_TRACKS)
@@ -459,6 +462,8 @@ class TestCounterTracks:
         assert by_name["memory.device.resident_bytes"] == [111, 444]
         assert by_name["memory.host.cache_bytes"] == [222, 555]
         assert by_name["spans.live"] == [3, 6]
+        assert by_name["engine.cost.padding_waste_bytes"] == [40, 80]
+        assert by_name["engine.cost.achieved_bw_bytes_s"] == [1000, 2000]
 
     def test_profile_export_carries_counter_tracks(self):
         import modin_tpu.observability as graftscope
