@@ -105,6 +105,14 @@ APPLY_ROWS = int(os.environ.get("BENCH_APPLY_ROWS", 10_000_000))
 # acceptance number; reduced-scale smoke runs loosen it (a ~10ms workload
 # at BENCH_RECOVERY_ROWS=1.5e5 flakes on scheduler noise alone)
 RECOVERY_OVERHEAD_PCT = float(os.environ.get("BENCH_RECOVERY_OVERHEAD_PCT", 10.0))
+# graftgate serving section: concurrent mixed queries against one shared
+# frame.  THREADS submit back-to-back against MAX_CONCURRENT=CONCURRENCY
+# with queue depth == CONCURRENCY, i.e. offered load ~= THREADS/CONCURRENCY
+# x saturation (the acceptance shape is 4x); QUERIES bounds total work.
+SERVING_ROWS = int(os.environ.get("BENCH_SERVING_ROWS", 2_000_000))
+SERVING_THREADS = int(os.environ.get("BENCH_SERVING_THREADS", 8))
+SERVING_CONCURRENCY = int(os.environ.get("BENCH_SERVING_CONCURRENCY", 2))
+SERVING_QUERIES = int(os.environ.get("BENCH_SERVING_QUERIES", 48))
 
 
 class SectionTimeout(BaseException):
@@ -166,6 +174,7 @@ def _run_provenance(platform: str) -> dict:
             "plan_rows": PLAN_ROWS,
             "recovery_rows": RECOVERY_ROWS,
             "apply_rows": APPLY_ROWS,
+            "serving_rows": SERVING_ROWS,
             "repeats": REPEATS,
             "meters": METERS,
         },
@@ -875,6 +884,164 @@ def main() -> None:
             )
         return sections["recovery"]
 
+    # ---- graftgate: concurrent mixed queries under admission control ---- #
+    def serving_section():
+        """N threads x mixed queries against one shared frame: p50/p99
+        latency of ADMITTED queries + throughput, uncontended vs 4x-
+        saturation offered load, with shed/degraded counts — the ROADMAP
+        item-3 "heavy traffic" number.  The acceptance shape: at 4x
+        saturation, admitted-query p99 stays within 3x of the uncontended
+        p99 while the excess is shed with typed rejections."""
+        import threading as _threading
+
+        import modin_tpu.serving as serving
+        from modin_tpu.config import (
+            ServingEnabled,
+            ServingMaxConcurrent,
+            ServingQueueDepth,
+            ServingTenantWeights,
+        )
+
+        n = SERVING_ROWS
+        datas = {
+            "a": rng.normal(size=n),
+            "b": rng.integers(0, 1000, n).astype(np.int64),
+            "key": rng.integers(0, 97, n).astype(np.int64),
+        }
+        mdfv = pd.DataFrame(datas)
+        mdfv._query_compiler.execute()
+
+        query_shapes = [
+            ("gb_sum", lambda: execute_modin(mdfv.groupby("key").sum())),
+            ("ew_reduce", lambda: execute_modin((mdfv["a"] * 2 + mdfv["b"]).sum())),
+            ("mean", lambda: execute_modin(mdfv.mean())),
+            ("median", lambda: execute_modin(mdfv["a"].median())),
+        ]
+
+        def percentile(walls, q):
+            if not walls:
+                return None
+            ordered = sorted(walls)
+            return ordered[min(int(q * (len(ordered) - 1) + 0.5), len(ordered) - 1)]
+
+        before = (
+            ServingEnabled.get(), ServingMaxConcurrent.get(),
+            ServingQueueDepth.get(), ServingTenantWeights.get(),
+        )
+        ServingEnabled.put(True)
+        # per-thread tenants with fat buckets: the binding constraint this
+        # section measures is concurrency+queue backpressure, not the
+        # token-bucket rate limiter (fairness has its own unit tests)
+        ServingTenantWeights.put(
+            ",".join(f"t{i}=64" for i in range(SERVING_THREADS))
+        )
+        try:
+            # warm compiles outside every timer
+            for _name, q in query_shapes:
+                q()
+
+            # -- uncontended baseline: one query at a time -- #
+            ServingMaxConcurrent.put(max(SERVING_THREADS, 4))
+            ServingQueueDepth.put(SERVING_THREADS * 4)
+            uncontended = []
+            for rep in range(max(2 * len(query_shapes), 8)):
+                _name, q = query_shapes[rep % len(query_shapes)]
+                t0 = time.perf_counter()
+                serving.submit(q, tenant="t0", deadline_ms=0)
+                uncontended.append(time.perf_counter() - t0)
+
+            # -- 4x saturation: THREADS submitters vs CONCURRENCY slots -- #
+            ServingMaxConcurrent.put(SERVING_CONCURRENCY)
+            ServingQueueDepth.put(SERVING_CONCURRENCY)
+            gate0 = serving.serving_snapshot()
+            admitted_walls = []
+            outcomes = {"completed": 0, "shed": 0, "deadline": 0}
+            walls_lock = _threading.Lock()
+            per_thread = max(SERVING_QUERIES // SERVING_THREADS, 1)
+
+            def submitter(tid):
+                for k in range(per_thread):
+                    _name, q = query_shapes[(tid + k) % len(query_shapes)]
+                    t0 = time.perf_counter()
+                    try:
+                        serving.submit(q, tenant=f"t{tid}", deadline_ms=0)
+                    except serving.QueryRejected:
+                        with walls_lock:
+                            outcomes["shed"] += 1
+                        continue
+                    except serving.DeadlineExceeded:
+                        with walls_lock:
+                            outcomes["deadline"] += 1
+                        continue
+                    wall = time.perf_counter() - t0
+                    with walls_lock:
+                        outcomes["completed"] += 1
+                        admitted_walls.append(wall)
+
+            threads = [
+                _threading.Thread(target=submitter, args=(tid,), daemon=True)
+                for tid in range(SERVING_THREADS)
+            ]
+            t_run0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            run_wall = time.perf_counter() - t_run0
+            gate1 = serving.serving_snapshot()
+        finally:
+            ServingEnabled.put(before[0])
+            ServingMaxConcurrent.put(before[1])
+            ServingQueueDepth.put(before[2])
+            ServingTenantWeights.put(before[3])
+
+        p50 = percentile(admitted_walls, 0.50)
+        p99 = percentile(admitted_walls, 0.99)
+        un_p50 = percentile(uncontended, 0.50)
+        un_p99 = percentile(uncontended, 0.99)
+        degraded = gate1["degraded"] - gate0["degraded"]
+        p99_ratio = (
+            round(p99 / max(un_p99, 1e-9), 2)
+            if p99 is not None and un_p99 is not None
+            else None
+        )
+        sections["serving"] = {
+            "rows": n,
+            "threads": SERVING_THREADS,
+            "max_concurrent": SERVING_CONCURRENCY,
+            "offered_queries": per_thread * SERVING_THREADS,
+            "completed": outcomes["completed"],
+            "shed": outcomes["shed"],
+            "deadline_aborts": outcomes["deadline"],
+            "degraded": degraded,
+            "throughput_qps": round(
+                outcomes["completed"] / max(run_wall, 1e-9), 2
+            ),
+            "uncontended_p50_s": round(un_p50, 4) if un_p50 else None,
+            "uncontended_p99_s": round(un_p99, 4) if un_p99 else None,
+            "admitted_p50_s": round(p50, 4) if p50 is not None else None,
+            "admitted_p99_s": round(p99, 4) if p99 is not None else None,
+            "p99_vs_uncontended_x": p99_ratio,
+            # the acceptance shape: backpressure keeps admitted-query tail
+            # latency bounded (within 3x uncontended) while excess load is
+            # shed with typed rejections rather than piling up
+            "backpressure_ok": bool(
+                p99_ratio is not None
+                and p99_ratio <= 3.0
+                and outcomes["shed"] > 0
+                and outcomes["completed"] > 0
+            ),
+        }
+        # fold the latency numbers into the per-op detail so the
+        # perf-history regression gate covers the serving tail like any op
+        if p50 is not None:
+            detail["serving_p50"] = {"modin_tpu_s": round(p50, 4)}
+            detail["serving_p99"] = {"modin_tpu_s": round(p99, 4)}
+            detail["serving_uncontended_p99"] = {
+                "modin_tpu_s": round(un_p99, 4)
+            }
+        return sections["serving"]
+
     # ---- groupby-apply: shuffle vs cliff on the virtual mesh ---- #
     def shuffle_apply() -> dict:
         sections["shuffle_apply_virtual_mesh"] = _shuffle_apply_section()
@@ -891,6 +1058,7 @@ def main() -> None:
         ("graftsort", graftsort_section),
         ("graftplan", graftplan_section),
         ("recovery", recovery_section),
+        ("serving", serving_section),
         ("shuffle_apply_virtual_mesh", shuffle_apply),
     ]
     for name, fn in section_list:

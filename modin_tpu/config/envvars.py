@@ -856,6 +856,113 @@ class PerfGateTolerance(EnvironmentVariable, type=float):
         super().put(value)
 
 
+class ServingEnabled(EnvironmentVariable, type=bool):
+    """graftgate multi-tenant serving: query admission control, latency
+    budgets, per-tenant fairness, and graceful degradation under
+    concurrent load (modin_tpu/serving/).
+
+    Off by default: ``serving.submit`` is a transparent direct call —
+    bit-for-bit the single-query behavior — and the seam checks cost one
+    module-attribute read (``context.CONTEXT_ON``), allocating nothing
+    (``serving.context_alloc_count()`` asserts it, graftscope-style).
+    """
+
+    varname = "MODIN_TPU_SERVING"
+    default = False
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+
+class ServingMaxConcurrent(EnvironmentVariable, type=int):
+    """Queries the admission gate lets run simultaneously.  Each admitted
+    query also reserves its estimated device bytes (tenant cost EWMA, or
+    ``device_budget / max_concurrent`` for an unknown tenant) against the
+    ``MODIN_TPU_DEVICE_MEMORY_BUDGET`` headroom."""
+
+    varname = "MODIN_TPU_SERVING_MAX_CONCURRENT"
+    default = 4
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Serving max-concurrent should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class ServingQueueDepth(EnvironmentVariable, type=int):
+    """Bounded admission wait queue: queries past max-concurrent wait here
+    (weighted-fair wake order); past this depth they are shed with a typed
+    ``QueryRejected`` + retry-after hint.  0 = never queue, shed
+    immediately at saturation."""
+
+    varname = "MODIN_TPU_SERVING_QUEUE_DEPTH"
+    default = 16
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Serving queue depth should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class ServingDefaultDeadlineMs(EnvironmentVariable, type=float):
+    """Latency budget (milliseconds) for queries submitted without an
+    explicit ``deadline_ms`` (0 = unbounded).  The budget rides the query
+    as a cancellation token checked at the engine-seam boundaries; expiry
+    raises a typed ``DeadlineExceeded`` with overshoot bounded by one
+    engine attempt."""
+
+    varname = "MODIN_TPU_SERVING_DEFAULT_DEADLINE_MS"
+    default = 0.0
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Serving default deadline should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class ServingTenantWeights(EnvironmentVariable, type=ExactStr):
+    """Per-tenant fairness weights as ``"name=weight,name=weight"`` (e.g.
+    ``"alice=3,bob=1"``; unlisted tenants weigh 1.0).  A tenant's token
+    bucket holds ``weight * max_concurrent`` tokens refilling at that rate
+    per second, and the saturated gate wakes queued tenants
+    fewest-in-flight-per-weight first."""
+
+    varname = "MODIN_TPU_SERVING_TENANT_WEIGHTS"
+    default = ""
+
+
+class ServingDegradedHighWater(EnvironmentVariable, type=float):
+    """Device-ledger fraction of ``MODIN_TPU_DEVICE_MEMORY_BUDGET`` past
+    which admitted queries route to the host/pandas path (degraded mode)
+    instead of queueing behind a pressured device; an OPEN device-path
+    breaker triggers the same routing regardless of residency."""
+
+    varname = "MODIN_TPU_SERVING_DEGRADED_HIGH_WATER"
+    default = 0.9
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if not 0.0 < value <= 1.0:
+            raise ValueError(
+                f"Degraded high-water should be in (0, 1], passed value {value}"
+            )
+        super().put(value)
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

@@ -68,6 +68,15 @@ _tls = threading.local()
 _epoch_lock = threading.Lock()
 _device_epoch = 0
 
+#: serializes whole reseat passes AND carries the reseat-once handshake:
+#: when several threads observe the same device loss (graftgate runs many
+#: queries against one device), exactly one runs the recovery pass; the
+#: others block on the lock, see the epoch already advanced past what they
+#: observed, and piggyback on that pass's result instead of re-seating the
+#: entire resident set once per observer.
+_reseat_lock = threading.Lock()
+_last_reseat_count = 0
+
 
 class Unrecoverable(Exception):
     """A column's lineage cannot reproduce its device buffer (internal
@@ -481,46 +490,79 @@ def _purge_io_caches() -> None:
             pass
 
 
-def reseat_all(reason: str) -> int:
+def reseat_all(reason: str, observed_epoch: Optional[int] = None) -> int:
     """Bump the device epoch and re-seat every live device column.
 
     Called on a terminal ``DeviceLost`` at the engine seam and on a
     device-path breaker opening on one.  Returns how many columns were
     re-seated; 0 means nothing was resident (or recovery is disabled) and
     the caller should not bother retrying.
+
+    ``observed_epoch`` is the device epoch the caller's failed work was
+    *launched* in (the engine seam captures it at attempt start).  It is
+    the reseat-once handshake: when several threads observe the same
+    device loss, the first to arrive runs the pass and bumps the epoch;
+    every thread whose failure belongs to the already-recovered epoch
+    piggybacks on that pass's result instead of churning the entire
+    resident set (and dropping every derived cache) once per observer.
     """
-    global _device_epoch
+    global _device_epoch, _last_reseat_count
     if not RECOVERY_ON or in_recovery():
         return 0
     from modin_tpu.core.memory import device_ledger
 
-    _tls.active = True
-    try:
-        with _epoch_lock:
-            _device_epoch += 1
-        emit_metric("recovery.device_lost", 1)
-        reseated = 0
-        with graftscope.span(
-            "recovery.reseat", layer="JAX-ENGINE", reason=reason
-        ):
-            for col in device_ledger.live_columns():
-                try:
-                    kind = recover_column(col)
-                except Unrecoverable:
-                    emit_metric("recovery.unrecoverable", 1)
-                    continue
-                except Exception:  # graftlint: disable=EXC-HYGIENE -- recovery is best-effort per column; one bad record must not abort the pass for every other column
-                    emit_metric("recovery.unrecoverable", 1)
-                    continue
-                if kind is not None:
-                    emit_metric(f"recovery.reseat.{kind}", 1)
-                    reseated += 1
-        if dump_flight_record("recovery_reseat", detail=reason):
-            emit_metric("trace.flight_dump", 1)
-        return reseated
-    finally:
-        _tls.active = False
-        _purge_io_caches()
+    if observed_epoch is None:
+        observed_epoch = _device_epoch
+    # Lock order: dispatch_lock -> _reseat_lock, ALWAYS.  A device-path
+    # caller reaches here already holding the serving dispatch lock (the
+    # guarded path wraps the whole kernel call), and the pass below replays
+    # deploys that acquire it; taking it first here (reentrant for that
+    # caller, a plain gate for everyone else) makes the order globally
+    # consistent — without this, one thread holding dispatch wanting
+    # reseat and another holding reseat wanting dispatch deadlock.
+    from modin_tpu.serving import context as serving_context
+
+    with serving_context.dispatch_lock, _reseat_lock:
+        if _device_epoch > observed_epoch:
+            return _last_reseat_count
+        # the pass is SHARED work — every concurrent query's columns come
+        # back through it — so it must not be abortable by the triggering
+        # thread's private deadline: clear this thread's serving context
+        # for the pass (restored below; the owner's scope bookkeeping is
+        # untouched, only routing of seam checks)
+        saved_ctx = serving_context.snapshot_context()
+        if saved_ctx is not None:
+            serving_context.seed_thread_context(None)
+        _tls.active = True
+        try:
+            with _epoch_lock:
+                _device_epoch += 1
+            emit_metric("recovery.device_lost", 1)
+            reseated = 0
+            with graftscope.span(
+                "recovery.reseat", layer="JAX-ENGINE", reason=reason
+            ):
+                for col in device_ledger.live_columns():
+                    try:
+                        kind = recover_column(col)
+                    except Unrecoverable:
+                        emit_metric("recovery.unrecoverable", 1)
+                        continue
+                    except Exception:  # graftlint: disable=EXC-HYGIENE -- recovery is best-effort per column; one bad record must not abort the pass for every other column
+                        emit_metric("recovery.unrecoverable", 1)
+                        continue
+                    if kind is not None:
+                        emit_metric(f"recovery.reseat.{kind}", 1)
+                        reseated += 1
+            _last_reseat_count = reseated
+            if dump_flight_record("recovery_reseat", detail=reason):
+                emit_metric("trace.flight_dump", 1)
+            return reseated
+        finally:
+            _tls.active = False
+            if saved_ctx is not None:
+                serving_context.seed_thread_context(saved_ctx)
+            _purge_io_caches()
 
 
 def recover_for_read(col: Any, err: BaseException) -> bool:
@@ -622,12 +664,13 @@ def _on_recovery_param(param: Any) -> None:
 
 def reset_for_tests() -> None:
     """Forget provenance and epoch state (test isolation)."""
-    global _device_epoch
+    global _device_epoch, _last_reseat_count
     with _prov_lock:
         _provenance.clear()
         _columns_by_data.clear()
     with _epoch_lock:
         _device_epoch = 0
+    _last_reseat_count = 0
 
 
 from modin_tpu.config import RecoveryMode as _RecoveryMode  # noqa: E402

@@ -57,6 +57,13 @@ from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
 from modin_tpu.observability.flight_recorder import dump_flight_record
 
+# graftgate serving context (deadline tokens + degraded routing).  A leaf
+# module by design — serving/__init__ loads only errors+context eagerly —
+# so this import cannot cycle; every seam check below gates on the single
+# module attribute serving_context.CONTEXT_ON (False unless a serving
+# query scope or ad-hoc deadline is active anywhere in the process).
+from modin_tpu.serving import context as serving_context
+
 # test seams: the suite patches these to run breaker-cooldown / backoff
 # scenarios without wall-clock sleeps
 _now = time.monotonic
@@ -185,12 +192,21 @@ def _run_with_watchdog(op: str, thunk: Callable[[], Any], timeout_s: float) -> A
     parent_scopes = (
         graftmeter.snapshot_scopes() if graftmeter.ACCOUNTING_ON else None
     )
+    # and the serving context: a deadline must bound work the worker does
+    # on the owner's behalf (nested engine calls inside the thunk)
+    parent_ctx = (
+        serving_context.snapshot_context()
+        if serving_context.CONTEXT_ON
+        else None
+    )
 
     def runner() -> None:
         if parent_stack is not None:
             graftscope.seed_thread(parent_stack)
         if parent_scopes is not None:
             graftmeter.seed_thread_scopes(parent_scopes)
+        if parent_ctx is not None:
+            serving_context.seed_thread_context(parent_ctx)
         try:
             result_q.put((True, thunk()))
         except BaseException as err:  # noqa: BLE001 - relayed to caller  # graftlint: disable=EXC-HYGIENE -- watchdog thread relays ANY exception to the waiting caller verbatim
@@ -200,18 +216,64 @@ def _run_with_watchdog(op: str, thunk: Callable[[], Any], timeout_s: float) -> A
         target=runner, daemon=True, name=f"modin-tpu-watchdog-{op}"
     )
     thread.start()
-    try:
-        ok, payload = result_q.get(timeout=timeout_s)
-    except queue.Empty:
-        emit_metric(f"resilience.watchdog.{op}.timeout", 1)
-        raise WatchdogTimeout(
-            f"{op} exceeded the {timeout_s:g}s resilience watchdog "
-            "(MODIN_TPU_RESILIENCE_WATCHDOG_S); treating the device path "
-            "as lost"
-        ) from None
+    # a query deadline tighter than the watchdog bounds the wait instead:
+    # the blocking fetch is abandoned the moment the budget is gone, and
+    # the expiry surfaces as the TYPED serving error — not as a
+    # WatchdogTimeout, which would misread a slow-but-healthy device as
+    # lost and trigger a pointless lineage re-seat.  The wait loops so a
+    # deadline-clamped get that wakes *before* the watchdog window closes
+    # (deadline not quite expired, value not quite ready) keeps waiting
+    # instead of misclassifying.
+    started = time.monotonic()  # real clock: tests patch _now for breakers
+    while True:
+        wait_s = timeout_s - (time.monotonic() - started)
+        if wait_s <= 0:
+            emit_metric(f"resilience.watchdog.{op}.timeout", 1)
+            raise WatchdogTimeout(
+                f"{op} exceeded the {timeout_s:g}s resilience watchdog "
+                "(MODIN_TPU_RESILIENCE_WATCHDOG_S); treating the device "
+                "path as lost"
+            ) from None
+        if serving_context.CONTEXT_ON:
+            # raises DeadlineExceeded when the budget expired; abandoning
+            # the daemon worker is the same trade the watchdog already
+            # makes for a wedged fetch
+            serving_context.check_deadline(f"engine.{op}.watchdog")
+            remaining = serving_context.remaining_s()
+            if remaining is not None:
+                wait_s = min(wait_s, max(remaining, 1e-3))
+        try:
+            ok, payload = result_q.get(timeout=wait_s)
+            break
+        except queue.Empty:
+            continue
     if ok:
         return payload
     raise payload
+
+
+def _run_attempt(op: str, attempt_once: Callable[[], Any], timeout_s: float) -> Any:
+    """One attempt, under the watchdog when requested and — while a serving
+    context is active — under the collective-safe dispatch lock for the
+    program-enqueue ops (see serving/context.py:dispatch_lock: concurrent
+    sharded enqueues that interleave per-device deadlock the collective
+    rendezvous).
+
+    The watchdog branch comes FIRST and is never serialized: blocking
+    fetches only drain results, and the lock must never span a worker
+    handoff — an owner holding it while a daemon worker enqueues would
+    release on abandonment (timeout/deadline) with the enqueue still in
+    flight, recreating the interleave the lock exists to prevent, and a
+    nested deploy on the worker would stall against its own owner.  If a
+    program-enqueue op ever grows a watchdog, take the lock INSIDE the
+    worker, not here.
+    """
+    if timeout_s > 0:
+        return _run_with_watchdog(op, attempt_once, timeout_s)
+    if serving_context.CONTEXT_ON and op in ("deploy", "put"):
+        with serving_context.dispatch_lock:
+            return attempt_once()
+    return attempt_once()
 
 
 def engine_call(
@@ -263,6 +325,12 @@ def engine_call(
     )
     from modin_tpu.core.execution import recovery
 
+    # graftgate deadline: one seam check before any engine work, covering
+    # the ResilienceMode=Disable bypass too — a budget-expired query must
+    # not enqueue more device work in either mode
+    if serving_context.CONTEXT_ON:
+        serving_context.check_deadline(f"engine.{op}")
+
     def attempt_once() -> Any:
         hook = _fault_hook
         if hook is not None:
@@ -278,7 +346,7 @@ def engine_call(
 
             compiles_before = compiles_on_this_thread()
         attempt_t0 = time.perf_counter()
-        result = attempt_once()
+        result = _run_attempt(op, attempt_once, 0.0)
         attempt_wall = time.perf_counter() - attempt_t0
         # accounting still owes the dispatch count under the bypass knob —
         # EXPLAIN ANALYZE / the metrics_smoke ceilings must not go blind
@@ -303,6 +371,11 @@ def engine_call(
     oom_rounds = 0
     reseat_spent = False
     while True:
+        if serving_context.CONTEXT_ON:
+            # attempt-start boundary: a retry / evict-then-retry / re-seat
+            # loop re-enters here, so deadline overshoot is bounded by ONE
+            # attempt, never by the remaining retry budget
+            serving_context.check_deadline(f"engine.{op}.attempt")
         sp = compiles_before = None
         if graftscope.TRACE_ON:
             sp = graftscope.start_span(
@@ -316,12 +389,13 @@ def engine_call(
             )
 
             compiles_before = compiles_on_this_thread()
+        # the epoch this attempt's work launches in: a DeviceLost below
+        # hands it to reseat_all so concurrent observers of ONE loss share
+        # one recovery pass (reseat-once) instead of re-seating per thread
+        attempt_epoch = recovery.current_epoch()
         attempt_t0 = time.perf_counter()
         try:
-            if timeout_s > 0:
-                result = _run_with_watchdog(op, attempt_once, timeout_s)
-            else:
-                result = attempt_once()
+            result = _run_attempt(op, attempt_once, timeout_s)
         except Exception as err:  # graftlint: disable=EXC-HYGIENE -- the classification point: catches broadly, re-raises non-device errors
             failure = classify_device_error(err)
             if sp is not None:
@@ -347,7 +421,10 @@ def engine_call(
                 isinstance(failure, DeviceLost)
                 and not reseat_spent
                 and not recovery.in_recovery()
-                and recovery.reseat_all(f"engine_{op}") > 0
+                and recovery.reseat_all(
+                    f"engine_{op}", observed_epoch=attempt_epoch
+                )
+                > 0
             ):
                 # lineage re-seat: resident columns were rebuilt on the
                 # fresh device; give the call one post-recovery retry
@@ -361,7 +438,13 @@ def engine_call(
                 raise failure from err
             attempt += 1
             emit_metric(f"resilience.engine.{op}.retry", 1)
-            _sleep(backoff_s * (2 ** (attempt - 1)))
+            delay_s = backoff_s * (2 ** (attempt - 1))
+            if serving_context.CONTEXT_ON:
+                # a backoff sleep never outlives the query's budget: sleep
+                # at most the remaining time, and the attempt-start check
+                # above turns the expiry into the typed abort
+                delay_s = serving_context.clamp_sleep(delay_s)
+            _sleep(delay_s)
             continue
         except BaseException:  # graftlint: disable=EXC-HYGIENE -- span-stack unwind only (KeyboardInterrupt, bench SIGALRM); re-raised immediately
             # a non-Exception unwind (Ctrl-C, SectionTimeout) must still pop
@@ -561,6 +644,14 @@ def reset_breakers() -> None:
         _BREAKERS.clear()
 
 
+def drop_breaker(name: str) -> None:
+    """Forget one breaker by name (graftgate's tenant registry evicts idle
+    tenants' health breakers so per-user tenant ids cannot grow this
+    registry without bound; device-path families are never dropped)."""
+    with _breakers_lock:
+        _BREAKERS.pop(name, None)
+
+
 def device_path(family: str) -> Callable:
     """Decorator for ``TpuQueryCompiler._try_*`` methods: per-family breaker.
 
@@ -584,6 +675,21 @@ def device_path(family: str) -> Callable:
 
             if ResilienceMode.get() == "Disable":
                 return fn(self, *args, **kwargs)
+            if serving_context.CONTEXT_ON and serving_context.degraded_active():
+                # graftgate degraded mode: this thread's query was admitted
+                # while the device was sick (open breaker / ledger past
+                # high water) — route it to the pandas fallback exactly
+                # like an open breaker would, without touching the device
+                emit_metric("serving.degraded.fallback", 1)
+                if graftscope.TRACE_ON:
+                    graftscope.finish_span(
+                        graftscope.start_span(
+                            f"fallback.{family}",
+                            layer="QUERY-COMPILER",
+                            attrs={"family": family, "reason": "degraded"},
+                        )
+                    )
+                return None
             breaker = get_breaker(family)
             if not breaker.allow():
                 emit_metric(f"resilience.breaker.{family}.short_circuit", 1)
@@ -598,7 +704,17 @@ def device_path(family: str) -> Callable:
                 return None
             start = _now()
             try:
-                result = fn(self, *args, **kwargs)
+                if serving_context.CONTEXT_ON:
+                    # collective-safe dispatch (serving/context.py): the
+                    # kernel families direct-call their jitted programs, so
+                    # the whole guarded device path serializes — two
+                    # threads' sharded programs reaching the per-device
+                    # queues in different orders deadlock the collective
+                    # rendezvous.  Host/pandas fallbacks stay concurrent.
+                    with serving_context.dispatch_lock:
+                        result = fn(self, *args, **kwargs)
+                else:
+                    result = fn(self, *args, **kwargs)
             except Exception as err:  # graftlint: disable=EXC-HYGIENE -- device_path classification point: unclassified exceptions propagate
                 failure = classify_device_error(err)
                 if failure is None:

@@ -40,6 +40,7 @@ from typing import Any, List, Optional
 
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import spans as graftscope
+from modin_tpu.serving import context as serving_context
 
 
 class _HostCacheLedger:
@@ -261,33 +262,46 @@ class _DeviceLedger:
             candidates = list(self._entries.items())
         freed = 0
         spilled = 0
-        with graftscope.span(
-            "memory.device.spill", layer="JAX-ENGINE", target=target_bytes
-        ):
-            for _key, (ref, _nbytes) in candidates:
-                if freed >= target_bytes:
-                    break
-                col = ref()
-                if col is None or getattr(col, "is_lazy", False):
-                    continue
-                if exclude_ids is not None and id(col.raw) in exclude_ids:
-                    continue
-                try:
-                    got = col.spill()
-                except Exception:  # graftlint: disable=EXC-HYGIENE -- a column that cannot fetch its exact host copy simply stays resident; spill is best-effort by design
-                    continue
-                if got > 0:
-                    freed += got
-                    spilled += 1
-        if spilled:
-            with self._lock:
-                self._spill_events += spilled
-            emit_metric("memory.device.spill", spilled)
-            emit_metric("memory.device.spill_bytes", freed)
-            # residency gauges: observed after every spill pass so graftmeter
-            # snapshots carry the post-pressure footprint of both ledgers
-            emit_metric("memory.device.resident_bytes", self._total)
-            emit_metric("memory.host.cache_bytes", ledger.total_bytes())
+        try:
+            with graftscope.span(
+                "memory.device.spill", layer="JAX-ENGINE", target=target_bytes
+            ):
+                for _key, (ref, _nbytes) in candidates:
+                    if freed >= target_bytes:
+                        break
+                    if serving_context.CONTEXT_ON:
+                        # graftgate deadline boundary: a budget-expired
+                        # query must not keep paying device→host fetches
+                        # for columns it will never get to use (each
+                        # col.spill() below is atomic, so aborting between
+                        # columns leaves no torn state)
+                        serving_context.check_deadline("memory.device.spill")
+                    col = ref()
+                    if col is None or getattr(col, "is_lazy", False):
+                        continue
+                    if exclude_ids is not None and id(col.raw) in exclude_ids:
+                        continue
+                    try:
+                        got = col.spill()
+                    except Exception:  # graftlint: disable=EXC-HYGIENE -- a column that cannot fetch its exact host copy simply stays resident; spill is best-effort by design
+                        continue
+                    if got > 0:
+                        freed += got
+                        spilled += 1
+        finally:
+            # accounting in finally: a deadline abort mid-pass must still
+            # record the columns that DID spill (the OOM-burst injector and
+            # admission bookkeeping key off spill_count)
+            if spilled:
+                with self._lock:
+                    self._spill_events += spilled
+                emit_metric("memory.device.spill", spilled)
+                emit_metric("memory.device.spill_bytes", freed)
+                # residency gauges: observed after every spill pass so
+                # graftmeter snapshots carry the post-pressure footprint of
+                # both ledgers
+                emit_metric("memory.device.resident_bytes", self._total)
+                emit_metric("memory.host.cache_bytes", ledger.total_bytes())
         return freed
 
     def admit(self, estimate_bytes: int, exclude_ids: Any = None) -> None:

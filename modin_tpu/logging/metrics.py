@@ -276,6 +276,58 @@ METRICS = (
         "graftscope flight-recorder ring dumps written on a breaker-open "
         "or terminal device failure",
     ),
+    (
+        "serving.admit",
+        "counter",
+        "queries admitted by the graftgate admission gate (serving/)",
+    ),
+    (
+        "serving.queued",
+        "counter",
+        "admissions that waited in the bounded queue before a slot opened",
+    ),
+    (
+        "serving.queue_wait_s",
+        "histogram",
+        "seconds an admitted query spent in the admission queue",
+    ),
+    (
+        "serving.shed",
+        "counter",
+        "queries rejected with a typed QueryRejected (queue_full / "
+        "tenant throttled / tenant unhealthy) before any work ran",
+    ),
+    (
+        "serving.deadline_exceeded",
+        "counter",
+        "queries aborted by their latency budget (typed DeadlineExceeded "
+        "at a seam boundary or while queued)",
+    ),
+    (
+        "serving.degraded",
+        "counter",
+        "admitted queries routed to the host/pandas path because a "
+        "device-path breaker was open or the device ledger was past the "
+        "degraded high-water fraction",
+    ),
+    (
+        "serving.degraded.fallback",
+        "counter",
+        "device-path families short-circuited to the pandas fallback "
+        "because the running query was admitted in degraded mode",
+    ),
+    (
+        "serving.query_wall_s",
+        "histogram",
+        "end-to-end wall seconds per submitted query (admission to result)",
+    ),
+    (
+        "serving.tenant.*.*",
+        "counter",
+        "per-tenant serving outcomes: admit, complete, degraded, deadline, "
+        "device_failure, and the shed reasons (queue_full / throttled / "
+        "unhealthy)",
+    ),
 )
 
 

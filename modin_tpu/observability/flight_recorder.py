@@ -76,6 +76,7 @@ def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
         if now - _last_dump < MIN_DUMP_INTERVAL_S:
             return None
         _last_dump = now  # claim the window (concurrent callers back off)
+        claimed = now  # our claim token: see the failed-write reset below
         snapshot = list(ring)
         counters = list(_spans._COUNTERS or ())
     try:
@@ -114,7 +115,13 @@ def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
         # best-effort by contract: a failed dump must not worsen the fault —
         # and must not consume the rate-limit window (a transiently
         # unwritable TraceDir would otherwise suppress the next, possibly
-        # successful, dump of the real fault)
+        # successful, dump of the real fault).  Only release OUR claim:
+        # under simultaneous breaker-opens (graftgate: many threads, one
+        # incident) another thread may have claimed a newer window and be
+        # writing its dump right now — unconditionally zeroing the limiter
+        # here would re-open the window behind its back and let a third
+        # caller double-dump the same incident.
         with _dump_lock:
-            _last_dump = 0.0
+            if _last_dump == claimed:
+                _last_dump = 0.0
         return None

@@ -70,6 +70,17 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "plan.optimize.passes": (1, 2, 3, 4, 6, 8, 12, 16),
     # distinct plan nodes lowered per materialization
     "plan.lower.nodes": (1, 2, 4, 8, 16, 32, 64, 128, 256),
+    # seconds an admitted query spent in the admission queue (graftgate)
+    "serving.queue_wait_s": (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+        0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    ),
+    # end-to-end wall seconds per submitted query (graftgate; the bench's
+    # concurrent section reads p50/p99 straight off this family)
+    "serving.query_wall_s": (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    ),
 }
 
 VALID_KINDS = ("counter", "gauge", "histogram")
@@ -463,6 +474,7 @@ class QueryStats:
         "est_bytes",
         "padded_bytes",
         "padding_waste_bytes",
+        "breaker_trips",
         "_t0",
         "_lock",
         "_closed",
@@ -499,6 +511,10 @@ class QueryStats:
         self.est_bytes = 0.0
         self.padded_bytes = 0
         self.padding_waste_bytes = 0
+        # graftgate tenant health: device-path breaker strikes observed
+        # while this scope's query ran (its own fallbacks included — a
+        # query can complete correct via fallback yet be striking paths)
+        self.breaker_trips = 0
         self._t0 = time.perf_counter()
 
     # -- stream routing -------------------------------------------------- #
@@ -544,6 +560,17 @@ class QueryStats:
             self.cache_hits["plan_scan"] += int(value)
         elif name.startswith("recovery."):
             self.recoveries += int(value)
+        elif (
+            name.startswith("resilience.breaker.")
+            and name.endswith(".strike")
+            and not name.startswith("resilience.breaker.tenant_")
+        ):
+            # DEVICE-path strikes only: a nested submit's tenant-health
+            # breaker (graftgate strikes it on the same thread while the
+            # outer scope is still open) is a serving verdict, not device
+            # sickness — counting it would cascade one tenant's failures
+            # into the outer tenant's quarantine
+            self.breaker_trips += int(value)
         elif name.startswith("pandas-api."):
             self.api_calls += 1
 
@@ -575,6 +602,7 @@ class QueryStats:
             "est_bytes": self.est_bytes,
             "padded_bytes": self.padded_bytes,
             "padding_waste_bytes": self.padding_waste_bytes,
+            "breaker_trips": self.breaker_trips,
         }
 
     def summary(self) -> str:
@@ -640,9 +668,16 @@ def seed_thread_scopes(scopes: Optional[List["QueryStats"]]) -> None:
     is lock-guarded and a closed scope stops accepting, so a worker the
     owner abandoned (watchdog timeout) can race the owner's retry or
     outlive the scope without corrupting its rollup.
+
+    Always REPLACES the thread's stack — seeding with ``None``/empty
+    clears it.  The previous keep-if-falsy behavior was a single-owner
+    assumption: a pooled worker seeded for query A and later reused for
+    unscoped work (or query B) kept routing emissions into A's closed
+    scopes — closed-scope rejection hid the corruption, but a *still-open*
+    outer scope on the original thread would have silently absorbed
+    another query's metrics.
     """
-    if scopes:
-        _qs_tls.stack = list(scopes)
+    _qs_tls.stack = list(scopes) if scopes else []
 
 
 @contextlib.contextmanager

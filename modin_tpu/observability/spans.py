@@ -122,6 +122,18 @@ SPANS = (
         "through the eager dispatcher / query-compiler / engine seams "
         "(node count in attributes)",
     ),
+    (
+        "serving.admit",
+        "one graftgate admission decision: tenant, queue wait, and the "
+        "degraded-route flag in attributes; error status means the query "
+        "was shed or its deadline expired while queued",
+    ),
+    (
+        "serving.query",
+        "one admitted query's execution envelope under the serving "
+        "context (tenant / label / degraded in attributes); everything "
+        "the query dispatched nests under it",
+    ),
 )
 
 _EPOCH_PERF = time.perf_counter()

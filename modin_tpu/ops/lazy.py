@@ -29,6 +29,7 @@ Design notes:
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -36,6 +37,7 @@ import numpy as np
 
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import meters as graftmeter
+from modin_tpu.serving import context as serving_context
 
 _MAX_NODES = 160
 
@@ -43,17 +45,22 @@ _SCALAR_TYPES = (int, float, bool, np.integer, np.floating, np.bool_)
 
 # fingerprint -> jitted executable, LRU-bounded by MODIN_TPU_FUSED_CACHE_SIZE
 # (each entry pins an XLA executable; a long session with varying expression
-# shapes previously grew this without limit)
+# shapes previously grew this without limit).  All access is serialized by
+# _FUSED_LOCK: concurrent queries (graftgate) hit this cache from many
+# threads, and an unguarded OrderedDict move_to_end racing a popitem can
+# corrupt the dict's internal linkage, not just return a stale entry.
 _FUSED_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
+_FUSED_LOCK = threading.Lock()
 _evictions = 0
 
 
 def _fused_cache_get(key: Any) -> Optional[Any]:
-    fn = _FUSED_CACHE.get(key)
-    if fn is not None:
-        _FUSED_CACHE.move_to_end(key)
-        if graftmeter.ACCOUNTING_ON:
-            emit_metric("fusion.cache.hit", 1)
+    with _FUSED_LOCK:
+        fn = _FUSED_CACHE.get(key)
+        if fn is not None:
+            _FUSED_CACHE.move_to_end(key)
+    if fn is not None and graftmeter.ACCOUNTING_ON:
+        emit_metric("fusion.cache.hit", 1)
     return fn
 
 
@@ -61,17 +68,18 @@ def _fused_cache_put(key: Any, fn: Any) -> None:
     global _evictions
     from modin_tpu.config import FusedCacheSize
 
-    _FUSED_CACHE[key] = fn
-    _FUSED_CACHE.move_to_end(key)
     limit = FusedCacheSize.get()
-    if limit <= 0:
-        return
     evicted = 0
-    while len(_FUSED_CACHE) > limit:
-        _FUSED_CACHE.popitem(last=False)
-        evicted += 1
+    with _FUSED_LOCK:
+        _FUSED_CACHE[key] = fn
+        _FUSED_CACHE.move_to_end(key)
+        if limit > 0:
+            while len(_FUSED_CACHE) > limit:
+                _FUSED_CACHE.popitem(last=False)
+                evicted += 1
+        if evicted:
+            _evictions += evicted
     if evicted:
-        _evictions += evicted
         emit_metric("fusion.cache.evict", evicted)
 
 
@@ -239,6 +247,12 @@ def run_fused(
     into its elementwise producers) and its output is returned.
     """
     import jax
+
+    if serving_context.CONTEXT_ON:
+        # graftgate deadline boundary: fused-chain materialization is where
+        # a deferred query finally pays for its whole expression forest —
+        # check before linearize/compile, not after
+        serving_context.check_deadline("fusion.run_fused")
 
     if tail_builder is None and not any(is_lazy(r) for r in roots):
         return [r._result if isinstance(r, LazyExpr) else r for r in roots]

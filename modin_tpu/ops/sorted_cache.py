@@ -24,13 +24,27 @@ Correctness contract:
 - **Recovery**: after a device loss the graftguard reseat pass walks the
   same ledger; a rep is recognized (``is_derived_cache``) and dropped
   instead of replayed — it is disposable, never unrecoverable.
+- **Concurrency**: attach / get / invalidate are serialized by one module
+  lock (graftgate: concurrent queries legitimately share frames, so two
+  threads may race a sort-shaped op against a mutation of the same
+  column).  Without it, a reader could pass the identity check and then
+  observe ``rep._data = None`` torn in by a concurrent invalidate.  The
+  lock is module-wide, not per-column: the guarded sections are a few
+  attribute reads, and a per-column lock would have to live on
+  ``DeviceColumn`` (one more slot on every column for a cache only
+  sort-shaped ops touch).
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Optional, Tuple
 
 from modin_tpu.logging.metrics import emit_metric
+
+# reentrant: invalidate() drops the rep while already holding the lock,
+# and the ledger spill / recovery paths call SortedRep.drop() directly
+_CACHE_LOCK = threading.RLock()
 
 
 class SortedRep:
@@ -55,15 +69,21 @@ class SortedRep:
         return self._data
 
     def drop(self) -> int:
-        """Release the device buffer; returns bytes freed."""
-        if self._data is None:
-            return 0
-        from modin_tpu.core.memory import device_ledger
+        """Release the device buffer; returns bytes freed.
 
-        freed = device_ledger.deregister(self)
-        self._data = None
-        self.n_valid = None
-        return freed
+        Serialized under the module cache lock: ``_data`` only ever
+        transitions under it, so a reader holding the lock can never see
+        the pair torn by a concurrent ledger spill or recovery drop.
+        """
+        with _CACHE_LOCK:
+            if self._data is None:
+                return 0
+            from modin_tpu.core.memory import device_ledger
+
+            freed = device_ledger.deregister(self)
+            self._data = None
+            self.n_valid = None
+            return freed
 
     def spill(self) -> int:
         """Ledger spill protocol: derived data is dropped, not copied out."""
@@ -73,14 +93,27 @@ class SortedRep:
         return freed
 
 
-def _live_rep(col: Any) -> Optional[SortedRep]:
+def _invalidate_locked(col: Any) -> int:
+    """Detach + drop ``col``'s rep; returns bytes freed (lock held)."""
+    rep = getattr(col, "_sorted_rep", None)
+    if rep is None:
+        return 0
+    col._sorted_rep = None
+    return rep.drop()
+
+
+def _live_rep_locked(col: Any) -> Optional[SortedRep]:
+    """``col``'s rep if live and current, invalidating a stale one
+    (lock held: the identity check and any use of the returned rep's
+    buffer must be one atomic step against a concurrent invalidate)."""
     rep = getattr(col, "_sorted_rep", None)
     if rep is None or rep._data is None:
         return None
     from modin_tpu.core.execution import recovery
 
     if rep.epoch != recovery.current_epoch() or rep.source_id != id(col._data):
-        invalidate(col)
+        if _invalidate_locked(col):
+            emit_metric("sortcache.invalidate", 1)
         return None
     return rep
 
@@ -88,19 +121,24 @@ def _live_rep(col: Any) -> Optional[SortedRep]:
 def peek(col: Any) -> bool:
     """Whether ``col`` has a live, current rep (no metrics, no LRU touch —
     the router's planning probe)."""
-    return _live_rep(col) is not None
+    with _CACHE_LOCK:
+        return _live_rep_locked(col) is not None
 
 
 def get(col: Any) -> Optional[Tuple[Any, Any]]:
     """``(sorted values, n_valid)`` if ``col`` has a live, current rep."""
-    rep = _live_rep(col)
-    if rep is None:
-        return None
+    with _CACHE_LOCK:
+        rep = _live_rep_locked(col)
+        if rep is None:
+            return None
+        # copy the pair out under the lock: a concurrent invalidate after
+        # release only drops the ledger entry, never the arrays we hold
+        data, n_valid = rep._data, rep.n_valid
     from modin_tpu.core.memory import device_ledger
 
     device_ledger.touch(rep)
     emit_metric("sortcache.hit", 1)
-    return rep._data, rep.n_valid
+    return data, n_valid
 
 
 def attach(col: Any, xs: Any, n_valid: Any) -> None:
@@ -108,18 +146,19 @@ def attach(col: Any, xs: Any, n_valid: Any) -> None:
     from modin_tpu.core.execution import recovery
     from modin_tpu.core.memory import device_ledger
 
-    invalidate(col)
     rep = SortedRep(xs, n_valid, id(col._data), recovery.current_epoch())
-    device_ledger.register(rep)
-    col._sorted_rep = rep
+    with _CACHE_LOCK:
+        invalidated = _invalidate_locked(col)
+        device_ledger.register(rep)
+        col._sorted_rep = rep
+    if invalidated:
+        emit_metric("sortcache.invalidate", 1)
     emit_metric("sortcache.build", 1)
 
 
 def invalidate(col: Any) -> None:
     """Drop ``col``'s cached rep (buffer mutation, spill, re-seat)."""
-    rep = getattr(col, "_sorted_rep", None)
-    if rep is None:
-        return
-    col._sorted_rep = None
-    if rep.drop():
+    with _CACHE_LOCK:
+        freed = _invalidate_locked(col)
+    if freed:
         emit_metric("sortcache.invalidate", 1)

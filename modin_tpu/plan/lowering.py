@@ -23,6 +23,7 @@ from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import costs as graftcost
 from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
+from modin_tpu.serving import context as serving_context
 from modin_tpu.plan.ir import (
     Filter,
     GroupbyAgg,
@@ -44,6 +45,14 @@ _tls = threading.local()
 #: cache must stay small — a long-lived deferred frame forced under many
 #: different projections re-reads rather than hoard every width it ever saw.
 _SCAN_CACHE_MAX = 4
+
+#: One lock for every origin's read cache: concurrent queries (graftgate)
+#: can force plans sharing a Scan origin from several threads, and an
+#: unguarded dict iteration racing the FIFO eviction is torn state.  The
+#: physical read itself happens OUTSIDE the lock (a slow parse must not
+#: serialize every other query's scan); the worst case is a duplicate
+#: parse, never a corrupt cache.
+_SCAN_CACHE_LOCK = threading.Lock()
 
 
 def in_lowering() -> bool:
@@ -97,6 +106,10 @@ def _lower(node: PlanNode, memo: Dict[int, Any]) -> Any:
     hit = memo.get(id(node))
     if hit is not None:
         return hit
+    if serving_context.CONTEXT_ON:
+        # graftgate deadline boundary: between plan nodes is the cheapest
+        # safe place to abort a deferred query — nothing is half-lowered
+        serving_context.check_deadline("plan.lower")
     instrument = getattr(_tls, "instrument", None)
     if instrument is None:
         return _lower_node(node, memo)
@@ -207,13 +220,18 @@ def _lower_scan(node: Scan, memo: Dict[int, Any]) -> Any:
     # serve from a prior materialization of this source when it covers the
     # need: a scan shared by several plans (or re-forced after a reduction)
     # must not re-parse the file per force()
-    for key, cached in (origin.cache or {}).items():
-        if key is None and need is None:
-            emit_metric("plan.scan.cache_hit", 1)
-            return cached
-        if need is not None and (key is None or set(need) <= set(key)):
-            emit_metric("plan.scan.cache_hit", 1)
-            return cached.getitem_column_array(list(need))
+    hit = None
+    with _SCAN_CACHE_LOCK:
+        for key, cached in (origin.cache or {}).items():
+            if key is None and need is None:
+                hit = cached
+                break
+            if need is not None and (key is None or set(need) <= set(key)):
+                hit = cached
+                break
+    if hit is not None:
+        emit_metric("plan.scan.cache_hit", 1)
+        return hit if need is None else hit.getitem_column_array(list(need))
     kwargs = scan_read_kwargs(node)
     if need is not None:
         emit_metric(
@@ -221,9 +239,10 @@ def _lower_scan(node: Scan, memo: Dict[int, Any]) -> Any:
         )
     qc = node.dispatcher.read(**kwargs)
     if origin.cache is not None:
-        while len(origin.cache) >= _SCAN_CACHE_MAX:
-            origin.cache.pop(next(iter(origin.cache)))
-        origin.cache[need] = qc
+        with _SCAN_CACHE_LOCK:
+            while len(origin.cache) >= _SCAN_CACHE_MAX:
+                origin.cache.pop(next(iter(origin.cache)))
+            origin.cache[need] = qc
     return qc
 
 

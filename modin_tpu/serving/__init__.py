@@ -1,0 +1,82 @@
+"""graftgate — multi-tenant query serving: admission, deadlines, degradation.
+
+Public surface::
+
+    from modin_tpu import serving
+
+    result = serving.submit(
+        lambda: df.groupby("key").sum(),
+        tenant="alice",
+        deadline_ms=250,
+    )
+
+With ``MODIN_TPU_SERVING=0`` (the default) ``submit`` is a transparent
+direct call — bit-for-bit today's single-query behavior, zero allocations.
+With serving on, every submitted query is admitted (bounded concurrency +
+device-byte headroom), queued (bounded depth, weighted-fair wake order),
+or shed with a typed :class:`QueryRejected`; a latency budget rides the
+query as a :class:`CancellationToken` checked at the engine-seam
+boundaries and surfaces as a typed :class:`DeadlineExceeded`; and when the
+device is sick (open breakers / ledger past high water) admitted queries
+route to the host path instead of queueing behind it.
+
+Import discipline: only :mod:`~modin_tpu.serving.errors` and
+:mod:`~modin_tpu.serving.context` load eagerly — they are leaves, and the
+resilience layer imports them at module scope.  The gate (which imports
+resilience back) loads lazily on first use via PEP 562.
+"""
+
+from modin_tpu.serving import context, errors  # noqa: F401
+from modin_tpu.serving.context import (  # noqa: F401
+    CancellationToken,
+    QueryContext,
+    context_alloc_count,
+)
+from modin_tpu.serving.errors import (  # noqa: F401
+    DeadlineExceeded,
+    QueryRejected,
+    ServingError,
+)
+
+# NOTE: "gate" and "tenants" are deliberately NOT lazy-mapped here —
+# importing a submodule binds the MODULE object to the package attribute,
+# so mapping `serving.gate` to the AdmissionGate instance would make the
+# attribute's type depend on import order.  `serving.gate` is always the
+# submodule; the instance lives at `serving.gate.gate`.
+_LAZY = {
+    "submit": "modin_tpu.serving.gate",
+    "AdmissionGate": "modin_tpu.serving.gate",
+    "Permit": "modin_tpu.serving.gate",
+    "serving_snapshot": "modin_tpu.serving.gate",
+}
+
+__all__ = [
+    "AdmissionGate",
+    "CancellationToken",
+    "DeadlineExceeded",
+    "Permit",
+    "QueryContext",
+    "QueryRejected",
+    "ServingError",
+    "context",
+    "context_alloc_count",
+    "errors",
+    "serving_snapshot",
+    "submit",
+]
+
+
+def __getattr__(name: str):
+    if name in ("gate", "tenants"):
+        import importlib
+
+        return importlib.import_module(f"modin_tpu.serving.{name}")
+    module_name = _LAZY.get(name)
+    if module_name is None:
+        raise AttributeError(f"module 'modin_tpu.serving' has no attribute {name!r}")
+    import importlib
+
+    module = importlib.import_module(module_name)
+    value = getattr(module, name)
+    globals()[name] = value
+    return value

@@ -1,0 +1,241 @@
+"""Per-query serving context: deadline/cancellation tokens + degraded routing.
+
+One thread runs one admitted query at a time; this module carries that
+query's serving state — its :class:`CancellationToken` (deadline) and its
+degraded-route flag — on a thread-local, exactly the way graftscope spans
+and graftmeter QueryStats scopes ride their own thread-locals.  The seam
+checks (``engine_call`` attempt start and backoff sleeps, the device-memory
+spill pass, ``run_fused`` materialization, plan lowering) all gate on ONE
+module attribute, :data:`CONTEXT_ON`, so the default-off mode
+(``MODIN_TPU_SERVING=0`` and no ad-hoc deadline scope) costs one attribute
+read per seam crossing and allocates nothing —
+:func:`context_alloc_count` lets tests assert exactly that, mirroring
+``spans.span_alloc_count()`` / ``meters.meter_alloc_count()``.
+
+Cross-thread propagation mirrors spans/meters too: the resilience watchdog
+worker adopts the owner's context via :func:`snapshot_context` /
+:func:`seed_thread_context`, so a deadline expiring inside a watched thunk
+aborts with the same typed error it would on the owning thread.  Seeding
+always *replaces* the thread's context (a pooled worker reused across
+queries must never keep a previous query's deadline).
+
+This module is a leaf on purpose — it imports only the metric stream — so
+core/execution/resilience.py can import it at module scope without a cycle
+(serving/__init__ loads only ``errors`` and ``context`` eagerly; the gate
+machinery is lazy).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.serving.errors import DeadlineExceeded
+
+#: Module-level fast path: True while at least one serving query context
+#: (or ad-hoc deadline scope) is active anywhere in the process.  Seam
+#: checks read this ONE attribute before doing anything else.
+CONTEXT_ON: bool = False
+
+_active = 0
+_active_lock = threading.Lock()
+
+_tls = threading.local()  # .ctx: the innermost QueryContext on this thread
+
+_alloc_count = 0  # QueryContext objects ever constructed (zero-alloc assert)
+
+#: Collective-safe dispatch serialization.  Concurrent threads enqueueing
+#: sharded XLA programs onto the same device mesh can interleave their
+#: per-device executions — and two programs with cross-device collectives
+#: that reach the per-device queues in different orders DEADLOCK at the
+#: collective rendezvous (reproduced on the 8-device virtual CPU mesh:
+#: two AllReduce run_ids each waiting forever for the other's
+#: participants; real multi-chip meshes have the same launch-order
+#: hazard).  While a serving context is active, the engine seam wraps
+#: every deploy/put attempt in this lock so program enqueue is one global
+#: order across threads.  Reentrant: a recovery pass re-deploys from
+#: inside a failed attempt's handling on the same thread.
+dispatch_lock = threading.RLock()
+
+# test seam, resilience-style: patched to simulate clock advance
+_now = time.monotonic
+
+
+def context_alloc_count() -> int:
+    """How many query contexts this process has ever constructed.
+
+    The disabled-mode contract is *zero new allocations*; tests snapshot
+    this counter around a workload run with serving off.
+    """
+    return _alloc_count
+
+
+class CancellationToken:
+    """One query's latency budget: a monotonic deadline plus a manual
+    cancel flag.  Checked (never polled) at seam boundaries; expiry and
+    cancellation both surface as :class:`DeadlineExceeded`."""
+
+    __slots__ = ("deadline_at", "deadline_s", "label", "_cancelled", "_raised")
+
+    def __init__(self, deadline_s: Optional[float], label: str = "query"):
+        self.deadline_s = deadline_s
+        self.deadline_at = (
+            _now() + deadline_s if deadline_s is not None else None
+        )
+        self.label = label
+        self._cancelled = False
+        self._raised = False
+
+    def cancel(self) -> None:
+        """Abort the query at its next seam crossing (client disconnect)."""
+        self._cancelled = True
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds of budget left (None = unbounded; <= 0 = expired)."""
+        if self._cancelled:
+            return 0.0
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - _now()
+
+    def expired(self) -> bool:
+        remaining = self.remaining_s()
+        return remaining is not None and remaining <= 0
+
+    def check(self, where: str = "") -> None:
+        """Raise :class:`DeadlineExceeded` if the budget is gone."""
+        if not self.expired():
+            return
+        if not self._raised:
+            # once per token: the owner and a seeded watchdog worker can
+            # both observe expiry, but the query died exactly once
+            self._raised = True
+            emit_metric("serving.deadline_exceeded", 1)
+        budget = self.deadline_s if self.deadline_s is not None else 0.0
+        verb = "cancelled" if self._cancelled else (
+            f"exceeded its {budget * 1e3:.0f}ms deadline"
+        )
+        raise DeadlineExceeded(
+            f"query {self.label!r} {verb} (observed at {where or 'seam'}; "
+            "MODIN_TPU_SERVING_DEFAULT_DEADLINE_MS)",
+            deadline_s=budget,
+            where=where,
+        )
+
+
+class QueryContext:
+    """The serving state one admitted query carries across the seams."""
+
+    __slots__ = ("token", "degraded", "tenant", "label")
+
+    def __init__(
+        self,
+        token: Optional[CancellationToken],
+        degraded: bool = False,
+        tenant: str = "default",
+        label: str = "query",
+    ):
+        global _alloc_count
+        _alloc_count += 1
+        self.token = token
+        self.degraded = degraded
+        self.tenant = tenant
+        self.label = label
+
+
+# ---------------------------------------------------------------------- #
+# thread-local plumbing (callers check CONTEXT_ON first)
+# ---------------------------------------------------------------------- #
+
+
+def current_context() -> Optional[QueryContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def current_token() -> Optional[CancellationToken]:
+    ctx = getattr(_tls, "ctx", None)
+    return ctx.token if ctx is not None else None
+
+
+def degraded_active() -> bool:
+    """Is this thread's query routed to the host/pandas path?"""
+    ctx = getattr(_tls, "ctx", None)
+    return ctx is not None and ctx.degraded
+
+
+def check_deadline(where: str = "") -> None:
+    """Seam check: raise DeadlineExceeded when the thread's budget is gone.
+
+    No-op without an active token — callers pre-gate on :data:`CONTEXT_ON`
+    so the common path never even reaches this call.
+    """
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None and ctx.token is not None:
+        ctx.token.check(where)
+
+
+def remaining_s() -> Optional[float]:
+    """This thread's remaining budget (None = no active deadline)."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None or ctx.token is None:
+        return None
+    return ctx.token.remaining_s()
+
+
+def clamp_sleep(delay_s: float) -> float:
+    """A sleep duration that never outlives this thread's budget.
+
+    Backoff sleeps between engine retries call this: a 100ms-budget query
+    must not serve a 1.6s exponential backoff — it sleeps out its budget
+    and the next attempt-start check aborts it with the typed error.
+    """
+    remaining = remaining_s()
+    if remaining is None:
+        return delay_s
+    return max(min(delay_s, remaining), 0.0)
+
+
+def enter_context(ctx: QueryContext) -> Optional[QueryContext]:
+    """Install ``ctx`` on this thread; returns the displaced context (the
+    gate restores it on exit so nested submits compose)."""
+    global CONTEXT_ON
+    previous = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    with _active_lock:
+        global _active
+        _active += 1
+        CONTEXT_ON = True
+    return previous
+
+
+def exit_context(previous: Optional[QueryContext]) -> None:
+    global CONTEXT_ON
+    _tls.ctx = previous
+    with _active_lock:
+        global _active
+        _active -= 1
+        if _active <= 0:
+            _active = 0
+            CONTEXT_ON = False
+
+
+def snapshot_context() -> Optional[QueryContext]:
+    """This thread's context, for seeding a worker thread (watchdog)."""
+    return getattr(_tls, "ctx", None)
+
+
+def seed_thread_context(ctx: Optional[QueryContext]) -> None:
+    """Adopt (or clear) a context snapshot on a worker thread.
+
+    Always REPLACES: a pooled worker seeded for query A and later reused
+    for query B (or for un-scoped work, ctx=None) must not retain A's
+    deadline — the single-owner assumption the concurrency audit killed.
+    The active-count bookkeeping is untouched: the owner's enter/exit pair
+    owns the lifecycle; workers only route checks.
+    """
+    _tls.ctx = ctx

@@ -1,0 +1,565 @@
+"""graftgate: the bounded admission gate + the ``submit`` query front end.
+
+Every robustness layer below this one (resilience retry/breakers, graftguard
+lineage recovery, device-memory admission at ``deploy``) assumes one query
+at a time.  This module is the multi-tenant front door that makes hundreds
+of concurrent sessions a *defined* workload instead of an unbounded
+pile-up:
+
+- **Admission + backpressure.**  At most ``MODIN_TPU_SERVING_MAX_CONCURRENT``
+  queries run; each admitted query *reserves* its estimated device bytes
+  (the tenant's graftcost EWMA, or the conservative
+  ``device_budget / max_concurrent`` default for unknown tenants) against
+  the ``_DeviceLedger`` budget, so admission decisions happen BEFORE work
+  lands on the device rather than after an OOM.  Excess load waits in a
+  queue bounded by ``MODIN_TPU_SERVING_QUEUE_DEPTH``; past that, queries
+  are **shed** with a typed :class:`~.errors.QueryRejected` carrying a
+  retry-after hint.  Nothing ever waits unboundedly by accident: a queued
+  query with a deadline spends its budget waiting and aborts typed.
+
+- **Deadlines.**  ``deadline_ms`` (default
+  ``MODIN_TPU_SERVING_DEFAULT_DEADLINE_MS``) becomes a
+  :class:`~.context.CancellationToken` threaded through the engine seams;
+  see serving/context.py for the seam-boundary check sites and the
+  bounded-overshoot contract.
+
+- **Fairness + health.**  Weighted token buckets and per-tenant circuit
+  breakers (serving/tenants.py): a tenant past its weighted rate is
+  throttled, a tenant whose queries keep striking device-path breakers is
+  quarantined for the breaker cooldown — never the whole system.  When
+  the gate is saturated, the wake order among queued tenants is
+  weighted-fair (fewest in-flight per weight unit first), not FIFO-by-luck.
+
+- **Degraded mode.**  When a device-path breaker is OPEN or the device
+  ledger is past ``MODIN_TPU_SERVING_DEGRADED_HIGH_WATER`` of its budget,
+  admitted queries are routed to the host/pandas path (``@device_path``
+  families short-circuit, exactly like an open breaker) with a
+  ``serving.degraded`` metric — queueing behind a sick device is the one
+  thing a latency-budgeted query must never do.
+
+Zero-overhead-when-off: ``MODIN_TPU_SERVING=0`` (the default) makes
+``submit`` a direct call of the query function — no token, no scope, no
+allocation (asserted via ``context.context_alloc_count``), and the seam
+checks elsewhere see ``context.CONTEXT_ON`` False.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import meters as graftmeter
+from modin_tpu.observability import spans as graftscope
+from modin_tpu.serving import context as _context
+from modin_tpu.serving import tenants as _tenants
+from modin_tpu.serving.errors import DeadlineExceeded, QueryRejected, ServingError
+
+#: Module-level fast path: the MODIN_TPU_SERVING switch.
+SERVING_ON: bool = False
+
+#: Fallback retry-after hint (seconds) for a tenant with no wall history.
+_DEFAULT_RETRY_AFTER_S = 0.05
+
+#: Conservative cost default when no device budget is configured: admission
+#: then bounds only concurrency/queue/fairness, not bytes.
+_NO_BUDGET_COST = 0.0
+
+
+def _device_budget() -> Optional[int]:
+    from modin_tpu.core import memory as _memory
+
+    return _memory._DEVICE_BUDGET
+
+
+def _device_resident() -> int:
+    from modin_tpu.core import memory as _memory
+
+    return _memory.device_ledger.total_bytes()
+
+
+def _device_breaker_open() -> bool:
+    """Is any *device-path* breaker currently OPEN?  (Tenant/ad-hoc breakers
+    do not count: a sick tenant must not degrade everyone else's queries.)"""
+    from modin_tpu.core.execution.resilience import (
+        DEVICE_PATH_FAMILIES,
+        breaker_snapshot,
+    )
+
+    return any(
+        state == "open"
+        for name, state in breaker_snapshot().items()
+        if name in DEVICE_PATH_FAMILIES
+    )
+
+
+class _Waiter:
+    """One queued admission request (its own event: targeted wakeups)."""
+
+    __slots__ = ("tenant", "weight", "cost", "seq", "event")
+
+    def __init__(self, tenant: str, weight: float, cost: float, seq: int):
+        self.tenant = tenant
+        self.weight = weight
+        self.cost = cost
+        self.seq = seq
+        self.event = threading.Event()
+
+
+class Permit:
+    """Proof of admission; carries the per-query serving decisions."""
+
+    __slots__ = (
+        "tenant", "cost_bytes", "degraded", "queue_wait_s", "admitted_at",
+    )
+
+    def __init__(
+        self, tenant: str, cost_bytes: float, degraded: bool,
+        queue_wait_s: float,
+    ):
+        self.tenant = tenant
+        self.cost_bytes = cost_bytes
+        self.degraded = degraded
+        self.queue_wait_s = queue_wait_s
+        self.admitted_at = time.monotonic()
+
+
+class AdmissionGate:
+    """The process-wide bounded admission gate (one instance, module-level)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._running = 0
+        self._reserved_bytes = 0.0
+        self._inflight: dict = {}  # tenant -> running count
+        self._waiters: list = []
+        self._seq = 0
+        # lifetime counters for snapshots / the bench section
+        self.admitted = 0
+        self.queued = 0
+        self.shed = 0
+        self.degraded_count = 0
+        self.completed = 0
+
+    # -- config ---------------------------------------------------------- #
+
+    @staticmethod
+    def _max_concurrent() -> int:
+        from modin_tpu.config import ServingMaxConcurrent
+
+        return max(int(ServingMaxConcurrent.get()), 1)
+
+    @staticmethod
+    def _queue_depth() -> int:
+        from modin_tpu.config import ServingQueueDepth
+
+        return max(int(ServingQueueDepth.get()), 0)
+
+    @staticmethod
+    def _high_water() -> float:
+        from modin_tpu.config import ServingDegradedHighWater
+
+        return float(ServingDegradedHighWater.get())
+
+    # -- admission ------------------------------------------------------- #
+
+    def _cost_estimate(self, tenant: str) -> float:
+        budget = _device_budget()
+        if budget is None:
+            return _NO_BUDGET_COST
+        default = budget / self._max_concurrent()
+        return _tenants.registry.cost_estimate(tenant, default)
+
+    def _fits(self, cost: float) -> bool:
+        """Slot + byte headroom check (caller holds the lock)."""
+        if self._running >= self._max_concurrent():
+            return False
+        budget = _device_budget()
+        if budget is None or self._running == 0:
+            # admit-one rule: a query estimated past the whole budget must
+            # still be runnable alone (deploy-seam spill handles the rest);
+            # otherwise it would queue forever behind nothing
+            return True
+        return self._reserved_bytes + cost <= budget
+
+    def _next_waiter(self) -> Optional["_Waiter"]:
+        """Weighted-fair head-of-queue: fewest in-flight per weight unit,
+        FIFO within a tie (caller holds the lock)."""
+        if not self._waiters:
+            return None
+        return min(
+            self._waiters,
+            key=lambda w: (
+                self._inflight.get(w.tenant, 0) / max(w.weight, 1e-9),
+                w.seq,
+            ),
+        )
+
+    def _wake(self) -> None:
+        """Signal the waiter whose turn it is (caller holds the lock)."""
+        head = self._next_waiter()
+        if head is not None:
+            head.event.set()
+
+    def _shed(self, tenant: str, reason: str, retry_after_s: float) -> None:
+        # called WITHOUT the gate lock (metric fan-out must never run
+        # under it); only the counter bump takes it
+        with self._lock:
+            self.shed += 1
+        emit_metric("serving.shed", 1)
+        emit_metric(f"serving.tenant.{_tenants.sanitize(tenant)}.{reason}", 1)
+        _tenants.registry.note_shed(tenant)
+        raise QueryRejected(
+            f"query for tenant {tenant!r} rejected ({reason}); retry after "
+            f"~{retry_after_s * 1e3:.0f}ms",
+            reason=reason,
+            retry_after_s=retry_after_s,
+        )
+
+    def acquire(
+        self,
+        tenant: str,
+        token: Optional[_context.CancellationToken],
+    ) -> Permit:
+        """Admit, queue, or shed — the serving decision tree.
+
+        Order: tenant health (breaker) -> tenant rate (token bucket) ->
+        capacity (slots + byte headroom) -> bounded queue -> shed.
+        """
+        breaker = _tenants.breaker_for(tenant)
+        if not breaker.allow():
+            from modin_tpu.config import ResilienceBreakerCooldownS
+
+            self._shed(
+                tenant, "unhealthy", float(ResilienceBreakerCooldownS.get())
+            )
+        spent, retry_after = _tenants.registry.try_spend(tenant)
+        if not spent:
+            self._shed(tenant, "throttled", retry_after)
+
+        cost = self._cost_estimate(tenant)
+        weight = _tenants.registry.get(tenant).weight
+        wait_t0 = time.perf_counter()
+        waiter: Optional[_Waiter] = None
+        queue_len = None
+        with self._lock:
+            if self._fits(cost) and not self._waiters:
+                self._reserve_locked(tenant, cost)
+            elif len(self._waiters) >= self._queue_depth():
+                queue_len = len(self._waiters)
+            else:
+                self._seq += 1
+                waiter = _Waiter(tenant, weight, cost, self._seq)
+                self._waiters.append(waiter)
+                self.queued += 1
+        if waiter is None and queue_len is None:
+            return self._finalize_admit(tenant, cost, 0.0)
+        if queue_len is not None:
+            # queue is full at max concurrency: the soonest realistic
+            # retry is one queue drain away — and the tenant's rate token
+            # comes back: this is a capacity verdict, not a rate one, and
+            # a polite retrying client must not drain its bucket into a
+            # bogus "throttled" quarantine
+            wall = _tenants.registry.wall_hint(tenant, _DEFAULT_RETRY_AFTER_S)
+            hint = wall * (1 + queue_len / self._max_concurrent())
+            _tenants.registry.refund(tenant)
+            self._shed(tenant, "queue_full", hint)
+        emit_metric("serving.queued", 1)
+        try:
+            while True:
+                remaining = token.remaining_s() if token is not None else None
+                if remaining is not None and remaining <= 0:
+                    # budget spent in the queue: typed abort, never a hang
+                    # (the rate token comes back — nothing ever ran)
+                    _tenants.registry.refund(tenant)
+                    emit_metric(
+                        f"serving.tenant.{_tenants.sanitize(tenant)}.deadline",
+                        1,
+                    )
+                    token.check("serving.queue")  # raises DeadlineExceeded
+                    raise DeadlineExceeded(  # unreachable backstop
+                        "deadline expired while queued", where="serving.queue"
+                    )
+                waiter.event.wait(
+                    timeout=min(remaining, 0.5) if remaining is not None else 0.5
+                )
+                with self._lock:
+                    waiter.event.clear()
+                    head = self._next_waiter()
+                    if head is waiter and self._fits(waiter.cost):
+                        self._waiters.remove(waiter)
+                        waiter = None
+                        wait_s = time.perf_counter() - wait_t0
+                        self._reserve_locked(tenant, cost)
+                        # capacity may admit more than one queued query
+                        self._wake()
+                    elif head is not None and head is not waiter:
+                        # the wakeup landed on the wrong waiter (the fair
+                        # head changed after release() signalled us): pass
+                        # it on, or freed capacity idles until the next
+                        # 0.5s poll — straight into admitted-p99
+                        head.event.set()
+                if waiter is None:
+                    emit_metric("serving.queue_wait_s", wait_s)
+                    return self._finalize_admit(tenant, cost, wait_s)
+        finally:
+            if waiter is not None:  # deadline abort: leave the queue clean
+                with self._lock:
+                    if waiter in self._waiters:
+                        self._waiters.remove(waiter)
+                    self._wake()
+
+    def _reserve_locked(self, tenant: str, cost: float) -> None:
+        """Counter/reservation mutations only — the caller holds the gate
+        lock, so nothing here may fan out to metric handlers, scan breaker
+        state, or touch other subsystems' locks."""
+        self._running += 1
+        self._reserved_bytes += cost
+        self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+        self.admitted += 1
+
+    def _finalize_admit(
+        self, tenant: str, cost: float, queue_wait_s: float
+    ) -> Permit:
+        """The admission's observable side — degraded-route evaluation
+        (breaker snapshot + ledger read) and metric fan-out — run WITHOUT
+        the gate lock: one slow metric handler must not stall every other
+        thread's admission decision."""
+        _tenants.registry.note_admitted(tenant)
+        degraded = self._degraded_route()
+        if degraded:
+            with self._lock:
+                self.degraded_count += 1
+            emit_metric("serving.degraded", 1)
+            emit_metric(
+                f"serving.tenant.{_tenants.sanitize(tenant)}.degraded", 1
+            )
+        emit_metric("serving.admit", 1)
+        emit_metric(f"serving.tenant.{_tenants.sanitize(tenant)}.admit", 1)
+        return Permit(tenant, cost, degraded, queue_wait_s)
+
+    def _degraded_route(self) -> bool:
+        """Route this admission to the host path?  (breaker-open device, or
+        ledger past the high-water fraction of its budget)."""
+        if _device_breaker_open():
+            return True
+        budget = _device_budget()
+        if budget is None:
+            return False
+        return _device_resident() >= self._high_water() * budget
+
+    def release(self, permit: Permit) -> None:
+        with self._lock:
+            self._running = max(self._running - 1, 0)
+            self._reserved_bytes = max(
+                self._reserved_bytes - permit.cost_bytes, 0.0
+            )
+            count = self._inflight.get(permit.tenant, 0) - 1
+            if count <= 0:
+                self._inflight.pop(permit.tenant, None)
+            else:
+                self._inflight[permit.tenant] = count
+            self.completed += 1
+            self._wake()
+        _tenants.registry.note_release(permit.tenant)
+
+    # -- introspection --------------------------------------------------- #
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": SERVING_ON,
+                "running": self._running,
+                "queued": len(self._waiters),
+                "reserved_bytes": self._reserved_bytes,
+                "admitted": self.admitted,
+                "ever_queued": self.queued,
+                "shed": self.shed,
+                "degraded": self.degraded_count,
+                "completed": self.completed,
+                "max_concurrent": self._max_concurrent(),
+                "queue_depth": self._queue_depth(),
+            }
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._waiters.clear()
+            self._running = 0
+            self._reserved_bytes = 0.0
+            self._inflight.clear()
+            self._seq = 0
+            self.admitted = self.queued = self.shed = 0
+            self.degraded_count = self.completed = 0
+
+
+gate = AdmissionGate()
+
+#: Reentrancy marker: depth of submit() frames on this thread.  An
+#: admitted query that submits another query must NOT go back through the
+#: gate — at saturation it would queue behind the slot its own caller
+#: holds and deadlock (MAX_CONCURRENT=1 + nested submit = permanent hang
+#: without this).  The inner call runs under the outer permit: its own
+#: deadline token still nests via the context stack, but slots, tenant
+#: buckets, and byte reservations belong to the outer admission.
+_tls = threading.local()
+
+
+def serving_snapshot() -> dict:
+    """Gate + tenant state for dashboards / debugging."""
+    snap = gate.snapshot()
+    snap["tenants"] = _tenants.registry.snapshot()
+    return snap
+
+
+# ---------------------------------------------------------------------- #
+# the query front end
+# ---------------------------------------------------------------------- #
+
+
+def submit(
+    fn: Callable[..., Any],
+    *args: Any,
+    tenant: str = "default",
+    deadline_ms: Optional[float] = None,
+    label: Optional[str] = None,
+    **kwargs: Any,
+) -> Any:
+    """Run one query under admission control, returning its result.
+
+    With serving off (``MODIN_TPU_SERVING=0``, the default) this is a
+    direct call of ``fn`` — bit-for-bit the single-query behavior, zero
+    allocations.  With serving on, the call is admitted (or typed-rejected)
+    by the gate, runs under a :class:`~.context.QueryContext` carrying its
+    deadline/cancellation token and degraded-route flag, and is accounted
+    in a ``query_stats`` scope whose rollup feeds the tenant's cost EWMA
+    and health breaker.
+
+    ``deadline_ms=None`` takes ``MODIN_TPU_SERVING_DEFAULT_DEADLINE_MS``
+    (0 = unbounded); ``deadline_ms=0`` forces unbounded for this query.
+    """
+    if not SERVING_ON:
+        return fn(*args, **kwargs)
+    if deadline_ms is None:
+        from modin_tpu.config import ServingDefaultDeadlineMs
+
+        deadline_ms = float(ServingDefaultDeadlineMs.get())
+    qlabel = label or getattr(fn, "__name__", "query")
+    token = (
+        _context.CancellationToken(deadline_ms / 1e3, qlabel)
+        if deadline_ms and deadline_ms > 0
+        else None
+    )
+    if getattr(_tls, "depth", 0) > 0:
+        # nested submit on an already-admitted thread: run under the outer
+        # permit (re-entering the gate would deadlock at saturation); the
+        # inner deadline/degraded context still nests and unwinds
+        outer = _context.current_context()
+        ctx = _context.QueryContext(
+            token if token is not None else (outer.token if outer else None),
+            outer.degraded if outer is not None else False,
+            tenant,
+            qlabel,
+        )
+        previous = _context.enter_context(ctx)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _context.exit_context(previous)
+    sp = None
+    if graftscope.TRACE_ON:
+        sp = graftscope.start_span(
+            "serving.admit",
+            layer="PANDAS-API",
+            attrs={"tenant": tenant, "label": qlabel},
+        )
+    try:
+        permit = gate.acquire(tenant, token)
+    except ServingError:
+        if sp is not None:
+            graftscope.finish_span(sp, status="error")
+        raise
+    if sp is not None:
+        sp.attrs["queue_wait_s"] = round(permit.queue_wait_s, 6)
+        sp.attrs["degraded"] = permit.degraded
+        graftscope.finish_span(sp)
+    ctx = _context.QueryContext(token, permit.degraded, tenant, qlabel)
+    previous = _context.enter_context(ctx)
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    t0 = time.perf_counter()
+    stats = None
+    failure_kind = None
+    try:
+        with graftscope.span(
+            "serving.query",
+            layer="PANDAS-API",
+            tenant=tenant,
+            label=qlabel,
+            degraded=permit.degraded,
+        ):
+            with graftmeter.query_stats(qlabel) as stats:
+                return fn(*args, **kwargs)
+    except ServingError:
+        failure_kind = "serving"
+        raise
+    except Exception as err:
+        from modin_tpu.core.execution.resilience import classify_device_error
+
+        if classify_device_error(err) is not None:
+            failure_kind = "device"
+        raise
+    finally:
+        _tls.depth -= 1
+        _context.exit_context(previous)
+        gate.release(permit)
+        wall_s = time.perf_counter() - t0
+        emit_metric("serving.query_wall_s", wall_s)
+        _finish_accounting(tenant, stats, wall_s, failure_kind)
+
+
+def _finish_accounting(
+    tenant: str, stats: Any, wall_s: float, failure_kind: Optional[str]
+) -> None:
+    """Fold the query's rollup into tenant cost/health state (never raises
+    into the caller's result path)."""
+    try:
+        cost_bytes = 0.0
+        trips = 0
+        if stats is not None:
+            cost_bytes = float(stats.est_bytes or 0.0) or float(
+                stats.hbm_high_water or 0.0
+            )
+            trips = int(getattr(stats, "breaker_trips", 0))
+        _tenants.registry.observe(tenant, cost_bytes, wall_s)
+        breaker = _tenants.breaker_for(tenant)
+        if failure_kind == "device" or trips > 0:
+            # the query kept striking device paths (or died on a terminal
+            # device failure): one strike for the tenant's health breaker
+            breaker.record_failure()
+        elif failure_kind is None:
+            breaker.record_success()
+        outcome = {
+            None: "complete",
+            "serving": "deadline",
+            "device": "device_failure",
+        }.get(failure_kind, "complete")
+        emit_metric(
+            f"serving.tenant.{_tenants.sanitize(tenant)}.{outcome}", 1
+        )
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------- #
+# config wiring
+# ---------------------------------------------------------------------- #
+
+
+def _on_serving_param(param: Any) -> None:
+    global SERVING_ON
+    SERVING_ON = bool(param.get())
+
+
+from modin_tpu.config import ServingEnabled as _ServingEnabled  # noqa: E402
+
+_ServingEnabled.subscribe(_on_serving_param)

@@ -2,8 +2,10 @@
 
 from modin_tpu.testing.faults import (  # noqa: F401
     FaultInjector,
+    MixedFaultInjector,
     OomBurstInjector,
     SequencedFaultInjector,
+    concurrent_chaos,
     inject_faults,
     make_device_error,
     midquery_device_loss,

@@ -277,3 +277,74 @@ def oom_burst_until_eviction(
 ) -> OomBurstInjector:
     """Sugar for ``OomBurstInjector(...)`` — see its docstring."""
     return OomBurstInjector(ops=ops, spills=spills, max_faults=max_faults)
+
+
+# ---------------------------------------------------------------------- #
+# concurrent injectors (the graftgate serving chaos suite)
+# ---------------------------------------------------------------------- #
+
+
+class MixedFaultInjector(FaultInjector):
+    """Interleaved fault kinds under concurrency: the serving chaos shape.
+
+    With N threads running mixed queries, WHICH thread eats a fault is a
+    scheduling accident — so this injector is deterministic in the
+    *aggregate*, not per thread: every ``period``-th matching attempt
+    (process-wide, counted under the injector lock) faults, cycling
+    through ``kinds`` in order, until ``times`` faults have fired.  An
+    OOM burst and a mid-query DeviceLost therefore land while other
+    threads' queries are genuinely in flight — exactly the incident shape
+    the serving acceptance suite must survive (every query completes
+    bit-exact or fails with a typed serving error; zero hangs).
+
+        with MixedFaultInjector(
+            kinds=("oom", "device_lost"), ops=("deploy",), period=5, times=6
+        ) as inj:
+            ...  # N threads submit queries
+        assert inj.injected == 6
+    """
+
+    def __init__(
+        self,
+        kinds: Iterable[str] = ("oom", "device_lost"),
+        ops: Iterable[str] = ("deploy",),
+        period: int = 5,
+        times: Optional[int] = 8,
+        slow_s: float = 0.05,
+    ):
+        super().__init__(kind="transient", ops=ops, times=times, slow_s=slow_s)
+        self.kinds = tuple(str(k) for k in kinds)
+        if not self.kinds:
+            raise ValueError("kinds must name at least one fault kind")
+        for kind in self.kinds:
+            if kind != "slow_kernel" and kind not in _FAULT_MESSAGES:
+                raise ValueError(f"unknown fault kind {kind!r} in kinds")
+        if period <= 0:
+            raise ValueError(f"period must be > 0, got {period}")
+        self.period = period
+
+    def _hook(self, op: str) -> None:
+        if op not in self.ops:
+            return
+        with self._lock:
+            self.calls += 1
+            if self.calls <= self.skip or self.calls % self.period != 0:
+                return
+            if self.times is not None and self.injected >= self.times:
+                return
+            kind = self.kinds[self.injected % len(self.kinds)]
+            self.injected += 1
+        if kind == "slow_kernel":
+            time.sleep(self.slow_s)
+            return
+        raise make_device_error(kind)
+
+
+def concurrent_chaos(
+    kinds: Iterable[str] = ("oom", "device_lost"),
+    ops: Iterable[str] = ("deploy",),
+    period: int = 5,
+    times: Optional[int] = 8,
+) -> MixedFaultInjector:
+    """Sugar for ``MixedFaultInjector(...)`` — see its docstring."""
+    return MixedFaultInjector(kinds=kinds, ops=ops, period=period, times=times)

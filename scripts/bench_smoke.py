@@ -34,6 +34,7 @@ EXPECTED_SECTIONS = (
     "graftsort",
     "graftplan",
     "recovery",
+    "serving",
     "shuffle_apply_virtual_mesh",
 )
 
@@ -51,6 +52,8 @@ SMOKE_ENV = {
     # smoke scale the workload is ~10ms and scheduler noise alone flakes it
     "BENCH_RECOVERY_OVERHEAD_PCT": "100",
     "BENCH_APPLY_ROWS": "150000",
+    "BENCH_SERVING_ROWS": "150000",
+    "BENCH_SERVING_QUERIES": "24",
     "BENCH_REPEATS": "1",
     "BENCH_SECTION_TIMEOUT_S": "150",
     "BENCH_DEADLINE": str(TIMEOUT_S - 60),

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all eleven gates, fail on any red
+#   ./scripts/check_all.sh            # all twelve gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -31,7 +31,11 @@
 #       counters (dispatches/compiles/reads/bytes/pruned columns) must
 #       hold against scripts/metrics_baseline.json — re-record intentional
 #       changes with `python scripts/metrics_smoke.py --record`
-#   0g. perf-history smoke: PERF_HISTORY.json must re-seed byte-identically
+#   0g. graftgate serving smoke: 8 concurrent sessions under injected
+#       DeviceLost + OOM bursts with tight deadlines — zero hangs (global
+#       watchdog), every query bit-exact or a typed QueryRejected/
+#       DeadlineExceeded, deadline overshoot bounded, serving.* metrics > 0
+#   0h. perf-history smoke: PERF_HISTORY.json must re-seed byte-identically
 #       from the BENCH_r0*.json round files, PERF.md's per-op tables must
 #       regenerate byte-identically from the ledger, an honest reduced-scale
 #       bench run must fold through the regression gate green (with git-SHA/
@@ -66,6 +70,7 @@ run_gate "graftguard"      python scripts/chaos_smoke.py
 run_gate "bench_smoke"     python scripts/bench_smoke.py
 run_gate "graftplan"       python scripts/plan_smoke.py
 run_gate "graftmeter"      python scripts/metrics_smoke.py
+run_gate "graftgate"       python scripts/serving_smoke.py
 run_gate "perf_history"    python scripts/perf_history_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
@@ -76,4 +81,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL ELEVEN GATES GREEN"
+echo "ALL TWELVE GATES GREEN"

@@ -1,0 +1,240 @@
+"""graftgate serving smoke gate: concurrent chaos with a defined outcome.
+
+Run by scripts/check_all.sh (the twelfth gate).  Eight concurrent sessions
+hammer one shared frame with mixed queries through ``serving.submit``
+while the concurrent fault injector raises interleaved RESOURCE_EXHAUSTED
+bursts and mid-query DeviceLost at the deploy seam, and asserts the
+serving contract end to end:
+
+1. **zero hangs** — a global watchdog joins every session thread under a
+   hard budget; a thread still alive is an immediate failure;
+2. **no silent wrong answers** — every query either completes IDENTICAL
+   to its fault-free pandas ground truth, or raises a typed
+   ``QueryRejected`` / ``DeadlineExceeded``; any other escape fails;
+3. **deadlines are enforced** — under an injected slow kernel, a
+   40ms-budget query aborts with the typed error well inside the
+   bounded-overshoot contract (<= max(2xD, one engine attempt));
+4. **the gate actually ran** — ``serving.*`` metrics > 0 (admissions,
+   and at least one deadline abort), and the fault injector fired.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import os
+import sys
+import threading
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pandas  # noqa: E402
+
+SESSIONS = 8
+QUERIES_PER_SESSION = 6
+JOIN_BUDGET_S = 180.0  # the global watchdog: nothing may outlive this
+
+
+def main() -> int:
+    import modin_tpu.pandas as pd
+    import modin_tpu.serving as serving
+    from modin_tpu.config import (
+        ResilienceBackoffS,
+        ServingEnabled,
+        ServingMaxConcurrent,
+        ServingQueueDepth,
+    )
+    from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+    from modin_tpu.logging import add_metric_handler
+    from modin_tpu.testing import MixedFaultInjector, inject_faults
+
+    seen = []
+    add_metric_handler(lambda name, value: seen.append(name))
+    ResilienceBackoffS.put(0.0)
+    ServingEnabled.put(True)
+    ServingMaxConcurrent.put(4)
+    ServingQueueDepth.put(SESSIONS)
+
+    rng = np.random.default_rng(7)
+    n = 4096
+    data = {
+        "a": rng.normal(size=n),
+        "b": rng.integers(0, 1000, n).astype(np.int64),
+        "key": rng.integers(0, 13, n).astype(np.int64),
+    }
+    pdf = pandas.DataFrame(data)
+    mdf = pd.DataFrame(data)
+    mdf._query_compiler.execute()  # ingest outside the fault window
+
+    # cold spillable ballast: every injected OOM's evict-then-retry round
+    # has something cheap to reclaim, so a burst is absorbed instead of
+    # turning terminal (chaos_smoke's scenario-2 shape, tripled because
+    # the mixed schedule fires several OOMs)
+    ballast = [
+        DeviceColumn.from_numpy(rng.normal(size=262_144)) for _ in range(3)
+    ]
+
+    queries = [
+        (
+            "gb_sum",
+            lambda: mdf.groupby("key").sum().modin.to_pandas(),
+            pdf.groupby("key").sum(),
+        ),
+        (
+            "ew_reduce",
+            lambda: float((mdf["a"] * 2 + mdf["b"]).sum()),
+            float((pdf["a"] * 2 + pdf["b"]).sum()),
+        ),
+        (
+            "mean",
+            lambda: mdf.mean().modin.to_pandas(),
+            pdf.mean(),
+        ),
+        (
+            "median",
+            lambda: float(mdf["a"].median()),
+            float(pdf["a"].median()),
+        ),
+    ]
+
+    def check_exact(name, got, want):
+        if isinstance(want, float):
+            tol = 1e-9 * max(1.0, abs(want))
+            assert abs(got - want) <= tol, f"{name}: {got} != {want}"
+        elif isinstance(want, pandas.Series):
+            pandas.testing.assert_series_equal(got, want)
+        else:
+            pandas.testing.assert_frame_equal(got, want)
+
+    # ---- phase 1: 8 sessions x mixed queries under interleaved faults ---- #
+    outcomes = {"completed": 0, "rejected": 0, "deadline": 0}
+    failures = []
+    lock = threading.Lock()
+
+    def session(tid: int) -> None:
+        for k in range(QUERIES_PER_SESSION):
+            name, query, want = queries[(tid + k) % len(queries)]
+            # every sixth submission rides a tight budget through the chaos
+            deadline_ms = 40 if (tid * QUERIES_PER_SESSION + k) % 6 == 5 else 0
+            try:
+                got = serving.submit(
+                    query,
+                    tenant=f"session{tid}",
+                    deadline_ms=deadline_ms,
+                    label=name,
+                )
+            except serving.QueryRejected:
+                with lock:
+                    outcomes["rejected"] += 1
+                continue
+            except serving.DeadlineExceeded:
+                with lock:
+                    outcomes["deadline"] += 1
+                continue
+            except BaseException as err:  # noqa: BLE001 - the assertion itself
+                with lock:
+                    failures.append(
+                        f"session {tid} query {name}: UNTYPED escape "
+                        f"{type(err).__name__}: {err}"
+                    )
+                continue
+            try:
+                check_exact(name, got, want)
+            except AssertionError as err:
+                with lock:
+                    failures.append(f"session {tid}: SILENT WRONG ANSWER {err}")
+                continue
+            with lock:
+                outcomes["completed"] += 1
+
+    with MixedFaultInjector(
+        kinds=("oom", "device_lost"), ops=("deploy",), period=5, times=6
+    ) as inj:
+        threads = [
+            threading.Thread(target=session, args=(tid,), daemon=True)
+            for tid in range(SESSIONS)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max(JOIN_BUDGET_S - (time.monotonic() - t0), 1.0))
+        hung = [t.name for t in threads if t.is_alive()]
+        assert not hung, (
+            f"GLOBAL WATCHDOG: {len(hung)} session thread(s) still alive "
+            f"after {JOIN_BUDGET_S:g}s — the serving layer hung"
+        )
+
+    assert not failures, "\n".join(failures[:10])
+    assert inj.injected >= 1, (
+        f"no faults fired (calls={inj.calls}); the chaos phase tested nothing"
+    )
+    total = sum(outcomes.values())
+    assert total == SESSIONS * QUERIES_PER_SESSION, (
+        f"query accounting hole: {outcomes} != {SESSIONS * QUERIES_PER_SESSION}"
+    )
+    assert outcomes["completed"] > 0, f"nothing completed: {outcomes}"
+
+    # ---- phase 2: deadline enforcement under a slow kernel ---- #
+    with inject_faults(
+        "slow_kernel", ops=("deploy",), times=None, slow_s=0.08
+    ):
+        t0 = time.perf_counter()
+        try:
+            serving.submit(
+                lambda: float((mdf["a"] + 1.0).sum()),
+                tenant="deadline",
+                deadline_ms=40,
+                label="tight",
+            )
+            raise AssertionError(
+                "40ms-budget query under an 80ms/attempt slow kernel "
+                "completed instead of aborting"
+            )
+        except serving.DeadlineExceeded:
+            overshoot_s = time.perf_counter() - t0
+    assert overshoot_s < 1.5, (
+        f"deadline overshoot {overshoot_s:.3f}s blows the bounded-overshoot "
+        "contract (<= max(2xD, one engine attempt) plus scheduling slack)"
+    )
+
+    # ---- the gate's own evidence ---- #
+    serving_metrics = sorted(
+        {m for m in seen if m.startswith("modin_tpu.serving.")}
+    )
+    assert any(
+        m == "modin_tpu.serving.admit" for m in serving_metrics
+    ), f"no serving.admit metric; saw {serving_metrics}"
+    assert any(
+        m == "modin_tpu.serving.deadline_exceeded" for m in serving_metrics
+    ), f"no serving.deadline_exceeded metric; saw {serving_metrics}"
+
+    snap = serving.serving_snapshot()
+    print(
+        "serving smoke OK: "
+        f"{outcomes['completed']} bit-exact completions, "
+        f"{outcomes['rejected']} typed rejections, "
+        f"{outcomes['deadline']} typed deadline aborts across "
+        f"{SESSIONS} sessions under {inj.injected} injected fault(s); "
+        f"tight-deadline overshoot {overshoot_s * 1e3:.0f}ms; "
+        f"gate admitted={snap['admitted']} shed={snap['shed']} "
+        f"degraded={snap['degraded']}; "
+        f"{len(serving_metrics)} serving.* metric families"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(f"serving smoke FAILED: {err}", file=sys.stderr)
+        sys.exit(1)

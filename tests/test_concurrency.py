@@ -1,0 +1,537 @@
+"""Shared-state thread safety under concurrent queries (graftgate audit).
+
+The serving layer makes "many threads, one process" a supported workload,
+so every cache concurrent queries share must hold up under mixed
+read/write/invalidate load: the sorted-representation cache
+(ops/sorted_cache.py), the fused-executable LRU (ops/lazy.py), and the
+plan scan read cache (plan/lowering.py).  This suite also pins the
+single-owner fixes the audit surfaced: query-stats scope seeding on
+pooled workers, flight-recorder rate-limiting under simultaneous
+breaker-opens, and graftguard's reseat-once handshake when multiple
+threads observe the same device loss.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import (
+    FusedCacheSize,
+    RecoveryMode,
+    ResilienceBackoffS,
+    ServingEnabled,
+    TraceDir,
+    TraceEnabled,
+    TraceFlightRecorderSize,
+)
+from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+from modin_tpu.core.execution import recovery, resilience
+from modin_tpu.core.execution.resilience import engine_call, reset_breakers
+from modin_tpu.logging import add_metric_handler, clear_metric_handler
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import flight_recorder, meters
+from modin_tpu.ops import lazy as ops_lazy
+from modin_tpu.ops import sorted_cache
+from modin_tpu.serving.gate import gate
+from modin_tpu.testing import make_device_error
+
+THREADS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    saved = [
+        (p, p.get())
+        for p in (RecoveryMode, ResilienceBackoffS, ServingEnabled, FusedCacheSize)
+    ]
+    reset_breakers()
+    gate.reset_for_tests()
+    ResilienceBackoffS.put(0.0)
+    yield
+    for p, v in saved:
+        p.put(v)
+    reset_breakers()
+    gate.reset_for_tests()
+
+
+def _run_threads(workers, timeout_s=120):
+    """Run callables concurrently; re-raise the first failure; no hangs."""
+    errors = []
+    lock = threading.Lock()
+
+    def wrap(fn):
+        try:
+            fn()
+        except BaseException as err:  # noqa: BLE001 - surfaced to the test
+            with lock:
+                errors.append(err)
+
+    threads = [
+        threading.Thread(target=wrap, args=(fn,), daemon=True) for fn in workers
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+    assert not any(t.is_alive() for t in threads), "worker thread hung"
+    if errors:
+        raise errors[0]
+
+
+# ---------------------------------------------------------------------- #
+# sorted-representation cache under mixed load
+# ---------------------------------------------------------------------- #
+
+
+def test_sorted_cache_stress_no_torn_pairs():
+    """8 threads attach/get/invalidate one column's rep: a reader must
+    never observe a (data, n_valid) pair mixed from two attaches."""
+    import jax.numpy as jnp
+
+    values = np.arange(1024, dtype=np.float64)
+    col = DeviceColumn.from_numpy(values)
+    base = jnp.sort(col.raw)  # computed once, on one thread
+    base0 = float(np.asarray(base)[0])
+    # per-attacher payloads: n_valid encodes which xs was attached, so a
+    # torn pair is detectable from the values themselves
+    payloads = {t: (base + float(t), 1000 + t) for t in range(3)}
+    stop = time.monotonic() + 3.0
+
+    def attacher(t):
+        def fn():
+            while time.monotonic() < stop:
+                xs, n = payloads[t]
+                sorted_cache.attach(col, xs, n)
+
+        return fn
+
+    def invalidator():
+        while time.monotonic() < stop:
+            sorted_cache.invalidate(col)
+
+    def reader():
+        while time.monotonic() < stop:
+            got = sorted_cache.get(col)
+            if got is None:
+                continue
+            data, n = got
+            assert data is not None and n is not None, "torn rep: dropped half"
+            tag = n - 1000
+            assert tag in payloads, f"unknown n_valid {n}"
+            head = float(np.asarray(data[0]))
+            assert head == pytest.approx(base0 + tag), (
+                f"torn pair: n_valid says attach #{tag}, data says "
+                f"{head - base0:.1f}"
+            )
+
+    _run_threads(
+        [attacher(t) for t in range(3)]
+        + [invalidator, invalidator]
+        + [reader, reader, reader]
+    )
+    # steady state afterwards: one more attach+get round-trips exactly
+    sorted_cache.attach(col, base, 1000)
+    data, n = sorted_cache.get(col)
+    assert n == 1000
+    np.testing.assert_array_equal(np.asarray(data), np.asarray(base))
+    sorted_cache.invalidate(col)
+
+
+def test_sorted_cache_spill_races_reader():
+    """The device-ledger spill path drops reps concurrently with readers;
+    a reader holding the pair keeps valid arrays (never half-None)."""
+    import jax.numpy as jnp
+
+    values = np.arange(512, dtype=np.float64)
+    col = DeviceColumn.from_numpy(values)
+    xs = jnp.sort(col.raw)
+    stop = time.monotonic() + 2.0
+
+    def spiller():
+        while time.monotonic() < stop:
+            rep = getattr(col, "_sorted_rep", None)
+            if rep is not None:
+                rep.spill()  # the ledger's reclaim path (drop, no copy)
+
+    def attacher():
+        while time.monotonic() < stop:
+            sorted_cache.attach(col, xs, 512)
+
+    def reader():
+        while time.monotonic() < stop:
+            got = sorted_cache.get(col)
+            if got is not None:
+                data, n = got
+                assert data is not None and n == 512
+
+    _run_threads([spiller, attacher, reader, reader])
+
+
+# ---------------------------------------------------------------------- #
+# fused-executable LRU under mixed load
+# ---------------------------------------------------------------------- #
+
+
+def test_fused_cache_lru_stress_direct():
+    """Raw get/put hammering with a tiny bound: the OrderedDict's internal
+    linkage survives (no KeyError/RuntimeError from torn move_to_end vs
+    popitem) and the bound holds."""
+    with FusedCacheSize.context(4):
+        evict0 = ops_lazy.fused_cache_evictions()
+        stop = time.monotonic() + 2.0
+
+        def worker(t):
+            def fn():
+                i = 0
+                while time.monotonic() < stop:
+                    key = ("stress", t, i % 7)
+                    if ops_lazy._fused_cache_get(key) is None:
+                        ops_lazy._fused_cache_put(key, object())
+                    i += 1
+
+            return fn
+
+        _run_threads([worker(t) for t in range(THREADS)])
+        assert ops_lazy.fused_cache_len() <= 4
+        assert ops_lazy.fused_cache_evictions() > evict0
+
+
+def test_fused_chains_bit_exact_under_concurrent_submit():
+    """Concurrent queries with varying fusion depths stay bit-exact while
+    the bounded cache constantly evicts and recompiles."""
+    rng = np.random.default_rng(11)
+    n = 2048
+    base = rng.integers(0, 100, n).astype(np.int64)
+    mdf = pd.DataFrame({"b": base})
+    mdf._query_compiler.execute()
+    expected_base = int(base.sum())
+    import modin_tpu.serving as serving
+
+    ServingEnabled.put(True)
+    with FusedCacheSize.context(2):
+
+        def worker(t):
+            def query(depth):
+                def fn():
+                    s = mdf["b"]
+                    for _ in range(depth):
+                        s = s + 1
+                    return int(s.sum())
+
+                return fn
+
+            def fn():
+                for i in range(6):
+                    depth = 1 + (t + i) % 4
+                    got = serving.submit(
+                        query(depth), tenant=f"t{t}", deadline_ms=0
+                    )
+                    assert got == expected_base + depth * n, (
+                        f"depth {depth}: {got}"
+                    )
+
+            return fn
+
+        _run_threads([worker(t) for t in range(6)])
+        assert ops_lazy.fused_cache_len() <= 2
+
+
+# ---------------------------------------------------------------------- #
+# plan scan read cache under mixed load
+# ---------------------------------------------------------------------- #
+
+
+def test_scan_cache_stress_shared_origin(tmp_path):
+    """8 threads force pruned scans sharing ONE origin: the FIFO-bounded
+    read cache stays coherent (right columns out, bound held)."""
+    from modin_tpu.core.execution.jax_engine.io import TpuCSVDispatcher
+    from modin_tpu.plan import ir
+    from modin_tpu.plan.lowering import _SCAN_CACHE_MAX, lower
+
+    rng = np.random.default_rng(5)
+    path = tmp_path / "scan.csv"
+    cols = list("abcdef")
+    pandas.DataFrame(
+        {c: rng.integers(0, 100, 512) for c in cols}
+    ).to_csv(path, index=False)
+    origin = ir.Scan(
+        TpuCSVDispatcher,
+        {"filepath_or_buffer": str(path)},
+        pandas.Index(cols),
+    )
+    projections = [
+        ("a", "b"), ("b", "c"), ("c", "d"), ("d", "e"), ("e", "f"),
+        ("a", "f"), ("b", "e"),
+    ]
+
+    def worker(t):
+        def fn():
+            for i in range(6):
+                keep = projections[(t + i) % len(projections)]
+                scan = ir.Scan(
+                    TpuCSVDispatcher,
+                    {"filepath_or_buffer": str(path)},
+                    pandas.Index(cols),
+                    pruned=keep,
+                    colarg="usecols",
+                    pushed=True,
+                    origin=origin,
+                )
+                qc = lower(scan)
+                assert list(qc.get_columns()) == list(keep), (
+                    f"thread {t} iter {i}: wrong columns {list(qc.get_columns())}"
+                )
+
+        return fn
+
+    _run_threads([worker(t) for t in range(THREADS)])
+    assert origin.cache is not None
+    assert len(origin.cache) <= _SCAN_CACHE_MAX
+
+
+# ---------------------------------------------------------------------- #
+# query-stats scope seeding (pooled-worker reuse)
+# ---------------------------------------------------------------------- #
+
+
+def test_seed_thread_scopes_clears_stale_seeding():
+    with meters.query_stats("owner") as qs:
+        snap = meters.snapshot_scopes()
+        assert snap and snap[0] is qs
+
+        def reused_worker():
+            meters.seed_thread_scopes(snap)
+            # pooled-thread reuse for UNSCOPED work: must clear, not keep
+            meters.seed_thread_scopes(None)
+            emit_metric("engine.dispatch", 1)
+
+        t = threading.Thread(target=reused_worker)
+        t.start()
+        t.join(timeout=10)
+    assert qs.dispatches == 0, (
+        "a worker re-seeded with None still routed into the stale scope"
+    )
+    # positive control: a properly seeded worker DOES route
+    with meters.query_stats("owner2") as qs2:
+        snap2 = meters.snapshot_scopes()
+
+        def seeded_worker():
+            meters.seed_thread_scopes(snap2)
+            emit_metric("engine.dispatch", 1)
+
+        t = threading.Thread(target=seeded_worker)
+        t.start()
+        t.join(timeout=10)
+    assert qs2.dispatches == 1
+
+
+def test_seed_thread_scopes_empty_list_clears():
+    with meters.query_stats("q") as qs:
+        snap = meters.snapshot_scopes()
+        seen = {}
+
+        def worker():
+            meters.seed_thread_scopes(snap)
+            meters.seed_thread_scopes([])
+            seen["scopes"] = meters.snapshot_scopes()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=10)
+    assert seen["scopes"] is None
+    assert qs.dispatches == 0
+
+
+# ---------------------------------------------------------------------- #
+# flight-recorder rate limiting under simultaneous dumps
+# ---------------------------------------------------------------------- #
+
+
+@pytest.fixture
+def _traced(tmp_path):
+    with TraceEnabled.context(True), TraceFlightRecorderSize.context(64), \
+            TraceDir.context(str(tmp_path / "traces")):
+        flight_recorder.reset_for_tests()
+        from modin_tpu.observability import spans as graftscope
+
+        for i in range(4):  # something in the ring to dump
+            with graftscope.span(f"engine.warm{i}.attempt", layer="JAX-ENGINE"):
+                pass
+        yield tmp_path
+    flight_recorder.reset_for_tests()
+
+
+def test_simultaneous_breaker_open_dumps_write_exactly_one(_traced):
+    saved = flight_recorder.MIN_DUMP_INTERVAL_S
+    flight_recorder.MIN_DUMP_INTERVAL_S = 3600.0
+    try:
+        barrier = threading.Barrier(THREADS, timeout=30)
+        paths = []
+        lock = threading.Lock()
+
+        def opener(t):
+            def fn():
+                barrier.wait()
+                path = flight_recorder.dump_flight_record(f"breaker_open_x{t}")
+                with lock:
+                    paths.append(path)
+
+            return fn
+
+        _run_threads([opener(t) for t in range(THREADS)])
+        written = [p for p in paths if p is not None]
+        assert len(written) == 1, (
+            f"{len(written)} dumps for ONE incident: rate limiter raced"
+        )
+    finally:
+        flight_recorder.MIN_DUMP_INTERVAL_S = saved
+
+
+def test_failed_dump_releases_only_its_own_claim(_traced):
+    """A slow failing dump must not zero a NEWER successful claim — that
+    re-opened the window and double-dumped the same incident."""
+    saved_interval = flight_recorder.MIN_DUMP_INTERVAL_S
+    real_to_chrome = flight_recorder.to_chrome_trace
+    flight_recorder.MIN_DUMP_INTERVAL_S = 0.05
+    slow_entered = threading.Event()
+    slow_release = threading.Event()
+
+    def hooked(spans, other_data=None, counters=None):
+        if threading.current_thread().name == "slow-failing-dump":
+            slow_entered.set()
+            assert slow_release.wait(timeout=30)
+            raise RuntimeError("disk full")
+        return real_to_chrome(spans, other_data=other_data, counters=counters)
+
+    flight_recorder.to_chrome_trace = hooked
+    try:
+        results = {}
+
+        def slow_dump():
+            results["slow"] = flight_recorder.dump_flight_record("slow_fail")
+
+        t = threading.Thread(
+            target=slow_dump, name="slow-failing-dump", daemon=True
+        )
+        t.start()
+        assert slow_entered.wait(timeout=30)  # claim taken, write in flight
+        time.sleep(0.06)  # the 0.05s window expires
+        ok_path = flight_recorder.dump_flight_record("newer_claim")
+        assert ok_path is not None  # newer claim, successful write
+        slow_release.set()
+        t.join(timeout=30)
+        assert results["slow"] is None  # the failed dump wrote nothing
+        # the regression: the failed dump's cleanup must NOT have zeroed
+        # the newer claim — an immediate third dump stays rate-limited
+        assert flight_recorder.dump_flight_record("third") is None
+    finally:
+        flight_recorder.to_chrome_trace = real_to_chrome
+        flight_recorder.MIN_DUMP_INTERVAL_S = saved_interval
+
+
+# ---------------------------------------------------------------------- #
+# graftguard reseat-once under concurrent observers
+# ---------------------------------------------------------------------- #
+
+
+@pytest.fixture
+def metrics():
+    seen = []
+    handler = lambda name, value: seen.append(name)  # noqa: E731
+    add_metric_handler(handler)
+    yield seen
+    clear_metric_handler(handler)
+
+
+def test_reseat_once_piggyback_semantics():
+    RecoveryMode.put("Enable")
+    values = np.arange(256, dtype=np.float64)
+    col = DeviceColumn.from_numpy(values)  # host-cache lineage: recoverable
+    e0 = recovery.current_epoch()
+    n1 = recovery.reseat_all("first_observer", observed_epoch=e0)
+    assert recovery.current_epoch() == e0 + 1
+    assert n1 >= 1
+    # a second observer of the SAME loss (same observed epoch) piggybacks:
+    # no second pass, no second epoch bump, same answer for its retry logic
+    n2 = recovery.reseat_all("second_observer", observed_epoch=e0)
+    assert recovery.current_epoch() == e0 + 1
+    assert n2 == n1
+    # a genuinely NEW loss (observed in the recovered epoch) recovers again
+    n3 = recovery.reseat_all("new_loss", observed_epoch=e0 + 1)
+    assert recovery.current_epoch() == e0 + 2
+    assert n3 >= 1
+    assert np.array_equal(col.to_numpy(), values)
+
+
+def test_reseat_with_dispatch_lock_held_no_deadlock():
+    """Lock-order regression: a device-path thread reaches reseat_all while
+    HOLDING the serving dispatch lock, while another thread reseats
+    concurrently.  The globally-consistent order (dispatch -> reseat)
+    must make this converge, never deadlock."""
+    from modin_tpu.serving import context as serving_context
+
+    RecoveryMode.put("Enable")
+    DeviceColumn.from_numpy(np.arange(128, dtype=np.float64))
+    e0 = recovery.current_epoch()
+
+    def holder_path():
+        # a guarded kernel family holds the dispatch lock for its whole
+        # call; a terminal DeviceLost inside it triggers the reseat
+        with serving_context.dispatch_lock:
+            recovery.reseat_all("holder", observed_epoch=e0)
+
+    def bare_observer():
+        recovery.reseat_all("observer", observed_epoch=e0)
+
+    _run_threads([holder_path, bare_observer], timeout_s=60)
+    assert recovery.current_epoch() == e0 + 1  # and reseat-once held too
+
+
+def test_reseat_once_concurrent_engine_calls(metrics):
+    """Two threads fail the same epoch's deploys simultaneously: exactly
+    one recovery pass runs, both calls succeed after it."""
+    RecoveryMode.put("Enable")
+    values = np.arange(512, dtype=np.float64)
+    col = DeviceColumn.from_numpy(values)
+    barrier = threading.Barrier(2, timeout=30)
+    fired = [0]
+    fire_lock = threading.Lock()
+
+    def hook(op):
+        if op != "deploy":
+            return
+        with fire_lock:
+            if fired[0] >= 2:
+                return
+            fired[0] += 1
+        # both threads are INSIDE an attempt (epochs captured) before
+        # either raises: the deterministic same-loss shape
+        barrier.wait()
+        raise make_device_error("device_lost")
+
+    assert resilience._fault_hook is None
+    resilience._fault_hook = hook
+    e0 = recovery.current_epoch()
+    try:
+        results = [None, None]
+
+        def worker(i):
+            def fn():
+                results[i] = engine_call("deploy", lambda: 40 + i)
+
+            return fn
+
+        _run_threads([worker(0), worker(1)])
+    finally:
+        resilience._fault_hook = None
+    assert results == [40, 41]
+    assert fired[0] == 2
+    assert recovery.current_epoch() == e0 + 1, (
+        "two observers of one loss ran two recovery passes"
+    )
+    assert metrics.count("modin_tpu.recovery.device_lost") == 1
+    assert np.array_equal(col.to_numpy(), values)
