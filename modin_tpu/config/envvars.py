@@ -713,6 +713,42 @@ class KernelRouterCalibrationRows(EnvironmentVariable, type=int):
         super().put(value)
 
 
+class SpmdMode(EnvironmentVariable, type=str):
+    """graftmesh layout routing: local single-program kernels vs sharded
+    collective kernels (range_shuffle all_to_all) for the collective-eligible
+    ops (sort_values, the sorted-representation build, merge-join).
+
+    Auto (default): the kernel router's calibrated crossover model decides
+    per op — a sharded sort pays bucketize + all_to_all + per-shard local
+    sorts against one global device sort, so the winner depends on mesh
+    shape, row count, and interconnect bandwidth; frames below
+    ``SpmdMinRows`` (and every frame on a single-shard mesh) stay local.
+    Local: never take the sharded path.  Sharded: always take it when the
+    mesh has >= 2 row shards (tests/bench force legs).
+    """
+
+    varname = "MODIN_TPU_SPMD"
+    choices = ("Auto", "Local", "Sharded")
+    default = "Auto"
+
+
+class SpmdMinRows(EnvironmentVariable, type=int):
+    """Row count below which ``Auto`` SPMD routing always stays local
+    without consulting (or running) the calibration: at small n the
+    collective launch overhead dominates and the decision is noise."""
+
+    varname = "MODIN_TPU_SPMD_MIN_ROWS"
+    default = 1 << 18
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"SPMD min rows should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
 class PlanMode(EnvironmentVariable, type=str):
     """graftplan whole-query deferred planning.
 

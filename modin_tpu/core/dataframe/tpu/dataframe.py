@@ -49,17 +49,14 @@ def _is_device_dtype(dtype: Any) -> bool:
     return dtype.kind in "mM" and dtype.itemsize == 8
 
 
-def _device_put_values(values: np.ndarray, sharding: Any = None) -> Any:
-    """Host values -> padded device buffer under the dtype policy.
-
-    The transform ``from_numpy`` applies (datetime int64 view, Downcast
-    float32 policy, contiguity, shard padding), shared with the graftguard
-    spill-restore and lineage re-seat paths so a recovered buffer is
-    byte-identical to the original upload.
+def _device_layout_values(values: np.ndarray) -> np.ndarray:
+    """The dtype-policy transform of host values for device residence
+    (datetime int64 view, Downcast float32 policy, contiguity).  The ONE
+    transform shared by full uploads (``_device_put_values``) and the
+    graftmesh single-shard re-seat, so a recovered shard's slice is always
+    byte-identical to what a full upload would have put there.
     """
     from modin_tpu.config import Float64Policy
-    from modin_tpu.ops.structural import pad_host
-    from modin_tpu.parallel.engine import JaxWrapper
 
     device_values = values.view("int64") if values.dtype.kind in "mM" else values
     if device_values.dtype == np.float64 and Float64Policy.get() == "Downcast":
@@ -70,7 +67,21 @@ def _device_put_values(values: np.ndarray, sharding: Any = None) -> Any:
         device_values = device_values.astype(np.float32)
     if not device_values.flags.c_contiguous:
         device_values = np.ascontiguousarray(device_values)
-    return JaxWrapper.put(pad_host(device_values), sharding)
+    return device_values
+
+
+def _device_put_values(values: np.ndarray, sharding: Any = None) -> Any:
+    """Host values -> padded device buffer under the dtype policy.
+
+    The transform ``from_numpy`` applies (datetime int64 view, Downcast
+    float32 policy, contiguity, shard padding), shared with the graftguard
+    spill-restore and lineage re-seat paths so a recovered buffer is
+    byte-identical to the original upload.
+    """
+    from modin_tpu.ops.structural import pad_host
+    from modin_tpu.parallel.engine import JaxWrapper
+
+    return JaxWrapper.put(pad_host(_device_layout_values(values)), sharding)
 
 
 class DeviceColumn:
@@ -239,6 +250,93 @@ class DeviceColumn:
         self._invalidate_sorted()
         self._data = _device_put_values(np.asarray(values))
         self._register_device()
+
+    def reseat_from_host_shard(self, shard_index: int) -> bool:
+        """Re-seat ONLY one lost shard's slice from the exact host copy,
+        keeping every live shard's device buffer (graftmesh single-shard
+        recovery).  Returns False when not applicable — no host copy, a
+        lazy/spilled column, a single-shard mesh, an uneven layout, or any
+        failure reading the surviving shards (a real whole-device loss) —
+        and the caller takes the full re-seat path instead.
+        """
+        values = self.host_cache  # single read: eviction may race us
+        data = self._data
+        if values is None or data is None or self.is_lazy:
+            return False
+        try:
+            import jax
+
+            from modin_tpu.parallel.mesh import num_row_shards
+
+            S = num_row_shards()
+            P = int(data.shape[0])
+            if S < 2 or not (0 <= int(shard_index) < S) or P % S:
+                return False
+            L = P // S
+            start = int(shard_index) * L
+            # the ONE shared host->device transform (_device_layout_values,
+            # exactly what a full upload applies), restricted to the lost
+            # shard's row range (pad rows zero)
+            dev_vals = _device_layout_values(np.asarray(values))
+            sl = np.ascontiguousarray(dev_vals[start : start + L])
+            if len(sl) < L:
+                sl = np.concatenate(
+                    [sl, np.zeros(L - len(sl), dtype=sl.dtype)]
+                )
+            by_start = {}
+            for sh in data.addressable_shards:
+                idx = sh.index[0]
+                by_start[int(idx.start or 0)] = sh
+            if len(by_start) != S or start not in by_start:
+                return False
+            arrays = []
+            for st in sorted(by_start):
+                sh = by_start[st]
+                if st == start:
+                    arrays.append(jax.device_put(sl, sh.device))
+                else:
+                    # touching a dead device's buffer raises here, which is
+                    # exactly the signal to fall back to the full re-seat
+                    arrays.append(sh.data)
+            fresh = jax.make_array_from_single_device_arrays(
+                data.shape, data.sharding, arrays
+            )
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- the single-shard leg is an optimization; ANY failure (dead neighbor shards, exotic sharding) falls back to the whole-column re-seat
+            return False
+        self._invalidate_sorted()
+        self._data = fresh
+        self._register_device()
+        return True
+
+    def shard_valid_counts(self) -> np.ndarray:
+        """Per-shard valid-row counts under the padded prefix layout:
+        leading shards are full, one shard is ragged, trailing pad shards
+        are empty.  The per-shard valid-row accounting of the SPMD layout
+        (docs/architecture.md "SPMD execution & the mesh substrate"): the
+        padded-bytes ledger splits evenly, this answers how much of each
+        shard's slice is live data.
+
+        Uses the concrete buffer's physical length when it divides the
+        current shard count; a buffer laid out under a different mesh (or
+        a lazy/spilled column) answers for the canonical current-mesh
+        padding instead.
+        """
+        from modin_tpu.ops.structural import pad_len
+        from modin_tpu.parallel.mesh import num_row_shards
+
+        S = max(num_row_shards(), 1)
+        data = self._data
+        P = (
+            int(data.shape[0])
+            if data is not None and hasattr(data, "shape")
+            else pad_len(self.length)
+        )
+        if P % S:
+            P = pad_len(self.length)
+        L = P // S
+        return np.clip(
+            self.length - np.arange(S, dtype=np.int64) * L, 0, L
+        )
 
     def adopt_reseated(self, data: Any) -> None:
         """Adopt a lineage-replayed device buffer (op-replay recovery)."""

@@ -422,12 +422,24 @@ def _replay_record(rec: _Record, depth: int) -> Any:
     raise Unrecoverable("replayed op did not reproduce the output slot")
 
 
-def recover_column(col: Any, depth: int = 0, force: bool = False) -> Optional[str]:
+def recover_column(
+    col: Any,
+    depth: int = 0,
+    force: bool = False,
+    shard_index: Optional[int] = None,
+) -> Optional[str]:
     """Re-seat one column's device buffer from its lineage.
 
-    Returns the lineage kind used, or None when the column was already
-    fresh (current epoch, concrete buffer).  Raises :class:`Unrecoverable`
-    when no lineage can reproduce the buffer.
+    Returns the lineage kind used ("shard" for the graftmesh single-shard
+    leg), or None when the column was already fresh (current epoch,
+    concrete buffer).  Raises :class:`Unrecoverable` when no lineage can
+    reproduce the buffer.
+
+    ``shard_index`` (graftmesh): the loss named one mesh row shard — a
+    column with an exact host copy re-uploads ONLY that shard's slice,
+    keeping the surviving shards' buffers, instead of rebuilding the whole
+    column (1/S of the transfer per column on an S-shard mesh).  Any
+    failure of that leg falls through to the full paths below.
     """
     if getattr(col, "is_derived_cache", False):
         # graftsort sorted-representation rep (ops/sorted_cache.py): derived
@@ -443,6 +455,12 @@ def recover_column(col: Any, depth: int = 0, force: bool = False) -> Optional[st
         return None
     if not force and col._device_epoch >= _device_epoch:
         return None
+    if (
+        shard_index is not None
+        and col.host_cache is not None
+        and col.reseat_from_host_shard(shard_index)
+    ):
+        return "shard"
     if col.host_cache is not None:
         col.reseat_from_host()
         return KIND_HOST
@@ -490,13 +508,22 @@ def _purge_io_caches() -> None:
             pass
 
 
-def reseat_all(reason: str, observed_epoch: Optional[int] = None) -> int:
+def reseat_all(
+    reason: str,
+    observed_epoch: Optional[int] = None,
+    shard_index: Optional[int] = None,
+) -> int:
     """Bump the device epoch and re-seat every live device column.
 
     Called on a terminal ``DeviceLost`` at the engine seam and on a
     device-path breaker opening on one.  Returns how many columns were
     re-seated; 0 means nothing was resident (or recovery is disabled) and
     the caller should not bother retrying.
+
+    ``shard_index`` (graftmesh): when the loss named one mesh row shard,
+    columns with exact host copies replay only that shard's slice
+    (``recovery.reseat.shard``) instead of re-uploading whole buffers —
+    the pass then moves 1/S of the bytes a whole-column pass would.
 
     ``observed_epoch`` is the device epoch the caller's failed work was
     *launched* in (the engine seam captures it at attempt start).  It is
@@ -540,11 +567,14 @@ def reseat_all(reason: str, observed_epoch: Optional[int] = None) -> int:
             emit_metric("recovery.device_lost", 1)
             reseated = 0
             with graftscope.span(
-                "recovery.reseat", layer="JAX-ENGINE", reason=reason
+                "recovery.reseat",
+                layer="JAX-ENGINE",
+                reason=reason,
+                shard_index=-1 if shard_index is None else int(shard_index),
             ):
                 for col in device_ledger.live_columns():
                     try:
-                        kind = recover_column(col)
+                        kind = recover_column(col, shard_index=shard_index)
                     except Unrecoverable:
                         emit_metric("recovery.unrecoverable", 1)
                         continue

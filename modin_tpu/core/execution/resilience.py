@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import functools
 import queue
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
@@ -101,9 +102,16 @@ class DeviceOOM(DeviceFailure):
 
 class DeviceLost(DeviceFailure):
     """The device or its transport is gone (tunnel drop, device reset).
-    Not retried: recovery needs the breaker cooldown, not a tight loop."""
+    Not retried: recovery needs the breaker cooldown, not a tight loop.
+
+    ``shard_index`` is the mesh row shard the runtime named in the error
+    (parsed from a ``shard_index=N`` message fragment), or None when the
+    loss is unattributed.  graftmesh recovery uses it to re-seat ONLY that
+    shard's slice of each column instead of rebuilding whole columns.
+    """
 
     kind = "device_lost"
+    shard_index: Optional[int] = None
 
 
 class WatchdogTimeout(DeviceLost):
@@ -136,6 +144,10 @@ _LOST_MARKERS = (
 )
 _RUNTIME_ERROR_TYPE_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
 
+#: a runtime error message may name the lost shard (the fault harness does;
+#: real runtimes name devices in their own formats, unparsed = None)
+_SHARD_INDEX_RE = re.compile(r"shard_index=(\d+)")
+
 
 def is_device_runtime_error(exc: BaseException) -> bool:
     """True if ``exc`` is the accelerator runtime's error type (by name, so
@@ -160,7 +172,11 @@ def classify_device_error(exc: BaseException) -> Optional[DeviceFailure]:
     if any(m in msg for m in _OOM_MARKERS):
         return DeviceOOM(msg)
     if any(m in msg for m in _LOST_MARKERS):
-        return DeviceLost(msg)
+        failure = DeviceLost(msg)
+        shard = _SHARD_INDEX_RE.search(msg)
+        if shard is not None:
+            failure.shard_index = int(shard.group(1))
+        return failure
     # unknown runtime error: assume transient so it gets a bounded retry and
     # then strikes the breaker rather than crashing the query
     return TransientDeviceError(msg)
@@ -422,7 +438,9 @@ def engine_call(
                 and not reseat_spent
                 and not recovery.in_recovery()
                 and recovery.reseat_all(
-                    f"engine_{op}", observed_epoch=attempt_epoch
+                    f"engine_{op}",
+                    observed_epoch=attempt_epoch,
+                    shard_index=getattr(failure, "shard_index", None),
                 )
                 > 0
             ):
@@ -733,7 +751,12 @@ def device_path(family: str) -> Callable:
                     from modin_tpu.core.execution import recovery
 
                     if not recovery.in_recovery():
-                        recovery.reseat_all(f"breaker_open_{family}")
+                        recovery.reseat_all(
+                            f"breaker_open_{family}",
+                            shard_index=getattr(
+                                failure, "shard_index", None
+                            ),
+                        )
                 emit_metric(f"resilience.fallback.{family}.{failure.kind}", 1)
                 if graftscope.TRACE_ON:
                     graftscope.finish_span(

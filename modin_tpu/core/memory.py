@@ -180,12 +180,25 @@ class _DeviceLedger:
     # -- registration -------------------------------------------------- #
 
     def register(self, col: Any) -> None:
-        """Track ``col``'s concrete device buffer (idempotent per buffer)."""
+        """Track ``col``'s concrete device buffer (idempotent per buffer).
+
+        Each entry also records the mesh row-shard count it was registered
+        under: on a mesh every buffer is an even split across the row
+        shards, so per-shard residency (``per_shard_bytes``) — the number
+        that actually binds on real hardware, one shard's HBM fills first
+        — derives from the same entries.
+        """
         data = col.raw
         nbytes = getattr(data, "nbytes", None)
         if nbytes is None:
             return
         nbytes = int(nbytes)
+        try:
+            from modin_tpu.parallel.mesh import num_row_shards
+
+            shards = num_row_shards()
+        except Exception:  # graftlint: disable=EXC-HYGIENE -- no mesh (backend not initialized): account the buffer as single-shard
+            shards = 1
         with self._lock:
             old_key = getattr(col, "_dev_key", None)
             if old_key is not None:
@@ -198,7 +211,7 @@ class _DeviceLedger:
             def _on_dead(_ref: Any, *, _key: int = key) -> None:
                 self._forget(_key)
 
-            self._entries[key] = (weakref.ref(col, _on_dead), nbytes)
+            self._entries[key] = (weakref.ref(col, _on_dead), nbytes, shards)
             col._dev_key = key
             self._total += nbytes
 
@@ -247,7 +260,28 @@ class _DeviceLedger:
         this to re-seat everything after a device loss)."""
         with self._lock:
             entries = list(self._entries.values())
-        return [col for ref, _ in entries if (col := ref()) is not None]
+        return [col for e in entries if (col := e[0]()) is not None]
+
+    def per_shard_bytes(self) -> dict:
+        """{mesh row shard index: resident bytes} — each tracked padded
+        buffer split evenly over the shard count it was registered under
+        (a reshaped mesh's old buffers keep their original split until
+        they are replaced)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        out: dict = {}
+        for entry in entries:
+            nbytes, shards = entry[1], max(entry[2], 1)
+            share = nbytes // shards
+            for s in range(shards):
+                out[s] = out.get(s, 0) + share
+        return out
+
+    def max_shard_bytes(self) -> int:
+        """Largest single shard's resident bytes — the binding HBM
+        constraint on a mesh (gauge ``memory.device.shard_resident_bytes``)."""
+        per = self.per_shard_bytes()
+        return max(per.values()) if per else 0
 
     # -- spill policy --------------------------------------------------- #
 
@@ -266,7 +300,7 @@ class _DeviceLedger:
             with graftscope.span(
                 "memory.device.spill", layer="JAX-ENGINE", target=target_bytes
             ):
-                for _key, (ref, _nbytes) in candidates:
+                for _key, (ref, _nbytes, _shards) in candidates:
                     if freed >= target_bytes:
                         break
                     if serving_context.CONTEXT_ON:
@@ -302,6 +336,10 @@ class _DeviceLedger:
                 # both ledgers
                 emit_metric("memory.device.resident_bytes", self._total)
                 emit_metric("memory.host.cache_bytes", ledger.total_bytes())
+                emit_metric(
+                    "memory.device.shard_resident_bytes",
+                    self.max_shard_bytes(),
+                )
         return freed
 
     def admit(self, estimate_bytes: int, exclude_ids: Any = None) -> None:

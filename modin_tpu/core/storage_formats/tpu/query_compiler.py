@@ -2718,8 +2718,8 @@ class TpuQueryCompiler(BaseQueryCompiler):
         from modin_tpu.ops.join import (
             composite_key_codes,
             gather_right_columns,
+            merge_positions,
             right_only_positions,
-            sort_merge_positions,
         )
         from modin_tpu.ops.structural import gather_columns_device
         from modin_tpu.utils import hashable
@@ -2875,13 +2875,13 @@ class TpuQueryCompiler(BaseQueryCompiler):
         if how == "right":
             # probe from the right side: output rows follow right order and
             # the left side is the nullable one
-            rprobe_left, rprobe_right, n_out, has_miss = sort_merge_positions(
+            rprobe_left, rprobe_right, n_out, has_miss = merge_positions(
                 rkey, lkey, len(rframe), len(lframe), how="left"
             )
             left_pos, right_pos = rprobe_right, rprobe_left
         else:
             probe_how = "left" if how in ("left", "outer") else "inner"
-            left_pos, right_pos, n_out, has_miss = sort_merge_positions(
+            left_pos, right_pos, n_out, has_miss = merge_positions(
                 lkey, rkey, len(lframe), len(rframe), how=probe_how
             )
 
@@ -4519,14 +4519,17 @@ class TpuQueryCompiler(BaseQueryCompiler):
         """Explicit sample->pivots->all_to_all shuffle sort (RangePartitioning).
 
         Reference analogue: range-partitioning sort_by (dataframe.py:2742 +
-        partition_manager.py:1937); used when the config opts in — the global
-        argsort path is otherwise preferred on a single slice.
+        partition_manager.py:1937).  Taken when the RangePartitioning config
+        opts in, OR — graftmesh — when the kernel router's calibrated
+        crossover predicts the collective sort beats the global argsort at
+        this (rows, mesh shape): the router, not a flag, decides when
+        collectives pay.
         """
         from modin_tpu.config import RangePartitioning
         from modin_tpu.parallel.mesh import num_row_shards
         from modin_tpu.parallel.shuffle import ShuffleSkewError, range_shuffle
 
-        if not RangePartitioning.get() or num_row_shards() < 2:
+        if num_row_shards() < 2:
             return None
         if kwargs.get("na_position", "last") != "last" or kwargs.get("key") is not None:
             return None
@@ -4543,6 +4546,18 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return None
         if not all(c.is_device for c in frame._columns) or len(frame) == 0:
             return None
+        if not RangePartitioning.get():
+            from modin_tpu.ops import router
+
+            # payload = the row-id column + every non-key column, all moved
+            # through the all_to_all the local argsort path never pays
+            if (
+                router.decide_layout(
+                    "sort", len(frame), payload_cols=frame.num_cols
+                )
+                != "sharded"
+            ):
+                return None
         import jax.numpy as jnp
 
         frame.materialize_device()

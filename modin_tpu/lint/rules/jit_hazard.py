@@ -45,6 +45,17 @@ _SHAPE_METHODS = frozenset({"reshape", "broadcast_to", "resize"})
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
 _MUTABLE_CALLS = frozenset({"list", "dict", "set", "defaultdict", "OrderedDict"})
 
+#: cross-device collective primitives: legal ONLY inside a shard_map body
+#: (and never under a traced Python conditional there) — a collective in a
+#: plain jit / under data-dependent Python control flow is the SPMD
+#: miscompile class documented at ops/reductions.py:57 (a global lax.cond
+#: over sharded operands partitions each branch inconsistently per device,
+#: and a collective outside shard_map has no named mesh axis to rendezvous
+#: on)
+_COLLECTIVES = frozenset(
+    {"all_to_all", "psum", "all_gather", "ppermute", "pmean", "psum_scatter"}
+)
+
 
 def _jit_static_params(
     call: ast.Call, fn: ast.FunctionDef
@@ -149,11 +160,20 @@ class JitHazardRule(Rule):
 
     def check_file(self, ctx: FileContext, project: Project) -> Iterator[Finding]:
         module_mutables = self._module_mutables(ctx)
+        shard_bodies = self._shard_map_bodies(ctx)
+        shard_scopes = {ctx.scope_of(fn) for fn in shard_bodies}
+        yield from self._check_collective_placement(ctx, shard_scopes)
         for fn, static_params in self._jitted_functions(ctx):
             traced = {
                 a.arg for a in fn.args.args if a.arg not in static_params
             } - {"self", "cls"}
-            yield from self._check_body(ctx, fn, _TracedState(traced), module_mutables)
+            yield from self._check_body(
+                ctx,
+                fn,
+                _TracedState(traced),
+                module_mutables,
+                in_shard_map=fn in shard_bodies,
+            )
 
     # -- discovery ------------------------------------------------------ #
 
@@ -171,6 +191,111 @@ class JitHazardRule(Rule):
                     for t in stmt.targets:
                         names.update(assigned_names(t))
         return names
+
+    def _defs_by_scope(
+        self, ctx: FileContext
+    ) -> Dict[Tuple[str, str], ast.FunctionDef]:
+        """(containing scope, name) -> FunctionDef for call-form resolution."""
+        defs: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                own = ctx.scope_of(node)
+                containing = (
+                    own.rsplit(".", 1)[0] if "." in own else "<module>"
+                )
+                defs[(containing, node.name)] = node
+        return defs
+
+    def _resolve_in_chain(
+        self,
+        ctx: FileContext,
+        call: ast.Call,
+        defs: Dict[Tuple[str, str], ast.FunctionDef],
+    ) -> Optional[ast.FunctionDef]:
+        """The same-file FunctionDef a call's first positional arg names,
+        resolved through the call site's scope chain (innermost first)."""
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return None
+        fname = call.args[0].id
+        scope = ctx.scope_of(call)
+        chain = [scope]
+        while "." in scope:
+            scope = scope.rsplit(".", 1)[0]
+            chain.append(scope)
+        chain.append("<module>")
+        for s in chain:
+            fn = defs.get((s, fname))
+            if fn is not None:
+                return fn
+        return None
+
+    def _shard_map_bodies(self, ctx: FileContext) -> Set[ast.FunctionDef]:
+        """Function defs passed to ``shard_map(...)`` in this file — the
+        only scopes where a cross-device collective is legal."""
+        defs = self._defs_by_scope(ctx)
+        bodies: Set[ast.FunctionDef] = set()
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and (p := dotted_parts(node.func)) is not None
+                and p[-1] == "shard_map"
+            ):
+                continue
+            fn = self._resolve_in_chain(ctx, node, defs)
+            if fn is not None:
+                bodies.add(fn)
+        return bodies
+
+    @staticmethod
+    def _is_collective_call(node: ast.AST) -> Optional[str]:
+        """The collective's name when ``node`` is a lax collective call."""
+        if not isinstance(node, ast.Call):
+            return None
+        parts = dotted_parts(node.func)
+        if parts is None or parts[-1] not in _COLLECTIVES:
+            return None
+        # module form (lax.psum / jax.lax.all_to_all) or a bare name
+        # imported from lax; dotted access on anything else (obj.psum) is
+        # some other API
+        if len(parts) == 1 or parts[-2] == "lax":
+            return parts[-1]
+        return None
+
+    def _check_collective_placement(
+        self, ctx: FileContext, shard_scopes: Set[str]
+    ) -> Iterator[Finding]:
+        """Collectives outside every shard_map body: no named mesh axis to
+        rendezvous on — at best a trace error, at worst the per-device
+        inconsistent-partitioning miscompile (ops/reductions.py:57)."""
+        for node in ast.walk(ctx.tree):
+            name = self._is_collective_call(node)
+            if name is None:
+                continue
+            scope = ctx.scope_of(node)
+            inside = any(
+                scope == s or scope.startswith(s + ".")
+                for s in shard_scopes
+            )
+            if inside:
+                continue
+            yield Finding(
+                path=ctx.rel,
+                line=getattr(node, "lineno", 1),
+                rule=self.id,
+                message=(
+                    f"collective `{name}` outside a shard_map body — no "
+                    "mesh axis binding; under SPMD partitioning this is "
+                    "the miscompile class documented at ops/reductions.py"
+                ),
+                fix_hint=(
+                    "move the collective into a function passed to "
+                    "shard_map (parallel/jax_compat.py) with the mesh axis "
+                    "in scope, or use a sharded jnp reduction and let XLA "
+                    "emit the collective"
+                ),
+                scope=scope,
+                symbol=f"collective-{name}",
+            )
 
     def _jitted_functions(
         self, ctx: FileContext
@@ -197,14 +322,7 @@ class JitHazardRule(Rule):
         # call form: jax.jit(fn, ...) where fn is a def in the same file.
         # scope_of(def) includes the def's own name; key by the CONTAINING
         # scope so the jit call site's scope chain resolves it.
-        defs_by_scope: Dict[Tuple[str, str], ast.FunctionDef] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.FunctionDef):
-                own = ctx.scope_of(node)
-                containing = (
-                    own.rsplit(".", 1)[0] if "." in own else "<module>"
-                )
-                defs_by_scope[(containing, node.name)] = node
+        defs_by_scope = self._defs_by_scope(ctx)
         for node in ast.walk(ctx.tree):
             is_jit = isinstance(node, ast.Call) and _is_jit_callable(node.func)
             # shard_map(fn, ...) traces fn exactly like jit does
@@ -215,22 +333,10 @@ class JitHazardRule(Rule):
             )
             if not (is_jit or is_shard_map):
                 continue
-            if not node.args or not isinstance(node.args[0], ast.Name):
-                continue
-            fname = node.args[0].id
-            # resolve in the jit call's scope chain, innermost first
-            scope = ctx.scope_of(node)
-            chain = [scope]
-            while "." in scope:
-                scope = scope.rsplit(".", 1)[0]
-                chain.append(scope)
-            chain.append("<module>")
-            for s in chain:
-                fn = defs_by_scope.get((s, fname))
-                if fn is not None and fn not in seen:
-                    seen.add(fn)
-                    yield fn, _jit_static_params(node, fn)
-                    break
+            fn = self._resolve_in_chain(ctx, node, defs_by_scope)
+            if fn is not None and fn not in seen:
+                seen.add(fn)
+                yield fn, _jit_static_params(node, fn)
 
     # -- hazard checks -------------------------------------------------- #
 
@@ -240,6 +346,7 @@ class JitHazardRule(Rule):
         fn: ast.FunctionDef,
         state: _TracedState,
         module_mutables: Set[str],
+        in_shard_map: bool = False,
     ) -> Iterator[Finding]:
         local_bindings: Set[str] = set()
         for node in ast.walk(fn):
@@ -290,6 +397,30 @@ class JitHazardRule(Rule):
             # 2. traced values in shape positions
             if isinstance(node, ast.Call):
                 yield from self._check_shape_call(ctx, node, fn, state)
+            # 2b. collective under a traced Python conditional inside a
+            # shard_map body: the branch partitions inconsistently per
+            # device and the collective rendezvous never lines up — the
+            # SPMD miscompile class documented at ops/reductions.py:57
+            if (
+                in_shard_map
+                and isinstance(node, (ast.If, ast.While, ast.IfExp))
+                and state.is_traced_expr(node.test)
+            ):
+                for sub in ast.walk(node):
+                    name = self._is_collective_call(sub)
+                    if name is not None:
+                        yield self._finding(
+                            ctx,
+                            sub,
+                            fn,
+                            f"collective `{name}` under a traced Python "
+                            "conditional — per-device branch divergence "
+                            "deadlocks/miscompiles the rendezvous",
+                            "hoist the collective out of the branch; "
+                            "select its INPUT with jnp.where instead",
+                            f"collective-branch-{name}",
+                        )
+                        break
 
         # 3. closure capture of mutable module state
         reported: Set[str] = set()

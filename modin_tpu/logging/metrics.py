@@ -130,6 +130,13 @@ METRICS = (
         "logical bytes — arithmetic/traffic spent on rows no one reads",
     ),
     (
+        "engine.cost.collective_bytes",
+        "counter",
+        "payload bytes moved through interconnect collectives "
+        "(all_to_all/psum) at the instrumented sites — the cross-device "
+        "traffic term of the graftmesh router's sharded-vs-local crossover",
+    ),
+    (
         "io.read.bytes",
         "histogram",
         "bytes parsed per FileDispatcher read (source file size, "
@@ -146,7 +153,9 @@ METRICS = (
         "recovery.reseat.*",
         "counter",
         "device columns re-seated from lineage, per provenance kind "
-        "(host / io / op)",
+        "(host / io / op), plus the graftmesh single-shard leg (shard: "
+        "only the lost shard's slice was re-uploaded, the live shards' "
+        "buffers were kept)",
     ),
     (
         "recovery.unrecoverable",
@@ -196,10 +205,19 @@ METRICS = (
         "spill pass",
     ),
     (
+        "memory.device.shard_resident_bytes",
+        "gauge",
+        "largest per-shard share of device-resident bytes (the binding "
+        "constraint on a mesh: one shard's HBM fills first), observed "
+        "after each spill pass",
+    ),
+    (
         "router.*.*",
         "counter",
-        "graftsort kernel-router decisions per sort-shaped op family "
-        "(median/quantile/nunique/mode): device vs host choice counts",
+        "kernel-router decisions: device vs host choice counts per "
+        "sort-shaped op family (median/quantile/nunique/mode), and "
+        "graftmesh local-vs-sharded layout choices per collective-eligible "
+        "op (spmd_sort / spmd_merge)",
     ),
     (
         "router.calibrate",

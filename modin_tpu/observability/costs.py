@@ -71,6 +71,9 @@ _pad_lock = threading.Lock()
 # process-global padding accumulators (the Chrome counter track reads these)
 _total_padded_bytes = 0
 _total_waste_bytes = 0
+# process-global collective-traffic accumulator (all_to_all / psum payload
+# bytes observed at the instrumented collective sites, graftmesh)
+_total_collective_bytes = 0
 # most recent achieved bandwidth sample, bytes/s (Chrome counter track)
 _last_achieved_bw = 0.0
 
@@ -238,6 +241,7 @@ class CostLedger:
         self._lock = threading.Lock()
         self._entries: Dict[str, dict] = {}
         self._padding: Dict[str, dict] = {}  # per padding site
+        self._collective: Dict[str, dict] = {}  # per collective site
 
     def _entry(self, signature: str) -> dict:
         entry = self._entries.get(signature)
@@ -290,6 +294,14 @@ class CostLedger:
             entry["padded_bytes"] += padded_bytes
             entry["waste_bytes"] += max(padded_bytes - valid_bytes, 0)
 
+    def record_collective(self, site: str, nbytes: int) -> None:
+        with self._lock:
+            entry = self._collective.get(site)
+            if entry is None:
+                entry = self._collective[site] = {"events": 0, "bytes": 0}
+            entry["events"] += 1
+            entry["bytes"] += nbytes
+
     def efficiency(self, signature: str) -> Optional[dict]:
         """Achieved FLOP/s, bandwidth, and roofline fraction for one
         signature (None if never dispatched).  ``async_caveat`` is always
@@ -327,12 +339,16 @@ class CostLedger:
             return {
                 "signatures": {s: dict(e) for s, e in self._entries.items()},
                 "padding": {s: dict(e) for s, e in self._padding.items()},
+                "collective": {
+                    s: dict(e) for s, e in self._collective.items()
+                },
             }
 
     def reset(self) -> None:
         with self._lock:
             self._entries.clear()
             self._padding.clear()
+            self._collective.clear()
 
 
 _LEDGER = CostLedger()
@@ -346,10 +362,12 @@ def reset() -> None:
     """Clear the cost ledger and the process padding accumulators (tests,
     per-section bench resets)."""
     global _total_padded_bytes, _total_waste_bytes, _last_achieved_bw
+    global _total_collective_bytes
     _LEDGER.reset()
     with _pad_lock:
         _total_padded_bytes = 0
         _total_waste_bytes = 0
+        _total_collective_bytes = 0
         _last_achieved_bw = 0.0
 
 
@@ -366,6 +384,11 @@ def thread_cost() -> Tuple[float, float]:
 def thread_padding() -> Tuple[int, int]:
     """Monotonic per-thread (padded bytes, padding-waste bytes)."""
     return (getattr(_tls, "padded", 0), getattr(_tls, "waste", 0))
+
+
+def thread_collective() -> int:
+    """Monotonic per-thread collective-payload bytes (all_to_all/psum)."""
+    return getattr(_tls, "collective", 0)
 
 
 def _bump_thread_cost(flops: Any, bytes_accessed: Any) -> None:
@@ -531,6 +554,31 @@ def note_padding(site: str, padded_bytes: int, valid_bytes: int) -> None:
             if sp is not None:
                 sp.attrs["padding_waste_bytes"] = (
                     sp.attrs.get("padding_waste_bytes", 0) + waste
+                )
+    except Exception:
+        pass
+
+
+def note_collective(site: str, nbytes: int) -> None:
+    """One collective payload crossing the interconnect: ``nbytes`` moved
+    through an all_to_all/psum at ``site``.  Call sites gate on
+    :data:`COST_ON`.  Feeds ``engine.cost.collective_bytes``, the
+    per-thread counter, and the per-site ledger — the observability leg of
+    the router's collective-aware crossover model (graftmesh).
+    """
+    global _total_collective_bytes
+    try:
+        nbytes = int(nbytes)
+        _tls.collective = getattr(_tls, "collective", 0) + nbytes
+        with _pad_lock:
+            _total_collective_bytes += nbytes
+        _LEDGER.record_collective(site, nbytes)
+        emit_metric("engine.cost.collective_bytes", nbytes)
+        if _spans.TRACE_ON:
+            sp = _spans.current_span()
+            if sp is not None:
+                sp.attrs["collective_bytes"] = (
+                    sp.attrs.get("collective_bytes", 0) + nbytes
                 )
     except Exception:
         pass

@@ -34,7 +34,12 @@ from modin_tpu.observability.chrome_trace import to_chrome_trace
 #: minimum seconds between dumps (module-level so tests can lower it)
 MIN_DUMP_INTERVAL_S = 5.0
 
-_last_dump = 0.0
+#: "no dump yet" sentinel.  NOT 0.0: time.monotonic() is machine uptime on
+#: Linux, so `now - 0.0 < interval` spuriously rate-limits every dump for
+#: the first `interval` seconds after boot (observed: a test pinning a
+#: 3600s interval failed for the first hour of container uptime).
+_NEVER_DUMPED = float("-inf")
+_last_dump = _NEVER_DUMPED
 _dump_lock = threading.Lock()
 
 _REASON_SANITIZE = re.compile(r"[^A-Za-z0-9_.-]+")
@@ -55,7 +60,7 @@ def reset_for_tests() -> None:
     counters = _spans._COUNTERS
     if counters is not None:
         counters.clear()
-    _last_dump = 0.0
+    _last_dump = _NEVER_DUMPED
 
 
 def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
@@ -123,5 +128,5 @@ def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
         # caller double-dump the same incident.
         with _dump_lock:
             if _last_dump == claimed:
-                _last_dump = 0.0
+                _last_dump = _NEVER_DUMPED
         return None

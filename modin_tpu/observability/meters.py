@@ -474,6 +474,7 @@ class QueryStats:
         "est_bytes",
         "padded_bytes",
         "padding_waste_bytes",
+        "collective_bytes",
         "breaker_trips",
         "_t0",
         "_lock",
@@ -511,6 +512,9 @@ class QueryStats:
         self.est_bytes = 0.0
         self.padded_bytes = 0
         self.padding_waste_bytes = 0
+        # graftmesh: payload bytes this scope moved through collectives
+        # (all_to_all/psum) — the cross-device traffic share of est_bytes
+        self.collective_bytes = 0
         # graftgate tenant health: device-path breaker strikes observed
         # while this scope's query ran (its own fallbacks included — a
         # query can complete correct via fallback yet be striking paths)
@@ -552,6 +556,8 @@ class QueryStats:
             self.padded_bytes += int(value)
         elif name == "engine.cost.padding_waste_bytes":
             self.padding_waste_bytes += int(value)
+        elif name == "engine.cost.collective_bytes":
+            self.collective_bytes += int(value)
         elif name == "sortcache.hit":
             self.cache_hits["sorted_rep"] += int(value)
         elif name == "fusion.cache.hit":
@@ -602,6 +608,7 @@ class QueryStats:
             "est_bytes": self.est_bytes,
             "padded_bytes": self.padded_bytes,
             "padding_waste_bytes": self.padding_waste_bytes,
+            "collective_bytes": self.collective_bytes,
             "breaker_trips": self.breaker_trips,
         }
 

@@ -187,6 +187,34 @@ def sort_merge_positions(
     return left_pos, right_pos, n_out, has_miss
 
 
+def merge_positions(
+    left_key: Any,
+    right_key: Any,
+    n_left: int,
+    n_right: int,
+    how: str = "inner",
+) -> Tuple[Any, Any, int, bool]:
+    """Router-dispatched match positions (graftmesh).
+
+    When ``decide_layout`` predicts the collective pays at this (rows, mesh
+    shape), the right-side sort runs through the all_to_all shuffle
+    (ops/spmd.py) — bit-identical positions, different substrate cost; the
+    local sort-merge kernel is the fallback for single-shard meshes, small
+    frames, and pathological key skew.
+    """
+    from modin_tpu.ops import router
+
+    if router.decide_layout("merge", int(n_right), payload_cols=1) == "sharded":
+        from modin_tpu.ops import spmd
+
+        result = spmd.sharded_merge_positions(
+            left_key, right_key, int(n_left), int(n_right), how
+        )
+        if result is not None:
+            return result
+    return sort_merge_positions(left_key, right_key, n_left, n_right, how)
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_right_only(p_right: int, n_right: int, n_out: int):
     """Right rows untouched by a left join: (order, count).

@@ -659,9 +659,22 @@ def _sorted_inputs(plans: List[ColumnPlan], n: int) -> dict:
         with graftscope.span(
             "sortcache.build", layer="QUERY-COMPILER", cols=len(missing)
         ):
-            built = sorted_valid_columns(
-                [c.data for _, c in missing], int(n)
-            )
+            built = None
+            from modin_tpu.ops import router
+
+            if router.decide_layout("sort", int(n)) == "sharded":
+                # graftmesh: build the reps through the all_to_all shuffle
+                # (bit-identical representation); any decline (skew,
+                # single shard) falls back to the one-jit local build
+                from modin_tpu.ops import spmd
+
+                built = spmd.sharded_sorted_valid_columns(
+                    [c.data for _, c in missing], int(n)
+                )
+            if built is None:
+                built = sorted_valid_columns(
+                    [c.data for _, c in missing], int(n)
+                )
         for (i, col), pair in zip(missing, built):
             sorted_cache.attach(col, pair[0], pair[1])
             reps[i] = pair

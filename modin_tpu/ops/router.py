@@ -53,19 +53,27 @@ STRATEGIES = ("dict", "cached", "hist", "sort")
 #: noise and device residency wins ties
 MIN_SAVINGS_S = 0.05
 
-_CAL_VERSION = 2
+_CAL_VERSION = 3
 
 _lock = threading.Lock()
 #: None = not yet resolved; False = calibration failed (route device);
 #: dict = live table
 _calibration: Any = None
+#: the mesh shape the lazy resolution (success OR failure) belongs to —
+#: an in-process reshape re-resolves both outcomes, not just tables
+_calibration_mesh: Optional[str] = None
+#: a table installed by set_calibration is honored verbatim (tests force
+#: crossovers); a lazily-resolved one is re-resolved when the mesh reshapes
+_calibration_forced = False
 
 
 def set_calibration(table: Optional[Dict[str, float]]) -> None:
     """Force the calibration table (tests) or reset to lazy (None)."""
-    global _calibration
+    global _calibration, _calibration_forced, _calibration_mesh
     with _lock:
         _calibration = table if table is not None else None
+        _calibration_forced = table is not None
+        _calibration_mesh = None
 
 
 def _platform() -> str:
@@ -77,11 +85,21 @@ def _platform() -> str:
         return "unknown"
 
 
-def _cache_path(platform: str) -> str:
+def _mesh_key() -> str:
+    from modin_tpu.parallel.mesh import mesh_shape_key
+
+    try:
+        return mesh_shape_key()
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- no backend/mesh at all: calibration is keyed 'unknown' and the sharded entries are simply absent
+        return "unknown"
+
+
+def _cache_path(platform: str, mesh_key: str) -> str:
     from modin_tpu.config import CacheDir
 
     return os.path.join(
-        CacheDir.get(), f"kernel_router_{platform}_v{_CAL_VERSION}.json"
+        CacheDir.get(),
+        f"kernel_router_{platform}_mesh{mesh_key}_v{_CAL_VERSION}.json",
     )
 
 
@@ -134,6 +152,7 @@ def _measure() -> Dict[str, float]:
     table = {
         "version": _CAL_VERSION,
         "platform": _platform(),
+        "mesh": _mesh_key(),
         "rows": rows,
         "device_sort_s": _time_best(
             lambda: np.asarray(sort_fn(dev_wide))
@@ -153,7 +172,77 @@ def _measure() -> Dict[str, float]:
         )
         table[f"host_nunique_{regime}_s"] = _time_best(lambda: host.nunique())
         table[f"host_mode_{regime}_s"] = _time_best(lambda: host.mode())
+    _measure_sharded(table, rows, wide)
     return table
+
+
+def _measure_sharded(table: Dict[str, Any], rows: int, wide: Any) -> None:
+    """graftmesh calibration entries, only meaningful on a >= 2-shard mesh:
+
+    - ``device_shuffle_s``: the full sharded sort (sample -> pivots ->
+      all_to_all -> per-shard local sort -> compaction) at the calibration
+      size with one payload column — the end-to-end cost ``decide_layout``
+      scales by n log n against the local ``device_sort_s``;
+    - ``collective_bytes_per_s``: a bare tiled ``all_to_all`` round over
+      the same volume, giving the interconnect term extra payload columns
+      are billed at (the ``engine.cost.collective_bytes`` coefficient).
+
+    Any failure leaves the entries absent: ``decide_layout`` then answers
+    "local"/uncalibrated, never crashes.
+    """
+    from modin_tpu.parallel.mesh import get_mesh, num_row_shards
+
+    try:
+        S = num_row_shards()
+        if S < 2:
+            return
+        import jax
+        import numpy as np
+
+        from jax.sharding import PartitionSpec as P
+
+        from modin_tpu.ops.structural import pad_host
+        from modin_tpu.parallel import shuffle as _shuffle
+        from modin_tpu.parallel.engine import JaxWrapper
+        from modin_tpu.parallel.jax_compat import shard_map
+
+        key_dev = JaxWrapper.put(pad_host(wide))
+        payload = JaxWrapper.put(pad_host(wide))
+
+        def run_shuffle() -> None:
+            out = _shuffle.range_shuffle(
+                key_dev, [payload], rows, local_sort=True
+            )
+            np.asarray(out[0])
+
+        table["device_shuffle_s"] = _time_best(run_shuffle)
+
+        mesh = get_mesh()
+        cap = max(rows // max(S * S, 1), 8)
+
+        def local_roundtrip(x):
+            block = x.reshape(S, cap)
+            recv = jax.lax.all_to_all(
+                block, "rows", split_axis=0, concat_axis=0, tiled=True
+            )
+            return recv.reshape(-1)
+
+        fn = jax.jit(
+            shard_map(
+                local_roundtrip,
+                mesh=mesh,
+                in_specs=(P("rows"),),
+                out_specs=P("rows"),
+                check_vma=False,
+            )
+        )
+        data = JaxWrapper.put(np.zeros(S * S * cap, dtype=np.int64))
+        wall = _time_best(lambda: np.asarray(fn(data)))
+        moved_bytes = S * S * cap * 8
+        if wall > 0:
+            table["collective_bytes_per_s"] = moved_bytes / wall
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- sharded calibration is an optimization probe; absence of its entries keeps layout routing on the local default
+        pass
 
 
 def get_calibration() -> Optional[Dict[str, float]]:
@@ -163,20 +252,27 @@ def get_calibration() -> Optional[Dict[str, float]]:
     the pre-router behavior); the failure is remembered so a broken
     substrate is probed once, not per decision.
     """
-    global _calibration
+    global _calibration, _calibration_mesh
     with _lock:
         if _calibration is not None:
-            return _calibration if _calibration is not False else None
+            if _calibration_forced or _calibration_mesh == _mesh_key():
+                return _calibration if _calibration is not False else None
+            # mesh reshaped: the resolution — a table's sharded entries,
+            # their absence, or a FAILURE — belongs to another topology
+            _calibration = None
         platform = _platform()
-        path = _cache_path(platform)
+        mesh_key = _mesh_key()
+        path = _cache_path(platform, mesh_key)
         try:
             with open(path) as f:
                 table = json.load(f)
             if (
                 table.get("version") == _CAL_VERSION
                 and table.get("platform") == platform
+                and table.get("mesh") == mesh_key
             ):
                 _calibration = table
+                _calibration_mesh = mesh_key
                 return table
         except (OSError, ValueError):
             pass
@@ -188,8 +284,10 @@ def get_calibration() -> Optional[Dict[str, float]]:
             emit_metric("router.calibrate", 1)
         except Exception:  # graftlint: disable=EXC-HYGIENE -- calibration is an optimization probe; ANY failure (no backend, OOM at micro size) must leave routing on the pre-router device default
             _calibration = False
+            _calibration_mesh = mesh_key
             return None
         _calibration = table
+        _calibration_mesh = mesh_key
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             tmp = f"{path}.tmp.{os.getpid()}"
@@ -227,6 +325,80 @@ def predicted_costs(
         for s in strategies
     ) * scale
     return {"device_s": device_s, "host_s": host_s}
+
+
+def decide_layout(
+    op: str, n: int, payload_cols: int = 0, itemsize: int = 8
+) -> str:
+    """"local" or "sharded" for one collective-eligible op over ``n`` rows.
+
+    ``op`` names the kernel family (``sort`` for sort_values and the
+    sorted-representation build, ``merge`` for the join's right-side sort);
+    ``payload_cols`` counts the non-key columns the sharded path would move
+    through the all_to_all (each is pure collective traffic the local path
+    never pays).  The model: both sides scale n log n from their calibrated
+    walls (``device_sort_s`` vs ``device_shuffle_s``), and payload columns
+    beyond the calibration's single one are billed at the measured
+    ``collective_bytes_per_s``.  Forced modes (``MODIN_TPU_SPMD``) and a
+    single-shard mesh skip the model entirely — the router, not a flag, is
+    the default decider, but tests and bench legs pin each side.
+
+    Emitted as ``router.spmd_<op>.<choice>`` metrics and a
+    ``router.decide`` span with the predicted costs.
+    """
+    from modin_tpu.config import SpmdMinRows, SpmdMode
+    from modin_tpu.parallel.mesh import num_row_shards
+
+    try:
+        S = num_row_shards()
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- no backend: there is no mesh to shard over, the local path is the only path
+        S = 1
+    mode = SpmdMode.get().lower()
+    costs: Dict[str, float] = {}
+    if S < 2:
+        choice, reason = "local", "single_shard"
+    elif mode == "sharded":
+        choice, reason = "sharded", "forced"
+    elif mode == "local":
+        choice, reason = "local", "forced"
+    elif n < int(SpmdMinRows.get()):
+        choice, reason = "local", "below_min_rows"
+    else:
+        table = get_calibration()
+        if table is None or "device_shuffle_s" not in table:
+            choice, reason = "local", "uncalibrated"
+        else:
+            cal_rows = max(int(table["rows"]), 2)
+            logscale = (n * math.log2(max(n, 2))) / (
+                cal_rows * math.log2(cal_rows)
+            )
+            local_s = table["device_sort_s"] * logscale
+            sharded_s = table["device_shuffle_s"] * logscale
+            bw = float(table.get("collective_bytes_per_s") or 0.0)
+            if bw > 0 and payload_cols > 1:
+                # the calibration shuffled one payload column; each extra
+                # one is (n rows + slack) of pure interconnect traffic
+                sharded_s += (payload_cols - 1) * n * itemsize / bw
+            costs = {"local_s": local_s, "sharded_s": sharded_s}
+            choice = "sharded" if sharded_s < local_s else "local"
+            reason = "cost_model"
+    emit_metric(f"router.spmd_{op}.{choice}", 1)
+    if graftscope.TRACE_ON:
+        graftscope.finish_span(
+            graftscope.start_span(
+                "router.decide",
+                layer="QUERY-COMPILER",
+                attrs={
+                    "op": f"spmd_{op}",
+                    "n": n,
+                    "choice": choice,
+                    "reason": reason,
+                    "payload_cols": payload_cols,
+                    **{k: round(v, 6) for k, v in costs.items()},
+                },
+            )
+        )
+    return choice
 
 
 def forced_host(op: str, n: int) -> bool:

@@ -50,18 +50,33 @@ _CACHE_LOCK = threading.RLock()
 class SortedRep:
     """One column's cached sorted representation, device-ledger-tracked."""
 
-    __slots__ = ("_data", "n_valid", "source_id", "epoch", "_dev_key", "__weakref__")
+    __slots__ = (
+        "_data", "n_valid", "source_id", "epoch", "mesh_key", "_dev_key",
+        "__weakref__",
+    )
 
     #: recovery marker: reseat passes drop derived caches instead of
     #: replaying lineage for them (core/execution/recovery.py)
     is_derived_cache = True
     is_lazy = False
 
-    def __init__(self, data: Any, n_valid: Any, source_id: int, epoch: int):
+    def __init__(
+        self,
+        data: Any,
+        n_valid: Any,
+        source_id: int,
+        epoch: int,
+        mesh_key: str = "",
+    ):
         self._data = data
         self.n_valid = n_valid
         self.source_id = source_id
         self.epoch = epoch
+        # graftmesh: the rep is keyed on the shard layout it was built
+        # under — a mesh reshape changes the padded physical layout and
+        # which collectives later consumers compile against, so a rep from
+        # another topology is stale even if the source buffer survived
+        self.mesh_key = mesh_key
         self._dev_key = None
 
     @property
@@ -110,8 +125,13 @@ def _live_rep_locked(col: Any) -> Optional[SortedRep]:
     if rep is None or rep._data is None:
         return None
     from modin_tpu.core.execution import recovery
+    from modin_tpu.parallel.mesh import mesh_shape_key
 
-    if rep.epoch != recovery.current_epoch() or rep.source_id != id(col._data):
+    if (
+        rep.epoch != recovery.current_epoch()
+        or rep.source_id != id(col._data)
+        or rep.mesh_key != mesh_shape_key()
+    ):
         if _invalidate_locked(col):
             emit_metric("sortcache.invalidate", 1)
         return None
@@ -145,8 +165,11 @@ def attach(col: Any, xs: Any, n_valid: Any) -> None:
     """Cache ``(xs, n_valid)`` as ``col``'s sorted representation."""
     from modin_tpu.core.execution import recovery
     from modin_tpu.core.memory import device_ledger
+    from modin_tpu.parallel.mesh import mesh_shape_key
 
-    rep = SortedRep(xs, n_valid, id(col._data), recovery.current_epoch())
+    rep = SortedRep(
+        xs, n_valid, id(col._data), recovery.current_epoch(), mesh_shape_key()
+    )
     with _CACHE_LOCK:
         invalidated = _invalidate_locked(col)
         device_ledger.register(rep)

@@ -22,6 +22,19 @@ _lock = threading.Lock()
 _mesh = None
 _mesh_shape: Optional[tuple] = None
 
+# hot-path caches: num_row_shards / mesh_shape_key run per sorted-rep
+# lookup and per buffer registration, where get_mesh()'s config update +
+# lock would serialize.  Filled whenever the mesh is (re)built; cleared by
+# reset_mesh and the MeshShape subscription (every mutation point).
+_cached_row_shards: Optional[int] = None
+_cached_shape_key: Optional[str] = None
+
+
+def _fill_cache(mesh) -> None:
+    global _cached_row_shards, _cached_shape_key
+    _cached_row_shards = int(mesh.shape["rows"])
+    _cached_shape_key = "x".join(str(int(s)) for s in mesh.devices.shape)
+
 
 def get_mesh():
     """Get (building on first use) the global device mesh."""
@@ -44,6 +57,7 @@ def get_mesh():
             mesh_devices = np.asarray(devices[: int(np.prod(shape))]).reshape(shape)
             _mesh = Mesh(mesh_devices, _MESH_AXES)
             _mesh_shape = shape
+        _fill_cache(_mesh)
     return _mesh
 
 
@@ -53,13 +67,16 @@ def set_mesh(mesh) -> None:
     with _lock:
         _mesh = mesh
         _mesh_shape = tuple(mesh.devices.shape)
+        _fill_cache(mesh)
 
 
 def reset_mesh() -> None:
-    global _mesh, _mesh_shape
+    global _mesh, _mesh_shape, _cached_row_shards, _cached_shape_key
     with _lock:
         _mesh = None
         _mesh_shape = None
+        _cached_row_shards = None
+        _cached_shape_key = None
 
 
 def row_sharding():
@@ -76,4 +93,35 @@ def replicated_sharding():
 
 
 def num_row_shards() -> int:
-    return get_mesh().shape["rows"]
+    cached = _cached_row_shards
+    if cached is not None:
+        return cached
+    return int(get_mesh().shape["rows"])
+
+
+def mesh_shape_key() -> str:
+    """Stable string identity of the live mesh shape, e.g. ``"8x1"``.
+
+    Keys everything whose validity is tied to the mesh topology: the
+    kernel-router calibration cache, sorted-representation reps (a rep
+    built under one shard count has a different padded layout than the
+    next), and the SPMD perf-history scale keys (1-dev and 8-dev walls
+    must never gate against each other).
+    """
+    cached = _cached_shape_key
+    if cached is not None:
+        return cached
+    get_mesh()  # fills the cache under the lock
+    return _cached_shape_key
+
+
+def _on_mesh_shape(_param) -> None:
+    """MeshShape changed (put / context): drop the hot-path caches so the
+    next consumer rebuilds the mesh at the new shape — exactly the rebuild
+    get_mesh() itself performs on a shape change."""
+    global _cached_row_shards, _cached_shape_key
+    _cached_row_shards = None
+    _cached_shape_key = None
+
+
+MeshShape.subscribe(_on_mesh_shape)

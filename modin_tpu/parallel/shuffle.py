@@ -73,8 +73,21 @@ def sample_pivots(key: Any, n: int, num_partitions: int, num_samples: int = 4096
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_shuffle(n_cols: int, capacity: int, n: int, descending: bool, local_sort: bool = False):
-    """shard_map kernel: local bucketize+pack, all_to_all, local compaction."""
+def _jit_shuffle(
+    n_cols: int,
+    capacity: int,
+    n: int,
+    descending: bool,
+    local_sort: bool = False,
+    mesh_key: str = "",
+):
+    """shard_map kernel: local bucketize+pack, all_to_all, local compaction.
+
+    ``mesh_key`` participates in the cache key only: the compiled program
+    closes over the mesh captured at trace time, so a mesh reshape (the
+    parity grid reconfigures MeshShape in-process) must never reuse a
+    program traced for a different topology.
+    """
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -192,8 +205,9 @@ def range_shuffle(
     import jax.numpy as jnp
 
     from modin_tpu.logging.metrics import emit_metric
+    from modin_tpu.observability import costs as _costs
     from modin_tpu.ops.structural import gather_columns
-    from modin_tpu.parallel.mesh import num_row_shards
+    from modin_tpu.parallel.mesh import mesh_shape_key, num_row_shards
 
     with graftscope.span(
         "shuffle.range_shuffle",
@@ -203,6 +217,7 @@ def range_shuffle(
         local_sort=bool(local_sort),
     ) as _sp:
         S = num_row_shards()
+        mesh_key = mesh_shape_key()
         P_len = key.shape[0]
         L = P_len // S
         pivots = sample_pivots(key, n, S)
@@ -212,7 +227,10 @@ def range_shuffle(
         slack_retries = 0
         while True:
             capacity = int(max(8, int(L / max(S, 1) * slack)))
-            fn = _jit_shuffle(len(cols), capacity, n, bool(descending), bool(local_sort))
+            fn = _jit_shuffle(
+                len(cols), capacity, n, bool(descending), bool(local_sort),
+                mesh_key,
+            )
             out = fn(pivots_dev, key, row_valid, *cols)
             counts_r, overflow_r = out[0], out[1]
             payload = list(out[2:])
@@ -227,6 +245,17 @@ def range_shuffle(
                 emit_metric("resilience.shuffle.skew_fallback", 1)
                 raise ShuffleSkewError("range_shuffle: pathological key skew")
 
+        if _costs.COST_ON:
+            # graftcost collective accounting: every routed column moves a
+            # [S, capacity] block per shard through the all_to_all (S*S*cap
+            # rows total), plus the validity mask (1 byte/slot).  This is
+            # the ``engine.cost.collective_bytes`` term the router's
+            # sharded-vs-local crossover model is calibrated against.
+            slots = S * S * capacity
+            payload_bytes = sum(
+                slots * c.dtype.itemsize for c in (key, *cols)
+            ) + slots
+            _costs.note_collective("shuffle.all_to_all", payload_bytes)
         if _sp is not None:
             _sp.attrs["shards"] = S
             _sp.attrs["capacity"] = capacity

@@ -60,15 +60,25 @@ def _runtime_error_type() -> type:
         return type("XlaRuntimeError", (RuntimeError,), {})
 
 
-def make_device_error(kind: str) -> BaseException:
+def make_device_error(
+    kind: str, shard_index: Optional[int] = None
+) -> BaseException:
     """A real-typed runtime error whose message classifies as ``kind``
-    (one of 'oom', 'device_lost', 'transient')."""
+    (one of 'oom', 'device_lost', 'transient').
+
+    ``shard_index`` (device_lost only) names ONE lost mesh row shard in
+    the message the way a real runtime names a device; the taxonomy parses
+    it back out and graftmesh recovery re-seats only that shard's slices.
+    """
     if kind not in _FAULT_MESSAGES:
         raise ValueError(
             f"unknown fault kind {kind!r}; expected one of "
             f"{sorted(_FAULT_MESSAGES)} or 'slow_kernel'"
         )
-    return _runtime_error_type()(_FAULT_MESSAGES[kind])
+    message = _FAULT_MESSAGES[kind]
+    if shard_index is not None and kind == "device_lost":
+        message = f"{message} shard_index={int(shard_index)}"
+    return _runtime_error_type()(message)
 
 
 class FaultInjector:
@@ -101,6 +111,7 @@ class FaultInjector:
         times: Optional[int] = 1,
         skip: int = 0,
         slow_s: float = 0.05,
+        shard_index: Optional[int] = None,
     ):
         if kind != "slow_kernel" and kind not in _FAULT_MESSAGES:
             raise ValueError(f"unknown fault kind {kind!r}")
@@ -112,6 +123,7 @@ class FaultInjector:
         self.times = times
         self.skip = skip
         self.slow_s = slow_s
+        self.shard_index = shard_index
         self.injected = 0
         self.calls = 0
         self._lock = threading.Lock()
@@ -129,7 +141,7 @@ class FaultInjector:
         if self.kind == "slow_kernel":
             time.sleep(self.slow_s)
             return
-        raise make_device_error(self.kind)
+        raise make_device_error(self.kind, shard_index=self.shard_index)
 
     def __enter__(self) -> "FaultInjector":
         if resilience._fault_hook is not None:
@@ -181,8 +193,12 @@ class SequencedFaultInjector(FaultInjector):
         steps: Iterable[tuple],
         ops: Iterable[str] = _ENGINE_OPS,
         slow_s: float = 0.05,
+        shard_index: Optional[int] = None,
     ):
-        super().__init__(kind="transient", ops=ops, times=0, slow_s=slow_s)
+        super().__init__(
+            kind="transient", ops=ops, times=0, slow_s=slow_s,
+            shard_index=shard_index,
+        )
         self.steps = [(str(kind), int(count)) for kind, count in steps]
         for kind, count in self.steps:
             if kind != "clean" and kind != "slow_kernel" and kind not in _FAULT_MESSAGES:
@@ -213,17 +229,26 @@ class SequencedFaultInjector(FaultInjector):
         if kind == "slow_kernel":
             time.sleep(self.slow_s)
             return
-        raise make_device_error(kind)
+        raise make_device_error(kind, shard_index=self.shard_index)
 
 
 def midquery_device_loss(
-    after_deploys: int, times: int = 1, ops: Iterable[str] = ("deploy",)
+    after_deploys: int,
+    times: int = 1,
+    ops: Iterable[str] = ("deploy",),
+    shard_index: Optional[int] = None,
 ) -> SequencedFaultInjector:
     """DeviceLost mid-query: after ``after_deploys`` successful dispatches
     the next ``times`` attempts raise UNAVAILABLE, then the (replacement)
-    device answers — the recovery manager's acceptance scenario."""
+    device answers — the recovery manager's acceptance scenario.
+
+    ``shard_index`` kills ONE mesh row shard instead of the whole device:
+    the error names the shard and graftmesh recovery re-seats only that
+    shard's slice of every host-backed column (``recovery.reseat.shard``).
+    """
     return SequencedFaultInjector(
-        [("clean", after_deploys), ("device_lost", times)], ops=ops
+        [("clean", after_deploys), ("device_lost", times)], ops=ops,
+        shard_index=shard_index,
     )
 
 

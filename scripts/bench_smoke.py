@@ -35,6 +35,7 @@ EXPECTED_SECTIONS = (
     "graftplan",
     "recovery",
     "serving",
+    "spmd",
     "shuffle_apply_virtual_mesh",
 )
 
@@ -52,6 +53,7 @@ SMOKE_ENV = {
     # smoke scale the workload is ~10ms and scheduler noise alone flakes it
     "BENCH_RECOVERY_OVERHEAD_PCT": "100",
     "BENCH_APPLY_ROWS": "150000",
+    "BENCH_SPMD_ROWS": "60000",
     "BENCH_SERVING_ROWS": "150000",
     "BENCH_SERVING_QUERIES": "24",
     "BENCH_REPEATS": "1",

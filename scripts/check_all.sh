@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all twelve gates, fail on any red
+#   ./scripts/check_all.sh            # all thirteen gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -41,6 +41,12 @@
 #       bench run must fold through the regression gate green (with git-SHA/
 #       substrate/version provenance on every streamed line), and a 2x wall
 #       inflation of the same run must be rejected
+#   0i. graftmesh spmd smoke: traced sharded sort + merge-join over the
+#       all_to_all shuffle on the 8-device mesh must be bit-exact vs
+#       pandas, the compiled kernel's HLO must carry an all-to-all op
+#       (one fused SPMD program, not per-shard host round-trips), and one
+#       injected SHARD loss must be survived by re-seating only that
+#       shard's slices (recovery.reseat.shard, zero whole-column re-seats)
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -72,6 +78,7 @@ run_gate "graftplan"       python scripts/plan_smoke.py
 run_gate "graftmeter"      python scripts/metrics_smoke.py
 run_gate "graftgate"       python scripts/serving_smoke.py
 run_gate "perf_history"    python scripts/perf_history_smoke.py
+run_gate "graftmesh"       python scripts/spmd_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -81,4 +88,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL TWELVE GATES GREEN"
+echo "ALL THIRTEEN GATES GREEN"

@@ -324,7 +324,11 @@ class TestKernelRouter:
     def test_uncalibrated_routes_device(self):
         KernelRouterMinRows.put(1)
         router.set_calibration(None)
-        router._calibration = False  # remembered calibration failure
+        # remembered calibration failure — mesh-keyed since graftmesh (a
+        # failure under one topology must not poison the next), so the
+        # simulated failure must pin the CURRENT mesh
+        router._calibration = False
+        router._calibration_mesh = router._mesh_key()
         try:
             assert router.decide("median", 100_000_000, ["sort"]) == "device"
         finally:

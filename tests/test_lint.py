@@ -237,6 +237,83 @@ def test_jit_hazard_negative_statics_and_metadata(tmp_path):
     assert not result.findings, [f.render() for f in result.findings]
 
 
+def test_jit_hazard_flags_collective_outside_shard_map(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def bad_reduce(x):
+                # BAD: collective with no shard_map body in sight
+                return jax.lax.psum(x, "rows")
+
+            def make():
+                def fn(x):
+                    # BAD: still outside any shard_map body (plain jit)
+                    return jax.lax.all_to_all(
+                        x, "rows", split_axis=0, concat_axis=0
+                    )
+                return jax.jit(fn)
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert {"collective-psum", "collective-all_to_all"} <= symbols
+
+
+def test_jit_hazard_collective_inside_shard_map_is_clean(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def make(mesh, P):
+                def local_fn(shard):
+                    def route(col):
+                        # ok: nested inside the shard_map body
+                        return jax.lax.all_to_all(
+                            col, "rows", split_axis=0, concat_axis=0
+                        )
+                    total = jax.lax.psum(shard, "rows")  # ok
+                    return route(shard) + total
+                return jax.jit(shard_map(local_fn, mesh=mesh, in_specs=P))
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_jit_hazard_flags_collective_under_traced_conditional(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def make(mesh):
+                def local_fn(shard):
+                    if jnp.sum(shard) > 0:  # BAD: traced branch...
+                        # ...with a collective inside: per-device branch
+                        # divergence deadlocks the rendezvous
+                        shard = jax.lax.psum(shard, "rows")
+                    return shard
+                return shard_map(local_fn, mesh=mesh)
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "local_fn-collective-branch-psum" in symbols
+    # the plain traced-branch finding fires too (same If, distinct symbol)
+    assert "local_fn-branch-if" in symbols
+
+
 def test_jit_hazard_sees_through_shard_map(tmp_path):
     result = lint_tree(
         tmp_path,

@@ -199,6 +199,67 @@ class TestGate:
         assert ph.op_scale_key(run, "apply1") == "rows=11"
         assert ph.op_scale_key(run, "sum") == "rows=100"
 
+    def test_spmd_ops_keyed_by_rows_and_mesh(self):
+        run = {
+            "rows": 100,
+            "scale": {"rows": 100, "spmd_rows": 60000, "spmd_mesh": "8x1"},
+        }
+        assert (
+            ph.op_scale_key(run, "spmd_sort_sharded")
+            == "rows=60000@mesh=8x1"
+        )
+        # the per-mode map form: each leg carries its OWN topology (the
+        # "single" leg genuinely runs on a (1,1) mesh)
+        mapped = {
+            "rows": 100,
+            "scale": {
+                "rows": 100,
+                "spmd_rows": 60000,
+                "spmd_mesh": {
+                    "sharded": "8x1", "local": "8x1", "single": "1x1"
+                },
+            },
+        }
+        assert (
+            ph.op_scale_key(mapped, "spmd_sort_sharded")
+            == "rows=60000@mesh=8x1"
+        )
+        assert (
+            ph.op_scale_key(mapped, "spmd_sort_single")
+            == "rows=60000@mesh=1x1"
+        )
+        # without a recorded mesh the key still isolates (unknown bucket)
+        bare = {"rows": 100, "scale": {"rows": 100, "spmd_rows": 60000}}
+        assert (
+            ph.op_scale_key(bare, "spmd_sort_sharded")
+            == "rows=60000@mesh=unknown"
+        )
+
+    def test_spmd_walls_never_gate_across_mesh_shapes(self):
+        # the same op at the same row count on a 1-dev vs 8-dev mesh is a
+        # different substrate topology: a 100x wall delta must NOT gate
+        ledger = self._ledger_with(
+            {"spmd_sort_sharded": 0.05},
+            extra_scale={"spmd_rows": 60000, "spmd_mesh": "8x1"},
+        )
+        other_mesh = ph.parse_bench_stream(
+            _stream(
+                {"spmd_sort_sharded": 5.0},
+                extra_scale={"spmd_rows": 60000, "spmd_mesh": "1x1"},
+            )
+        )
+        assert ph.check_regression(ledger, other_mesh) == []
+        # same mesh shape DOES gate
+        same_mesh = ph.parse_bench_stream(
+            _stream(
+                {"spmd_sort_sharded": 5.0},
+                extra_scale={"spmd_rows": 60000, "spmd_mesh": "8x1"},
+            )
+        )
+        assert ph.check_regression(ledger, same_mesh), (
+            "a 100x same-mesh spmd regression folded green"
+        )
+
     def test_gs_ops_isolated_by_sort_rows_not_headline(self):
         ledger = self._ledger_with(
             {"gs_median": 0.5}, extra_scale={"sort_rows": 120000}
