@@ -749,6 +749,97 @@ class SpmdMinRows(EnvironmentVariable, type=int):
         super().put(value)
 
 
+class StreamMode(EnvironmentVariable, type=str):
+    """graftstream out-of-core residency routing: resident single-pass
+    kernels vs the windowed streaming executor (modin_tpu/streaming/) for
+    frames/sources larger than the device-memory budget.
+
+    Auto (default): the kernel router's ``decide_residency`` leg decides
+    per op — estimated bytes against the device ledger's headroom; with no
+    ``MODIN_TPU_DEVICE_MEMORY_BUDGET`` set everything stays resident (one
+    attribute read on the hot path).  Resident: never stream.  Windowed:
+    always stream when the op family supports it (tests/bench pin legs).
+    """
+
+    varname = "MODIN_TPU_STREAM"
+    choices = ("Auto", "Resident", "Windowed")
+    default = "Auto"
+
+
+class StreamWindowBytes(EnvironmentVariable, type=int):
+    """Explicit streaming window size in source bytes; 0 (default) derives
+    the window from the device budget so ``1 + prefetch_depth`` windows
+    (plus a 2x kernel working-set allowance) fit under it by construction."""
+
+    varname = "MODIN_TPU_STREAM_WINDOW_BYTES"
+    default = 0
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Stream window bytes should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class StreamPrefetch(EnvironmentVariable, type=int):
+    """Windows prefetched ahead of the consuming kernel (0 = fully serial:
+    parse, deploy, consume, drop, repeat).  The default of 1 double-buffers:
+    window i+1's byte-range parse + host->device transfer overlaps window
+    i's kernel, with the window size shrunk so both stay under budget."""
+
+    varname = "MODIN_TPU_STREAM_PREFETCH"
+    default = 1
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Stream prefetch depth should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class StreamMaxGroups(EnvironmentVariable, type=int):
+    """Bound on the streaming groupby's partial-state table (distinct groups
+    accumulated across windows).  Past it the streaming executor degrades to
+    the resident path — whose high-cardinality groupby already routes
+    through the range_shuffle — instead of growing host state unbounded."""
+
+    varname = "MODIN_TPU_STREAM_MAX_GROUPS"
+    default = 1 << 20
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Stream max groups should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class PlanScanCacheBytes(EnvironmentVariable, type=int):
+    """Byte bound on graftplan's per-origin materialized-scan cache.
+
+    Each cached entry pins a fully materialized query compiler; with
+    out-of-core-sized sources even the old four-entry FIFO was a multi-GB
+    host leak, so eviction is now driven by the entries' measured bytes
+    (coldest-first, ``plan.scan.cache_evict``).  0 disables caching
+    entirely — every force() re-reads."""
+
+    varname = "MODIN_TPU_PLAN_SCAN_CACHE_BYTES"
+    default = 1 << 28
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Plan scan cache bytes should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
 class PlanMode(EnvironmentVariable, type=str):
     """graftplan whole-query deferred planning.
 

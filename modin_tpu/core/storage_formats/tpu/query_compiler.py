@@ -48,6 +48,18 @@ _SHUFFLE_APPLY_MIN_ROWS = 1 << 19
 from modin_tpu.parallel.engine import materialize as _engine_materialize
 from modin_tpu.plan import explain as graftplan_explain
 from modin_tpu.plan import runtime as graftplan
+from modin_tpu import streaming as graftstream
+
+
+def _decide_windowed(op: str, frames: tuple) -> bool:
+    """graftstream residency verdict for an op over concrete frames (the
+    caller has already checked the ``STREAM_ON`` fast path)."""
+    from modin_tpu.ops import router
+    from modin_tpu.streaming import windows as stream_windows
+
+    est = sum(stream_windows.frame_nbytes(f) for f in frames)
+    resident = sum(stream_windows.frame_resident_bytes(f) for f in frames)
+    return router.decide_residency(op, est, resident) == "windowed"
 
 
 class TpuQueryCompiler(BaseQueryCompiler):
@@ -2708,6 +2720,15 @@ class TpuQueryCompiler(BaseQueryCompiler):
     # ------------------------------ merge ----------------------------- #
 
     def merge(self, right: Any, **kwargs: Any) -> "TpuQueryCompiler":
+        if graftstream.STREAM_ON and isinstance(right, TpuQueryCompiler):
+            # graftstream: the residency router, not a flag, sends an
+            # out-of-core join through the spill-aware external merge
+            if _decide_windowed(
+                "merge", (self._modin_frame, right._modin_frame)
+            ):
+                streamed = graftstream.external_merge_qc(self, right, kwargs)
+                if streamed is not None:
+                    return streamed
         result = self._try_device_merge(right, kwargs)
         if result is not None:
             return result
@@ -4600,6 +4621,17 @@ class TpuQueryCompiler(BaseQueryCompiler):
             if planned is not None:
                 return planned
         from modin_tpu.ops import sort as sort_ops
+
+        if graftstream.STREAM_ON and _decide_windowed(
+            "sort", (self._modin_frame,)
+        ):
+            # graftstream: external per-window sort + k-way run merge,
+            # bit-identical to the resident paths below
+            streamed = graftstream.external_sort_qc(
+                self, columns, ascending, kwargs
+            )
+            if streamed is not None:
+                return streamed
 
         range_result = self._try_range_partition_sort(columns, ascending, kwargs)
         if range_result is not None:

@@ -22,6 +22,15 @@ Detection is a per-function forward pass:
 
 Host metadata escapes (``x.shape``, ``x.dtype``, ``jnp.issubdtype``) are
 recognized, so shape arithmetic and dtype dispatch never trip the rule.
+
+**Streaming leg (graftstream):** a function decorated ``@window_body`` is a
+registered window-loop body — it runs once per resident window, and the
+out-of-core budget only holds if it touches nothing but the window handed
+to it.  Whole-column forces of *captured* (closure) state inside one —
+``captured.to_numpy()``, ``materialize(captured)``, ``captured.host_cache``
+— would materialize the full frame from inside the loop, so they are
+flagged; the same sinks over the body's own parameters/locals (the window)
+are the loop's normal work and stay clean.
 """
 
 from __future__ import annotations
@@ -78,6 +87,56 @@ _COERCION_BUILTINS = frozenset({"float", "int", "bool", "complex"})
 def _is_jit_factory_call(func: ast.AST) -> bool:
     """The ``_jit_foo(...)`` half of the ``_jit_foo(...)(cols)`` idiom."""
     return isinstance(func, ast.Name) and func.id.startswith("_jit_")
+
+
+def _is_window_body(fn: ast.AST) -> bool:
+    """Whether ``fn`` carries the ``@window_body`` registration decorator
+    (bare name or any dotted spelling, e.g. ``streaming.window_body``)."""
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        parts = dotted_parts(target)
+        if parts and parts[-1] == "window_body":
+            return True
+    return False
+
+
+def _window_local_names(fn: ast.AST) -> set:
+    """Names bound inside a window-loop body (parameters and every
+    assignment/loop/with/comprehension target): reads of these are the
+    window; reads of anything else are captured whole-frame state."""
+    args = fn.args
+    names = {a.arg for a in args.args + args.kwonlyargs + args.posonlyargs}
+    for special in (args.vararg, args.kwarg):
+        if special is not None:
+            names.add(special.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                names.update(assigned_names(target))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+            names.update(assigned_names(node.target))
+        elif isinstance(node, ast.For):
+            names.update(assigned_names(node.target))
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    names.update(assigned_names(item.optional_vars))
+        elif isinstance(node, ast.comprehension):
+            names.update(assigned_names(node.target))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                names.add(node.name)
+    return names
+
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an attribute/subscript chain, or None (a call
+    result or literal has no stable identity to classify)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
 
 
 class _FunctionState:
@@ -218,6 +277,67 @@ class HostSyncRule(Rule):
                 )
         # 2. dataflow: device-valued expressions reaching coercion sinks
         yield from self._check_scope(ctx, ctx.tree, _FunctionState())
+        # 3. streaming leg: whole-frame forces inside window-loop bodies
+        for node in ast.walk(ctx.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and _is_window_body(node):
+                yield from self._check_window_body(ctx, node)
+
+    # -- streaming leg ---------------------------------------------------- #
+
+    def _check_window_body(
+        self, ctx: FileContext, fn: ast.AST
+    ) -> Iterator[Finding]:
+        local = _window_local_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                sink = self._window_call_sink(node, local)
+                if sink is not None:
+                    yield self._window_finding(ctx, fn, node, sink)
+            elif (
+                isinstance(node, ast.Attribute)
+                and node.attr == "host_cache"
+                and isinstance(node.ctx, ast.Load)
+            ):
+                base = _base_name(node.value)
+                if base is not None and base not in local:
+                    yield self._window_finding(ctx, fn, node, ".host_cache")
+
+    def _window_call_sink(
+        self, call: ast.Call, local: set
+    ) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr in (
+            "to_numpy",
+            "materialize",
+        ):
+            base = _base_name(func.value)
+            if base is not None and base not in local and base != "JaxWrapper":
+                return f".{func.attr}()"
+        parts = dotted_parts(func)
+        if parts and parts[-1] in _MATERIALIZE_NAMES | {"materialize"}:
+            for arg in call.args:
+                base = _base_name(arg)
+                if base is not None and base not in local:
+                    return f"{parts[-1]}()"
+        return None
+
+    def _window_finding(
+        self, ctx: FileContext, fn: ast.AST, node: ast.AST, sink: str
+    ) -> Finding:
+        return Finding(
+            path=ctx.rel,
+            line=node.lineno,
+            rule=self.id,
+            message=f"{sink} forces whole-frame state captured from outside "
+            "the window-loop body (one window must never materialize the "
+            "full frame)",
+            fix_hint="operate only on the window handed to the body; hoist "
+            "whole-column fetches out of the loop or slice them per window",
+            scope=ctx.scope_of(node),
+            symbol=f"stream-{fn.name}-{sink.strip('().')}",
+        )
 
     # -- dataflow pass -------------------------------------------------- #
 

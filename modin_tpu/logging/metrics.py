@@ -272,6 +272,62 @@ METRICS = (
         "of re-parsing the source",
     ),
     (
+        "plan.scan.cache_evict",
+        "counter",
+        "materialized-scan cache entries dropped because the origin's "
+        "measured cached bytes crossed MODIN_TPU_PLAN_SCAN_CACHE_BYTES "
+        "(coldest projection first)",
+    ),
+    (
+        "stream.window.count",
+        "counter",
+        "resident windows completed by the graftstream out-of-core "
+        "executor (scan window loops and external-sort windows)",
+    ),
+    (
+        "stream.window.rows",
+        "counter",
+        "rows processed per streaming window (parse or sort slice)",
+    ),
+    (
+        "stream.window.bytes",
+        "counter",
+        "source bytes parsed per streaming scan window (record-aligned "
+        "byte range)",
+    ),
+    (
+        "stream.window.replay",
+        "counter",
+        "windows replayed after a terminal mid-stream device failure: one "
+        "window's byte range re-parsed and re-run, never the dataset",
+    ),
+    (
+        "stream.prefetch.wait_s",
+        "counter",
+        "seconds the consuming thread waited on the prefetch worker per "
+        "window (0 when the parse fully hid behind the previous kernel)",
+    ),
+    (
+        "stream.prefetch.overlap_s",
+        "counter",
+        "seconds of window parse+deploy wall hidden behind the previous "
+        "window's kernel (parse wall minus consumer wait, floored at 0) — "
+        "the pipelining win the oocore bench measures",
+    ),
+    (
+        "stream.degrade",
+        "counter",
+        "streaming groupbys degraded to the resident (range_shuffle-"
+        "capable) path because the partial-state table crossed "
+        "MODIN_TPU_STREAM_MAX_GROUPS distinct groups",
+    ),
+    (
+        "stream.spill.run_bytes",
+        "counter",
+        "host bytes spilled as sorted runs by the external sort (merge "
+        "keys + row ids per window)",
+    ),
+    (
         "fusion.cache.evict",
         "counter",
         "fused-executable LRU evictions under MODIN_TPU_FUSED_CACHE_SIZE "

@@ -476,6 +476,10 @@ class QueryStats:
         "padding_waste_bytes",
         "collective_bytes",
         "breaker_trips",
+        "stream_windows",
+        "stream_replays",
+        "stream_overlap_s",
+        "stream_wait_s",
         "_t0",
         "_lock",
         "_closed",
@@ -519,6 +523,15 @@ class QueryStats:
         # while this scope's query ran (its own fallbacks included — a
         # query can complete correct via fallback yet be striking paths)
         self.breaker_trips = 0
+        # graftstream: resident windows this query streamed through, window
+        # replays after mid-stream device failures, and the prefetch
+        # overlap/wait split (overlap / (overlap + wait) is the pipeline's
+        # overlap efficiency).  stream_windows > 0 also tells graftgate to
+        # bill this query at its window footprint, not its dataset size.
+        self.stream_windows = 0
+        self.stream_replays = 0
+        self.stream_overlap_s = 0.0
+        self.stream_wait_s = 0.0
         self._t0 = time.perf_counter()
 
     # -- stream routing -------------------------------------------------- #
@@ -564,6 +577,15 @@ class QueryStats:
             self.cache_hits["fused"] += int(value)
         elif name == "plan.scan.cache_hit":
             self.cache_hits["plan_scan"] += int(value)
+        elif name == "stream.window.count":
+            self.stream_windows += int(value)
+            self._sample_hbm()
+        elif name == "stream.window.replay":
+            self.stream_replays += int(value)
+        elif name == "stream.prefetch.overlap_s":
+            self.stream_overlap_s += value
+        elif name == "stream.prefetch.wait_s":
+            self.stream_wait_s += value
         elif name.startswith("recovery."):
             self.recoveries += int(value)
         elif (
@@ -610,6 +632,10 @@ class QueryStats:
             "padding_waste_bytes": self.padding_waste_bytes,
             "collective_bytes": self.collective_bytes,
             "breaker_trips": self.breaker_trips,
+            "stream_windows": self.stream_windows,
+            "stream_replays": self.stream_replays,
+            "stream_overlap_s": self.stream_overlap_s,
+            "stream_wait_s": self.stream_wait_s,
         }
 
     def summary(self) -> str:
@@ -626,6 +652,15 @@ class QueryStats:
             f"cache hits: {hits}",
             self._cost_line(),
         ]
+        if self.stream_windows:
+            busy = self.stream_overlap_s + self.stream_wait_s
+            eff = f"{self.stream_overlap_s / busy:.0%}" if busy > 0 else "?"
+            lines.append(
+                f"stream: {self.stream_windows} window(s), "
+                f"{self.stream_replays} replay(s), overlap efficiency {eff} "
+                f"({self.stream_overlap_s:.3f}s hidden, "
+                f"{self.stream_wait_s:.3f}s waited)"
+            )
         return "\n".join(lines)
 
     def _cost_line(self) -> str:

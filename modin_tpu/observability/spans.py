@@ -123,6 +123,17 @@ SPANS = (
         "(node count in attributes)",
     ),
     (
+        "stream.window",
+        "one graftstream resident window: parse/deploy/consume/drop of a "
+        "record-aligned byte range (scan loop) or one external-sort window "
+        "slice (window index in attributes)",
+    ),
+    (
+        "stream.merge",
+        "one graftstream k-way fold of spilled sorted runs into the final "
+        "permutation (run count in attributes)",
+    ),
+    (
         "serving.admit",
         "one graftgate admission decision: tenant, queue wait, and the "
         "degraded-route flag in attributes; error status means the query "

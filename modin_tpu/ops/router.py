@@ -401,6 +401,66 @@ def decide_layout(
     return choice
 
 
+def decide_residency(op: str, est_bytes: int, self_bytes: int = 0) -> str:
+    """"resident" or "windowed" for one streaming-eligible op (graftstream).
+
+    ``op`` names the family (``scan_reduce`` / ``scan_groupby`` for the
+    windowed plan lowering, ``sort`` / ``merge`` for the external kernels);
+    ``est_bytes`` is the op's estimated working-set (sniffed source size or
+    frame bytes) and ``self_bytes`` the share of the device ledger the op's
+    own inputs already occupy (subtracted so a frame is not counted against
+    its own headroom).  Model: with ``MODIN_TPU_STREAM=Auto`` the op
+    streams exactly when its estimate exceeds the ledger headroom —
+    ``budget - other residents`` — under the configured device budget; no
+    budget means resident always.  ``Resident``/``Windowed`` pin a side
+    (tests, bench legs).
+
+    Emitted as ``router.residency_<op>.<choice>`` metrics and a
+    ``router.decide`` span with the estimate and headroom.
+    """
+    from modin_tpu.config import StreamMode
+    from modin_tpu.core.memory import device_ledger
+
+    mode = StreamMode.get().lower()
+    headroom = None
+    if mode == "resident":
+        choice, reason = "resident", "forced"
+    elif mode == "windowed":
+        choice, reason = "windowed", "forced"
+    else:
+        budget = device_ledger.budget()
+        if budget is None:
+            choice, reason = "resident", "no_budget"
+        else:
+            headroom = budget - max(
+                device_ledger.total_bytes() - max(int(self_bytes), 0), 0
+            )
+            if int(est_bytes) > headroom:
+                choice, reason = "windowed", "over_headroom"
+            else:
+                choice, reason = "resident", "fits"
+    emit_metric(f"router.residency_{op}.{choice}", 1)
+    if graftscope.TRACE_ON:
+        graftscope.finish_span(
+            graftscope.start_span(
+                "router.decide",
+                layer="QUERY-COMPILER",
+                attrs={
+                    "op": f"residency_{op}",
+                    "est_bytes": int(est_bytes),
+                    "choice": choice,
+                    "reason": reason,
+                    **(
+                        {"headroom_bytes": int(headroom)}
+                        if headroom is not None
+                        else {}
+                    ),
+                },
+            )
+        )
+    return choice
+
+
 def forced_host(op: str, n: int) -> bool:
     """True when routing is forced to Host: callers check this BEFORE any
     planning work (device materialization, the min/max histogram probe) so

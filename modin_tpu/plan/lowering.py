@@ -40,11 +40,19 @@ from modin_tpu.plan.ir import (
 
 _tls = threading.local()
 
-#: Materialized reads retained per scan origin (one per distinct projection,
-#: FIFO-evicted): each entry pins a full query compiler's buffers, so the
-#: cache must stay small — a long-lived deferred frame forced under many
-#: different projections re-reads rather than hoard every width it ever saw.
-_SCAN_CACHE_MAX = 4
+
+def _scan_cache_budget() -> int:
+    """Byte bound on each origin's materialized-read cache.
+
+    Entries are (compiler, measured bytes) per distinct projection,
+    FIFO-evicted coldest-first once the measured total crosses
+    ``MODIN_TPU_PLAN_SCAN_CACHE_BYTES`` — a count bound alone let four
+    out-of-core-sized reads pin a multi-GB host/device leak.  0 disables
+    caching entirely.
+    """
+    from modin_tpu.config import PlanScanCacheBytes
+
+    return int(PlanScanCacheBytes.get())
 
 #: One lock for every origin's read cache: concurrent queries (graftgate)
 #: can force plans sharing a Scan origin from several threads, and an
@@ -224,10 +232,10 @@ def _lower_scan(node: Scan, memo: Dict[int, Any]) -> Any:
     with _SCAN_CACHE_LOCK:
         for key, cached in (origin.cache or {}).items():
             if key is None and need is None:
-                hit = cached
+                hit = cached[0]
                 break
             if need is not None and (key is None or set(need) <= set(key)):
-                hit = cached
+                hit = cached[0]
                 break
     if hit is not None:
         emit_metric("plan.scan.cache_hit", 1)
@@ -238,11 +246,20 @@ def _lower_scan(node: Scan, memo: Dict[int, Any]) -> Any:
             "plan.scan.pruned_columns", len(node.all_columns) - len(node.pruned)
         )
     qc = node.dispatcher.read(**kwargs)
-    if origin.cache is not None:
+    budget = _scan_cache_budget()
+    if origin.cache is not None and budget > 0:
+        nbytes = _result_bytes(qc) or 0
+        evicted = 0
         with _SCAN_CACHE_LOCK:
-            while len(origin.cache) >= _SCAN_CACHE_MAX:
-                origin.cache.pop(next(iter(origin.cache)))
-            origin.cache[need] = qc
+            origin.cache[need] = (qc, nbytes)
+            total = sum(b for _qc, b in origin.cache.values())
+            while total > budget and origin.cache:
+                oldest = next(iter(origin.cache))
+                _dropped, dropped_bytes = origin.cache.pop(oldest)
+                total -= dropped_bytes
+                evicted += 1
+        for _ in range(evicted):
+            emit_metric("plan.scan.cache_evict", 1)
     return qc
 
 
@@ -291,16 +308,36 @@ def _lower_map(node: Map, memo: Dict[int, Any]) -> Any:
 
 
 def _lower_reduce(node: Reduce, memo: Dict[int, Any]) -> Any:
+    streamed = _maybe_stream(node, memo, groupby=False)
+    if streamed is not None:
+        return streamed
     child = _lower(node.children[0], memo)
     return getattr(child, node.method)(**node.call_kwargs)
 
 
 def _lower_groupby(node: GroupbyAgg, memo: Dict[int, Any]) -> Any:
+    streamed = _maybe_stream(node, memo, groupby=True)
+    if streamed is not None:
+        return streamed
     child = _lower(node.children[0], memo)
     by = node.by
     if isinstance(by, Ref):
         by = _lower(node.children[by.index], memo)
     return child.groupby_agg(by, node.agg_func, **node.call_kwargs)
+
+
+def _maybe_stream(node: PlanNode, memo: Dict[int, Any], groupby: bool) -> Any:
+    """graftstream residency hook: lower a Reduce/GroupbyAgg root through
+    the windowed out-of-core executor when the chain below it is one
+    streamable scan whose size the residency router judges out-of-core.
+    One attribute read while streaming is off (the default)."""
+    from modin_tpu import streaming
+
+    if not streaming.STREAM_ON:
+        return None
+    if groupby:
+        return streaming.maybe_stream_groupby(node, memo)
+    return streaming.maybe_stream_reduce(node, memo)
 
 
 def _lower_sort(node: Sort, memo: Dict[int, Any]) -> Any:

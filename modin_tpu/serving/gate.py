@@ -526,9 +526,17 @@ def _finish_accounting(
         cost_bytes = 0.0
         trips = 0
         if stats is not None:
-            cost_bytes = float(stats.est_bytes or 0.0) or float(
-                stats.hbm_high_water or 0.0
-            )
+            if getattr(stats, "stream_windows", 0):
+                # graftstream: a windowed query's est_bytes accumulates the
+                # whole dataset's estimated traffic across windows, but its
+                # device footprint is the window double-buffer — bill the
+                # measured HBM high-water so out-of-core queries stop
+                # inflating the tenant's EWMA into auto-shed territory
+                cost_bytes = float(stats.hbm_high_water or 0.0)
+            else:
+                cost_bytes = float(stats.est_bytes or 0.0) or float(
+                    stats.hbm_high_water or 0.0
+                )
             trips = int(getattr(stats, "breaker_trips", 0))
         _tenants.registry.observe(tenant, cost_bytes, wall_s)
         breaker = _tenants.breaker_for(tenant)

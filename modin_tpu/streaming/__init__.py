@@ -1,0 +1,119 @@
+"""graftstream — out-of-core streaming execution.
+
+A frame (or source file) larger than ``MODIN_TPU_DEVICE_MEMORY_BUDGET`` is
+processed in resident **windows** that pipeline read -> deploy -> consume ->
+drop: the next window's byte-range parse and host->device transfer overlap
+the current window's kernel (double-buffered under ``MODIN_TPU_STREAM_PREFETCH``),
+and the window size is derived from the budget so ``1 + prefetch`` resident
+windows — plus a 2x kernel working-set allowance — stay under it by
+construction.  Three legs:
+
+- **windowed scan/reduce/groupby** (:mod:`modin_tpu.streaming.executor`):
+  graftplan lowers ``scan -> filter/map/project -> reduce|groupby_agg``
+  chains into a window loop when the sniffed source size exceeds the device
+  budget, reusing the byte-range readers' record-aligned splits as window
+  boundaries (projection pushdown and pruning still apply per window);
+  reductions recombine through algebraic combiners, groupbys through a
+  bounded partial-state table that degrades to the resident path (whose
+  high-cardinality groupby is the range_shuffle) past
+  ``MODIN_TPU_STREAM_MAX_GROUPS``;
+- **external sort & spill-aware merge-join**
+  (:mod:`modin_tpu.streaming.external`): per-window device sort -> spilled
+  sorted runs on host -> k-way stable merge, bit-identical to the resident
+  ``sort_values``/``merge`` paths and routed by the kernel router's
+  ``decide_residency`` leg (ops/router.py), not a flag;
+- **subsystem integration**: window deploys ride the existing engine seam
+  (resilience retry, graftguard lineage, device-ledger admission), a
+  mid-stream ``DeviceLost`` replays ONE window (``stream.window.replay``),
+  ``stream.*`` spans/metrics land in graftmeter (QueryStats window counts +
+  prefetch overlap), and graftgate bills a streaming query at its window
+  footprint instead of its dataset size.
+
+The operator patterns follow "High Performance Dataframes from Parallel
+Processing Patterns" (arXiv:2209.06146) and "Towards Scalable Dataframe
+Systems" (arXiv:2001.00888): chunked scan/reduce pipelines, external sort,
+incremental aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+#: Module-level fast path (graftscope-style): every streaming hook on an
+#: eager hot path (sort, merge, plan lowering) checks this ONE attribute
+#: before doing any work.  True only when streaming can possibly apply:
+#: ``MODIN_TPU_STREAM=Windowed`` (forced), or Auto with a device-memory
+#: budget configured.  The default (Auto, no budget) costs resident paths
+#: a single attribute read.
+STREAM_ON: bool = False
+
+_BUDGET: Optional[int] = None
+_MODE: str = "Auto"
+
+
+def _refresh(_param: Any = None) -> None:
+    global STREAM_ON
+    STREAM_ON = _MODE == "Windowed" or (_MODE == "Auto" and _BUDGET is not None)
+
+
+def _on_stream_mode(param: Any) -> None:
+    global _MODE
+    _MODE = param.get()
+    _refresh()
+
+
+def _on_budget(param: Any) -> None:
+    global _BUDGET
+    _BUDGET = param.get()
+    _refresh()
+
+
+def window_body(fn):
+    """Mark ``fn`` as a streaming window-loop body.
+
+    A registered body runs once per resident window and must only touch the
+    window handed to it: forcing a whole captured frame (``to_numpy`` /
+    ``materialize`` / ``host_cache`` reads on closure state) would
+    materialize the full dataset from inside the loop and defeat the budget
+    the loop exists to honor.  graftlint's HOST-SYNC streaming leg enforces
+    exactly that statically — the decorator itself is a no-op marker.
+    """
+    fn.__graftstream_window_body__ = True
+    return fn
+
+
+class StreamDegrade(Exception):
+    """The streaming executor cannot finish within its bounds (e.g. the
+    groupby partial-state table exceeded ``MODIN_TPU_STREAM_MAX_GROUPS``);
+    the caller falls back to the resident path."""
+
+
+def __getattr__(name: str) -> Any:
+    # heavy halves load lazily: importing modin_tpu.streaming from the
+    # query compiler / lowering must not drag jax-touching modules in
+    if name in (
+        "maybe_stream_reduce",
+        "maybe_stream_groupby",
+        "window_loop",
+    ):
+        from modin_tpu.streaming import executor
+
+        return getattr(executor, name)
+    if name in ("external_sort_qc", "external_merge_qc"):
+        from modin_tpu.streaming import external
+
+        return getattr(external, name)
+    if name in ("WindowSource", "streamable_read_kwargs", "window_bytes_for"):
+        from modin_tpu.streaming import windows
+
+        return getattr(windows, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+from modin_tpu.config import (  # noqa: E402
+    DeviceMemoryBudget as _DeviceMemoryBudget,
+    StreamMode as _StreamMode,
+)
+
+_StreamMode.subscribe(_on_stream_mode)
+_DeviceMemoryBudget.subscribe(_on_budget)

@@ -37,6 +37,7 @@ EXPECTED_SECTIONS = (
     "serving",
     "spmd",
     "shuffle_apply_virtual_mesh",
+    "oocore",
 )
 
 SMOKE_ENV = {
@@ -54,6 +55,9 @@ SMOKE_ENV = {
     "BENCH_RECOVERY_OVERHEAD_PCT": "100",
     "BENCH_APPLY_ROWS": "150000",
     "BENCH_SPMD_ROWS": "60000",
+    # float-heavy rows (~94 source B/row): the default budget formula
+    # (rows*56//4, 4 MB floor) gives ~6 windows here — streamed, but fast
+    "BENCH_OOCORE_ROWS": "60000",
     "BENCH_SERVING_ROWS": "150000",
     "BENCH_SERVING_QUERIES": "24",
     "BENCH_REPEATS": "1",

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all thirteen gates, fail on any red
+#   ./scripts/check_all.sh            # all fourteen gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -47,6 +47,12 @@
 #       (one fused SPMD program, not per-shard host round-trips), and one
 #       injected SHARD loss must be survived by re-seating only that
 #       shard's slices (recovery.reseat.shard, zero whole-column re-seats)
+#   0j. graftstream oocore smoke: a CSV scan->filter->groupby over a source
+#       >= 4x an artificially tight device budget must complete bit-exact
+#       vs pandas with peak memory.device.resident_bytes <= budget
+#       (QueryStats high-water AND the meter gauge max) and
+#       stream.window.count > 1, and the external sort / merge-join must
+#       answer bit-identically to the resident kernels
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -79,6 +85,7 @@ run_gate "graftmeter"      python scripts/metrics_smoke.py
 run_gate "graftgate"       python scripts/serving_smoke.py
 run_gate "perf_history"    python scripts/perf_history_smoke.py
 run_gate "graftmesh"       python scripts/spmd_smoke.py
+run_gate "graftstream"     python scripts/oocore_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -88,4 +95,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL THIRTEEN GATES GREEN"
+echo "ALL FOURTEEN GATES GREEN"

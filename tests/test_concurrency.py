@@ -246,11 +246,12 @@ def test_fused_chains_bit_exact_under_concurrent_submit():
 
 
 def test_scan_cache_stress_shared_origin(tmp_path):
-    """8 threads force pruned scans sharing ONE origin: the FIFO-bounded
+    """8 threads force pruned scans sharing ONE origin: the byte-bounded
     read cache stays coherent (right columns out, bound held)."""
+    from modin_tpu.config import PlanScanCacheBytes
     from modin_tpu.core.execution.jax_engine.io import TpuCSVDispatcher
     from modin_tpu.plan import ir
-    from modin_tpu.plan.lowering import _SCAN_CACHE_MAX, lower
+    from modin_tpu.plan.lowering import lower
 
     rng = np.random.default_rng(5)
     path = tmp_path / "scan.csv"
@@ -290,7 +291,8 @@ def test_scan_cache_stress_shared_origin(tmp_path):
 
     _run_threads([worker(t) for t in range(THREADS)])
     assert origin.cache is not None
-    assert len(origin.cache) <= _SCAN_CACHE_MAX
+    cached_bytes = sum(b for _qc, b in origin.cache.values())
+    assert cached_bytes <= int(PlanScanCacheBytes.get())
 
 
 # ---------------------------------------------------------------------- #

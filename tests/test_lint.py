@@ -154,6 +154,65 @@ def test_host_sync_negative_materialized_and_metadata(tmp_path):
     assert not result.findings, [f.render() for f in result.findings]
 
 
+def test_host_sync_stream_leg_flags_captured_whole_frame(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            from modin_tpu.parallel.engine import materialize
+            from modin_tpu.streaming import window_body
+
+            def run(frame, source):
+                @window_body
+                def consume(index, qc):
+                    part = qc.to_numpy()        # ok: the window itself
+                    whole = frame.to_numpy()    # BAD: captured frame forced
+                    vals = materialize(frame)   # BAD: captured materialize
+                    cache = frame.host_cache    # BAD: captured host_cache
+                    return part, whole, vals, cache
+                return consume
+            """
+        },
+        select=["HOST-SYNC"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert symbols == {
+        "stream-consume-to_numpy",
+        "stream-consume-materialize",
+        "stream-consume-host_cache",
+    }, [f.render() for f in result.findings]
+
+
+def test_host_sync_stream_leg_negative(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            from modin_tpu.parallel.engine import materialize
+            from modin_tpu.streaming import window_body
+
+            def run(frame, source):
+                whole = frame.to_numpy()  # ok: OUTSIDE the window loop
+
+                @window_body
+                def consume(index, qc):
+                    # the window handed in (and anything derived from it)
+                    # is the body's to force
+                    child = qc.filtered()
+                    vals = child.to_numpy()
+                    host = materialize(vals)
+                    cache = qc.host_cache
+                    for col in child.columns:
+                        piece = col.to_numpy()
+                    return host, cache, piece
+                return consume, whole
+            """
+        },
+        select=["HOST-SYNC"],
+    )
+    assert not result.findings, [f.render() for f in result.findings]
+
+
 def test_host_sync_exempts_seam_modules(tmp_path):
     result = lint_tree(
         tmp_path,

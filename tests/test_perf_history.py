@@ -260,6 +260,73 @@ class TestGate:
             "a 100x same-mesh spmd regression folded green"
         )
 
+    def test_oocore_ops_keyed_by_rows_and_window(self):
+        mapped = {
+            "rows": 100,
+            "scale": {
+                "rows": 100,
+                "oocore_rows": 200000,
+                "oocore_window": {
+                    "stream": 65536, "serial": 65536, "resident": "resident"
+                },
+            },
+        }
+        assert (
+            ph.op_scale_key(mapped, "oocore_stream")
+            == "rows=200000@window=65536"
+        )
+        # the resident leg has no window: its key says so explicitly
+        assert (
+            ph.op_scale_key(mapped, "oocore_resident")
+            == "rows=200000@window=resident"
+        )
+        bare = {"rows": 100, "scale": {"rows": 100, "oocore_rows": 200000}}
+        assert (
+            ph.op_scale_key(bare, "oocore_stream")
+            == "rows=200000@window=unknown"
+        )
+
+    def test_oocore_walls_never_gate_across_window_sizes(self):
+        # the same streamed op at the same row count but a different window
+        # size is a different workload (mirrors the spmd mesh key): a
+        # 100x wall delta must NOT gate; the same window size MUST
+        ledger = self._ledger_with(
+            {"oocore_stream": 0.05},
+            extra_scale={
+                "oocore_rows": 200000, "oocore_window": {"stream": 65536}
+            },
+        )
+        other_window = ph.parse_bench_stream(
+            _stream(
+                {"oocore_stream": 5.0},
+                extra_scale={
+                    "oocore_rows": 200000, "oocore_window": {"stream": 4096}
+                },
+            )
+        )
+        assert ph.check_regression(ledger, other_window) == []
+        resident = ph.parse_bench_stream(
+            _stream(
+                {"oocore_stream": 5.0},
+                extra_scale={
+                    "oocore_rows": 200000,
+                    "oocore_window": {"stream": "resident"},
+                },
+            )
+        )
+        assert ph.check_regression(ledger, resident) == []
+        same_window = ph.parse_bench_stream(
+            _stream(
+                {"oocore_stream": 5.0},
+                extra_scale={
+                    "oocore_rows": 200000, "oocore_window": {"stream": 65536}
+                },
+            )
+        )
+        assert ph.check_regression(ledger, same_window), (
+            "a 100x same-window oocore regression folded green"
+        )
+
     def test_gs_ops_isolated_by_sort_rows_not_headline(self):
         ledger = self._ledger_with(
             {"gs_median": 0.5}, extra_scale={"sort_rows": 120000}

@@ -562,15 +562,18 @@ def test_free_on_pending_plan_drops_and_errors_clearly(csv_path):
 
 def test_scan_read_cache_is_bounded(csv_path):
     """A long-lived deferred read forced under many distinct projections
-    must not hoard one materialized compiler per projection forever."""
-    from modin_tpu.plan.lowering import _SCAN_CACHE_MAX
+    must not hoard one materialized compiler per projection forever: the
+    cache is bounded by its entries' MEASURED bytes
+    (MODIN_TPU_PLAN_SCAN_CACHE_BYTES), evicting coldest-first."""
+    from modin_tpu.config import PlanScanCacheBytes
 
     md = pd.read_csv(csv_path)
     scan = md._query_compiler._plan
     assert isinstance(scan, ir.Scan)
     results = {c: float(md[c].sum()) for c in ("a", "b", "c", "d", "e")}
     assert scan.origin.cache is not None
-    assert len(scan.origin.cache) <= _SCAN_CACHE_MAX
+    cached_bytes = sum(b for _qc, b in scan.origin.cache.values())
+    assert cached_bytes <= int(PlanScanCacheBytes.get())
     with PlanMode.context("Off"):
         eager = pd.read_csv(csv_path)
         for c, value in results.items():

@@ -1,0 +1,698 @@
+"""graftstream acceptance: out-of-core windowed execution.
+
+Four layers:
+
+1. the differential pipeline grid — CSV scan -> filter -> reduce/groupby
+   with the windowed executor FORCED, bit-exact vs pandas AND vs the
+   resident path, including a ragged final window, an all-NaN window, a
+   window landing exactly on a record boundary, empty-after-filter
+   windows, sort=False / series-groupby / dropna=False legs, and the
+   MODIN_TPU_STREAM_MAX_GROUPS degrade;
+2. external kernels — the per-window external sort and the spill-aware
+   merge-join are bit-identical to the resident device paths (and pandas)
+   across dtype/direction/ties/NaN/miss grids;
+3. chaos — ``midquery_device_loss`` and ``oom_burst_until_eviction``
+   injected MID-STREAM complete bit-exact with recovery.* showing a
+   single-WINDOW (not whole-dataset) replay, plus the explicit
+   terminal-failure window-replay legs of the loop itself;
+4. routing/accounting units — ``decide_residency``, window-size
+   derivation, the byte-bounded scan cache (``plan.scan.cache_evict``),
+   QueryStats window fields, and graftgate's window-footprint billing.
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import (
+    DeviceMemoryBudget,
+    PlanScanCacheBytes,
+    ResilienceBackoffS,
+    StreamMaxGroups,
+    StreamMode,
+    StreamPrefetch,
+    StreamWindowBytes,
+)
+from modin_tpu.logging import add_metric_handler, clear_metric_handler
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("graftstream requires TpuOnJax")
+
+
+@pytest.fixture
+def metric_counts():
+    seen = {}
+
+    def handler(name, value):
+        seen[name.replace("modin_tpu.", "", 1)] = (
+            seen.get(name.replace("modin_tpu.", "", 1), 0) + value
+        )
+
+    add_metric_handler(handler)
+    yield seen
+    clear_metric_handler(handler)
+
+
+@pytest.fixture
+def windowed():
+    """Force the windowed executor at a small window so every test frame
+    genuinely streams (multiple windows) without needing huge files."""
+    with StreamMode.context("Windowed"), StreamWindowBytes.context(4096):
+        yield
+
+
+def _csv(tmp_path, df, name="stream.csv"):
+    path = tmp_path / name
+    df.to_csv(path, index=False)
+    return str(path)
+
+
+def _base_df(n=12000, nan_block=False, seed=5):
+    rng = np.random.default_rng(seed)
+    v = rng.integers(0, 1000, n).astype(np.float64) * 0.5
+    if nan_block:
+        # a contiguous NaN region wide enough to cover entire windows at
+        # the 4 KB test window size (several hundred rows per window)
+        v[2000:6000] = np.nan
+    return pandas.DataFrame(
+        {
+            "k": rng.integers(0, 20, n),
+            "a": rng.integers(-50, 50, n),
+            "v": v,
+        }
+    )
+
+
+# ---------------------------------------------------------------------- #
+# 1. the differential pipeline grid
+# ---------------------------------------------------------------------- #
+
+
+class TestStreamedPipelines:
+    @pytest.mark.parametrize("agg", ["sum", "mean", "min", "max", "count"])
+    def test_filter_groupby_bit_exact_and_streamed(
+        self, tmp_path, windowed, metric_counts, agg
+    ):
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        m = pd.read_csv(path)
+        got = getattr(m[m["a"] > 0].groupby("k"), agg)()._to_pandas()
+        expect = getattr(df[df["a"] > 0].groupby("k"), agg)()
+        pandas.testing.assert_frame_equal(got, expect)
+        assert metric_counts.get("stream.window.count", 0) > 1
+
+    @pytest.mark.parametrize("agg", ["sum", "mean", "min", "max", "count", "prod"])
+    def test_filter_reduce_bit_exact(self, tmp_path, windowed, agg):
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        m = pd.read_csv(path)
+        got = getattr(m[m["a"] > 0][["v", "a"]], agg)()._to_pandas()
+        expect = getattr(df[df["a"] > 0][["v", "a"]], agg)()
+        pandas.testing.assert_series_equal(got, expect)
+
+    def test_windowed_meets_resident_bit_for_bit(self, tmp_path):
+        df = _base_df()
+        path = _csv(tmp_path, df)
+
+        def run():
+            m = pd.read_csv(path)
+            return m[m["a"] > 0].groupby("k").sum()._to_pandas()
+
+        with StreamMode.context("Resident"):
+            resident = run()
+        with StreamMode.context("Windowed"), StreamWindowBytes.context(4096):
+            streamed = run()
+        pandas.testing.assert_frame_equal(streamed, resident)
+
+    def test_all_nan_window(self, tmp_path, windowed):
+        df = _base_df(nan_block=True)
+        path = _csv(tmp_path, df)
+        for agg in ("sum", "mean", "min"):
+            m = pd.read_csv(path)
+            got = getattr(m[["v"]], agg)()._to_pandas()
+            pandas.testing.assert_series_equal(got, getattr(df[["v"]], agg)())
+        got = pd.read_csv(path).groupby("k").min()._to_pandas()
+        pandas.testing.assert_frame_equal(got, df.groupby("k").min())
+
+    def test_skipna_false_with_nans(self, tmp_path, windowed):
+        df = _base_df(nan_block=True)
+        path = _csv(tmp_path, df)
+        for agg in ("sum", "mean", "min", "max"):
+            got = getattr(pd.read_csv(path)[["v", "a"]], agg)(
+                skipna=False
+            )._to_pandas()
+            expect = getattr(df[["v", "a"]], agg)(skipna=False)
+            pandas.testing.assert_series_equal(got, expect)
+
+    def test_exact_window_boundary(self, tmp_path, metric_counts):
+        # fixed-width records: every line is exactly 10 bytes, so a
+        # 400-record window target lands PRECISELY on a record boundary
+        n = 4000
+        rng = np.random.default_rng(3)
+        k = rng.integers(0, 9, n)
+        v = rng.integers(0, 9999, n)
+        path = tmp_path / "fixed.csv"
+        with open(path, "w") as f:
+            f.write("k,v\n")
+            for ki, vi in zip(k, v):
+                f.write(f"{ki:04d},{vi:04d}\n")
+        df = pandas.read_csv(path)
+        with StreamMode.context("Windowed"), StreamWindowBytes.context(
+            10 * 400
+        ):
+            got = pd.read_csv(str(path)).groupby("k").sum()._to_pandas()
+        pandas.testing.assert_frame_equal(got, df.groupby("k").sum())
+        assert metric_counts.get("stream.window.count", 0) == (n + 399) // 400
+
+    def test_ragged_final_window(self, tmp_path, windowed, metric_counts):
+        # a prime row count guarantees the last byte window is ragged
+        df = _base_df(n=10007)
+        path = _csv(tmp_path, df)
+        got = pd.read_csv(path).groupby("k").count()._to_pandas()
+        pandas.testing.assert_frame_equal(got, df.groupby("k").count())
+        assert metric_counts.get("stream.window.count", 0) > 1
+
+    def test_sparse_filter_empty_windows(self, tmp_path, windowed):
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        m = pd.read_csv(path)
+        got = m[m["a"] > 48].groupby("k").sum()._to_pandas()
+        pandas.testing.assert_frame_equal(
+            got, df[df["a"] > 48].groupby("k").sum()
+        )
+
+    def test_sort_false_and_series_groupby(self, tmp_path, windowed):
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        got = pd.read_csv(path).groupby("k", sort=False)["v"].sum()._to_pandas()
+        pandas.testing.assert_series_equal(
+            got, df.groupby("k", sort=False)["v"].sum()
+        )
+
+    def test_groupby_dropna_false_nan_keys(self, tmp_path, windowed):
+        df = _base_df()
+        df["k"] = df["k"].astype(np.float64)
+        df.loc[df.index % 7 == 0, "k"] = np.nan
+        path = _csv(tmp_path, df)
+        got = (
+            pd.read_csv(path).groupby("k", dropna=False).sum()._to_pandas()
+        )
+        pandas.testing.assert_frame_equal(
+            got, df.groupby("k", dropna=False).sum()
+        )
+
+    def test_projection_prunes_per_window(self, tmp_path, windowed):
+        # pushdown still applies per window: parse only {a, v}
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        m = pd.read_csv(path)
+        got = m[m["a"] > 0][["v"]].sum()._to_pandas()
+        pandas.testing.assert_series_equal(got, df[df["a"] > 0][["v"]].sum())
+
+    def test_max_groups_degrades_to_resident(
+        self, tmp_path, windowed, metric_counts
+    ):
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        with StreamMaxGroups.context(5):  # 20 real groups crosses it
+            got = pd.read_csv(path).groupby("k").sum()._to_pandas()
+        pandas.testing.assert_frame_equal(got, df.groupby("k").sum())
+        assert metric_counts.get("stream.degrade", 0) >= 1
+
+    def test_serial_prefetch_zero(self, tmp_path, metric_counts):
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        with StreamMode.context("Windowed"), StreamWindowBytes.context(
+            4096
+        ), StreamPrefetch.context(0):
+            got = pd.read_csv(path).groupby("k").sum()._to_pandas()
+        pandas.testing.assert_frame_equal(got, df.groupby("k").sum())
+        assert metric_counts.get("stream.window.count", 0) > 1
+        assert metric_counts.get("stream.prefetch.overlap_s", 0) == 0
+
+    def test_windows_release_device_memory(self, tmp_path, windowed):
+        from modin_tpu.core.memory import device_ledger
+
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        before = device_ledger.total_bytes()
+        got = pd.read_csv(path).groupby("k").sum()._to_pandas()
+        assert len(got) == 20
+        # only the (tiny) result may remain resident — dead windows were
+        # deregistered eagerly, not left to GC
+        assert device_ledger.total_bytes() - before < 1 << 17
+
+    def test_unsupported_agg_stays_resident(
+        self, tmp_path, windowed, metric_counts
+    ):
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        got = pd.read_csv(path)[["v"]].median()._to_pandas()
+        pandas.testing.assert_series_equal(got, df[["v"]].median())
+        assert metric_counts.get("stream.window.count", 0) == 0
+
+
+# ---------------------------------------------------------------------- #
+# 2. external sort & merge-join
+# ---------------------------------------------------------------------- #
+
+
+def _sort_frame(n=9000, key_dtype="float"):
+    rng = np.random.default_rng(11)
+    if key_dtype == "float":
+        key = rng.integers(0, 300, n).astype(np.float64) * 0.5
+        key[rng.random(n) < 0.04] = np.nan
+    else:
+        key = rng.integers(-500, 500, n)
+    return pandas.DataFrame(
+        {
+            "key": key,
+            "pay": rng.integers(0, 1000, n),
+            "w": rng.integers(0, 50, n).astype(np.float64),
+        }
+    )
+
+
+class TestExternalKernels:
+    @pytest.mark.parametrize("key_dtype", ["float", "int"])
+    @pytest.mark.parametrize("ascending", [True, False])
+    def test_external_sort_bit_identical(
+        self, windowed, metric_counts, key_dtype, ascending
+    ):
+        df = _sort_frame(key_dtype=key_dtype)
+        mdf = pd.DataFrame(df)
+        with StreamMode.context("Resident"):
+            resident = mdf.sort_values("key", ascending=ascending)._to_pandas()
+        with StreamMode.context("Windowed"), StreamWindowBytes.context(4096):
+            streamed = mdf.sort_values("key", ascending=ascending)._to_pandas()
+        pandas.testing.assert_frame_equal(streamed, resident)
+        pandas.testing.assert_frame_equal(
+            streamed,
+            df.sort_values("key", ascending=ascending, kind="stable"),
+        )
+        assert metric_counts.get("stream.window.count", 0) > 1
+        assert metric_counts.get("stream.spill.run_bytes", 0) > 0
+
+    def test_external_sort_ignore_index(self, windowed):
+        df = _sort_frame(key_dtype="int")
+        got = pd.DataFrame(df).sort_values("key", ignore_index=True)._to_pandas()
+        pandas.testing.assert_frame_equal(
+            got, df.sort_values("key", kind="stable", ignore_index=True)
+        )
+
+    def test_external_sort_heavy_ties_stable(self, windowed):
+        rng = np.random.default_rng(2)
+        df = pandas.DataFrame(
+            {"key": rng.integers(0, 3, 8000), "pay": np.arange(8000)}
+        )
+        got = pd.DataFrame(df).sort_values("key")._to_pandas()
+        pandas.testing.assert_frame_equal(
+            got, df.sort_values("key", kind="stable")
+        )
+
+    def test_multikey_declines_to_resident(self, windowed):
+        df = _sort_frame(key_dtype="int")
+        got = pd.DataFrame(df).sort_values(["key", "pay"])._to_pandas()
+        pandas.testing.assert_frame_equal(
+            got, df.sort_values(["key", "pay"], kind="stable")
+        )
+
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    def test_external_merge_bit_identical(self, windowed, how):
+        rng = np.random.default_rng(4)
+        left = pandas.DataFrame(
+            {"k": rng.integers(0, 150, 9000), "lv": rng.integers(0, 100, 9000)}
+        )
+        right = pandas.DataFrame(
+            {
+                "k": rng.integers(0, 150, 3000),
+                "rv": rng.integers(0, 100, 3000),
+            }
+        )
+        ml, mr = pd.DataFrame(left), pd.DataFrame(right)
+        with StreamMode.context("Resident"):
+            resident = ml.merge(mr, on="k", how=how)._to_pandas()
+        with StreamMode.context("Windowed"), StreamWindowBytes.context(4096):
+            streamed = ml.merge(mr, on="k", how=how)._to_pandas()
+        pandas.testing.assert_frame_equal(streamed, resident)
+        pandas.testing.assert_frame_equal(
+            streamed, left.merge(right, on="k", how=how)
+        )
+
+    def test_external_left_merge_misses_promote(self, windowed):
+        rng = np.random.default_rng(6)
+        left = pandas.DataFrame(
+            {"k": rng.integers(0, 200, 8000), "lv": rng.integers(0, 9, 8000)}
+        )
+        right = pandas.DataFrame(
+            {"k": rng.integers(0, 40, 2500), "rv": rng.integers(0, 9, 2500)}
+        )
+        got = (
+            pd.DataFrame(left)
+            .merge(pd.DataFrame(right), on="k", how="left")
+            ._to_pandas()
+        )
+        expect = left.merge(right, on="k", how="left")
+        pandas.testing.assert_frame_equal(got, expect)
+        assert expect["rv"].dtype == np.float64  # misses promoted
+
+    def test_external_merge_nan_keys_match(self, windowed):
+        rng = np.random.default_rng(8)
+        lk = rng.integers(0, 60, 7000).astype(np.float64)
+        lk[rng.random(7000) < 0.03] = np.nan
+        rk = rng.integers(0, 60, 2000).astype(np.float64)
+        rk[rng.random(2000) < 0.03] = np.nan
+        left = pandas.DataFrame({"k": lk, "lv": np.arange(7000)})
+        right = pandas.DataFrame({"k": rk, "rv": np.arange(2000)})
+        got = (
+            pd.DataFrame(left)
+            .merge(pd.DataFrame(right), on="k", how="inner")
+            ._to_pandas()
+        )
+        pandas.testing.assert_frame_equal(
+            got, left.merge(right, on="k", how="inner")
+        )
+
+    def test_external_merge_preserves_string_dtype(self, windowed):
+        rng = np.random.default_rng(12)
+        left = pandas.DataFrame(
+            {"k": rng.integers(0, 60, 7000), "lv": rng.integers(0, 9, 7000)}
+        )
+        right = pandas.DataFrame(
+            {
+                "k": rng.integers(0, 60, 2500),
+                "tag": pandas.array(
+                    rng.choice(["x", "y", "z"], 2500), dtype="string"
+                ),
+            }
+        )
+        ml, mr = pd.DataFrame(left), pd.DataFrame(right)
+        with StreamMode.context("Resident"):
+            resident = ml.merge(mr, on="k", how="inner")._to_pandas()
+        with StreamMode.context("Windowed"), StreamWindowBytes.context(4096):
+            streamed = ml.merge(mr, on="k", how="inner")._to_pandas()
+        # the binding contract is bit-identity WITH THE RESIDENT PATH
+        # (dtype included — the miss-free gather must not degrade string
+        # columns to object when the resident path would not); values also
+        # match pandas, whose extension-dtype preservation is the
+        # documented pre-existing str-extension divergence family
+        pandas.testing.assert_frame_equal(streamed, resident)
+        assert streamed["tag"].dtype == resident["tag"].dtype
+        pandas.testing.assert_frame_equal(
+            streamed,
+            left.merge(right, on="k", how="inner"),
+            check_dtype=False,
+        )
+
+    def test_external_merge_empty_result(self, windowed):
+        left = pandas.DataFrame(
+            {"k": np.arange(6000), "lv": np.arange(6000)}
+        )
+        right = pandas.DataFrame(
+            {"k": np.arange(6000) + 10_000_000, "rv": np.arange(6000)}
+        )
+        got = (
+            pd.DataFrame(left)
+            .merge(pd.DataFrame(right), on="k", how="inner")
+            ._to_pandas()
+        )
+        pandas.testing.assert_frame_equal(
+            got, left.merge(right, on="k", how="inner")
+        )
+
+
+# ---------------------------------------------------------------------- #
+# 3. chaos
+# ---------------------------------------------------------------------- #
+
+
+class TestChaos:
+    def test_midquery_device_loss_single_window_recovery(
+        self, tmp_path, windowed, metric_counts
+    ):
+        from modin_tpu.testing.faults import midquery_device_loss
+
+        df = _base_df(16000)
+        path = _csv(tmp_path, df)
+        expect = df[df["a"] > 0].groupby("k").sum()
+        with ResilienceBackoffS.context(0.0):
+            with midquery_device_loss(after_deploys=8, times=1) as inj:
+                m = pd.read_csv(path)
+                got = m[m["a"] > 0].groupby("k").sum()._to_pandas()
+        pandas.testing.assert_frame_equal(got, expect)
+        assert inj.injected == 1
+        assert metric_counts.get("recovery.device_lost", 0) >= 1
+        windows = metric_counts.get("stream.window.count", 0)
+        assert windows > 10
+        # single-WINDOW recovery: only the live window's columns (plus at
+        # most the prefetched neighbor and the handful of result columns)
+        # were re-seated — a whole-dataset replay would re-seat one column
+        # per window per source column (3 * windows)
+        reseats = sum(
+            v
+            for k, v in metric_counts.items()
+            if k.startswith("recovery.reseat.")
+        )
+        assert 1 <= reseats < windows
+
+    def test_oom_burst_mid_stream_absorbed(
+        self, tmp_path, windowed, metric_counts
+    ):
+        from modin_tpu.testing.faults import oom_burst_until_eviction
+
+        df = _base_df(16000)
+        path = _csv(tmp_path, df)
+        expect = df[df["a"] > 0].groupby("k").sum()
+        with ResilienceBackoffS.context(0.0):
+            with oom_burst_until_eviction(spills=1) as inj:
+                m = pd.read_csv(path)
+                got = m[m["a"] > 0].groupby("k").sum()._to_pandas()
+        pandas.testing.assert_frame_equal(got, expect)
+        assert inj.injected >= 1
+        assert metric_counts.get("memory.device.spill", 0) >= 1
+        assert metric_counts.get("stream.window.count", 0) > 10
+
+    def test_terminal_consume_failure_replays_one_window(
+        self, tmp_path, windowed, metric_counts
+    ):
+        from modin_tpu.core.execution.jax_engine.io import TpuCSVDispatcher
+        from modin_tpu.core.execution.resilience import DeviceLost
+        from modin_tpu.streaming import executor, windows as stream_windows
+
+        path = _csv(tmp_path, _base_df(8000))
+        source = stream_windows.WindowSource(
+            TpuCSVDispatcher, {"filepath_or_buffer": path}, 2048
+        )
+        assert len(source) > 3
+        failed = []
+        consumed = []
+
+        def consume(index, qc):
+            if index == 2 and not failed:
+                failed.append(True)
+                raise DeviceLost("injected terminal mid-window loss")
+            consumed.append(index)
+
+        executor.window_loop(source, consume)
+        assert sorted(consumed) == list(range(len(source)))
+        assert metric_counts.get("stream.window.replay", 0) == 1
+        assert metric_counts.get("stream.window.count", 0) == len(source)
+
+    def test_terminal_prefetch_failure_finishes_serially(
+        self, tmp_path, windowed, metric_counts, monkeypatch
+    ):
+        from modin_tpu.core.execution.jax_engine.io import TpuCSVDispatcher
+        from modin_tpu.core.execution.resilience import DeviceLost
+        from modin_tpu.streaming import executor, windows as stream_windows
+
+        path = _csv(tmp_path, _base_df(8000))
+        source = stream_windows.WindowSource(
+            TpuCSVDispatcher, {"filepath_or_buffer": path}, 2048
+        )
+        real_parse = source.parse_window
+        failed = []
+
+        def flaky_parse(index):
+            if index == 3 and not failed:
+                failed.append(True)
+                raise DeviceLost("injected prefetch-side loss")
+            return real_parse(index)
+
+        monkeypatch.setattr(source, "parse_window", flaky_parse)
+        consumed = []
+        executor.window_loop(source, lambda i, qc: consumed.append(i))
+        assert sorted(consumed) == list(range(len(source)))
+        assert metric_counts.get("stream.window.replay", 0) == 1
+
+    def test_mid_consume_replay_does_not_double_count(
+        self, tmp_path, windowed, metric_counts, monkeypatch
+    ):
+        """A terminal loss AFTER a window's sum partial was recorded but
+        BEFORE its count partial replays the window; partial state is keyed
+        by window index, so the replay overwrites instead of appending —
+        the mean must stay bit-exact (the old append-based state double-
+        counted the window's sum)."""
+        import modin_tpu.core.storage_formats.tpu.query_compiler as qcmod
+        from modin_tpu.core.execution.resilience import DeviceLost
+
+        df = _base_df(8000)
+        path = _csv(tmp_path, df)
+        orig = qcmod.TpuQueryCompiler.groupby_agg
+        state = {"count_calls": 0, "tripped": False}
+
+        def flaky(self, by, agg_func, *args, **kwargs):
+            result = orig(self, by, agg_func, *args, **kwargs)
+            if agg_func == "count" and not state["tripped"]:
+                state["count_calls"] += 1
+                if state["count_calls"] == 1:
+                    state["tripped"] = True
+                    raise DeviceLost(
+                        "injected after the window's sum partial landed"
+                    )
+            return result
+
+        monkeypatch.setattr(qcmod.TpuQueryCompiler, "groupby_agg", flaky)
+        got = pd.read_csv(path).groupby("k").mean()._to_pandas()
+        pandas.testing.assert_frame_equal(got, df.groupby("k").mean())
+        assert state["tripped"]
+        assert metric_counts.get("stream.window.replay", 0) == 1
+
+    def test_non_device_errors_propagate(self, tmp_path, windowed):
+        from modin_tpu.core.execution.jax_engine.io import TpuCSVDispatcher
+        from modin_tpu.streaming import executor, windows as stream_windows
+
+        path = _csv(tmp_path, _base_df(6000))
+        source = stream_windows.WindowSource(
+            TpuCSVDispatcher, {"filepath_or_buffer": path}, 2048
+        )
+
+        def consume(index, qc):
+            if index == 1:
+                raise ValueError("not a device problem")
+
+        with pytest.raises(ValueError, match="not a device problem"):
+            executor.window_loop(source, consume)
+
+
+# ---------------------------------------------------------------------- #
+# 4. routing & accounting units
+# ---------------------------------------------------------------------- #
+
+
+class TestRoutingAndAccounting:
+    def test_decide_residency_forced_and_auto(self, metric_counts):
+        from modin_tpu.ops import router
+
+        with StreamMode.context("Resident"):
+            assert router.decide_residency("sort", 1 << 60) == "resident"
+        with StreamMode.context("Windowed"):
+            assert router.decide_residency("sort", 1) == "windowed"
+        with StreamMode.context("Auto"):
+            if DeviceMemoryBudget.get() is None:  # the tier-1 default
+                assert router.decide_residency("sort", 1 << 60) == "resident"
+            with DeviceMemoryBudget.context(1 << 20):
+                assert router.decide_residency("sort", 1 << 30) == "windowed"
+                assert router.decide_residency("sort", 1 << 10) == "resident"
+        assert metric_counts.get("router.residency_sort.windowed", 0) >= 2
+        assert metric_counts.get("router.residency_sort.resident", 0) >= 3
+
+    def test_decide_residency_self_bytes_discount(self):
+        from modin_tpu.ops import router
+
+        with StreamMode.context("Auto"), DeviceMemoryBudget.context(1 << 20):
+            # an estimate just under budget fits when the op's own inputs
+            # are discounted from the ledger total
+            est = (1 << 20) - 1
+            assert (
+                router.decide_residency("merge", est, self_bytes=est)
+                == router.decide_residency("merge", est, self_bytes=est)
+            )
+
+    def test_window_bytes_derivation(self):
+        from modin_tpu.streaming import windows
+
+        with StreamWindowBytes.context(12345):
+            assert windows.window_bytes_for(1) == 12345
+        with StreamWindowBytes.context(0):
+            with DeviceMemoryBudget.context(1 << 26):
+                # budget // (2 * expansion(4) * (1 + prefetch))
+                assert windows.window_bytes_for(1) == (1 << 26) // 16
+                assert windows.window_bytes_for(0) == (1 << 26) // 8
+            if DeviceMemoryBudget.get() is None:  # the tier-1 default
+                assert windows.window_bytes_for(1) == windows._MIN_WINDOW_BYTES
+
+    def test_pow2_bucket(self):
+        from modin_tpu.streaming.windows import pow2_bucket
+
+        assert pow2_bucket(0) == 1024
+        assert pow2_bucket(1000) == 1024
+        assert pow2_bucket(1024) == 1024
+        assert pow2_bucket(1025) == 2048
+        assert pow2_bucket(100_000) == 1 << 17
+
+    def test_scan_cache_evicts_by_bytes(self, tmp_path, metric_counts):
+        df = _base_df(4000)
+        path = _csv(tmp_path, df)
+        with PlanScanCacheBytes.context(1):
+            got = pd.read_csv(path)[["v"]].sum()._to_pandas()
+        pandas.testing.assert_series_equal(got, df[["v"]].sum())
+        # a 1-byte bound evicts every materialized entry immediately
+        assert metric_counts.get("plan.scan.cache_evict", 0) >= 1
+
+    def test_scan_cache_zero_disables_caching(self, tmp_path, metric_counts):
+        df = _base_df(4000)
+        path = _csv(tmp_path, df)
+        with PlanScanCacheBytes.context(0):
+            got = pd.read_csv(path)[["v"]].sum()._to_pandas()
+        pandas.testing.assert_series_equal(got, df[["v"]].sum())
+        assert metric_counts.get("plan.scan.cache_evict", 0) == 0
+        assert metric_counts.get("plan.scan.cache_hit", 0) == 0
+
+    def test_query_stats_window_fields(self, tmp_path, windowed):
+        from modin_tpu.observability import meters as graftmeter
+
+        df = _base_df()
+        path = _csv(tmp_path, df)
+        with graftmeter.query_stats("stream-test") as stats:
+            m = pd.read_csv(path)
+            m[m["a"] > 0].groupby("k").sum()._to_pandas()
+        assert stats.stream_windows > 1
+        assert stats.stream_replays == 0
+        assert stats.stream_overlap_s >= 0.0
+        rolled = stats.as_dict()
+        assert rolled["stream_windows"] == stats.stream_windows
+        assert "stream_overlap_s" in rolled
+        assert stats.hbm_high_water > 0
+        assert "stream:" in stats.summary()
+
+    def test_gate_bills_window_footprint_not_dataset(self):
+        from modin_tpu.observability.meters import QueryStats
+        from modin_tpu.serving import gate as serving_gate
+        from modin_tpu.serving import tenants as _tenants
+
+        streamed = QueryStats("s")
+        streamed.est_bytes = 10.0 ** 12  # dataset-scale traffic estimate
+        streamed.hbm_high_water = 4096  # the real window footprint
+        streamed.stream_windows = 7
+        serving_gate._finish_accounting(
+            "stream_bill_tenant_a", streamed, 0.1, None
+        )
+        billed = _tenants.registry.cost_estimate("stream_bill_tenant_a", 0.0)
+        assert billed < 10.0 ** 6, billed
+
+        resident = QueryStats("r")
+        resident.est_bytes = 10.0 ** 12
+        resident.hbm_high_water = 4096
+        serving_gate._finish_accounting(
+            "stream_bill_tenant_b", resident, 0.1, None
+        )
+        billed_resident = _tenants.registry.cost_estimate(
+            "stream_bill_tenant_b", 0.0
+        )
+        assert billed_resident > 10.0 ** 9, billed_resident
