@@ -99,6 +99,12 @@ SORT_ROWS = int(os.environ.get("BENCH_SORT_ROWS", 10_000_000))
 # run-provenance scale record keys the perf-history regression gate, so the
 # recorded value and the value the section actually uses must be one)
 PLAN_ROWS = int(os.environ.get("BENCH_PLAN_ROWS", 2_000_000))
+# graftfuse fusion section: the plan_smoke pipeline under Fused vs Staged
+# vs eager vs pandas, with dispatch/compile counts and the QueryStats HBM
+# high-water per leg (the donation reduction is the headline claim).  Ops
+# fold into PERF_HISTORY.json keyed rows=N@fuse=<mode> so fused and staged
+# walls never gate against each other.
+FUSE_ROWS = int(os.environ.get("BENCH_FUSE_ROWS", 2_000_000))
 RECOVERY_ROWS = int(os.environ.get("BENCH_RECOVERY_ROWS", 2_000_000))
 APPLY_ROWS = int(os.environ.get("BENCH_APPLY_ROWS", 10_000_000))
 # graftmesh spmd section: sharded (all_to_all) vs single-shard vs pandas
@@ -230,6 +236,7 @@ def _run_provenance(platform: str) -> dict:
             "udf_rows": UDF_ROWS,
             "sort_rows": SORT_ROWS,
             "plan_rows": PLAN_ROWS,
+            "fuse_rows": FUSE_ROWS,
             "recovery_rows": RECOVERY_ROWS,
             "apply_rows": APPLY_ROWS,
             "serving_rows": SERVING_ROWS,
@@ -1186,6 +1193,139 @@ def main() -> None:
         }
         return sections["graftplan"]
 
+    # ---- graftfuse: whole-plan fused vs staged vs eager vs pandas ---- #
+    def fusion_section():
+        """The plan_smoke pipeline with the compile router pinned per leg:
+        Fused (one donated whole-plan program), Staged (mask-fused
+        compaction + trim-fused reduction), eager (Plan=Off), pandas.
+        Every modin leg records its compile-ledger dispatch/compile counts
+        and its QueryStats HBM high-water — the fused leg's reduction is
+        the buffer-donation claim, measured not asserted."""
+        import tempfile as _tempfile
+
+        from modin_tpu.config import FuseMode, PlanMode, TraceEnabled
+        from modin_tpu.observability import meters as _graftmeter
+        from modin_tpu.observability.compile_ledger import get_compile_ledger
+
+        n = FUSE_ROWS
+        csv_path = os.path.join(
+            _tempfile.mkdtemp(prefix="graftfuse_bench_"), "fuse.csv"
+        )
+        pandas.DataFrame(
+            {
+                "a": rng.integers(-50, 50, n),
+                "b": rng.uniform(0, 1, n),
+                "c": rng.uniform(-1, 1, n),
+                "d": rng.integers(0, 1000, n),
+                "e": rng.uniform(0, 100, n),
+                "f": rng.integers(0, 2, n),
+            }
+        ).to_csv(csv_path, index=False)
+
+        def pipeline_modin():
+            out = pd.read_csv(csv_path).query("a > 0")[["b", "c"]].agg("sum")
+            execute_modin(out)
+
+        legs = {
+            "fused": ("Auto", "Fused"),
+            "staged": ("Auto", "Staged"),
+            "eager": ("Off", "Staged"),
+        }
+        ledger = get_compile_ledger()
+        plan_before, fuse_before = PlanMode.get(), FuseMode.get()
+        trace_before = TraceEnabled.get()
+        timings, dispatches, compiles, hbm, stats_extra = {}, {}, {}, {}, {}
+        TraceEnabled.put(True)  # dispatch billing needs the ledger listener
+        try:
+            for leg, (plan_mode, fuse_mode) in legs.items():
+                PlanMode.put(plan_mode)
+                FuseMode.put(fuse_mode)
+                pipeline_modin()  # warm compiles outside the timer
+                best = float("inf")
+                for _ in range(max(repeats, 2)):
+                    # plan graphs are cyclic: collect the previous run's
+                    # columns so the high-water measures THIS leg's peak,
+                    # not residue pinned from earlier legs
+                    import gc
+
+                    gc.collect()
+                    ledger.reset()
+                    with _graftmeter.query_stats(f"bench.fusion.{leg}") as st:
+                        t0 = time.perf_counter()
+                        pipeline_modin()
+                        wall = time.perf_counter() - t0
+                    if wall < best:
+                        best = wall
+                        snap = ledger.snapshot()
+                        dispatches[leg] = sum(
+                            e["dispatches"] for e in snap["signatures"].values()
+                        )
+                        compiles[leg] = snap["total_compiles"]
+                        stats_extra[leg] = {
+                            "fused_dispatches": st.fused_dispatches,
+                            "donated_bytes": st.donated_bytes,
+                        }
+                timings[leg] = best
+                # session high-water: two back-to-back pipelines in ONE
+                # stats scope.  Donation consumes query 1's inputs at its
+                # dispatch, so query 2's peak starts from zero; the staged
+                # leg still pins query 1's columns (cyclic plan graphs
+                # hold them past refcounting) when query 2 samples — the
+                # HBM reduction donation actually buys a session
+                import gc
+
+                gc.collect()
+                with _graftmeter.query_stats(f"bench.fusion.hbm.{leg}") as st2:
+                    pipeline_modin()
+                    pipeline_modin()
+                hbm[leg] = st2.hbm_high_water
+        finally:
+            PlanMode.put(plan_before)
+            FuseMode.put(fuse_before)
+            TraceEnabled.put(trace_before)
+
+        best_pandas = float("inf")
+        for _ in range(max(repeats, 2)):
+            t0 = time.perf_counter()
+            pandas.read_csv(csv_path).query("a > 0")[["b", "c"]].agg("sum")
+            best_pandas = min(best_pandas, time.perf_counter() - t0)
+
+        import shutil
+
+        shutil.rmtree(os.path.dirname(csv_path), ignore_errors=True)
+        for leg in legs:
+            entry = {
+                "modin_tpu_s": round(timings[leg], 4),
+                "pandas_s": round(best_pandas, 4),
+                "speedup": round(best_pandas / max(timings[leg], 1e-9), 2),
+            }
+            detail[f"fusion_{leg}"] = entry
+        sections["fusion"] = {
+            "rows": n,
+            "fused_s": round(timings["fused"], 4),
+            "staged_s": round(timings["staged"], 4),
+            "eager_s": round(timings["eager"], 4),
+            "pandas_s": round(best_pandas, 4),
+            "fused_vs_staged_x": round(
+                timings["staged"] / max(timings["fused"], 1e-9), 2
+            ),
+            "speedup_vs_pandas": round(
+                best_pandas / max(timings["fused"], 1e-9), 2
+            ),
+            "dispatches_fused": dispatches["fused"],
+            "dispatches_staged": dispatches["staged"],
+            "compiles_fused": compiles["fused"],
+            "compiles_staged": compiles["staged"],
+            "hbm_high_water_fused": hbm["fused"],
+            "hbm_high_water_staged": hbm["staged"],
+            "fused_dispatches": stats_extra["fused"]["fused_dispatches"],
+            "donated_bytes": stats_extra["fused"]["donated_bytes"],
+            "fused_ge_staged_ok": timings["fused"] <= timings["staged"],
+            "hbm_reduction_ok": hbm["fused"] < hbm["staged"],
+            "dispatch_budget_ok": dispatches["fused"] <= 1,
+        }
+        return sections["fusion"]
+
     # ---- graftguard: lineage overhead + spill/restore throughput ---- #
     def recovery_section():
         """Steady-state cost of lineage recording (must be ~0: no failure
@@ -1445,6 +1585,7 @@ def main() -> None:
         ("host_udf", host_udf_section),
         ("graftsort", graftsort_section),
         ("graftplan", graftplan_section),
+        ("fusion", fusion_section),
         ("recovery", recovery_section),
         ("serving", serving_section),
         ("spmd", spmd_section),

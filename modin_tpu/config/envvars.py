@@ -896,6 +896,37 @@ class FusedCacheSize(EnvironmentVariable, type=int):
         super().put(value)
 
 
+class FuseMode(EnvironmentVariable, type=str):
+    """graftfuse whole-plan compilation: compile the entire post-scan
+    segment of an optimized plan (filter/map/project chain plus its
+    reduce or groupby_agg tail) into ONE donated, bucket-padded XLA
+    program (plan/fuse.py).
+
+    Auto (default): the kernel router's ``decide_compile`` leg decides per
+    materialization — frames below ``MODIN_TPU_FUSE_MIN_ROWS`` stay on the
+    staged path, where per-op trace cost beats the dispatch savings.
+    Staged: never fuse across the filter boundary (the pre-graftfuse
+    lowering).  Fused: always fuse where the segment shape supports it
+    (tests and bench legs pin sides).
+    """
+
+    varname = "MODIN_TPU_FUSE"
+    choices = ("Auto", "Staged", "Fused")
+    default = "Auto"
+
+
+class FuseMinRows(EnvironmentVariable, type=int):
+    """Row floor for the Auto fused-compilation decision (graftfuse).
+
+    Below it, ``decide_compile`` keeps the staged path: tracing and
+    compiling a whole-plan program costs milliseconds, which a tiny
+    frame's saved dispatch never earns back — and unit-test-sized frames
+    stay deterministically on the staged kernels."""
+
+    varname = "MODIN_TPU_FUSE_MIN_ROWS"
+    default = 32768
+
+
 class MetersEnabled(EnvironmentVariable, type=bool):
     """graftmeter in-process metric aggregation: counters, gauges, and
     fixed-bucket histograms over the ``emit_metric`` stream, with

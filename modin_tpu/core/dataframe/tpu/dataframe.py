@@ -107,7 +107,7 @@ class DeviceColumn:
 
     __slots__ = (
         "_data", "pandas_dtype", "length", "host_cache", "_ledger_key",
-        "lineage", "_device_epoch", "_dev_key", "_sorted_rep",
+        "lineage", "_device_epoch", "_dev_key", "_sorted_rep", "donated",
         "__weakref__",
     )
     is_device = True
@@ -131,6 +131,7 @@ class DeviceColumn:
         self._device_epoch = 0
         self._dev_key = None
         self._sorted_rep = None  # graftsort: cached (sorted, n_valid) rep
+        self.donated = False  # graftfuse: buffer consumed by a donated dispatch
         if host_cache is not None:
             # host caches count against the Memory spill budget (core/memory.py)
             from modin_tpu.core.memory import ledger
@@ -187,6 +188,7 @@ class DeviceColumn:
 
         device_ledger.register(self)
         self._device_epoch = recovery.current_epoch()
+        self.donated = False  # a fresh buffer: the donation is history
         recovery.note_column_data(self)
 
     def _on_materialized(self) -> None:
@@ -230,16 +232,67 @@ class DeviceColumn:
             self.adopt_host_cache(cache)
         return freed
 
+    # -- graftfuse: buffer donation ------------------------------------- #
+
+    def donation_eligible(self) -> bool:
+        """The LOCAL half of the donation proof: a concrete resident
+        buffer with an exact host copy to restore from (the lineage-replay
+        contract: after donation the column is *spilled*, and the next
+        access transparently re-uploads).  The sole-consumer half comes
+        from the device ledger — ``donation_safe`` for one column,
+        ``buffer_consumer_counts`` for a whole dispatch's batch."""
+        return (
+            self._data is not None
+            and not self.is_lazy
+            and self.host_cache is not None
+        )
+
+    def donation_safe(self) -> bool:
+        """Whether this column's buffer may ride in a donated jit position:
+        :meth:`donation_eligible` plus the device ledger's proof that no
+        OTHER live column holds the same buffer — donating a shared buffer
+        would delete it under its other owner mid-use."""
+        if not self.donation_eligible():
+            return False
+        from modin_tpu.core.memory import device_ledger
+
+        return device_ledger.buffer_consumers(self._data) == 1
+
+    def mark_donated(self) -> int:
+        """Record that a donated dispatch consumed this column's buffer.
+
+        The column becomes *spilled* (``_data is None`` with the exact host
+        copy authoritative): every later read restores via lineage — a
+        fresh upload — instead of touching the consumed buffer, which is
+        exactly the use-after-donate guard.  Returns the device bytes
+        released from the ledger (the HBM the donation reclaimed).
+        """
+        if self._data is None or self.is_lazy:
+            return 0
+        # a sorted rep derived from the consumed buffer must not outlive it
+        self._invalidate_sorted()
+        from modin_tpu.core.memory import device_ledger
+
+        freed = device_ledger.deregister(self)
+        self._data = None
+        self.donated = True
+        return freed
+
     def _restore(self) -> None:
         """Re-seat a spilled column's device buffer from its host copy."""
         if self.host_cache is None:
             raise RuntimeError(
                 "spilled DeviceColumn has no host copy to restore from"
             )
+        was_donated = self.donated  # reseat stamps the fresh buffer clean
         self.reseat_from_host()
         from modin_tpu.logging.metrics import emit_metric
 
         emit_metric("memory.device.restore", 1)
+        if was_donated:
+            # the use-after-donate guard doing its job: a buffer a fused
+            # dispatch consumed was rebuilt via lineage on first re-access
+            emit_metric("fuse.donated_restore", 1)
 
     def reseat_from_host(self) -> None:
         """Upload the exact host copy as a fresh device buffer (lineage

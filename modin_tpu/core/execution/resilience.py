@@ -143,6 +143,13 @@ _LOST_MARKERS = (
     "NOT_FOUND: device",
 )
 _RUNTIME_ERROR_TYPE_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
+#: graftfuse: dispatching over a buffer a previous donated dispatch consumed
+#: surfaces as a plain ValueError/RuntimeError, not an XlaRuntimeError — the
+#: engine's own retry of a donated thunk on real hardware hits exactly this.
+#: Classified as DeviceLost so the deploy rebind leg rebuilds the argument
+#: tree from the (lineage-restorable) columns and dispatches over live
+#: buffers, instead of crashing the query on a retry artifact.
+_DONATED_MARKERS = ("deleted or donated", "Array has been deleted")
 
 #: a runtime error message may name the lost shard (the fault harness does;
 #: real runtimes name devices in their own formats, unparsed = None)
@@ -167,6 +174,10 @@ def classify_device_error(exc: BaseException) -> Optional[DeviceFailure]:
     if isinstance(exc, DeviceFailure):
         return exc
     if not is_device_runtime_error(exc):
+        if isinstance(exc, (ValueError, RuntimeError)) and any(
+            m in str(exc) for m in _DONATED_MARKERS
+        ):
+            return DeviceLost(str(exc))
         return None
     msg = str(exc)
     if any(m in msg for m in _OOM_MARKERS):

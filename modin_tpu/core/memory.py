@@ -262,6 +262,34 @@ class _DeviceLedger:
             entries = list(self._entries.values())
         return [col for e in entries if (col := e[0]()) is not None]
 
+    def buffer_consumers(self, buffer: Any) -> int:
+        """How many live tracked columns hold exactly this device buffer.
+
+        The graftfuse donation proof: a buffer may be passed in a donated
+        position only when ONE column owns it — donating a buffer two
+        ``DeviceColumn`` objects share would delete it under the second
+        one.  Reads ``_data`` directly (never ``raw``): probing must not
+        restore a spilled column.  Sorted-representation entries hold
+        their own derived buffers, so they count only if they literally
+        alias the probed one (they never do by construction).
+        """
+        return self.buffer_consumer_counts([buffer]).get(id(buffer), 0)
+
+    def buffer_consumer_counts(self, buffers: List[Any]) -> dict:
+        """One-pass ``{id(buffer): live-column count}`` for a batch of
+        buffers — the graftfuse donation proof amortized: one ledger walk
+        per fused dispatch instead of one per candidate column."""
+        wanted = {id(b) for b in buffers}
+        with self._lock:
+            entries = list(self._entries.values())
+        out: dict = {}
+        for entry in entries:
+            col = entry[0]()
+            data = getattr(col, "_data", None) if col is not None else None
+            if data is not None and id(data) in wanted:
+                out[id(data)] = out.get(id(data), 0) + 1
+        return out
+
     def per_shard_bytes(self) -> dict:
         """{mesh row shard index: resident bytes} — each tracked padded
         buffer split evenly over the shard count it was registered under

@@ -1079,8 +1079,18 @@ class TpuQueryCompiler(BaseQueryCompiler):
 
     @device_path("reduce")
     def _try_device_reduce(
-        self, op: str, axis: Any, skipna: bool, numeric_only: bool, kwargs: dict
+        self, op: str, axis: Any, skipna: bool, numeric_only: bool, kwargs: dict,
+        keep: Any = None, donate_cols: Any = None,
     ) -> Optional["TpuQueryCompiler"]:
+        """``keep``/``donate_cols`` are the graftfuse whole-plan leg
+        (plan/fuse.py): ``keep`` is a deferred boolean mask over the
+        UNCOMPACTED rows — the filter fuses into the reduction program
+        instead of paying a compaction dispatch — and ``donate_cols`` are
+        input columns whose buffers the ledger proved donation-safe.
+        ``keep`` declines (returns None) wherever the masked form is not
+        bit-faithful to the staged one: axis=1, the sort-shaped median
+        leg, dictionary-encoded host columns, and a filter that keeps zero
+        rows (pandas empty-frame semantics live with the staged path)."""
         from modin_tpu.ops import reductions
 
         if kwargs.get("min_count", 0) not in (0, -1):
@@ -1130,6 +1140,8 @@ class TpuQueryCompiler(BaseQueryCompiler):
             frame._columns[i] if i not in decoders else decoders[i].codes
             for i in positions
         ]
+        if keep is not None and (decoders or axis in (1,)):
+            return None
         labels = frame.columns[positions]
         # raw: lazy elementwise producers fuse into the reduction tail
         arrays = [c.raw for c in sel_cols]
@@ -1152,7 +1164,22 @@ class TpuQueryCompiler(BaseQueryCompiler):
             return qc
         if axis not in (0, None):
             return None
-        if (
+        if keep is not None:
+            if op == "median":
+                return None  # masked median has no fused form
+            values, kept = reductions.reduce_columns_masked(
+                op, arrays, keep, len(frame), skipna=skipna, ddof=ddof,
+                cast_bool=cast_bool, donate_cols=donate_cols,
+            )
+            if kept == 0:
+                # a filter matching nothing at fused scale pays one
+                # discarded dispatch here (donated inputs restore
+                # transparently from host on the staged re-run): pandas
+                # empty-frame semantics — int min answering NaN, var
+                # edges — are not worth expressing in-program for a query
+                # that selected zero rows
+                return None
+        elif (
             op == "median"
             and not decoders
             and all(not c.is_lazy for c in sel_cols)
@@ -1175,7 +1202,7 @@ class TpuQueryCompiler(BaseQueryCompiler):
         else:
             values = reductions.reduce_columns(
                 op, arrays, len(frame), skipna=skipna, ddof=ddof,
-                cast_bool=cast_bool,
+                cast_bool=cast_bool, donate_cols=donate_cols,
             )
         out_values = []
         for pos, v in zip(positions, values):

@@ -163,6 +163,7 @@ class JitHazardRule(Rule):
         shard_bodies = self._shard_map_bodies(ctx)
         shard_scopes = {ctx.scope_of(fn) for fn in shard_bodies}
         yield from self._check_collective_placement(ctx, shard_scopes)
+        yield from self._check_donation(ctx)
         for fn, static_params in self._jitted_functions(ctx):
             traced = {
                 a.arg for a in fn.args.args if a.arg not in static_params
@@ -174,6 +175,217 @@ class JitHazardRule(Rule):
                 module_mutables,
                 in_shard_map=fn in shard_bodies,
             )
+
+    # -- donation leg (graftfuse) --------------------------------------- #
+    #
+    # A buffer passed in a donated jit position is CONSUMED by the
+    # dispatch: XLA reuses its memory for the program's outputs and any
+    # later read answers garbage or raises "deleted or donated".  The leg
+    # flags, within one function scope, any load of a name AFTER it was
+    # passed at a donated argument position of a callable built by
+    # ``jax.jit(..., donate_argnums=...)`` in the same scope.
+
+    @staticmethod
+    def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+        """Literal donate_argnums of a jit call, or None when absent or
+        not statically known."""
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            nums = []
+            for e in elts:
+                if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                    return None
+                nums.append(e.value)
+            return tuple(nums)
+        return None
+
+    @staticmethod
+    def _own_nodes(fn: ast.FunctionDef) -> Iterator[ast.AST]:
+        """Walk ``fn``'s body WITHOUT descending into nested function
+        bodies: a nested def's reads execute when IT is called, not at
+        definition time, so mixing its positions into the enclosing
+        function's timeline flags pre-call reads and double-reports the
+        nested function's own hazards (it gets its own walk)."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _branch_path(
+        ctx: FileContext, node: ast.AST, stop: ast.AST
+    ) -> Tuple[Dict[int, str], bool]:
+        """({id(If): branch}, any-enclosing-loop) for ``node`` up to
+        ``stop`` — the mutual-exclusion evidence: a load in the OTHER
+        branch of an If the consuming call sits in can never execute
+        after it in the same pass, unless a loop re-enters."""
+        path: Dict[int, str] = {}
+        loops = False
+        child: ast.AST = node
+        cur = ctx.parent_of(node)
+        while cur is not None and cur is not stop:
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                loops = True
+            if isinstance(cur, ast.If):
+                path[id(cur)] = "orelse" if child in cur.orelse else "body"
+            child = cur
+            cur = ctx.parent_of(cur)
+        return path, loops
+
+    def _check_donation(self, ctx: FileContext) -> Iterator[Finding]:
+        # scope -> {jitted-callable name: donated positions}
+        donated_fns: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            if not _is_jit_callable(call.func):
+                continue
+            positions = self._donate_positions(call)
+            if not positions:
+                continue
+            scope = ctx.scope_of(node)
+            for target in node.targets:
+                for name in assigned_names(target):
+                    donated_fns[(scope, name)] = positions
+        if not donated_fns:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            scope = ctx.scope_of(fn)
+            # donated names -> the source position where the consuming call
+            # ENDS.  Positions are (line, col) pairs, not bare lines: in
+            # `f(x) + x` the second load is on the call's own line but
+            # still runs after the dispatch consumed x's buffer — Python
+            # evaluates left to right, so textually-after-the-call is
+            # after-the-consumption
+            consumed: Dict[str, Tuple[Tuple[int, int], ast.Call]] = {}
+            for node in self._own_nodes(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                ):
+                    # resolve through the scope chain: a nested body may
+                    # call a jitted closure its ENCLOSING function built
+                    positions = None
+                    chain_scope = scope
+                    while positions is None:
+                        positions = donated_fns.get(
+                            (chain_scope, node.func.id)
+                        )
+                        if "." not in chain_scope:
+                            break
+                        chain_scope = chain_scope.rsplit(".", 1)[0]
+                    if positions is None:
+                        positions = donated_fns.get(
+                            ("<module>", node.func.id)
+                        )
+                    if positions:
+                        end = (
+                            getattr(node, "end_lineno", node.lineno),
+                            getattr(node, "end_col_offset", 0),
+                        )
+                        for pos in positions:
+                            if pos < len(node.args) and isinstance(
+                                node.args[pos], ast.Name
+                            ):
+                                name = node.args[pos].id
+                                prev = consumed.get(name)
+                                # keep the EARLIEST consuming position —
+                                # ast.walk is BFS, so first-seen order is
+                                # not source order
+                                if prev is None or end < prev[0]:
+                                    consumed[name] = (end, node)
+            if not consumed:
+                continue
+            # a rebind AFTER the consuming call makes later reads clean
+            # (the name no longer holds the donated buffer).  A rebind's
+            # effective position is the END of its statement, not the
+            # target Name's own (left-hand) position: in the idiomatic
+            # `x = f(x)` the Store is textually before the call but the
+            # assignment completes after it — later reads of x hold the
+            # program's OUTPUT and are clean.
+            rebinds: Dict[str, List[Tuple[int, int]]] = {}
+            for node in self._own_nodes(fn):
+                if isinstance(
+                    node, (ast.Assign, ast.AugAssign, ast.AnnAssign)
+                ):
+                    end = (
+                        getattr(node, "end_lineno", node.lineno),
+                        getattr(node, "end_col_offset", 0),
+                    )
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for target in targets:
+                        for name in assigned_names(target):
+                            rebinds.setdefault(name, []).append(end)
+                elif isinstance(node, (ast.For, ast.comprehension)):
+                    for name in assigned_names(node.target):
+                        rebinds.setdefault(name, []).append(
+                            (node.target.lineno, node.target.col_offset)
+                        )
+            for node in self._own_nodes(fn):
+                if not (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in consumed
+                ):
+                    continue
+                pos, call = consumed[node.id]
+                if (node.lineno, node.col_offset) <= pos:
+                    continue
+                if any(
+                    # <=: a rebind ending exactly at the consuming call's
+                    # end IS the assignment that captured its result
+                    # (`x = f(x)`)
+                    pos <= store < (node.lineno, node.col_offset)
+                    for store in rebinds.get(node.id, ())
+                ):
+                    continue
+                # mutual exclusion: a load in the OTHER branch of an If
+                # the consuming call sits in never runs after it in the
+                # same pass — unless a loop can re-enter the whole shape
+                call_path, call_loops = self._branch_path(ctx, call, fn)
+                load_path, load_loops = self._branch_path(ctx, node, fn)
+                if (
+                    not call_loops
+                    and not load_loops
+                    and any(
+                        call_path.get(k) != b
+                        for k, b in load_path.items()
+                        if k in call_path
+                    )
+                ):
+                    continue
+                yield Finding(
+                    path=ctx.rel,
+                    line=node.lineno,
+                    rule=self.id,
+                    message=(
+                        f"`{node.id}` read after being passed in a "
+                        "donated jit position — the dispatch consumed "
+                        "its buffer (use-after-donate); re-read it "
+                        "through its owning column's lineage instead"
+                    ),
+                    fix_hint=(
+                        "donated buffers are dead after the call: mark "
+                        "the owning DeviceColumn donated (spilled) and "
+                        "access via col.raw, or drop donate_argnums"
+                    ),
+                    scope=ctx.scope_of(node),
+                    symbol=f"donated-{node.id}",
+                )
 
     # -- discovery ------------------------------------------------------ #
 

@@ -340,6 +340,48 @@ METRICS = (
         "re-jitting; emitted while graftmeter accounting is active)",
     ),
     (
+        "fuse.dispatch",
+        "counter",
+        "graftfuse whole-plan dispatches: one compiled program covering "
+        "the entire post-scan segment (filter/map/project chain plus its "
+        "reduce or groupby tail) instead of one dispatch per stage",
+    ),
+    (
+        "fuse.donated",
+        "counter",
+        "input columns whose buffers rode in donated jit positions of a "
+        "fused program (the device ledger proved no other live consumer; "
+        "the column restores via lineage on next access)",
+    ),
+    (
+        "fuse.donated_bytes",
+        "counter",
+        "device bytes released by graftfuse buffer donation (freed by XLA "
+        "at the dispatch instead of surviving to the next GC pass; reused "
+        "in place where an output shape aliases an input)",
+    ),
+    (
+        "fuse.donated_restore",
+        "counter",
+        "donated columns rebuilt via lineage (exact host copy) on their "
+        "first post-donation device access — the use-after-donate guard "
+        "doing its job",
+    ),
+    (
+        "fuse.decline",
+        "counter",
+        "fused-eligible segments that fell back to the staged lowering "
+        "mid-flight (unsupported tail kwargs, zero kept rows, key range "
+        "over the group-bucket cap)",
+    ),
+    (
+        "fuse.bucket.quantized",
+        "counter",
+        "scan uploads whose padding was quantized to a recompile-storm "
+        "bucket (adaptive padding chosen from the compile ledger's "
+        "recompile_storms feedback; pad rows per upload as the value)",
+    ),
+    (
         "pandas-api.*",
         "histogram",
         "wall-clock seconds per public pandas-API call (logging layer)",

@@ -480,6 +480,8 @@ class QueryStats:
         "stream_replays",
         "stream_overlap_s",
         "stream_wait_s",
+        "fused_dispatches",
+        "donated_bytes",
         "_t0",
         "_lock",
         "_closed",
@@ -532,6 +534,10 @@ class QueryStats:
         self.stream_replays = 0
         self.stream_overlap_s = 0.0
         self.stream_wait_s = 0.0
+        # graftfuse: whole-plan dispatches (one program per query segment)
+        # and the HBM released to XLA by buffer donation under this scope
+        self.fused_dispatches = 0
+        self.donated_bytes = 0
         self._t0 = time.perf_counter()
 
     # -- stream routing -------------------------------------------------- #
@@ -579,6 +585,16 @@ class QueryStats:
             self.cache_hits["plan_scan"] += int(value)
         elif name == "stream.window.count":
             self.stream_windows += int(value)
+            self._sample_hbm()
+        elif name == "fuse.dispatch":
+            self.fused_dispatches += int(value)
+            self._sample_hbm()
+        elif name == "fuse.donated":
+            # fired BEFORE the donated buffers leave the ledger: the last
+            # honest pre-donation residency peak
+            self._sample_hbm()
+        elif name == "fuse.donated_bytes":
+            self.donated_bytes += int(value)
             self._sample_hbm()
         elif name == "stream.window.replay":
             self.stream_replays += int(value)
@@ -636,6 +652,8 @@ class QueryStats:
             "stream_replays": self.stream_replays,
             "stream_overlap_s": self.stream_overlap_s,
             "stream_wait_s": self.stream_wait_s,
+            "fused_dispatches": self.fused_dispatches,
+            "donated_bytes": self.donated_bytes,
         }
 
     def summary(self) -> str:
@@ -652,6 +670,11 @@ class QueryStats:
             f"cache hits: {hits}",
             self._cost_line(),
         ]
+        if self.fused_dispatches:
+            lines.append(
+                f"fuse: {self.fused_dispatches} whole-plan dispatch(es), "
+                f"{self.donated_bytes} bytes donated"
+            )
         if self.stream_windows:
             busy = self.stream_overlap_s + self.stream_wait_s
             eff = f"{self.stream_overlap_s / busy:.0%}" if busy > 0 else "?"

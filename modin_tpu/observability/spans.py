@@ -123,6 +123,13 @@ SPANS = (
         "(node count in attributes)",
     ),
     (
+        "fuse.lower",
+        "one graftfuse whole-plan fused lowering: the post-scan segment "
+        "(filter/map/project chain plus its reduce or groupby tail) "
+        "compiled and dispatched as a single donated program (segment "
+        "signature, rows, donated column count in attributes)",
+    ),
+    (
         "stream.window",
         "one graftstream resident window: parse/deploy/consume/drop of a "
         "record-aligned byte range (scan loop) or one external-sort window "

@@ -1222,3 +1222,168 @@ def groupby_cumulative(op: str, value_cols: List[Any], codes: Any) -> List[Any]:
     """Row-shaped grouped cumsum/cumprod/cummax/cummin."""
     fn = _jit_grouped_cum(op, len(value_cols))
     return list(fn(tuple(value_cols), codes))
+
+
+# ---------------------------------------------------------------------- #
+# graftfuse: whole-plan fused groupby (bounded-range int/bool keys)
+# ---------------------------------------------------------------------- #
+
+#: aggregations with a masked scatter form the fused whole-plan program
+#: can express in one pass (pandas groupby semantics: NaN values always
+#: skipped; all-NaN float groups answer sum=0 / count=0 / min=max=mean=NaN)
+FUSED_GROUPBY_AGGS = frozenset({"sum", "prod", "count", "mean", "min", "max"})
+
+#: widest group-id table a fused program will scatter into (pow2-padded);
+#: wider key ranges decline to the staged factorize path
+FUSED_MAX_GROUPS = 1 << 16
+
+
+def fused_groups_bucket(width: int) -> int:
+    """Pow2-padded group-table size for a key range of ``width`` values —
+    the same shape discipline the histogram reductions use, so a dozen
+    nearby cardinalities share one compiled program."""
+    return 1 << max(int(width - 1).bit_length(), 3)
+
+
+def fused_group_probe(
+    key_expr: Any, keep: Optional[Any], n: int
+) -> Tuple[int, int, int]:
+    """(key_min, key_max, kept_rows) of the masked key column, one dispatch.
+
+    The filter/map chain below the key fuses into this probe program; the
+    three scalars are the only host fetch.  ``keep`` may be None (no
+    filter: only the pad rows are masked).  ``kept_rows == 0`` tells the
+    caller to decline (pandas empty-groupby semantics stay with the staged
+    path).  Keys must be integral (int/uint/bool) — the caller gates.
+    """
+    from modin_tpu.ops.lazy import run_fused
+
+    has_mask = keep is not None
+
+    def tail(arrs):
+        import jax.numpy as jnp
+
+        if has_mask:
+            k, m, n_t = arrs
+        else:
+            k, n_t = arrs
+            m = True
+        k64 = k.astype(jnp.int64)
+        valid = m & (jnp.arange(k64.shape[0]) < n_t)
+        kept = jnp.sum(valid, dtype=jnp.int64)
+        kmin = jnp.min(jnp.where(valid, k64, jnp.iinfo(jnp.int64).max))
+        kmax = jnp.max(jnp.where(valid, k64, jnp.iinfo(jnp.int64).min))
+        return kmin, kmax, kept
+
+    roots = [key_expr] + ([keep] if has_mask else []) + [int(n)]
+    results = run_fused(
+        roots,
+        tail_key=("fuse_gb_probe", has_mask),
+        tail_builder=tail,
+    )
+    kmin, kmax, kept = [int(np.asarray(r)) for r in _engine_materialize(results)]
+    return kmin, kmax, kept
+
+
+def fused_group_agg(
+    agg: str,
+    key_expr: Any,
+    cols: List[Any],
+    keep: Optional[Any],
+    n: int,
+    kmin: int,
+    n_buckets: int,
+    donate_cols: Optional[List[Any]] = None,
+) -> Tuple[np.ndarray, List[np.ndarray], List[np.ndarray]]:
+    """The whole post-scan chain + masked group aggregation, one dispatch.
+
+    Scatters every kept row into a ``n_buckets``-slot table (slot =
+    ``key - kmin``, with ``kmin`` a runtime scalar so one program serves
+    any key offset at a bucket size); dropped/pad rows land in the
+    overflow slot and are sliced off.  Returns host arrays
+    ``(group_sizes[n_buckets], per-column aggregates, per-column non-NaN
+    counts)`` — the caller keeps slots with ``group_sizes > 0`` (observed
+    groups, already in sorted key order) and applies pandas dtype rules.
+    """
+    from modin_tpu.ops.reductions import _mark_and_run
+
+    G = int(n_buckets)
+    has_mask = keep is not None
+
+    def tail(arrs):
+        import jax.numpy as jnp
+
+        if has_mask:
+            k, *col_arrs, m, n_t, kmin_t = arrs
+        else:
+            k, *col_arrs, n_t, kmin_t = arrs
+            m = True
+        k64 = k.astype(jnp.int64)
+        valid = m & (jnp.arange(k64.shape[0]) < n_t)
+        ids = jnp.where(valid, jnp.clip(k64 - kmin_t, 0, G - 1), G)
+        sizes = jnp.zeros(G + 1, jnp.int64).at[ids].add(
+            jnp.where(valid, 1, 0)
+        )
+        tables = []
+        counts = []
+        for c in col_arrs:
+            is_f = jnp.issubdtype(c.dtype, jnp.floating)
+            use = valid & ~jnp.isnan(c) if is_f else valid
+            nn = jnp.zeros(G + 1, jnp.int64).at[ids].add(jnp.where(use, 1, 0))
+            counts.append(nn)
+            if agg == "count":
+                tables.append(nn)
+                continue
+            x = c.astype(jnp.int64) if c.dtype == jnp.bool_ else c
+            if agg in ("sum", "mean"):
+                acc = x.astype(jnp.float64) if agg == "mean" else x
+                neutral = jnp.zeros((), acc.dtype)
+                t = jnp.zeros(G + 1, acc.dtype).at[ids].add(
+                    jnp.where(use, acc, neutral)
+                )
+                if agg == "mean":
+                    t = jnp.where(nn > 0, t / nn, jnp.nan)
+                tables.append(t)
+            elif agg == "prod":
+                t = jnp.ones(G + 1, x.dtype).at[ids].multiply(
+                    jnp.where(use, x, jnp.ones((), x.dtype))
+                )
+                tables.append(t)
+            elif agg in ("min", "max"):
+                from modin_tpu.ops.reductions import _int_max, _int_min
+
+                if is_f:
+                    neutral = jnp.inf if agg == "min" else -jnp.inf
+                else:
+                    neutral = (
+                        _int_max(x.dtype) if agg == "min" else _int_min(x.dtype)
+                    )
+                init = jnp.full(G + 1, neutral, x.dtype)
+                at = init.at[ids]
+                t = (at.min if agg == "min" else at.max)(
+                    jnp.where(use, x, jnp.full((), neutral, x.dtype))
+                )
+                if is_f:
+                    # all-NaN (or empty) slot: the neutral infinity means
+                    # "no value"; pandas answers NaN there
+                    t = jnp.where(nn > 0, t, jnp.nan)
+                tables.append(t)
+            else:
+                raise ValueError(agg)
+        return (sizes,) + tuple(tables) + tuple(counts)
+
+    roots = (
+        [key_expr, *cols]
+        + ([keep] if has_mask else [])
+        + [int(n), int(kmin)]
+    )
+    results = _mark_and_run(
+        roots,
+        ("fuse_gb_agg", agg, G, len(cols), has_mask),
+        tail,
+        donate_cols,
+    )
+    fetched = [np.asarray(r) for r in _engine_materialize(results)]
+    sizes = fetched[0]
+    n_cols = len(cols)
+    return sizes, fetched[1 : 1 + n_cols], fetched[1 + n_cols :]

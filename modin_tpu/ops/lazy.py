@@ -234,10 +234,61 @@ def _linearize(roots: Sequence[Any]):
     return nodes, out_refs, leaves, scalars, fingerprint
 
 
+def _cache_epoch_key() -> Tuple:
+    """(mesh shape, device epoch) component of every fused-cache key.
+
+    A program traced under one mesh topology bakes that topology's
+    sharding into its compiled executable — an in-process ``MeshShape``
+    flip (the ``_jit_shuffle`` stale-program class graftmesh fixed) must
+    never reuse it.  The device epoch guards the same way across a
+    graftguard re-seat: post-loss executables are retraced rather than
+    trusted to hold no dead device state.  Both reads are cached module
+    attributes (no lock, no mesh build) on the hot path.
+    """
+    try:
+        from modin_tpu.core.execution.recovery import current_epoch
+        from modin_tpu.parallel.mesh import mesh_shape_key
+
+        return (mesh_shape_key(), current_epoch())
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- no backend/mesh yet: a single unkeyed epoch is the pre-mesh world
+        return ("unknown", 0)
+
+
+_donation_filter_installed = False
+
+
+def _ensure_donation_warning_filter() -> None:
+    """One-time, process-wide suppression of jax's "Some donated buffers
+    were not usable" UserWarning.
+
+    The fused reduce/groupby tails output scalars and small tables, so no
+    output shape ever aliases a full-length donated input and jax warns on
+    every compiled shape — but the donation is still doing its job (the
+    buffer is deleted at dispatch, the early HBM release the ledger
+    records), so the warning is pure noise.  Installed lazily at the first
+    donated dispatch (a process that never donates keeps its filters
+    untouched) and module-global rather than per-dispatch: a scoped
+    ``catch_warnings`` mutates process-global filter state non-atomically,
+    which two concurrently-dispatching threads can corrupt.
+    """
+    global _donation_filter_installed
+    if _donation_filter_installed:
+        return
+    import warnings
+
+    with _FUSED_LOCK:
+        if not _donation_filter_installed:
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            _donation_filter_installed = True
+
+
 def run_fused(
     roots: Sequence[Any],
     tail_key: Optional[Tuple] = None,
     tail_builder: Optional[Callable[[List[Any]], Any]] = None,
+    donate: Optional[frozenset] = None,
 ):
     """Compile + run the whole forest (and optional tail) as one jit.
 
@@ -245,6 +296,16 @@ def run_fused(
     memoizes each root LazyExpr's result.  With a tail: the tail builder is
     traced over the root arrays inside the same jit (fusing e.g. a reduction
     into its elementwise producers) and its output is returned.
+
+    ``donate`` is a set of ``id(buffer)`` for concrete leaf arrays the
+    caller proved have no other live consumer (graftfuse: the device ledger
+    ref-count): those leaves are passed in donated positions
+    (``donate_argnums``), so XLA frees them the moment the dispatch is done
+    with them — and reuses them in place where an output shape aliases an
+    input — instead of every input surviving to the next GC pass.  The
+    caller owns the donation contract — marking the owning columns spilled
+    so later reads restore via lineage instead of touching the consumed
+    buffer.
     """
     import jax
 
@@ -258,14 +319,22 @@ def run_fused(
         return [r._result if isinstance(r, LazyExpr) else r for r in roots]
 
     nodes, out_refs, leaves, scalars, fingerprint = _linearize(roots)
-    key = (fingerprint, tail_key)
+    donate_positions: Tuple[int, ...] = ()
+    if donate:
+        donate_positions = tuple(
+            i for i, leaf in enumerate(leaves) if id(leaf) in donate
+        )
+    # the donated positions are part of the executable's identity: jit
+    # fixes donate_argnums at wrap time, so the same forest with and
+    # without donation is two programs
+    key = (fingerprint, tail_key, _cache_epoch_key(), donate_positions)
     fn = _fused_cache_get(key)
     if fn is None:
         from modin_tpu.ops.elementwise import get_op
 
         nodes_spec = tuple(nodes)
 
-        def execute(leaf_vals: Tuple, scalar_vals: Tuple):
+        def execute(scalar_vals: Tuple, *leaf_vals):
             vals: List[Any] = []
 
             def res(ref):
@@ -281,7 +350,11 @@ def run_fused(
             outs = [res(r) for r in out_refs]
             return tail_builder(outs) if tail_builder is not None else tuple(outs)
 
-        fn = jax.jit(execute)
+        fn = jax.jit(
+            execute,
+            # +1: argument 0 is the scalar tuple (never donated)
+            donate_argnums=tuple(p + 1 for p in donate_positions),
+        )
         _fused_cache_put(key, fn)
 
     # dispatch through the engine seam: the fused call gets the resilience
@@ -289,13 +362,51 @@ def run_fused(
     # exactly like every other device computation
     from modin_tpu.parallel.engine import JaxWrapper
 
-    result = JaxWrapper.deploy(fn, (tuple(leaves), tuple(scalars)))
+    if donate_positions:
+        _ensure_donation_warning_filter()
+        result = JaxWrapper.deploy(
+            fn,
+            (tuple(scalars), *leaves),
+            # a donated program must never be replayed from provenance:
+            # replay would re-donate (and delete) the freshly restored
+            # input buffers under their columns.  Its outputs are
+            # materialized to host at the call site, so they never need
+            # op-replay lineage anyway.
+            donated=True,
+        )
+    else:
+        result = JaxWrapper.deploy(fn, (tuple(scalars), *leaves))
     if tail_builder is not None:
         return result
     for root, value in zip(roots, result):
         if isinstance(root, LazyExpr):
             root._result = value
     return list(result)
+
+
+def leaf_buffer_ids(roots: Sequence[Any]) -> frozenset:
+    """``id()`` of every concrete array leaf an expression forest consumes.
+
+    The graftfuse donation path intersects its candidate columns with this
+    set so only buffers the program actually receives are marked consumed —
+    a candidate outside the forest must stay resident.
+    """
+    ids = set()
+    seen = set()
+    stack = list(roots)
+    while stack:
+        e = stack.pop()
+        if isinstance(e, LazyExpr):
+            if e._result is not None:
+                ids.add(id(e._result))
+                continue
+            if id(e) in seen:
+                continue
+            seen.add(id(e))
+            stack.extend(e.args)
+        elif not isinstance(e, _SCALAR_TYPES) and hasattr(e, "dtype"):
+            ids.add(id(e))
+    return frozenset(ids)
 
 
 def materialize_exprs(items: Sequence[Any]) -> List[Any]:

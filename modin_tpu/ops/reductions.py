@@ -15,7 +15,7 @@ passed statically).
 from __future__ import annotations
 
 import functools
-from typing import Any, List, NamedTuple, Tuple
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -365,6 +365,7 @@ def reduce_columns(
     skipna: bool = True,
     ddof: int = 1,
     cast_bool: bool = False,
+    donate_cols: Optional[List[Any]] = None,
 ) -> list:
     """Reduce each padded column (logical length n) to a scalar; one fetch.
 
@@ -375,7 +376,6 @@ def reduce_columns(
     """
     import jax
 
-    from modin_tpu.ops.lazy import run_fused
     from modin_tpu.parallel.mesh import num_row_shards
 
     n, skipna, ddof = int(n), bool(skipna), int(ddof)
@@ -394,14 +394,202 @@ def reduce_columns(
             for c in arrs
         )
 
-    results = run_fused(
+    results = _mark_and_run(
         cols,
         # adaptive/adaptive_sharded are derived from (n, n_shards), so the
         # shard count alone completes the cache key
-        tail_key=("reduce", op_name, n, skipna, ddof, bool(cast_bool), n_shards),
-        tail_builder=tail,
+        ("reduce", op_name, n, skipna, ddof, bool(cast_bool), n_shards),
+        tail,
+        donate_cols,
     )
     return [np.asarray(r) for r in _engine_materialize(results)]
+
+
+def _reduce_one_masked(op: str, c, valid, skipna: bool, ddof: int):
+    """Reduce one padded column restricted to the ``valid`` row mask.
+
+    The graftfuse whole-plan form of :func:`_reduce_one`: ``valid`` is the
+    filter's keep mask already AND-ed with the logical-length iota mask (n
+    rides as a *traced* scalar in the fused program, so one executable
+    serves every logical length at a physical size).  Semantics mirror
+    ``_reduce_one``'s masked branch exactly — the compacted rows a staged
+    filter would have gathered are the same values this mask selects, in
+    the same order — with the NaN-adaptive fast paths skipped (the mask
+    forces the select form anyway).
+    """
+    import jax.numpy as jnp
+
+    is_f = jnp.issubdtype(c.dtype, jnp.floating)
+    cnt_dtype = jnp.int32 if c.shape[0] < 2**31 else jnp.int64
+    nan_mask = jnp.isnan(c) & valid if is_f else None
+    use = valid & ~nan_mask if (skipna and is_f) else valid
+    n_use = jnp.sum(use, dtype=cnt_dtype).astype(jnp.int64)
+
+    def sel(x, neutral):
+        return jnp.where(use, x, neutral)
+
+    def sel_valid(x, neutral):
+        return jnp.where(valid, x, neutral)
+
+    if op == "count":
+        if nan_mask is None:
+            return jnp.sum(valid, dtype=cnt_dtype).astype(jnp.int64)
+        return jnp.sum(sel_valid(~nan_mask, False), dtype=cnt_dtype).astype(jnp.int64)
+    if op == "sum":
+        return jnp.sum(sel(c, 0))
+    if op == "prod":
+        return jnp.prod(sel(c, 1))
+    if op == "min":
+        if is_f:
+            r = jnp.min(sel(c, jnp.inf))
+            any_nan = jnp.any(nan_mask) & (not skipna)
+            return jnp.where(jnp.isinf(r) & (n_use == 0), jnp.nan, jnp.where(any_nan, jnp.nan, r))
+        return jnp.min(sel(c, _int_max(c.dtype)))
+    if op == "max":
+        if is_f:
+            r = jnp.max(sel(c, -jnp.inf))
+            any_nan = jnp.any(nan_mask) & (not skipna)
+            return jnp.where(jnp.isinf(-r) & (n_use == 0), jnp.nan, jnp.where(any_nan, jnp.nan, r))
+        return jnp.max(sel(c, _int_min(c.dtype)))
+    if op in ("mean", "var", "std", "sem", "skew", "kurt"):
+        x = sel(c, 0).astype(jnp.float64)
+        s = jnp.sum(x)
+        mean = s / n_use
+        if op == "mean":
+            if is_f and not skipna:
+                return jnp.where(jnp.any(nan_mask), jnp.nan, mean)
+            return jnp.where(n_use == 0, jnp.nan, mean)
+        d = sel(x - mean, 0.0)
+        m2s = jnp.sum(d**2)
+        if op in ("var", "std", "sem"):
+            var = m2s / jnp.maximum(n_use - ddof, 1)
+            var = jnp.where(n_use - ddof > 0, var, jnp.nan)
+            if is_f and not skipna:
+                var = jnp.where(jnp.any(nan_mask), jnp.nan, var)
+            if op == "var":
+                return var
+            if op == "std":
+                return jnp.sqrt(var)
+            return jnp.sqrt(var / n_use)
+        nf = n_use.astype(jnp.float64)
+        m2 = m2s / nf
+        if op == "skew":
+            m3 = jnp.sum(d**3) / nf
+            g1 = m3 / jnp.where(m2 > 0, m2, 1.0) ** 1.5
+            res = jnp.sqrt(nf * (nf - 1.0)) / (nf - 2.0) * g1
+            res = jnp.where((nf < 3) | (m2 == 0), jnp.nan, res)
+        else:  # kurt
+            m4 = jnp.sum(d**4) / nf
+            g2 = m4 / jnp.where(m2 > 0, m2, 1.0) ** 2 - 3.0
+            res = ((nf + 1.0) * g2 + 6.0) * (nf - 1.0) / ((nf - 2.0) * (nf - 3.0))
+            res = jnp.where((nf < 4) | (m2 == 0), jnp.nan, res)
+        if is_f and not skipna:
+            res = jnp.where(jnp.any(nan_mask), jnp.nan, res)
+        return res
+    if op == "median":
+        # a masked median needs a data-dependent selection; the fused leg
+        # declines it to the staged path before getting here
+        raise ValueError("median has no masked fused form")
+    if op == "any":
+        truthy = jnp.where(nan_mask, not skipna, c != 0) if is_f else (c != 0 if c.dtype != jnp.bool_ else c)
+        return jnp.any(sel_valid(truthy, False))
+    if op == "all":
+        truthy = jnp.where(nan_mask, True, c != 0) if is_f else (c != 0 if c.dtype != jnp.bool_ else c)
+        return jnp.all(sel_valid(truthy, True))
+    raise ValueError(op)
+
+
+def _mark_and_run(roots, tail_key, tail, donate_cols):
+    """Dispatch ``run_fused`` with buffer donation (graftfuse).
+
+    ``donate_cols`` are DeviceColumns the caller proved donation-safe; only
+    those whose buffer the forest actually consumes are donated.  Columns
+    are marked consumed (spilled-with-exact-host-copy semantics) BEFORE the
+    dispatch — the argument tree pins the buffers for the program itself,
+    and any failure path that re-dispatches (the engine's rebind retry)
+    then rebuilds over lineage-restored buffers instead of the consumed
+    ones.  The finally re-mark covers exactly that rebind: its restore
+    hands the column a fresh buffer that the retried donated program
+    consumes too.
+    """
+    from modin_tpu.logging.metrics import emit_metric
+    from modin_tpu.ops.lazy import leaf_buffer_ids, run_fused
+
+    donate_map = {}
+    if donate_cols:
+        consumed = leaf_buffer_ids(roots)
+        for col in donate_cols:
+            buf = col._data
+            if buf is not None and not col.is_lazy and id(buf) in consumed:
+                donate_map[id(buf)] = col
+    if not donate_map:
+        return run_fused(roots, tail_key=tail_key, tail_builder=tail)
+    # emit BEFORE marking: QueryStats samples HBM residency on this metric,
+    # and the pre-donation sample is the honest peak (the consumed buffers
+    # are still resident right up to the dispatch)
+    emit_metric("fuse.donated", len(donate_map))
+    freed = 0
+    for col in donate_map.values():
+        freed += col.mark_donated()
+    emit_metric("fuse.donated_bytes", freed)
+    try:
+        return run_fused(
+            roots, tail_key=tail_key, tail_builder=tail,
+            donate=frozenset(donate_map),
+        )
+    finally:
+        for col in donate_map.values():
+            if col._data is not None:
+                col.mark_donated()
+
+
+def reduce_columns_masked(
+    op_name: str,
+    cols: List[Any],
+    keep: Any,
+    n: int,
+    skipna: bool = True,
+    ddof: int = 1,
+    cast_bool: bool = False,
+    donate_cols: Optional[List[Any]] = None,
+) -> Tuple[list, int]:
+    """graftfuse whole-plan tail: reduce each column over ``keep`` rows.
+
+    ``keep`` is the (possibly deferred) boolean filter mask over the
+    UNCOMPACTED padded rows — the filter/map chain fuses into this one
+    program instead of paying a separate compaction dispatch.  ``n`` (the
+    pre-filter logical length) rides as a runtime scalar so the compiled
+    program is shared across logical lengths at one physical size.
+    Returns ``(values, kept_rows)``; the caller declines to the staged
+    path when ``kept_rows == 0`` (pandas empty-frame semantics live there).
+    """
+    n, skipna, ddof = int(n), bool(skipna), int(ddof)
+
+    def tail(arrs):
+        import jax.numpy as jnp
+
+        *col_arrs, m, n_t = arrs
+        if cast_bool:
+            col_arrs = [
+                a.astype(jnp.int64) if a.dtype == jnp.bool_ else a
+                for a in col_arrs
+            ]
+        valid = m & (jnp.arange(m.shape[0]) < n_t)
+        kept = jnp.sum(valid, dtype=jnp.int64)
+        outs = tuple(
+            _reduce_one_masked(op_name, c, valid, skipna, ddof)
+            for c in col_arrs
+        )
+        return outs + (kept,)
+
+    results = _mark_and_run(
+        [*cols, keep, n],
+        ("fuse_reduce", op_name, skipna, ddof, bool(cast_bool), len(cols)),
+        tail,
+        donate_cols,
+    )
+    fetched = [np.asarray(r) for r in _engine_materialize(results)]
+    return fetched[:-1], int(fetched[-1])
 
 
 @functools.lru_cache(maxsize=None)

@@ -461,6 +461,48 @@ def decide_residency(op: str, est_bytes: int, self_bytes: int = 0) -> str:
     return choice
 
 
+def decide_compile(plan_sig: Any, n: int) -> str:
+    """"fused" or "staged" for one whole-plan materialization (graftfuse).
+
+    ``plan_sig`` is the stable segment signature (plan/fuse.py), carried
+    into the decision span so a trace shows WHICH plan chose which leg;
+    ``n`` is the leaf frame's logical row count.  The model is a floor,
+    not a calibration: tracing + compiling a whole-plan XLA program costs
+    milliseconds regardless of data size, so below
+    ``MODIN_TPU_FUSE_MIN_ROWS`` the staged path's already-compiled per-op
+    kernels win outright.  ``MODIN_TPU_FUSE`` pins a side (tests, bench
+    legs).  Emitted as ``router.fuse.<choice>`` metrics and a
+    ``router.decide`` span.
+    """
+    from modin_tpu.config import FuseMinRows, FuseMode
+
+    mode = FuseMode.get().lower()
+    if mode == "fused":
+        choice, reason = "fused", "forced"
+    elif mode == "staged":
+        choice, reason = "staged", "forced"
+    elif n < int(FuseMinRows.get()):
+        choice, reason = "staged", "below_min_rows"
+    else:
+        choice, reason = "fused", "auto"
+    emit_metric(f"router.fuse.{choice}", 1)
+    if graftscope.TRACE_ON:
+        graftscope.finish_span(
+            graftscope.start_span(
+                "router.decide",
+                layer="QUERY-COMPILER",
+                attrs={
+                    "op": "fuse",
+                    "n": n,
+                    "choice": choice,
+                    "reason": reason,
+                    "plan_sig": str(plan_sig),
+                },
+            )
+        )
+    return choice
+
+
 def forced_host(op: str, n: int) -> bool:
     """True when routing is forced to Host: callers check this BEFORE any
     planning work (device materialization, the min/max histogram probe) so

@@ -9,12 +9,37 @@ rows.
 
 from __future__ import annotations
 
+import contextlib
 import functools
-from typing import Any, List, Tuple
+import threading
+from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
 from modin_tpu.observability import costs as _costs
+
+#: graftfuse adaptive padding: while a quantizer is installed on this
+#: thread, ``pad_host`` rounds its padded length up through it, so a scan
+#: whose plan signature the compile ledger reports as a recompile storm
+#: uploads at a shared bucket size instead of an exact one.  Scoped (the
+#: fused lowering wraps ONLY its leaf-scan lowering) and thread-local, so
+#: nothing else in the process ever sees a quantized pad.
+_bucket_tls = threading.local()
+
+
+@contextlib.contextmanager
+def pad_bucket_scope(quantizer: Optional[Callable[[int], int]]):
+    """Install ``quantizer`` (padded length -> bucketed padded length) for
+    ``pad_host`` calls on this thread; ``None`` is a no-op scope."""
+    if quantizer is None:
+        yield
+        return
+    prev = getattr(_bucket_tls, "quantize", None)
+    _bucket_tls.quantize = quantizer
+    try:
+        yield
+    finally:
+        _bucket_tls.quantize = prev
 
 
 def float_total_order(x):
@@ -43,9 +68,15 @@ def pad_len(n: int) -> int:
 
 
 def pad_host(values: np.ndarray, n: int | None = None) -> np.ndarray:
-    """Pad a host array with zeros to the sharded length."""
+    """Pad a host array with zeros to the sharded length (quantized up to
+    the active graftfuse pad bucket when one is installed)."""
     n = len(values) if n is None else n
     p = pad_len(n)
+    quantize = getattr(_bucket_tls, "quantize", None)
+    if quantize is not None:
+        # re-run pad_len so a quantizer that answers off the shard grid
+        # still lands on an even shard split
+        p = pad_len(max(p, int(quantize(p))))
     if _costs.COST_ON:
         _costs.note_padding(
             "structural.pad_host",

@@ -102,7 +102,7 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
     """Uniform engine API over jax dispatch and device buffers."""
 
     @classmethod
-    def deploy(cls, func: Callable, f_args: tuple = (), f_kwargs: Optional[dict] = None, num_returns: int = 1) -> Any:
+    def deploy(cls, func: Callable, f_args: tuple = (), f_kwargs: Optional[dict] = None, num_returns: int = 1, donated: bool = False) -> Any:
         """Run ``func`` (usually jit-compiled); returns device buffers (futures:
         jax arrays are async until materialized).
 
@@ -158,7 +158,12 @@ class JaxWrapper(ClassLogger, modin_layer="JAX-ENGINE"):
                 cost_cb=rebind_cb,
             )
             f_args = fresh_args  # provenance must describe the live inputs
-        if _recovery.RECOVERY_ON:
+        if _recovery.RECOVERY_ON and not donated:
+            # a donated dispatch consumes its input buffers: replaying it
+            # from op-replay provenance would re-donate the restored
+            # incarnations under their columns (use-after-donate).  The
+            # fused caller materializes the outputs to host immediately,
+            # so they recover via host lineage, never via replay.
             _recovery.record_deploy(func, f_args, f_kwargs, result)
         if BenchmarkMode.get():
             cls.wait(result)

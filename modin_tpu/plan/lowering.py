@@ -311,6 +311,9 @@ def _lower_reduce(node: Reduce, memo: Dict[int, Any]) -> Any:
     streamed = _maybe_stream(node, memo, groupby=False)
     if streamed is not None:
         return streamed
+    fused = _maybe_fuse(node, memo, groupby=False)
+    if fused is not None:
+        return fused
     child = _lower(node.children[0], memo)
     return getattr(child, node.method)(**node.call_kwargs)
 
@@ -319,6 +322,9 @@ def _lower_groupby(node: GroupbyAgg, memo: Dict[int, Any]) -> Any:
     streamed = _maybe_stream(node, memo, groupby=True)
     if streamed is not None:
         return streamed
+    fused = _maybe_fuse(node, memo, groupby=True)
+    if fused is not None:
+        return fused
     child = _lower(node.children[0], memo)
     by = node.by
     if isinstance(by, Ref):
@@ -338,6 +344,21 @@ def _maybe_stream(node: PlanNode, memo: Dict[int, Any], groupby: bool) -> Any:
     if groupby:
         return streaming.maybe_stream_groupby(node, memo)
     return streaming.maybe_stream_reduce(node, memo)
+
+
+def _maybe_fuse(node: PlanNode, memo: Dict[int, Any], groupby: bool) -> Any:
+    """graftfuse whole-plan hook: compile the entire post-scan segment
+    (filter/map/project chain + this reduce/groupby tail) into ONE donated
+    program when the segment shape supports it and the compile router says
+    the frame is big enough to pay for the trace (plan/fuse.py).  One
+    attribute read while MODIN_TPU_FUSE=Staged."""
+    from modin_tpu.plan import fuse
+
+    if not fuse.FUSE_ON:
+        return None
+    if groupby:
+        return fuse.maybe_fuse_groupby(node, memo)
+    return fuse.maybe_fuse_reduce(node, memo)
 
 
 def _lower_sort(node: Sort, memo: Dict[int, Any]) -> Any:

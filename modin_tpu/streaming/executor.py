@@ -467,8 +467,30 @@ def maybe_stream_reduce(node: Any, memo: dict) -> Optional[Any]:
     hint: List[Any] = [None]
     template_holder: List[Any] = [None]
 
+    # graftfuse window body: the window's filter/map chain and its
+    # reduction as ONE masked program — no host mask compaction, no
+    # logical-length quantization (n rides as a runtime scalar), so every
+    # same-bucket window re-dispatches one cached executable.  The
+    # stream-invariant gates/signature are computed ONCE here; per window
+    # the plan answers None (no filter, staged-routed stream, zero kept
+    # rows, exotic dtypes) to keep the staged quantized body.
+    from modin_tpu.plan import fuse as _fuse
+
+    fused_run = (
+        _fuse.window_reduce_plan(node, scan, ck)
+        if _fuse.FUSE_ON and method != "mean"
+        else None
+    )
+
     @window_body
     def consume(index: int, qc: Any) -> None:
+        if fused_run is not None:
+            fused = fused_run(qc)
+            if fused is not None:
+                partials[index] = _one_column(fused.to_pandas())
+                if hint[0] is None:
+                    hint[0] = "column"
+                return
         sub = {id(scan): qc}
         _seed_filters(node.children, sub)
         child = lowering._lower(node.children[0], sub)

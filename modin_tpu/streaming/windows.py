@@ -182,8 +182,19 @@ class WindowSource:
 def pow2_bucket(m: int) -> int:
     """Power-of-two row bucket (floor 1024) a window pads its physical
     shape to, so every same-bucket window re-dispatches the first one's
-    compiled programs instead of re-tracing for its exact ragged length."""
-    return max(1 << max(m - 1, 1).bit_length(), 1024)
+    compiled programs instead of re-tracing for its exact ragged length.
+    When the compile ledger reports the fused window programs themselves
+    storming (graftfuse storm feedback), the bucket coarsens one level so
+    near-boundary window streams collapse onto fewer executables."""
+    bucket = max(1 << max(m - 1, 1).bit_length(), 1024)
+    try:
+        from modin_tpu.plan.fuse import stream_bucket
+
+        return max(bucket, stream_bucket(bucket))
+    except Exception:
+        # the coarsening consult is an optimization; any import/plan
+        # failure keeps the plain pow2 bucket
+        return bucket
 
 
 def bucketed_column(values: Any, m: int) -> Any:

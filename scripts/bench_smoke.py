@@ -33,6 +33,7 @@ EXPECTED_SECTIONS = (
     "host_udf",
     "graftsort",
     "graftplan",
+    "fusion",
     "recovery",
     "serving",
     "spmd",
@@ -49,6 +50,7 @@ SMOKE_ENV = {
     "BENCH_UDF_ROWS": "2000",
     "BENCH_SORT_ROWS": "120000",
     "BENCH_PLAN_ROWS": "120000",
+    "BENCH_FUSE_ROWS": "120000",
     "BENCH_RECOVERY_ROWS": "150000",
     # the 10% lineage-overhead acceptance belongs to full-scale runs; at
     # smoke scale the workload is ~10ms and scheduler noise alone flakes it

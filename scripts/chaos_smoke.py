@@ -102,11 +102,54 @@ def main() -> int:
         "spilled ballast column lost exactness"
     )
 
+    # ---- scenario 3: DeviceLost during a FUSED donated dispatch ------- #
+    # graftfuse marks donated input columns consumed BEFORE the dispatch;
+    # a mid-dispatch loss must recover bit-exact with the donated inputs
+    # rebuilt via lineage (host copies), never read through the consumed
+    # buffers (the use-after-donate miscompile class).
+    import tempfile
+
+    from modin_tpu.config import FuseMode
+
+    csv_dir = tempfile.mkdtemp(prefix="graftfuse_chaos_")
+    csv_path = os.path.join(csv_dir, "fuse.csv")
+    pdf3 = pandas.DataFrame(
+        {
+            "a": rng.integers(-50, 50, 20_000),
+            "b": rng.uniform(0.0, 1.0, 20_000),
+            "c": rng.uniform(-1.0, 1.0, 20_000),
+        }
+    )
+    pdf3.to_csv(csv_path, index=False)
+    expected3 = pdf3.query("a > 0")[["b", "c"]].agg("sum")
+    seen.clear()
+    with FuseMode.context("Fused"):
+        md3 = pd.read_csv(csv_path)
+        assert md3._query_compiler._plan is not None, "read_csv did not defer"
+        with midquery_device_loss(
+            after_deploys=0, times=1, ops=("deploy",)
+        ) as inj3:
+            got3 = md3.query("a > 0")[["b", "c"]].agg("sum").modin.to_pandas()
+    assert inj3.injected == 1, (
+        f"the fused-dispatch loss never fired (calls={inj3.calls})"
+    )
+    pandas.testing.assert_series_equal(got3, expected3)
+    assert any(m == "modin_tpu.fuse.donated" for m in seen), (
+        f"the fused dispatch donated nothing: {sorted(set(seen))}"
+    )
+    assert any(m.startswith("modin_tpu.recovery.") for m in seen), (
+        f"no recovery engaged for the fused loss: {sorted(set(seen))}"
+    )
+    # the use-after-donate guard: every donated scan column transparently
+    # rebuilds via lineage on its next read — the whole frame round-trips
+    pandas.testing.assert_frame_equal(md3.modin.to_pandas(), pdf3)
+
     print(
         f"graftguard chaos smoke OK: device-lost recovered bit-exact "
         f"({len(recovery_metrics)} recovery metrics, "
         f"{len(reseat_spans)} reseat span(s)); oom burst absorbed after "
-        f"{burst.injected} fault(s) with zero fallbacks"
+        f"{burst.injected} fault(s) with zero fallbacks; fused donated "
+        f"dispatch survived a mid-dispatch loss bit-exact"
     )
     return 0
 

@@ -17,7 +17,11 @@ graftmeter contract:
    columns) are checked against the recorded baseline in
    ``scripts/metrics_baseline.json`` — a refactor that silently doubles
    dispatches, re-reads the file, or stops pruning columns turns this gate
-   red.  Re-record an intentional change with
+   red.  Under graftfuse the deferred aggregation is ONE whole-plan
+   dispatch (ceiling 1), and the dispatch FLOOR of 1 is asserted too — a
+   staged-path regression that silently routes the whole pipeline to
+   pandas (zero device dispatches) can't hide under the ceilings.
+   Re-record an intentional change with
    ``python scripts/metrics_smoke.py --record``.
 
 Exit 0 on success; any assertion prints a diagnostic and exits 1.
@@ -123,16 +127,19 @@ def main(record: bool = False) -> int:
     assert meters.METERS_ON, "MODIN_TPU_METERS=1 did not enable aggregation"
     meters.reset()
 
-    # ---- the pipeline, executed BY explain(analyze=True) --------------- #
+    # ---- the pipeline: the aggregation runs on the DEFERRED plan, so the
+    # counters measure graftfuse's whole-plan program (one dispatch); the
+    # EXPLAIN ANALYZE pass runs after the snapshot and annotates the
+    # filter chain's own (staged) execution
     md = pd.read_csv(path)
     assert md._query_compiler._plan is not None, "read_csv did not defer"
     md3 = md.query("a > 0")[["b", "c"]]
-    analyzed = md3.modin.explain(analyze=True)
-    assert "status: analyzed" in analyzed, analyzed.splitlines()[0]
     planned = md3.agg("sum").modin.to_pandas()
     # snapshot NOW: the baseline must reflect the planned pipeline alone,
-    # not the eager control run below
+    # not the analyze re-run or the eager control run below
     snapshot = meters.snapshot()
+    analyzed = md3.modin.explain(analyze=True)
+    assert "status: analyzed" in analyzed, analyzed.splitlines()[0]
 
     # every optimized-plan node carries measured actuals
     after = analyzed.split("== logical plan (after rewrite, with actuals) ==")[1]
@@ -191,12 +198,18 @@ def main(record: bool = False) -> int:
     if record:
         baseline = {
             "pipeline": "read_csv(6 cols).query('a > 0')[['b','c']]"
-            ".explain(analyze=True) + .agg('sum')  [plan_smoke shape]",
+            ".agg('sum') fused + .explain(analyze=True)  [plan_smoke shape]",
             "max": {
                 key: measured[key]
                 for key in ("dispatches", "compiles", "io_reads", "bytes_parsed")
             },
-            "min": {"pruned_columns": measured["pruned_columns"]},
+            # floors: the fused pipeline must actually RUN on device (a
+            # silent pandas fallback measures 0 dispatches) and pruning
+            # must keep working
+            "min": {
+                "pruned_columns": measured["pruned_columns"],
+                "dispatches": measured["dispatches"],
+            },
         }
         with open(BASELINE_PATH, "w") as f:
             json.dump(baseline, f, indent=2, sort_keys=True)

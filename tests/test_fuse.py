@@ -1,0 +1,532 @@
+"""graftfuse acceptance: whole-plan fused compilation, donation, buckets.
+
+Four layers of coverage:
+
+1. **differential grid** — deferred ``read_csv -> filter/map/project ->
+   reduce | groupby_agg`` pipelines executed three ways (MODIN_TPU_FUSE=
+   Fused / Staged, plus plain pandas) must agree: int/float/bool columns,
+   NaN values, empty sources, filters keeping zero rows, groupby at high
+   and low key cardinality, and ragged physical sizes at bucket
+   boundaries.
+2. **donation** — the fused dispatch consumes sole-consumer input buffers;
+   the owning DeviceColumns transparently restore via lineage on the next
+   access (host round-trip AND a later device op), and a shared buffer is
+   never donated.
+3. **program-cache identity** — the fused-executable cache key carries the
+   mesh shape + device epoch: an in-process ``MeshShape`` flip must never
+   reuse a program traced for another topology (the ``_jit_shuffle``
+   stale-program class).
+4. **routing/bucket units** — ``decide_compile`` forced modes + min-rows
+   floor, and the storm-feedback padding quantizer's escalation levels.
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import FuseMinRows, FuseMode, MeshShape, PlanMode
+from modin_tpu.logging import add_metric_handler, clear_metric_handler
+from modin_tpu.plan import fuse
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu_backend():
+    from modin_tpu.utils import get_current_execution
+
+    if get_current_execution() != "TpuOnJax":
+        pytest.skip("graftfuse rides the TpuOnJax query compiler")
+
+
+@pytest.fixture(autouse=True)
+def _clean_storm_state():
+    fuse.reset_storm_state()
+    yield
+    fuse.reset_storm_state()
+
+
+@pytest.fixture
+def metric_counts():
+    seen = {}
+
+    def handler(name, value):
+        seen[name] = seen.get(name, 0) + value
+
+    add_metric_handler(handler)
+    yield seen
+    clear_metric_handler(handler)
+
+
+_rng = np.random.default_rng(23)
+
+
+def _write_csv(tmp_path, n, name="fuse.csv", nan_frac=0.0):
+    b = _rng.uniform(0, 1, n)
+    c = _rng.uniform(-1, 1, n)
+    if nan_frac and n:
+        idx = _rng.random(n) < nan_frac
+        b = b.copy()
+        b[idx] = np.nan
+    pandas.DataFrame(
+        {
+            "a": _rng.integers(-10, 10, n),
+            "b": b,
+            "c": c,
+            "k": _rng.integers(0, 5, n),
+            "g": _rng.integers(0, 2000, n),
+            "t": _rng.integers(0, 2, n).astype(bool),
+        }
+    ).to_csv(tmp_path / name, index=False)
+    return str(tmp_path / name)
+
+
+def _both_modes(pipeline):
+    """(fused result, staged result) of one deferred-pipeline callable."""
+    with FuseMode.context("Fused"):
+        fused = pipeline().modin.to_pandas()
+    with FuseMode.context("Staged"):
+        staged = pipeline().modin.to_pandas()
+    return fused, staged
+
+
+# ---------------------------------------------------------------------- #
+# 1. differential grid: fused vs staged vs pandas
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize(
+    "agg", ["sum", "mean", "min", "max", "count", "prod", "var", "std"]
+)
+@pytest.mark.parametrize("nan_frac", [0.0, 0.3])
+def test_filter_reduce_grid(tmp_path, agg, nan_frac):
+    path = _write_csv(tmp_path, 3000, nan_frac=nan_frac)
+
+    def pipeline():
+        return pd.read_csv(path).query("a > 0")[["b", "c"]].agg(agg)
+
+    fused, staged = _both_modes(pipeline)
+    reference = pandas.read_csv(path).query("a > 0")[["b", "c"]].agg(agg)
+    pandas.testing.assert_series_equal(fused, reference)
+    pandas.testing.assert_series_equal(staged, reference)
+
+
+@pytest.mark.parametrize("agg", ["sum", "mean", "count", "min", "max"])
+def test_mixed_dtype_reduce(tmp_path, agg):
+    path = _write_csv(tmp_path, 2500)
+
+    def pipeline():
+        # int + float + bool columns through the same masked tail
+        return pd.read_csv(path).query("a > 0")[["a", "b", "t"]].agg(agg)
+
+    fused, staged = _both_modes(pipeline)
+    reference = (
+        pandas.read_csv(path).query("a > 0")[["a", "b", "t"]].agg(agg)
+    )
+    pandas.testing.assert_series_equal(fused, reference)
+    pandas.testing.assert_series_equal(staged, reference)
+
+
+def test_map_chain_into_reduce(tmp_path):
+    path = _write_csv(tmp_path, 2000)
+
+    def pipeline():
+        md = pd.read_csv(path)
+        kept = md[md["a"] > 0]
+        return ((kept["b"] * 2 + kept["c"]) * kept["b"]).sum()
+
+    with FuseMode.context("Fused"):
+        fused = float(pipeline())
+    with FuseMode.context("Staged"):
+        staged = float(pipeline())
+    pdf = pandas.read_csv(path)
+    kept = pdf[pdf["a"] > 0]
+    reference = float(((kept["b"] * 2 + kept["c"]) * kept["b"]).sum())
+    assert fused == pytest.approx(reference, rel=1e-12)
+    assert staged == pytest.approx(reference, rel=1e-12)
+
+
+def test_stacked_filters(tmp_path):
+    path = _write_csv(tmp_path, 2000)
+
+    def pipeline():
+        md = pd.read_csv(path)
+        return md[md["a"] > 0][md[md["a"] > 0]["b"] > 0.5][["b", "c"]].agg("sum")
+
+    def pipeline_pd():
+        df = pandas.read_csv(path)
+        return df[df["a"] > 0][df[df["a"] > 0]["b"] > 0.5][["b", "c"]].agg("sum")
+
+    fused, staged = _both_modes(pipeline)
+    pandas.testing.assert_series_equal(fused, pipeline_pd())
+    pandas.testing.assert_series_equal(staged, pipeline_pd())
+
+
+def test_filter_to_zero_rows_and_empty_frame(tmp_path):
+    path = _write_csv(tmp_path, 1000)
+    empty_path = _write_csv(tmp_path, 0, name="empty.csv")
+
+    def zero_rows():
+        return pd.read_csv(path).query("a > 99")[["b", "c"]].agg("sum")
+
+    def empty():
+        return pd.read_csv(empty_path)[["b", "c"]].agg("sum")
+
+    for pipeline, pd_frame in ((zero_rows, path), (empty, empty_path)):
+        fused, staged = _both_modes(pipeline)
+        if pipeline is zero_rows:
+            reference = (
+                pandas.read_csv(pd_frame).query("a > 99")[["b", "c"]].agg("sum")
+            )
+        else:
+            reference = pandas.read_csv(pd_frame)[["b", "c"]].agg("sum")
+        pandas.testing.assert_series_equal(fused, reference)
+        pandas.testing.assert_series_equal(staged, reference)
+
+
+@pytest.mark.parametrize("agg", ["sum", "mean", "count", "min", "max", "prod"])
+@pytest.mark.parametrize("key", ["k", "g", "t"])  # low / high cardinality / bool
+def test_filter_groupby_grid(tmp_path, agg, key):
+    path = _write_csv(tmp_path, 3000, nan_frac=0.2)
+
+    def pipeline():
+        return pd.read_csv(path).query("a > 0").groupby(key).agg(agg)
+
+    fused, staged = _both_modes(pipeline)
+    reference = pandas.read_csv(path).query("a > 0").groupby(key).agg(agg)
+    pandas.testing.assert_frame_equal(fused, reference)
+    pandas.testing.assert_frame_equal(staged, reference)
+
+
+def test_groupby_without_filter(tmp_path):
+    path = _write_csv(tmp_path, 2000)
+
+    def pipeline():
+        return pd.read_csv(path)[["k", "b", "c"]].groupby("k").agg("mean")
+
+    fused, staged = _both_modes(pipeline)
+    reference = (
+        pandas.read_csv(path)[["k", "b", "c"]].groupby("k").agg("mean")
+    )
+    pandas.testing.assert_frame_equal(fused, reference)
+    pandas.testing.assert_frame_equal(staged, reference)
+
+
+def test_groupby_wide_key_range_declines_to_staged(tmp_path, metric_counts):
+    from modin_tpu.ops import groupby as gb
+
+    n = 1500
+    pandas.DataFrame(
+        {
+            "k": _rng.integers(0, 2**40, n),  # range >> FUSED_MAX_GROUPS
+            "v": _rng.uniform(0, 1, n),
+        }
+    ).to_csv(tmp_path / "wide.csv", index=False)
+    path = str(tmp_path / "wide.csv")
+    assert 2**40 > gb.FUSED_MAX_GROUPS
+    with FuseMode.context("Fused"):
+        got = pd.read_csv(path).groupby("k").agg("sum").modin.to_pandas()
+    reference = pandas.read_csv(path).groupby("k").agg("sum")
+    pandas.testing.assert_frame_equal(got, reference)
+    # the fused leg probed, found the range over the bucket cap, declined
+    assert metric_counts.get("modin_tpu.fuse.decline", 0) >= 1
+
+
+@pytest.mark.parametrize("n", [1023, 1024, 1025])
+def test_ragged_bucket_boundaries(tmp_path, n):
+    """Physical sizes straddling a bucket edge under FORCED quantization
+    stay exact (the bucket only changes padding, never values)."""
+    path = _write_csv(tmp_path, n, name=f"ragged{n}.csv")
+    # force level-2 (pow2) buckets for every signature
+    for _ in range(3 * fuse._STORM_COMPILES):
+        fuse.note_fused_compiles("__test_all__", n, 1)
+
+    real_level = fuse.storm_level
+
+    def pipeline():
+        return pd.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+
+    try:
+        fuse.storm_level = lambda sig: 2
+        with FuseMode.context("Fused"):
+            fused = pipeline().modin.to_pandas()
+    finally:
+        fuse.storm_level = real_level
+    reference = pandas.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+    pandas.testing.assert_series_equal(fused, reference)
+
+
+# ---------------------------------------------------------------------- #
+# 2. donation
+# ---------------------------------------------------------------------- #
+
+
+def test_use_after_donate_restores_via_lineage(tmp_path, metric_counts):
+    path = _write_csv(tmp_path, 2000)
+    with FuseMode.context("Fused"):
+        md = pd.read_csv(path)
+        got = md.query("a > 0")[["b", "c"]].agg("sum").modin.to_pandas()
+        assert metric_counts.get("modin_tpu.fuse.donated", 0) >= 1
+        # the scan compiler's columns were consumed by the donated
+        # dispatch: they read as spilled-with-host-copy (donated flag set)
+        scan_qc = next(
+            iter(md._query_compiler._plan.origin.cache.values())
+        )[0]
+        donated = [
+            c
+            for c in scan_qc._modin_frame._columns
+            if getattr(c, "donated", False)
+        ]
+        assert donated, "no column was marked donated"
+        for col in donated:
+            assert col.is_spilled and col.host_cache is not None
+        # device access FIRST (md still deferred, so the pruned donated
+        # compiler serves): the column transparently re-seats via lineage
+        # and the computation answers exactly, recorded as a donated
+        # restore
+        dev = float((md["b"] * 3).sum())
+        assert dev == pytest.approx(
+            float((pandas.read_csv(path)["b"] * 3).sum()), rel=1e-12
+        )
+        assert metric_counts.get("modin_tpu.fuse.donated_restore", 0) >= 1
+        # host access: the full-width force re-reads what the pruned parse
+        # never carried and serves donated columns from their host copies
+        pandas.testing.assert_frame_equal(
+            md.modin.to_pandas(), pandas.read_csv(path)
+        )
+    reference = pandas.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+    pandas.testing.assert_series_equal(got, reference)
+
+
+def test_donated_dispatch_emits_no_user_warning(tmp_path):
+    """Reduce tails output scalars, so no output aliases a donated input
+    and jax would warn 'Some donated buffers were not usable' per compile;
+    run_fused suppresses it for the donated dispatch only."""
+    import warnings
+
+    path = _write_csv(tmp_path, 2000, name="warn.csv")
+    with FuseMode.context("Fused"):
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            pd.read_csv(path).query("a > 0")[["b", "c"]].agg(
+                "sum"
+            ).modin.to_pandas()
+    assert not [
+        w for w in caught if "donated buffers" in str(w.message)
+    ], [str(w.message) for w in caught]
+
+
+def test_shared_buffer_is_never_donated():
+    from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+
+    values = np.arange(4096, dtype=np.float64)
+    col = DeviceColumn.from_numpy(values)
+    assert col.donation_safe()
+    twin = DeviceColumn(col.raw, col.pandas_dtype, length=col.length)
+    # two live ledger entries hold the same buffer: neither may donate
+    assert not col.donation_safe()
+    assert not twin.donation_safe()
+    del twin
+    import gc
+
+    gc.collect()
+    assert col.donation_safe()
+
+
+def test_donation_requires_host_copy():
+    import jax.numpy as jnp
+
+    from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+    from modin_tpu.ops.structural import pad_host
+
+    data = pad_host(np.arange(100, dtype=np.float64))
+    col = DeviceColumn(jnp.asarray(data), np.dtype(np.float64), length=100)
+    assert col.host_cache is None
+    assert not col.donation_safe()  # nothing to restore from
+
+
+def test_fused_dispatch_in_query_stats(tmp_path):
+    from modin_tpu.observability import meters
+
+    path = _write_csv(tmp_path, 2000)
+    with FuseMode.context("Fused"):
+        md = pd.read_csv(path)
+        with meters.query_stats("fuse-test") as stats:
+            md.query("a > 0")[["b", "c"]].agg("sum").modin.to_pandas()
+    assert stats.fused_dispatches == 1
+    assert stats.donated_bytes > 0
+    assert stats.dispatches == 1
+
+
+# ---------------------------------------------------------------------- #
+# 3. program-cache identity: mesh shape + device epoch in the key
+# ---------------------------------------------------------------------- #
+
+
+def test_mesh_flip_never_reuses_fused_program():
+    from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+    from modin_tpu.ops import lazy
+    from modin_tpu.parallel.mesh import num_row_shards, reset_mesh
+
+    if num_row_shards() < 2:
+        pytest.skip("needs the multi-device virtual mesh")
+
+    values = _rng.uniform(0, 1, 4096)
+
+    def dispatch_once():
+        col = DeviceColumn.from_numpy(values)
+        expr = lazy.lazy_op("mul", col.raw, 2.0)
+        before = set(lazy._FUSED_CACHE)
+        out = lazy.run_fused([expr])[0]
+        new = [k for k in lazy._FUSED_CACHE if k not in before]
+        np.testing.assert_allclose(np.asarray(out), values * 2.0)
+        return new
+
+    first = dispatch_once()
+    assert len(first) == 1
+    try:
+        MeshShape.put((4, 1))
+        reset_mesh()
+        second = dispatch_once()
+        # the same forest under another topology is a NEW cache entry —
+        # the executable traced for the 8-way layout is never reused
+        assert len(second) == 1
+        assert second[0] != first[0]
+        assert second[0][2] != first[0][2]  # the (mesh, epoch) component
+    finally:
+        MeshShape.put((8, 1))
+        reset_mesh()
+
+
+def test_device_epoch_in_fused_key():
+    from modin_tpu.core.execution import recovery
+    from modin_tpu.ops import lazy
+
+    key = lazy._cache_epoch_key()
+    assert key[1] == recovery.current_epoch()
+
+
+# ---------------------------------------------------------------------- #
+# 4. routing + bucket units
+# ---------------------------------------------------------------------- #
+
+
+def test_decide_compile_modes(metric_counts):
+    from modin_tpu.ops.router import decide_compile
+
+    with FuseMode.context("Staged"):
+        assert decide_compile("sig", 10**9) == "staged"
+    with FuseMode.context("Fused"):
+        assert decide_compile("sig", 1) == "fused"
+    with FuseMode.context("Auto"):
+        floor = int(FuseMinRows.get())
+        assert decide_compile("sig", floor - 1) == "staged"
+        assert decide_compile("sig", floor) == "fused"
+    assert metric_counts.get("modin_tpu.router.fuse.fused", 0) >= 2
+    assert metric_counts.get("modin_tpu.router.fuse.staged", 0) >= 2
+
+
+def test_auto_keeps_tiny_frames_staged(tmp_path, metric_counts):
+    path = _write_csv(tmp_path, 500)  # far below the 32768 default floor
+    with FuseMode.context("Auto"):
+        got = (
+            pd.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+        ).modin.to_pandas()
+    reference = pandas.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+    pandas.testing.assert_series_equal(got, reference)
+    assert metric_counts.get("modin_tpu.fuse.dispatch", 0) == 0
+    assert metric_counts.get("modin_tpu.router.fuse.staged", 0) >= 1
+
+
+def test_storm_level_escalation():
+    sig = ("test-sig",)
+    assert fuse.storm_level(sig) == 0
+    fuse.note_fused_compiles(sig, 2048, fuse._STORM_COMPILES)
+    assert fuse.storm_level(sig) == 1
+    fuse.note_fused_compiles(sig, 4096, 2 * fuse._STORM_COMPILES)
+    assert fuse.storm_level(sig) == 2
+
+
+def test_storm_registry_is_bounded():
+    """Per-request literal operands mint fresh signatures (Map payloads
+    embed scalar reprs); the registry must stay capped, LRU-evicted."""
+    for i in range(fuse._MAX_STORM_SIGS + 100):
+        fuse.note_fused_compiles(("sig", i), 2048, 1)
+    assert len(fuse._sig_state) == fuse._MAX_STORM_SIGS
+    # the oldest signatures were evicted, the newest survive
+    assert ("sig", 0) not in fuse._sig_state
+    assert ("sig", fuse._MAX_STORM_SIGS + 99) in fuse._sig_state
+
+
+def test_cold_compiles_of_distinct_plans_never_storm():
+    """Three unrelated plans cold-compiling once each bill the SAME
+    'fuse.lower' ledger signature; that alone must not escalate a
+    signature that has not itself re-compiled across sizes."""
+    sig = ("healthy",)
+    fuse.note_fused_compiles(sig, 2048, 1)
+    fuse.note_fused_compiles(sig, 4096, 0)  # second size, cache hit
+    # two shapes but only ONE own compile: no escalation regardless of
+    # what the shared ledger entry looks like
+    assert fuse.storm_level(sig) == 0
+
+
+def test_quantize_padded_levels():
+    # level 0: exact, always
+    assert fuse.quantize_padded(5000, 0) == 5000
+    # below the floor: exact at every level (unit-test frames untouched)
+    assert fuse.quantize_padded(1000, 2) == 1000
+    # level 1: eighth-octave steps (<= 12.5% waste)
+    q1 = fuse.quantize_padded(5000, 1)
+    assert q1 >= 5000 and (q1 - 5000) / 5000 <= 0.125
+    assert q1 % (8192 // 8) == 0
+    # level 2: pow2
+    assert fuse.quantize_padded(5000, 2) == 8192
+    assert fuse.quantize_padded(8192, 2) == 8192
+
+
+def test_pad_bucket_scope_unit():
+    from modin_tpu.ops.structural import pad_bucket_scope, pad_host, pad_len
+
+    v = np.arange(3000, dtype=np.float64)
+    assert len(pad_host(v)) == pad_len(3000)
+    with pad_bucket_scope(lambda p: fuse.quantize_padded(p, 2)):
+        assert len(pad_host(v)) == 4096
+    assert len(pad_host(v)) == pad_len(3000)  # scope restored
+    with pad_bucket_scope(None):  # no-op scope
+        assert len(pad_host(v)) == pad_len(3000)
+
+
+def test_quantizer_applies_to_scan_upload(tmp_path, metric_counts):
+    """Under a stormed signature the scan's columns upload at the bucketed
+    physical size (fuse.bucket.quantized fires); results stay exact."""
+    n = 3000
+    path = _write_csv(tmp_path, n, name="bucketed.csv")
+    real_level = fuse.storm_level
+    try:
+        fuse.storm_level = lambda sig: 2
+        with FuseMode.context("Fused"):
+            got = (
+                pd.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+            ).modin.to_pandas()
+    finally:
+        fuse.storm_level = real_level
+    assert metric_counts.get("modin_tpu.fuse.bucket.quantized", 0) > 0
+    reference = pandas.read_csv(path).query("a > 0")[["b", "c"]].agg("sum")
+    pandas.testing.assert_series_equal(got, reference)
+
+
+def test_segment_signature_stable_across_leaves(tmp_path):
+    """Two queries with the same shape over different files share one
+    signature (the storm counters aggregate by plan shape, not by file)."""
+    p1 = _write_csv(tmp_path, 1200, name="s1.csv")
+    p2 = _write_csv(tmp_path, 1700, name="s2.csv")
+
+    def plan_of(path):
+        md = pd.read_csv(path).query("a > 0")[["b", "c"]]
+        from modin_tpu.plan import ir, rules
+
+        root = ir.Reduce(md._query_compiler._plan, "sum", {})
+        optimized, _ = rules.optimize(root)
+        return fuse.segment_signature(optimized)
+
+    with PlanMode.context("Auto"):
+        assert plan_of(p1) == plan_of(p2)

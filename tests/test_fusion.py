@@ -58,7 +58,8 @@ def test_map_reduce_fuses_to_one_program(dfs):
     new_keys = [k for k in lazy._FUSED_CACHE if k not in before]
     # exactly one new fused executable: mul+add+reduce in a single jit
     assert len(new_keys) == 1
-    fingerprint, tail_key = new_keys[0]
+    # key = (fingerprint, tail_key, (mesh shape, device epoch), donated)
+    fingerprint, tail_key = new_keys[0][0], new_keys[0][1]
     ops_in_program = [node[0] for node in fingerprint[0]]
     assert ops_in_program == ["mul", "add"]
     assert tail_key[0] == "reduce" and tail_key[1] == "sum"
@@ -85,7 +86,7 @@ def test_diamond_subexpression_computed_once(dfs):
     result = out.to_numpy()
     new_keys = [k for k in lazy._FUSED_CACHE if k not in before]
     if new_keys:  # may be cached from a prior test run
-        fingerprint, _ = new_keys[0]
+        fingerprint = new_keys[0][0]
         ops = [node[0] for node in fingerprint[0]]
         assert ops.count("mul") == 1  # diamond: mul appears once
     expected = pdf["a"] * pdf["b"]

@@ -393,6 +393,156 @@ def test_jit_hazard_sees_through_shard_map(tmp_path):
     assert {f.symbol for f in result.findings} == {"local_fn-branch-if"}
 
 
+def test_jit_hazard_flags_read_after_donated_position(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def run(g, x, y):
+                f = jax.jit(g, donate_argnums=(0,))
+                out = f(x, y)
+                return out + x    # BAD: x's buffer was donated to f
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "donated-x" in symbols
+    # y was NOT in a donated position
+    assert "donated-y" not in symbols
+
+
+def test_jit_hazard_flags_same_line_read_after_donated_call(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def run(g, x):
+                f = jax.jit(g, donate_argnums=(0,))
+                return f(x) + x    # BAD: read right after the call consumed x
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    assert "donated-x" in {f.symbol for f in result.findings}
+
+
+def test_jit_hazard_donation_uses_earliest_consuming_call(tmp_path):
+    """ast.walk is BFS: a nested (earlier-in-source) donated call must
+    still anchor the consumption point, or a read between two calls slips
+    through."""
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def run(g, x, cond):
+                f = jax.jit(g, donate_argnums=(0,))
+                if cond:
+                    f(x)          # nested: consumed HERE first
+                probe = x + 1     # BAD: read after the nested donated call
+                return f(x), probe
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    lines = {
+        f.line for f in result.findings if f.symbol == "donated-x"
+    }
+    assert 8 in lines, result.findings  # the `probe = x + 1` load
+
+
+def test_jit_hazard_donation_negative_cases(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def clean_before(g, x):
+                pre = x + 1            # read BEFORE the donated call: fine
+                f = jax.jit(g, donate_argnums=(0,))
+                return f(x) + pre
+
+            def clean_rebind(g, x):
+                f = jax.jit(g, donate_argnums=(0,))
+                out = f(x)
+                x = out * 2            # rebind: the name no longer holds
+                return x + 1           # the donated buffer
+
+            def clean_self_rebind(g, x):
+                f = jax.jit(g, donate_argnums=(0,))
+                x = f(x)               # the idiomatic donation pattern:
+                return x + 1           # x now holds the program's OUTPUT
+
+            def clean_undonated(g, x):
+                f = jax.jit(g)
+                out = f(x)
+                return out + x         # no donation anywhere
+
+            def clean_nested_def(g, x):
+                f = jax.jit(g, donate_argnums=(0,))
+                def later():
+                    return f(x)        # consumes only when CALLED
+                probe = x + 1          # runs at definition time: clean
+                return later, probe
+
+            def clean_exclusive_branches(g, x, cond):
+                f = jax.jit(g, donate_argnums=(0,))
+                if cond:
+                    out = f(x)
+                else:
+                    out = x + 1        # never runs after f(x): clean
+                return out
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    assert not {
+        f.symbol for f in result.findings if f.symbol.startswith("donated-")
+    }
+
+
+def test_jit_hazard_donation_nested_and_loop_legs(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "pkg/mod.py": """
+            import jax
+
+            def outer(g, x):
+                f = jax.jit(g, donate_argnums=(0,))
+                def inner(y):
+                    out = f(y)
+                    return out + y     # BAD: y consumed inside inner
+                return inner
+
+            def loop_branches(g, x, conds):
+                f = jax.jit(g, donate_argnums=(0,))
+                for cond in conds:
+                    if cond:
+                        out = f(x)
+                    else:
+                        out = x + 1    # BAD: iteration 2 reads after
+                return out             # iteration 1 donated x
+            """
+        },
+        select=["JIT-HAZARD"],
+    )
+    donated = [f for f in result.findings if f.symbol.startswith("donated-")]
+    # the nested hazard reports ONCE (inner's own walk), not once per
+    # enclosing function
+    inner_hits = [f for f in donated if f.scope.endswith("inner")]
+    assert len(inner_hits) == 1, donated
+    # the loop keeps the exclusive-branch exemption OFF: flagged
+    assert any(f.scope.endswith("loop_branches") for f in donated), donated
+
+
 # ---------------------------------------------------------------------- #
 # FALLBACK-PARITY
 # ---------------------------------------------------------------------- #
