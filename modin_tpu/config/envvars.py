@@ -1121,6 +1121,83 @@ class ServingDegradedHighWater(EnvironmentVariable, type=float):
         super().put(value)
 
 
+class ViewsMode(EnvironmentVariable, type=str):
+    """graftview derived-artifact cache (modin_tpu/views/): whole reduction
+    results, nunique/mode/median answers, small groupby output tables, and
+    the sorted representations cached per (op fingerprint, column identity,
+    device epoch, mesh shape) and shared across every query on the same
+    buffers — with append-only (``concat``) growth folding ONLY the
+    appended tail into algebraic artifacts instead of recomputing.
+
+    Auto (default): consult and maintain artifacts on the device hot
+    paths.  Off: never consult the registry — bit-for-bit the pre-graftview
+    behavior, at the cost of one module-attribute read per gated hook
+    (``views.VIEWS_ON``, the graftscope zero-overhead-when-off contract).
+    The pre-existing sorted-representation cache is NOT gated here: it
+    predates graftview and keeps its own semantics in both modes.
+    """
+
+    varname = "MODIN_TPU_VIEWS"
+    choices = ("Auto", "Off")
+    default = "Auto"
+
+
+class ViewsMaxEntries(EnvironmentVariable, type=int):
+    """Cap on live artifacts in the graftview registry; the coldest
+    entries are evicted (``view.evict``) past it.  Bounds per-process
+    memory under serving workloads that mint many distinct (op, column)
+    pairs."""
+
+    varname = "MODIN_TPU_VIEWS_MAX_ENTRIES"
+    default = 4096
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Views entry cap should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class ViewsHostBudget(EnvironmentVariable, type=int):
+    """Host-byte budget for artifact STATE (scalar results, groupby partial
+    tables) held by the graftview registry; coldest artifacts evicted past
+    it.  Device payloads are budgeted separately by the device ledger
+    (``MODIN_TPU_DEVICE_MEMORY_BUDGET``), where pressure drops them before
+    any real column spills."""
+
+    varname = "MODIN_TPU_VIEWS_HOST_BUDGET"
+    default = 128 * 1024 * 1024
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Views host budget should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class ViewsMaxGroups(EnvironmentVariable, type=int):
+    """Group-count bound for cacheable groupby output tables (graftview):
+    results with more groups than this are never cached or folded — the
+    partial-state table must stay small enough that host-side combining
+    beats device recomputation, exactly the bound graftstream's windowed
+    groupby applies via ``MODIN_TPU_STREAM_MAX_GROUPS``."""
+
+    varname = "MODIN_TPU_VIEWS_MAX_GROUPS"
+    default = 65536
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Views group bound should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

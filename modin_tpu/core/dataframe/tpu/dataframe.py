@@ -108,6 +108,7 @@ class DeviceColumn:
     __slots__ = (
         "_data", "pandas_dtype", "length", "host_cache", "_ledger_key",
         "lineage", "_device_epoch", "_dev_key", "_sorted_rep", "donated",
+        "_view_token", "_view_parent",
         "__weakref__",
     )
     is_device = True
@@ -132,6 +133,10 @@ class DeviceColumn:
         self._dev_key = None
         self._sorted_rep = None  # graftsort: cached (sorted, n_valid) rep
         self.donated = False  # graftfuse: buffer consumed by a donated dispatch
+        # graftview identity: process-unique token (lazily allocated) and
+        # the (parent_token, parent_length) append link
+        self._view_token = None
+        self._view_parent = None
         if host_cache is not None:
             # host caches count against the Memory spill budget (core/memory.py)
             from modin_tpu.core.memory import ledger
@@ -200,12 +205,18 @@ class DeviceColumn:
         recovery.attach_lineage(self)
 
     def _invalidate_sorted(self) -> None:
-        """Drop the cached sorted representation — the buffer this column
-        answers for is about to change (spill / re-seat / materialize)."""
+        """Drop every derived cache answering for this column's buffer —
+        it is about to change (spill / re-seat / materialize / donation):
+        the graftsort sorted rep and every graftview artifact registered
+        under the column's token."""
         if self._sorted_rep is not None:
             from modin_tpu.ops.sorted_cache import invalidate
 
             invalidate(self)
+        if self._view_token is not None:
+            from modin_tpu.views import registry as views_registry
+
+            views_registry.invalidate_column(self, reason="buffer")
 
     def spill(self) -> int:
         """Drop the device buffer, keeping an exact host copy; returns the
@@ -472,7 +483,9 @@ class HostColumn:
     are replaced, never mutated in place, so the caches cannot go stale.
     """
 
-    __slots__ = ("data", "_dict_cache", "_cat_cache")
+    # __weakref__: graftview host-identity guards (views/groupby_cache.py)
+    # pin cached results to the exact live column objects via weakrefs
+    __slots__ = ("data", "_dict_cache", "_cat_cache", "__weakref__")
     is_device = False
 
     def __init__(self, data: Any):
@@ -822,6 +835,8 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
         ]
         new_columns: List[Column] = [None] * self.num_cols
         device_cis = [ci for ci in range(self.num_cols) if device_ok[ci]]
+        from modin_tpu import views as graftview
+
         if device_cis:
             parts = [[f._columns[ci].data for ci in device_cis] for f in frames]
             datas, n_out = concat_columns(parts, lengths)
@@ -832,9 +847,17 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
                 cache = None
                 if all(c is not None for c in caches):
                     cache = np.concatenate(caches)
-                new_columns[ci] = DeviceColumn(
+                new_col = DeviceColumn(
                     d, cols[0].pandas_dtype, length=total, host_cache=cache
                 )
+                if graftview.VIEWS_ON:
+                    # graftview append link: the new column's first
+                    # len(self) rows ARE self's column — artifacts built
+                    # from it fold only the appended tail on the next query
+                    from modin_tpu.views import registry as views_registry
+
+                    views_registry.note_append(new_col, cols[0])
+                new_columns[ci] = new_col
         for ci in range(self.num_cols):
             if device_ok[ci]:
                 continue
@@ -848,11 +871,36 @@ class TpuDataframe(BaseDataframe, ClassLogger, modin_layer="CORE-FRAME"):
                 if len(dtypes) == 1:
                     # keep the exact dtype: re-inference would e.g. turn the
                     # pandas-3 'str' dtype into the 'string' extension dtype
-                    new_columns[ci] = HostColumn(
-                        pandas.array(values, dtype=next(iter(dtypes)))
-                    )
+                    arr = pandas.array(values, dtype=next(iter(dtypes)))
                 else:
-                    new_columns[ci] = HostColumn(pandas.array(values))
+                    arr = pandas.array(values)
+                if isinstance(arr, pandas.arrays.NumpyExtensionArray):
+                    # store the raw ndarray, exactly like from_pandas: a
+                    # NumpyEADtype('object') compares unequal to the
+                    # np.dtype(object) every dispatch check expects, which
+                    # would make a CHAINED concat fail the dtype-equality
+                    # gate and fall back to pandas
+                    arr = np.asarray(arr)
+                new_columns[ci] = HostColumn(arr)
+                if (
+                    graftview.VIEWS_ON
+                    and getattr(self._columns[ci], "_dict_cache", None)
+                    not in (None, False)
+                ):
+                    # graftview dictionary maintenance: the prefix already
+                    # paid its factorize — extend the code table with only
+                    # the appended tail instead of re-encoding n_out rows
+                    # on the next string groupby/nunique
+                    from modin_tpu.views.incremental import extend_dict_encoding
+
+                    ext = extend_dict_encoding(
+                        self._columns[ci], values[lengths[0]:]
+                    )
+                    if ext is not None:
+                        new_columns[ci]._dict_cache = ext
+                        from modin_tpu.logging.metrics import emit_metric
+
+                        emit_metric("view.fold", 1)
         lazies = [f._index for f in frames]
 
         def build_index() -> pandas.Index:

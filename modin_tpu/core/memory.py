@@ -25,10 +25,11 @@ Two budgets, two ledgers, one spill policy each way:
 
 The device ledger tracks two kinds of entries under one spill protocol:
 column buffers (``DeviceColumn`` — spill keeps an exact host copy) and
-graftsort sorted-representation reps (``ops/sorted_cache.SortedRep``,
-marked ``is_derived_cache`` — spill just drops them; derived data is
-rebuilt on demand, so reclaiming a rep is the cheapest spill available
-and LRU order naturally prefers cold reps over cold columns).
+derived caches (graftsort's ``SortedRep`` and graftview's
+``DerivedArtifact``, marked ``is_derived_cache`` — spill just drops them;
+derived data is rebuilt on demand).  A pressure pass spills derived
+entries FIRST (coldest-first within each tier): reclaiming them is free,
+so no real column pays a device->host copy while disposable bytes remain.
 """
 
 from __future__ import annotations
@@ -118,6 +119,13 @@ class _HostCacheLedger:
                 col._ledger_key = None
                 self._entries.pop(key)
                 self._total -= nbytes
+
+
+def _is_derived(col: Any) -> bool:
+    """Whether a device-ledger entry is a derived cache (sorted rep /
+    graftview artifact) — dropped free, so spilled before real columns.
+    A dead weakref sorts with the columns; the spill loop skips it."""
+    return col is not None and getattr(col, "is_derived_cache", False)
 
 
 def _evictable(col: Any) -> bool:
@@ -322,6 +330,13 @@ class _DeviceLedger:
         """
         with self._lock:
             candidates = list(self._entries.items())
+        # derived caches first (graftview/graftsort artifacts: "spill" just
+        # drops them, no host transfer, and they rebuild on demand), each
+        # tier coldest-first — pressure reclaims every disposable byte
+        # before any real column pays a device->host copy
+        candidates.sort(
+            key=lambda e: not _is_derived(e[1][0]())
+        )
         freed = 0
         spilled = 0
         try:

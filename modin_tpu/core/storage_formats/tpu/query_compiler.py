@@ -49,6 +49,7 @@ from modin_tpu.parallel.engine import materialize as _engine_materialize
 from modin_tpu.plan import explain as graftplan_explain
 from modin_tpu.plan import runtime as graftplan
 from modin_tpu import streaming as graftstream
+from modin_tpu import views as graftview
 
 
 def _decide_windowed(op: str, frames: tuple) -> bool:
@@ -1187,23 +1188,57 @@ class TpuQueryCompiler(BaseQueryCompiler):
             # graftsort: concrete columns take the shared-sorted-
             # representation median (one sort amortized across the whole
             # sort-shaped family, correct skipna=False semantics),
-            # router-gated; lazy chains keep the fused nanmedian tail
+            # router-gated; lazy chains keep the fused nanmedian tail.
+            # graftview: a cached whole-result artifact answers without any
+            # device work and flips the router crossover ("view" strategy)
             from modin_tpu.ops import sorted_cache
             from modin_tpu.ops.router import decide
 
+            from modin_tpu.views import reduce_cache as view_reduce
+
+            med_params = (bool(skipna),)
+            cached_med: dict = {}
+            if graftview.VIEWS_ON:
+                cached_med = view_reduce.sort_reduce_lookup(
+                    "median", med_params, sel_cols
+                )
             strategies = [
-                "cached" if sorted_cache.peek(c) else "sort" for c in sel_cols
+                "view" if i in cached_med
+                else ("cached" if sorted_cache.peek(c) else "sort")
+                for i, c in enumerate(sel_cols)
             ]
             if decide("median", len(frame), strategies) == "host":
                 return None
-            values = reductions.median_columns(
-                sel_cols, len(frame), skipna=skipna
+            view_reduce.sort_reduce_consume(
+                "median", med_params, sel_cols, cached_med
             )
+            values = [None] * len(sel_cols)
+            miss_is = [i for i in range(len(sel_cols)) if i not in cached_med]
+            if miss_is:
+                got = reductions.median_columns(
+                    [sel_cols[i] for i in miss_is], len(frame), skipna=skipna
+                )
+                for i, v in zip(miss_is, got):
+                    values[i] = v
+                    if graftview.VIEWS_ON:
+                        view_reduce.sort_reduce_store(
+                            "median", med_params, sel_cols[i], v
+                        )
+            for i, v in cached_med.items():
+                values[i] = v
         else:
-            values = reductions.reduce_columns(
-                op, arrays, len(frame), skipna=skipna, ddof=ddof,
-                cast_bool=cast_bool, donate_cols=donate_cols,
-            )
+            values = None
+            if graftview.VIEWS_ON and not donate_cols:
+                from modin_tpu.views.reduce_cache import cached_reduce
+
+                values = cached_reduce(
+                    op, sel_cols, len(frame), skipna, ddof, cast_bool
+                )
+            if values is None:
+                values = reductions.reduce_columns(
+                    op, arrays, len(frame), skipna=skipna, ddof=ddof,
+                    cast_bool=cast_bool, donate_cols=donate_cols,
+                )
         out_values = []
         for pos, v in zip(positions, values):
             v = v.item() if v.ndim == 0 else v
@@ -1302,10 +1337,36 @@ class TpuQueryCompiler(BaseQueryCompiler):
         specs, _ = got
         frame.materialize_device()
         n = len(frame)
-        plans = reductions.plan_sort_reduce("nunique", specs, n)
-        if decide("nunique", n, [p.strategy for p in plans]) == "host":
+        # graftview: whole-result artifacts answer cached columns with zero
+        # device work (no histogram probe, no sort) and plan as "view"
+        from modin_tpu.views import reduce_cache as view_reduce
+
+        keyed = [
+            spec["col"] if "n_categories" not in spec else None
+            for spec in specs
+        ]
+        nu_params = (bool(dropna),)
+        cached_vals = (
+            view_reduce.sort_reduce_lookup("nunique", nu_params, keyed)
+            if graftview.VIEWS_ON
+            else {}
+        )
+        miss_is = [i for i in range(len(specs)) if i not in cached_vals]
+        plans = reductions.plan_sort_reduce(
+            "nunique", [specs[i] for i in miss_is], n
+        )
+        strategies = ["view"] * len(cached_vals) + [p.strategy for p in plans]
+        if decide("nunique", n, strategies) == "host":
             return None
-        counts = reductions.nunique_planned(plans, n, bool(dropna))
+        view_reduce.sort_reduce_consume("nunique", nu_params, keyed, cached_vals)
+        sub_counts = reductions.nunique_planned(plans, n, bool(dropna))
+        counts: list = [None] * len(specs)
+        for i, v, p in zip(miss_is, sub_counts, plans):
+            counts[i] = v
+            if graftview.VIEWS_ON and keyed[i] is not None and p.strategy != "dict":
+                view_reduce.sort_reduce_store("nunique", nu_params, keyed[i], v)
+        for i, v in cached_vals.items():
+            counts[i] = v
         result = pandas.Series(counts, index=frame.columns, dtype=np.int64)
         return type(self).from_pandas(
             result.to_frame(MODIN_UNNAMED_SERIES_LABEL)
@@ -1370,12 +1431,43 @@ class TpuQueryCompiler(BaseQueryCompiler):
         specs, decoders = got
         frame.materialize_device()
         n = len(frame)
-        plans = reductions.plan_sort_reduce("mode", specs, n)
+        # graftview: cached per-column (modal values, nan_modal) artifacts
+        # skip device work entirely and plan as "view"
+        from modin_tpu.views import reduce_cache as view_reduce
+
+        keyed = [
+            spec["col"] if "n_categories" not in spec else None
+            for spec in specs
+        ]
+        mode_params = (bool(dropna),)
+        cached_vals = (
+            view_reduce.sort_reduce_lookup("mode", mode_params, keyed)
+            if graftview.VIEWS_ON
+            else {}
+        )
+        miss_is = [i for i in range(len(specs)) if i not in cached_vals]
+        plans = reductions.plan_sort_reduce(
+            "mode", [specs[i] for i in miss_is], n
+        )
         if not dropna and any(p.strategy != "hist" for p in plans):
             return None  # NaN-counting mode needs the histogram everywhere
-        if decide("mode", n, [p.strategy for p in plans]) == "host":
+        strategies = ["view"] * len(cached_vals) + [p.strategy for p in plans]
+        if decide("mode", n, strategies) == "host":
             return None
-        per_col = reductions.mode_planned(plans, n, bool(dropna))
+        view_reduce.sort_reduce_consume("mode", mode_params, keyed, cached_vals)
+        sub_cols = reductions.mode_planned(plans, n, bool(dropna))
+        per_col: list = [None] * len(specs)
+        for i, v, p in zip(miss_is, sub_cols, plans):
+            per_col[i] = v
+            if (
+                graftview.VIEWS_ON
+                and v is not None
+                and keyed[i] is not None
+                and p.strategy != "dict"
+            ):
+                view_reduce.sort_reduce_store("mode", mode_params, keyed[i], v)
+        for i, v in cached_vals.items():
+            per_col[i] = v
         if any(v is None for v in per_col):
             return None
         pieces = []
@@ -3613,10 +3705,39 @@ class TpuQueryCompiler(BaseQueryCompiler):
             )
             if planned is not None:
                 return planned
+        views_args = None
+        if (
+            graftview.VIEWS_ON
+            and axis == 0
+            and not agg_args
+            and isinstance(agg_func, str)
+        ):
+            # graftview: a prior identical aggregation on these exact
+            # buffers answers from the artifact registry — and an appended
+            # frame folds only the tail rows through the device groupby
+            from modin_tpu.views import groupby_cache
+
+            views_args = (
+                by, agg_func, groupby_kwargs or {}, agg_kwargs or {}, drop,
+                series_groupby, selection,
+            )
+            try:
+                cached = groupby_cache.groupby_consult(self, *views_args)
+            except Exception:  # graftlint: disable=EXC-HYGIENE -- cache consult is best-effort: ANY failure (registry bug included) must degrade to the ordinary device path, never break the query
+                cached = None
+            if cached is not None:
+                return cached
         result = self._try_device_groupby(
             by, agg_func, axis, groupby_kwargs or {}, agg_args, agg_kwargs or {},
             drop, series_groupby, selection,
         )
+        if result is not None and views_args is not None:
+            from modin_tpu.views import groupby_cache
+
+            try:
+                groupby_cache.groupby_record(self, result, *views_args)
+            except Exception:  # graftlint: disable=EXC-HYGIENE -- cache recording is best-effort: the computed result is already correct and must be returned regardless
+                pass
         if result is None:
             result = self._try_device_groupby_multi(
                 by, agg_func, axis, groupby_kwargs or {}, agg_args,

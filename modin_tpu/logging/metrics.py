@@ -234,6 +234,54 @@ METRICS = (
         "device-memory ledger reclaimed it under pressure)",
     ),
     (
+        "view.hit",
+        "counter",
+        "graftview derived-artifact registry answers: a whole reduction "
+        "result / sort-shaped answer / groupby table served without any "
+        "device work, shared across every query on the same buffer epoch",
+    ),
+    (
+        "view.miss",
+        "counter",
+        "graftview registry consults that found no usable artifact (the "
+        "op computes from scratch and stores one)",
+    ),
+    (
+        "view.build",
+        "counter",
+        "graftview artifacts cached after a from-scratch computation",
+    ),
+    (
+        "view.fold",
+        "counter",
+        "graftview incremental maintenance: an artifact absorbed an "
+        "appended tail (algebraic scalar combine, groupby partial-table "
+        "combine, or dictionary code-table extension) instead of a full "
+        "recompute — only the delta was dispatched",
+    ),
+    (
+        "view.invalidate.*",
+        "counter",
+        "graftview artifacts dropped, by reason: buffer (mutation / spill "
+        "/ re-seat / donation), device_epoch (recovery pass), "
+        "mesh_reshape, not_incremental (an append reached an artifact "
+        "with no fold rule — dropped once its owning column is gone; a "
+        "live parent keeps its warm answer and the child just misses), "
+        "pressure (the ledger reclaimed a cold column's caches), dead",
+    ),
+    (
+        "view.evict",
+        "counter",
+        "graftview artifacts evicted coldest-first past "
+        "MODIN_TPU_VIEWS_MAX_ENTRIES / MODIN_TPU_VIEWS_HOST_BUDGET",
+    ),
+    (
+        "view.spill",
+        "counter",
+        "graftview device-payload artifacts dropped by the device-memory "
+        "ledger under pressure (before any real column spills)",
+    ),
+    (
         "plan.defer.scan",
         "counter",
         "reads deferred into graftplan Scan-rooted logical plans instead "

@@ -482,6 +482,9 @@ class QueryStats:
         "stream_wait_s",
         "fused_dispatches",
         "donated_bytes",
+        "view_hits",
+        "view_folds",
+        "view_invalidations",
         "_t0",
         "_lock",
         "_closed",
@@ -538,6 +541,12 @@ class QueryStats:
         # and the HBM released to XLA by buffer donation under this scope
         self.fused_dispatches = 0
         self.donated_bytes = 0
+        # graftview: derived-artifact registry traffic under this scope —
+        # whole results served from cache, appends absorbed by folds, and
+        # artifacts honestly invalidated
+        self.view_hits = 0
+        self.view_folds = 0
+        self.view_invalidations = 0
         self._t0 = time.perf_counter()
 
     # -- stream routing -------------------------------------------------- #
@@ -596,6 +605,12 @@ class QueryStats:
         elif name == "fuse.donated_bytes":
             self.donated_bytes += int(value)
             self._sample_hbm()
+        elif name == "view.hit":
+            self.view_hits += int(value)
+        elif name == "view.fold":
+            self.view_folds += int(value)
+        elif name.startswith("view.invalidate."):
+            self.view_invalidations += int(value)
         elif name == "stream.window.replay":
             self.stream_replays += int(value)
         elif name == "stream.prefetch.overlap_s":
@@ -654,6 +669,9 @@ class QueryStats:
             "stream_wait_s": self.stream_wait_s,
             "fused_dispatches": self.fused_dispatches,
             "donated_bytes": self.donated_bytes,
+            "view_hits": self.view_hits,
+            "view_folds": self.view_folds,
+            "view_invalidations": self.view_invalidations,
         }
 
     def summary(self) -> str:
@@ -674,6 +692,12 @@ class QueryStats:
             lines.append(
                 f"fuse: {self.fused_dispatches} whole-plan dispatch(es), "
                 f"{self.donated_bytes} bytes donated"
+            )
+        if self.view_hits or self.view_folds or self.view_invalidations:
+            lines.append(
+                f"views: {self.view_hits} artifact hit(s), "
+                f"{self.view_folds} incremental fold(s), "
+                f"{self.view_invalidations} invalidation(s)"
             )
         if self.stream_windows:
             busy = self.stream_overlap_s + self.stream_wait_s

@@ -111,6 +111,13 @@ SPANS = (
         "attributes",
     ),
     (
+        "view.fold",
+        "one graftview incremental-maintenance fold: the appended tail "
+        "gathered and reduced (scalar combine) or grouped (partial-table "
+        "combine) and merged into the cached artifact; op, column count, "
+        "base and tail row counts in attributes",
+    ),
+    (
         "plan.optimize",
         "one graftplan rewrite pass to fixpoint over a pending logical "
         "plan (node count in attributes; applied rules become plan.rule.* "

@@ -44,9 +44,12 @@ from modin_tpu.observability import spans as graftscope
 
 #: column strategies a sort-shaped plan may carry (see plan_strategies in
 #: ops/reductions.py): "dict" costs ~0 (host categories already known),
-#: "cached" consumes an existing sorted representation, "hist" is the O(n)
-#: segment-sum path, "sort" pays the full O(n log n) device sort
-STRATEGIES = ("dict", "cached", "hist", "sort")
+#: "view" costs 0 on device (a graftview whole-result artifact already
+#: holds the answer — flipping the crossover exactly like the sorted-rep
+#: amortization leg, one stage further), "cached" consumes an existing
+#: sorted representation, "hist" is the O(n) segment-sum path, "sort" pays
+#: the full O(n log n) device sort
+STRATEGIES = ("dict", "view", "cached", "hist", "sort")
 
 #: predicted device-minus-host savings (seconds) the host side must clear
 #: before auto routing declines a device path: below this the decision is
@@ -311,6 +314,7 @@ def predicted_costs(
     consume = table["device_consume_s"] * scale
     per_strategy = {
         "dict": 0.0,
+        "view": 0.0,  # graftview result artifact: the answer is cached
         "cached": consume,
         "hist": table["device_hist_s"] * scale,
         "sort": table["device_sort_s"] * logscale + consume,
@@ -318,6 +322,8 @@ def predicted_costs(
     device_s = sum(per_strategy[s] for s in strategies)
     # host cost is cardinality-sensitive: hist/dict columns are the
     # low-cardinality regime pandas hashes fast, sort columns the slow one
+    # (a view-cached column bills host at the slow regime: the host side
+    # would have to recompute it from scratch)
     host_s = sum(
         table[
             f"host_{op}_{'low' if s in ('hist', 'dict') else 'high'}_s"

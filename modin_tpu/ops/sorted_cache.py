@@ -1,110 +1,94 @@
-"""Per-column sorted-representation cache (graftsort).
+"""Per-column sorted-representation cache (graftsort) — graftview shim.
 
 The sort-shaped reductions (median, quantile, nunique, mode) all begin with
 the same prefix: sort the column with NaN/pad rows collapsed to the tail and
-count the valid prefix (``ops/sort.py sorted_valid_columns``).  Before this
-cache, that prefix was recomputed inside every op's own jit — four ops on
-one column paid four O(n log n) sorts.  Now the first op attaches the
-``(sorted values, n_valid)`` pair to its ``DeviceColumn`` as a
+count the valid prefix (``ops/sort.py sorted_valid_columns``).  The first op
+attaches the ``(sorted values, n_valid)`` pair to its ``DeviceColumn`` as a
 :class:`SortedRep` and every later op consumes it with an O(n) pass.
 
-Correctness contract:
+Since graftview (modin_tpu/views/) this module is a **compatibility shim**:
+``SortedRep`` is a :class:`~modin_tpu.views.registry.DerivedArtifact`
+subclass and the lock, validity stamps (buffer identity / device epoch /
+mesh-shape key), ledger registration, and recovery protocol all live in the
+shared registry — the invalidation bookkeeping that used to be duplicated
+here is gone.  What stays local:
 
-- **Identity**: a rep is valid only while the column still holds the exact
-  buffer it was computed from (``source_id == id(col._data)``) in the
-  current device epoch.  Every mutation of the column's buffer — spill,
-  spill-restore, lineage re-seat, lazy materialization — additionally drops
-  the rep eagerly (``DeviceColumn._invalidate_sorted``), so the identity
-  check is belt-and-braces, not the only line of defense.
-- **Memory**: the rep's device buffer is registered in the
-  ``_DeviceLedger`` (core/memory.py) like any column buffer, so admission
-  control and the OOM evict-then-retry leg can reclaim it.  "Spilling" a
-  rep just drops it — derived data needs no host copy; the next sort-shaped
-  op rebuilds it.
-- **Recovery**: after a device loss the graftguard reseat pass walks the
-  same ledger; a rep is recognized (``is_derived_cache``) and dropped
-  instead of replayed — it is disposable, never unrecoverable.
-- **Concurrency**: attach / get / invalidate are serialized by one module
-  lock (graftgate: concurrent queries legitimately share frames, so two
-  threads may race a sort-shaped op against a mutation of the same
-  column).  Without it, a reader could pass the identity check and then
-  observe ``rep._data = None`` torn in by a concurrent invalidate.  The
-  lock is module-wide, not per-column: the guarded sections are a few
-  attribute reads, and a per-column lock would have to live on
-  ``DeviceColumn`` (one more slot on every column for a cache only
-  sort-shaped ops touch).
+- the per-column attachment slot (``DeviceColumn._sorted_rep``) — the rep
+  is consulted on sort-shaped hot paths and a slot read beats a keyed
+  lookup;
+- the ``sortcache.*`` metric names (stable observability surface; the
+  generic artifacts emit ``view.*``).
+
+The correctness contract is unchanged: a rep is valid only for the exact
+buffer it was computed from in the current device epoch under the current
+mesh shape, every buffer mutation drops it eagerly, ledger "spill" = drop
+(derived data rebuilds on demand), and graftguard reseat passes drop it
+instead of replaying lineage — never counting it unrecoverable.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Optional, Tuple
 
 from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.views import registry as _registry
 
-# reentrant: invalidate() drops the rep while already holding the lock,
-# and the ledger spill / recovery paths call SortedRep.drop() directly
-_CACHE_LOCK = threading.RLock()
+#: the ONE derived-cache lock, shared with every graftview artifact
+#: (graftgate: concurrent queries legitimately share frames, so readers
+#: and invalidators of the same column serialize here)
+_CACHE_LOCK = _registry.LOCK
 
 
-class SortedRep:
-    """One column's cached sorted representation, device-ledger-tracked."""
+class SortedRep(_registry.DerivedArtifact):
+    """One column's cached sorted representation, device-ledger-tracked.
 
-    __slots__ = (
-        "_data", "n_valid", "source_id", "epoch", "mesh_key", "_dev_key",
-        "__weakref__",
-    )
+    The device payload is the sorted array; ``n_valid`` rides in the
+    artifact state.  ``_data`` keeps its historical name (tests and the
+    recovery path read it)."""
 
-    #: recovery marker: reseat passes drop derived caches instead of
-    #: replaying lineage for them (core/execution/recovery.py)
-    is_derived_cache = True
-    is_lazy = False
+    __slots__ = ("col_ref",)
 
-    def __init__(
-        self,
-        data: Any,
-        n_valid: Any,
-        source_id: int,
-        epoch: int,
-        mesh_key: str = "",
-    ):
-        self._data = data
-        self.n_valid = n_valid
-        self.source_id = source_id
-        self.epoch = epoch
-        # graftmesh: the rep is keyed on the shard layout it was built
-        # under — a mesh reshape changes the padded physical layout and
-        # which collectives later consumers compile against, so a rep from
-        # another topology is stale even if the source buffer survived
-        self.mesh_key = mesh_key
-        self._dev_key = None
+    def __init__(self, data: Any, n_valid: Any, source_id: int, col: Any = None):
+        super().__init__(
+            kind="sorted_rep",
+            params=(),
+            token=0,
+            length=0,
+            source_id=source_id,
+            state={"n_valid": n_valid},
+            can_fold=False,
+            payload=data,
+        )
+        import weakref
+
+        self.col_ref = weakref.ref(col) if col is not None else None
 
     @property
-    def raw(self) -> Any:
-        return self._data
+    def _data(self) -> Any:
+        return self._payload
 
-    def drop(self) -> int:
-        """Release the device buffer; returns bytes freed.
-
-        Serialized under the module cache lock: ``_data`` only ever
-        transitions under it, so a reader holding the lock can never see
-        the pair torn by a concurrent ledger spill or recovery drop.
-        """
-        with _CACHE_LOCK:
-            if self._data is None:
-                return 0
-            from modin_tpu.core.memory import device_ledger
-
-            freed = device_ledger.deregister(self)
-            self._data = None
-            self.n_valid = None
-            return freed
+    @property
+    def n_valid(self) -> Any:
+        state = self.state
+        return state["n_valid"] if state is not None else None
 
     def spill(self) -> int:
-        """Ledger spill protocol: derived data is dropped, not copied out."""
+        """Ledger spill protocol: derived data is dropped, not copied out.
+
+        A pressure drop also clears the owning column's graftview
+        artifacts: the ledger chose this column as cold, and every derived
+        cache answering for it shares the drop-under-pressure contract —
+        the next query rebuilds from the (still resident) source buffer.
+        """
         freed = self.drop()
         if freed:
             emit_metric("sortcache.spill", 1)
+            # the rep IS a graftview device-payload artifact: its pressure
+            # drop counts in the registry's family too
+            emit_metric("view.spill", 1)
+            col = self.col_ref() if self.col_ref is not None else None
+            if col is not None and col._view_token is not None:
+                _registry.invalidate_column(col, reason="pressure")
         return freed
 
 
@@ -122,15 +106,12 @@ def _live_rep_locked(col: Any) -> Optional[SortedRep]:
     (lock held: the identity check and any use of the returned rep's
     buffer must be one atomic step against a concurrent invalidate)."""
     rep = getattr(col, "_sorted_rep", None)
-    if rep is None or rep._data is None:
+    if rep is None or rep._payload is None:
         return None
-    from modin_tpu.core.execution import recovery
-    from modin_tpu.parallel.mesh import mesh_shape_key
-
     if (
-        rep.epoch != recovery.current_epoch()
+        rep.epoch != _registry._current_epoch()
         or rep.source_id != id(col._data)
-        or rep.mesh_key != mesh_shape_key()
+        or rep.mesh_key != _registry._mesh_key()
     ):
         if _invalidate_locked(col):
             emit_metric("sortcache.invalidate", 1)
@@ -153,7 +134,7 @@ def get(col: Any) -> Optional[Tuple[Any, Any]]:
             return None
         # copy the pair out under the lock: a concurrent invalidate after
         # release only drops the ledger entry, never the arrays we hold
-        data, n_valid = rep._data, rep.n_valid
+        data, n_valid = rep._payload, rep.n_valid
     from modin_tpu.core.memory import device_ledger
 
     device_ledger.touch(rep)
@@ -163,13 +144,9 @@ def get(col: Any) -> Optional[Tuple[Any, Any]]:
 
 def attach(col: Any, xs: Any, n_valid: Any) -> None:
     """Cache ``(xs, n_valid)`` as ``col``'s sorted representation."""
-    from modin_tpu.core.execution import recovery
     from modin_tpu.core.memory import device_ledger
-    from modin_tpu.parallel.mesh import mesh_shape_key
 
-    rep = SortedRep(
-        xs, n_valid, id(col._data), recovery.current_epoch(), mesh_shape_key()
-    )
+    rep = SortedRep(xs, n_valid, id(col._data), col)
     with _CACHE_LOCK:
         invalidated = _invalidate_locked(col)
         device_ledger.register(rep)

@@ -39,11 +39,16 @@ from modin_tpu.streaming import StreamDegrade, window_body
 from modin_tpu.streaming import windows as _windows
 
 #: reductions with an exact algebraic window combiner; everything else
-#: (median, var, nunique, ...) stays resident
-_REDUCE_COMBINABLE = frozenset({"sum", "prod", "min", "max", "count", "mean"})
+#: (median, var, nunique, ...) stays resident.  Public names: graftview's
+#: incremental maintenance (views/incremental.py) keys its append-only
+#: fold sets off the SAME combinability facts — one source of truth for
+#: "which aggregations recombine from partials".
+REDUCE_COMBINABLE = frozenset({"sum", "prod", "min", "max", "count", "mean"})
+_REDUCE_COMBINABLE = REDUCE_COMBINABLE
 
 #: groupby aggregations with an exact partial-state combiner
-_GROUPBY_COMBINABLE = frozenset({"sum", "min", "max", "count", "mean"})
+GROUPBY_COMBINABLE = frozenset({"sum", "min", "max", "count", "mean"})
+_GROUPBY_COMBINABLE = GROUPBY_COMBINABLE
 
 
 # ---------------------------------------------------------------------- #

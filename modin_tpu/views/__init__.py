@@ -1,0 +1,40 @@
+"""graftview — cross-query derived-artifact cache with incremental
+maintenance over appended batches.
+
+Three legs (ISSUE 14 / ROADMAP items 3+4):
+
+- **registry.py** — the keyed artifact registry generalizing graftsort's
+  sorted-representation cache: whole reduction results, nunique/mode/median
+  answers, and small groupby output tables cached per (op fingerprint,
+  column identity, device epoch, mesh shape), device payloads ledger-
+  tracked as derived (pressure drops them; graftguard never counts them
+  unrecoverable);
+- **incremental.py** — append-only fold rules: algebraic scalar reductions
+  and bounded groupby partial tables absorb a ``concat`` tail instead of
+  recomputing, dictionary encodings extend their code tables;
+- **reduce_cache.py / groupby_cache.py** — the query-compiler integration
+  that consults the registry, dispatches ONLY the appended delta through
+  the engine seam, and assembles full-data answers.
+
+``MODIN_TPU_VIEWS=Off`` restores today's behavior bit-for-bit: every hook
+gates on the module attribute ``VIEWS_ON`` (one attribute read — the
+graftscope zero-overhead-when-off contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+#: fast-path flag: True while MODIN_TPU_VIEWS resolves to Auto.  Every
+#: integration hook reads this one attribute before doing ANY views work.
+VIEWS_ON: bool = True
+
+
+def _on_views_mode(param: Any) -> None:
+    global VIEWS_ON
+    VIEWS_ON = str(param.get()).lower() != "off"
+
+
+from modin_tpu.config import ViewsMode as _ViewsMode  # noqa: E402
+
+_ViewsMode.subscribe(_on_views_mode)

@@ -1,0 +1,421 @@
+"""graftview groupby result caching: small output tables, folded over appends.
+
+``groupby_agg`` consults this module before running the device groupby.
+Artifacts cache the **result table** (a pandas frame — bounded by
+``MODIN_TPU_VIEWS_MAX_GROUPS``) keyed on the aggregation fingerprint plus
+the identity of every participating column; a **fold** reruns the SAME
+device groupby on only the appended tail rows and combines the partial
+tables host-side with graftstream's combiner shapes
+(views/incremental.combine_groupby).
+
+Gates are deliberately tight: internal by-labels only, string aggs the
+device path supports, and folding additionally requires sorted
+as_index=True dropna=True results over all-device numeric key/value
+columns.  Anything outside the gates simply declines — the ordinary device
+path (or pandas fallback) runs untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+import pandas
+
+from modin_tpu.observability import spans as graftscope
+from modin_tpu.views import incremental, registry
+
+_KIND = "groupby"
+
+
+def _hashable(value: Any) -> bool:
+    try:
+        hash(value)
+    except TypeError:
+        return False
+    return True
+
+
+def _participants(qc: Any, by: Any, selection: Any, agg_kwargs: dict):
+    """(key_positions, value_positions) of the columns this aggregation
+    reads, or None when the by/selection shape is outside the cacheable
+    gate (external key compilers, unresolvable labels).
+
+    Under ``numeric_only`` the value set mirrors the device path's own
+    resolution: non-numeric columns are dropped from the aggregation (so
+    they are not part of the result's identity — a frame's object column
+    must not block folding a numeric aggregation), while a numeric column
+    the device cannot compute makes the device path decline entirely, so
+    caching declines too."""
+    frame = qc._modin_frame
+    if not (isinstance(by, list) and by and all(_hashable(b) for b in by)):
+        return None
+    key_positions = []
+    for label in by:
+        pos = frame.column_position(label)
+        if len(pos) != 1 or pos[0] < 0:
+            return None
+        key_positions.append(pos[0])
+    if selection is not None:
+        sel_list = [selection] if not isinstance(selection, list) else list(selection)
+        if not all(_hashable(s) for s in sel_list):
+            return None
+        value_positions = []
+        for label in sel_list:
+            pos = frame.column_position(label)
+            if len(pos) != 1 or pos[0] < 0:
+                return None
+            value_positions.append(pos[0])
+    else:
+        value_positions = [
+            i for i in range(frame.num_cols) if i not in key_positions
+        ]
+    if agg_kwargs.get("numeric_only", False):
+        from pandas.api.types import is_numeric_dtype
+
+        kept = []
+        for p in value_positions:
+            col = frame._columns[p]
+            if getattr(col, "is_device", False) and col.pandas_dtype.kind in "biuf":
+                kept.append(p)
+            elif is_numeric_dtype(col.pandas_dtype):
+                return None  # numeric but not device-computable: device path declines
+        value_positions = kept
+    return key_positions, value_positions
+
+
+def _col_ident(col: Any) -> Optional[tuple]:
+    if getattr(col, "is_device", False):
+        if col._data is None or col.is_lazy:
+            return None  # spilled/lazy: identity is in flux, don't cache
+        return ("d", registry.ensure_token(col), id(col._data), col.length)
+    # host columns have no token; id() alone is reusable after GC, so the
+    # artifact additionally carries weakref guards (_host_guards) that pin
+    # identity to the exact live objects
+    return ("h", id(col), id(col.data))
+
+
+def _host_guards(qc: Any, positions: List[int]) -> tuple:
+    """(position-index, weakref-to-column) for every host participant:
+    a cached result is valid only while each guard still resolves to the
+    very object at that position — CPython id reuse after a GC cannot
+    alias a replaced host column into a stale hit."""
+    import weakref
+
+    frame = qc._modin_frame
+    return tuple(
+        (j, weakref.ref(frame._columns[p]))
+        for j, p in enumerate(positions)
+        if not getattr(frame._columns[p], "is_device", False)
+    )
+
+
+def _host_guards_hold(qc: Any, positions: List[int], guards: Any) -> bool:
+    if not guards:
+        return True
+    frame = qc._modin_frame
+    for j, ref in guards:
+        if j >= len(positions) or ref() is not frame._columns[positions[j]]:
+            return False
+    return True
+
+
+def _fingerprint(
+    by: Any, agg_func: str, groupby_kwargs: dict, agg_kwargs: dict,
+    drop: Any, series_groupby: Any, selection: Any,
+) -> Optional[tuple]:
+    gk = tuple(sorted(groupby_kwargs.items())) if groupby_kwargs else ()
+    ak = tuple(sorted(agg_kwargs.items())) if agg_kwargs else ()
+    sel = tuple(selection) if isinstance(selection, list) else selection
+    parts = (agg_func, tuple(by), gk, ak, bool(drop), bool(series_groupby), sel)
+    return parts if _hashable(parts) else None
+
+
+def _anchor(qc: Any, key_positions: List[int], value_positions: List[int]):
+    frame = qc._modin_frame
+    for p in key_positions + value_positions:
+        col = frame._columns[p]
+        if getattr(col, "is_device", False) and col._data is not None and not col.is_lazy:
+            return col
+    return None
+
+
+def _idents(qc: Any, positions: List[int]) -> Optional[tuple]:
+    frame = qc._modin_frame
+    out = []
+    for p in positions:
+        ident = _col_ident(frame._columns[p])
+        if ident is None:
+            return None
+        out.append(ident)
+    return tuple(out)
+
+
+def _rebuild(qc: Any, state: dict) -> Any:
+    result = type(qc).from_pandas(state["pdf"])
+    if state.get("shape_hint"):
+        result._shape_hint = state["shape_hint"]
+    return result
+
+
+def _foldable(
+    qc: Any, agg_func: str, groupby_kwargs: dict, key_positions, value_positions
+) -> bool:
+    if agg_func not in incremental.FOLDABLE_GROUPBYS:
+        return False
+    if not groupby_kwargs.get("as_index", True):
+        return False
+    if not groupby_kwargs.get("dropna", True):
+        return False
+    frame = qc._modin_frame
+    for p in key_positions + value_positions:
+        col = frame._columns[p]
+        if not getattr(col, "is_device", False) or col.pandas_dtype.kind not in "biuf":
+            return False
+    return True
+
+
+def _chain_base(col: Any, ident: tuple) -> Optional[int]:
+    """The stored ident's length when it is an ancestor of ``col`` along
+    the append chain (so col[:length] IS that ancestor's data); else None."""
+    if ident[0] != "d":
+        return None
+    want_token, want_len = ident[1], ident[3]
+    link = getattr(col, "_view_parent", None)
+    hops = 0
+    while link is not None and hops < 8:
+        ptok, plen = link
+        if ptok == want_token and plen == want_len:
+            return plen
+        link = registry._parent_links.get(ptok)
+        hops += 1
+    return None
+
+
+def groupby_consult(
+    qc: Any, by: Any, agg_func: Any, groupby_kwargs: dict, agg_kwargs: dict,
+    drop: Any, series_groupby: Any, selection: Any,
+) -> Optional[Any]:
+    """A cached (or tail-folded) groupby result, or None to run the device
+    path.  Called by ``groupby_agg`` before ``_try_device_groupby``."""
+    if not isinstance(agg_func, str):
+        return None
+    got = _participants(qc, by, selection, agg_kwargs)
+    if got is None:
+        return None
+    key_positions, value_positions = got
+    fp = _fingerprint(
+        by, agg_func, groupby_kwargs, agg_kwargs, drop, series_groupby,
+        selection,
+    )
+    if fp is None:
+        return None
+    anchor = _anchor(qc, key_positions, value_positions)
+    if anchor is None:
+        return None
+    idents = _idents(qc, key_positions + value_positions)
+    if idents is None:
+        return None
+    positions = key_positions + value_positions
+    outcome, state, _base = registry.lookup(anchor, _KIND, fp)
+    if (
+        outcome == "hit"
+        and state.get("idents") == idents
+        and _host_guards_hold(qc, positions, state.get("host_guards"))
+    ):
+        return _rebuild(qc, state)
+    if (
+        outcome == "fold"
+        and _foldable(qc, agg_func, groupby_kwargs, key_positions, value_positions)
+    ):
+        folded = _fold(
+            qc, by, agg_func, groupby_kwargs, agg_kwargs, drop,
+            series_groupby, selection, key_positions, value_positions,
+            fp, state, idents, anchor,
+        )
+        if folded is not None:
+            return folded
+    return None
+
+
+def _fold(
+    qc, by, agg_func, groupby_kwargs, agg_kwargs, drop, series_groupby,
+    selection, key_positions, value_positions, fp, state, idents, anchor,
+):
+    from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn, TpuDataframe
+    from modin_tpu.core.dataframe.tpu.metadata import LazyIndex
+    from modin_tpu.ops.structural import gather_columns
+
+    frame = qc._modin_frame
+    n = len(frame)
+    n0 = state.get("n")
+    old_idents = state.get("idents")
+    if n0 is None or old_idents is None or len(old_idents) != len(idents):
+        return None
+    positions = key_positions + value_positions
+    for p, old_ident in zip(positions, old_idents):
+        col = frame._columns[p]
+        if _chain_base(col, old_ident) != n0:
+            return None
+    n_tail = n - n0
+    if n_tail < 0:
+        return None
+    with graftscope.span(
+        "view.fold", layer="QUERY-COMPILER", op=f"groupby.{agg_func}",
+        cols=len(positions), base=n0, tail=n_tail,
+    ):
+        def slice_qc(start, stop):
+            m = stop - start
+            datas, _ = gather_columns(
+                [frame._columns[p].data for p in positions],
+                np.arange(start, stop, dtype=np.int64),
+            )
+            cols = [
+                DeviceColumn(d, frame._columns[p].pandas_dtype, length=m)
+                for p, d in zip(positions, datas)
+            ]
+            return type(qc)(
+                TpuDataframe(
+                    cols,
+                    pandas.Index([frame.columns[p] for p in positions]),
+                    LazyIndex(pandas.RangeIndex(m), m),
+                )
+            )
+
+        def run_groupby(sub_qc, agg, kwargs):
+            return sub_qc._try_device_groupby(
+                list(by), agg, 0, groupby_kwargs, (), kwargs,
+                drop, series_groupby, selection,
+            )
+
+        if n_tail == 0:
+            combined, combined_count = state["pdf"], state.get("count_pdf")
+            tail_shape_hint = state.get("shape_hint")
+        else:
+            old_count = state.get("count_pdf")
+            if agg_func == "mean" and old_count is None:
+                # lazily built on first fold: the current frame's prefix
+                # rows ARE the artifact's source data (append-link
+                # invariant), so the count table the stored means pair
+                # with comes from exactly those rows — and it is written
+                # back to the ancestor artifact so later folds from the
+                # same ancestor (other branches, bench reps) skip this
+                # O(prefix) dispatch
+                prefix_count = run_groupby(slice_qc(0, n0), "count", {})
+                if prefix_count is None:
+                    return None
+                old_count = prefix_count.to_pandas()
+                registry.amend_ancestor_state(
+                    anchor, _KIND, fp, n0, "count_pdf", old_count,
+                    extra_bytes=_pdf_bytes(old_count),
+                )
+            tail_qc = slice_qc(n0, n)
+            tail_result = run_groupby(tail_qc, agg_func, agg_kwargs)
+            if tail_result is None:
+                return None
+            tail_pdf = tail_result.to_pandas()
+            tail_shape_hint = getattr(tail_result, "_shape_hint", None)
+            tail_count = None
+            if agg_func == "mean":
+                tail_count_result = run_groupby(tail_qc, "count", {})
+                if tail_count_result is None:
+                    return None
+                tail_count = tail_count_result.to_pandas()
+            try:
+                combined, combined_count = incremental.combine_groupby(
+                    agg_func, state["pdf"], tail_pdf, old_count, tail_count,
+                )
+            except (ValueError, TypeError):
+                return None
+    from modin_tpu.config import ViewsMaxGroups
+
+    if len(combined) > int(ViewsMaxGroups.get()):
+        # the combined table outgrew the cacheable bound: folding this
+        # chain can never succeed again, so drop the ancestor artifact —
+        # otherwise every later query would re-pay the wasted tail
+        # dispatch before recomputing in full
+        registry.invalidate_ancestor(anchor, _KIND, fp, "not_incremental")
+        return None
+    new_state = {
+        "pdf": combined,
+        "count_pdf": combined_count,
+        "shape_hint": tail_shape_hint or state.get("shape_hint"),
+        "idents": idents,
+        "host_guards": (),  # the fold gate admits device columns only
+        "n": n,
+    }
+    registry.store(
+        anchor, _KIND, fp, new_state, can_fold=True,
+        host_bytes=_pdf_bytes(combined) + _pdf_bytes(combined_count),
+        folded=True,
+    )
+    return _rebuild(qc, new_state)
+
+
+def groupby_record(
+    qc: Any, result: Any, by: Any, agg_func: Any, groupby_kwargs: dict,
+    agg_kwargs: dict, drop: Any, series_groupby: Any, selection: Any,
+) -> None:
+    """Cache a freshly computed device-groupby result (bounded tables)."""
+    if not isinstance(agg_func, str):
+        return
+    got = _participants(qc, by, selection, agg_kwargs)
+    if got is None:
+        return
+    key_positions, value_positions = got
+    fp = _fingerprint(
+        by, agg_func, groupby_kwargs, agg_kwargs, drop, series_groupby,
+        selection,
+    )
+    if fp is None:
+        return
+    anchor = _anchor(qc, key_positions, value_positions)
+    if anchor is None:
+        return
+    idents = _idents(qc, key_positions + value_positions)
+    if idents is None:
+        return
+    from modin_tpu.config import ViewsMaxGroups
+
+    # bound check BEFORE any materialization: the result frame carries its
+    # row count, so a high-cardinality groupby is declined without paying
+    # the device->host transfer of a table we would discard anyway
+    if len(result._modin_frame) > int(ViewsMaxGroups.get()):
+        return
+    try:
+        # the materialization here is deliberate, not deferred: callers
+        # routinely serialize-and-DISCARD results (a weakref-deferred copy
+        # would be dead by the warm re-query, silently disabling the
+        # cache), and the transfer is bounded by MODIN_TPU_VIEWS_MAX_GROUPS
+        # rows — the same bound that keeps the host combine cheap
+        pdf = result.to_pandas()
+    except Exception:  # caching is best-effort; a result that cannot materialize is simply not cached
+        return
+    can_fold = _foldable(
+        qc, agg_func, groupby_kwargs, key_positions, value_positions
+    )
+    state = {
+        "pdf": pdf,
+        # mean's fold needs a per-group valid-count table; it is built
+        # LAZILY at first fold time (over the prefix rows, which ARE this
+        # frame's rows by the append-link invariant) so the common
+        # no-reuse path never pays a second device groupby
+        "count_pdf": None,
+        "shape_hint": getattr(result, "_shape_hint", None),
+        "idents": idents,
+        "host_guards": _host_guards(qc, key_positions + value_positions),
+        "n": len(qc._modin_frame),
+    }
+    registry.store(
+        anchor, _KIND, fp, state, can_fold=can_fold,
+        host_bytes=_pdf_bytes(pdf),
+    )
+
+
+def _pdf_bytes(pdf: Any) -> int:
+    if pdf is None:
+        return 0
+    try:
+        return int(pdf.memory_usage(deep=False).sum())
+    except Exception:  # byte accounting is budget bookkeeping; an exotic frame estimates flat
+        return 1024

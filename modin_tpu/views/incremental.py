@@ -1,0 +1,223 @@
+"""graftview incremental maintenance: append-only fold rules.
+
+The algebraic combiner patterns of "High Performance Dataframes from
+Parallel Processing Patterns" (arXiv 2209.06146), applied to the registry's
+artifacts: a column grown by ``concat`` is its parent's rows plus an
+appended tail, so a cached aggregate over the parent folds the tail's
+partial instead of recomputing the whole column.
+
+Exactness contract, stated honestly (docs/architecture.md carries the
+decision table):
+
+- ``count`` / ``min`` / ``max`` / ``any`` / ``all`` and integer/bool
+  ``sum`` / ``prod`` folds are **bit-exact**: their combines are exactly
+  associative (integer addition wraps identically in any order; min/max is
+  a total-order fold; the NaN rules compose segment-wise).
+- float ``sum`` / ``prod`` and every ``mean`` fold re-associates the
+  floating-point accumulation — identical to the recombination contract
+  the graftstream window combiners already ship (streaming/executor.py
+  ``_REDUCE_COMBINABLE``), and inside the repo's differential-comparison
+  tolerance.
+- everything else (var/std/sem/skew/kurt, median, quantile, nunique, mode,
+  sorted reps) does **not** fold: the registry invalidates those artifacts
+  on append with ``view.invalidate.not_incremental`` and the next query
+  rebuilds from scratch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+# graftstream already declares which aggregations recombine exactly from
+# partials (its window combiners, arXiv 2209.06146's algebraic patterns);
+# the append-only fold sets are the SAME facts, so they derive from the
+# one source of truth instead of a drifted copy
+from modin_tpu.streaming.executor import (  # noqa: E402
+    GROUPBY_COMBINABLE as _STREAM_GROUPBY_COMBINABLE,
+    REDUCE_COMBINABLE as _STREAM_REDUCE_COMBINABLE,
+)
+
+#: scalar reductions whose artifact state admits an append-only fold
+#: (graftstream's window-combinable set plus the pure boolean folds)
+FOLDABLE_REDUCES = _STREAM_REDUCE_COMBINABLE | frozenset({"any", "all"})
+
+#: scalar reductions cached as whole results (exact-hit reuse; the
+#: non-foldable ones invalidate honestly on append)
+CACHEABLE_REDUCES = FOLDABLE_REDUCES | frozenset(
+    {"var", "std", "sem", "skew", "kurt", "median"}
+)
+
+#: groupby aggregations with an exact (or fp-reassociating, for mean)
+#: partial-table combine — graftstream's combinable set plus size
+FOLDABLE_GROUPBYS = _STREAM_GROUPBY_COMBINABLE | frozenset({"size"})
+
+
+def combine_scalar(
+    op: str, skipna: bool, old: np.ndarray, tail: np.ndarray
+) -> np.ndarray:
+    """Fold one column's tail reduction into the cached prefix result.
+
+    ``old``/``tail`` are the 0-d numpy results the device kernel answered
+    for each segment under identical (op, skipna) semantics; the combine
+    reproduces ``_reduce_one``'s whole-column semantics segment-wise.
+    """
+    old = np.asarray(old)
+    tail = np.asarray(tail)
+    if op in ("sum", "count"):
+        return np.add(old, tail)
+    if op == "prod":
+        return np.multiply(old, tail)
+    if op == "min":
+        if old.dtype.kind == "f" and skipna:
+            # skipna: a NaN segment result can only mean all-NaN — fmin
+            # lets the other segment answer
+            return np.fmin(old, tail)
+        return np.minimum(old, tail)  # NaN propagates (skipna=False rule)
+    if op == "max":
+        if old.dtype.kind == "f" and skipna:
+            return np.fmax(old, tail)
+        return np.maximum(old, tail)
+    if op == "any":
+        return np.logical_or(old, tail)
+    if op == "all":
+        return np.logical_and(old, tail)
+    raise ValueError(op)
+
+
+def combine_mean(
+    old_mean: np.ndarray,
+    old_k: int,
+    tail_mean: np.ndarray,
+    tail_k: int,
+) -> Tuple[np.ndarray, int]:
+    """Fold a (mean, valid-count) pair; NaN segments with k=0 defer to the
+    other side, NaN with k>0 (skipna=False poisoning) propagates."""
+    k = int(old_k) + int(tail_k)
+    if old_k == 0:
+        return np.asarray(tail_mean, dtype=np.float64), k
+    if tail_k == 0:
+        return np.asarray(old_mean, dtype=np.float64), k
+    total = np.float64(old_mean) * old_k + np.float64(tail_mean) * tail_k
+    return np.float64(total / k), k
+
+
+# --------------------------------------------------------------------- #
+# groupby partial-table combine (host side, graftstream's combiner shapes)
+# --------------------------------------------------------------------- #
+
+
+def _group_levels(pdf) -> list:
+    return list(range(pdf.index.nlevels))
+
+
+def combine_groupby(
+    agg: str,
+    old: Any,
+    tail: Any,
+    old_count: Any = None,
+    tail_count: Any = None,
+) -> Tuple[Any, Any]:
+    """Combine two groupby result tables (same columns, key-indexed, sorted,
+    dropna=True) into the full-data table.  Returns ``(combined,
+    combined_count)`` — the count table is carried only for ``mean``.
+
+    Index union + sort + dtype rules ride on pandas' own concat->groupby,
+    which is exactly the recombination the streaming executor's partial
+    tables use.
+    """
+    import pandas
+
+    levels = _group_levels(old)
+    if agg in ("sum", "count", "size"):
+        combined = pandas.concat([old, tail]).groupby(level=levels, sort=True).sum()
+        return combined, None
+    if agg in ("min", "max"):
+        grouped = pandas.concat([old, tail]).groupby(level=levels, sort=True)
+        return (grouped.min() if agg == "min" else grouped.max()), None
+    if agg == "mean":
+        counts = (
+            pandas.concat([old_count, tail_count])
+            .groupby(level=levels, sort=True)
+            .sum()
+        )
+
+        def contribution(means, ks):
+            k = ks.to_numpy()
+            # an all-NaN group means NaN with k=0: it contributes 0 to the
+            # sum instead of poisoning it (the group's NaN re-appears below
+            # through the 0/0 division)
+            return means.where(k != 0, 0.0) * np.where(k != 0, k, 0)
+
+        sums = pandas.concat(
+            [contribution(old, old_count), contribution(tail, tail_count)]
+        ).groupby(level=levels, sort=True).sum()
+        combined = sums / counts.to_numpy()
+        return combined, counts
+    raise ValueError(agg)
+
+
+# --------------------------------------------------------------------- #
+# dictionary-encoding code-table extension (append-only concat)
+# --------------------------------------------------------------------- #
+
+
+def extend_dict_encoding(base_col: Any, tail_values: np.ndarray) -> Optional[Any]:
+    """The concatenated column's :class:`~modin_tpu.ops.dictionary.DictEncoding`
+    built by code-table extension: factorize ONLY the appended tail, union
+    the (sorted) category tables, remap the base's device codes through the
+    old->union translation (a small device gather — no remap at all when
+    the tail introduced no new category), and device-concat the code
+    columns.  Returns None whenever the extension cannot reproduce
+    ``_encode``'s exact result (unorderable tails, category-count bound),
+    leaving the plain lazy re-encode path untouched.
+    """
+    import pandas
+
+    from modin_tpu.ops import dictionary as _dict
+    from modin_tpu.ops.structural import concat_columns
+
+    base_enc = getattr(base_col, "_dict_cache", None)
+    if not isinstance(base_enc, _dict.DictEncoding):
+        return None
+    try:
+        tail_codes, tail_cats = pandas.factorize(
+            np.asarray(tail_values, dtype=object), sort=True, use_na_sentinel=True
+        )
+    except TypeError:
+        return None
+    tail_cats = np.asarray(tail_cats, dtype=object)
+    try:
+        union, base_map, tail_map = _dict.union_categories(
+            base_enc.categories, tail_cats
+        )
+    except TypeError:
+        return None  # unorderable across the two category sets
+    if len(union) > _dict._MAX_CATEGORIES:
+        return None
+    tail_fcodes = tail_codes.astype(np.float64)
+    tail_has_nan = bool((tail_codes == -1).any())
+    if tail_has_nan:
+        tail_fcodes[tail_codes == -1] = np.nan
+    if len(tail_map):
+        tail_fcodes = np.where(
+            np.isnan(tail_fcodes), np.nan, tail_map[
+                np.where(np.isnan(tail_fcodes), 0, tail_fcodes).astype(np.int64)
+            ]
+        )
+    from modin_tpu.core.dataframe.tpu.dataframe import DeviceColumn
+
+    base_codes_col = base_enc.codes
+    base_raw = base_codes_col.raw
+    if len(union) != len(base_enc.categories):
+        base_raw = _dict.remap_codes_device(base_raw, base_map)
+    tail_codes_col = DeviceColumn.from_numpy(tail_fcodes)
+    datas, n_out = concat_columns(
+        [[base_raw], [tail_codes_col.data]],
+        [base_codes_col.length, len(tail_fcodes)],
+    )
+    codes_col = DeviceColumn(datas[0], np.dtype(np.float64), length=n_out)
+    return _dict.DictEncoding(
+        codes_col, union, base_enc.has_nan or tail_has_nan
+    )

@@ -1,0 +1,245 @@
+"""graftview scalar-reduction caching: whole results, folded over appends.
+
+The query compiler's axis-0 reduction path (``_try_device_reduce``) hands
+this module its concrete device columns; per column the registry answers
+
+- **hit** — the identical (op, skipna, ddof, cast_bool) reduction already
+  ran on this exact buffer at this device epoch: zero dispatches;
+- **fold** — the column grew by an append since the artifact was built:
+  ONLY the appended tail is gathered and reduced (both dispatches go
+  through the engine seam, so resilience / lineage / graftcost see the
+  delta like any other work), then combined by views/incremental.py;
+- **miss** — reduced from scratch (one fused dispatch over all missed
+  columns, exactly the computation the Off path runs) and cached.
+
+The assembled values are the same numpy scalars the plain path returns;
+``MODIN_TPU_VIEWS=Off`` bypasses this module entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from modin_tpu.observability import spans as graftscope
+from modin_tpu.views import incremental, registry
+
+
+def _mean_k(col: Any, n: int, skipna: bool) -> Optional[int]:
+    """The valid count the mean artifact must carry, when it is knowable
+    without a dispatch: the full length for NaN-free dtypes and for
+    skipna=False (where the device mean divides by n)."""
+    if col.pandas_dtype.kind != "f" or not skipna:
+        return int(n)
+    return None
+
+
+def cached_reduce(
+    op: str,
+    cols: List[Any],
+    n: int,
+    skipna: bool,
+    ddof: int,
+    cast_bool: bool,
+) -> Optional[List[np.ndarray]]:
+    """Per-column results for ``op`` over ``cols`` using the artifact
+    registry, or None to decline (the caller runs the plain path).
+
+    Declines whenever any column is not a concrete resident DeviceColumn —
+    lazy chains keep their fusion, spilled columns their restore path.
+    """
+    from modin_tpu.ops import reductions
+
+    if op not in incremental.CACHEABLE_REDUCES:
+        return None
+    for c in cols:
+        if not getattr(c, "is_device", False) or c._data is None or c.is_lazy:
+            return None
+    n, skipna, ddof = int(n), bool(skipna), int(ddof)
+    params = (op, skipna, ddof, bool(cast_bool))
+    can_fold = op in incremental.FOLDABLE_REDUCES
+
+    results: List[Optional[np.ndarray]] = [None] * len(cols)
+    misses: List[int] = []
+    folds: List[Any] = []  # (i, state, base_len)
+    for i, col in enumerate(cols):
+        outcome, state, base = registry.lookup(col, "reduce", params)
+        if outcome == "hit":
+            results[i] = state["r"]
+        elif outcome == "fold" and can_fold:
+            folds.append((i, state, base))
+        else:
+            misses.append(i)
+
+    if misses:
+        values = reductions.reduce_columns(
+            op, [cols[i].data for i in misses], n,
+            skipna=skipna, ddof=ddof, cast_bool=cast_bool,
+        )
+        for i, v in zip(misses, values):
+            results[i] = v
+            state = {"r": v}
+            if op == "mean":
+                # the valid count the fold needs: knowable for free on
+                # NaN-free dtypes / skipna=False; a float skipna mean
+                # stores None and derives it LAZILY at first fold over the
+                # prefix rows (the groupby cache's count_pdf discipline) —
+                # the cold no-reuse path must stay at one dispatch
+                state["k"] = _mean_k(cols[i], n, skipna)
+            registry.store(
+                cols[i], "reduce", params, state,
+                can_fold=can_fold, host_bytes=64,
+            )
+
+    if folds:
+        _fold_reduces(op, cols, n, skipna, ddof, cast_bool, params, folds, results)
+
+    return [r for r in results]
+
+
+def _fold_reduces(
+    op: str,
+    cols: List[Any],
+    n: int,
+    skipna: bool,
+    ddof: int,
+    cast_bool: bool,
+    params: tuple,
+    folds: List[Any],
+    results: List[Optional[np.ndarray]],
+) -> None:
+    """Reduce each fold column's appended tail and combine with its cached
+    prefix state; groups columns by base length so one gather + one fused
+    reduce serves each append generation."""
+    from modin_tpu.ops import reductions
+    from modin_tpu.ops.structural import gather_columns
+
+    by_base: dict = {}
+    for i, state, base in folds:
+        by_base.setdefault(base, []).append((i, state))
+    for base, group in by_base.items():
+        n_tail = n - base
+        idxs = [i for i, _ in group]
+        with graftscope.span(
+            "view.fold", layer="QUERY-COMPILER", op=op, cols=len(idxs),
+            base=base, tail=n_tail,
+        ):
+            if n_tail == 0:
+                tail_values = None
+            else:
+                tails, _ = gather_columns(
+                    [cols[i].data for i in idxs],
+                    np.arange(base, n, dtype=np.int64),
+                )
+                tail_values = reductions.reduce_columns(
+                    op, tails, n_tail,
+                    skipna=skipna, ddof=ddof, cast_bool=cast_bool,
+                )
+                tail_counts = None
+                base_counts = None
+                if op == "mean":
+                    need_k = [
+                        j for j, i in enumerate(idxs)
+                        if _mean_k(cols[i], n_tail, skipna) is None
+                    ]
+                    if need_k:
+                        counted = reductions.reduce_columns(
+                            "count", [tails[j] for j in need_k], n_tail,
+                            skipna=True,
+                        )
+                        tail_counts = dict(zip(need_k, counted))
+                    # lazily derive the PREFIX counts the cold path did
+                    # not pay for: the prefix rows [0, base) ARE the
+                    # ancestor's rows (append-link invariant), and the
+                    # result is amended back so repeat folds skip it
+                    need_k0 = [
+                        j for j, (i, st) in enumerate(group)
+                        if st.get("k") is None
+                    ]
+                    if need_k0:
+                        prefix, _ = gather_columns(
+                            [cols[group[j][0]].data for j in need_k0],
+                            np.arange(0, base, dtype=np.int64),
+                        )
+                        counted0 = reductions.reduce_columns(
+                            "count", prefix, base, skipna=True
+                        )
+                        base_counts = dict(zip(need_k0, counted0))
+            for j, (i, state) in enumerate(group):
+                if tail_values is None:
+                    new_state = dict(state)  # empty tail: the prefix answer
+                elif op == "mean":
+                    k_tail = _mean_k(cols[i], n_tail, skipna)
+                    if k_tail is None:
+                        k_tail = int(tail_counts[j])
+                    k_base = state["k"]
+                    if k_base is None:
+                        k_base = int(base_counts[j])
+                        registry.amend_ancestor_state(
+                            cols[i], "reduce", params, base, "k", k_base
+                        )
+                    m, k = incremental.combine_mean(
+                        state["r"], k_base, tail_values[j], k_tail
+                    )
+                    new_state = {"r": np.asarray(m), "k": k}
+                else:
+                    new_state = {
+                        "r": incremental.combine_scalar(
+                            op, skipna, state["r"], tail_values[j]
+                        )
+                    }
+                results[i] = new_state["r"]
+                registry.store(
+                    cols[i], "reduce", params, new_state,
+                    can_fold=True, host_bytes=64, folded=True,
+                )
+
+
+# --------------------------------------------------------------------- #
+# sort-shaped result caches (nunique / mode / median): exact-hit only —
+# these are the honestly-non-incrementalizable artifacts
+# --------------------------------------------------------------------- #
+
+
+def sort_reduce_lookup(op: str, params: tuple, cols: List[Any]) -> dict:
+    """{column position: cached result} for plain device columns.
+
+    A planning PEEK: no hit metrics, no LRU touch — the router may still
+    route the whole op to host, in which case nothing was served.  The
+    caller confirms actually-used answers with :func:`sort_reduce_consume`
+    after the routing decision."""
+    out = {}
+    for i, col in enumerate(cols):
+        if col is None:
+            continue
+        outcome, state, _ = registry.lookup(
+            col, f"sortred.{op}", params, consume=False
+        )
+        if outcome == "hit":
+            out[i] = state["r"]
+    return out
+
+
+def sort_reduce_consume(op: str, params: tuple, cols: List[Any], used) -> None:
+    """Mark the peeked answers at positions ``used`` as served (view.hit
+    + LRU touch) — called after the router chose the device side."""
+    for i in used:
+        if cols[i] is not None:
+            registry.consume_hit(cols[i], f"sortred.{op}", params)
+
+
+def sort_reduce_store(op: str, params: tuple, col: Any, value: Any) -> None:
+    registry.store(
+        col, f"sortred.{op}", params, {"r": value},
+        can_fold=False, host_bytes=_state_bytes(value),
+    )
+
+
+def _state_bytes(value: Any) -> int:
+    nbytes = getattr(value, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes) + 64
+    if isinstance(value, tuple):
+        return sum(_state_bytes(v) for v in value)
+    return 64

@@ -1,0 +1,528 @@
+"""graftview artifact registry: keyed derived artifacts shared across queries.
+
+Generalizes the graftsort sorted-representation cache (ops/sorted_cache.py)
+into a process-global registry of **derived artifacts**: values computed
+FROM a column's buffer that later queries on the same buffer epoch can
+reuse — whole reduction results (scalar aggs), nunique/mode/median answers,
+small groupby output tables, and (through the compatibility shim in
+ops/sorted_cache.py) the sorted representations themselves.
+
+Identity model
+--------------
+
+Every ``DeviceColumn`` can carry a **view token** — a process-unique int
+allocated on first use.  Column objects are immutable in length and are
+*replaced*, never grown, by every structural op, so a token names exactly
+one (length, logical content) pair... with one deliberate exception:
+``concat_rows`` records the appended child's **parent link**
+``(parent_token, parent_length)``, because the child's first
+``parent_length`` rows are the parent's rows *by construction*.  That link
+is what makes incremental maintenance sound: an artifact built from the
+parent answers for the child's prefix, and only the appended tail
+``[parent_length, child_length)`` needs folding in.  Branches are safe for
+free — two different appends onto one parent get two different child
+tokens, so a fold committed for one branch can never serve the other.
+
+Artifacts are validated on every lookup against the current device epoch,
+mesh-shape key, and the owning buffer's identity (``id(col._data)``), and
+the buffer-mutation hooks (spill / restore / re-seat / materialize /
+donation) drop a column's artifacts eagerly — the same belt-and-braces
+contract the sorted-rep cache has always had.
+
+Memory model
+------------
+
+Artifacts holding a device payload register in the ``_DeviceLedger`` as
+derived entries (``is_derived_cache``): ledger pressure *drops* them (no
+host copy needed — they rebuild on demand), and graftguard reseat passes
+drop them instead of replaying lineage, never counting them unrecoverable.
+Host-side artifact state (scalar results, small groupby tables) is bounded
+by the registry's own LRU: ``MODIN_TPU_VIEWS_MAX_ENTRIES`` entries and
+``MODIN_TPU_VIEWS_HOST_BUDGET`` bytes, coldest evicted first.
+
+Concurrency
+-----------
+
+One reentrant module lock (shared with the sorted-rep shim) serializes
+lookup / store / invalidate, exactly like the PR 9 sorted-rep hardening:
+concurrent serving queries legitimately share frames, and a reader must
+never observe an artifact torn by a concurrent invalidate.  Folds cannot
+hold the lock across a device dispatch, so they run lookup -> compute ->
+``store`` with the store re-checking the column's spilled state under the
+lock: a buffer mutation between lookup and commit always goes through a
+spill (``_data = None``) first, so the re-check makes a racer's commit a
+no-op instead of a stale write.  (A spill-then-restore completing entirely
+inside the window commits against the restored buffer — safe, because a
+restore reproduces the exact same values; column VALUES never mutate in
+place.  Any future mutation path that changes values while keeping
+``_data`` non-None must add a buffer-identity compare here.)
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from modin_tpu.logging.metrics import emit_metric
+
+#: THE derived-cache lock (reentrant: invalidation runs under it while the
+#: ledger spill / recovery paths call ``Artifact.drop`` directly, and the
+#: sorted-rep shim re-enters through the same invalidation hooks)
+LOCK = threading.RLock()
+
+_token_counter = 0
+
+#: (token, kind, params) -> DerivedArtifact, insertion order = LRU
+_entries: "OrderedDict[Tuple[int, str, Any], Any]" = OrderedDict()
+#: token -> set of live _entries keys (O(1) per-column invalidation)
+_by_token: Dict[int, set] = {}
+_host_bytes_total = 0
+
+
+def ensure_token(col: Any) -> int:
+    """``col``'s view token, allocating one on first use (lock held or not —
+    allocation is idempotent under the lock)."""
+    tok = getattr(col, "_view_token", None)
+    if tok is not None:
+        return tok
+    global _token_counter
+    with LOCK:
+        tok = col._view_token
+        if tok is None:
+            _token_counter += 1
+            tok = _token_counter
+            col._view_token = tok
+    return tok
+
+
+def note_append(child: Any, parent: Any) -> None:
+    """Record that ``child``'s first ``parent.length`` rows ARE ``parent``'s
+    rows (concat_rows).  The child gets its own fresh token; the parent link
+    is what fold lookups walk."""
+    with LOCK:
+        ptok = ensure_token(parent)
+        ctok = ensure_token(child)
+        child._view_parent = (ptok, int(parent.length))
+        # record the link by token too, so fold lookups can walk chains
+        # whose intermediate column objects have been collected
+        _note_link_locked(ctok, child._view_parent)
+        plink = getattr(parent, "_view_parent", None)
+        if plink is not None:
+            _note_link_locked(ptok, plink)
+
+
+def _current_epoch() -> int:
+    from modin_tpu.core.execution import recovery
+
+    return recovery.current_epoch()
+
+
+def _mesh_key() -> str:
+    from modin_tpu.parallel.mesh import mesh_shape_key
+
+    return mesh_shape_key()
+
+
+class DerivedArtifact:
+    """One cached derived value, ledger-tracked when it holds device data.
+
+    ``state`` is the host-side payload (a dict the producing cache layer
+    owns: scalar results, partial tables); ``_payload`` an optional device
+    array registered in the device ledger.  ``token``/``length``/
+    ``source_id``/``epoch``/``mesh_key`` are the validity stamps;
+    ``can_fold`` marks artifacts whose state admits an exact append-only
+    combine (views/incremental.py).
+    """
+
+    __slots__ = (
+        "kind", "params", "token", "length", "source_id", "epoch",
+        "mesh_key", "state", "can_fold", "host_bytes", "_payload",
+        "_dev_key", "owner_ref", "__weakref__",
+    )
+
+    #: recovery marker: reseat passes drop derived caches instead of
+    #: replaying lineage for them (core/execution/recovery.py)
+    is_derived_cache = True
+    is_lazy = False
+
+    def __init__(
+        self,
+        kind: str,
+        params: Any,
+        token: int,
+        length: int,
+        source_id: int,
+        state: Optional[dict],
+        can_fold: bool = False,
+        payload: Any = None,
+        host_bytes: int = 0,
+    ):
+        self.kind = kind
+        self.params = params
+        self.token = token
+        self.length = int(length)
+        self.source_id = source_id
+        self.epoch = _current_epoch()
+        self.mesh_key = _mesh_key()
+        self.state = state
+        self.can_fold = bool(can_fold)
+        self.host_bytes = int(host_bytes)
+        self._payload = payload
+        self._dev_key = None
+        self.owner_ref = None  # weakref to the owning column (set by store)
+
+    @property
+    def raw(self) -> Any:
+        """Ledger protocol: the device payload this entry accounts for."""
+        return self._payload
+
+    @property
+    def live(self) -> bool:
+        return self.state is not None or self._payload is not None
+
+    def drop(self) -> int:
+        """Release payload + state; returns device bytes freed.
+
+        Serialized under the registry lock so a reader holding it can never
+        see the artifact torn by a concurrent ledger spill or recovery drop.
+        """
+        global _host_bytes_total
+        with LOCK:
+            freed = 0
+            if self._payload is not None:
+                from modin_tpu.core.memory import device_ledger
+
+                freed = device_ledger.deregister(self)
+                self._payload = None
+            if self.state is not None:
+                self.state = None
+                _host_bytes_total -= self.host_bytes
+                self.host_bytes = 0
+            key = (self.token, self.kind, self.params)
+            if _entries.get(key) is self:
+                _entries.pop(key, None)
+                toks = _by_token.get(self.token)
+                if toks is not None:
+                    toks.discard(key)
+                    if not toks:
+                        _by_token.pop(self.token, None)
+            return freed
+
+    def spill(self) -> int:
+        """Ledger spill protocol: derived data is dropped, not copied out."""
+        freed = self.drop()
+        if freed:
+            emit_metric("view.spill", 1)
+        return freed
+
+
+def _budget_entries() -> int:
+    from modin_tpu.config import ViewsMaxEntries
+
+    return int(ViewsMaxEntries.get())
+
+
+def _budget_host_bytes() -> int:
+    from modin_tpu.config import ViewsHostBudget
+
+    return int(ViewsHostBudget.get())
+
+
+def _enforce_locked() -> int:
+    """Evict coldest artifacts past the entry/host-byte budgets (lock
+    held); returns the eviction count for the caller to emit OUTSIDE the
+    lock (metric fan-out must never run under it — the PR 9 gate-lock
+    lesson: one slow handler would stall every thread's cache consult)."""
+    max_entries = _budget_entries()
+    max_bytes = _budget_host_bytes()
+    evicted = 0
+    while _entries and (
+        len(_entries) > max_entries or _host_bytes_total > max_bytes
+    ):
+        _key, art = next(iter(_entries.items()))
+        art.drop()  # removes itself from _entries/_by_token
+        evicted += 1
+    return evicted
+
+
+def _drop_locked(art: Any, reason: str, pending: List[str]) -> None:
+    """Drop under the lock, deferring the metric to ``pending`` (emitted
+    by the caller after release)."""
+    art.drop()
+    pending.append(reason)
+
+
+def _emit_dropped(pending: List[str]) -> None:
+    for reason in pending:
+        emit_metric(f"view.invalidate.{reason}", 1)
+
+
+def _valid_locked(art: Any, col: Any) -> Optional[str]:
+    """None when ``art`` is an exact live answer for ``col``; otherwise the
+    staleness reason ('' = merely not-for-this-column, do not drop)."""
+    if not art.live:
+        return "dead"
+    if art.epoch != _current_epoch():
+        return "device_epoch"
+    if art.mesh_key != _mesh_key():
+        return "mesh_reshape"
+    if art.token != getattr(col, "_view_token", None):
+        return ""
+    if art.length != col.length or art.source_id != id(col._data):
+        return "buffer"
+    return None
+
+
+def lookup(
+    col: Any, kind: str, params: Any, consume: bool = True
+) -> Tuple[str, Optional[dict], int]:
+    """Consult the registry for ``col``'s ``(kind, params)`` artifact.
+
+    Returns ``(outcome, state_snapshot, base_length)``:
+
+    - ``("hit", state, col.length)`` — exact live answer for this buffer;
+    - ``("fold", state, base_length)`` — an ancestor's artifact whose state
+      covers rows ``[0, base_length)``; the caller folds the tail
+      ``[base_length, col.length)`` and commits via :func:`store`;
+    - ``("miss", None, 0)`` — compute from scratch.
+
+    ``consume=False`` is the planning probe (the router's sorted-rep
+    ``peek`` analogue): no hit/miss metrics, no LRU touch — the caller
+    decides later whether the answer is actually used and then calls
+    :func:`consume_hit`, so a query the router sends to host never counts
+    artifact hits it did not serve.
+
+    The state dict returned is the artifact's own; callers must not
+    mutate it — folds build a fresh state dict and commit it with
+    :func:`store`.
+    """
+    tok = getattr(col, "_view_token", None)
+    if tok is None or col._data is None or getattr(col, "is_lazy", False):
+        return ("miss", None, 0)
+    pending: List[str] = []
+    outcome: Tuple[str, Optional[dict], int] = ("miss", None, 0)
+    with LOCK:
+        art = _entries.get((tok, kind, params))
+        if art is not None:
+            why = _valid_locked(art, col)
+            if why is None:
+                if consume:
+                    _entries.move_to_end((tok, kind, params))
+                    if art._payload is not None:
+                        from modin_tpu.core.memory import device_ledger
+
+                        device_ledger.touch(art)
+                outcome = ("hit", art.state, col.length)
+            elif why:
+                _drop_locked(art, why, pending)
+        if outcome[0] == "miss":
+            # walk the parent chain for a foldable ancestor artifact
+            link = getattr(col, "_view_parent", None)
+            hops = 0
+            while link is not None and hops < 8:
+                ptok, plen = link
+                art = _entries.get((ptok, kind, params))
+                if art is not None and art.live:
+                    if (
+                        art.epoch == _current_epoch()
+                        and art.mesh_key == _mesh_key()
+                        and art.length == plen
+                    ):
+                        if art.can_fold:
+                            _entries.move_to_end((ptok, kind, params))
+                            outcome = ("fold", art.state, plen)
+                        else:
+                            # honest invalidation: this artifact cannot
+                            # absorb an append — name the reason.  Drop it
+                            # only once its owning column is gone: a live
+                            # parent keeps its warm answer and the child
+                            # simply misses.
+                            owner = art.owner_ref() if art.owner_ref else None
+                            if owner is None:
+                                _drop_locked(art, "not_incremental", pending)
+                    break
+                # follow the chain through columns the registry has seen;
+                # parent links of dead intermediate columns are
+                # unreachable, which is fine — deeper folds save less
+                link = _parent_links.get(ptok)
+                hops += 1
+    # metric fan-out OUTSIDE the lock (user metric handlers can be slow or
+    # raise; neither may stall or break other threads' consults)
+    _emit_dropped(pending)
+    if consume:
+        if outcome[0] == "hit":
+            emit_metric("view.hit", 1)
+        elif outcome[0] == "miss":
+            emit_metric("view.miss", 1)
+    return outcome
+
+
+def consume_hit(col: Any, kind: str, params: Any) -> None:
+    """Mark a previously peeked (``consume=False``) answer as actually
+    served: LRU-touch the entry and emit ``view.hit``.  A no-op when the
+    entry was concurrently invalidated — the value the caller already
+    holds is still correct, it just no longer warms the cache."""
+    tok = getattr(col, "_view_token", None)
+    if tok is None:
+        return
+    touched = False
+    with LOCK:
+        art = _entries.get((tok, kind, params))
+        if art is not None and _valid_locked(art, col) is None:
+            _entries.move_to_end((tok, kind, params))
+            touched = True
+    if touched:
+        emit_metric("view.hit", 1)
+
+
+#: token -> its own (parent_token, parent_length) link, so fold lookups can
+#: walk chains even after intermediate column objects are collected.
+#: FIFO-bounded: links are two ints, but per-append growth must not be
+#: unbounded over a long-lived serving process.
+_parent_links: "OrderedDict[int, Tuple[int, int]]" = OrderedDict()
+_PARENT_LINKS_MAX = 65536
+
+
+def _note_link_locked(token: int, link: Tuple[int, int]) -> None:
+    _parent_links[token] = link
+    while len(_parent_links) > _PARENT_LINKS_MAX:
+        _parent_links.popitem(last=False)
+
+
+def store(
+    col: Any,
+    kind: str,
+    params: Any,
+    state: dict,
+    can_fold: bool = False,
+    payload: Any = None,
+    host_bytes: int = 0,
+    folded: bool = False,
+) -> bool:
+    """Commit an artifact for ``col``.  Returns False when the column's
+    buffer changed since the caller computed (concurrent spill / donation /
+    re-seat) — the stale-write guard: the result is still correct for the
+    caller to RETURN, it just must not be cached against the new buffer."""
+    global _host_bytes_total
+    if col._data is None or getattr(col, "is_lazy", False):
+        return False
+    tok = ensure_token(col)
+    with LOCK:
+        if col._data is None:  # re-check under the lock (spill raced us)
+            return False
+        link = getattr(col, "_view_parent", None)
+        if link is not None:
+            _note_link_locked(tok, link)
+        old = _entries.pop((tok, kind, params), None)
+        if old is not None:
+            old.drop()
+        art = DerivedArtifact(
+            kind, params, tok, col.length, id(col._data), state,
+            can_fold=can_fold, payload=payload, host_bytes=host_bytes,
+        )
+        art.owner_ref = weakref.ref(col)
+        _entries[(tok, kind, params)] = art
+        _by_token.setdefault(tok, set()).add((tok, kind, params))
+        _host_bytes_total += art.host_bytes
+        if payload is not None:
+            from modin_tpu.core.memory import device_ledger
+
+            device_ledger.register(art)
+        evicted = _enforce_locked()
+    if evicted:
+        emit_metric("view.evict", evicted)
+    if folded:
+        emit_metric("view.fold", 1)
+    else:
+        emit_metric("view.build", 1)
+    return True
+
+
+def invalidate_ancestor(col: Any, kind: str, params: Any, reason: str) -> None:
+    """Drop the ancestor artifact a fold for ``col`` would consume — the
+    caller discovered folding it can never succeed (e.g. the combined
+    groupby table overflows the cacheable bound), so leaving it foldable
+    would re-pay the wasted delta dispatch on every later query."""
+    link = getattr(col, "_view_parent", None)
+    pending: List[str] = []
+    with LOCK:
+        hops = 0
+        while link is not None and hops < 8:
+            ptok, _plen = link
+            art = _entries.get((ptok, kind, params))
+            if art is not None and art.live:
+                _drop_locked(art, reason, pending)
+                break
+            link = _parent_links.get(ptok)
+            hops += 1
+    _emit_dropped(pending)
+
+
+def amend_ancestor_state(
+    col: Any, kind: str, params: Any, base_len: int, key: str, value: Any,
+    extra_bytes: int = 0,
+) -> None:
+    """Record a lazily-built auxiliary ``state[key]`` on the ancestor
+    artifact a fold for ``col`` consumed (e.g. the mean fold's per-group
+    count table, derived from the ancestor's own rows): later folds from
+    the same ancestor then skip re-deriving it.  No-op when the ancestor
+    is gone or already carries the key."""
+    global _host_bytes_total
+    link = getattr(col, "_view_parent", None)
+    with LOCK:
+        hops = 0
+        while link is not None and hops < 8:
+            ptok, plen = link
+            art = _entries.get((ptok, kind, params))
+            if art is not None and art.live and art.length == base_len:
+                if art.state.get(key) is None:
+                    art.state[key] = value
+                    art.host_bytes += int(extra_bytes)
+                    _host_bytes_total += int(extra_bytes)
+                return
+            link = _parent_links.get(ptok)
+            hops += 1
+
+
+def invalidate_column(col: Any, reason: str = "buffer") -> None:
+    """Drop every artifact registered under ``col``'s token (buffer
+    mutation: spill / restore / re-seat / materialize / donation)."""
+    tok = getattr(col, "_view_token", None)
+    if tok is None:
+        return
+    pending: List[str] = []
+    with LOCK:
+        keys = _by_token.get(tok)
+        if keys:
+            for key in list(keys):
+                art = _entries.get(key)
+                if art is not None:
+                    _drop_locked(art, reason, pending)
+    _emit_dropped(pending)
+
+
+def stats() -> dict:
+    """Registry introspection (tests, smoke gates)."""
+    with LOCK:
+        return {
+            "entries": len(_entries),
+            "host_bytes": _host_bytes_total,
+            "tokens": len(_by_token),
+        }
+
+
+def live_artifacts() -> List[Any]:
+    with LOCK:
+        return list(_entries.values())
+
+
+def reset() -> None:
+    """Drop every artifact (tests)."""
+    with LOCK:
+        for art in list(_entries.values()):
+            art.drop()
+        _entries.clear()
+        _by_token.clear()
+        _parent_links.clear()

@@ -34,6 +34,7 @@ EXPECTED_SECTIONS = (
     "graftsort",
     "graftplan",
     "fusion",
+    "graftview",
     "recovery",
     "serving",
     "spmd",
@@ -51,6 +52,7 @@ SMOKE_ENV = {
     "BENCH_SORT_ROWS": "120000",
     "BENCH_PLAN_ROWS": "120000",
     "BENCH_FUSE_ROWS": "120000",
+    "BENCH_VIEW_ROWS": "120000",
     "BENCH_RECOVERY_ROWS": "150000",
     # the 10% lineage-overhead acceptance belongs to full-scale runs; at
     # smoke scale the workload is ~10ms and scheduler noise alone flakes it

@@ -86,6 +86,7 @@ run_gate "graftgate"       python scripts/serving_smoke.py
 run_gate "perf_history"    python scripts/perf_history_smoke.py
 run_gate "graftmesh"       python scripts/spmd_smoke.py
 run_gate "graftstream"     python scripts/oocore_smoke.py
+run_gate "graftview"       python scripts/views_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -95,4 +96,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL FOURTEEN GATES GREEN"
+echo "ALL FIFTEEN GATES GREEN"

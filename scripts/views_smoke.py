@@ -1,0 +1,194 @@
+"""graftview smoke gate: query -> append -> re-query, observable and safe.
+
+Run by scripts/check_all.sh (the fifteenth gate).  On the 8-device
+virtual CPU mesh it asserts, end to end:
+
+1. a mixed aggregation workload (scalar aggs + a groupby) re-run after an
+   appended batch is bit-exact vs pandas AND vs ``MODIN_TPU_VIEWS=Off``
+   on the same data (the cache is invisible to correctness);
+2. the incremental maintenance actually ran — ``view.fold`` appears in
+   the graftmeter snapshot, alongside ``view.hit`` for the warm re-run;
+3. a ``DeviceLost`` injected mid-fold (the fold's first delta dispatch)
+   recovers bit-exact with artifacts dropped by the reseat pass and ZERO
+   ``recovery.unrecoverable``;
+4. a ledger-pressure burst drops derived artifacts BEFORE any real
+   column pays a device->host spill.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_METERS"] = "1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pandas  # noqa: E402
+
+
+def _suite(frame):
+    return {
+        "sum": frame.sum(),
+        "mean": frame.mean(),
+        "min": frame.min(),
+        "count": frame.count(),
+        "gb": frame.groupby("k").sum(),
+    }
+
+
+def _check(got, expect, what):
+    import pandas.testing as pt
+
+    for name in expect:
+        g = got[name]
+        g = g._to_pandas() if hasattr(g, "_to_pandas") else g
+        e = expect[name]
+        e = e._to_pandas() if hasattr(e, "_to_pandas") else e
+        if isinstance(e, pandas.DataFrame):
+            pt.assert_frame_equal(g, e), name
+        else:
+            pt.assert_series_equal(g, e), name
+    print(f"views_smoke: {what} OK")
+
+
+def main() -> int:
+    import modin_tpu.pandas as pd
+    from modin_tpu.config import ResilienceBackoffS, ViewsMode
+    from modin_tpu.core.memory import device_ledger
+    from modin_tpu.logging import add_metric_handler
+    from modin_tpu.observability import meters
+    from modin_tpu.testing import midquery_device_loss
+    from modin_tpu.views import registry as view_registry
+
+    seen = []
+    add_metric_handler(lambda name, value: seen.append(name))
+    ResilienceBackoffS.put(0.0)
+    assert meters.METERS_ON, "MODIN_TPU_METERS=1 did not enable aggregation"
+    meters.reset()
+
+    rng = np.random.default_rng(3)
+    n, n_tail = 50_000, 2_000
+    mk = lambda m, seed: pandas.DataFrame(  # noqa: E731
+        {
+            "i": np.random.default_rng(seed).integers(-1000, 1000, m),
+            "x": np.random.default_rng(seed + 1).normal(size=m),
+            "k": np.random.default_rng(seed + 2).integers(0, 32, m),
+        }
+    )
+    pdf, tail = mk(n, 10), mk(n_tail, 20)
+    pdf2 = pandas.concat([pdf, tail], ignore_index=True)
+
+    # ---- leg 1+2: query -> append -> re-query, meters watching -------- #
+    mdf = pd.DataFrame(pdf)
+    _check(_suite(mdf), _suite(pdf), "cold vs pandas")
+    _check(_suite(mdf), _suite(pdf), "warm vs pandas")
+    mdf2 = pd.concat([mdf, pd.DataFrame(tail)], ignore_index=True)
+    auto_results = _suite(mdf2)
+    _check(auto_results, _suite(pdf2), "appended vs pandas")
+
+    snapshot = meters.snapshot()
+    series = snapshot["series"]
+    folds = series.get("view.fold", {}).get("total", 0)
+    hits = series.get("view.hit", {}).get("total", 0)
+    assert folds > 0, f"no view.fold in the meter snapshot: {sorted(series)}"
+    assert hits > 0, "no view.hit in the meter snapshot"
+    print(f"views_smoke: meter snapshot OK (view.fold={folds}, view.hit={hits})")
+
+    # Off-mode ground truth on the same data: bit-for-bit today's behavior
+    before = ViewsMode.get()
+    ViewsMode.put("Off")
+    try:
+        view_registry.reset()
+        off_results = _suite(pd.DataFrame(pdf2))
+    finally:
+        ViewsMode.put(before)
+    for name in off_results:
+        a = auto_results[name]._to_pandas()
+        o = off_results[name]._to_pandas()
+        if isinstance(o, pandas.DataFrame):
+            pandas.testing.assert_frame_equal(a, o)
+        else:
+            pandas.testing.assert_series_equal(a, o)
+        # the int column is bit-exact by contract (associative folds)
+        if not isinstance(o, pandas.DataFrame) and name != "mean":
+            assert repr(a["i"]) == repr(o["i"]), (name, a["i"], o["i"])
+    print("views_smoke: Auto vs Off OK")
+
+    # ---- leg 3: DeviceLost mid-fold ----------------------------------- #
+    # drop the earlier legs' frames first: small groupby RESULT columns
+    # (device outputs with opaque lineage, no host copy) are legitimately
+    # unrecoverable if a loss hits while a test keeps them alive — this
+    # leg asserts the VIEWS machinery never adds an unrecoverable entry
+    import gc
+
+    del auto_results, off_results, mdf, mdf2
+    gc.collect()
+    view_registry.reset()
+    mdf3 = pd.DataFrame(pdf)
+    mdf3.sum()  # seed the artifacts the fold will extend
+    mdf4 = pd.concat([mdf3, pd.DataFrame(tail)], ignore_index=True)
+    unrecoverable_before = seen.count("modin_tpu.recovery.unrecoverable")
+    with midquery_device_loss(after_deploys=0, times=1):
+        got = mdf4.sum()
+    expect = pdf2.sum()
+    assert repr(got._to_pandas()["i"]) == repr(expect["i"]), (
+        "mid-fold DeviceLost result not bit-exact on the int column"
+    )
+    pandas.testing.assert_series_equal(got._to_pandas(), expect)
+    assert seen.count("modin_tpu.recovery.unrecoverable") == unrecoverable_before, (
+        "an artifact was counted unrecoverable during mid-fold recovery"
+    )
+    assert seen.count("modin_tpu.recovery.device_lost") > 0, (
+        "the injected loss never reached recovery"
+    )
+    print("views_smoke: mid-fold DeviceLost OK")
+
+    # ---- leg 4: ledger pressure drops artifacts before columns -------- #
+    view_registry.reset()
+    mdf5 = pd.DataFrame(pdf)
+    mdf5.median()  # builds device-resident sorted reps (derived entries)
+    frame = mdf5._query_compiler._modin_frame
+    cols = [frame.get_column(i) for i in range(frame.num_cols)]
+    derived = [
+        e for e in device_ledger.live_columns()
+        if getattr(e, "is_derived_cache", False)
+    ]
+    assert derived, "no derived entries in the device ledger"
+    spills_before = seen.count("modin_tpu.memory.device.spill")
+    freed = device_ledger.spill_lru(1)
+    assert freed > 0, "pressure pass freed nothing"
+    assert all(not c.is_spilled for c in cols), (
+        "a real column spilled while derived artifacts were available"
+    )
+    assert (
+        seen.count("modin_tpu.sortcache.spill")
+        + seen.count("modin_tpu.view.spill")
+        > 0
+    ), "the pressure pass did not drop a derived artifact"
+    pandas.testing.assert_series_equal(
+        mdf5.median()._to_pandas(), pdf.median()
+    )
+    print(
+        f"views_smoke: pressure OK (freed {freed} derived bytes, "
+        f"{seen.count('modin_tpu.memory.device.spill') - spills_before} "
+        "spill pass(es), zero column spills)"
+    )
+    print("views_smoke: ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(f"views_smoke: FAILED — {err}", file=sys.stderr)
+        sys.exit(1)

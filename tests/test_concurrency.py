@@ -537,3 +537,116 @@ def test_reseat_once_concurrent_engine_calls(metrics):
     )
     assert metrics.count("modin_tpu.recovery.device_lost") == 1
     assert np.array_equal(col.to_numpy(), values)
+
+
+# --------------------------------------------------------------------- #
+# graftview: the lookup -> delta-epoch/commit stale-read class
+# --------------------------------------------------------------------- #
+
+
+def test_view_artifact_commit_loses_to_concurrent_buffer_mutation():
+    """Barrier-aligned graftview tear regression (the PR 9 sorted-rep tear
+    class, one layer up): thread A snapshots an artifact between lookup
+    and commit while thread B mutates the column's buffer (a concurrent
+    append's spill/invalidate).  A's commit must become a no-op — never a
+    stale artifact claiming the new buffer — and the registry must stay
+    consistent for the next query."""
+    from modin_tpu.views import registry as view_registry
+
+    view_registry.reset()
+    values = np.arange(4096, dtype=np.int64)
+    col = DeviceColumn.from_numpy(values)
+    params = ("sum", True, 1, False)
+    assert view_registry.store(
+        col, "reduce", params, {"r": np.int64(values.sum())}, can_fold=True
+    )
+    barrier = threading.Barrier(2, timeout=30)
+    done = threading.Barrier(2, timeout=30)
+    out = {}
+
+    def reader():
+        outcome, state, _ = view_registry.lookup(col, "reduce", params)
+        out["outcome"] = outcome
+        out["state"] = dict(state) if state else None
+        barrier.wait()  # B mutates the buffer here
+        done.wait()
+        out["committed"] = view_registry.store(
+            col, "reduce", params, out["state"], can_fold=True
+        )
+
+    def mutator():
+        barrier.wait()
+        out["freed"] = col.spill()
+        done.wait()
+
+    ts = [threading.Thread(target=reader), threading.Thread(target=mutator)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert out["outcome"] == "hit"
+    assert out["freed"] > 0
+    assert out["committed"] is False, (
+        "a commit against a spilled buffer must decline"
+    )
+    # spill invalidated the bucket; nothing may claim the column
+    assert view_registry.lookup(col, "reduce", params)[0] == "miss"
+    # the column itself stays correct (transparent restore)
+    assert np.array_equal(col.to_numpy(), values)
+
+
+def test_view_fold_lookup_race_with_append_branching():
+    """Two threads fold from ONE parent artifact into two different
+    appended children at a barrier: each commit lands under its own child
+    token with its own tail, so neither branch can serve the other's
+    answer (the delta-epoch check the ISSUE names, exercised at the
+    registry layer where the interleaving is deterministic)."""
+    from modin_tpu.views import incremental, registry as view_registry
+
+    view_registry.reset()
+    base = np.arange(1000, dtype=np.int64)
+    parent = DeviceColumn.from_numpy(base)
+    params = ("sum", True, 1, False)
+    assert view_registry.store(
+        parent, "reduce", params, {"r": np.int64(base.sum())}, can_fold=True
+    )
+
+    def make_child(tail):
+        child = DeviceColumn.from_numpy(np.concatenate([base, tail]))
+        view_registry.note_append(child, parent)
+        return child
+
+    tail_a = np.full(100, 7, dtype=np.int64)
+    tail_b = np.full(250, -3, dtype=np.int64)
+    child_a, child_b = make_child(tail_a), make_child(tail_b)
+    barrier = threading.Barrier(2, timeout=30)
+    out = {}
+
+    def fold(name, child, tail):
+        outcome, state, n0 = view_registry.lookup(child, "reduce", params)
+        assert outcome == "fold" and n0 == len(base)
+        barrier.wait()  # both threads hold the SAME parent snapshot
+        folded = incremental.combine_scalar(
+            "sum", True, state["r"], np.int64(tail.sum())
+        )
+        view_registry.store(
+            child, "reduce", params, {"r": folded}, can_fold=True,
+            folded=True,
+        )
+        out[name] = folded
+
+    ts = [
+        threading.Thread(target=fold, args=("a", child_a, tail_a)),
+        threading.Thread(target=fold, args=("b", child_b, tail_b)),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert out["a"] == base.sum() + tail_a.sum()
+    assert out["b"] == base.sum() + tail_b.sum()
+    # each child answers with ITS branch's artifact
+    oa, sa, _ = view_registry.lookup(child_a, "reduce", params)
+    ob, sb, _ = view_registry.lookup(child_b, "reduce", params)
+    assert (oa, sa["r"]) == ("hit", out["a"])
+    assert (ob, sb["r"]) == ("hit", out["b"])

@@ -132,6 +132,89 @@ def test_series_repeat_explode():
     eval_general(ml, pl_, lambda s: s.explode())
 
 
+# --------------------------------------------------------------------- #
+# graftview invisibility grid: agg x dtype x skipna, Auto vs Off, warm
+# and appended.  The derived-artifact cache (modin_tpu/views/) must be
+# invisible to correctness: a warm re-run (whole-result hit) and a re-run
+# after an appended batch (incremental fold where the op is algebraic)
+# must answer exactly what a cold MODIN_TPU_VIEWS=Off run answers.
+# --------------------------------------------------------------------- #
+
+VIEW_GRID_AGGS = [
+    "sum", "mean", "min", "max", "count", "prod", "var", "std", "median",
+    "nunique", "any", "all",
+]
+
+#: folds of these aggs re-associate a floating-point accumulation (the
+#: graftstream window-combiner contract); everything else is bit-exact
+_FP_REASSOCIATING = {"sum", "mean", "prod", "var", "std"}
+
+
+def _views_off_result(data, append, agg, skipna_kw):
+    from modin_tpu.config import ViewsMode
+    from modin_tpu.views import registry as view_registry
+
+    before = ViewsMode.get()
+    ViewsMode.put("Off")
+    try:
+        view_registry.reset()
+        s = pd.Series(data)
+        if append:
+            s = pd.concat([s, pd.Series(data[: len(data) // 3])],
+                          ignore_index=True)
+        return getattr(s, agg)(**skipna_kw)
+    finally:
+        ViewsMode.put(before)
+
+
+@pytest.mark.parametrize("append", [False, True], ids=["flat", "appended"])
+@pytest.mark.parametrize("skipna", [True, False, None],
+                         ids=["skipna", "no_skipna", "default"])
+@pytest.mark.parametrize("agg", VIEW_GRID_AGGS)
+@pytest.mark.parametrize("dtype", list(SERIES_DATA), ids=list(SERIES_DATA))
+def test_views_grid_auto_vs_off(dtype, agg, skipna, append):
+    if skipna is not None and agg in ("count", "nunique", "any", "all"):
+        pytest.skip("agg takes no skipna")
+    data = SERIES_DATA[dtype]
+    skipna_kw = {} if skipna is None else {"skipna": skipna}
+    pandas_s = pandas.Series(data)
+    if append:
+        pandas_s = pandas.concat(
+            [pandas_s, pandas.Series(data[: len(data) // 3])],
+            ignore_index=True,
+        )
+    expect_pd = getattr(pandas_s, agg)(**skipna_kw)
+
+    # Auto: cold run seeds the artifacts, warm run must hit, and the
+    # appended variant folds (or honestly invalidates) — then everything
+    # is compared against Off AND pandas
+    base = pd.Series(data)
+    getattr(base, agg)(**skipna_kw)  # seed artifacts on the base frame
+    if append:
+        target = pd.concat([base, pd.Series(data[: len(data) // 3])],
+                           ignore_index=True)
+    else:
+        target = base
+    auto_1 = getattr(target, agg)(**skipna_kw)
+    auto_2 = getattr(target, agg)(**skipna_kw)  # warm: artifact hit
+    off = _views_off_result(data, append, agg, skipna_kw)
+
+    df_equals(auto_1, expect_pd)
+    df_equals(auto_2, expect_pd)
+    df_equals(auto_1, off)
+    # bit-exactness holds everywhere EXCEPT the appended fp-reassociating
+    # folds: mean always accumulates in float64, and float sum/prod folds
+    # combine segment partials (the graftstream window-combiner contract).
+    # Non-foldable aggs (var/std/median/nunique) recompute cold after an
+    # append, so they are bit-exact even appended.
+    fp_fold = append and (
+        agg == "mean"
+        or (agg in ("sum", "prod") and dtype not in ("ints", "bools"))
+    )
+    if not fp_fold:
+        assert repr(auto_1) == repr(off) == repr(auto_2), (auto_1, off)
+
+
 def test_arrow_list_struct_accessors():
     pa = pytest.importorskip("pyarrow")
     s = pd.Series(

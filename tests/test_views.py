@@ -1,0 +1,516 @@
+"""graftview acceptance suite: the derived-artifact registry, incremental
+maintenance over appended batches, and cross-query sharing.
+
+Covers the PR's tentpole contract:
+
+- whole-result reuse (scalar aggs, nunique/mode/median, groupby tables)
+  with results bit-exact vs pandas and identical to the Off path;
+- append-only folds (algebraic scalar combines, groupby partial tables,
+  dictionary code-table extension) dispatching only the delta;
+- eager invalidation under every buffer mutation + honest
+  ``not_incremental`` invalidation for non-foldable artifacts;
+- ledger-pressure drops ordered derived-first;
+- chaos: DeviceLost mid-fold recovers bit-exact with zero
+  ``recovery.unrecoverable``;
+- the stale-write guard between lookup and commit under concurrent
+  buffer mutation.
+"""
+
+import threading
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu.config import ViewsMaxGroups, ViewsMode
+from modin_tpu.logging.metrics import add_metric_handler, clear_metric_handler
+from modin_tpu.views import incremental, registry
+
+from tests.utils import df_equals, require_tpu_execution
+
+
+@pytest.fixture(autouse=True)
+def _tpu_only():
+    require_tpu_execution()
+    registry.reset()
+    yield
+    registry.reset()
+
+
+@pytest.fixture
+def metric_log():
+    events = []
+
+    def handler(name, value):
+        events.append((name, value))
+
+    add_metric_handler(handler)
+    yield events
+    clear_metric_handler(handler)
+
+
+def _count(events, name):
+    return sum(1 for n, _ in events if n == f"modin_tpu.{name}")
+
+
+def _count_prefix(events, prefix):
+    return sum(1 for n, _ in events if n.startswith(f"modin_tpu.{prefix}"))
+
+
+def _device_col(mdf, label):
+    frame = mdf._query_compiler._modin_frame
+    return frame.get_column(list(frame.columns).index(label))
+
+
+def _frames(n=400, seed=7):
+    rng = np.random.default_rng(seed)
+    pdf = pandas.DataFrame(
+        {
+            "i": rng.integers(-1000, 1000, n),
+            "f": np.where(rng.random(n) < 0.2, np.nan, rng.normal(size=n)),
+            "b": rng.random(n) < 0.5,
+        }
+    )
+    return pd.DataFrame(pdf), pdf
+
+
+def _tails(n=120, seed=8):
+    rng = np.random.default_rng(seed)
+    return pandas.DataFrame(
+        {
+            "i": rng.integers(-1000, 1000, n),
+            "f": np.where(rng.random(n) < 0.2, np.nan, rng.normal(size=n)),
+            "b": rng.random(n) < 0.5,
+        }
+    )
+
+
+class TestWholeResultReuse:
+    def test_second_query_is_artifact_hit(self, metric_log):
+        mdf, pdf = _frames()
+        df_equals(mdf.sum(), pdf.sum())
+        builds = _count(metric_log, "view.build")
+        assert builds >= 3  # one artifact per column
+        hits_before = _count(metric_log, "view.hit")
+        df_equals(mdf.sum(), pdf.sum())
+        assert _count(metric_log, "view.hit") >= hits_before + 3
+        assert _count(metric_log, "view.build") == builds  # nothing recomputed
+
+    @pytest.mark.parametrize(
+        "op", ["sum", "mean", "min", "max", "count", "prod", "var", "std",
+               "median", "any", "all"]
+    )
+    def test_scalar_ops_cached_and_correct(self, op):
+        mdf, pdf = _frames()
+        df_equals(getattr(mdf, op)(), getattr(pdf, op)())
+        df_equals(getattr(mdf, op)(), getattr(pdf, op)())  # warm
+
+    def test_nunique_mode_cached(self, metric_log):
+        mdf, pdf = _frames()
+        df_equals(mdf.nunique(), pdf.nunique())
+        df_equals(mdf.mode(), pdf.mode())
+        hits_before = _count(metric_log, "view.hit")
+        df_equals(mdf.nunique(), pdf.nunique())
+        df_equals(mdf.mode(), pdf.mode())
+        assert _count(metric_log, "view.hit") > hits_before
+
+    def test_groupby_result_cached(self, metric_log):
+        mdf, pdf = _frames()
+        df_equals(mdf.groupby("b").sum(), pdf.groupby("b").sum())
+        hits_before = _count(metric_log, "view.hit")
+        df_equals(mdf.groupby("b").sum(), pdf.groupby("b").sum())
+        assert _count(metric_log, "view.hit") > hits_before
+
+    def test_cross_thread_sharing(self, metric_log):
+        mdf, pdf = _frames()
+        df_equals(mdf.sum(), pdf.sum())  # seed the artifacts on this thread
+        results = {}
+
+        def worker():
+            results["sum"] = mdf.sum()
+
+        t = threading.Thread(target=worker)
+        hits_before = _count(metric_log, "view.hit")
+        t.start()
+        t.join()
+        df_equals(results["sum"], pdf.sum())
+        assert _count(metric_log, "view.hit") >= hits_before + 3
+
+    def test_query_stats_rollup(self):
+        from modin_tpu.observability import query_stats
+
+        mdf, pdf = _frames()
+        df_equals(mdf.sum(), pdf.sum())
+        with query_stats("warm") as qs:
+            df_equals(mdf.sum(), pdf.sum())
+        assert qs.view_hits >= 3
+        assert "views:" in qs.summary()
+
+
+class TestIncrementalFolds:
+    def _append(self, mdf, pdf, tail):
+        mdf2 = pd.concat([mdf, pd.DataFrame(tail)], ignore_index=True)
+        pdf2 = pandas.concat([pdf, tail], ignore_index=True)
+        return mdf2, pdf2
+
+    @pytest.mark.parametrize("op", ["sum", "count", "min", "max", "prod"])
+    def test_fold_bit_exact_int(self, metric_log, op):
+        mdf, pdf = _frames()
+        getattr(mdf, op)()
+        mdf2, pdf2 = self._append(mdf, pdf, _tails())
+        folds_before = _count(metric_log, "view.fold")
+        got = getattr(mdf2, op)()
+        assert _count(metric_log, "view.fold") > folds_before
+        expect = getattr(pdf2, op)()
+        # integer/bool columns: the fold is bit-exact, not just tolerant
+        assert got["i"] == expect["i"]
+        df_equals(got, expect)
+
+    @pytest.mark.parametrize("op", ["mean", "sum", "min", "max", "count"])
+    def test_fold_float_matches_pandas(self, metric_log, op):
+        mdf, pdf = _frames()
+        getattr(mdf, op)()
+        mdf2, pdf2 = self._append(mdf, pdf, _tails())
+        folds_before = _count(metric_log, "view.fold")
+        df_equals(getattr(mdf2, op)(), getattr(pdf2, op)())
+        assert _count(metric_log, "view.fold") > folds_before
+
+    def test_fold_matches_views_off(self):
+        """The cache must be invisible: Auto-after-append == Off."""
+        mdf, pdf = _frames()
+        mdf.sum(), mdf.mean(), mdf.min()
+        tail = _tails()
+        mdf2, pdf2 = self._append(mdf, pdf, tail)
+        auto = {op: getattr(mdf2, op)() for op in ("sum", "mean", "min")}
+        before = ViewsMode.get()
+        ViewsMode.put("Off")
+        try:
+            registry.reset()
+            m_off = pd.DataFrame(pdf2)
+            off = {op: getattr(m_off, op)() for op in ("sum", "mean", "min")}
+        finally:
+            ViewsMode.put(before)
+        for op in auto:
+            # sum/min fold bit-exact on the int column; mean re-associates
+            # the fp accumulation (documented contract) and floats compare
+            # at the differential tolerance
+            if op != "mean":
+                assert auto[op]["i"] == off[op]["i"], op
+            df_equals(auto[op], off[op])
+
+    def test_chained_appends_fold_twice(self, metric_log):
+        mdf, pdf = _frames()
+        mdf.sum()
+        mdf2, pdf2 = self._append(mdf, pdf, _tails(seed=21))
+        mdf2.sum()
+        mdf3, pdf3 = self._append(mdf2, pdf2, _tails(seed=22))
+        folds_before = _count(metric_log, "view.fold")
+        df_equals(mdf3.sum(), pdf3.sum())
+        assert _count(metric_log, "view.fold") > folds_before
+
+    def test_branching_appends_never_cross(self):
+        """Two different appends onto one parent, folded from two
+        concurrent serving sessions: each branch's fold must answer for
+        ITS tail (fresh child tokens prevent contamination).  Dispatch
+        rides serving.submit — the collective-safe path for concurrent
+        threads on the sharded mesh (PR 9)."""
+        import modin_tpu.serving as serving
+        from modin_tpu.config import ServingEnabled
+
+        mdf, pdf = _frames()
+        mdf.sum()
+        tail_a, tail_b = _tails(seed=31), _tails(seed=32)
+        mdf_a, pdf_a = self._append(mdf, pdf, tail_a)
+        mdf_b, pdf_b = self._append(mdf, pdf, tail_b)
+        barrier = threading.Barrier(2, timeout=30)
+        results = {}
+        serving_before = ServingEnabled.get()
+        ServingEnabled.put(True)
+        try:
+
+            def run(name, frame):
+                barrier.wait()
+                results[name] = serving.submit(
+                    frame.sum, tenant=name, deadline_ms=0
+                )
+
+            ts = [
+                threading.Thread(target=run, args=("a", mdf_a)),
+                threading.Thread(target=run, args=("b", mdf_b)),
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            ServingEnabled.put(serving_before)
+        assert results["a"]["i"] == pdf_a.sum()["i"]
+        assert results["b"]["i"] == pdf_b.sum()["i"]
+        df_equals(results["a"], pdf_a.sum())
+        df_equals(results["b"], pdf_b.sum())
+
+    def test_non_incremental_keeps_live_parent_warm(self, metric_log):
+        """An append reaching a non-foldable artifact must not destroy the
+        LIVE parent's warm answer: the child misses and recomputes, the
+        parent keeps hitting."""
+        mdf, pdf = _frames()
+        df_equals(mdf.median(), pdf.median())
+        mdf2, pdf2 = self._append(mdf, pdf, _tails())
+        inval_before = _count(metric_log, "view.invalidate.not_incremental")
+        df_equals(mdf2.median(), pdf2.median())
+        assert _count(metric_log, "view.invalidate.not_incremental") == inval_before
+        hits_before = _count(metric_log, "view.hit")
+        df_equals(mdf.median(), pdf.median())  # the parent is still warm
+        assert _count(metric_log, "view.hit") > hits_before
+
+    def test_non_incremental_invalidates_honestly_once_parent_dies(
+        self, metric_log
+    ):
+        import gc
+
+        mdf, pdf = _frames()
+        df_equals(mdf.median(), pdf.median())
+        mdf2, pdf2 = self._append(mdf, pdf, _tails())
+        del mdf  # the pre-append frame is gone: the artifact is dead weight
+        gc.collect()
+        inval_before = _count(metric_log, "view.invalidate.not_incremental")
+        df_equals(mdf2.median(), pdf2.median())
+        assert _count(metric_log, "view.invalidate.not_incremental") > inval_before
+
+    def test_groupby_folds(self, metric_log):
+        mdf, pdf = _frames()
+        for agg in ("sum", "count", "mean", "min", "max"):
+            getattr(mdf.groupby("b"), agg)()
+        mdf2, pdf2 = self._append(mdf, pdf, _tails())
+        folds_before = _count(metric_log, "view.fold")
+        for agg in ("sum", "count", "mean", "min", "max"):
+            df_equals(
+                getattr(mdf2.groupby("b"), agg)(),
+                getattr(pdf2.groupby("b"), agg)(),
+            )
+        assert _count(metric_log, "view.fold") > folds_before
+
+    def test_groupby_size_and_selection(self, metric_log):
+        mdf, pdf = _frames()
+        df_equals(mdf.groupby("b").size(), pdf.groupby("b").size())
+        df_equals(mdf.groupby("b")["i"].sum(), pdf.groupby("b")["i"].sum())
+        mdf2, pdf2 = self._append(mdf, pdf, _tails())
+        df_equals(mdf2.groupby("b").size(), pdf2.groupby("b").size())
+        df_equals(mdf2.groupby("b")["i"].sum(), pdf2.groupby("b")["i"].sum())
+
+    def test_groupby_bound_declines_large_cardinality(self, metric_log):
+        before = ViewsMaxGroups.get()
+        ViewsMaxGroups.put(8)
+        try:
+            rng = np.random.default_rng(5)
+            pdf = pandas.DataFrame(
+                {"k": rng.integers(0, 64, 500), "v": rng.integers(0, 9, 500)}
+            )
+            mdf = pd.DataFrame(pdf)
+            builds_before = _count(metric_log, "view.build")
+            df_equals(mdf.groupby("k").sum(), pdf.groupby("k").sum())
+            # 64 groups > bound of 8: no groupby artifact may be cached
+            # (the per-column scalar artifacts are a different kind)
+            assert not any(
+                art.kind == "groupby" for art in registry.live_artifacts()
+            ), builds_before
+        finally:
+            ViewsMaxGroups.put(before)
+
+    def test_dictionary_code_table_extension(self, metric_log):
+        pdf = pandas.DataFrame(
+            {
+                "city": ["lima", "oslo", None, "lima", "oslo", "lima"],
+                "n": np.arange(6, dtype=np.int64),
+            }
+        )
+        mdf = pd.DataFrame(pdf)
+        # seed the encoding (nunique factorizes the string column)
+        df_equals(mdf.nunique(), pdf.nunique())
+        tail = pandas.DataFrame(
+            {"city": ["pune", "lima", None], "n": np.arange(3, dtype=np.int64)}
+        )
+        folds_before = _count(metric_log, "view.fold")
+        mdf2 = pd.concat([mdf, pd.DataFrame(tail)], ignore_index=True)
+        pdf2 = pandas.concat([pdf, tail], ignore_index=True)
+        assert _count(metric_log, "view.fold") > folds_before
+        # the extended encoding must answer EXACTLY like a fresh factorize
+        col = mdf2._query_compiler._modin_frame.get_column(0)
+        enc = col._dict_cache
+        assert enc is not None and enc is not False
+        assert list(enc.categories) == ["lima", "oslo", "pune"]
+        assert enc.has_nan
+        codes = np.asarray(enc.codes.to_numpy(), dtype=np.float64)
+        expect_codes, expect_cats = pandas.factorize(
+            np.asarray(pdf2["city"], dtype=object), sort=True,
+            use_na_sentinel=True,
+        )
+        np.testing.assert_array_equal(
+            np.where(np.isnan(codes), -1, codes).astype(np.int64), expect_codes
+        )
+        df_equals(mdf2.nunique(), pdf2.nunique())
+        df_equals(
+            mdf2.groupby("city").sum(), pdf2.groupby("city").sum()
+        )
+
+
+class TestInvalidation:
+    def test_setitem_misses_cleanly(self):
+        mdf, pdf = _frames()
+        df_equals(mdf.sum(), pdf.sum())
+        mdf["i"] = mdf["i"] * 2
+        pdf["i"] = pdf["i"] * 2
+        df_equals(mdf.sum(), pdf.sum())
+        df_equals(mdf.mean(), pdf.mean())
+
+    def test_spill_restore_invalidates(self, metric_log):
+        mdf, pdf = _frames()
+        df_equals(mdf.sum(), pdf.sum())
+        col = _device_col(mdf, "i")
+        assert col.spill() > 0
+        assert _count_prefix(metric_log, "view.invalidate.") >= 1
+        assert col.raw is not None  # transparent restore
+        df_equals(mdf.sum(), pdf.sum())
+
+    def test_reseat_invalidates(self):
+        mdf, pdf = _frames()
+        df_equals(mdf.max(), pdf.max())
+        col = _device_col(mdf, "i")
+        col.reseat_from_host()
+        assert registry.lookup(col, "reduce", ("max", True, 1, False))[0] == "miss"
+        assert registry.lookup(col, "reduce", ("max", True, 1, True))[0] == "miss"
+        df_equals(mdf.max(), pdf.max())
+
+    def test_recovery_pass_drops_artifacts(self, metric_log):
+        from modin_tpu.core.execution import recovery
+
+        mdf, pdf = _frames()
+        df_equals(mdf.sum(), pdf.sum())
+        assert len(registry.live_artifacts()) >= 3
+        recovery.reseat_all("test-views-epoch-bump")
+        # the epoch bump makes every artifact stale; queries stay correct
+        # and nothing counts unrecoverable
+        df_equals(mdf.sum(), pdf.sum())
+        assert _count(metric_log, "recovery.unrecoverable") == 0
+
+    def test_pressure_drops_artifacts_before_columns(self):
+        from modin_tpu.core.memory import device_ledger
+
+        mdf, pdf = _frames(n=1024)
+        df_equals(mdf.median(), pdf.median())  # builds sorted reps
+        reps = [
+            e for e in device_ledger.live_columns()
+            if getattr(e, "is_derived_cache", False)
+        ]
+        assert reps
+        cols = [_device_col(mdf, c) for c in ("i", "f", "b")]
+        freed = device_ledger.spill_lru(1)  # tiny target: one entry
+        assert freed > 0
+        # a derived cache paid the pressure; every real column is resident
+        assert all(not c.is_spilled for c in cols)
+        df_equals(mdf.median(), pdf.median())
+
+
+class TestChaos:
+    def test_device_lost_mid_fold_recovers_bit_exact(self, metric_log):
+        from modin_tpu.testing.faults import midquery_device_loss
+
+        mdf, pdf = _frames()
+        mdf.sum()
+        tail = _tails()
+        mdf2 = pd.concat([mdf, pd.DataFrame(tail)], ignore_index=True)
+        pdf2 = pandas.concat([pdf, tail], ignore_index=True)
+        # the fold's FIRST dispatch (the tail gather) dies; recovery
+        # re-seats and the retry answers bit-exact
+        with midquery_device_loss(after_deploys=0, times=1):
+            got = mdf2.sum()
+        assert got["i"] == pdf2.sum()["i"]
+        df_equals(got, pdf2.sum())
+        assert _count(metric_log, "recovery.unrecoverable") == 0
+        # artifacts from the dead epoch never serve afterwards
+        df_equals(mdf2.mean(), pdf2.mean())
+
+
+class TestStaleWriteGuard:
+    def test_store_declines_on_spilled_buffer(self):
+        mdf, pdf = _frames()
+        df_equals(mdf.sum(), pdf.sum())
+        col = _device_col(mdf, "i")
+        params = ("sum", True, 1, True)  # sum casts bools in-fusion
+        outcome, state, _ = registry.lookup(col, "reduce", params)
+        assert outcome == "hit"
+        # simulate the racer: the buffer mutates between lookup and commit
+        assert col.spill() > 0
+        assert registry.store(col, "reduce", params, dict(state)) is False
+
+    def test_concurrent_append_and_spill_stress(self):
+        """The PR 9 sorted-rep tear class, graftview edition: one thread
+        folds over an appended child while another spills the child's
+        buffer.  Every answer must equal pandas; a racer's commit becomes
+        a no-op, never a stale artifact."""
+        import modin_tpu.serving as serving
+        from modin_tpu.config import ServingEnabled
+
+        serving_before = ServingEnabled.get()
+        ServingEnabled.put(True)
+        try:
+            for round_ in range(6):
+                registry.reset()
+                mdf, pdf = _frames(seed=100 + round_)
+                mdf.sum()
+                tail = _tails(seed=200 + round_)
+                mdf2 = pd.concat([mdf, pd.DataFrame(tail)], ignore_index=True)
+                pdf2 = pandas.concat([pdf, tail], ignore_index=True)
+                col = _device_col(mdf2, "i")
+                barrier = threading.Barrier(2, timeout=30)
+                out = {}
+
+                def fold():
+                    barrier.wait()
+                    out["sum"] = serving.submit(
+                        mdf2.sum, tenant="fold", deadline_ms=0
+                    )
+
+                def spill():
+                    barrier.wait()
+                    col.spill()
+
+                ts = [
+                    threading.Thread(target=fold),
+                    threading.Thread(target=spill),
+                ]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                self._check_round(out, pdf2, col)
+        finally:
+            ServingEnabled.put(serving_before)
+
+    @staticmethod
+    def _check_round(out, pdf2, col):
+        assert out["sum"]["i"] == pdf2.sum()["i"]
+        df_equals(out["sum"], pdf2.sum())
+        # whatever the interleaving, no live artifact may claim a
+        # buffer the column no longer holds
+        for art in registry.live_artifacts():
+            if art.token == col._view_token and art.kind == "reduce":
+                assert art.source_id == id(col._data)
+
+
+class TestOffMode:
+    def test_off_is_inert_and_identical(self):
+        before = ViewsMode.get()
+        ViewsMode.put("Off")
+        try:
+            registry.reset()
+            mdf, pdf = _frames()
+            df_equals(mdf.sum(), pdf.sum())
+            df_equals(mdf.groupby("b").mean(), pdf.groupby("b").mean())
+            mdf2 = pd.concat([mdf, pd.DataFrame(_tails())], ignore_index=True)
+            pdf2 = pandas.concat([pdf, _tails()], ignore_index=True)
+            df_equals(mdf2.sum(), pdf2.sum())
+            assert registry.stats()["entries"] == 0
+        finally:
+            ViewsMode.put(before)
