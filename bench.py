@@ -185,6 +185,11 @@ SERVING_ROWS = int(os.environ.get("BENCH_SERVING_ROWS", 2_000_000))
 SERVING_THREADS = int(os.environ.get("BENCH_SERVING_THREADS", 8))
 SERVING_CONCURRENCY = int(os.environ.get("BENCH_SERVING_CONCURRENCY", 2))
 SERVING_QUERIES = int(os.environ.get("BENCH_SERVING_QUERIES", 48))
+# graftwatch telemetry-overhead budget on admitted p50, percent: 5% is the
+# full-scale acceptance number; reduced-scale smoke runs loosen it (a
+# ~5ms p50 at BENCH_SERVING_ROWS=1.5e5 flakes on scheduler noise alone,
+# same reasoning as BENCH_RECOVERY_OVERHEAD_PCT)
+WATCH_OVERHEAD_PCT = float(os.environ.get("BENCH_WATCH_OVERHEAD_PCT", 5.0))
 
 
 class SectionTimeout(BaseException):
@@ -1651,6 +1656,9 @@ def main() -> None:
             ServingMaxConcurrent,
             ServingQueueDepth,
             ServingTenantWeights,
+            WatchEnabled,
+            WatchIntervalS,
+            WatchPort,
         )
 
         n = SERVING_ROWS
@@ -1679,6 +1687,9 @@ def main() -> None:
             ServingEnabled.get(), ServingMaxConcurrent.get(),
             ServingQueueDepth.get(), ServingTenantWeights.get(),
         )
+        watch_before = (
+            WatchEnabled.get(), WatchPort.get(), WatchIntervalS.get(),
+        )
         ServingEnabled.put(True)
         # per-thread tenants with fat buckets: the binding constraint this
         # section measures is concurrency+queue backpressure, not the
@@ -1694,62 +1705,121 @@ def main() -> None:
             # -- uncontended baseline: one query at a time -- #
             ServingMaxConcurrent.put(max(SERVING_THREADS, 4))
             ServingQueueDepth.put(SERVING_THREADS * 4)
-            uncontended = []
-            for rep in range(max(2 * len(query_shapes), 8)):
-                _name, q = query_shapes[rep % len(query_shapes)]
-                t0 = time.perf_counter()
-                serving.submit(q, tenant="t0", deadline_ms=0)
-                uncontended.append(time.perf_counter() - t0)
+
+            def run_uncontended():
+                walls = []
+                for rep in range(max(2 * len(query_shapes), 8)):
+                    _name, q = query_shapes[rep % len(query_shapes)]
+                    t0 = time.perf_counter()
+                    serving.submit(q, tenant="t0", deadline_ms=0)
+                    walls.append(time.perf_counter() - t0)
+                return walls
+
+            uncontended = run_uncontended()
+
+            # -- telemetry overhead: the SAME serial admitted workload
+            # with the graftwatch sampler live.  Serial on purpose: the
+            # saturation legs admit a different query mix every run
+            # (shed/admit races), so their p50s compare different
+            # workloads — the overhead assertion needs an identical,
+            # deterministic query sequence on both sides. -- #
+            from modin_tpu.observability import watch as graftwatch
+
+            WatchPort.put(-1)  # exporter off: the leg isolates sampler
+            WatchIntervalS.put(0.25)  # cost; an unscraped port measures
+            WatchEnabled.put(True)  # nothing anyway
+            try:
+                uncontended_watch = run_uncontended()
+            finally:
+                WatchEnabled.put(False)
 
             # -- 4x saturation: THREADS submitters vs CONCURRENCY slots -- #
             ServingMaxConcurrent.put(SERVING_CONCURRENCY)
             ServingQueueDepth.put(SERVING_CONCURRENCY)
-            gate0 = serving.serving_snapshot()
-            admitted_walls = []
-            outcomes = {"completed": 0, "shed": 0, "deadline": 0}
-            walls_lock = _threading.Lock()
             per_thread = max(SERVING_QUERIES // SERVING_THREADS, 1)
 
-            def submitter(tid):
-                for k in range(per_thread):
-                    _name, q = query_shapes[(tid + k) % len(query_shapes)]
-                    t0 = time.perf_counter()
-                    try:
-                        serving.submit(q, tenant=f"t{tid}", deadline_ms=0)
-                    except serving.QueryRejected:
-                        with walls_lock:
-                            outcomes["shed"] += 1
-                        continue
-                    except serving.DeadlineExceeded:
-                        with walls_lock:
-                            outcomes["deadline"] += 1
-                        continue
-                    wall = time.perf_counter() - t0
-                    with walls_lock:
-                        outcomes["completed"] += 1
-                        admitted_walls.append(wall)
+            def run_saturation():
+                admitted_walls = []
+                outcomes = {"completed": 0, "shed": 0, "deadline": 0}
+                walls_lock = _threading.Lock()
 
-            threads = [
-                _threading.Thread(target=submitter, args=(tid,), daemon=True)
-                for tid in range(SERVING_THREADS)
-            ]
-            t_run0 = time.perf_counter()
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            run_wall = time.perf_counter() - t_run0
+                def submitter(tid):
+                    for k in range(per_thread):
+                        _name, q = query_shapes[(tid + k) % len(query_shapes)]
+                        t0 = time.perf_counter()
+                        try:
+                            serving.submit(q, tenant=f"t{tid}", deadline_ms=0)
+                        except serving.QueryRejected:
+                            with walls_lock:
+                                outcomes["shed"] += 1
+                            continue
+                        except serving.DeadlineExceeded:
+                            with walls_lock:
+                                outcomes["deadline"] += 1
+                            continue
+                        wall = time.perf_counter() - t0
+                        with walls_lock:
+                            outcomes["completed"] += 1
+                            admitted_walls.append(wall)
+
+                threads = [
+                    _threading.Thread(
+                        target=submitter, args=(tid,), daemon=True
+                    )
+                    for tid in range(SERVING_THREADS)
+                ]
+                t_run0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                return (
+                    admitted_walls,
+                    outcomes,
+                    time.perf_counter() - t_run0,
+                )
+
+            run_saturation()  # discarded warmup: both measured legs (off
+            # and watch_on below) run against the same steady state, so
+            # the overhead delta is telemetry cost, not first-contention
+            # warming landing on whichever leg happens to run first
+            gate0 = serving.serving_snapshot()
+            admitted_walls, outcomes, run_wall = run_saturation()
             gate1 = serving.serving_snapshot()
+
+            # -- watch_on saturation leg: the concurrent workload with
+            # the sampler live — its walls land in the perf history under
+            # the @watch=on scale key (never gated against watch-off) -- #
+            WatchEnabled.put(True)
+            try:
+                watch_walls, watch_outcomes, watch_run_wall = run_saturation()
+                watch_ticks = graftwatch.watch_snapshot()["sampler"]["ticks"]
+            finally:
+                WatchEnabled.put(False)
         finally:
             ServingEnabled.put(before[0])
             ServingMaxConcurrent.put(before[1])
             ServingQueueDepth.put(before[2])
             ServingTenantWeights.put(before[3])
+            # knobs BEFORE the switch: restoring WatchEnabled=True
+            # restarts the service, which reads WatchPort/IntervalS — the
+            # bench's leftover -1/0.25 must not stick to the restart
+            WatchPort.put(watch_before[1])
+            WatchIntervalS.put(watch_before[2])
+            WatchEnabled.put(watch_before[0])
 
         p50 = percentile(admitted_walls, 0.50)
         p99 = percentile(admitted_walls, 0.99)
         un_p50 = percentile(uncontended, 0.50)
         un_p99 = percentile(uncontended, 0.99)
+        watch_p50 = percentile(watch_walls, 0.50)
+        watch_p99 = percentile(watch_walls, 0.99)
+        un_watch_p50 = percentile(uncontended_watch, 0.50)
+        watch_overhead_pct = (
+            round((un_watch_p50 / un_p50 - 1.0) * 100.0, 2)
+            if un_watch_p50 is not None and un_p50 is not None and un_p50 > 0
+            else None
+        )
         degraded = gate1["degraded"] - gate0["degraded"]
         p99_ratio = (
             round(p99 / max(un_p99, 1e-9), 2)
@@ -1782,6 +1852,30 @@ def main() -> None:
                 and outcomes["shed"] > 0
                 and outcomes["completed"] > 0
             ),
+            # graftwatch watch_on leg: the same workloads with the
+            # telemetry sampler live.  The acceptance shape: admitted p50
+            # overhead on the deterministic serial leg under
+            # WATCH_OVERHEAD_PCT (5% at full scale).
+            "watch_overhead_budget_pct": WATCH_OVERHEAD_PCT,
+            "watch_uncontended_p50_s": (
+                round(un_watch_p50, 4) if un_watch_p50 is not None else None
+            ),
+            "watch_completed": watch_outcomes["completed"],
+            "watch_shed": watch_outcomes["shed"],
+            "watch_run_wall_s": round(watch_run_wall, 4),
+            "watch_sampler_ticks": watch_ticks,
+            "watch_admitted_p50_s": (
+                round(watch_p50, 4) if watch_p50 is not None else None
+            ),
+            "watch_admitted_p99_s": (
+                round(watch_p99, 4) if watch_p99 is not None else None
+            ),
+            "watch_overhead_pct": watch_overhead_pct,
+            "watch_overhead_ok": bool(
+                watch_overhead_pct is not None
+                and watch_overhead_pct < WATCH_OVERHEAD_PCT
+                and watch_outcomes["completed"] > 0
+            ),
         }
         # fold the latency numbers into the per-op detail so the
         # perf-history regression gate covers the serving tail like any op
@@ -1791,6 +1885,11 @@ def main() -> None:
             detail["serving_uncontended_p99"] = {
                 "modin_tpu_s": round(un_p99, 4)
             }
+        if watch_p50 is not None:
+            # scale-keyed @watch=on by perf_history.op_scale_key, so the
+            # telemetry-live walls never gate against the watch-off walls
+            detail["serving_watch_p50"] = {"modin_tpu_s": round(watch_p50, 4)}
+            detail["serving_watch_p99"] = {"modin_tpu_s": round(watch_p99, 4)}
         return sections["serving"]
 
     # ---- graftmesh: sharded vs single-shard vs pandas on the mesh ---- #

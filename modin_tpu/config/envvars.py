@@ -1243,6 +1243,83 @@ class TraceDir(EnvironmentVariable, type=ExactStr):
     default = ".modin_tpu/traces"
 
 
+class WatchEnabled(EnvironmentVariable, type=bool):
+    """graftwatch always-on serving telemetry: a background sampler thread
+    folds the meter registry, ledger gauges, gate depth, and compile-ledger
+    deltas into bounded time-series rings every
+    ``MODIN_TPU_WATCH_INTERVAL_S``; a stdlib HTTP exporter serves
+    ``/metrics`` / ``/statusz`` / ``/debug/queries`` on
+    ``MODIN_TPU_WATCH_PORT``; per-tenant SLO burn rates
+    (``MODIN_TPU_WATCH_SLO_MS``) and anomaly tripwires run over the rings
+    (modin_tpu/observability/watch/).
+
+    Off by default: no sampler or exporter thread exists, and the one hot
+    path the service touches (per-query SLO observation at the serving
+    gate) costs one module-attribute check and allocates nothing
+    (``watch_alloc_count()`` asserts it, graftscope-style).
+    """
+
+    varname = "MODIN_TPU_WATCH"
+    default = False
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+
+class WatchIntervalS(EnvironmentVariable, type=float):
+    """Seconds between graftwatch sampler ticks (ring sample spacing).
+    The sampler re-reads this every tick, so a live retune takes effect
+    at the next wakeup."""
+
+    varname = "MODIN_TPU_WATCH_INTERVAL_S"
+    default = 1.0
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Watch interval should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class WatchPort(EnvironmentVariable, type=int):
+    """TCP port the graftwatch HTTP exporter binds on 127.0.0.1 while the
+    service runs (``/metrics``, ``/statusz``, ``/debug/queries``).  0 (the
+    default) binds an OS-assigned ephemeral port — read the live port back
+    with ``modin_tpu.observability.watch.httpd_port()``; -1 disables the
+    exporter entirely (rings/SLO/tripwires still run)."""
+
+    varname = "MODIN_TPU_WATCH_PORT"
+    default = 0
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < -1 or value > 65535:
+            raise ValueError(
+                f"Watch port should be -1 (exporter off), 0 (ephemeral), "
+                f"or a valid TCP port, passed value {value}"
+            )
+        super().put(value)
+
+
+class WatchSloMs(EnvironmentVariable, type=ExactStr):
+    """Per-tenant latency objectives (milliseconds) for graftwatch SLO
+    burn-rate tracking, ``"default=250,alice=50"`` style (same parser
+    shape as ``MODIN_TPU_SERVING_TENANT_WEIGHTS``; a bare number such as
+    ``"250"`` is shorthand for ``default=250``).  The ``default`` entry
+    applies to every tenant without its own; empty (the default) tracks
+    latency observations but computes no burn rates."""
+
+    varname = "MODIN_TPU_WATCH_SLO_MS"
+    default = ""
+
+
 class DocModule(EnvironmentVariable, type=ExactStr):
     """Alternate module to source API docstrings from (reference: envvars.py:1338)."""
 

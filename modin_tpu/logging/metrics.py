@@ -492,6 +492,31 @@ METRICS = (
         "device_failure, and the shed reasons (queue_full / throttled / "
         "unhealthy)",
     ),
+    (
+        "watch.sampler.died",
+        "counter",
+        "graftwatch sampler-thread crashes: the telemetry service degraded "
+        "itself to disabled instead of taking queries down",
+    ),
+    (
+        "watch.trip.*",
+        "counter",
+        "graftwatch anomaly tripwires fired, per rule (latency_shift / "
+        "recompile_storm / spill_thrash / shed_spike / slo_burn)",
+    ),
+    (
+        "watch.evidence",
+        "counter",
+        "graftwatch evidence bundles written to MODIN_TPU_TRACE_DIR after "
+        "a tripwire fired (rate-limited through the flight recorder's "
+        "claim-token window)",
+    ),
+    (
+        "watch.scrape",
+        "counter",
+        "HTTP requests served by the graftwatch live exporter "
+        "(/metrics, /statusz, /debug/queries)",
+    ),
 )
 
 

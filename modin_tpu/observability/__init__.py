@@ -82,6 +82,14 @@ from modin_tpu.observability.costs import (  # noqa: F401
     roofline_fraction,
     substrate_peaks,
 )
+from modin_tpu.observability.watch import (  # noqa: F401
+    WatchService,
+    httpd_port,
+    recent_trips,
+    slo_health,
+    watch_alloc_count,
+    watch_snapshot,
+)
 
 # MODIN_TPU_TRACE=1 at import: the config subscription fired while
 # compile_ledger was still initializing and deferred the listener install —

@@ -41,6 +41,11 @@ COUNTER_TRACKS = (
     # HBM tracks on the same Perfetto timeline
     "engine.cost.padding_waste_bytes",
     "engine.cost.achieved_bw_bytes_s",
+    # graftgate: admission-queue depth and in-flight query count sampled
+    # at each span finish — profile exports show admission pressure over
+    # time next to the spans it delayed
+    "serving.gate.queued",
+    "serving.gate.running",
 )
 
 
@@ -52,8 +57,9 @@ def to_chrome_trace(
     """Render finished spans as a chrome://tracing-loadable trace object.
 
     ``counters`` is an iterable of ``(ts_us, (device_bytes, host_bytes,
-    live_spans, padding_waste_bytes, achieved_bw))`` samples; each becomes
-    one "C" event per :data:`COUNTER_TRACKS` track.
+    live_spans, padding_waste_bytes, achieved_bw, gate_queued,
+    gate_running))`` samples; each becomes one "C" event per
+    :data:`COUNTER_TRACKS` track.
     """
     pid = os.getpid()
     events: List[dict] = []

@@ -101,6 +101,13 @@ class CompileLedger:
             if not compiled:
                 entry["cache_hits"] += 1
 
+    def totals(self) -> tuple:
+        """``(total_compiles, total_compile_s)`` without building the full
+        per-signature snapshot — the graftwatch sampler reads this every
+        tick, so it must stay O(1) under the lock."""
+        with self._lock:
+            return (self.total_compiles, self.total_compile_s)
+
     def snapshot(self) -> dict:
         """Deep copy: {signature: {compiles, compile_s, dispatches,
         cache_hits}} plus process totals."""

@@ -25,6 +25,7 @@ bytes parsed, cache hits, spills).
 
 from __future__ import annotations
 
+import fnmatch
 import json
 import re
 from typing import Any, Dict, List, Optional
@@ -52,13 +53,44 @@ def _fmt(value: Any) -> str:
     return repr(float(value))
 
 
+#: name -> rendered HELP text; bounded because exposition accepts
+#: arbitrary snapshots (the live registry itself is cardinality-capped)
+_HELP_CACHE: Dict[str, str] = {}
+_HELP_CACHE_MAX = 4096
+
+
+def help_text(metric_name: str) -> str:
+    """The ``# HELP`` line body for a metric: the family's description
+    from the ``METRICS`` registry (the 3-tuples already carry one),
+    whitespace-normalized and escaped per the Prometheus text format
+    (``\\`` -> ``\\\\``, newline -> ``\\n``).  Ad-hoc names not matching
+    any registry pattern keep the generic fallback text."""
+    cached = _HELP_CACHE.get(metric_name)
+    if cached is not None:
+        return cached
+    text = f"modin_tpu metric {metric_name}"
+    try:
+        from modin_tpu.logging.metrics import METRICS
+
+        for entry in METRICS:
+            if fnmatch.fnmatchcase(metric_name, entry[0]) and len(entry) > 2:
+                text = " ".join(str(entry[2]).split())
+                break
+    except ImportError:  # teardown: keep the fallback
+        pass
+    text = text.replace("\\", "\\\\").replace("\n", "\\n")
+    if len(_HELP_CACHE) < _HELP_CACHE_MAX:
+        _HELP_CACHE[metric_name] = text
+    return text
+
+
 def to_prometheus(snapshot: dict) -> str:
     """Render a meter snapshot as Prometheus text exposition format."""
     lines: List[str] = []
     for name, series in snapshot.get("series", {}).items():
         kind = series.get("kind", "counter")
         promname = prometheus_name(name)
-        lines.append(f"# HELP {promname} modin_tpu metric {name}")
+        lines.append(f"# HELP {promname} {help_text(name)}")
         if kind == "histogram":
             lines.append(f"# TYPE {promname} histogram")
             for bound, cum_count in series.get("buckets", []):
@@ -94,11 +126,16 @@ def parse_prometheus(text: str) -> Dict[str, dict]:
     out: Dict[str, dict] = {}
     current_type: Dict[str, str] = {}
     last_bucket: Dict[str, float] = {}
+    help_texts: Dict[str, str] = {}
     for raw in text.splitlines():
         line = raw.rstrip()
         if not line:
             continue
         if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or not parts[2]:
+                raise ValueError(f"malformed HELP line: {line!r}")
+            help_texts[parts[2]] = parts[3] if len(parts) > 3 else ""
             continue
         if line.startswith("# TYPE "):
             parts = line.split(" ")
@@ -108,7 +145,11 @@ def parse_prometheus(text: str) -> Dict[str, dict]:
             if kind not in PROMETHEUS_KINDS:
                 raise ValueError(f"unknown TYPE {kind!r} for {name}: {line!r}")
             current_type[name] = kind
-            out[name] = {"type": kind, "samples": {}}
+            out[name] = {
+                "type": kind,
+                "samples": {},
+                "help": help_texts.get(name),
+            }
             continue
         if line.startswith("#"):
             raise ValueError(f"unknown comment directive: {line!r}")

@@ -51,6 +51,33 @@ def flight_snapshot() -> List[object]:
     return list(ring) if ring is not None else []
 
 
+def claim_dump_window() -> Optional[float]:
+    """Claim the shared dump rate-limit window; None when rate-limited.
+
+    One claim token guards EVERY on-disk failure artifact — breaker/terminal
+    flight dumps here and graftwatch tripwire evidence bundles — so one
+    incident produces one artifact set, however many detectors saw it.
+    A successful claim must be followed by either a completed write or
+    :func:`release_dump_claim` (a failed write must not consume the window).
+    """
+    global _last_dump
+    with _dump_lock:
+        now = time.monotonic()
+        if now - _last_dump < MIN_DUMP_INTERVAL_S:
+            return None
+        _last_dump = now
+        return now
+
+
+def release_dump_claim(claimed: float) -> None:
+    """Release OUR claim after a failed write (see the failure path in
+    :func:`dump_flight_record` for why only the matching claim resets)."""
+    global _last_dump
+    with _dump_lock:
+        if _last_dump == claimed:
+            _last_dump = _NEVER_DUMPED
+
+
 def reset_for_tests() -> None:
     """Clear the ring, counter samples, and the rate limiter (test isolation)."""
     global _last_dump
@@ -70,18 +97,15 @@ def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
     or the write failed.  Never raises: the caller is the failure path
     itself and must stay failure-free.
     """
-    global _last_dump
     if not _spans.TRACE_ON:
         return None
     ring = _spans._RING
     if not ring:
         return None
+    claimed = claim_dump_window()  # concurrent callers back off
+    if claimed is None:
+        return None
     with _dump_lock:
-        now = time.monotonic()
-        if now - _last_dump < MIN_DUMP_INTERVAL_S:
-            return None
-        _last_dump = now  # claim the window (concurrent callers back off)
-        claimed = now  # our claim token: see the failed-write reset below
         snapshot = list(ring)
         counters = list(_spans._COUNTERS or ())
     try:
@@ -126,7 +150,5 @@ def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
         # writing its dump right now — unconditionally zeroing the limiter
         # here would re-open the window behind its back and let a third
         # caller double-dump the same incident.
-        with _dump_lock:
-            if _last_dump == claimed:
-                _last_dump = _NEVER_DUMPED
+        release_dump_claim(claimed)
         return None

@@ -92,7 +92,36 @@ _qs_tls = threading.local()  # .stack: active QueryStats; .dispatches: count
 _scope_lock = threading.Lock()
 _active_scopes = 0
 
+#: every currently-open QueryStats scope, process-wide (insertion order =
+#: open order).  Maintained under _scope_lock by query_stats enter/exit;
+#: graftwatch's /debug/queries endpoint renders this live.
+_live_scopes: Dict[int, "QueryStats"] = {}
+
 _env_enabled = False
+
+#: long-lived registry consumers (graftwatch): registry aggregation is
+#: active while ANY consumer holds an acquire, independent of the
+#: MODIN_TPU_METERS knob — the watch sampler/exporter need the series to
+#: exist without asking the operator to flip a second switch
+_registry_consumers = 0
+
+
+def acquire_registry() -> None:
+    """Activate registry aggregation on behalf of a long-lived consumer.
+
+    Balanced by :func:`release_registry`; callers (the graftwatch
+    service) must hold at most one acquire per logical consumer."""
+    global _registry_consumers
+    with _scope_lock:
+        _registry_consumers += 1
+        _refresh_enabled()
+
+
+def release_registry() -> None:
+    global _registry_consumers
+    with _scope_lock:
+        _registry_consumers = max(_registry_consumers - 1, 0)
+        _refresh_enabled()
 
 
 def meter_alloc_count() -> int:
@@ -360,8 +389,8 @@ def reset() -> None:
 def _refresh_enabled() -> None:
     """Recompute the fast-path flags and (un)install the emit hook."""
     global ACCOUNTING_ON, METERS_ON
-    METERS_ON = _env_enabled
-    on = _env_enabled or _active_scopes > 0
+    METERS_ON = _env_enabled or _registry_consumers > 0
+    on = METERS_ON or _active_scopes > 0
     ACCOUNTING_ON = on
     metrics = sys.modules.get("modin_tpu.logging.metrics")
     if metrics is None and on:
@@ -387,7 +416,8 @@ def _on_meters_param(param: Any) -> None:
 
 
 def meters_enabled() -> bool:
-    """Is registry aggregation active right now (the config switch)?"""
+    """Is registry aggregation active right now (the config switch, or a
+    long-lived consumer such as the graftwatch service)?"""
     return METERS_ON
 
 
@@ -638,6 +668,12 @@ class QueryStats:
         if resident > self.hbm_high_water:
             self.hbm_high_water = resident
 
+    def elapsed_s(self) -> float:
+        """Wall seconds so far (final wall once the scope has closed)."""
+        if self._closed:
+            return self.wall_s
+        return time.perf_counter() - self._t0
+
     # -- export ---------------------------------------------------------- #
 
     def as_dict(self) -> dict:
@@ -737,6 +773,18 @@ class QueryStats:
         )
 
 
+def live_scopes() -> List["QueryStats"]:
+    """Every QueryStats scope currently open on ANY thread (open order).
+
+    The returned scopes are live objects owned by their opening threads —
+    read them via :meth:`QueryStats.as_dict` (slot reads are atomic
+    enough for telemetry); graftwatch's ``/debug/queries`` endpoint is
+    the consumer.
+    """
+    with _scope_lock:
+        return list(_live_scopes.values())
+
+
 def snapshot_scopes() -> Optional[List["QueryStats"]]:
     """Copy of this thread's open QueryStats stack (outermost first), or None.
 
@@ -789,6 +837,7 @@ def query_stats(label: str = "query") -> Iterator[QueryStats]:
             qs.signature = sig
     with _scope_lock:
         _active_scopes += 1
+        _live_scopes[id(qs)] = qs
         _refresh_enabled()
     stack = getattr(_qs_tls, "stack", None)
     if stack is None:
@@ -807,6 +856,7 @@ def query_stats(label: str = "query") -> Iterator[QueryStats]:
             pass
         with _scope_lock:
             _active_scopes -= 1
+            _live_scopes.pop(id(qs), None)
             _refresh_enabled()
 
 

@@ -408,7 +408,10 @@ def _deliver(sp: Span) -> None:
         counters.append(
             (
                 sp.start_us + sp.dur_us,
-                _ledger_bytes() + (_live_spans,) + _cost_samples(),
+                _ledger_bytes()
+                + (_live_spans,)
+                + _cost_samples()
+                + _gate_samples(),
             )
         )
     if _collectors:
@@ -443,13 +446,26 @@ def _cost_samples() -> tuple:
         return (0, 0)
 
 
+def _gate_samples() -> tuple:
+    """(admission-queue depth, in-flight queries) from graftgate — 0s
+    until serving.gate is imported (same no-import rule as
+    :func:`_ledger_bytes`), read lock-free by design."""
+    gate_mod = sys.modules.get("modin_tpu.serving.gate")
+    if gate_mod is None:
+        return (0, 0)
+    try:
+        return gate_mod.counter_sample()
+    except Exception:
+        return (0, 0)
+
+
 def counter_samples(
     start_us: Optional[float] = None, end_us: Optional[float] = None
 ) -> List[tuple]:
     """Counter samples ``(ts_us, (device_bytes, host_bytes, live_spans,
-    padding_waste_bytes, achieved_bw))`` currently in the ring, optionally
-    clipped to a time window (a profile exports only the samples its own
-    spans cover)."""
+    padding_waste_bytes, achieved_bw, gate_queued, gate_running))``
+    currently in the ring, optionally clipped to a time window (a profile
+    exports only the samples its own spans cover)."""
     counters = _COUNTERS
     if counters is None:
         return []

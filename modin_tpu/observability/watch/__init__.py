@@ -1,0 +1,395 @@
+"""graftwatch — always-on serving telemetry for an operable graftgate.
+
+Every observability surface before this one (graftscope traces,
+graftmeter snapshots / EXPLAIN ANALYZE, graftcost rooflines) is
+pull-on-demand from inside the process: an operator cannot watch p99
+drift, spill thrash, or a recompile storm *while* the gate is shedding,
+and the flight recorder only dumps after a breaker already opened.
+graftwatch is the background service that closes that gap — four legs:
+
+1. **time-series rings** (watch/timeseries.py): a sampler thread folds
+   the meter registry, device/host ledger gauges, admission-gate depth,
+   and compile-ledger totals into bounded rings every
+   ``MODIN_TPU_WATCH_INTERVAL_S``, making "p99 over the last 60s" and
+   "spill bytes/s" answerable questions;
+2. **live exporter** (watch/httpd.py): ``/metrics`` (Prometheus text),
+   ``/statusz`` (human one-pager), ``/debug/queries`` (live query
+   scopes) on ``MODIN_TPU_WATCH_PORT``;
+3. **per-tenant SLO burn rates** (watch/slo.py): objectives from
+   ``MODIN_TPU_WATCH_SLO_MS``, fed per query by the serving gate,
+   multi-window fast/slow burn surfaced to graftgate as an ADVISORY
+   health signal next to the breakers;
+4. **anomaly tripwires** (watch/tripwires.py): declarative rules over
+   the rings that emit ``watch.trip.<rule>`` and auto-capture a
+   rate-limited evidence bundle to ``MODIN_TPU_TRACE_DIR``.
+
+Zero-overhead-when-off (the default, ``MODIN_TPU_WATCH=0``): no sampler
+or exporter thread exists, the serving gate's per-query hook costs one
+module-attribute check of :data:`WATCH_ON`, and nothing is allocated —
+:func:`watch_alloc_count` asserts it exactly the way
+``spans.span_alloc_count()`` asserts the tracing contract.  A sampler
+crash emits ``watch.sampler.died`` and degrades the service to disabled
+instead of taking queries down.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from modin_tpu.observability.watch.timeseries import (  # noqa: F401
+    Ring,
+    RingStore,
+    Sampler,
+    alloc_count as _ts_alloc_count,
+)
+
+#: Module-level fast path, graftscope-style: the ONE attribute hot-path
+#: hooks (the serving gate's per-query SLO observation) check before
+#: doing anything else.  True only while the service is running.
+WATCH_ON: bool = False
+
+_state_lock = threading.RLock()
+_service: Optional["WatchService"] = None
+_env_enabled = False
+
+
+def watch_alloc_count() -> int:
+    """graftwatch objects ever constructed (rings, trackers, tripwires,
+    samplers) — the zero-overhead-when-off assertion counter."""
+    return _ts_alloc_count()
+
+
+class WatchService:
+    """The running telemetry service: rings + sampler + SLO + tripwires +
+    exporter, one instance while ``MODIN_TPU_WATCH=1``."""
+
+    def __init__(self) -> None:
+        from modin_tpu.observability.watch.slo import SloTracker
+        from modin_tpu.observability.watch.tripwires import TripwireEngine
+
+        self.rings = RingStore()
+        self.slo = SloTracker()
+        self.tripwires = TripwireEngine(self)
+        self.sampler = Sampler(
+            self.rings,
+            on_tick=self.tripwires.on_tick,
+            on_died=self._on_sampler_died,
+        )
+        from modin_tpu.observability.watch.httpd import Exporter
+
+        self.exporter = Exporter(self)
+        self.started_monotonic: Optional[float] = None
+        self.started_wall: Optional[float] = None
+        self._registry_hold = False  # one acquire_registry per service run
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def start(self) -> None:
+        """Start sampler + exporter (idempotent)."""
+        if self.started_monotonic is None:
+            self.started_monotonic = time.monotonic()
+            self.started_wall = time.time()
+        if not self._registry_hold:
+            # watch standalone must actually see series: hold registry
+            # aggregation for the service's lifetime, independent of the
+            # MODIN_TPU_METERS knob (the rings, /metrics, and every
+            # registry-fed tripwire are dead without it)
+            from modin_tpu.observability import meters as _meters
+
+            _meters.acquire_registry()
+            self._registry_hold = True
+        self.sampler.start()
+        from modin_tpu.config import WatchPort
+
+        port = int(WatchPort.get())
+        if port >= 0:
+            self.exporter.start(port)
+
+    def _release_registry(self) -> None:
+        if self._registry_hold:
+            self._registry_hold = False
+            from modin_tpu.observability import meters as _meters
+
+            _meters.release_registry()
+
+    def stop(self) -> None:
+        """Stop sampler + exporter (idempotent; state stays inspectable)."""
+        self.sampler.stop()
+        self.exporter.stop()
+        self._release_registry()
+
+    def _on_sampler_died(self, _err: BaseException) -> None:
+        """The sampler loop crashed: degrade to disabled — flip the fast
+        path off and stop the exporter, but never join the dying thread
+        (it is the caller)."""
+        global WATCH_ON
+        with _state_lock:
+            if self.sampler._thread is not threading.current_thread():
+                # stale crash: a stop()/restart raced this callback (the
+                # _run-side guard passed before the swap) — the current
+                # state belongs to the new run, leave it alone
+                return
+            WATCH_ON = False
+            self.exporter.stop()
+            self._release_registry()
+
+    # -- statusz --------------------------------------------------------- #
+
+    def statusz_text(self) -> str:
+        """The human-readable one-pager.  Every section is exception-
+        isolated: a broken seam renders as an error line, never a 500."""
+        lines: List[str] = ["graftwatch /statusz", ""]
+
+        def section(title: str, render) -> None:
+            lines.append(f"== {title} ==")
+            try:
+                render()
+            except Exception as err:
+                lines.append(f"  <unavailable: {type(err).__name__}: {err}>")
+            lines.append("")
+
+        def _service_section() -> None:
+            uptime = (
+                time.monotonic() - self.started_monotonic
+                if self.started_monotonic is not None
+                else 0.0
+            )
+            sampler = self.sampler
+            age = (
+                time.monotonic() - sampler.last_tick_t
+                if sampler.last_tick_t is not None
+                else None
+            )
+            lines.append(f"  pid: {os.getpid()}  uptime: {uptime:.1f}s")
+            age_txt = f"{age:.1f}" if age is not None else "?"
+            lines.append(
+                f"  sampler: ticks={sampler.ticks} last_tick_age_s={age_txt}"
+            )
+            if sampler.died:
+                lines.append(f"  sampler DIED: {sampler.error}")
+            lines.append(
+                f"  rings: {len(self.rings)} series "
+                f"(dropped={self.rings.dropped_series})"
+            )
+            port = self.exporter.port
+            lines.append(f"  exporter: 127.0.0.1:{port}")
+
+        def _substrate_section() -> None:
+            import sys as _sys
+
+            mesh = _sys.modules.get("modin_tpu.parallel.mesh")
+            shape = (
+                mesh.mesh_shape_key() if mesh is not None else "uninitialized"
+            )
+            lines.append(f"  mesh shape: {shape}")
+            from modin_tpu.observability import spans as _spans
+
+            device_bytes, host_bytes = _spans._ledger_bytes()
+            lines.append(
+                f"  ledger: device_resident={device_bytes}B "
+                f"host_cache={host_bytes}B"
+            )
+
+        def _rates_section() -> None:
+            window = 60.0
+
+            def fmt(value: Optional[float], unit: str) -> str:
+                return f"{value:.3g}{unit}" if value is not None else "?"
+
+            lines.append(
+                f"  (trailing {window:g}s)  "
+                f"dispatch/s: {fmt(self.rings.rate('engine.dispatch', window), '')}  "
+                f"spill B/s: {fmt(self.rings.rate('memory.device.spill_bytes', window), '')}  "
+                f"compiles: {fmt(self.rings.delta('compile.total', window), '')}"
+            )
+            p50 = self.rings.quantile("serving.query_wall_s", 0.50, window)
+            p99 = self.rings.quantile("serving.query_wall_s", 0.99, window)
+            lines.append(
+                "  query wall: "
+                f"p50={fmt(p50 * 1e3 if p50 is not None else None, 'ms')} "
+                f"p99={fmt(p99 * 1e3 if p99 is not None else None, 'ms')}"
+            )
+
+        def _gate_section() -> None:
+            import sys as _sys
+
+            gate_mod = _sys.modules.get("modin_tpu.serving.gate")
+            if gate_mod is None:
+                lines.append("  serving not active in this process")
+                return
+            snap = gate_mod.gate.snapshot()
+            lines.append(
+                f"  running={snap['running']}/{snap['max_concurrent']} "
+                f"queued={snap['queued']}/{snap['queue_depth']} "
+                f"admitted={snap['admitted']} shed={snap['shed']} "
+                f"degraded={snap['degraded']}"
+            )
+
+        def _tenants_section() -> None:
+            import sys as _sys
+
+            tenants_mod = _sys.modules.get("modin_tpu.serving.tenants")
+            tenant_rows = (
+                tenants_mod.registry.snapshot() if tenants_mod else {}
+            )
+            health = self.slo.health()
+            stats = self.slo.latency_stats()
+            names = sorted(set(tenant_rows) | set(health) | set(stats))
+            if not names:
+                lines.append("  no tenants observed")
+                return
+            lines.append(
+                "  tenant | in_flight | admitted | shed | breaker | "
+                "p50/p99 (60s) | slo fast/slow burn"
+            )
+            for name in names:
+                row = tenant_rows.get(name, {})
+                st = stats.get(name, {})
+                verdict = health.get(name)
+                latency = (
+                    f"{st.get('p50_ms', '?')}/{st.get('p99_ms', '?')}ms"
+                    if st
+                    else "?"
+                )
+                slo_txt = "-"
+                if verdict is not None:
+                    slo_txt = (
+                        f"{verdict['fast_burn']}/{verdict['slow_burn']}"
+                        + (" BREACHING" if verdict["breaching"] else "")
+                    )
+                lines.append(
+                    f"  {name} | {row.get('in_flight', 0)} | "
+                    f"{row.get('admitted', 0)} | {row.get('shed', 0)} | "
+                    f"{row.get('breaker', '?')} | {latency} | {slo_txt}"
+                )
+
+        def _trips_section() -> None:
+            recent = self.tripwires.snapshot()
+            if not recent:
+                lines.append("  none")
+                return
+            for trip in recent[-10:]:
+                lines.append(
+                    f"  [{trip['at_unix_s']}] {trip['rule']}: "
+                    f"{trip['detail']}  evidence={trip['evidence']}"
+                )
+
+        section("service", _service_section)
+        section("substrate", _substrate_section)
+        section("windowed rates", _rates_section)
+        section("admission gate", _gate_section)
+        section("tenants", _tenants_section)
+        section("recent tripwires", _trips_section)
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------- #
+# module API (the names the rest of the system calls)
+# ---------------------------------------------------------------------- #
+
+
+def get_service() -> Optional[WatchService]:
+    """The service instance (present once watch was ever enabled this
+    process; its threads only run while :data:`WATCH_ON`)."""
+    return _service
+
+
+def observe_query(
+    tenant: str, wall_s: float, failure_kind: Optional[str] = None
+) -> None:
+    """One finished serving query: feed the tenant's SLO observations.
+
+    The serving gate checks :data:`WATCH_ON` before calling (the
+    zero-overhead contract); this re-check only guards the teardown race.
+    ``failure_kind`` rides for future rules; deadline aborts count as
+    latency observations too — a query the deadline killed is exactly the
+    latency signal the SLO exists to catch.
+    """
+    service = _service
+    if service is None or not WATCH_ON:
+        return
+    try:
+        service.slo.observe(tenant, wall_s)
+    except Exception:
+        pass
+
+
+def slo_health() -> Dict[str, dict]:
+    """Per-tenant burn verdicts ({} while off/untracked) — the advisory
+    signal graftgate surfaces next to its breakers."""
+    service = _service
+    if service is None:
+        return {}
+    try:
+        return service.slo.health()
+    except Exception:
+        return {}
+
+
+def httpd_port() -> Optional[int]:
+    """The exporter's live TCP port, or None while it is not serving."""
+    service = _service
+    return service.exporter.port if service is not None else None
+
+
+def recent_trips() -> List[dict]:
+    service = _service
+    return service.tripwires.snapshot() if service is not None else []
+
+
+def watch_snapshot() -> Dict[str, Any]:
+    """Service state for tests / dashboards."""
+    service = _service
+    if service is None:
+        return {"enabled": WATCH_ON, "service": None}
+    return {
+        "enabled": WATCH_ON,
+        "sampler": {
+            "alive": service.sampler.is_alive(),
+            "ticks": service.sampler.ticks,
+            "died": service.sampler.died,
+            "error": service.sampler.error,
+        },
+        "exporter_port": service.exporter.port,
+        "ring_series": len(service.rings),
+        "recent_trips": service.tripwires.snapshot(),
+        "slo": slo_health(),
+    }
+
+
+# ---------------------------------------------------------------------- #
+# config wiring
+# ---------------------------------------------------------------------- #
+
+
+def _start_locked() -> None:
+    global _service, WATCH_ON
+    if _service is None:
+        _service = WatchService()
+    _service.start()
+    WATCH_ON = True
+
+
+def _stop_locked() -> None:
+    global WATCH_ON
+    WATCH_ON = False
+    if _service is not None:
+        _service.stop()
+
+
+def _on_watch_param(param: Any) -> None:
+    global _env_enabled
+    with _state_lock:
+        _env_enabled = bool(param.get())
+        if _env_enabled:
+            _start_locked()
+        else:
+            _stop_locked()
+
+
+from modin_tpu.config import WatchEnabled as _WatchEnabled  # noqa: E402
+
+_WatchEnabled.subscribe(_on_watch_param)

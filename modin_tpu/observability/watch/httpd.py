@@ -1,0 +1,178 @@
+"""graftwatch live exporter: a stdlib HTTP thread over the telemetry state.
+
+One ``http.server.ThreadingHTTPServer`` bound to ``127.0.0.1`` on
+``MODIN_TPU_WATCH_PORT`` (0 = OS-assigned ephemeral; the live port reads
+back via ``watch.httpd_port()``), serving:
+
+- ``GET /metrics`` — the meter registry as Prometheus text exposition
+  (``observability/exposition.py``; the same text the smoke gates
+  validate with ``parse_prometheus``), scrapeable by a real collector;
+- ``GET /statusz`` — a human-readable one-page status: uptime, sampler
+  health, mesh shape, ledger residency, admission-gate pressure,
+  windowed rates/quantiles off the rings, per-tenant table with SLO
+  burn rates, recent tripwires;
+- ``GET /debug/queries`` — the live ``query_stats()`` scopes process-wide
+  (graftmeter's open-scope registry) as JSON, wall-so-far included;
+- ``GET /`` — a plain-text index of the above.
+
+Every request emits one ``watch.scrape``.  Handlers never raise into the
+socket loop and never write to stderr (``log_message`` is silenced); an
+endpoint whose renderer fails returns 500 with the error name rather
+than killing the exporter thread.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_INDEX = (
+    "graftwatch live exporter\n"
+    "  /metrics        Prometheus text exposition of the meter registry\n"
+    "  /statusz        human-readable service status\n"
+    "  /debug/queries  live query_stats scopes (JSON)\n"
+)
+
+
+class _WatchHandler(BaseHTTPRequestHandler):
+    server_version = "modin-tpu-graftwatch"
+
+    def log_message(self, fmt: str, *args) -> None:  # noqa: D102
+        pass  # telemetry must never spam the host application's stderr
+
+    def _respond(
+        self, status: int, content_type: str, body: str
+    ) -> None:
+        payload = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        try:
+            from modin_tpu.logging.metrics import emit_metric
+
+            emit_metric("watch.scrape", 1)
+        except Exception:
+            pass
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                from modin_tpu.observability import exposition, meters
+
+                self._respond(
+                    200,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    exposition.to_prometheus(meters.snapshot()),
+                )
+            elif path == "/statusz":
+                service = self.server.watch_service  # type: ignore[attr-defined]
+                self._respond(
+                    200, "text/plain; charset=utf-8", service.statusz_text()
+                )
+            elif path == "/debug/queries":
+                self._respond(
+                    200,
+                    "application/json; charset=utf-8",
+                    json.dumps(_debug_queries(), sort_keys=True),
+                )
+            elif path == "/":
+                self._respond(200, "text/plain; charset=utf-8", _INDEX)
+            else:
+                self._respond(
+                    404, "text/plain; charset=utf-8", f"unknown path {path}\n"
+                )
+        except BrokenPipeError:
+            pass  # the scraper hung up; nothing to salvage
+        except Exception as err:
+            try:
+                self._respond(
+                    500,
+                    "text/plain; charset=utf-8",
+                    f"renderer failed: {type(err).__name__}: {err}\n",
+                )
+            except Exception:
+                pass
+
+
+def _debug_queries() -> dict:
+    from modin_tpu.observability import meters
+
+    queries = []
+    for qs in meters.live_scopes():
+        entry = qs.as_dict()
+        entry["wall_so_far_s"] = round(qs.elapsed_s(), 6)
+        entry["open"] = not qs._closed
+        queries.append(entry)
+    return {"open_scopes": len(queries), "queries": queries}
+
+
+class Exporter:
+    """Lifecycle wrapper around the exporter server + its serve thread."""
+
+    THREAD_NAME = "modin-tpu-watch-httpd"
+
+    def __init__(self, service) -> None:
+        self._service = service
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        server = self._server
+        return server.server_address[1] if server is not None else None
+
+    def start(self, port: int) -> bool:
+        """Bind 127.0.0.1:port (0 = ephemeral) and serve on a daemon
+        thread.  Returns False (service keeps running exporter-less) when
+        the bind fails — a taken port must not take queries down."""
+        if self._server is not None:
+            return True
+        try:
+            server = ThreadingHTTPServer(
+                ("127.0.0.1", max(port, 0)), _WatchHandler
+            )
+        except Exception as err:
+            # not just OSError: an env-sourced out-of-range port (which
+            # bypasses WatchPort.put validation) raises OverflowError
+            # from bind() — any bind failure degrades exporter-less
+            print(
+                f"graftwatch: exporter bind failed on port {port}: {err}; "
+                "rings/SLO/tripwires keep running without HTTP",
+                file=sys.stderr,
+            )
+            return False
+        server.daemon_threads = True
+        server.watch_service = self._service  # type: ignore[attr-defined]
+        self._server = server
+        self._thread = threading.Thread(
+            target=server.serve_forever,
+            name=self.THREAD_NAME,
+            daemon=True,
+            kwargs={"poll_interval": 0.1},
+        )
+        self._thread.start()
+        return True
+
+    def stop(self, timeout: float = 5.0) -> None:
+        server = self._server
+        if server is None:
+            return
+        self._server = None
+        try:
+            server.shutdown()
+            server.server_close()
+        except Exception:
+            pass
+        thread = self._thread
+        self._thread = None
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()

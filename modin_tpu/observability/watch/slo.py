@@ -1,0 +1,218 @@
+"""graftwatch per-tenant SLO tracking: latency objectives and burn rates.
+
+An SLO here is "at least :data:`TARGET_FRACTION` of a tenant's admitted
+queries finish under their objective latency" — objectives come from
+``MODIN_TPU_WATCH_SLO_MS`` (``"default=250,alice=50"``; a bare number is
+shorthand for ``default=``).  The serving gate feeds every finished
+query's ``(tenant, wall_s)`` through ``watch.observe_query`` (one
+module-attribute check when watch is off), and this tracker answers the
+operator question the raw histogram cannot: *how fast is each tenant
+burning its error budget right now?*
+
+Burn rate is the standard SRE multi-window form: over a window,
+``burn = bad_fraction / (1 - TARGET_FRACTION)`` — 1.0 means the tenant is
+spending budget exactly as fast as the SLO allows, >1 means faster.  Two
+windows are computed (:data:`FAST_WINDOW_S` / :data:`SLOW_WINDOW_S`);
+"breaching" requires BOTH above 1.0 with at least :data:`MIN_SAMPLES`
+fast-window observations, so one unlucky query never pages and a
+recovered incident stops paging as soon as the fast window clears.  The
+verdict is *advisory*: graftgate surfaces it in ``serving_snapshot()``
+next to the breaker states, and the ``slo_burn`` tripwire captures
+evidence — nothing is shed because of it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, Optional
+
+from modin_tpu.observability.watch.timeseries import note_alloc
+
+#: fraction of queries that must meet the objective (the error budget is
+#: ``1 - TARGET_FRACTION``); module-level so tests can tighten it
+TARGET_FRACTION = 0.99
+
+#: the two burn windows (seconds); module-level so tests and the smoke
+#: gate can shrink them instead of sleeping real minutes
+FAST_WINDOW_S = 60.0
+SLOW_WINDOW_S = 300.0
+
+#: minimum fast-window observations before a breach verdict is possible
+MIN_SAMPLES = 4
+
+#: per-tenant observation ring capacity and the tenant cardinality cap
+#: (mirrors serving/tenants.py: per-user tenant ids must not grow memory;
+#: like there, the cap LRU-EVICTS the least-recently-observed tenant —
+#: permanently ignoring every tenant created after the first 1024 would
+#: blind SLO tracking to exactly the churn the cap exists to survive)
+_MAX_OBSERVATIONS = 4096
+_MAX_TENANTS = 1024
+
+
+def parse_slo_ms(spec: str) -> Dict[str, float]:
+    """``"default=250,alice=50"`` -> {"default": 0.25, "alice": 0.05}
+    (values in SECONDS).  A bare number is ``default=``; malformed or
+    non-positive entries are skipped — config must never crash telemetry.
+    """
+    objectives: Dict[str, float] = {}
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, _, value = part.partition("=")
+            name = name.strip()
+        else:
+            name, value = "default", part
+        try:
+            ms = float(value)
+        except ValueError:
+            continue
+        if ms > 0 and name:
+            objectives[name] = ms / 1e3
+    return objectives
+
+
+class SloTracker:
+    """Thread-safe per-tenant latency observations + burn-rate math."""
+
+    def __init__(self) -> None:
+        note_alloc()
+        self._lock = threading.Lock()
+        self._observations: "OrderedDict[str, deque]" = OrderedDict()
+        self.evicted_tenants = 0
+
+    def _objectives(self) -> Dict[str, float]:
+        from modin_tpu.config import WatchSloMs
+
+        return parse_slo_ms(WatchSloMs.get())
+
+    def observe(
+        self, tenant: str, wall_s: float, now: Optional[float] = None
+    ) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            ring = self._observations.get(tenant)
+            if ring is None:
+                while len(self._observations) >= _MAX_TENANTS:
+                    self._observations.popitem(last=False)  # LRU tenant
+                    self.evicted_tenants += 1
+                ring = self._observations[tenant] = deque(
+                    maxlen=_MAX_OBSERVATIONS
+                )
+            else:
+                self._observations.move_to_end(tenant)
+            # age-prune on the write path: nothing reads past the slow
+            # window, and health() copies each ring under this same lock
+            # every sampler tick — retaining up to 4096 stale samples per
+            # tenant would make the serving hot path (observe blocks on
+            # the lock) pay for history no verdict can use
+            horizon = now - SLOW_WINDOW_S
+            while ring and ring[0][0] < horizon:
+                ring.popleft()
+            ring.append((now, float(wall_s)))
+
+    def objective_s(self, tenant: str) -> Optional[float]:
+        """The tenant's objective in seconds (its own entry, else the
+        ``default`` entry), or None when untracked."""
+        objectives = self._objectives()
+        return objectives.get(tenant, objectives.get("default"))
+
+    @staticmethod
+    def _burn(
+        window: list, objective_s: float
+    ) -> Optional[float]:
+        if not window:
+            return None
+        bad = sum(1 for _t, wall in window if wall > objective_s)
+        budget = max(1.0 - TARGET_FRACTION, 1e-9)
+        return (bad / len(window)) / budget
+
+    def health(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Per-tenant burn verdicts for every OBSERVED tenant that has an
+        objective.  ``breaching`` is the advisory multi-window verdict."""
+        now = time.monotonic() if now is None else now
+        objectives = self._objectives()
+        if not objectives:
+            return {}
+        with self._lock:
+            observed = {
+                tenant: list(ring)
+                for tenant, ring in self._observations.items()
+            }
+        out: Dict[str, dict] = {}
+        for tenant, obs in sorted(observed.items()):
+            objective = objectives.get(tenant, objectives.get("default"))
+            if objective is None:
+                continue
+            fast = [s for s in obs if s[0] >= now - FAST_WINDOW_S]
+            slow = [s for s in obs if s[0] >= now - SLOW_WINDOW_S]
+            fast_burn = self._burn(fast, objective)
+            slow_burn = self._burn(slow, objective)
+            breaching = bool(
+                fast_burn is not None
+                and slow_burn is not None
+                and len(fast) >= MIN_SAMPLES
+                and fast_burn > 1.0
+                and slow_burn > 1.0
+            )
+            out[tenant] = {
+                "objective_ms": round(objective * 1e3, 3),
+                "target": TARGET_FRACTION,
+                "fast_window_s": FAST_WINDOW_S,
+                "slow_window_s": SLOW_WINDOW_S,
+                "fast_burn": (
+                    round(fast_burn, 3) if fast_burn is not None else None
+                ),
+                "slow_burn": (
+                    round(slow_burn, 3) if slow_burn is not None else None
+                ),
+                "fast_samples": len(fast),
+                "breaching": breaching,
+            }
+        return out
+
+    def breaching(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Just the tenants currently breaching (the slo_burn tripwire)."""
+        return {
+            tenant: verdict
+            for tenant, verdict in self.health(now).items()
+            if verdict["breaching"]
+        }
+
+    def latency_stats(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Per-tenant fast-window p50/p99/count for ``/statusz`` — every
+        observed tenant, objective or not."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            observed = {
+                tenant: [
+                    wall
+                    for t, wall in ring
+                    if t >= now - FAST_WINDOW_S
+                ]
+                for tenant, ring in self._observations.items()
+            }
+        out: Dict[str, dict] = {}
+        for tenant, walls in sorted(observed.items()):
+            if not walls:
+                continue
+            ordered = sorted(walls)
+
+            def pick(q: float) -> float:
+                idx = min(int(q * (len(ordered) - 1) + 0.5), len(ordered) - 1)
+                return ordered[idx]
+
+            out[tenant] = {
+                "count": len(ordered),
+                "p50_ms": round(pick(0.50) * 1e3, 3),
+                "p99_ms": round(pick(0.99) * 1e3, 3),
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._observations.clear()
+            self.evicted_tenants = 0

@@ -1,0 +1,510 @@
+"""graftwatch time-series rings: bounded history over the telemetry seams.
+
+Everything graftmeter/graftscope expose is *instantaneous* — a counter
+total, a gauge value, a cumulative histogram.  Operability questions are
+about *time*: "what is p99 over the last 60 seconds", "how many spill
+bytes per second right now", "did the storm count grow this minute".
+This module holds the answer machinery:
+
+- :class:`Ring` — one bounded deque of ``(t_monotonic, value)`` samples
+  for one series, typed like the meter kinds (counter / gauge /
+  histogram) with the derived reads each kind supports: counters get
+  windowed ``delta``/``rate`` (cumulative-total subtraction, clamped at
+  zero so a registry ``reset()`` reads as a restart, not a negative
+  rate), histograms get windowed ``quantile`` (cumulative-bucket
+  subtraction between the window's edges, interpolated inside the
+  bucket), gauges get ``latest``/window min/max.
+
+- :class:`RingStore` — name -> Ring, cardinality-capped by the same
+  ``MODIN_TPU_METERS_MAX_SERIES`` guard the meter registry uses, with a
+  JSON-safe ``excerpt()`` for evidence bundles and ``/statusz``.
+
+- :class:`Sampler` — the one background thread (daemon, named
+  ``modin-tpu-watch-sampler``): every ``MODIN_TPU_WATCH_INTERVAL_S`` it
+  folds the meter registry snapshot, the device/host ledger gauges, the
+  admission gate's queue depth / in-flight counts, and the
+  compile-ledger totals into the store, then hands the tick to the
+  tripwire engine.  A sampler crash emits ``watch.sampler.died`` and
+  degrades the whole service to disabled — telemetry must never take a
+  query down.
+
+Allocation accounting: every Ring / Tripwire / tracker construction calls
+:func:`note_alloc`; ``watch.watch_alloc_count()`` exposes the counter so
+tests can assert the zero-overhead-when-off contract the graftscope way.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: ring capacity in samples (per series).  At the default 1s interval this
+#: is ~8.5 minutes of history — enough for the slow SLO window with slack.
+#: Module-level so tests can shrink it; read at Ring construction.
+RING_SAMPLES = 512
+
+_alloc_count = 0
+
+
+def note_alloc() -> None:
+    """Count one graftwatch object construction (the zero-alloc assertion
+    counter shared by rings, trackers, and tripwires)."""
+    global _alloc_count
+    _alloc_count += 1
+
+
+def alloc_count() -> int:
+    return _alloc_count
+
+
+#: histogram ring sample payload: (bucket upper bounds, cumulative counts
+#: per bound, overall count, overall sum) — the meter snapshot's shape,
+#: flattened to tuples so samples are immutable
+HistSample = Tuple[Tuple[float, ...], Tuple[int, ...], int, float]
+
+
+class Ring:
+    """Bounded time-series of one metric family.
+
+    Writes come from the sampler thread, reads from HTTP handler threads
+    and the tripwire engine; the per-ring lock makes the copy-out reads
+    safe (``list(deque)`` racing an append raises "deque mutated during
+    iteration") at a cost the 1 Hz sampler never notices."""
+
+    __slots__ = ("name", "kind", "_samples", "_lock")
+
+    def __init__(self, name: str, kind: str, maxlen: Optional[int] = None):
+        note_alloc()
+        self.name = name
+        self.kind = kind
+        self._samples: deque = deque(maxlen=maxlen or RING_SAMPLES)
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def append(self, t: float, value: Any) -> None:
+        with self._lock:
+            self._samples.append((t, value))
+
+    def samples(self) -> List[tuple]:
+        with self._lock:
+            return list(self._samples)
+
+    def latest(self) -> Optional[tuple]:
+        with self._lock:
+            return self._samples[-1] if self._samples else None
+
+    def between(self, t0: float, t1: float) -> List[tuple]:
+        """Samples with ``t0 <= t <= t1`` (oldest first)."""
+        return [s for s in self.samples() if t0 <= s[0] <= t1]
+
+    # -- counter reads --------------------------------------------------- #
+
+    def delta(
+        self, window_s: float, now: Optional[float] = None
+    ) -> Optional[float]:
+        """Cumulative-total growth over the trailing window (>= 0).
+
+        None with fewer than two in-window samples.  A negative raw delta
+        (the underlying registry was reset mid-window) clamps to the last
+        sample's absolute value — the restart's own accumulation."""
+        now = time.monotonic() if now is None else now
+        window = self.between(now - window_s, now)
+        if len(window) < 2:
+            return None
+        raw = float(window[-1][1]) - float(window[0][1])
+        return raw if raw >= 0 else float(window[-1][1])
+
+    def rate(
+        self, window_s: float, now: Optional[float] = None
+    ) -> Optional[float]:
+        """Per-second growth over the trailing window, or None."""
+        now = time.monotonic() if now is None else now
+        window = self.between(now - window_s, now)
+        if len(window) < 2:
+            return None
+        dt = window[-1][0] - window[0][0]
+        if dt <= 0:
+            return None
+        delta = float(window[-1][1]) - float(window[0][1])
+        if delta < 0:
+            delta = float(window[-1][1])
+        return delta / dt
+
+    # -- gauge reads ----------------------------------------------------- #
+
+    def window_minmax(
+        self, window_s: float, now: Optional[float] = None
+    ) -> Optional[Tuple[float, float]]:
+        now = time.monotonic() if now is None else now
+        window = self.between(now - window_s, now)
+        if not window:
+            return None
+        values = [float(s[1]) for s in window]
+        return (min(values), max(values))
+
+    # -- histogram reads ------------------------------------------------- #
+
+    def hist_delta(
+        self, t0: float, t1: float
+    ) -> Optional[Tuple[Tuple[float, ...], List[int], int]]:
+        """``(bounds, per-bucket counts, total)`` of the observations that
+        landed between the first sample at/after ``t0`` and the last at/
+        before ``t1`` — cumulative-bucket subtraction between the window's
+        edge samples.  None when the window holds no usable pair or saw
+        no observations."""
+        window = self.between(t0, t1)
+        if not window:
+            return None
+        last = window[-1][1]
+        first: Optional[HistSample] = None
+        if len(window) > 1:
+            first = window[0][1]
+        bounds, cums, count, _total_sum = last
+        if first is not None and first[0] == bounds:
+            base_cums, base_count = first[1], first[2]
+        else:
+            # bucket layout changed (registry reset + re-bucket) or a
+            # single-sample window: bill the last sample's full history
+            base_cums, base_count = (0,) * len(cums), 0
+        counts = [max(c - b, 0) for c, b in zip(cums, base_cums)]
+        total = max(count - base_count, 0)
+        # de-cumulate: per-bucket counts from the cumulative deltas
+        per_bucket: List[int] = []
+        prev = 0
+        for c in counts:
+            per_bucket.append(max(c - prev, 0))
+            prev = c
+        overflow = max(total - sum(per_bucket), 0)
+        per_bucket.append(overflow)
+        return (bounds, per_bucket, total)
+
+    def quantile(
+        self,
+        q: float,
+        window_s: float,
+        now: Optional[float] = None,
+        end_offset_s: float = 0.0,
+    ) -> Optional[float]:
+        """Estimated q-quantile of the observations inside the trailing
+        window (``end_offset_s`` shifts the window back: the tripwires'
+        baseline window is ``quantile(q, W, end_offset_s=W)``)."""
+        now = time.monotonic() if now is None else now
+        t1 = now - end_offset_s
+        delta = self.hist_delta(t1 - window_s, t1)
+        if delta is None:
+            return None
+        bounds, per_bucket, total = delta
+        if total <= 0:
+            return None
+        target = q * total
+        seen = 0.0
+        for i, bucket_count in enumerate(per_bucket):
+            if bucket_count == 0:
+                continue
+            if seen + bucket_count >= target:
+                if i >= len(bounds):  # overflow bucket
+                    return float(bounds[-1]) if bounds else None
+                lo = float(bounds[i - 1]) if i > 0 else 0.0
+                hi = float(bounds[i])
+                frac = (target - seen) / bucket_count
+                return lo + (hi - lo) * frac
+            seen += bucket_count
+        return float(bounds[-1]) if bounds else None
+
+    def window_count(
+        self, window_s: float, now: Optional[float] = None
+    ) -> int:
+        """Histogram observations inside the trailing window (0 if none)."""
+        now = time.monotonic() if now is None else now
+        delta = self.hist_delta(now - window_s, now)
+        return delta[2] if delta is not None else 0
+
+
+class RingStore:
+    """Thread-safe name -> :class:`Ring` (sampler writes, HTTP/tripwires
+    read), cardinality-capped like the meter registry."""
+
+    def __init__(self) -> None:
+        note_alloc()
+        self._lock = threading.Lock()
+        self._rings: Dict[str, Ring] = {}
+        self.dropped_series = 0
+
+    def _max_series(self) -> int:
+        try:
+            from modin_tpu.config import MetersMaxSeries
+
+            return int(MetersMaxSeries.get())
+        except ImportError:
+            return 2048
+
+    def observe(self, name: str, kind: str, value: Any, t: float) -> None:
+        with self._lock:
+            ring = self._rings.get(name)
+            if ring is None:
+                if len(self._rings) >= self._max_series():
+                    self.dropped_series += 1
+                    return
+                ring = self._rings[name] = Ring(name, kind)
+            ring.append(t, value)
+
+    def observe_meter(self, name: str, series: dict, t: float) -> None:
+        """Fold one meter-registry snapshot entry into its ring."""
+        kind = series.get("kind", "counter")
+        if kind == "histogram":
+            bounds = tuple(float(b) for b, _c in series.get("buckets", []))
+            cums = tuple(int(c) for _b, c in series.get("buckets", []))
+            value: Any = (
+                bounds, cums, int(series.get("count", 0)),
+                float(series.get("sum", 0.0)),
+            )
+        elif kind == "gauge":
+            value = series.get("value", 0.0)
+        else:
+            value = series.get("total", 0.0)
+        self.observe(name, kind, value, t)
+
+    def get(self, name: str) -> Optional[Ring]:
+        with self._lock:
+            return self._rings.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rings)
+
+    def rate(
+        self, name: str, window_s: float, now: Optional[float] = None
+    ) -> Optional[float]:
+        ring = self.get(name)
+        return ring.rate(window_s, now) if ring is not None else None
+
+    def delta(
+        self, name: str, window_s: float, now: Optional[float] = None
+    ) -> Optional[float]:
+        ring = self.get(name)
+        return ring.delta(window_s, now) if ring is not None else None
+
+    def quantile(
+        self,
+        name: str,
+        q: float,
+        window_s: float,
+        now: Optional[float] = None,
+        end_offset_s: float = 0.0,
+    ) -> Optional[float]:
+        ring = self.get(name)
+        if ring is None:
+            return None
+        return ring.quantile(q, window_s, now, end_offset_s)
+
+    def excerpt(self, last_n: int = 60) -> dict:
+        """JSON-safe tail of every ring (evidence bundles, ``/statusz``)."""
+        with self._lock:
+            rings = list(self._rings.items())
+        out: Dict[str, dict] = {}
+        for name, ring in rings:
+            tail = ring.samples()[-last_n:]
+            out[name] = {
+                "kind": ring.kind,
+                "samples": [
+                    [round(t, 3), _json_safe_value(v)] for t, v in tail
+                ],
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rings.clear()
+            self.dropped_series = 0
+
+
+def _json_safe_value(value: Any) -> Any:
+    if isinstance(value, tuple):  # histogram sample
+        bounds, cums, count, total_sum = value
+        return {
+            "buckets": [[b, c] for b, c in zip(bounds, cums)],
+            "count": count,
+            "sum": total_sum,
+        }
+    return value
+
+
+# ---------------------------------------------------------------------- #
+# the sampler thread
+# ---------------------------------------------------------------------- #
+
+
+#: ring names the sampler reads LIVE each tick (step 2 below); the meter
+#: registry holds same-named gauges updated only at spill passes, and its
+#: stale copies must not interleave into the same rings
+_DIRECT_SAMPLED = frozenset(
+    {"memory.device.resident_bytes", "memory.host.cache_bytes"}
+)
+
+
+class Sampler:
+    """The graftwatch background sampling loop (one daemon thread).
+
+    ``on_tick`` runs after every successful sample pass (the tripwire
+    engine); ``on_died`` runs once if the loop crashes, AFTER the
+    ``watch.sampler.died`` metric is emitted — the service uses it to
+    degrade itself to disabled without joining the dying thread.
+    """
+
+    THREAD_NAME = "modin-tpu-watch-sampler"
+
+    def __init__(
+        self,
+        store: RingStore,
+        on_tick: Optional[Callable[[float], None]] = None,
+        on_died: Optional[Callable[[BaseException], None]] = None,
+    ) -> None:
+        note_alloc()
+        self._store = store
+        self._on_tick = on_tick
+        self._on_died = on_died
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.ticks = 0
+        self.last_tick_t: Optional[float] = None
+        self.died = False
+        self.error: Optional[str] = None
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def start(self) -> None:
+        """Start the loop (idempotent: a live thread is left running)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        # a FRESH event per run, never clear() of the shared one: a prior
+        # run whose stop() join timed out (a tick stalled past the join
+        # budget) still holds its own — set — event, so when its stalled
+        # tick returns it exits instead of reviving alongside this run
+        self._stop = threading.Event()
+        self.died = False
+        self.error = None
+        self.ticks = 0  # per-run: a restart starts its own tick count
+        self.last_tick_t = None
+        self._thread = threading.Thread(
+            target=self._run, name=self.THREAD_NAME, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop and join the loop (idempotent; never called from the
+        sampler thread itself)."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            if thread is not threading.current_thread():
+                thread.join(timeout)
+        self._thread = None
+
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _interval_s(self) -> float:
+        from modin_tpu.config import WatchIntervalS
+
+        return max(float(WatchIntervalS.get()), 0.001)
+
+    # -- the loop -------------------------------------------------------- #
+
+    def _run(self) -> None:
+        stop = self._stop  # THIS run's event (see start(): a later start
+        # swaps in a fresh one, which must not revive a stalled run)
+        try:
+            while not stop.is_set():
+                self.sample_once()
+                if self._on_tick is not None:
+                    self._on_tick(time.monotonic())
+                if stop.wait(self._interval_s()):
+                    break
+        except BaseException as err:  # noqa: BLE001 - the degrade contract
+            if self._thread is not threading.current_thread():
+                # superseded run: stop()/start() already replaced this
+                # thread — a crash during its teardown must not degrade
+                # the healthy restarted service
+                return
+            # telemetry must never take queries down: record the crash,
+            # emit the counter, and let the service disable itself
+            self.died = True
+            self.error = f"{type(err).__name__}: {err}"
+            try:
+                from modin_tpu.logging.metrics import emit_metric
+
+                emit_metric("watch.sampler.died", 1)
+            except Exception:
+                pass
+            if self._on_died is not None:
+                try:
+                    self._on_died(err)
+                except Exception:
+                    pass
+
+    def sample_once(self, now: Optional[float] = None) -> None:
+        """One sampling pass over every seam (also callable directly by
+        tests and the smoke gate for deterministic ticks)."""
+        now = time.monotonic() if now is None else now
+        store = self._store
+
+        # 1. the meter registry (the watch service holds a registry
+        #    acquire for its lifetime, so series exist even with
+        #    MODIN_TPU_METERS=0).  Names the direct seams below sample
+        #    live are SKIPPED here: the registry's copy is the value last
+        #    emitted at a spill pass — possibly minutes stale — and
+        #    interleaving it with the live ledger reading at the same
+        #    tick would halve the ring and invent min/max excursions.
+        from modin_tpu.observability import meters as _meters
+
+        for name, series in _meters.snapshot().get("series", {}).items():
+            if name in _DIRECT_SAMPLED:
+                continue
+            store.observe_meter(name, series, now)
+
+        # 2. device/host ledger gauges, via the one shared sampling seam
+        from modin_tpu.observability import spans as _spans
+
+        device_bytes, host_bytes = _spans._ledger_bytes()
+        store.observe(
+            "memory.device.resident_bytes", "gauge", device_bytes, now
+        )
+        store.observe("memory.host.cache_bytes", "gauge", host_bytes, now)
+
+        # 3. admission-gate pressure (only when serving is imported; the
+        #    sampler must never trigger an import chain)
+        gate_mod = sys.modules.get("modin_tpu.serving.gate")
+        if gate_mod is not None:
+            try:
+                queued, running = gate_mod.counter_sample()
+            except Exception:
+                queued, running = 0, 0
+            store.observe("serving.gate.queued", "gauge", queued, now)
+            store.observe("serving.gate.running", "gauge", running, now)
+
+        # 4. compile-ledger deltas (totals are O(1); the storm count walks
+        #    the signature table once per tick)
+        from modin_tpu.observability.compile_ledger import get_compile_ledger
+
+        ledger = get_compile_ledger()
+        compiles, compile_s = ledger.totals()
+        store.observe("compile.total", "counter", compiles, now)
+        store.observe("compile.wall_s", "counter", compile_s, now)
+        store.observe(
+            "compile.storm_signatures",
+            "gauge",
+            len(ledger.recompile_storms()),
+            now,
+        )
+
+        self.ticks += 1
+        self.last_tick_t = now

@@ -52,6 +52,7 @@ from typing import Any, Callable, Optional
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
+from modin_tpu.observability import watch as _watch
 from modin_tpu.serving import context as _context
 from modin_tpu.serving import tenants as _tenants
 from modin_tpu.serving.errors import DeadlineExceeded, QueryRejected, ServingError
@@ -395,6 +396,14 @@ class AdmissionGate:
 
 gate = AdmissionGate()
 
+
+def counter_sample() -> tuple:
+    """``(queued, running)`` read lock-free: racy-by-design telemetry
+    reads (the chrome-trace counter tracks sample this at every span
+    finish and the graftwatch sampler every tick) — taking the gate lock
+    here would serialize traced threads against the admission path."""
+    return (len(gate._waiters), gate._running)
+
 #: Reentrancy marker: depth of submit() frames on this thread.  An
 #: admitted query that submits another query must NOT go back through the
 #: gate — at saturation it would queue behind the slot its own caller
@@ -406,9 +415,15 @@ _tls = threading.local()
 
 
 def serving_snapshot() -> dict:
-    """Gate + tenant state for dashboards / debugging."""
+    """Gate + tenant state for dashboards / debugging.
+
+    With graftwatch running, the per-tenant SLO burn verdicts ride along
+    under ``"slo"`` — an ADVISORY health signal next to the breaker
+    states (the gate surfaces it, it never sheds because of it)."""
     snap = gate.snapshot()
     snap["tenants"] = _tenants.registry.snapshot()
+    if _watch.WATCH_ON:
+        snap["slo"] = _watch.slo_health()
     return snap
 
 
@@ -514,6 +529,10 @@ def submit(
         gate.release(permit)
         wall_s = time.perf_counter() - t0
         emit_metric("serving.query_wall_s", wall_s)
+        if _watch.WATCH_ON:
+            # per-tenant latency series for graftwatch SLO burn tracking
+            # (one module-attribute check when watch is off)
+            _watch.observe_query(tenant, wall_s, failure_kind)
         _finish_accounting(tenant, stats, wall_s, failure_kind)
 
 

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all fourteen gates, fail on any red
+#   ./scripts/check_all.sh            # all sixteen gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -53,6 +53,12 @@
 #       (QueryStats high-water AND the meter gauge max) and
 #       stream.window.count > 1, and the external sort / merge-join must
 #       answer bit-identically to the resident kernels
+#   0k. graftwatch smoke: 8 concurrent serving sessions under an injected
+#       slow-kernel phase with the telemetry service live — every mid-load
+#       /metrics scrape must parse via parse_prometheus, the per-tenant
+#       SLO burn tripwire must fire, and exactly ONE rate-limited
+#       evidence bundle (trace segment + meter snapshot + ring excerpt +
+#       SLO health) must land in MODIN_TPU_TRACE_DIR
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -87,6 +93,7 @@ run_gate "perf_history"    python scripts/perf_history_smoke.py
 run_gate "graftmesh"       python scripts/spmd_smoke.py
 run_gate "graftstream"     python scripts/oocore_smoke.py
 run_gate "graftview"       python scripts/views_smoke.py
+run_gate "graftwatch"      python scripts/watch_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -96,4 +103,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL FIFTEEN GATES GREEN"
+echo "ALL SIXTEEN GATES GREEN"

@@ -1,0 +1,275 @@
+"""graftwatch smoke gate: live telemetry under concurrent serving load.
+
+Run by scripts/check_all.sh (the sixteenth gate).  Eight concurrent
+serving sessions hammer one shared frame through ``serving.submit`` with
+an injected slow-kernel phase while the graftwatch service is live, and
+the gate asserts the always-on telemetry contract end to end:
+
+1. **the exporter serves under load** — ``/metrics`` is scraped MID-LOAD
+   from the main thread and every response must parse through
+   ``parse_prometheus`` (the same validating parser the metrics gate
+   trusts), and ``/statusz`` + ``/debug/queries`` must answer;
+2. **the SLO burn tripwire fires** — every query breaches the injected
+   25ms objective under the 80ms/deploy slow kernel, so the per-tenant
+   multi-window burn verdict must go breaching and the ``slo_burn``
+   tripwire must trip (visible in ``watch.trip.slo_burn`` and the
+   recent-trips ring);
+3. **exactly one evidence bundle lands** — capture is rate-limited
+   through the flight recorder's claim-token window, so the whole
+   incident produces ONE ``watchtrip_*.json`` carrying all four legs
+   (trace segment, meter snapshot, ring excerpt, SLO health);
+4. **nothing degrades** — the sampler survives the run (no
+   ``watch.sampler.died``), and every query completes or fails typed.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import glob
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pandas  # noqa: E402
+
+SESSIONS = 8
+QUERIES_PER_SESSION = 4
+JOIN_BUDGET_S = 180.0
+SLO_MS = 25.0
+SLOW_KERNEL_S = 0.08
+
+
+def main() -> int:
+    import modin_tpu.pandas as pd
+    import modin_tpu.serving as serving
+    from modin_tpu.config import (
+        MetersEnabled,
+        ResilienceBackoffS,
+        ServingEnabled,
+        ServingMaxConcurrent,
+        ServingQueueDepth,
+        TraceDir,
+        TraceEnabled,
+        WatchEnabled,
+        WatchIntervalS,
+        WatchPort,
+        WatchSloMs,
+    )
+    from modin_tpu.logging import add_metric_handler
+    from modin_tpu.observability import watch
+    from modin_tpu.observability.exposition import parse_prometheus
+    from modin_tpu.testing import inject_faults
+
+    seen = []
+    add_metric_handler(lambda name, value: seen.append(name))
+
+    tracedir = tempfile.mkdtemp(prefix="watch_smoke_")
+    TraceDir.put(tracedir)
+    TraceEnabled.put(True)  # the evidence bundle's trace segment is real
+    # MODIN_TPU_METERS stays OFF on purpose: watch alone must activate
+    # registry aggregation (the service holds a registry acquire), or
+    # /metrics and the registry-fed tripwires would be silently dead
+    assert not MetersEnabled.get()
+    ResilienceBackoffS.put(0.0)
+    ServingEnabled.put(True)
+    ServingMaxConcurrent.put(4)
+    # deep queue: this gate tests telemetry, not shedding — a shed burst
+    # >5s after the slo_burn trip would legally mint a second bundle
+    ServingQueueDepth.put(SESSIONS * QUERIES_PER_SESSION)
+    WatchSloMs.put(f"default={SLO_MS:g}")
+    WatchIntervalS.put(0.1)
+    WatchPort.put(0)  # ephemeral
+
+    rng = np.random.default_rng(11)
+    n = 4096
+    data = {
+        "a": rng.normal(size=n),
+        "b": rng.integers(0, 1000, n).astype(np.int64),
+        "key": rng.integers(0, 13, n).astype(np.int64),
+    }
+    pdf = pandas.DataFrame(data)
+    mdf = pd.DataFrame(data)
+    mdf._query_compiler.execute()  # ingest + compile outside the timers
+
+    queries = [
+        (
+            "gb_sum",
+            lambda: mdf.groupby("key").sum().modin.to_pandas(),
+            pdf.groupby("key").sum(),
+        ),
+        (
+            "ew_reduce",
+            lambda: float((mdf["a"] * 2 + mdf["b"]).sum()),
+            float((pdf["a"] * 2 + pdf["b"]).sum()),
+        ),
+        (
+            "mean",
+            lambda: mdf.mean().modin.to_pandas(),
+            pdf.mean(),
+        ),
+    ]
+    for _name, q, _want in queries:  # warm every compile path
+        q()
+
+    # watch goes live only now: the warmup's compile churn pre-dates the
+    # first ring sample, so the recompile_storm rule measures the LOAD
+    # (which recompiles nothing), not process startup
+    WatchEnabled.put(True)
+    port = watch.httpd_port()
+    assert port is not None and port > 0, "exporter did not bind a port"
+
+    def check_exact(name, got, want):
+        if isinstance(want, float):
+            tol = 1e-9 * max(1.0, abs(want))
+            assert abs(got - want) <= tol, f"{name}: {got} != {want}"
+        elif isinstance(want, pandas.Series):
+            pandas.testing.assert_series_equal(got, want)
+        else:
+            pandas.testing.assert_frame_equal(got, want)
+
+    def scrape(path: str) -> str:
+        return (
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            )
+            .read()
+            .decode()
+        )
+
+    # ---- the load: 8 sessions under a slow kernel, exporter scraped
+    # concurrently from the main thread ---- #
+    failures = []
+    completed = [0]
+    lock = threading.Lock()
+
+    def session(tid: int) -> None:
+        for k in range(QUERIES_PER_SESSION):
+            name, q, want = queries[(tid + k) % len(queries)]
+            try:
+                got = serving.submit(
+                    q, tenant=f"session{tid}", deadline_ms=0, label=name
+                )
+                check_exact(name, got, want)
+            except (serving.QueryRejected, serving.DeadlineExceeded):
+                continue  # typed outcomes are legal, just not expected here
+            except BaseException as err:  # noqa: BLE001 - the assertion
+                with lock:
+                    failures.append(
+                        f"session {tid} {name}: {type(err).__name__}: {err}"
+                    )
+                continue
+            with lock:
+                completed[0] += 1
+
+    midload_parses = [0]
+    with inject_faults(
+        "slow_kernel", ops=("deploy",), times=None, slow_s=SLOW_KERNEL_S
+    ):
+        threads = [
+            threading.Thread(target=session, args=(tid,), daemon=True)
+            for tid in range(SESSIONS)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        # scrape WHILE the load runs: every response must stay parseable
+        while any(t.is_alive() for t in threads):
+            if time.monotonic() - t0 > JOIN_BUDGET_S:
+                break
+            parsed = parse_prometheus(scrape("/metrics"))
+            assert parsed, "mid-load /metrics parsed to an empty registry"
+            midload_parses[0] += 1
+            time.sleep(0.1)
+        for t in threads:
+            t.join(timeout=max(JOIN_BUDGET_S - (time.monotonic() - t0), 1.0))
+        hung = [t.name for t in threads if t.is_alive()]
+        assert not hung, (
+            f"GLOBAL WATCHDOG: {len(hung)} session thread(s) still alive"
+        )
+
+    assert not failures, "\n".join(failures[:10])
+    assert completed[0] > 0, "nothing completed under the slow kernel"
+    assert midload_parses[0] >= 1, (
+        "the load finished before a single mid-load /metrics scrape — "
+        "the gate proved nothing about the exporter under load"
+    )
+
+    # ---- the SLO burn tripwire must have fired ---- #
+    deadline = time.monotonic() + 30.0
+    tripped = []
+    while time.monotonic() < deadline:
+        tripped = [t for t in watch.recent_trips() if t["rule"] == "slo_burn"]
+        if tripped:
+            break
+        time.sleep(0.1)
+    assert tripped, (
+        f"slo_burn never tripped; recent={watch.recent_trips()} "
+        f"slo={watch.slo_health()}"
+    )
+    assert "modin_tpu.watch.trip.slo_burn" in seen, (
+        "watch.trip.slo_burn metric not emitted"
+    )
+    snap = serving.serving_snapshot()
+    assert "slo" in snap and any(
+        v["breaching"] for v in snap["slo"].values()
+    ), f"serving_snapshot carries no breaching SLO verdict: {snap.get('slo')}"
+
+    # the other surfaces answer under/after load
+    statusz = scrape("/statusz")
+    assert "BREACHING" in statusz, "statusz does not show the breach"
+    dbg = json.loads(scrape("/debug/queries"))
+    assert "queries" in dbg
+
+    # ---- stop the service, then count evidence: exactly ONE bundle ---- #
+    WatchEnabled.put(False)
+    bundles = glob.glob(os.path.join(tracedir, "watchtrip_*.json"))
+    assert len(bundles) == 1, (
+        f"expected exactly one rate-limited evidence bundle, found "
+        f"{len(bundles)}: {bundles}"
+    )
+    bundle = json.loads(open(bundles[0]).read())
+    assert bundle["rule"] == "slo_burn"
+    for leg in ("trace", "metrics", "rings", "slo"):
+        assert leg in bundle, f"evidence bundle missing {leg!r}"
+    assert bundle["trace"]["traceEvents"], "trace segment is empty"
+    assert bundle["slo"] and any(
+        v["breaching"] for v in bundle["slo"].values()
+    ), "bundle slo table carries no breach"
+
+    # ---- the sampler survived ---- #
+    wsnap = watch.watch_snapshot()
+    assert not wsnap["sampler"]["died"], f"sampler died: {wsnap}"
+    assert "modin_tpu.watch.sampler.died" not in seen
+
+    print(
+        "watch smoke OK: "
+        f"{completed[0]} bit-exact completions across {SESSIONS} sessions "
+        f"under a {SLOW_KERNEL_S * 1e3:.0f}ms/deploy slow kernel; "
+        f"{midload_parses[0]} mid-load /metrics scrapes parsed; "
+        f"slo_burn tripped ({tripped[0]['detail'][:80]}...); "
+        f"1 evidence bundle at {bundles[0]}; "
+        f"sampler ticks={wsnap['sampler']['ticks']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(f"watch smoke FAILED: {err}", file=sys.stderr)
+        sys.exit(1)

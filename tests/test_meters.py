@@ -358,6 +358,58 @@ class TestExposition:
         assert hist["samples"]["modin_tpu_io_read_bytes_sum"] == 4096 + (1 << 22)
         assert any("_bucket" in k for k in hist["samples"])
 
+    def test_help_lines_carry_registry_descriptions(self):
+        """# HELP text comes from the METRICS registry 3-tuples and
+        survives the parse roundtrip (the graftwatch satellite)."""
+        from modin_tpu.logging.metrics import METRICS
+
+        snap = self._snapshot_with_all_kinds()
+        text = exposition.to_prometheus(snap)
+        parsed = exposition.parse_prometheus(text)
+        declared = {
+            entry[0]: " ".join(str(entry[2]).split()) for entry in METRICS
+        }
+        # exact-name family: description verbatim
+        assert (
+            parsed["modin_tpu_io_read_bytes"]["help"]
+            == declared["io.read.bytes"]
+        )
+        # wildcard family resolves through fnmatch
+        assert (
+            parsed["modin_tpu_sortcache_hit"]["help"]
+            == declared["sortcache.*"]
+        )
+        # an ad-hoc name not in the registry keeps the generic fallback
+        emit_metric("adhoc.testonly.name", 1)
+        text = exposition.to_prometheus(meters.snapshot())
+        parsed = exposition.parse_prometheus(text)
+        assert (
+            parsed["modin_tpu_adhoc_testonly_name"]["help"]
+            == "modin_tpu metric adhoc.testonly.name"
+        )
+
+    def test_help_text_escapes_newlines_and_backslashes(self, monkeypatch):
+        import modin_tpu.logging.metrics as metrics_mod
+
+        patched = metrics_mod.METRICS + (
+            ("unit.help.escape", "counter", "path C:\\tmp\nsecond line"),
+        )
+        monkeypatch.setattr(metrics_mod, "METRICS", patched)
+        # a registry description: whitespace (the newline included)
+        # normalizes to single spaces, then backslashes escape per the
+        # Prometheus text format
+        text = exposition.help_text("unit.help.escape")
+        assert text == "path C:\\\\tmp second line"
+        assert "\n" not in text
+        # the generic fallback escapes a hostile snapshot name too (names
+        # from exposition callers are arbitrary, unlike emit_metric's)
+        evil = exposition.help_text("adhoc\nhostile.name")
+        assert "\n" not in evil and "\\n" in evil
+
+    def test_parser_rejects_malformed_help(self):
+        with pytest.raises(ValueError):
+            exposition.parse_prometheus("# HELP \nx 1")
+
     def test_json_round_trip(self):
         snap = self._snapshot_with_all_kinds()
         loaded = json.loads(exposition.to_json(snap))
@@ -450,8 +502,8 @@ class TestMetricsSmokeGate:
 class TestCounterTracks:
     def test_chrome_trace_counter_events_from_samples(self):
         samples = [
-            (10.0, (111, 222, 3, 40, 1000)),
-            (20.0, (444, 555, 6, 80, 2000)),
+            (10.0, (111, 222, 3, 40, 1000, 2, 1)),
+            (20.0, (444, 555, 6, 80, 2000, 5, 4)),
         ]
         trace = to_chrome_trace([], counters=samples)
         cevents = [e for e in trace["traceEvents"] if e["ph"] == "C"]
@@ -464,6 +516,16 @@ class TestCounterTracks:
         assert by_name["spans.live"] == [3, 6]
         assert by_name["engine.cost.padding_waste_bytes"] == [40, 80]
         assert by_name["engine.cost.achieved_bw_bytes_s"] == [1000, 2000]
+        assert by_name["serving.gate.queued"] == [2, 5]
+        assert by_name["serving.gate.running"] == [1, 4]
+
+    def test_legacy_samples_render_without_gate_tracks(self):
+        """Pre-graftwatch 5-tuple samples still render — zip stops short,
+        the gate tracks are simply absent (the documented contract)."""
+        trace = to_chrome_trace([], counters=[(10.0, (1, 2, 3, 4, 5))])
+        names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "C"}
+        assert "engine.cost.achieved_bw_bytes_s" in names
+        assert "serving.gate.queued" not in names
 
     def test_profile_export_carries_counter_tracks(self):
         import modin_tpu.observability as graftscope

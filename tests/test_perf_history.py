@@ -260,6 +260,45 @@ class TestGate:
             "a 100x same-mesh spmd regression folded green"
         )
 
+    def test_serving_ops_keyed_by_watch_mode(self):
+        run = {"rows": 100, "scale": {"rows": 100, "serving_rows": 2000000}}
+        assert (
+            ph.op_scale_key(run, "serving_p50")
+            == "rows=2000000@watch=off"
+        )
+        assert (
+            ph.op_scale_key(run, "serving_watch_p50")
+            == "rows=2000000@watch=on"
+        )
+        # the committed r09 records compute the same @watch=off key, so
+        # history stays comparable across the key-schema change
+        legacy = {"rows": 2000000, "scale": {"serving_rows": 2000000}}
+        assert ph.op_scale_key(legacy, "serving_p99").endswith("@watch=off")
+
+    def test_serving_walls_never_gate_across_watch_modes(self):
+        # the same saturation workload with the graftwatch sampler live is
+        # a different workload: its (bounded) overhead must never gate
+        # against the watch-off wall, and vice versa
+        ledger = self._ledger_with(
+            {"serving_p50": 0.05}, extra_scale={"serving_rows": 2000000}
+        )
+        watch_on = ph.parse_bench_stream(
+            _stream(
+                {"serving_watch_p50": 5.0},
+                extra_scale={"serving_rows": 2000000},
+            )
+        )
+        assert ph.check_regression(ledger, watch_on) == []
+        same_mode = ph.parse_bench_stream(
+            _stream(
+                {"serving_p50": 5.0},
+                extra_scale={"serving_rows": 2000000},
+            )
+        )
+        assert ph.check_regression(ledger, same_mode), (
+            "a 100x same-mode serving regression folded green"
+        )
+
     def test_oocore_ops_keyed_by_rows_and_window(self):
         mapped = {
             "rows": 100,
