@@ -191,6 +191,14 @@ SERVING_QUERIES = int(os.environ.get("BENCH_SERVING_QUERIES", 48))
 # same reasoning as BENCH_RECOVERY_OVERHEAD_PCT)
 WATCH_OVERHEAD_PCT = float(os.environ.get("BENCH_WATCH_OVERHEAD_PCT", 5.0))
 
+# graftfleet section: routed multi-tenant queries against a replicated
+# serving fleet — steady-state routing overhead vs the single-process
+# path, replica-loss MTTR (kill -9 to back-routable), and the drained
+# tenants' p99 on the survivors while the slot respawns.
+FLEET_ROWS = int(os.environ.get("BENCH_FLEET_ROWS", 500_000))
+FLEET_REPLICAS = int(os.environ.get("BENCH_FLEET_REPLICAS", 2))
+FLEET_QUERIES = int(os.environ.get("BENCH_FLEET_QUERIES", 32))
+
 
 class SectionTimeout(BaseException):
     """A benchmark section overran its wall-clock budget.
@@ -254,6 +262,8 @@ def _run_provenance(platform: str) -> dict:
             "recovery_rows": RECOVERY_ROWS,
             "apply_rows": APPLY_ROWS,
             "serving_rows": SERVING_ROWS,
+            "fleet_rows": FLEET_ROWS,
+            "fleet_replicas": FLEET_REPLICAS,
             "spmd_rows": SPMD_ROWS,
             "spmd_mesh": SPMD_MESHES,
             "oocore_rows": OOCORE_ROWS,
@@ -1911,6 +1921,152 @@ def main() -> None:
         sections["oocore"] = payload
         return payload
 
+    # ---- graftfleet: replicated serving fleet under replica loss ---- #
+    def fleet_section() -> dict:
+        import tempfile
+
+        import pandas as host_pd
+
+        from modin_tpu import fleet
+        from modin_tpu.config import FleetEnabled, ServingEnabled
+        from modin_tpu.serving.errors import DeadlineExceeded, QueryRejected
+        from modin_tpu.testing import ReplicaFaultInjector
+
+        n = FLEET_ROWS
+        csv = tempfile.NamedTemporaryFile(
+            mode="w", suffix=".csv", prefix="bench_fleet_", delete=False
+        )
+        host_pd.DataFrame(
+            {
+                "k": rng.integers(0, 97, n).astype(np.int64),
+                "i": rng.normal(size=n),
+            }
+        ).to_csv(csv.name, index=False)
+        csv.close()
+
+        def percentile(walls, q):
+            if not walls:
+                return None
+            ordered = sorted(walls)
+            return ordered[min(int(q * (len(ordered) - 1) + 0.5), len(ordered) - 1)]
+
+        serving_before = ServingEnabled.get()
+        fleet_before = FleetEnabled.get()
+        ServingEnabled.put(True)
+        tenants = [f"t{k}" for k in range(4)]
+        mttr = None
+        try:
+            # -- single-process baseline: the identical submit API with
+            # the fleet off (one module-attr check, then the local
+            # serving path) -- #
+            fleet.register_dataset("bench_fleet", "read_csv", csv.name)
+            fleet.submit("bench_fleet", "groupby_sum", key="k")  # warm
+            local_walls = []
+            for k in range(FLEET_QUERIES):
+                t0 = time.perf_counter()
+                fleet.submit(
+                    "bench_fleet", "groupby_sum", key="k",
+                    tenant=tenants[k % len(tenants)],
+                )
+                local_walls.append(time.perf_counter() - t0)
+
+            # -- routed steady state: the same load over socket RPC -- #
+            FleetEnabled.put(True)
+            coord = fleet.start_fleet(FLEET_REPLICAS)
+            fleet.register_dataset("bench_fleet", "read_csv", csv.name)
+            for tenant in tenants:  # warm every replica's compile caches
+                fleet.submit("bench_fleet", "groupby_sum", key="k", tenant=tenant)
+            routed_walls = []
+            for k in range(FLEET_QUERIES):
+                t0 = time.perf_counter()
+                fleet.submit(
+                    "bench_fleet", "groupby_sum", key="k",
+                    tenant=tenants[k % len(tenants)],
+                )
+                routed_walls.append(time.perf_counter() - t0)
+
+            # -- replica loss: kill -9 one replica, keep the tenant load
+            # flowing (drained tenants land on survivors), and time the
+            # slot back to routable (MTTR = kill .. respawned+warm) -- #
+            inj = ReplicaFaultInjector(coord)
+            t_kill = time.perf_counter()
+            inj.kill(0)
+            redistributed_walls = []
+            loss_deadline = time.perf_counter() + 120.0
+            k = 0
+            while time.perf_counter() < loss_deadline and (
+                mttr is None or len(redistributed_walls) < FLEET_QUERIES
+            ):
+                t0 = time.perf_counter()
+                try:
+                    fleet.submit(
+                        "bench_fleet", "groupby_sum", key="k",
+                        tenant=tenants[k % len(tenants)],
+                    )
+                    redistributed_walls.append(time.perf_counter() - t0)
+                except (QueryRejected, DeadlineExceeded):
+                    pass
+                k += 1
+                if mttr is None:
+                    snap = coord.snapshot()
+                    if snap["respawned"] >= 1 and all(
+                        r["state"] == "up" for r in snap["replicas"]
+                    ):
+                        mttr = time.perf_counter() - t_kill
+            final = coord.snapshot()
+        finally:
+            fleet.reset_for_tests()
+            FleetEnabled.put(fleet_before)
+            ServingEnabled.put(serving_before)
+            try:
+                os.unlink(csv.name)
+            except OSError:
+                pass
+
+        local_p50 = percentile(local_walls, 0.50)
+        local_p99 = percentile(local_walls, 0.99)
+        routed_p50 = percentile(routed_walls, 0.50)
+        routed_p99 = percentile(routed_walls, 0.99)
+        redist_p99 = percentile(redistributed_walls, 0.99)
+        sections["fleet"] = {
+            "rows": n,
+            "replicas": FLEET_REPLICAS,
+            "queries": FLEET_QUERIES,
+            "local_p50_s": round(local_p50, 4) if local_p50 else None,
+            "local_p99_s": round(local_p99, 4) if local_p99 else None,
+            "routed_p50_s": round(routed_p50, 4) if routed_p50 else None,
+            "routed_p99_s": round(routed_p99, 4) if routed_p99 else None,
+            # routing tax: socket RPC + pickle both ways vs in-process
+            "routing_overhead_x": (
+                round(routed_p50 / local_p50, 2)
+                if routed_p50 and local_p50
+                else None
+            ),
+            "loss_mttr_s": round(mttr, 4) if mttr is not None else None,
+            "redistributed_queries": len(redistributed_walls),
+            "redistributed_p99_s": (
+                round(redist_p99, 4) if redist_p99 else None
+            ),
+            "lost": final["lost"],
+            "respawned": final["respawned"],
+            "redistributed_tenants": final["redistributed"],
+        }
+        # scale-keyed @replicas=N (fleet_local_* land @replicas=local) by
+        # perf_history.op_scale_key, so fleet topologies never cross-gate
+        if local_p50 is not None:
+            detail["fleet_local_p50"] = {"modin_tpu_s": round(local_p50, 4)}
+            detail["fleet_local_p99"] = {"modin_tpu_s": round(local_p99, 4)}
+        if routed_p50 is not None:
+            detail["fleet_routed_p50"] = {"modin_tpu_s": round(routed_p50, 4)}
+            detail["fleet_routed_p99"] = {"modin_tpu_s": round(routed_p99, 4)}
+        if mttr is not None:
+            detail["fleet_mttr"] = {"modin_tpu_s": round(mttr, 4)}
+        if redist_p99 is not None:
+            detail["fleet_redistributed_p99"] = {
+                "modin_tpu_s": round(redist_p99, 4)
+            }
+        return sections["fleet"]
+
     # ---- the run: every section under the global BENCH_DEADLINE ---- #
     # (subprocess timeouts inside shuffle_apply already bound it; the
     # per-section alarm is a backstop there)
@@ -1928,6 +2084,7 @@ def main() -> None:
         ("spmd", spmd_section),
         ("shuffle_apply_virtual_mesh", shuffle_apply),
         ("oocore", oocore_section),
+        ("fleet", fleet_section),
     ]
     for name, fn in section_list:
         if SECTION_FILTER and name not in SECTION_FILTER:

@@ -1121,6 +1121,115 @@ class ServingDegradedHighWater(EnvironmentVariable, type=float):
         super().put(value)
 
 
+class FleetEnabled(EnvironmentVariable, type=bool):
+    """graftfleet replicated serving: a coordinator spawns and supervises
+    N replica serving processes (each with its own virtual mesh, admission
+    gate, and watch exporter on an ephemeral port), routes tenant queries
+    over a local socket RPC with deadline propagation, detects replica
+    failure (heartbeat loss / liveness-probe timeout / dead socket on
+    dispatch), drains and redistributes tenants weighted by each
+    survivor's typed-shed rate, and respawns dead replicas warm from the
+    dataset manifest plus graftview's artifact export/ingest seam
+    (modin_tpu/fleet/).
+
+    Off by default: no coordinator, no sockets, no threads —
+    ``fleet.submit`` is one module-attribute check away from the local
+    ``serving.submit`` path, allocating nothing
+    (``fleet_alloc_count()`` asserts it, graftscope-style).
+    """
+
+    varname = "MODIN_TPU_FLEET"
+    default = False
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+
+class FleetReplicas(EnvironmentVariable, type=int):
+    """How many replica serving processes ``start_fleet()`` spawns."""
+
+    varname = "MODIN_TPU_FLEET_REPLICAS"
+    default = 2
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Fleet replica count should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class FleetHeartbeatS(EnvironmentVariable, type=float):
+    """Seconds between replica heartbeats to the coordinator.  A replica
+    whose heartbeat goes silent for ~3 intervals gets one liveness probe
+    (fresh dial + ping on its RPC socket); probe failure declares it
+    lost.  The monitor re-reads this every tick, so a live retune takes
+    effect at the next wakeup."""
+
+    varname = "MODIN_TPU_FLEET_HEARTBEAT_S"
+    default = 0.5
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Fleet heartbeat interval should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class FleetRespawn(EnvironmentVariable, type=bool):
+    """Respawn a lost replica (fresh process, generation + 1) and re-warm
+    it from the dataset manifest + graftview artifact export before
+    routing to it again.  Off: the fleet runs degraded on the survivors
+    (tests pin legs)."""
+
+    varname = "MODIN_TPU_FLEET_RESPAWN"
+    default = True
+
+
+class FleetCoordAddress(EnvironmentVariable, type=ExactStr):
+    """INTERNAL: ``host:port`` of the coordinator's control listener.  Set
+    by the coordinator in a replica's spawn environment; never set by
+    hand (a replica with no coordinator to dial exits immediately)."""
+
+    varname = "MODIN_TPU_FLEET_COORD"
+    default = ""
+
+
+class FleetReplicaIndex(EnvironmentVariable, type=int):
+    """INTERNAL: this replica's slot index in the coordinator's table.
+    Set by the coordinator in a replica's spawn environment."""
+
+    varname = "MODIN_TPU_FLEET_INDEX"
+    default = -1
+
+
+class FleetReplicaGeneration(EnvironmentVariable, type=int):
+    """INTERNAL: this replica's spawn generation (bumped on every
+    respawn so stale hellos/heartbeats from a resumed corpse are
+    ignored).  Set by the coordinator in a replica's spawn environment."""
+
+    varname = "MODIN_TPU_FLEET_GEN"
+    default = 0
+
+
+class FleetTestCrash(EnvironmentVariable, type=ExactStr):
+    """INTERNAL: fault-injection leg for the test suite — ``warm`` makes
+    a replica ``os._exit(3)`` when the warm RPC arrives (the
+    crash-during-respawn case).  Set one-shot by
+    ``ReplicaFaultInjector.crash_next_respawn()``; never set by hand."""
+
+    varname = "MODIN_TPU_FLEET_TEST_CRASH"
+    default = ""
+
+
 class ViewsMode(EnvironmentVariable, type=str):
     """graftview derived-artifact cache (modin_tpu/views/): whole reduction
     results, nunique/mode/median answers, small groupby output tables, and

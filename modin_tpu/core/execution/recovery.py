@@ -683,6 +683,86 @@ def evict_for_oom(op: str, exclude_ids: Any = None) -> int:
 
 
 # ---------------------------------------------------------------------- #
+# dataset manifest (graftfleet warm-state recovery)
+# ---------------------------------------------------------------------- #
+#
+# Lineage re-seats buffers inside ONE process; a dead replica process has
+# no buffers left to re-seat.  The manifest is the process-level
+# generalization of the io-source record: at dataset registration the
+# serving layer records *how the dataset was read* (public reader name +
+# call args, all picklable), and a respawned replica re-warms by replaying
+# those reads through the public API — so the re-reads flow through
+# ``FileDispatcher.read`` and io lineage, spans, and cost accounting see
+# the replay exactly like the original read.
+
+_manifest_lock = threading.Lock()
+_dataset_manifest: Dict[str, dict] = {}
+
+
+def register_dataset(
+    name: str,
+    reader: str,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+) -> None:
+    """Record the re-read recipe for dataset ``name``.
+
+    ``reader`` is a public ``modin_tpu.pandas`` reader name (``read_csv``,
+    ``read_parquet``, ...); ``args``/``kwargs`` are its call arguments.
+    The entry must pickle — it crosses the coordinator->replica socket —
+    so unpicklable arguments are rejected here, at registration, not at
+    respawn time when the dead replica needs it.
+    """
+    import pickle
+
+    entry = {
+        "name": str(name),
+        "reader": str(reader),
+        "args": tuple(args),
+        "kwargs": dict(kwargs or {}),
+    }
+    try:
+        pickle.dumps(entry)
+    except Exception as err:  # graftlint: disable=EXC-HYGIENE -- nothing is swallowed: ANY pickling failure re-raises as a typed TypeError naming the dataset
+        raise TypeError(
+            f"dataset {name!r} manifest entry is not picklable: {err}"
+        ) from err
+    with _manifest_lock:
+        _dataset_manifest[entry["name"]] = entry
+
+
+def dataset_manifest() -> List[dict]:
+    """Picklable snapshot of every registered dataset's re-read recipe."""
+    with _manifest_lock:
+        return [dict(entry) for entry in _dataset_manifest.values()]
+
+
+def warm_from_manifest(entries: List[dict]) -> Dict[str, Any]:
+    """Replay manifest ``entries`` through the public readers.
+
+    Returns ``{name: frame}``.  Each replay also re-registers the entry
+    locally, so the warmed process can itself hand the manifest onward.
+    A reader that fails raises — a replica that cannot re-warm must not
+    report ready and silently serve an empty dataset.
+    """
+    import modin_tpu.pandas as _pd
+
+    frames: Dict[str, Any] = {}
+    for entry in entries:
+        reader = getattr(_pd, entry["reader"], None)
+        if reader is None:
+            raise ValueError(
+                f"manifest names unknown reader {entry['reader']!r}"
+            )
+        frames[entry["name"]] = reader(*entry["args"], **entry["kwargs"])
+        register_dataset(
+            entry["name"], entry["reader"], entry["args"], entry["kwargs"]
+        )
+        emit_metric("fleet.warm.dataset", 1)
+    return frames
+
+
+# ---------------------------------------------------------------------- #
 # config wiring & test seams
 # ---------------------------------------------------------------------- #
 
@@ -701,6 +781,8 @@ def reset_for_tests() -> None:
     with _epoch_lock:
         _device_epoch = 0
     _last_reseat_count = 0
+    with _manifest_lock:
+        _dataset_manifest.clear()
 
 
 from modin_tpu.config import RecoveryMode as _RecoveryMode  # noqa: E402

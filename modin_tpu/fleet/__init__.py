@@ -1,0 +1,206 @@
+"""graftfleet — replicated serving fleet (ISSUE 16).
+
+A coordinator (:mod:`modin_tpu.fleet.coordinator`) supervises N replica
+serving processes (:mod:`modin_tpu.fleet.replica`), each with its own
+virtual device mesh, admission gate, and watch exporter on an ephemeral
+port.  Tenant queries route over local socket RPC with deadline
+propagation; replica failure is detected three independent ways
+(heartbeat loss, liveness-probe timeout, dead socket on dispatch); lost
+replicas drain their tenants onto survivors weighted by typed-shed-rate
+backpressure, respawn a fresh generation, and re-warm from the dataset
+manifest (re-read through the public readers, so io lineage / spans /
+cost accounting all see the replay) plus a survivor's exported graftview
+artifacts.
+
+``MODIN_TPU_FLEET=0`` (the default) is the whole story for everyone
+else: no coordinator, no sockets, no threads — ``submit`` is one module
+attribute check and then the exact local serving path, and
+``fleet_alloc_count()`` stays 0 (the graftscope zero-overhead-when-off
+contract, asserted in tests).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: fast-path flag: True while MODIN_TPU_FLEET resolves truthy.  Every
+#: fleet hook on a hot path reads this one attribute and nothing else.
+FLEET_ON: bool = False
+
+#: fleet-object allocation counter (Coordinator + replica records); the
+#: off-mode zero-overhead assertion reads this through
+#: :func:`fleet_alloc_count`.
+_alloc_count: int = 0
+
+#: the process's coordinator (exactly one per fleet-enabled process)
+_coordinator: Optional[Any] = None
+
+#: fleet-off working set: dataset name -> locally-warmed frame, so the
+#: two modes answer the same ``submit`` calls bit-for-bit
+_local_frames: Dict[str, Any] = {}
+
+
+def _note_alloc() -> None:
+    global _alloc_count
+    _alloc_count += 1
+
+
+def fleet_alloc_count() -> int:
+    """How many fleet objects this process ever allocated (0 when the
+    fleet never started — the zero-overhead-when-off assertion)."""
+    return _alloc_count
+
+
+def get_coordinator() -> Optional[Any]:
+    """The live coordinator, or None (fleet off / never started /
+    replica process)."""
+    return _coordinator
+
+
+def start_fleet(replicas: Optional[int] = None) -> Any:
+    """Spawn and supervise the replica fleet; idempotent per process.
+
+    Requires ``MODIN_TPU_FLEET=1``; replica count defaults to
+    ``MODIN_TPU_FLEET_REPLICAS``.  Blocks until every replica has said
+    hello (imported the serving substrate and bound its ports).
+    """
+    global _coordinator
+    if not FLEET_ON:
+        raise RuntimeError(
+            "MODIN_TPU_FLEET is off; enable it (or FleetEnabled.enable()) "
+            "before start_fleet()"
+        )
+    if _coordinator is not None:
+        return _coordinator
+    from modin_tpu.fleet.coordinator import Coordinator
+
+    coord = Coordinator(replicas)
+    try:
+        coord.start()
+    except Exception:
+        coord.stop()
+        raise
+    _coordinator = coord
+    return coord
+
+
+def stop_fleet() -> None:
+    """Tear the fleet down (kill replicas, close sockets); idempotent."""
+    global _coordinator
+    coord = _coordinator
+    _coordinator = None
+    if coord is not None:
+        coord.stop()
+
+
+def register_dataset(name: str, reader: str, *args: Any, **kwargs: Any) -> None:
+    """Register a serving dataset: ``reader`` (a ``modin_tpu.pandas``
+    reader name, e.g. ``"read_csv"``) applied to ``args``/``kwargs``.
+
+    The entry lands in the recovery manifest either way — that is what a
+    respawned replica re-warms from.  Fleet on: every live replica warms
+    it now.  Fleet off: it is read locally, through the same public
+    reader path a replica would use.
+    """
+    if FLEET_ON and _coordinator is not None:
+        _coordinator.register_dataset(name, reader, tuple(args), dict(kwargs))
+        return
+    from modin_tpu.core.execution import recovery
+
+    recovery.register_dataset(name, reader, tuple(args), dict(kwargs))
+    import modin_tpu.pandas as _mpd
+
+    fn = getattr(_mpd, reader, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"unknown modin_tpu.pandas reader {reader!r}")
+    _local_frames[str(name)] = fn(*args, **kwargs)
+
+
+def submit(
+    dataset: str,
+    query: Any,
+    *args: Any,
+    tenant: str = "default",
+    deadline_ms: Optional[float] = None,
+    label: Optional[str] = None,
+    idempotent: bool = True,
+    **kwargs: Any,
+) -> Any:
+    """Run one query against a registered dataset, fleet-routed when on.
+
+    ``query`` is a catalog name from :mod:`modin_tpu.fleet.queries` or a
+    module-qualified picklable callable ``fn(frame, *args, **kwargs)``.
+    The outcome is always typed: the (host) result, ``QueryRejected``, or
+    ``DeadlineExceeded`` — never a hang (deadline propagation + the
+    coordinator's global join watchdog) and never an untyped error.
+
+    ``idempotent`` declares the query safe to re-dispatch to a survivor
+    if its replica dies mid-flight (true for everything lineage-replayable
+    from the manifest, which is every catalog op); non-idempotent queries
+    surface ``QueryRejected(reason="replica_lost")`` instead.
+    """
+    from modin_tpu.fleet import queries as _queries
+
+    fn = _queries.resolve(query)
+    if label is None and isinstance(query, str):
+        label = query
+    if FLEET_ON and _coordinator is not None:
+        return _coordinator.submit(
+            str(dataset),
+            fn,
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            tenant=tenant,
+            deadline_ms=deadline_ms,
+            label=label,
+            idempotent=idempotent,
+        )
+    frame = _local_frames.get(str(dataset))
+    if frame is None:
+        from modin_tpu.serving.errors import QueryRejected
+
+        raise QueryRejected(
+            f"no dataset {dataset!r} registered", reason="unknown_dataset"
+        )
+    from modin_tpu.serving import gate as _gate
+
+    return _gate.submit(
+        fn,
+        frame,
+        *args,
+        tenant=tenant,
+        deadline_ms=deadline_ms,
+        label=label,
+        **kwargs,
+    )
+
+
+def fleet_snapshot() -> dict:
+    """Introspection: enabled flag + the coordinator's replica table
+    (empty when no coordinator lives in this process)."""
+    snap = {
+        "enabled": FLEET_ON,
+        "active": _coordinator is not None,
+        "alloc_count": _alloc_count,
+        "local_datasets": sorted(_local_frames),
+    }
+    if _coordinator is not None:
+        snap.update(_coordinator.snapshot())
+    return snap
+
+
+def reset_for_tests() -> None:
+    """Tear down any fleet and clear the local working set (alloc counter
+    intentionally survives: it counts a process's lifetime allocations)."""
+    stop_fleet()
+    _local_frames.clear()
+
+
+def _on_fleet_enabled(param: Any) -> None:
+    global FLEET_ON
+    FLEET_ON = bool(param.get())
+
+
+from modin_tpu.config import FleetEnabled as _FleetEnabled  # noqa: E402
+
+_FleetEnabled.subscribe(_on_fleet_enabled)

@@ -1,0 +1,257 @@
+"""graftfleet replica: one supervised serving process.
+
+Runnable as ``python -m modin_tpu.fleet.replica``; only the coordinator
+spawns it.  The contract with the coordinator, all over the wire protocol
+(fleet/wire.py):
+
+1. **Hello.**  Connect the control socket to
+   ``MODIN_TPU_FLEET_COORD`` and announce ``{index, generation, pid,
+   rpc_port, watch_port}``.  The RPC port is bound ephemeral here; the
+   watch exporter's port was forced ephemeral by the coordinator
+   (``MODIN_TPU_WATCH_PORT=0`` in the spawn env) and the *bound* port is
+   read back live — two replicas on one host can never collide on a
+   user-pinned fixed port.
+2. **Heartbeats.**  A daemon thread sends ``{shed_rate, gate counters}``
+   every ``MODIN_TPU_FLEET_HEARTBEAT_S`` on the control socket.  The
+   shed rate is the admission gate's windowed typed-shed rate — the
+   backpressure signal the coordinator weighs redistribution by.  A dead
+   control socket means the coordinator is gone: the replica exits
+   rather than serve unsupervised.
+3. **RPC.**  Connection-per-request on the ephemeral RPC listener:
+   ``ping`` (liveness probe), ``warm`` (dataset-manifest replay through
+   the public readers + graftview artifact ingest), ``query`` (run one
+   catalog/pickled query through the local ``serving.submit`` with the
+   coordinator's remaining deadline), ``export_views`` (artifact export
+   for warming a respawned peer), ``snapshot``, ``shutdown``.
+
+Every query outcome crossing the wire is typed: a result payload, a
+serialized ``QueryRejected``/``DeadlineExceeded``, or — for an escaped
+untyped error, itself a contract violation — an ``internal`` record the
+coordinator surfaces as a typed rejection.  The replica never answers a
+query with silence; silence is what the coordinator's failure detection
+is for.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from modin_tpu.fleet import wire
+
+#: dataset name -> warmed frame (this process's serving working set)
+_frames: Dict[str, Any] = {}
+_frames_lock = threading.Lock()
+
+#: serialized control-socket writes (hello/heartbeat share one socket)
+_control_lock = threading.Lock()
+_control_sock: Optional[socket.socket] = None
+
+
+def _watch_port() -> int:
+    """The watch exporter's live bound port (-1 when not serving)."""
+    try:
+        from modin_tpu.observability import watch
+
+        port = watch.httpd_port()
+        return int(port) if port is not None else -1
+    except Exception:
+        return -1
+
+
+def _heartbeat_loop(index: int, generation: int) -> None:
+    import time
+
+    from modin_tpu.config import FleetHeartbeatS
+    from modin_tpu.serving.gate import gate
+
+    while True:
+        time.sleep(float(FleetHeartbeatS.get()))
+        snap = gate.snapshot()
+        beat = {
+            "type": "heartbeat",
+            "index": index,
+            "generation": generation,
+            "shed_rate": snap["shed_rate"],
+            "running": snap["running"],
+            "shed": snap["shed"],
+            "admitted": snap["admitted"],
+            "completed": snap["completed"],
+            "watch_port": _watch_port(),
+        }
+        try:
+            with _control_lock:
+                wire.send_msg(_control_sock, beat)
+        except wire.WireError:
+            os._exit(0)  # coordinator gone: never serve unsupervised
+
+
+def _run_query(req: dict) -> dict:
+    from modin_tpu.serving import gate as gate_mod
+    from modin_tpu.serving.errors import DeadlineExceeded, QueryRejected
+
+    with _frames_lock:
+        frame = _frames.get(req["dataset"])
+    if frame is None:
+        return {
+            "ok": False,
+            "error": "rejected",
+            "message": f"replica has no dataset {req['dataset']!r}",
+            "reason": "unknown_dataset",
+            "retry_after_s": None,
+        }
+    try:
+        result = gate_mod.submit(
+            req["fn"],
+            frame,
+            *req.get("args", ()),
+            tenant=req.get("tenant", "default"),
+            deadline_ms=req.get("deadline_ms"),
+            label=req.get("label"),
+            **req.get("kwargs", {}),
+        )
+        return {"ok": True, "result": result}
+    except QueryRejected as err:
+        return {
+            "ok": False,
+            "error": "rejected",
+            "message": str(err),
+            "reason": err.reason,
+            "retry_after_s": err.retry_after_s,
+        }
+    except DeadlineExceeded as err:
+        return {
+            "ok": False,
+            "error": "deadline",
+            "message": str(err),
+            "deadline_s": err.deadline_s,
+            "where": err.where,
+        }
+    except Exception as err:
+        # an untyped error is a contract bug, but the wire answer must
+        # still be typed, never silence
+        return {
+            "ok": False,
+            "error": "internal",
+            "message": f"{type(err).__name__}: {err}"[:500],
+        }
+
+
+def _handle_request(req: dict) -> dict:
+    kind = req.get("type")
+    if kind == "ping":
+        return {"ok": True, "pid": os.getpid(), "datasets": sorted(_frames)}
+    if kind == "warm":
+        if os.environ.get("MODIN_TPU_FLEET_TEST_CRASH") == "warm":
+            os._exit(3)  # ReplicaFaultInjector crash-during-respawn leg
+        from modin_tpu.core.execution import recovery
+        from modin_tpu.views import exporter as view_exporter
+
+        frames = recovery.warm_from_manifest(req.get("manifest", []))
+        with _frames_lock:
+            _frames.update(frames)
+        ingested = view_exporter.ingest_datasets(
+            _frames, req.get("views") or {}
+        )
+        return {
+            "ok": True,
+            "datasets": sorted(_frames),
+            "views_ingested": ingested,
+        }
+    if kind == "query":
+        return _run_query(req)
+    if kind == "export_views":
+        from modin_tpu.views import exporter as view_exporter
+
+        with _frames_lock:
+            frames = dict(_frames)
+        return {"ok": True, "views": view_exporter.export_datasets(frames)}
+    if kind == "snapshot":
+        from modin_tpu.serving.gate import serving_snapshot
+
+        snap = {"ok": True, "serving": serving_snapshot()}
+        try:
+            from modin_tpu.observability import meters
+
+            snap["meters"] = meters.snapshot()
+        except Exception:
+            pass
+        return snap
+    if kind == "shutdown":
+        return {"ok": True, "bye": True}
+    return {"ok": False, "error": "internal", "message": f"unknown rpc {kind!r}"}
+
+
+def _serve_connection(conn: socket.socket) -> None:
+    try:
+        conn.settimeout(30.0)
+        req = wire.recv_msg(conn)
+        conn.settimeout(None)
+        reply = _handle_request(req)
+        wire.send_msg(conn, reply)
+        if reply.get("bye"):
+            conn.close()
+            os._exit(0)
+    except wire.WireError:
+        pass  # the peer (or its query) died; nothing to answer
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def main() -> int:
+    global _control_sock
+
+    coord = os.environ["MODIN_TPU_FLEET_COORD"]
+    index = int(os.environ["MODIN_TPU_FLEET_INDEX"])
+    generation = int(os.environ.get("MODIN_TPU_FLEET_GEN", "0"))
+    host, _, port_text = coord.rpartition(":")
+
+    # Build the serving substrate BEFORE hello: "hello" means "ready".
+    import modin_tpu.pandas  # noqa: F401
+
+    rpc = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    rpc.bind(("127.0.0.1", 0))
+    rpc.listen(64)
+    rpc_port = rpc.getsockname()[1]
+
+    _control_sock = wire.connect(host, int(port_text), timeout=10.0)
+    _control_sock.settimeout(None)
+    with _control_lock:
+        wire.send_msg(
+            _control_sock,
+            {
+                "type": "hello",
+                "index": index,
+                "generation": generation,
+                "pid": os.getpid(),
+                "rpc_port": rpc_port,
+                "watch_port": _watch_port(),
+            },
+        )
+    threading.Thread(
+        target=_heartbeat_loop,
+        args=(index, generation),
+        name=f"modin-tpu-fleet-heartbeat-{index}",
+        daemon=True,
+    ).start()
+
+    while True:
+        try:
+            conn, _addr = rpc.accept()
+        except OSError:
+            return 0
+        threading.Thread(
+            target=_serve_connection,
+            args=(conn,),
+            name=f"modin-tpu-fleet-rpc-{index}",
+            daemon=True,
+        ).start()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

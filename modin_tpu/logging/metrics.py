@@ -517,6 +517,69 @@ METRICS = (
         "HTTP requests served by the graftwatch live exporter "
         "(/metrics, /statusz, /debug/queries)",
     ),
+    (
+        "view.export",
+        "counter",
+        "graftview artifacts exported for a respawning fleet replica "
+        "(host-state records a survivor hands the coordinator)",
+    ),
+    (
+        "view.ingest",
+        "counter",
+        "graftview artifacts ingested by a re-warming fleet replica "
+        "(warm derived answers restored without recomputation)",
+    ),
+    (
+        "fleet.replica.spawn",
+        "counter",
+        "graftfleet replica processes spawned (initial fleet start and "
+        "every respawn generation)",
+    ),
+    (
+        "fleet.replica.lost",
+        "counter",
+        "graftfleet replicas declared lost — by process exit, heartbeat "
+        "silence with a failed liveness probe, or a dead socket under a "
+        "dispatched query",
+    ),
+    (
+        "fleet.replica.heartbeat_miss",
+        "counter",
+        "graftfleet heartbeat-age trips (~3 intervals silent); each one "
+        "triggers a fresh-dial liveness probe before any loss verdict",
+    ),
+    (
+        "fleet.replica.respawned",
+        "counter",
+        "graftfleet replicas respawned and re-warmed (manifest replay + "
+        "graftview artifact ingest) back to routable",
+    ),
+    (
+        "fleet.query.routed",
+        "counter",
+        "graftfleet queries dispatched to a replica and joined to a typed "
+        "outcome",
+    ),
+    (
+        "fleet.query.redispatch",
+        "counter",
+        "graftfleet in-flight queries re-dispatched to a survivor after "
+        "their replica died mid-query (idempotent-by-lineage only)",
+    ),
+    (
+        "fleet.drain.redistributed",
+        "counter",
+        "graftfleet tenants drained off a lost replica and reassigned "
+        "weighted-fair across survivors (value = tenants moved; survivor "
+        "typed-shed rate is the backpressure weight)",
+    ),
+    (
+        "fleet.warm.dataset",
+        "counter",
+        "graftfleet datasets re-warmed from the recovery manifest through "
+        "the public readers (io lineage / spans / cost accounting all see "
+        "the replay)",
+    ),
 )
 
 

@@ -266,6 +266,44 @@ class WatchService:
                     f"{row.get('breaker', '?')} | {latency} | {slo_txt}"
                 )
 
+        def _fleet_section() -> None:
+            import sys as _sys
+
+            fleet_mod = _sys.modules.get("modin_tpu.fleet")
+            if fleet_mod is None or not fleet_mod.FLEET_ON:
+                lines.append("  fleet not active in this process")
+                return
+            coordinator = fleet_mod.get_coordinator()
+            if coordinator is None:
+                lines.append(
+                    "  fleet enabled, no coordinator here (replica process)"
+                )
+                return
+            snap = coordinator.snapshot()
+            lines.append(
+                "  replica | state | gen | pid | rpc_port | watch_port | "
+                "tenants | in_flight | shed_rate | p50/p99 (ms)"
+            )
+            for row in snap["replicas"]:
+                latency = (
+                    f"{row['p50_ms']:.1f}/{row['p99_ms']:.1f}"
+                    if row["p50_ms"] is not None
+                    else "?"
+                )
+                lines.append(
+                    f"  {row['index']} | {row['state']} | {row['generation']}"
+                    f" | {row['pid']} | {row['rpc_port']} | "
+                    f"{row['watch_port']} | {row['tenants']} | "
+                    f"{row['in_flight']} | {row['shed_rate']:.2f}/s | "
+                    f"{latency}"
+                )
+            lines.append(
+                f"  routed={snap['routed']} "
+                f"redispatched={snap['redispatched']} "
+                f"lost={snap['lost']} respawned={snap['respawned']} "
+                f"tenants_redistributed={snap['redistributed']}"
+            )
+
         def _trips_section() -> None:
             recent = self.tripwires.snapshot()
             if not recent:
@@ -282,6 +320,7 @@ class WatchService:
         section("windowed rates", _rates_section)
         section("admission gate", _gate_section)
         section("tenants", _tenants_section)
+        section("fleet", _fleet_section)
         section("recent tripwires", _trips_section)
         return "\n".join(lines)
 

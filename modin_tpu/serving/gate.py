@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Optional
 
 from modin_tpu.logging.metrics import emit_metric
@@ -142,6 +143,10 @@ class AdmissionGate:
         self.shed = 0
         self.degraded_count = 0
         self.completed = 0
+        # recent shed timestamps (monotonic): the windowed typed-shed rate
+        # graftfleet uses as its backpressure signal when redistributing
+        # drained tenants across survivors
+        self._shed_times: deque = deque(maxlen=256)
 
     # -- config ---------------------------------------------------------- #
 
@@ -208,6 +213,7 @@ class AdmissionGate:
         # under it); only the counter bump takes it
         with self._lock:
             self.shed += 1
+            self._shed_times.append(time.monotonic())
         emit_metric("serving.shed", 1)
         emit_metric(f"serving.tenant.{_tenants.sanitize(tenant)}.{reason}", 1)
         _tenants.registry.note_shed(tenant)
@@ -367,6 +373,18 @@ class AdmissionGate:
 
     # -- introspection --------------------------------------------------- #
 
+    def _shed_rate_locked(self, window_s: float = 5.0) -> float:
+        """Typed sheds per second over the trailing window (caller holds
+        the lock).  This is the routable backpressure signal graftfleet
+        weighs survivors by when redistributing drained tenants."""
+        cutoff = time.monotonic() - window_s
+        recent = sum(1 for t in self._shed_times if t >= cutoff)
+        return recent / window_s
+
+    def shed_rate(self, window_s: float = 5.0) -> float:
+        with self._lock:
+            return self._shed_rate_locked(window_s)
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -377,6 +395,7 @@ class AdmissionGate:
                 "admitted": self.admitted,
                 "ever_queued": self.queued,
                 "shed": self.shed,
+                "shed_rate": self._shed_rate_locked(),
                 "degraded": self.degraded_count,
                 "completed": self.completed,
                 "max_concurrent": self._max_concurrent(),
@@ -392,6 +411,7 @@ class AdmissionGate:
             self._seq = 0
             self.admitted = self.queued = self.shed = 0
             self.degraded_count = self.completed = 0
+            self._shed_times.clear()
 
 
 gate = AdmissionGate()
@@ -424,6 +444,16 @@ def serving_snapshot() -> dict:
     snap["tenants"] = _tenants.registry.snapshot()
     if _watch.WATCH_ON:
         snap["slo"] = _watch.slo_health()
+    # coordinator-aware: with a graftfleet coordinator live in THIS process
+    # the replica table rides along (sys.modules probe — reading a snapshot
+    # must never import, let alone start, the fleet machinery)
+    import sys as _sys
+
+    _fleet = _sys.modules.get("modin_tpu.fleet")
+    if _fleet is not None and _fleet.FLEET_ON:
+        coordinator = _fleet.get_coordinator()
+        if coordinator is not None:
+            snap["fleet"] = coordinator.snapshot()
     return snap
 
 

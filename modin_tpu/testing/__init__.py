@@ -4,6 +4,7 @@ from modin_tpu.testing.faults import (  # noqa: F401
     FaultInjector,
     MixedFaultInjector,
     OomBurstInjector,
+    ReplicaFaultInjector,
     SequencedFaultInjector,
     concurrent_chaos,
     inject_faults,

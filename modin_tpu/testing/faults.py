@@ -373,3 +373,72 @@ def concurrent_chaos(
 ) -> MixedFaultInjector:
     """Sugar for ``MixedFaultInjector(...)`` — see its docstring."""
     return MixedFaultInjector(kinds=kinds, ops=ops, period=period, times=times)
+
+
+# ---------------------------------------------------------------------- #
+# process-level injectors (the graftfleet replica chaos suite)
+# ---------------------------------------------------------------------- #
+
+
+class ReplicaFaultInjector:
+    """Kill, wedge, and re-crash live graftfleet replicas on demand.
+
+    Unlike the engine-seam injectors above, these faults are real OS
+    signals against real supervised processes — the fleet's failure
+    detection has to earn every leg:
+
+    - :meth:`kill` — SIGKILL (``kill -9``): the process-exit and
+      dead-socket-on-dispatch detection legs;
+    - :meth:`hang` — SIGSTOP: the process freezes with its sockets still
+      connected (the kernel keeps accepting on its backlog), so only the
+      heartbeat-age + liveness-probe-timeout leg can catch it;
+    - :meth:`resume` — SIGCONT, for tests that un-wedge a survivor;
+    - :meth:`crash_next_respawn` — arm a one-shot crash *inside the next
+      respawned replica's warm RPC* (``os._exit(3)`` before any dataset
+      loads), proving the coordinator survives a respawn that itself
+      dies and retries the slot on the following monitor tick.
+
+        inj = ReplicaFaultInjector(coordinator)
+        inj.kill(1)          # replica 1 dies mid-query
+        inj.hang(0)          # replica 0 wedges; probe timeout declares it
+    """
+
+    def __init__(self, coordinator: Any):
+        self.coordinator = coordinator
+
+    def _pid(self, index: int) -> int:
+        rep = self.coordinator._replicas[index]
+        if rep.pid is None:
+            raise RuntimeError(f"replica {index} has no live process")
+        return rep.pid
+
+    def kill(self, index: int) -> int:
+        """SIGKILL replica ``index``; returns the pid it killed."""
+        import os
+        import signal as _signal
+
+        pid = self._pid(index)
+        os.kill(pid, _signal.SIGKILL)
+        return pid
+
+    def hang(self, index: int) -> int:
+        """SIGSTOP replica ``index`` (socket stays up, process wedges)."""
+        import os
+        import signal as _signal
+
+        pid = self._pid(index)
+        os.kill(pid, _signal.SIGSTOP)
+        return pid
+
+    def resume(self, index: int) -> int:
+        """SIGCONT replica ``index`` (undo :meth:`hang`)."""
+        import os
+        import signal as _signal
+
+        pid = self._pid(index)
+        os.kill(pid, _signal.SIGCONT)
+        return pid
+
+    def crash_next_respawn(self) -> None:
+        """Arm a one-shot crash in the next respawn's warm RPC."""
+        self.coordinator._test_crash_next_respawn = True

@@ -1,0 +1,133 @@
+"""graftview export/ingest: derived artifacts across process boundaries.
+
+The registry (views/registry.py) keys artifacts by in-process identity —
+view token, buffer id, device epoch — none of which survive a process
+death.  graftfleet's warm-state recovery needs the *answers* to survive:
+when a replica dies and respawns, the coordinator re-warms its datasets
+from the manifest (core/execution/recovery.py) and then replays a healthy
+survivor's host-state artifacts onto the fresh frames, so the respawned
+replica's first queries hit warm instead of re-paying every reduction.
+
+Export is positional: an artifact is shipped as (column position, kind,
+params, length, state) with NO token/buffer/epoch stamps — those are
+minted fresh by ``registry.store`` on the ingesting side, against the
+ingesting process's own columns.  Only host-state artifacts whose state
+pickles travel; device payloads (sorted reps) rebuild on demand exactly
+as they do after a ledger drop.  Length is re-checked at ingest: a
+mismatched frame (the dataset changed between export and ingest) skips
+the artifact rather than caching a wrong answer.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List
+
+from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.views import registry
+
+
+def _frame_columns(frame: Any) -> List[Any]:
+    """The DeviceColumns of a modin_tpu frame (empty for non-TPU frames)."""
+    try:
+        mf = frame._query_compiler._modin_frame
+        return [mf.get_column(i) for i in range(mf.num_cols)]
+    except Exception:
+        return []
+
+
+def export_artifacts(frame: Any) -> List[dict]:
+    """Picklable snapshot of ``frame``'s live host-state artifacts.
+
+    One record per exportable artifact: ``{"col": position, "kind": ...,
+    "params": ..., "length": ..., "state": ..., "can_fold": ...,
+    "host_bytes": ...}``.  Artifacts with a device payload, no host
+    state, or unpicklable state are skipped — they rebuild on demand.
+    """
+    records: List[dict] = []
+    cols = _frame_columns(frame)
+    with registry.LOCK:
+        for pos, col in enumerate(cols):
+            tok = getattr(col, "_view_token", None)
+            if tok is None:
+                continue
+            for key in registry._by_token.get(tok, ()):
+                art = registry._entries.get(key)
+                if art is None or not art.live or art.state is None:
+                    continue
+                if art._payload is not None:
+                    continue  # device payloads rebuild; they never travel
+                state = art.state
+                if isinstance(state, dict) and (
+                    "idents" in state or "host_guards" in state
+                ):
+                    # column identities (buffer ids, weakref guards) are
+                    # process-local: ship the ADOPT sentinel instead and
+                    # let the consuming layer re-stamp them on its first
+                    # exact-length hit (registry.ADOPT_IDENTS)
+                    state = dict(state)
+                    state["idents"] = registry.ADOPT_IDENTS
+                    state["host_guards"] = ()
+                record = {
+                    "col": pos,
+                    "kind": art.kind,
+                    "params": art.params,
+                    "length": art.length,
+                    "state": state,
+                    "can_fold": art.can_fold,
+                    "host_bytes": art.host_bytes,
+                }
+                try:
+                    pickle.dumps(record)
+                except Exception:
+                    continue  # e.g. a device array inside the state dict
+                records.append(record)
+    emit_metric("view.export", len(records))
+    return records
+
+
+def ingest_artifacts(frame: Any, records: List[dict]) -> int:
+    """Replay exported ``records`` onto ``frame``'s columns.
+
+    Returns how many artifacts were stored.  Records whose column
+    position or length does not match the local frame are skipped — an
+    exported answer must never be cached against different data.
+    """
+    cols = _frame_columns(frame)
+    ingested = 0
+    for record in records:
+        pos = record["col"]
+        if pos >= len(cols):
+            continue
+        col = cols[pos]
+        if int(record["length"]) != int(col.length):
+            continue
+        if registry.store(
+            col,
+            record["kind"],
+            record["params"],
+            record["state"],
+            can_fold=record.get("can_fold", False),
+            host_bytes=int(record.get("host_bytes", 0)),
+        ):
+            ingested += 1
+    if ingested:
+        emit_metric("view.ingest", ingested)
+    return ingested
+
+
+def export_datasets(frames: Dict[str, Any]) -> Dict[str, List[dict]]:
+    """``{dataset: records}`` export over a whole dataset map."""
+    return {name: export_artifacts(frame) for name, frame in frames.items()}
+
+
+def ingest_datasets(
+    frames: Dict[str, Any], exported: Dict[str, List[dict]]
+) -> int:
+    """Ingest a multi-dataset export; returns the total stored count."""
+    total = 0
+    for name, records in exported.items():
+        frame = frames.get(name)
+        if frame is not None:
+            total += ingest_artifacts(frame, records)
+    return total

@@ -220,6 +220,17 @@ def groupby_consult(
     outcome, state, _base = registry.lookup(anchor, _KIND, fp)
     if (
         outcome == "hit"
+        and state.get("idents") == registry.ADOPT_IDENTS
+        and state.get("n") == len(qc._modin_frame)
+    ):
+        # an ingested cross-process artifact (views/exporter.py): adopt
+        # this process's column identities on the first exact-length hit
+        # — a deliberate in-place rewrite (idempotent: every adopter
+        # computes the same values for the same live frame)
+        state["idents"] = idents
+        state["host_guards"] = _host_guards(qc, positions)
+    if (
+        outcome == "hit"
         and state.get("idents") == idents
         and _host_guards_hold(qc, positions, state.get("host_guards"))
     ):

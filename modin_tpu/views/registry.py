@@ -72,6 +72,12 @@ from modin_tpu.logging.metrics import emit_metric
 #: sorted-rep shim re-enters through the same invalidation hooks)
 LOCK = threading.RLock()
 
+#: sentinel an exported artifact's state carries in place of its
+#: process-local column identities (views/exporter.py strips them — ids
+#: and weakrefs don't cross a process); the consuming cache layer adopts
+#: the ingesting process's own identities on the first exact-length hit
+ADOPT_IDENTS = "__adopt__"
+
 _token_counter = 0
 
 #: (token, kind, params) -> DerivedArtifact, insertion order = LRU

@@ -40,6 +40,7 @@ EXPECTED_SECTIONS = (
     "spmd",
     "shuffle_apply_virtual_mesh",
     "oocore",
+    "fleet",
 )
 
 SMOKE_ENV = {
@@ -64,6 +65,11 @@ SMOKE_ENV = {
     "BENCH_OOCORE_ROWS": "60000",
     "BENCH_SERVING_ROWS": "150000",
     "BENCH_SERVING_QUERIES": "24",
+    # two replica processes each import the full stack (~5s); keep the
+    # workload small so the section is dominated by the fleet mechanics
+    # (routing, kill, MTTR) it exists to time
+    "BENCH_FLEET_ROWS": "60000",
+    "BENCH_FLEET_QUERIES": "10",
     # same reasoning as the recovery overhead: the 5% graftwatch telemetry
     # budget belongs to full-scale runs, a ~5ms admitted p50 flakes on noise
     "BENCH_WATCH_OVERHEAD_PCT": "100",

@@ -59,6 +59,13 @@
 #       SLO burn tripwire must fire, and exactly ONE rate-limited
 #       evidence bundle (trace segment + meter snapshot + ring excerpt +
 #       SLO health) must land in MODIN_TPU_TRACE_DIR
+#   0l. graftfleet smoke: a 3-replica serving fleet must route a mixed
+#       multi-tenant workload bit-exactly, survive kill -9 of a replica
+#       mid-query with ZERO hangs (every query bit-exact or a typed
+#       rejection), redistribute the drained tenants onto survivors,
+#       respawn the dead slot warm (manifest re-read + graftview
+#       artifact ingest), and ride out a crash-during-respawn; disabled
+#       mode must be a bit-for-bit passthrough with zero allocations
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -94,6 +101,7 @@ run_gate "graftmesh"       python scripts/spmd_smoke.py
 run_gate "graftstream"     python scripts/oocore_smoke.py
 run_gate "graftview"       python scripts/views_smoke.py
 run_gate "graftwatch"      python scripts/watch_smoke.py
+run_gate "graftfleet"      python scripts/fleet_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -103,4 +111,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL SIXTEEN GATES GREEN"
+echo "ALL SEVENTEEN GATES GREEN"

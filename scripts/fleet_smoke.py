@@ -1,0 +1,313 @@
+"""graftfleet smoke gate: a replicated serving fleet under replica loss.
+
+Run by scripts/check_all.sh (the seventeenth gate).  On the 8-device
+virtual CPU mesh it asserts, end to end:
+
+1. fleet DISABLED (the default): ``fleet.submit`` is a bit-for-bit
+   passthrough to the local serving path — zero fleet allocations
+   (``fleet_alloc_count() == 0``), zero fleet threads, answers identical
+   to pandas;
+2. a 3-replica fleet routes a mixed multi-tenant workload with every
+   answer bit-exact vs pandas;
+3. kill -9 of one replica under concurrent multi-tenant load: ZERO hung
+   queries (every submit returns a result or a typed
+   ``QueryRejected``/``DeadlineExceeded`` within the join watchdog), the
+   drained tenants keep completing on the survivors, and the meter
+   snapshot shows ``fleet.replica.lost`` / ``fleet.drain.redistributed``
+   / ``fleet.replica.respawned``;
+4. the respawned replica re-warmed from the dataset manifest AND
+   ingested a survivor's exported graftview artifacts (``view.ingest``
+   in ITS meter snapshot; a direct query hits warm);
+5. crash-during-respawn (the replica dies again inside its warm RPC):
+   the slot survives the failed attempt and the next one succeeds.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import os
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "1"  # replicas inherit: bit-exact vs pandas
+os.environ["MODIN_TPU_METERS"] = "1"
+os.environ["MODIN_TPU_SERVING"] = "1"
+os.environ["MODIN_TPU_FLEET_REPLICAS"] = "3"
+os.environ["MODIN_TPU_FLEET_HEARTBEAT_S"] = "0.3"
+# MODIN_TPU_FLEET stays UNSET: leg 1 asserts the default-off path
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pandas  # noqa: E402
+
+ROWS = int(os.environ.get("FLEET_SMOKE_ROWS", 40_000))
+QUERIES_PER_TENANT = int(os.environ.get("FLEET_SMOKE_QPT", 30))
+TENANTS = [f"t{i}" for i in range(6)]
+
+
+def _expected(pdf):
+    return {
+        "sum": pdf.sum(),
+        "count": pdf.count(),
+        "min": pdf.min(),
+        "max": pdf.max(),
+        "groupby_sum": pdf.groupby("k").sum(),
+        "filter_sum": pdf[pdf["i"] > 0].sum(),
+    }
+
+
+def _check(got, expect, what):
+    import pandas.testing as pt
+
+    got = got._to_pandas() if hasattr(got, "_to_pandas") else got
+    if isinstance(expect, pandas.DataFrame):
+        pt.assert_frame_equal(got, expect)
+    elif isinstance(expect, pandas.Series):
+        pt.assert_series_equal(got, expect)
+    else:
+        assert got == expect, (what, got, expect)
+
+
+def _fleet_threads():
+    return [
+        t.name
+        for t in threading.enumerate()
+        if t.name.startswith("modin-tpu-fleet")
+    ]
+
+
+def _wait(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for {what}")
+
+
+def main() -> int:
+    import tempfile
+
+    import modin_tpu.fleet as fleet
+    from modin_tpu.config import FleetEnabled
+    from modin_tpu.fleet import queries as fleet_queries
+    from modin_tpu.observability import meters
+    from modin_tpu.serving.errors import DeadlineExceeded, QueryRejected
+    from modin_tpu.testing import ReplicaFaultInjector
+
+    rng = np.random.default_rng(11)
+    pdf = pandas.DataFrame(
+        {
+            "k": rng.integers(0, 9, ROWS).astype(np.int64),
+            "i": rng.normal(size=ROWS),
+            "j": rng.integers(0, 1000, ROWS).astype(np.int64),
+        }
+    )
+    tmpdir = tempfile.mkdtemp(prefix="fleet_smoke_")
+    csv_path = os.path.join(tmpdir, "ds.csv")
+    pdf.to_csv(csv_path, index=False)
+    expect = _expected(pandas.read_csv(csv_path))
+    mixed = list(expect)
+
+    # ---- leg 1: fleet disabled (default) — bit-exact, zero overhead ---- #
+    assert not fleet.FLEET_ON, "MODIN_TPU_FLEET leaked on"
+    fleet.register_dataset("ds", "read_csv", csv_path)
+    for name in mixed:
+        _check(fleet.submit("ds", name, tenant="t0"), expect[name], name)
+    assert fleet.fleet_alloc_count() == 0, (
+        f"fleet-off path allocated fleet objects: {fleet.fleet_alloc_count()}"
+    )
+    assert not _fleet_threads(), f"fleet-off threads: {_fleet_threads()}"
+    print("fleet_smoke: disabled-mode passthrough (bit-exact, 0 allocs) OK")
+
+    # ---- leg 2: 3-replica fleet, mixed multi-tenant load, bit-exact ---- #
+    FleetEnabled.put(True)
+    coord = fleet.start_fleet()
+    fleet.register_dataset("ds", "read_csv", csv_path)
+    for k, tenant in enumerate(TENANTS):
+        for name in mixed:
+            _check(
+                fleet.submit("ds", name, tenant=tenant), expect[name],
+                f"{tenant}:{name}",
+            )
+    snap = coord.snapshot()
+    assert len(snap["replicas"]) == 3
+    assert all(r["state"] == "up" for r in snap["replicas"]), snap["replicas"]
+    ports = [r["watch_port"] for r in snap["replicas"]]
+    rpc_ports = [r["rpc_port"] for r in snap["replicas"]]
+    assert len(set(rpc_ports)) == 3, f"rpc port collision: {rpc_ports}"
+    # the fixed-port collision fix: every replica bound its watch
+    # exporter ephemeral and reported the live port back
+    assert all(p > 0 for p in ports) and len(set(ports)) == 3, (
+        f"watch port collision or unreported: {ports}"
+    )
+    print(
+        f"fleet_smoke: 3-replica routed load bit-exact OK "
+        f"(rpc={rpc_ports}, watch={ports})"
+    )
+
+    # ---- leg 3: kill -9 mid-query under load — zero hangs, typed ------- #
+    inj = ReplicaFaultInjector(coord)
+    assignments = coord.snapshot()["assignments"]
+    by_replica = {}
+    for tenant, idx in assignments.items():
+        by_replica.setdefault(idx, []).append(tenant)
+    victim = max(by_replica, key=lambda idx: len(by_replica[idx]))
+    drained = sorted(by_replica[victim])
+    assert drained, f"victim replica {victim} had no tenants: {assignments}"
+
+    kill_event = threading.Event()
+    errors: list = []
+    after_kill_ok = {t: 0 for t in TENANTS}
+    typed = {"rejected": 0, "deadline": 0}
+    lock = threading.Lock()
+
+    def storm(tenant):
+        for k in range(QUERIES_PER_TENANT):
+            name = mixed[k % len(mixed)]
+            try:
+                got = fleet.submit("ds", name, tenant=tenant)
+                _check(got, expect[name], f"{tenant}:{name}")
+                if kill_event.is_set():
+                    with lock:
+                        after_kill_ok[tenant] += 1
+            except QueryRejected:
+                with lock:
+                    typed["rejected"] += 1
+            except DeadlineExceeded:
+                with lock:
+                    typed["deadline"] += 1
+            except Exception as err:  # noqa: BLE001 -- any OTHER escape is the bug this gate exists to catch
+                with lock:
+                    errors.append(f"{tenant}:{name}: {type(err).__name__}: {err}")
+
+    threads = [
+        threading.Thread(target=storm, args=(t,), daemon=True) for t in TENANTS
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)  # let queries go in flight
+    killed_pid = inj.kill(victim)
+    kill_event.set()
+    join_deadline = time.monotonic() + 180.0
+    for t in threads:
+        t.join(timeout=max(join_deadline - time.monotonic(), 1.0))
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"HUNG query threads after kill -9: {hung}"
+    assert not errors, "untyped failures: " + "; ".join(errors[:5])
+    for tenant in drained:
+        assert after_kill_ok[tenant] > 0, (
+            f"drained tenant {tenant} never completed on a survivor "
+            f"(after-kill completions: {after_kill_ok})"
+        )
+    _wait(
+        lambda: coord.snapshot()["respawned"] >= 1
+        and all(r["state"] == "up" for r in coord.snapshot()["replicas"]),
+        120.0,
+        "replica respawn",
+    )
+    series = meters.snapshot()["series"]
+    for family in (
+        "fleet.replica.lost",
+        "fleet.replica.respawned",
+        "fleet.drain.redistributed",
+        "fleet.query.routed",
+    ):
+        total = series.get(family, {}).get("total", 0)
+        assert total > 0, f"{family} missing from the meter snapshot"
+    print(
+        f"fleet_smoke: kill -9 (pid {killed_pid}) under load OK — 0 hangs, "
+        f"{sum(after_kill_ok.values())} post-kill completions, "
+        f"typed={typed}, drained {drained} all completed on survivors"
+    )
+
+    # ---- leg 4: warm graftview artifacts survived the respawn ---------- #
+    rep = coord._replicas[victim]
+    reply = coord._call(rep, {"type": "snapshot"}, timeout=30.0)
+    rep_series = reply.get("meters", {}).get("series", {})
+    ingested = rep_series.get("view.ingest", {}).get("total", 0)
+    assert ingested > 0, (
+        f"respawned replica {victim} ingested no graftview artifacts: "
+        f"{sorted(k for k in rep_series if k.startswith('view.'))}"
+    )
+    hits_before = rep_series.get("view.hit", {}).get("total", 0)
+    direct = coord._call(
+        rep,
+        {
+            "type": "query",
+            "dataset": "ds",
+            "fn": fleet_queries.QUERIES["groupby_sum"],
+            "args": (),
+            "kwargs": {"key": "k"},
+            "tenant": "t0",
+            "deadline_ms": None,
+            "label": "warm_check",
+        },
+        timeout=60.0,
+    )
+    assert direct.get("ok"), direct
+    _check(direct["result"], expect["groupby_sum"], "respawned:groupby_sum")
+    reply2 = coord._call(rep, {"type": "snapshot"}, timeout=30.0)
+    hits_after = (
+        reply2.get("meters", {}).get("series", {})
+        .get("view.hit", {}).get("total", 0)
+    )
+    assert hits_after > hits_before, (
+        f"respawned replica answered cold (view.hit {hits_before} -> "
+        f"{hits_after}) — the export/ingest seam did not warm it"
+    )
+    print(
+        f"fleet_smoke: respawn warm-state OK — {ingested} artifacts "
+        f"ingested, direct re-query hit warm ({hits_before} -> {hits_after})"
+    )
+
+    # ---- leg 5: crash-during-respawn — the slot survives and retries --- #
+    inj.crash_next_respawn()
+    victim2 = next(
+        r["index"] for r in coord.snapshot()["replicas"] if r["state"] == "up"
+    )
+    inj.kill(victim2)
+    _wait(
+        lambda: coord.snapshot()["respawn_failures"] >= 1,
+        120.0,
+        "the armed warm-crash to fail one respawn attempt",
+    )
+    _wait(
+        lambda: all(r["state"] == "up" for r in coord.snapshot()["replicas"]),
+        120.0,
+        "the retry respawn to recover the slot",
+    )
+    final = coord.snapshot()
+    assert final["respawned"] >= 2, final
+    _check(
+        fleet.submit("ds", "sum", tenant="t0"), expect["sum"],
+        "post-crash-respawn sum",
+    )
+    print(
+        f"fleet_smoke: crash-during-respawn OK — "
+        f"{final['respawn_failures']} failed attempt(s), slot recovered, "
+        f"lost={final['lost']} respawned={final['respawned']}"
+    )
+
+    fleet.stop_fleet()
+    print("fleet_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(f"fleet_smoke: FAIL — {err}", file=sys.stderr)
+        sys.exit(1)
