@@ -1,0 +1,56 @@
+"""graftdep: named locks, the declared lock order, and runtime lockdep.
+
+This package is a deliberate leaf (stdlib-only at import time): every
+other modin_tpu module constructs its locks through :func:`named_lock` /
+:func:`named_rlock` during early import, before config/metrics exist.
+
+See :mod:`modin_tpu.concurrency.registry` for the LOCKS/LOCK_ORDER data
+and :mod:`modin_tpu.concurrency.lockdep` for the runtime validator
+(``MODIN_TPU_LOCKDEP=1``).
+"""
+
+from modin_tpu.concurrency.registry import (
+    LOCK_ORDER,
+    LOCKS,
+    NESTABLE,
+    declared_kinds,
+    order_edges,
+    transitive_order,
+    validate_registry,
+)
+from modin_tpu.concurrency.lockdep import (
+    DepLock,
+    LockdepViolation,
+    disable,
+    enable,
+    enabled,
+    held_locks,
+    lockdep_alloc_count,
+    named_lock,
+    named_rlock,
+    observed_edges,
+    reset_violations,
+    violations,
+)
+
+__all__ = [
+    "LOCKS",
+    "LOCK_ORDER",
+    "NESTABLE",
+    "declared_kinds",
+    "order_edges",
+    "transitive_order",
+    "validate_registry",
+    "DepLock",
+    "LockdepViolation",
+    "named_lock",
+    "named_rlock",
+    "enable",
+    "disable",
+    "enabled",
+    "violations",
+    "reset_violations",
+    "held_locks",
+    "observed_edges",
+    "lockdep_alloc_count",
+]

@@ -1329,6 +1329,33 @@ class TraceEnabled(EnvironmentVariable, type=bool):
         cls.put(False)
 
 
+class LockdepEnabled(EnvironmentVariable, type=bool):
+    """graftdep runtime lock-order validation: every ``named_lock`` /
+    ``named_rlock`` acquisition is checked against the declared partial
+    order in concurrency/registry.py, per-thread acquisition stacks are
+    tracked, and an observed inversion raises ``LockdepViolation`` (and
+    flight-dumps the witness pair).
+
+    Debug mode for the concurrency suites and smoke gates, not
+    production: the disabled mode costs one module-attribute check per
+    acquisition and allocates nothing.  Read raw at import time by
+    concurrency/lockdep.py (locks are constructed before the config
+    layer is importable); declared here so the switch is typed and
+    documented like every other knob.
+    """
+
+    varname = "MODIN_TPU_LOCKDEP"
+    default = False
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+
 class TraceFlightRecorderSize(EnvironmentVariable, type=int):
     """How many recent spans the flight-recorder ring buffer retains while
     tracing is on (0 disables the ring and its fault dumps)."""

@@ -24,10 +24,21 @@ class _OpProgress:
         self.name = name
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._span_stack: Any = None
+        self._scopes: Any = None
 
     def __enter__(self) -> "_OpProgress":
+        from modin_tpu.observability import meters as graftmeter
+        from modin_tpu.observability import spans as graftscope
+
         _reentrancy.active = True
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._span_stack = graftscope.snapshot_stack()
+        self._scopes = graftmeter.snapshot_scopes()
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"modin-tpu-progress-{self.name}",
+            daemon=True,
+        )
         self._thread.start()
         return self
 
@@ -38,24 +49,38 @@ class _OpProgress:
             self._thread.join(timeout=1.0)
 
     def _run(self) -> None:
-        # wait before showing anything: short ops stay silent
-        if self._done.wait(_LONG_OP_SECONDS):
-            return
-        try:
-            from tqdm.auto import tqdm
+        from modin_tpu.observability import meters as graftmeter
+        from modin_tpu.observability import spans as graftscope
 
-            bar = tqdm(desc=f"modin_tpu::{self.name}", total=None, leave=False)
-            while not self._done.wait(0.25):
-                bar.update(1)
-            bar.close()
-        except ImportError:
-            start = time.time()
-            while not self._done.wait(1.0):
-                elapsed = time.time() - start
-                print(  # noqa: T201
-                    f"\rmodin_tpu::{self.name} running {elapsed:.0f}s", end=""
+        # the spinner reports on the caller's operation: adopt its
+        # span/QueryStats context so anything it emits bills the owner
+        graftscope.seed_thread(self._span_stack)
+        graftmeter.seed_thread_scopes(self._scopes)
+        try:
+            # wait before showing anything: short ops stay silent
+            if self._done.wait(_LONG_OP_SECONDS):
+                return
+            try:
+                from tqdm.auto import tqdm
+
+                bar = tqdm(
+                    desc=f"modin_tpu::{self.name}", total=None, leave=False
                 )
-            print("\r", end="")  # noqa: T201
+                while not self._done.wait(0.25):
+                    bar.update(1)
+                bar.close()
+            except ImportError:
+                start = time.time()
+                while not self._done.wait(1.0):
+                    elapsed = time.time() - start
+                    print(  # noqa: T201
+                        f"\rmodin_tpu::{self.name} running {elapsed:.0f}s",
+                        end="",
+                    )
+                print("\r", end="")  # noqa: T201
+        finally:
+            graftmeter.seed_thread_scopes(None)
+            graftscope.seed_thread(None)
 
 
 def call_progress_bar(name: str) -> Any:

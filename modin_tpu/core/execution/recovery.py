@@ -46,6 +46,7 @@ import threading
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from modin_tpu.concurrency import named_lock, named_rlock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import spans as graftscope
 from modin_tpu.observability.flight_recorder import dump_flight_record
@@ -65,7 +66,7 @@ RECOVERY_ON: bool = True
 
 _tls = threading.local()
 
-_epoch_lock = threading.Lock()
+_epoch_lock = named_lock("recovery.epoch")
 _device_epoch = 0
 
 #: serializes whole reseat passes AND carries the reseat-once handshake:
@@ -74,7 +75,7 @@ _device_epoch = 0
 #: others block on the lock, see the epoch already advanced past what they
 #: observed, and piggyback on that pass's result instead of re-seating the
 #: entire resident set once per observer.
-_reseat_lock = threading.Lock()
+_reseat_lock = named_lock("recovery.reseat")
 _last_reseat_count = 0
 
 
@@ -164,7 +165,7 @@ class _Record:
         self.depth = call.depth if call is not None else 0
 
 
-_prov_lock = threading.RLock()
+_prov_lock = named_rlock("recovery.provenance")
 _provenance: Dict[int, _Record] = {}
 #: id(device array) -> (weakref(owning DeviceColumn), weakref(the array));
 #: lets op replay resolve an input buffer back to its column (and that
@@ -574,6 +575,7 @@ def reseat_all(
             ):
                 for col in device_ledger.live_columns():
                     try:
+                        # graftlint: disable=LOCK-BLOCKING -- re-deploying under dispatch/reseat is the point: the dispatch serialization exists so nothing else enqueues mid-recovery, and reseat must finish re-deploying before anyone dispatches
                         kind = recover_column(col, shard_index=shard_index)
                     except Unrecoverable:
                         emit_metric("recovery.unrecoverable", 1)
@@ -695,7 +697,7 @@ def evict_for_oom(op: str, exclude_ids: Any = None) -> int:
 # ``FileDispatcher.read`` and io lineage, spans, and cost accounting see
 # the replay exactly like the original read.
 
-_manifest_lock = threading.Lock()
+_manifest_lock = named_lock("recovery.manifest")
 _dataset_manifest: Dict[str, dict] = {}
 
 

@@ -53,6 +53,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
@@ -557,7 +558,7 @@ class CircuitBreaker:
         self.state = CLOSED
         self.strikes = 0
         self.opened_at = 0.0
-        self._lock = threading.Lock()
+        self._lock = named_lock("resilience.breaker")
 
     # -- config ------------------------------------------------------- #
 
@@ -650,7 +651,7 @@ class CircuitBreaker:
 
 
 _BREAKERS: Dict[str, CircuitBreaker] = {}
-_breakers_lock = threading.Lock()
+_breakers_lock = named_lock("resilience.breakers")
 
 
 def get_breaker(name: str) -> CircuitBreaker:

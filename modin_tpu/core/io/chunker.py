@@ -18,7 +18,9 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-_lock = threading.Lock()
+from modin_tpu.concurrency import named_lock
+
+_lock = named_lock("io.chunker")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
@@ -82,6 +84,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
     if _lib is None and not _build_failed:
         with _lock:
             if _lib is None and not _build_failed:
+                # graftlint: disable=LOCK-BLOCKING -- build-once: the lock exists precisely to make every caller wait out the one cc invocation instead of racing duplicate builds
                 _lib = _build_library()
     return _lib
 

@@ -39,6 +39,7 @@ import weakref
 from collections import OrderedDict
 from typing import Any, List, Optional
 
+from modin_tpu.concurrency import named_rlock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import spans as graftscope
 from modin_tpu.serving import context as serving_context
@@ -50,7 +51,7 @@ class _HostCacheLedger:
     def __init__(self) -> None:
         # reentrant: a weakref callback can fire via GC while the same
         # thread already holds the lock (a plain Lock would self-deadlock)
-        self._lock = threading.RLock()
+        self._lock = named_rlock("memory.host_cache")
         # ledger id -> (weakref to column, nbytes); insertion order = LRU
         self._entries: "OrderedDict[int, tuple]" = OrderedDict()
         self._total = 0
@@ -179,7 +180,7 @@ class _DeviceLedger:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()  # weakref callbacks may re-enter
+        self._lock = named_rlock("memory.device_ledger")  # weakref callbacks may re-enter
         self._entries: "OrderedDict[int, tuple]" = OrderedDict()
         self._total = 0
         self._next_id = 0

@@ -42,8 +42,11 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from modin_tpu.concurrency import named_lock, named_rlock
 from modin_tpu.fleet import wire
 from modin_tpu.logging.metrics import emit_metric
+from modin_tpu.observability import meters as graftmeter
+from modin_tpu.observability import spans as graftscope
 from modin_tpu.serving.errors import DeadlineExceeded, QueryRejected
 
 #: Global join watchdog (seconds) for queries submitted WITHOUT a
@@ -92,7 +95,7 @@ class _Replica:
         self.hello_event = threading.Event()
         self.latencies: deque = deque(maxlen=512)
         self.inflight_socks: set = set()
-        self.lock = threading.Lock()
+        self.lock = named_lock("fleet.replica_state")
 
     def note_inflight(self, sock: socket.socket) -> None:
         with self.lock:
@@ -123,13 +126,15 @@ class Coordinator:
 
         _fleet._note_alloc()
         count = int(replicas if replicas is not None else FleetReplicas.get())
-        self._lock = threading.RLock()
+        self._lock = named_rlock("fleet.coordinator")
         self._replicas = [_Replica(i) for i in range(count)]
         self._assignments: Dict[str, int] = {}  # tenant -> replica index
         self._listener: Optional[socket.socket] = None
         self._control_port: Optional[int] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._obs_span_stack: Any = None
+        self._obs_scopes: Any = None
         self.routed = 0
         self.redispatched = 0
         self.lost_count = 0
@@ -141,6 +146,10 @@ class Coordinator:
     # -- lifecycle ------------------------------------------------------- #
 
     def start(self) -> None:
+        # service threads adopt the starter's observability context so
+        # their fleet.* metrics bill whoever brought the fleet up
+        self._obs_span_stack = graftscope.snapshot_stack()
+        self._obs_scopes = graftmeter.snapshot_scopes()
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.bind(("127.0.0.1", 0))
         listener.listen(64)
@@ -232,18 +241,26 @@ class Coordinator:
         emit_metric("fleet.replica.spawn", 1)
 
     def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _addr = self._listener.accept()
-            except OSError:
-                return
-            threading.Thread(
-                target=self._control_reader, args=(conn,),
-                name="modin-tpu-fleet-control", daemon=True,
-            ).start()
+        graftscope.seed_thread(self._obs_span_stack)
+        graftmeter.seed_thread_scopes(self._obs_scopes)
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _addr = self._listener.accept()
+                except OSError:
+                    return
+                threading.Thread(
+                    target=self._control_reader, args=(conn,),
+                    name="modin-tpu-fleet-control", daemon=True,
+                ).start()
+        finally:
+            graftmeter.seed_thread_scopes(None)
+            graftscope.seed_thread(None)
 
     def _control_reader(self, conn: socket.socket) -> None:
         """One replica's control stream: a hello, then heartbeats."""
+        graftscope.seed_thread(self._obs_span_stack)
+        graftmeter.seed_thread_scopes(self._obs_scopes)
         rep: Optional[_Replica] = None
         try:
             conn.settimeout(30.0)
@@ -288,6 +305,8 @@ class Coordinator:
                 conn.close()
             except OSError:
                 pass
+            graftmeter.seed_thread_scopes(None)
+            graftscope.seed_thread(None)
 
     # -- datasets -------------------------------------------------------- #
 
@@ -589,22 +608,31 @@ class Coordinator:
             return False
 
     def _monitor_loop(self) -> None:
-        while not self._stop.wait(self._heartbeat_s() / 2):
-            hb = self._heartbeat_s()
-            with self._lock:
-                reps = list(self._replicas)
-            for rep in reps:
-                if self._stop.is_set():
-                    return
-                if rep.state == "up":
-                    if rep.proc is not None and rep.proc.poll() is not None:
-                        self._declare_lost(rep, "exit")
-                    elif time.monotonic() - rep.last_heartbeat > 3 * hb:
-                        emit_metric("fleet.replica.heartbeat_miss", 1)
-                        if not self._probe(rep):
-                            self._declare_lost(rep, "heartbeat")
-                elif rep.state == "lost" and self._respawn_enabled():
-                    self._respawn(rep)
+        graftscope.seed_thread(self._obs_span_stack)
+        graftmeter.seed_thread_scopes(self._obs_scopes)
+        try:
+            while not self._stop.wait(self._heartbeat_s() / 2):
+                hb = self._heartbeat_s()
+                with self._lock:
+                    reps = list(self._replicas)
+                for rep in reps:
+                    if self._stop.is_set():
+                        return
+                    if rep.state == "up":
+                        if (
+                            rep.proc is not None
+                            and rep.proc.poll() is not None
+                        ):
+                            self._declare_lost(rep, "exit")
+                        elif time.monotonic() - rep.last_heartbeat > 3 * hb:
+                            emit_metric("fleet.replica.heartbeat_miss", 1)
+                            if not self._probe(rep):
+                                self._declare_lost(rep, "heartbeat")
+                    elif rep.state == "lost" and self._respawn_enabled():
+                        self._respawn(rep)
+        finally:
+            graftmeter.seed_thread_scopes(None)
+            graftscope.seed_thread(None)
 
     @staticmethod
     def _respawn_enabled() -> bool:

@@ -39,15 +39,21 @@ import socket
 import threading
 from typing import Any, Dict, Optional
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.fleet import wire
 
 #: dataset name -> warmed frame (this process's serving working set)
 _frames: Dict[str, Any] = {}
-_frames_lock = threading.Lock()
+_frames_lock = named_lock("fleet.frames")
 
 #: serialized control-socket writes (hello/heartbeat share one socket)
-_control_lock = threading.Lock()
+_control_lock = named_lock("fleet.control")
 _control_sock: Optional[socket.socket] = None
+
+#: spawn-time observability context for this process's service threads
+#: (snapshotted in main() once the serving substrate is imported)
+_obs_span_stack: Any = None
+_obs_scopes: Any = None
 
 
 def _watch_port() -> int:
@@ -65,8 +71,12 @@ def _heartbeat_loop(index: int, generation: int) -> None:
     import time
 
     from modin_tpu.config import FleetHeartbeatS
+    from modin_tpu.observability import meters as graftmeter
+    from modin_tpu.observability import spans as graftscope
     from modin_tpu.serving.gate import gate
 
+    graftscope.seed_thread(_obs_span_stack)
+    graftmeter.seed_thread_scopes(_obs_scopes)
     while True:
         time.sleep(float(FleetHeartbeatS.get()))
         snap = gate.snapshot()
@@ -83,6 +93,7 @@ def _heartbeat_loop(index: int, generation: int) -> None:
         }
         try:
             with _control_lock:
+                # graftlint: disable=LOCK-BLOCKING -- fleet.control's entire purpose is serializing this one socket's frame writes; interleaved sends would corrupt the wire protocol
                 wire.send_msg(_control_sock, beat)
         except wire.WireError:
             os._exit(0)  # coordinator gone: never serve unsupervised
@@ -185,6 +196,11 @@ def _handle_request(req: dict) -> dict:
 
 
 def _serve_connection(conn: socket.socket) -> None:
+    from modin_tpu.observability import meters as graftmeter
+    from modin_tpu.observability import spans as graftscope
+
+    graftscope.seed_thread(_obs_span_stack)
+    graftmeter.seed_thread_scopes(_obs_scopes)
     try:
         conn.settimeout(30.0)
         req = wire.recv_msg(conn)
@@ -201,10 +217,12 @@ def _serve_connection(conn: socket.socket) -> None:
             conn.close()
         except OSError:
             pass
+        graftmeter.seed_thread_scopes(None)
+        graftscope.seed_thread(None)
 
 
 def main() -> int:
-    global _control_sock
+    global _control_sock, _obs_span_stack, _obs_scopes
 
     coord = os.environ["MODIN_TPU_FLEET_COORD"]
     index = int(os.environ["MODIN_TPU_FLEET_INDEX"])
@@ -214,6 +232,12 @@ def main() -> int:
     # Build the serving substrate BEFORE hello: "hello" means "ready".
     import modin_tpu.pandas  # noqa: F401
 
+    from modin_tpu.observability import meters as graftmeter
+    from modin_tpu.observability import spans as graftscope
+
+    _obs_span_stack = graftscope.snapshot_stack()
+    _obs_scopes = graftmeter.snapshot_scopes()
+
     rpc = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     rpc.bind(("127.0.0.1", 0))
     rpc.listen(64)
@@ -222,6 +246,7 @@ def main() -> int:
     _control_sock = wire.connect(host, int(port_text), timeout=10.0)
     _control_sock.settimeout(None)
     with _control_lock:
+        # graftlint: disable=LOCK-BLOCKING -- fleet.control's entire purpose is serializing this one socket's frame writes; interleaved sends would corrupt the wire protocol
         wire.send_msg(
             _control_sock,
             {

@@ -8,6 +8,9 @@ invariant that grew out of it) — see docs/linting.md for the catalog:
 - FALLBACK-PARITY  every _try_* device path has a breaker + pandas fallback
 - EXC-HYGIENE      no broad except around device dispatch
 - REGISTRY-DRIFT   metrics and MODIN_TPU_* env vars are declared + documented
+- LOCK-ORDER       acquisitions follow the declared partial order (graftdep)
+- LOCK-BLOCKING    no blocking call reachable while a registry lock is held
+- THREAD-HYGIENE   threads are named, daemon-explicit, and seed context
 """
 
 from modin_tpu.lint.rules import (  # noqa: F401
@@ -15,5 +18,7 @@ from modin_tpu.lint.rules import (  # noqa: F401
     fallback_parity,
     host_sync,
     jit_hazard,
+    lock_order,
     registry_drift,
+    thread_hygiene,
 )

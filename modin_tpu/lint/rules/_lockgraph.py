@@ -1,0 +1,681 @@
+"""Shared whole-program lock analysis for LOCK-ORDER / LOCK-BLOCKING.
+
+Builds, once per lint run (cached on the Project):
+
+- **lock bindings** — every place a ``named_lock("x")`` / ``named_rlock``
+  / raw ``threading.Lock()`` lands in a name: module globals, class
+  attributes assigned through ``self.``, and alias assignments
+  (``_CACHE_LOCK = _registry.LOCK``), resolved across files through the
+  import graph;
+- **acquisition sites** — ``with <lock>:`` blocks and ``<lock>.acquire()``
+  calls whose target expression resolves to a binding;
+- **function summaries** — for every def, the set of lock names and
+  blocking operations reachable from its body (direct + transitive
+  through a resolved call graph: same-file scope chain like jit_hazard's,
+  ``self.method``, and ``module.function`` through imports), computed to
+  fixpoint so recursion converges;
+- **the observed edge set** — ``held -> acquired`` with a witness site
+  per edge, from each with-block's body effects (nested acquisitions in
+  the block itself plus everything its calls reach).
+
+Resolution is deliberately conservative: an expression that does not
+resolve to a known binding is not a lock (``with span(...)`` etc.), and
+an attribute on an arbitrary receiver resolves only when exactly one
+class in the project binds that attribute name to a lock (``rep.lock``
+works because only ``_Replica`` has a ``.lock``).  Missed resolution
+costs coverage, never false positives — the runtime lockdep validator is
+the backstop for what the static half cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from modin_tpu.lint.framework import FileContext, Project
+from modin_tpu.lint.rules._ast_utils import dotted_parts
+
+LOCK_REGISTRY_SUFFIX = "concurrency/registry.py"
+
+#: the two factory names; position 0 argument is the lock's registry name
+_FACTORIES = {"named_lock": "lock", "named_rlock": "rlock"}
+
+#: subprocess-module calls that wait on a child process
+_SUBPROCESS_WAITS = frozenset(
+    {"run", "call", "check_call", "check_output", "communicate", "wait"}
+)
+#: socket methods that park the thread on the network
+_SOCKET_WAITS = frozenset({"recv", "recv_into", "accept", "connect", "sendall"})
+#: engine-seam entry points: each one is a device dispatch (or a full
+#: host materialization) — seconds of wall, never legal under a lock
+_ENGINE_SEAM = frozenset({"deploy", "materialize"})
+
+
+class Acquisition:
+    """One resolved lock acquisition site."""
+
+    __slots__ = ("ctx", "node", "name", "raw", "body")
+
+    def __init__(self, ctx, node, name, raw, body):
+        self.ctx = ctx  # FileContext
+        self.node = node  # the With or Call node
+        self.name = name  # registry name, or the binding's var/attr name
+        self.raw = raw  # True: anonymous threading.Lock(), not a DepLock
+        self.body = body  # with-block statements ([] for .acquire() calls)
+
+
+class Blocking:
+    """One blocking operation (category + human description)."""
+
+    __slots__ = ("kind", "detail")
+
+    def __init__(self, kind: str, detail: str):
+        self.kind = kind
+        self.detail = detail
+
+    def key(self) -> Tuple[str, str]:
+        return (self.kind, self.detail)
+
+
+class LockAnalysis:
+    """See module docstring.  Get via :func:`get_analysis`."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        # (rel, var) -> (name, kind, raw) for module-level bindings
+        self.module_locks: Dict[Tuple[str, str], Tuple[str, str, bool]] = {}
+        # (rel, class_scope, attr) -> (name, kind, raw)
+        self.attr_locks: Dict[Tuple[str, str, str], Tuple[str, str, bool]] = {}
+        # attr -> {(name, kind, raw)} across the project (unique-attr fallback)
+        self.attr_global: Dict[str, Set[Tuple[str, str, bool]]] = {}
+        # rel -> {alias: imported module rel}
+        self.import_maps: Dict[str, Dict[str, str]] = {}
+        # rel -> {local name: (source rel, source symbol)} for from-imports
+        self.symbol_imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        # declared registry data parsed from concurrency/registry.py
+        self.declared_kinds: Dict[str, str] = {}
+        self.declared_edges: Set[Tuple[str, str]] = set()
+        self.declared_closure: Dict[str, Set[str]] = {}
+        # analysis products
+        self.acquisitions: List[Acquisition] = []
+        # (before, after) -> witness (ctx, node) — first seen
+        self.edges: Dict[Tuple[str, str], Tuple[FileContext, ast.AST]] = {}
+        # (rel, scope) summaries
+        self.fn_locks: Dict[Tuple[str, str], Set[str]] = {}
+        self.fn_blocking: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self.blocking_findings: List[
+            Tuple[FileContext, ast.AST, str, Blocking, str]
+        ] = []  # (ctx, site, held lock name, blocking op, via)
+
+        self._defs: Dict[Tuple[str, str], Tuple[FileContext, ast.AST]] = {}
+        self._thread_bindings: Set[Tuple[str, str, str]] = set()
+        self._queue_bindings: Set[Tuple[str, str, str]] = set()
+        self._socket_bindings: Set[Tuple[str, str, str]] = set()
+
+        self._parse_registry()
+        self._build_imports()
+        self._build_bindings()
+        self._build_defs()
+        self._summarize()
+        self._walk_acquisitions()
+
+    # -- registry data --------------------------------------------------- #
+
+    def _parse_registry(self) -> None:
+        for ctx in self.project.files_matching(LOCK_REGISTRY_SUFFIX):
+            for node in ctx.tree.body:
+                # both plain and annotated assignment (the registry
+                # declares ``LOCKS: Tuple[...] = (...)``)
+                if isinstance(node, ast.Assign):
+                    names = {
+                        t.id for t in node.targets if isinstance(t, ast.Name)
+                    }
+                elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    names = {node.target.id}
+                else:
+                    continue
+                if "LOCKS" in names and isinstance(
+                    node.value, (ast.Tuple, ast.List)
+                ):
+                    for entry in node.value.elts:
+                        if (
+                            isinstance(entry, (ast.Tuple, ast.List))
+                            and len(entry.elts) >= 2
+                            and isinstance(entry.elts[0], ast.Constant)
+                            and isinstance(entry.elts[1], ast.Constant)
+                        ):
+                            self.declared_kinds[entry.elts[0].value] = (
+                                entry.elts[1].value
+                            )
+                if "LOCK_ORDER" in names and isinstance(
+                    node.value, (ast.Tuple, ast.List)
+                ):
+                    for entry in node.value.elts:
+                        if (
+                            isinstance(entry, (ast.Tuple, ast.List))
+                            and len(entry.elts) >= 2
+                            and isinstance(entry.elts[0], ast.Constant)
+                            and isinstance(entry.elts[1], ast.Constant)
+                        ):
+                            self.declared_edges.add(
+                                (entry.elts[0].value, entry.elts[1].value)
+                            )
+            break
+        # transitive closure of the declared order (DFS per node)
+        adjacency: Dict[str, Set[str]] = {}
+        for before, after in self.declared_edges:
+            adjacency.setdefault(before, set()).add(after)
+        for start in adjacency:
+            seen: Set[str] = set()
+            stack = list(adjacency[start])
+            while stack:
+                node = stack.pop()
+                if node in seen:
+                    continue
+                seen.add(node)
+                stack.extend(adjacency.get(node, ()))
+            self.declared_closure[start] = seen
+
+    # -- imports --------------------------------------------------------- #
+
+    def _module_rel(self, dotted: str) -> Optional[str]:
+        """The project-relative path a dotted module name resolves to."""
+        path = dotted.replace(".", "/")
+        for candidate in (f"{path}.py", f"{path}/__init__.py"):
+            for ctx in self.project.files:
+                if ctx.rel == candidate or ctx.rel.endswith("/" + candidate):
+                    return ctx.rel
+        return None
+
+    def _build_imports(self) -> None:
+        for ctx in self.project.files:
+            aliases: Dict[str, str] = {}
+            symbols: Dict[str, Tuple[str, str]] = {}
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        rel = self._module_rel(alias.name)
+                        if rel:
+                            aliases[alias.asname or alias.name] = rel
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        sub = self._module_rel(
+                            f"{node.module}.{alias.name}"
+                        )
+                        if sub:  # `from pkg import module`
+                            aliases[alias.asname or alias.name] = sub
+                            continue
+                        rel = self._module_rel(node.module)
+                        if rel:  # `from module import symbol`
+                            symbols[alias.asname or alias.name] = (
+                                rel,
+                                alias.name,
+                            )
+            self.import_maps[ctx.rel] = aliases
+            self.symbol_imports[ctx.rel] = symbols
+
+    # -- bindings -------------------------------------------------------- #
+
+    @staticmethod
+    def _lock_ctor(node: ast.AST) -> Optional[Tuple[str, str, bool]]:
+        """(name, kind, raw) when ``node`` constructs a lock."""
+        if not isinstance(node, ast.Call):
+            return None
+        parts = dotted_parts(node.func)
+        if parts is None:
+            return None
+        leaf = parts[-1]
+        if leaf in _FACTORIES:
+            if (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                return (node.args[0].value, _FACTORIES[leaf], False)
+            return ("<dynamic>", _FACTORIES[leaf], False)
+        if leaf in ("Lock", "RLock") and (
+            len(parts) == 1 or parts[-2] == "threading"
+        ):
+            kind = "rlock" if leaf == "RLock" else "lock"
+            return ("<anonymous>", kind, True)
+        return None
+
+    @staticmethod
+    def _ctor_of(node: ast.AST, names: FrozenSet[str], modules) -> bool:
+        """Is ``node`` a call to one of ``names`` (bare or via ``modules``)?"""
+        if not isinstance(node, ast.Call):
+            return False
+        parts = dotted_parts(node.func)
+        return bool(
+            parts
+            and parts[-1] in names
+            and (len(parts) == 1 or parts[-2] in modules)
+        )
+
+    def _enclosing_class_scope(
+        self, ctx: FileContext, node: ast.AST
+    ) -> Optional[str]:
+        cur = ctx.parent_of(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return ctx.scope_of(cur)
+            cur = ctx.parent_of(cur)
+        return None
+
+    def _build_bindings(self) -> None:
+        # pass 1: direct constructions
+        deferred: List[Tuple[FileContext, ast.Assign]] = []
+        for ctx in self.project.files:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                info = self._lock_ctor(node.value)
+                if info is None:
+                    if isinstance(node.value, (ast.Name, ast.Attribute)):
+                        deferred.append((ctx, node))
+                    self._note_resource_bindings(ctx, node)
+                    continue
+                self._bind_targets(ctx, node, info)
+        # pass 2: alias assignments (X = other_lock / X = mod.LOCK) — two
+        # sweeps so an alias-of-an-alias one file over still lands
+        for _ in range(2):
+            for ctx, node in deferred:
+                info = self.resolve_lock_expr(ctx, node.value)
+                if info is not None:
+                    self._bind_targets(ctx, node, info)
+
+    def _bind_targets(
+        self,
+        ctx: FileContext,
+        node: ast.Assign,
+        info: Tuple[str, str, bool],
+    ) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                scope = ctx.scope_of(node)
+                if scope == "<module>":
+                    self.module_locks[(ctx.rel, target.id)] = info
+                else:
+                    cls = self._enclosing_class_scope(ctx, node)
+                    if cls is not None and ctx.parent_of(node) is not None:
+                        # class-body assignment (LOCK = named_lock(...))
+                        parent = ctx.parent_of(node)
+                        if isinstance(parent, ast.ClassDef):
+                            self.attr_locks[
+                                (ctx.rel, ctx.scope_of(parent), target.id)
+                            ] = info
+                            self.attr_global.setdefault(
+                                target.id, set()
+                            ).add(info)
+                    # function-local lock bindings also resolve by name
+                    self.module_locks[(ctx.rel, target.id)] = info
+            elif (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                cls = self._enclosing_class_scope(ctx, node)
+                if cls is not None:
+                    self.attr_locks[(ctx.rel, cls, target.attr)] = info
+                self.attr_global.setdefault(target.attr, set()).add(info)
+
+    def _note_resource_bindings(
+        self, ctx: FileContext, node: ast.Assign
+    ) -> None:
+        """Track Thread/Queue/socket constructions for blocking-receiver
+        resolution (worker.join(), q.get(), sock.recv())."""
+        value = node.value
+        kind = None
+        if self._ctor_of(value, frozenset({"Thread"}), ("threading",)):
+            kind = self._thread_bindings
+        elif self._ctor_of(
+            value,
+            frozenset({"Queue", "SimpleQueue", "LifoQueue"}),
+            ("queue",),
+        ):
+            kind = self._queue_bindings
+        elif self._ctor_of(value, frozenset({"socket"}), ("socket",)):
+            kind = self._socket_bindings
+        if kind is None:
+            return
+        scope = ctx.scope_of(node)
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                kind.add((ctx.rel, scope, target.id))
+                kind.add((ctx.rel, "*", target.id))
+            elif (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                kind.add((ctx.rel, "*", target.attr))
+
+    def _is_resource(
+        self, ctx: FileContext, expr: ast.AST, bindings
+    ) -> bool:
+        if isinstance(expr, ast.Name):
+            return (ctx.rel, "*", expr.id) in bindings
+        if isinstance(expr, ast.Attribute):
+            return (ctx.rel, "*", expr.attr) in bindings
+        return False
+
+    # -- expression resolution ------------------------------------------- #
+
+    def resolve_lock_expr(
+        self, ctx: FileContext, expr: ast.AST
+    ) -> Optional[Tuple[str, str, bool]]:
+        """(name, kind, raw) when ``expr`` denotes a known lock binding."""
+        if isinstance(expr, ast.Name):
+            hit = self.module_locks.get((ctx.rel, expr.id))
+            if hit is not None:
+                return hit
+            imported = self.symbol_imports.get(ctx.rel, {}).get(expr.id)
+            if imported is not None:
+                return self.module_locks.get(imported)
+            return None
+        if isinstance(expr, ast.Attribute):
+            receiver = expr.value
+            if isinstance(receiver, ast.Name):
+                if receiver.id in ("self", "cls"):
+                    cls = self._enclosing_class_scope(ctx, expr)
+                    if cls is not None:
+                        hit = self.attr_locks.get((ctx.rel, cls, expr.attr))
+                        if hit is not None:
+                            return hit
+                else:
+                    target_rel = self.import_maps.get(ctx.rel, {}).get(
+                        receiver.id
+                    )
+                    if target_rel is not None:
+                        return self.module_locks.get(
+                            (target_rel, expr.attr)
+                        )
+            # unique-attribute fallback: exactly one class anywhere binds
+            # this attribute name to a lock
+            candidates = self.attr_global.get(expr.attr, set())
+            if len(candidates) == 1:
+                return next(iter(candidates))
+        return None
+
+    # -- call graph + summaries ------------------------------------------ #
+
+    def _build_defs(self) -> None:
+        for ctx in self.project.files:
+            for node in ast.walk(ctx.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self._defs[(ctx.rel, ctx.scope_of(node))] = (ctx, node)
+
+    def _resolve_call(
+        self, ctx: FileContext, call: ast.Call
+    ) -> Optional[Tuple[str, str]]:
+        """The (rel, scope) key of the def a call targets, when resolvable."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            scope = ctx.scope_of(call)
+            chain = [scope]
+            while "." in scope:
+                scope = scope.rsplit(".", 1)[0]
+                chain.append(scope)
+            chain.append("<module>")
+            for s in chain:
+                candidate = s + "." + func.id if s != "<module>" else func.id
+                if (ctx.rel, candidate) in self._defs:
+                    return (ctx.rel, candidate)
+            imported = self.symbol_imports.get(ctx.rel, {}).get(func.id)
+            if imported is not None and (imported[0], imported[1]) in self._defs:
+                return (imported[0], imported[1])
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ):
+            if func.value.id in ("self", "cls"):
+                cls = self._enclosing_class_scope(ctx, call)
+                if cls is not None:
+                    key = (ctx.rel, cls + "." + func.attr)
+                    if key in self._defs:
+                        return key
+            else:
+                target_rel = self.import_maps.get(ctx.rel, {}).get(
+                    func.value.id
+                )
+                if target_rel is not None:
+                    key = (target_rel, func.attr)
+                    if key in self._defs:
+                        return key
+        return None
+
+    def _blocking_op(
+        self, ctx: FileContext, node: ast.AST
+    ) -> Optional[Blocking]:
+        """Classify ``node`` when it is a blocking call."""
+        if not isinstance(node, ast.Call):
+            return None
+        parts = dotted_parts(node.func)
+        if parts is None:
+            return None
+        leaf = parts[-1]
+        if leaf == "sleep" and (len(parts) == 1 or parts[-2] == "time"):
+            return Blocking("sleep", "time.sleep")
+        if len(parts) >= 2 and parts[0] == "subprocess":
+            if leaf in _SUBPROCESS_WAITS or leaf == "Popen":
+                return Blocking("subprocess", f"subprocess.{leaf}")
+        if len(parts) >= 2 and parts[0] == "pickle" and leaf in (
+            "dumps",
+            "dump",
+            "loads",
+            "load",
+        ):
+            # serializing arbitrarily large state is a CPU wall every
+            # lock contender waits out (the views-exporter class)
+            return Blocking("pickle", f"pickle.{leaf}")
+        if leaf in _ENGINE_SEAM:
+            return Blocking(
+                "engine-seam", f"{leaf}() (device dispatch/materialization)"
+            )
+        if isinstance(node.func, ast.Attribute):
+            receiver = node.func.value
+            if leaf == "join" and self._is_resource(
+                ctx, receiver, self._thread_bindings
+            ):
+                return Blocking("join", "Thread.join")
+            if leaf in ("wait", "communicate") and self._is_resource(
+                ctx, receiver, self._thread_bindings
+            ):
+                return Blocking("join", f"process.{leaf}")
+            if leaf == "get" and self._is_resource(
+                ctx, receiver, self._queue_bindings
+            ):
+                timeout = next(
+                    (kw.value for kw in node.keywords if kw.arg == "timeout"),
+                    None,
+                )
+                if timeout is None or (
+                    isinstance(timeout, ast.Constant)
+                    and timeout.value is None
+                ):
+                    return Blocking("queue-get", "queue.get() with no timeout")
+            if leaf in _SOCKET_WAITS and (
+                self._is_resource(ctx, receiver, self._socket_bindings)
+                or (
+                    isinstance(receiver, (ast.Name, ast.Attribute))
+                    and "sock"
+                    in (
+                        receiver.id
+                        if isinstance(receiver, ast.Name)
+                        else receiver.attr
+                    )
+                )
+            ):
+                return Blocking("socket", f"socket.{leaf}")
+        return None
+
+    @staticmethod
+    def _own_nodes(root: ast.AST, include_root: bool = False) -> Iterator[ast.AST]:
+        """Walk without descending into nested function/class bodies (they
+        run when called, not where defined)."""
+        stack: List[ast.AST] = (
+            [root] if include_root else list(ast.iter_child_nodes(root))
+        )
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _direct_effects(
+        self, ctx: FileContext, nodes: Iterator[ast.AST]
+    ) -> Tuple[Set[str], Set[Tuple[str, str]], List[Tuple[str, str]]]:
+        """(lock names, blocking keys, callee keys) directly in ``nodes``."""
+        locks: Set[str] = set()
+        blocking: Set[Tuple[str, str]] = set()
+        callees: List[Tuple[str, str]] = []
+        for node in nodes:
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    info = self.resolve_lock_expr(ctx, item.context_expr)
+                    if info is not None:
+                        locks.add(info[0])
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    info = self.resolve_lock_expr(ctx, node.func.value)
+                    if info is not None:
+                        locks.add(info[0])
+                        continue
+                op = self._blocking_op(ctx, node)
+                if op is not None:
+                    blocking.add(op.key())
+                    continue
+                callee = self._resolve_call(ctx, node)
+                if callee is not None:
+                    callees.append(callee)
+        return locks, blocking, callees
+
+    def _summarize(self) -> None:
+        direct: Dict[Tuple[str, str], Tuple[Set[str], Set, List]] = {}
+        for key, (ctx, fn) in self._defs.items():
+            direct[key] = self._direct_effects(ctx, self._own_nodes(fn))
+        # fixpoint over the call graph (cycles converge because sets only
+        # grow and the universe is finite)
+        for key, (locks, blocking, _callees) in direct.items():
+            self.fn_locks[key] = set(locks)
+            self.fn_blocking[key] = set(blocking)
+        changed = True
+        while changed:
+            changed = False
+            for key, (_locks, _blocking, callees) in direct.items():
+                for callee in callees:
+                    if callee not in self.fn_locks:
+                        continue
+                    if not self.fn_locks[callee] <= self.fn_locks[key]:
+                        self.fn_locks[key] |= self.fn_locks[callee]
+                        changed = True
+                    if not self.fn_blocking[callee] <= self.fn_blocking[key]:
+                        self.fn_blocking[key] |= self.fn_blocking[callee]
+                        changed = True
+
+    # -- acquisition walk + edges ---------------------------------------- #
+
+    def _walk_acquisitions(self) -> None:
+        for ctx in self.project.files:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.With):
+                    resolved_items: List[Tuple[str, str, bool]] = []
+                    for item in node.items:
+                        info = self.resolve_lock_expr(
+                            ctx, item.context_expr
+                        )
+                        if info is not None:
+                            # `with A, B:` acquires in item order
+                            for prior in resolved_items:
+                                if prior[0] != info[0]:
+                                    self.edges.setdefault(
+                                        (prior[0], info[0]), (ctx, node)
+                                    )
+                            resolved_items.append(info)
+                            acq = Acquisition(
+                                ctx, node, info[0], info[2], node.body
+                            )
+                            self.acquisitions.append(acq)
+                            self._block_effects(acq, info)
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    info = self.resolve_lock_expr(ctx, node.func.value)
+                    if info is not None:
+                        self.acquisitions.append(
+                            Acquisition(ctx, node, info[0], info[2], [])
+                        )
+
+    def _block_effects(
+        self, acq: Acquisition, info: Tuple[str, str, bool]
+    ) -> None:
+        """Record ``held -> acquired`` edges and blocking-under-lock hits
+        for one with-block: direct nested sites plus everything reachable
+        through resolved calls in the block body."""
+        ctx = acq.ctx
+        held = acq.name
+        for stmt in acq.body:
+            for node in self._own_nodes(stmt, include_root=True):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        nested = self.resolve_lock_expr(
+                            ctx, item.context_expr
+                        )
+                        if nested is not None and nested[0] != held:
+                            self.edges.setdefault(
+                                (held, nested[0]), (ctx, node)
+                            )
+                elif isinstance(node, ast.Call):
+                    if (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "acquire"
+                    ):
+                        nested = self.resolve_lock_expr(
+                            ctx, node.func.value
+                        )
+                        if nested is not None and nested[0] != held:
+                            self.edges.setdefault(
+                                (held, nested[0]), (ctx, node)
+                            )
+                            continue
+                    op = self._blocking_op(ctx, node)
+                    if op is not None:
+                        self.blocking_findings.append(
+                            (ctx, node, held, op, "")
+                        )
+                        continue
+                    callee = self._resolve_call(ctx, node)
+                    if callee is not None:
+                        via = callee[1]
+                        for name in self.fn_locks.get(callee, ()):
+                            if name != held:
+                                self.edges.setdefault(
+                                    (held, name), (ctx, node)
+                                )
+                        for kind, detail in sorted(
+                            self.fn_blocking.get(callee, ())
+                        ):
+                            self.blocking_findings.append(
+                                (ctx, node, held, Blocking(kind, detail), via)
+                            )
+
+
+def get_analysis(project: Project) -> LockAnalysis:
+    """The per-run LockAnalysis, built once and cached on the Project."""
+    cached = getattr(project, "_lock_analysis", None)
+    if cached is None:
+        cached = LockAnalysis(project)
+        project._lock_analysis = cached
+    return cached

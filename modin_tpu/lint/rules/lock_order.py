@@ -1,0 +1,183 @@
+"""LOCK-ORDER / LOCK-BLOCKING: statically checked lock discipline.
+
+Both rules consume the shared whole-program lock analysis
+(:mod:`_lockgraph`): lock bindings resolved across files, an
+interprocedural ``held -> acquired`` edge set with one witness site per
+edge, and per-function summaries of reachable acquisitions and blocking
+operations.
+
+**LOCK-ORDER** flags three things:
+
+1. an acquisition edge that *contradicts the declared partial order*
+   (``LOCK_ORDER`` in concurrency/registry.py) — acquiring ``A`` while
+   holding ``B`` when the registry declares ``A`` before ``B``.  This is
+   the PR-9 dispatch-vs-reseat inversion class, caught at lint time from
+   one side alone;
+2. a *cycle in the observed graph* — two code paths that nest the same
+   pair of locks in opposite orders are an ABBA deadlock waiting for the
+   interleaving, whether or not the registry ordered the pair;
+3. an acquisition of an *undeclared lock* — a raw
+   ``threading.Lock()``/``RLock()`` that never went through
+   ``named_lock``/``named_rlock`` is invisible to the registry, the
+   declared order, and the runtime lockdep validator.
+
+**LOCK-BLOCKING** flags a blocking operation reachable while a registry
+lock is held — directly in the ``with`` body or through any resolved call
+chain: ``time.sleep``, ``Thread.join``, ``queue.get()`` with no timeout,
+``subprocess`` waits, socket waits, ``pickle`` of arbitrarily large
+state, and the engine-seam ``deploy``/``materialize`` entry points (a
+device dispatch under a lock serializes every other thread behind a
+multi-second wall — the PR-9 "metric fan-out outside the gate lock"
+class).
+
+Both rules honor ``# graftlint: disable=...`` pragmas for vetted sites
+(e.g. a lock whose entire purpose is serializing one socket's writes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Set, Tuple
+
+from modin_tpu.lint.framework import Finding, Project, Rule, register_rule
+from modin_tpu.lint.rules._lockgraph import get_analysis
+
+
+@register_rule
+class LockOrderRule(Rule):
+    id = "LOCK-ORDER"
+    description = (
+        "lock acquisitions must follow the declared partial order in "
+        "concurrency/registry.py: no edge contradicting a declared edge, "
+        "no cycle in the observed acquisition graph, no acquisition of a "
+        "lock that bypassed named_lock/named_rlock"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        analysis = get_analysis(project)
+
+        # leg 3 — undeclared (anonymous) lock acquisitions
+        seen_raw: Set[Tuple[str, str, str]] = set()
+        for acq in analysis.acquisitions:
+            if not acq.raw:
+                continue
+            scope = acq.ctx.scope_of(acq.node)
+            key = (acq.ctx.rel, scope, acq.name)
+            if key in seen_raw:
+                continue
+            seen_raw.add(key)
+            yield Finding(
+                path=acq.ctx.rel,
+                line=acq.node.lineno,
+                rule=self.id,
+                message=(
+                    "acquisition of an undeclared lock (raw threading."
+                    "Lock/RLock) — invisible to the declared order and "
+                    "the runtime lockdep validator"
+                ),
+                fix_hint=(
+                    "declare it in concurrency/registry.py:LOCKS and "
+                    "construct it with named_lock()/named_rlock()"
+                ),
+                scope=scope,
+                symbol="undeclared-lock",
+            )
+
+        # observed closure, for cycle detection
+        adjacency: Dict[str, Set[str]] = {}
+        for before, after in analysis.edges:
+            adjacency.setdefault(before, set()).add(after)
+
+        def reaches(start: str, goal: str) -> bool:
+            seen: Set[str] = set()
+            stack = list(adjacency.get(start, ()))
+            while stack:
+                node = stack.pop()
+                if node == goal:
+                    return True
+                if node in seen:
+                    continue
+                seen.add(node)
+                stack.extend(adjacency.get(node, ()))
+            return False
+
+        for (held, acquired), (ctx, node) in sorted(
+            analysis.edges.items(), key=lambda kv: kv[0]
+        ):
+            # leg 1 — contradiction of the declared order
+            if held in analysis.declared_closure.get(acquired, ()):
+                yield Finding(
+                    path=ctx.rel,
+                    line=node.lineno,
+                    rule=self.id,
+                    message=(
+                        f"acquires '{acquired}' while holding '{held}' — "
+                        f"contradicts the declared order {acquired} -> "
+                        f"{held} (concurrency/registry.py:LOCK_ORDER)"
+                    ),
+                    fix_hint=(
+                        "restructure to acquire in declared order (snapshot "
+                        "under the held lock, act after releasing), or fix "
+                        "the declaration if reality is right"
+                    ),
+                    scope=ctx.scope_of(node),
+                    symbol=f"contradicts-{held}-{acquired}",
+                )
+            # leg 2 — cycle in the observed graph (an opposite-direction
+            # path exists for this edge)
+            elif reaches(acquired, held):
+                yield Finding(
+                    path=ctx.rel,
+                    line=node.lineno,
+                    rule=self.id,
+                    message=(
+                        f"acquires '{acquired}' while holding '{held}', "
+                        f"but another path acquires '{held}' while "
+                        f"'{acquired}' is held — ABBA deadlock cycle in "
+                        "the observed acquisition graph"
+                    ),
+                    fix_hint=(
+                        "pick one order, declare it in LOCK_ORDER, and "
+                        "restructure the losing side (usually: snapshot "
+                        "state under one lock, release, then act)"
+                    ),
+                    scope=ctx.scope_of(node),
+                    symbol=f"cycle-{held}-{acquired}",
+                )
+
+
+@register_rule
+class LockBlockingRule(Rule):
+    id = "LOCK-BLOCKING"
+    description = (
+        "no blocking operation (sleep, Thread.join, untimed queue.get, "
+        "subprocess/socket waits, pickle of large state, engine-seam "
+        "deploy/materialize) may be reachable while a registry lock is "
+        "held"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        analysis = get_analysis(project)
+        seen: Set[Tuple[str, int, str, Tuple[str, str]]] = set()
+        for ctx, node, held, op, via in analysis.blocking_findings:
+            key = (ctx.rel, node.lineno, held, op.key())
+            if key in seen:
+                continue
+            seen.add(key)
+            via_txt = f" (via {via}())" if via else ""
+            yield Finding(
+                path=ctx.rel,
+                line=node.lineno,
+                rule=self.id,
+                message=(
+                    f"{op.detail} reachable while holding '{held}'"
+                    f"{via_txt} — every thread contending the lock waits "
+                    "out the blocking call too"
+                ),
+                fix_hint=(
+                    "snapshot state under the lock and perform the "
+                    "blocking work after releasing it (the gate's "
+                    "shed/metric fan-out pattern)"
+                ),
+                scope=ctx.scope_of(node),
+                symbol=f"blocking-{held}-{op.kind}",
+            )

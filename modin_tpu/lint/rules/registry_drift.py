@@ -30,6 +30,18 @@ Three quiet ways observability rots:
    in the package must be a declared ``varname`` in ``config/envvars.py``,
    and every declared varname must be mentioned in ``docs/``.
 
+4. **locks** — graftdep's ``LOCKS`` registry
+   (``concurrency/registry.py``) is cross-checked against the actual
+   ``named_lock``/``named_rlock`` construction sites both ways: a
+   construction whose literal name is undeclared (would raise at import
+   time — caught at lint time instead), a declared name no site
+   constructs (dead declaration the order table keeps ordering), and a
+   kind mismatch (``named_lock`` for an ``"rlock"`` declaration or vice
+   versa).  A raw ``threading.Lock()``/``RLock()`` construction outside
+   ``concurrency/`` is flagged too — even one never acquired in-tree
+   (which LOCK-ORDER would miss) is invisible to lockdep.  Every
+   declared lock name must appear in ``docs/``.
+
 Docstrings are exempt from the literal scan (prose references a knob by
 name legitimately); docs checks are skipped when the scanned tree has no
 ``docs/`` directory (snippet unit tests, vendored subsets).
@@ -49,9 +61,14 @@ METRICS_SUFFIX = "logging/metrics.py"
 SPANS_SUFFIX = "observability/spans.py"
 METERS_SUFFIX = "observability/meters.py"
 ENVVARS_SUFFIX = "config/envvars.py"
+LOCKS_SUFFIX = "concurrency/registry.py"
 METRIC_REGISTRY_NAME = "METRICS"
 SPAN_REGISTRY_NAME = "SPANS"
 BUCKETS_NAME = "HISTOGRAM_BUCKETS"
+LOCK_REGISTRY_NAME = "LOCKS"
+
+#: lock factory name -> the kind its declaration must carry
+LOCK_FACTORIES = {"named_lock": "lock", "named_rlock": "rlock"}
 
 #: meter kinds graftmeter can aggregate (meters.VALID_KINDS, restated here
 #: so the lint tree does not import runtime modules)
@@ -94,9 +111,18 @@ def _registry_entries(
             for t in node.targets
         ):
             value = node.value
-            if isinstance(value, (ast.Tuple, ast.List)):
-                return list(value.elts)
-            return []
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == registry_name
+            and node.value is not None
+        ):
+            value = node.value
+        else:
+            continue
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return list(value.elts)
+        return []
     return None
 
 
@@ -216,9 +242,11 @@ class RegistryDriftRule(Rule):
         "every emit_metric name must match the METRICS registry (with a "
         "valid meter kind, histogram families cross-checked against "
         "HISTOGRAM_BUCKETS both ways), every graftscope span/start_span "
-        "name must match the SPANS registry, and every MODIN_TPU_* env var "
-        "must be declared in config/envvars.py; all must be mentioned in "
-        "docs/"
+        "name must match the SPANS registry, every MODIN_TPU_* env var "
+        "must be declared in config/envvars.py, and every "
+        "named_lock/named_rlock site must match the LOCKS registry "
+        "(both ways, kinds included, no raw threading.Lock outside "
+        "concurrency/); all must be mentioned in docs/"
     )
 
     def check_project(self, project: Project) -> Iterator[Finding]:
@@ -240,6 +268,7 @@ class RegistryDriftRule(Rule):
             is_emitter=self._is_span_emitter,
         )
         yield from self._check_envvars(project)
+        yield from self._check_locks(project)
 
     # -- named-emission registries (metrics, spans) ---------------------- #
 
@@ -462,3 +491,139 @@ class RegistryDriftRule(Rule):
                         "(docs/configuration.md)",
                         symbol=f"undocumented-envvar-{var}",
                     )
+
+    # -- locks (graftdep) ------------------------------------------------ #
+
+    def _check_locks(self, project: Project) -> Iterator[Finding]:
+        """The LOCKS registry vs the named_lock/named_rlock construction
+        sites, both ways, plus the no-raw-locks-outside-concurrency leg."""
+        declared: Optional[Dict[str, Tuple[Optional[str], int]]] = None
+        registry_ctx: Optional[FileContext] = None
+        for ctx in project.files_matching(LOCKS_SUFFIX):
+            declared = self._declared_locks(ctx)
+            registry_ctx = ctx
+            break
+        if declared is None:
+            return  # no lock registry in this tree: nothing to check against
+
+        constructed: Set[str] = set()
+        for ctx in project.files:
+            in_concurrency = "concurrency/" in ctx.rel or ctx.rel.startswith(
+                "concurrency"
+            )
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                leaf = (
+                    func.id
+                    if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute) else None
+                )
+                if leaf in LOCK_FACTORIES:
+                    if not (
+                        node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                    ):
+                        continue  # forwarding wrapper (e.g. the factory itself)
+                    name = node.args[0].value
+                    entry = declared.get(name)
+                    if entry is None:
+                        yield Finding(
+                            path=ctx.rel,
+                            line=node.lineno,
+                            rule=self.id,
+                            message=f"{leaf}({name!r}) constructs a lock not "
+                            f"declared in {LOCK_REGISTRY_NAME} "
+                            f"({LOCKS_SUFFIX}) — named_lock will raise at "
+                            "import time",
+                            fix_hint="declare (name, kind, what-it-guards) "
+                            "in the LOCKS registry",
+                            scope=ctx.scope_of(node),
+                            symbol=f"undeclared-lock-{name}",
+                        )
+                    elif entry[0] != LOCK_FACTORIES[leaf]:
+                        yield Finding(
+                            path=ctx.rel,
+                            line=node.lineno,
+                            rule=self.id,
+                            message=f"{leaf}({name!r}) contradicts the "
+                            f"declared kind {entry[0]!r} — reentrancy "
+                            "intent is declared data, not a site-local "
+                            "choice",
+                            fix_hint="use the factory matching the "
+                            "declaration, or change the declaration "
+                            "deliberately",
+                            scope=ctx.scope_of(node),
+                            symbol=f"lock-kind-{name}",
+                        )
+                    constructed.add(name)
+                elif (
+                    leaf in ("Lock", "RLock")
+                    and isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "threading"
+                    and not in_concurrency
+                ):
+                    yield Finding(
+                        path=ctx.rel,
+                        line=node.lineno,
+                        rule=self.id,
+                        message=f"raw threading.{leaf}() outside "
+                        "concurrency/ — invisible to the LOCKS registry, "
+                        "the declared order, and the lockdep validator "
+                        "even if nothing in-tree acquires it yet",
+                        fix_hint="declare it in LOCKS and construct it "
+                        "with named_lock()/named_rlock()",
+                        scope=ctx.scope_of(node),
+                        symbol=f"raw-lock-{leaf}",
+                    )
+
+        docs = project.docs_text() if project.has_docs() else None
+        for name, (kind, lineno) in sorted(declared.items()):
+            if name not in constructed:
+                yield Finding(
+                    path=registry_ctx.rel,
+                    line=lineno,
+                    rule=self.id,
+                    message=f"lock '{name}' is declared in "
+                    f"{LOCK_REGISTRY_NAME} but no "
+                    "named_lock/named_rlock site constructs it",
+                    fix_hint="remove the dead declaration (and its "
+                    "LOCK_ORDER edges) or restore the construction site",
+                    symbol=f"dead-lock-{name}",
+                )
+            if docs is not None and name not in docs:
+                yield Finding(
+                    path=registry_ctx.rel,
+                    line=lineno,
+                    rule=self.id,
+                    message=f"lock '{name}' is not mentioned in docs/",
+                    fix_hint="add it to the lock-ordering table in "
+                    "docs/architecture.md",
+                    symbol=f"undocumented-lock-{name}",
+                )
+
+    @staticmethod
+    def _declared_locks(
+        ctx: FileContext,
+    ) -> Optional[Dict[str, Tuple[Optional[str], int]]]:
+        """{name: (kind, lineno)} from ``LOCKS = ((name, kind, desc), ...)``."""
+        entries = _registry_entries(ctx, LOCK_REGISTRY_NAME)
+        if entries is None:
+            return None
+        out: Dict[str, Tuple[Optional[str], int]] = {}
+        for entry in entries:
+            named = _entry_pattern(entry)
+            if named is None:
+                continue
+            kind: Optional[str] = None
+            if (
+                len(entry.elts) >= 2
+                and isinstance(entry.elts[1], ast.Constant)
+                and isinstance(entry.elts[1].value, str)
+            ):
+                kind = entry.elts[1].value
+            out[named[0]] = (kind, named[1])
+        return out

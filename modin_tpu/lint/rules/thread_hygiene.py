@@ -1,0 +1,225 @@
+"""THREAD-HYGIENE: every spawned thread is named, daemonized deliberately,
+and seeds observability context.
+
+Three conventions every long-lived helper thread in this codebase
+(watchdog, prefetch worker, watch sampler) re-derived by hand, now
+checked:
+
+1. **name=** — an anonymous ``Thread-12`` in a stack dump, a flight
+   record, or lockdep's violation report is undebuggable; every
+   ``threading.Thread(...)`` site passes an explicit ``name=``;
+2. **daemon=** — whether a thread may outlive interpreter shutdown is a
+   decision, not a default; the site must say it either way;
+3. **span/QueryStats seeding** — a worker that runs a query's work on the
+   caller's behalf must adopt the spawner's observability context
+   (``graftscope.seed_thread`` + ``graftmeter.seed_thread_scopes``), or
+   its spans float parentless and its metrics bill nobody.  The check
+   resolves the ``target=`` function (same-file scope chain / bound
+   method) and requires both seeding calls somewhere in its body or one
+   call-hop below; an unresolvable target (cross-module callable) is
+   exempt — the rule never guesses.
+
+Vetted exceptions (a pure-stdlib thread that touches no observability,
+e.g. a build-probe helper) carry ``# graftlint: disable=THREAD-HYGIENE``
+with the reason inline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from modin_tpu.lint.framework import FileContext, Finding, Project, Rule, register_rule
+from modin_tpu.lint.rules._ast_utils import dotted_parts
+
+_SEED_CALLS = ("seed_thread", "seed_thread_scopes")
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    parts = dotted_parts(node.func)
+    return bool(
+        parts
+        and parts[-1] == "Thread"
+        and (len(parts) == 1 or parts[-2] == "threading")
+    )
+
+
+@register_rule
+class ThreadHygieneRule(Rule):
+    id = "THREAD-HYGIENE"
+    description = (
+        "every threading.Thread(...) site must pass name= and daemon=, and "
+        "its target must seed spans/QueryStats (seed_thread + "
+        "seed_thread_scopes)"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterator[Finding]:
+        defs = self._defs_by_scope(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            scope = ctx.scope_of(node)
+            target_label = self._target_label(node)
+            if "name" not in kwargs:
+                yield Finding(
+                    path=ctx.rel,
+                    line=node.lineno,
+                    rule=self.id,
+                    message=(
+                        "Thread() without name= — anonymous threads are "
+                        "undebuggable in stack dumps, flight records, and "
+                        "lockdep reports"
+                    ),
+                    fix_hint='pass name="modin-tpu-<role>"',
+                    scope=scope,
+                    symbol=f"unnamed-{target_label}",
+                )
+            if "daemon" not in kwargs:
+                yield Finding(
+                    path=ctx.rel,
+                    line=node.lineno,
+                    rule=self.id,
+                    message=(
+                        "Thread() without daemon= — whether the thread may "
+                        "outlive shutdown is a decision, not a default"
+                    ),
+                    fix_hint="pass daemon=True (helpers) or daemon=False "
+                    "(work that must finish)",
+                    scope=scope,
+                    symbol=f"undaemonized-{target_label}",
+                )
+            target_fn = self._resolve_target(ctx, node, defs)
+            if target_fn is not None and not self._seeds(
+                ctx, target_fn, defs
+            ):
+                yield Finding(
+                    path=ctx.rel,
+                    line=node.lineno,
+                    rule=self.id,
+                    message=(
+                        f"thread target `{target_label}` never seeds "
+                        "observability context (seed_thread + "
+                        "seed_thread_scopes) — its spans float parentless "
+                        "and its metrics bill nobody"
+                    ),
+                    fix_hint=(
+                        "snapshot at spawn (graftscope.snapshot_stack / "
+                        "graftmeter.snapshot_scopes), seed at the top of "
+                        "the target body, clear in a finally"
+                    ),
+                    scope=scope,
+                    symbol=f"unseeded-{target_label}",
+                )
+
+    # -- target resolution ----------------------------------------------- #
+
+    @staticmethod
+    def _target_expr(node: ast.Call) -> Optional[ast.AST]:
+        for kw in node.keywords:
+            if kw.arg == "target":
+                return kw.value
+        if node.args:  # Thread(group, target, ...) positional form
+            return node.args[1] if len(node.args) > 1 else None
+        return None
+
+    def _target_label(self, node: ast.Call) -> str:
+        expr = self._target_expr(node)
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return "thread"
+
+    @staticmethod
+    def _defs_by_scope(
+        ctx: FileContext,
+    ) -> Dict[Tuple[str, str], ast.FunctionDef]:
+        """(containing scope, name) -> def — jit_hazard's resolution map."""
+        defs: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                own = ctx.scope_of(node)
+                containing = (
+                    own.rsplit(".", 1)[0] if "." in own else "<module>"
+                )
+                defs[(containing, node.name)] = node
+        return defs
+
+    def _resolve_target(
+        self,
+        ctx: FileContext,
+        call: ast.Call,
+        defs: Dict[Tuple[str, str], ast.FunctionDef],
+    ) -> Optional[ast.FunctionDef]:
+        expr = self._target_expr(call)
+        if isinstance(expr, ast.Name):
+            scope = ctx.scope_of(call)
+            chain = [scope]
+            while "." in scope:
+                scope = scope.rsplit(".", 1)[0]
+                chain.append(scope)
+            chain.append("<module>")
+            for s in chain:
+                fn = defs.get((s, expr.id))
+                if fn is not None:
+                    return fn
+        elif isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            if expr.value.id == "self":
+                cls = self._enclosing_class(ctx, call)
+                if cls is not None:
+                    return defs.get((ctx.scope_of(cls), expr.attr))
+        return None
+
+    @staticmethod
+    def _enclosing_class(
+        ctx: FileContext, node: ast.AST
+    ) -> Optional[ast.ClassDef]:
+        cur = ctx.parent_of(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = ctx.parent_of(cur)
+        return None
+
+    # -- seeding check --------------------------------------------------- #
+
+    def _seeds(
+        self,
+        ctx: FileContext,
+        fn: ast.FunctionDef,
+        defs: Dict[Tuple[str, str], ast.FunctionDef],
+        depth: int = 0,
+    ) -> bool:
+        """Does ``fn`` (or a same-file callee, one hop) call BOTH seeders?"""
+        found: Set[str] = set()
+        callees = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                parts = dotted_parts(node.func)
+                if parts and parts[-1] in _SEED_CALLS:
+                    found.add(parts[-1])
+                elif (
+                    depth == 0
+                    and parts
+                    and isinstance(node.func, ast.Name)
+                ):
+                    callees.append(node)
+        if len(found) == len(_SEED_CALLS):
+            return True
+        for call in callees:
+            scope = ctx.scope_of(call)
+            chain = [scope]
+            while "." in scope:
+                scope = scope.rsplit(".", 1)[0]
+                chain.append(scope)
+            chain.append("<module>")
+            for s in chain:
+                callee = defs.get((s, call.func.id))
+                if callee is not None and callee is not fn:
+                    if self._seeds(ctx, callee, defs, depth=1):
+                        return True
+                    break
+        return False

@@ -20,6 +20,7 @@ import pandas
 import numpy
 
 import modin_tpu
+from modin_tpu.concurrency import named_lock
 from modin_tpu.config import LogFileSize, LogMemoryInterval, LogMode
 
 __LOGGER_CONFIGURED__: bool = False
@@ -29,7 +30,7 @@ __LOGGER_CONFIGURED__: bool = False
 # duplicate handlers on the trace logger AND two daemon memory-sampler
 # threads.  The handle to the (single) sampler thread is kept for
 # introspection and tests.
-_configure_lock = threading.Lock()
+_configure_lock = named_lock("logging.configure")
 _mem_sampler: "threading.Thread | None" = None
 
 
@@ -101,13 +102,21 @@ def configure_logging() -> None:
             pass
 
         if LogMode.get() != "Enable_Api_Only":
+            from modin_tpu.observability import meters as graftmeter
+            from modin_tpu.observability import spans as graftscope
+
             mem_sleep = LogMemoryInterval.get()
             mem = _create_logger(
                 "modin_tpu_memory.logger", job_id, "memory", logging.DEBUG
             )
             _mem_sampler = threading.Thread(
                 target=memory_thread,
-                args=[mem, mem_sleep],
+                args=[
+                    mem,
+                    mem_sleep,
+                    graftscope.snapshot_stack(),
+                    graftmeter.snapshot_scopes(),
+                ],
                 daemon=True,
                 name="modin-tpu-memory-sampler",
             )
@@ -116,8 +125,20 @@ def configure_logging() -> None:
         __LOGGER_CONFIGURED__ = True
 
 
-def memory_thread(logger: logging.Logger, sleep_time: int) -> None:
+def memory_thread(
+    logger: logging.Logger,
+    sleep_time: int,
+    span_stack=None,
+    scopes=None,
+) -> None:
     """Sample host RSS and (if available) device HBM usage forever."""
+    from modin_tpu.observability import meters as graftmeter
+    from modin_tpu.observability import spans as graftscope
+
+    # configure-once service thread: adopt the configuring thread's
+    # observability context (empty outside a query; cheap no-op either way)
+    graftscope.seed_thread(span_stack)
+    graftmeter.seed_thread_scopes(scopes)
     while True:
         rss = _process_rss_bytes()
         if rss is not None:

@@ -143,6 +143,14 @@ METRICS = (
         "best-effort; emitted while graftmeter accounting is active)",
     ),
     (
+        "concurrency.lockdep.violation",
+        "counter",
+        "lock-order violations the runtime lockdep validator detected "
+        "(MODIN_TPU_LOCKDEP=1): self-deadlock, same-name instance pair, "
+        "declared-order contradiction, or observed ABBA inversion; each "
+        "also flight-dumps its witness pair",
+    ),
+    (
         "recovery.device_lost",
         "counter",
         "device-lost events entering the graftguard lineage-recovery "

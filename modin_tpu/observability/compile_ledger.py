@@ -32,6 +32,7 @@ import contextlib
 import threading
 from typing import Dict, Iterator, Optional
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.observability import spans as _spans
 
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -60,7 +61,7 @@ class CompileLedger:
     """Thread-safe per-signature compile/dispatch accounting."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("compile_ledger.entries")
         self._entries: Dict[str, dict] = {}
         self.total_compiles = 0
         self.total_compile_s = 0.0
@@ -169,7 +170,7 @@ def _on_event_duration(event: str, duration: float, **kwargs: object) -> None:
 
 
 _installed = False
-_install_lock = threading.Lock()
+_install_lock = named_lock("compile_ledger.install")
 
 
 def ensure_listener() -> bool:

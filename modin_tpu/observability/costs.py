@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import spans as _spans
 
@@ -67,7 +68,7 @@ _mode = "Auto"
 
 _tls = threading.local()
 
-_pad_lock = threading.Lock()
+_pad_lock = named_lock("costs.padding")
 # process-global padding accumulators (the Chrome counter track reads these)
 _total_padded_bytes = 0
 _total_waste_bytes = 0
@@ -238,7 +239,7 @@ class CostLedger:
     """Thread-safe per-signature cost entries joined with dispatch wall."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("costs.ledger")
         self._entries: Dict[str, dict] = {}
         self._padding: Dict[str, dict] = {}  # per padding site
         self._collective: Dict[str, dict] = {}  # per collective site
@@ -608,7 +609,7 @@ KNOWN_PEAKS: Dict[str, Tuple[float, float]] = {
 }
 
 _peaks_cache: Optional[dict] = None
-_peaks_lock = threading.Lock()
+_peaks_lock = named_lock("costs.peaks")
 
 
 def _measure_host_peaks() -> Optional[dict]:

@@ -28,6 +28,7 @@ import threading
 import time
 from typing import List, Optional
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.observability import spans as _spans
 from modin_tpu.observability.chrome_trace import to_chrome_trace
 
@@ -40,7 +41,7 @@ MIN_DUMP_INTERVAL_S = 5.0
 #: 3600s interval failed for the first hour of container uptime).
 _NEVER_DUMPED = float("-inf")
 _last_dump = _NEVER_DUMPED
-_dump_lock = threading.Lock()
+_dump_lock = named_lock("flight.dump")
 
 _REASON_SANITIZE = re.compile(r"[^A-Za-z0-9_.-]+")
 

@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
 
+from modin_tpu.concurrency import named_lock
+
 #: Module-level fast path, graftscope-style: True while the aggregation
 #: registry (``MODIN_TPU_METERS``) or at least one ``query_stats()`` scope
 #: is live.  Instrumented seams (engine dispatch accounting, compile
@@ -89,7 +91,7 @@ _alloc_count = 0  # meter objects ever constructed (the zero-alloc assertion)
 
 _qs_tls = threading.local()  # .stack: active QueryStats; .dispatches: count
 
-_scope_lock = threading.Lock()
+_scope_lock = named_lock("meters.scopes")
 _active_scopes = 0
 
 #: every currently-open QueryStats scope, process-wide (insertion order =
@@ -275,7 +277,7 @@ class MeterRegistry:
     ``METRICS`` declarations."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("meters.registry")
         self._meters: Dict[str, Any] = {}
         self._kinds: Dict[str, Tuple[str, Optional[Tuple[float, ...]]]] = {}
         self._dropped = 0  # observations refused by the cardinality guard
@@ -529,7 +531,7 @@ class QueryStats:
         # abandoned mid-thunk): accumulation takes this lock, and a closed
         # scope stops accepting so late emissions from an abandoned worker
         # can never mutate a rollup the owner already read
-        self._lock = threading.Lock()
+        self._lock = named_lock("meters.query_stats")
         self._closed = False
         self.signature = None  # innermost QUERY-COMPILER span, if tracing
         self.wall_s = 0.0

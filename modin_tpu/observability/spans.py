@@ -40,6 +40,8 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
+from modin_tpu.concurrency import named_lock
+
 #: Module-level fast path.  Instrumentation sites check this ONE attribute
 #: before doing anything else; while it is False no span object is ever
 #: allocated.  Flipped by the TraceEnabled config subscription and by
@@ -170,7 +172,7 @@ _alloc_count = 0  # Span objects ever constructed (the zero-alloc assertion)
 _tls = threading.local()
 
 _collectors: List[list] = []  # active profile() collectors
-_state_lock = threading.Lock()
+_state_lock = named_lock("spans.state")
 
 #: bounded ring of recently finished spans (the flight recorder's memory);
 #: created/resized by _refresh_enabled from TraceFlightRecorderSize
@@ -187,7 +189,7 @@ _COUNTERS: Optional[deque] = None
 #: reconfiguration), read-modify-write only under _live_lock — threads
 #: finish spans concurrently and a lost update would drift the counter
 _live_spans = 0
-_live_lock = threading.Lock()
+_live_lock = named_lock("spans.live")
 
 _env_enabled = False
 

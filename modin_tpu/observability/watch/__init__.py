@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from modin_tpu.concurrency import named_rlock
 from modin_tpu.observability.watch.timeseries import (  # noqa: F401
     Ring,
     RingStore,
@@ -51,7 +52,7 @@ from modin_tpu.observability.watch.timeseries import (  # noqa: F401
 #: doing anything else.  True only while the service is running.
 WATCH_ON: bool = False
 
-_state_lock = threading.RLock()
+_state_lock = named_rlock("watch.state")
 _service: Optional["WatchService"] = None
 _env_enabled = False
 

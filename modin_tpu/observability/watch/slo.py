@@ -28,6 +28,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Dict, Optional
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.observability.watch.timeseries import note_alloc
 
 #: fraction of queries that must meet the objective (the error budget is
@@ -80,7 +81,7 @@ class SloTracker:
 
     def __init__(self) -> None:
         note_alloc()
-        self._lock = threading.Lock()
+        self._lock = named_lock("watch.slo")
         self._observations: "OrderedDict[str, deque]" = OrderedDict()
         self.evicted_tenants = 0
 

@@ -41,6 +41,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from modin_tpu.concurrency import named_lock
+
 #: ring capacity in samples (per series).  At the default 1s interval this
 #: is ~8.5 minutes of history — enough for the slow SLO window with slack.
 #: Module-level so tests can shrink it; read at Ring construction.
@@ -81,7 +83,7 @@ class Ring:
         self.name = name
         self.kind = kind
         self._samples: deque = deque(maxlen=maxlen or RING_SAMPLES)
-        self._lock = threading.Lock()
+        self._lock = named_lock("watch.ring")
 
     def __len__(self) -> int:
         return len(self._samples)
@@ -231,7 +233,7 @@ class RingStore:
 
     def __init__(self) -> None:
         note_alloc()
-        self._lock = threading.Lock()
+        self._lock = named_lock("watch.rings")
         self._rings: Dict[str, Ring] = {}
         self.dropped_series = 0
 
@@ -374,6 +376,8 @@ class Sampler:
         self._on_died = on_died
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._obs_span_stack: Any = None
+        self._obs_scopes: Any = None
         self.ticks = 0
         self.last_tick_t: Optional[float] = None
         self.died = False
@@ -394,6 +398,12 @@ class Sampler:
         self.error = None
         self.ticks = 0  # per-run: a restart starts its own tick count
         self.last_tick_t = None
+        from modin_tpu.observability import meters as graftmeter
+        from modin_tpu.observability import spans as graftscope
+
+        # the sampler's emitted samples bill whoever started the service
+        self._obs_span_stack = graftscope.snapshot_stack()
+        self._obs_scopes = graftmeter.snapshot_scopes()
         self._thread = threading.Thread(
             target=self._run, name=self.THREAD_NAME, daemon=True
         )
@@ -420,6 +430,11 @@ class Sampler:
     # -- the loop -------------------------------------------------------- #
 
     def _run(self) -> None:
+        from modin_tpu.observability import meters as graftmeter
+        from modin_tpu.observability import spans as graftscope
+
+        graftscope.seed_thread(self._obs_span_stack)
+        graftmeter.seed_thread_scopes(self._obs_scopes)
         stop = self._stop  # THIS run's event (see start(): a later start
         # swaps in a fresh one, which must not revive a stalled run)
         try:
@@ -450,6 +465,9 @@ class Sampler:
                     self._on_died(err)
                 except Exception:
                     pass
+        finally:
+            graftmeter.seed_thread_scopes(None)
+            graftscope.seed_thread(None)
 
     def sample_once(self, now: Optional[float] = None) -> None:
         """One sampling pass over every seam (also callable directly by

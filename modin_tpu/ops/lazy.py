@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import meters as graftmeter
 from modin_tpu.serving import context as serving_context
@@ -50,7 +51,7 @@ _SCALAR_TYPES = (int, float, bool, np.integer, np.floating, np.bool_)
 # threads, and an unguarded OrderedDict move_to_end racing a popitem can
 # corrupt the dict's internal linkage, not just return a stale entry.
 _FUSED_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
-_FUSED_LOCK = threading.Lock()
+_FUSED_LOCK = named_lock("ops.fused_cache")
 _evictions = 0
 
 

@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import spans as graftscope
 
@@ -58,7 +59,7 @@ MIN_SAVINGS_S = 0.05
 
 _CAL_VERSION = 3
 
-_lock = threading.Lock()
+_lock = named_lock("ops.router_calibration")
 #: None = not yet resolved; False = calibration failed (route device);
 #: dict = live table
 _calibration: Any = None

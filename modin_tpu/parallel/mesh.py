@@ -15,10 +15,11 @@ from typing import Optional
 
 import numpy as np
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.config import MeshShape
 
 _MESH_AXES = ("rows", "cols")
-_lock = threading.Lock()
+_lock = named_lock("parallel.mesh")
 _mesh = None
 _mesh_shape: Optional[tuple] = None
 

@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 import pandas
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import spans as graftscope
 from modin_tpu.observability.compile_ledger import (
@@ -132,7 +133,7 @@ _STORM_COMPILES = 3
 #: cold signature merely restarts its storm counter at exact padding
 _MAX_STORM_SIGS = 512
 
-_storm_lock = threading.Lock()
+_storm_lock = named_lock("plan.storm")
 #: plan signature -> [backend compiles observed during its fused
 #: dispatches, {distinct physical input sizes dispatched}]; LRU order
 _sig_state: "OrderedDict[Any, list]" = OrderedDict()

@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import costs as graftcost
 from modin_tpu.observability import meters as graftmeter
@@ -60,7 +61,7 @@ def _scan_cache_budget() -> int:
 #: physical read itself happens OUTSIDE the lock (a slow parse must not
 #: serialize every other query's scan); the worst case is a duplicate
 #: parse, never a corrupt cache.
-_SCAN_CACHE_LOCK = threading.Lock()
+_SCAN_CACHE_LOCK = named_lock("plan.scan_cache")
 
 
 def in_lowering() -> bool:

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Optional
 
+from modin_tpu.concurrency import named_lock, named_rlock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.serving.errors import DeadlineExceeded
 
@@ -40,7 +41,7 @@ from modin_tpu.serving.errors import DeadlineExceeded
 CONTEXT_ON: bool = False
 
 _active = 0
-_active_lock = threading.Lock()
+_active_lock = named_lock("serving.context_active")
 
 _tls = threading.local()  # .ctx: the innermost QueryContext on this thread
 
@@ -57,7 +58,7 @@ _alloc_count = 0  # QueryContext objects ever constructed (zero-alloc assert)
 #: every deploy/put attempt in this lock so program enqueue is one global
 #: order across threads.  Reentrant: a recovery pass re-deploys from
 #: inside a failed attempt's handling on the same thread.
-dispatch_lock = threading.RLock()
+dispatch_lock = named_rlock("resilience.dispatch")
 
 # test seam, resilience-style: patched to simulate clock advance
 _now = time.monotonic

@@ -50,6 +50,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Optional
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
@@ -131,7 +132,7 @@ class AdmissionGate:
     """The process-wide bounded admission gate (one instance, module-level)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.gate")
         self._running = 0
         self._reserved_bytes = 0.0
         self._inflight: dict = {}  # tenant -> running count

@@ -39,6 +39,8 @@ import time
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
+from modin_tpu.concurrency import named_lock
+
 # test seam: patched to simulate refill time passing
 _now = time.monotonic
 
@@ -165,7 +167,7 @@ class TenantRegistry:
     at :data:`_MAX_TENANTS` idle tenants."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.tenants")
         self._tenants: "OrderedDict[str, TenantState]" = OrderedDict()
         self._gen = 1  # any state created before wiring retunes on touch
 

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Iterable, Optional
 
+from modin_tpu.concurrency import named_lock
 from modin_tpu.core.execution import resilience
 
 _FAULT_MESSAGES = {
@@ -126,7 +127,7 @@ class FaultInjector:
         self.shard_index = shard_index
         self.injected = 0
         self.calls = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("testing.faults")
 
     def _hook(self, op: str) -> None:
         if op not in self.ops:

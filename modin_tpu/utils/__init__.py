@@ -396,7 +396,9 @@ def show_versions(as_json: Union[str, bool] = False) -> None:
             except Exception as err:  # pragma: no cover
                 result_queue.put(err)
 
-        thread = threading.Thread(target=probe, daemon=True)
+        thread = threading.Thread(  # graftlint: disable=THREAD-HYGIENE -- pure-stdlib build probe: deliberately imports no observability so a diagnostics dump works when the package is half-broken
+            target=probe, name="modin-tpu-version-probe", daemon=True
+        )
         thread.start()
         try:
             devices = result_queue.get(timeout=10)

@@ -44,8 +44,12 @@ def export_artifacts(frame: Any) -> List[dict]:
     "host_bytes": ...}``.  Artifacts with a device payload, no host
     state, or unpicklable state are skipped — they rebuild on demand.
     """
-    records: List[dict] = []
+    candidates: List[dict] = []
     cols = _frame_columns(frame)
+    # snapshot under the lock, serialize OUTSIDE it: the pickle probe on a
+    # large host state can take hundreds of ms, and registry.LOCK is THE
+    # derived-cache lock every query's hit path contends (LOCK-BLOCKING's
+    # snapshot-then-act pattern)
     with registry.LOCK:
         for pos, col in enumerate(cols):
             tok = getattr(col, "_view_token", None)
@@ -68,20 +72,24 @@ def export_artifacts(frame: Any) -> List[dict]:
                     state = dict(state)
                     state["idents"] = registry.ADOPT_IDENTS
                     state["host_guards"] = ()
-                record = {
-                    "col": pos,
-                    "kind": art.kind,
-                    "params": art.params,
-                    "length": art.length,
-                    "state": state,
-                    "can_fold": art.can_fold,
-                    "host_bytes": art.host_bytes,
-                }
-                try:
-                    pickle.dumps(record)
-                except Exception:
-                    continue  # e.g. a device array inside the state dict
-                records.append(record)
+                candidates.append(
+                    {
+                        "col": pos,
+                        "kind": art.kind,
+                        "params": art.params,
+                        "length": art.length,
+                        "state": state,
+                        "can_fold": art.can_fold,
+                        "host_bytes": art.host_bytes,
+                    }
+                )
+    records = []
+    for record in candidates:
+        try:
+            pickle.dumps(record)
+        except Exception:
+            continue  # e.g. a device array inside the state dict
+        records.append(record)
     emit_metric("view.export", len(records))
     return records
 

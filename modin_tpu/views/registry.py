@@ -65,12 +65,13 @@ import weakref
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+from modin_tpu.concurrency import named_rlock
 from modin_tpu.logging.metrics import emit_metric
 
 #: THE derived-cache lock (reentrant: invalidation runs under it while the
 #: ledger spill / recovery paths call ``Artifact.drop`` directly, and the
 #: sorted-rep shim re-enters through the same invalidation hooks)
-LOCK = threading.RLock()
+LOCK = named_rlock("views.registry")
 
 #: sentinel an exported artifact's state carries in place of its
 #: process-local column identities (views/exporter.py strips them — ids

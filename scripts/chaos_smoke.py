@@ -22,6 +22,7 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_LOCKDEP"] = "1"  # lock-order validated throughout
 
 import jax  # noqa: E402
 
@@ -150,6 +151,16 @@ def main() -> int:
         f"{len(reseat_spans)} reseat span(s)); oom burst absorbed after "
         f"{burst.injected} fault(s) with zero fallbacks; fused donated "
         f"dispatch survived a mid-dispatch loss bit-exact"
+    )
+    from modin_tpu.concurrency import lockdep
+
+    recorded = lockdep.violations()
+    assert not recorded, "lockdep violations under chaos:\n" + "\n".join(
+        v.render() for v in recorded
+    )
+    print(
+        f"graftdep: {len(lockdep.observed_edges())} lock-order edges "
+        "observed, zero violations"
     )
     return 0
 

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all sixteen gates, fail on any red
+#   ./scripts/check_all.sh            # all eighteen gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -66,6 +66,13 @@
 #       respawn the dead slot warm (manifest re-read + graftview
 #       artifact ingest), and ride out a crash-during-respawn; disabled
 #       mode must be a bit-for-bit passthrough with zero allocations
+#   0m. graftdep lockdep smoke: a concurrent serving workload with a
+#       mid-run device loss under MODIN_TPU_LOCKDEP=1 must exercise the
+#       acquisition graph (observed edges asserted, several matching
+#       declared LOCK_ORDER edges) with ZERO violations, and a
+#       deliberately seeded gate-under-dispatch inversion must raise
+#       LockdepViolation AND flight-dump the witness — the tripwire is
+#       proven live, not just quiet
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -102,6 +109,7 @@ run_gate "graftstream"     python scripts/oocore_smoke.py
 run_gate "graftview"       python scripts/views_smoke.py
 run_gate "graftwatch"      python scripts/watch_smoke.py
 run_gate "graftfleet"      python scripts/fleet_smoke.py
+run_gate "graftdep"        python scripts/lockdep_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -111,4 +119,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL SEVENTEEN GATES GREEN"
+echo "ALL EIGHTEEN GATES GREEN"

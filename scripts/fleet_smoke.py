@@ -38,6 +38,7 @@ os.environ["XLA_FLAGS"] = (
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_ENABLE_X64"] = "1"  # replicas inherit: bit-exact vs pandas
 os.environ["MODIN_TPU_METERS"] = "1"
+os.environ["MODIN_TPU_LOCKDEP"] = "1"  # coordinator AND replicas inherit
 os.environ["MODIN_TPU_SERVING"] = "1"
 os.environ["MODIN_TPU_FLEET_REPLICAS"] = "3"
 os.environ["MODIN_TPU_FLEET_HEARTBEAT_S"] = "0.3"
@@ -301,6 +302,17 @@ def main() -> int:
     )
 
     fleet.stop_fleet()
+
+    from modin_tpu.concurrency import lockdep
+
+    recorded = lockdep.violations()
+    assert not recorded, "lockdep violations in coordinator:\n" + "\n".join(
+        v.render() for v in recorded
+    )
+    print(
+        f"fleet_smoke: graftdep observed {len(lockdep.observed_edges())} "
+        "lock-order edges, zero violations"
+    )
     print("fleet_smoke: PASS")
     return 0
 

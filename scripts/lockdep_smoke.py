@@ -1,0 +1,173 @@
+"""graftdep lockdep smoke gate: runtime lock-order validation, both ways.
+
+Run by scripts/check_all.sh (the eighteenth gate).  Two legs:
+
+1. **Clean under fire** — a concurrent serving workload (multiple
+   tenant sessions submitting traced groupby/reduction queries through
+   the admission gate) with a device fault injected mid-run, all under
+   ``MODIN_TPU_LOCKDEP=1`` in strict mode.  The real engine must
+   exercise a healthy slice of the acquisition graph (observed-edge
+   count is asserted) with ZERO violations.
+
+2. **Detection actually works** — a deliberately seeded inversion
+   (acquiring ``serving.gate`` while holding ``resilience.dispatch``,
+   the exact PR-9 class the declared edge forbids) must raise
+   ``LockdepViolation``, record the violation, AND flight-dump the
+   witness (tracing is on, so the dump lands in the trace dir).  A
+   validator that never fires is indistinguishable from one that works;
+   this leg proves the tripwire is live.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import glob
+import os
+import sys
+import tempfile
+import threading
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_LOCKDEP"] = "1"
+os.environ["MODIN_TPU_TRACE"] = "1"  # the seeded inversion must flight-dump
+_TRACE_DIR = tempfile.mkdtemp(prefix="lockdep_smoke_traces_")
+os.environ["MODIN_TPU_TRACE_DIR"] = _TRACE_DIR
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import modin_tpu.pandas as pd
+    from modin_tpu import serving
+    from modin_tpu.concurrency import lockdep
+    from modin_tpu.concurrency.lockdep import LockdepViolation
+    from modin_tpu.concurrency.registry import order_edges
+    from modin_tpu.config import ResilienceBackoffS, ServingEnabled
+    from modin_tpu.serving.gate import gate
+    from modin_tpu.testing import inject_faults
+
+    assert lockdep.enabled(), "MODIN_TPU_LOCKDEP=1 did not enable lockdep"
+
+    # ---- leg 1: concurrent serving + chaos, zero violations ---------- #
+    ServingEnabled.put(True)
+    ResilienceBackoffS.put(0.0)
+    gate.reset_for_tests()
+
+    rng = np.random.default_rng(7)
+    frame = pd.DataFrame(
+        {
+            "k": rng.integers(0, 32, size=20_000),
+            "v": rng.standard_normal(20_000),
+            "w": rng.standard_normal(20_000),
+        }
+    )
+
+    errors = []
+
+    def session(tenant: str) -> None:
+        try:
+            for _ in range(4):
+                serving.submit(
+                    lambda f: f.groupby("k").agg({"v": "mean", "w": "sum"}),
+                    frame,
+                    tenant=tenant,
+                )
+                serving.submit(
+                    lambda f: (f["v"] * f["w"]).sum(), frame, tenant=tenant
+                )
+        except Exception as err:  # pragma: no cover - surfaced below
+            errors.append((tenant, err))
+
+    # one mid-run device loss so the recovery/reseat lock chain runs too
+    with inject_faults(
+        kind="device_lost", ops=("deploy",), times=1, skip=6
+    ) as inj:
+        threads = [
+            threading.Thread(
+                target=session, args=(f"tenant{i}",),
+                name=f"lockdep-smoke-{i}", daemon=True,
+            )
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), f"session {t.name} hung"
+    assert not errors, f"serving sessions failed: {errors[:3]}"
+    assert inj.injected >= 1, "the device fault never fired"
+
+    recorded = lockdep.violations()
+    assert not recorded, "violations in a clean workload:\n" + "\n".join(
+        v.render() for v in recorded
+    )
+    edges = lockdep.observed_edges()
+    assert len(edges) >= 5, (
+        f"only {len(edges)} observed edges — the workload did not exercise "
+        f"the acquisition graph: {sorted(edges)}"
+    )
+    declared = order_edges()
+    covered = {e for e in edges if e in declared}
+    assert covered, (
+        "no observed edge matches a declared LOCK_ORDER edge — the "
+        "validator is not seeing the real lock nesting"
+    )
+    print(
+        f"lockdep_smoke: clean leg OK — {len(edges)} observed edges "
+        f"({len(covered)} declared) across 6 concurrent sessions + one "
+        "device loss, zero violations"
+    )
+
+    # ---- leg 2: a seeded inversion IS detected and flight-dumped ----- #
+    from modin_tpu.concurrency import named_lock, named_rlock
+    from modin_tpu.observability import flight_recorder
+
+    # leg 1's recovery dump consumed the shared rate-limit window; open
+    # it again so the seeded violation's dump is not rate-limited away
+    flight_recorder._last_dump = 0.0
+
+    lockdep.enable(strict=True)  # fresh validator: leg 1's edges dropped
+    inverted_dispatch = named_rlock("resilience.dispatch")
+    inverted_gate = named_lock("serving.gate")
+    raised = None
+    try:
+        with inverted_dispatch:
+            with inverted_gate:  # declared order says gate BEFORE dispatch
+                pass
+    except LockdepViolation as err:
+        raised = err
+    assert raised is not None, (
+        "the seeded gate-under-dispatch inversion was NOT detected — "
+        "the validator is blind to the PR-9 class it exists for"
+    )
+    assert raised.kind == "declared-contradiction", raised.kind
+    recorded = lockdep.violations()
+    assert len(recorded) == 1 and recorded[0].kind == "declared-contradiction"
+
+    dumps = glob.glob(os.path.join(_TRACE_DIR, "flightrec_lockdep*"))
+    assert dumps, (
+        f"no lockdep flight dump in {_TRACE_DIR} — the violation did not "
+        "leave forensics"
+    )
+    print(
+        "lockdep_smoke: detection leg OK — seeded inversion raised "
+        f"{raised.kind!r} and flight-dumped ({os.path.basename(dumps[0])})"
+    )
+    lockdep.disable()
+    print("lockdep_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(f"lockdep_smoke: FAIL — {err}", file=sys.stderr)
+        sys.exit(1)

@@ -29,6 +29,7 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_LOCKDEP"] = "1"  # lock-order validated throughout
 
 import jax  # noqa: E402
 
@@ -228,6 +229,16 @@ def main() -> int:
         f"gate admitted={snap['admitted']} shed={snap['shed']} "
         f"degraded={snap['degraded']}; "
         f"{len(serving_metrics)} serving.* metric families"
+    )
+    from modin_tpu.concurrency import lockdep
+
+    recorded = lockdep.violations()
+    assert not recorded, "lockdep violations under load:\n" + "\n".join(
+        v.render() for v in recorded
+    )
+    print(
+        f"graftdep: {len(lockdep.observed_edges())} lock-order edges "
+        "observed, zero violations"
     )
     return 0
 

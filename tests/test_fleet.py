@@ -36,6 +36,20 @@ _PARAMS = (FleetEnabled, FleetReplicas, FleetHeartbeatS, FleetRespawn,
 
 
 @pytest.fixture(autouse=True)
+def _lockdep_validated():
+    """The fleet suite runs under the runtime lock-order validator:
+    coordinator/replica-slot nesting plus every lock the serving stack
+    acquires underneath; violations recorded in any thread fail here."""
+    from modin_tpu.concurrency import lockdep
+
+    lockdep.enable(strict=True)
+    yield
+    recorded = lockdep.violations()
+    lockdep.disable()
+    assert not recorded, "\n".join(v.render() for v in recorded)
+
+
+@pytest.fixture(autouse=True)
 def _clean_fleet_state():
     saved = [(p, p.get()) for p in _PARAMS]
     yield

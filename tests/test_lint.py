@@ -29,6 +29,9 @@ ALL_RULE_IDS = {
     "FALLBACK-PARITY",
     "EXC-HYGIENE",
     "REGISTRY-DRIFT",
+    "LOCK-ORDER",
+    "LOCK-BLOCKING",
+    "THREAD-HYGIENE",
 }
 
 
@@ -50,7 +53,7 @@ def rules_hit(result):
 # ---------------------------------------------------------------------- #
 
 
-def test_all_five_rules_registered():
+def test_all_rules_registered():
     assert ALL_RULE_IDS <= set(all_rules())
 
 
@@ -880,6 +883,355 @@ def test_registry_drift_negative_docstrings_and_internal_tokens(tmp_path):
         select=["REGISTRY-DRIFT"],
     )
     # no docs/ dir -> doc checks skip; no undeclared-var findings either
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+# ---------------------------------------------------------------------- #
+# REGISTRY-DRIFT: the LOCKS leg (graftdep)
+# ---------------------------------------------------------------------- #
+
+_LOCKS_STUB = """
+from typing import Tuple
+
+LOCKS: Tuple[Tuple[str, str, str], ...] = (
+    ("app.ok", "lock", "fine"),
+    ("app.wrongkind", "rlock", "declared reentrant"),
+    ("app.dead", "lock", "declared, never constructed"),
+)
+LOCK_ORDER: Tuple[Tuple[str, str, str], ...] = ()
+"""
+
+
+def test_registry_drift_locks_positive(tmp_path):
+    """Both directions of the LOCKS cross-check, the kind leg, the raw
+    threading.Lock leg, and the docs leg — against an AnnAssign registry
+    (the real registry's ``LOCKS: Tuple[...] = (...)`` shape)."""
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/concurrency/registry.py": _LOCKS_STUB,
+            "docs/ref.md": "app.ok and app.wrongkind are documented.",
+            "modin_tpu/work.py": """
+            import threading
+            from modin_tpu.concurrency import named_lock, named_rlock
+
+            A = named_lock("app.ok")
+            B = named_lock("app.wrongkind")    # BAD: declared "rlock"
+            C = named_lock("app.ghost")        # BAD: undeclared
+            D = threading.Lock()               # BAD: raw, outside concurrency/
+            """,
+        },
+        select=["REGISTRY-DRIFT"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "lock-kind-app.wrongkind" in symbols
+    assert "undeclared-lock-app.ghost" in symbols
+    assert "raw-lock-Lock" in symbols
+    assert "dead-lock-app.dead" in symbols
+    assert "undocumented-lock-app.dead" in symbols
+    # the well-declared, constructed, documented lock is clean everywhere
+    assert not any(s.endswith("app.ok") for s in symbols)
+
+
+def test_registry_drift_locks_negative(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/concurrency/registry.py": """
+            LOCKS = (
+                ("app.ok", "lock", "fine"),
+                ("app.re", "rlock", "fine"),
+            )
+            """,
+            "modin_tpu/concurrency/lockdep.py": """
+            import threading
+
+            def named_lock(name):
+                return threading.Lock()   # raw INSIDE concurrency/: exempt
+            """,
+            "modin_tpu/work.py": """
+            from modin_tpu.concurrency import named_lock, named_rlock
+
+            A = named_lock("app.ok")
+            B = named_rlock("app.re")
+
+            def make(name):
+                return named_lock(name)   # forwarding wrapper: not a site
+            """,
+        },
+        select=["REGISTRY-DRIFT"],
+    )
+    # no docs/ dir -> the undocumented-lock leg skips too
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+# ---------------------------------------------------------------------- #
+# LOCK-ORDER
+# ---------------------------------------------------------------------- #
+
+_ORDER_REGISTRY = """
+from typing import Tuple
+
+LOCKS: Tuple[Tuple[str, str, str], ...] = (
+    ("app.outer", "lock", "x"),
+    ("app.inner", "lock", "y"),
+)
+LOCK_ORDER: Tuple[Tuple[str, str, str], ...] = (
+    ("app.outer", "app.inner", "outer admits into inner"),
+)
+"""
+
+
+def test_lock_order_flags_declared_contradiction(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/concurrency/registry.py": _ORDER_REGISTRY,
+            "modin_tpu/work.py": """
+            from modin_tpu.concurrency import named_lock
+
+            OUTER = named_lock("app.outer")
+            INNER = named_lock("app.inner")
+
+            def inverted():
+                with INNER:
+                    with OUTER:      # declared order says outer FIRST
+                        pass
+            """,
+        },
+        select=["LOCK-ORDER"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "contradicts-app.inner-app.outer" in symbols
+
+
+def test_lock_order_declared_nesting_is_clean(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/concurrency/registry.py": _ORDER_REGISTRY,
+            "modin_tpu/work.py": """
+            from modin_tpu.concurrency import named_lock
+
+            OUTER = named_lock("app.outer")
+            INNER = named_lock("app.inner")
+
+            def fine():
+                with OUTER:
+                    with INNER:      # matches the declared order
+                        pass
+                with span("not.a.lock"):   # unresolvable: never a lock
+                    pass
+            """,
+        },
+        select=["LOCK-ORDER"],
+    )
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_lock_order_flags_abba_cycle_across_files(tmp_path):
+    """Two files nest the same (undeclared-order) pair in opposite
+    directions — the observed graph cycles even with no LOCK_ORDER edge,
+    and binding resolution crosses the import graph."""
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/mod_a.py": """
+            from modin_tpu.concurrency import named_lock
+
+            X = named_lock("app.x")
+            Y = named_lock("app.y")
+
+            def forward():
+                with X:
+                    with Y:
+                        pass
+            """,
+            "modin_tpu/mod_b.py": """
+            from modin_tpu.mod_a import X, Y
+
+            def backward():
+                with Y:
+                    with X:
+                        pass
+            """,
+        },
+        select=["LOCK-ORDER"],
+    )
+    assert any(f.symbol.startswith("cycle-") for f in result.findings), [
+        f.render() for f in result.findings
+    ]
+
+
+def test_lock_order_flags_undeclared_raw_lock(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/work.py": """
+            import threading
+
+            _L = threading.Lock()
+
+            def f():
+                with _L:
+                    pass
+            """,
+        },
+        select=["LOCK-ORDER"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "undeclared-lock" in symbols
+
+
+# ---------------------------------------------------------------------- #
+# LOCK-BLOCKING
+# ---------------------------------------------------------------------- #
+
+
+def test_lock_blocking_flags_sleep_direct_and_via_one_hop(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/work.py": """
+            import time
+            from modin_tpu.concurrency import named_lock
+
+            L = named_lock("app.l")
+
+            def helper():
+                time.sleep(1.0)
+
+            def direct():
+                with L:
+                    time.sleep(0.1)        # BAD: blocking under the lock
+
+            def indirect():
+                with L:
+                    helper()               # BAD: reachable one hop down
+            """,
+        },
+        select=["LOCK-BLOCKING"],
+    )
+    hits = [f for f in result.findings if f.symbol == "blocking-app.l-sleep"]
+    assert len(hits) == 2, [f.render() for f in result.findings]
+    assert any("via helper()" in f.message for f in hits)
+
+
+def test_lock_blocking_flags_pickle_under_lock(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/work.py": """
+            import pickle
+            from modin_tpu.concurrency import named_lock
+
+            L = named_lock("app.l")
+
+            def probe(state):
+                with L:
+                    return len(pickle.dumps(state))   # the exporter class
+            """,
+        },
+        select=["LOCK-BLOCKING"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert "blocking-app.l-pickle" in symbols
+
+
+def test_lock_blocking_negative_outside_lock_and_timed_get(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/work.py": """
+            import queue
+            import time
+            from modin_tpu.concurrency import named_lock
+
+            L = named_lock("app.l")
+            Q = queue.Queue()
+
+            def snapshot_then_act():
+                with L:
+                    item = Q.get(timeout=1.0)   # timed get: bounded, legal
+                time.sleep(0.1)                 # after release: legal
+                return item
+            """,
+        },
+        select=["LOCK-BLOCKING"],
+    )
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+# ---------------------------------------------------------------------- #
+# THREAD-HYGIENE
+# ---------------------------------------------------------------------- #
+
+
+def test_thread_hygiene_positive_all_three_legs(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/work.py": """
+            import threading
+
+            def worker():
+                pass
+
+            def spawn():
+                threading.Thread(target=worker).start()
+            """,
+        },
+        select=["THREAD-HYGIENE"],
+    )
+    symbols = {f.symbol for f in result.findings}
+    assert symbols == {
+        "unnamed-worker",
+        "undaemonized-worker",
+        "unseeded-worker",
+    }
+
+
+def test_thread_hygiene_negative_seeded_and_unresolvable(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "modin_tpu/work.py": """
+            import threading
+            from modin_tpu.observability import meters as graftmeter
+            from modin_tpu.observability import spans as graftscope
+
+            def worker(stack, scopes):
+                graftscope.seed_thread(stack)
+                graftmeter.seed_thread_scopes(scopes)
+                try:
+                    pass
+                finally:
+                    graftscope.seed_thread(None)
+                    graftmeter.seed_thread_scopes(None)
+
+            def seed_all(stack, scopes):
+                graftscope.seed_thread(stack)
+                graftmeter.seed_thread_scopes(scopes)
+
+            def hopper():
+                seed_all(None, None)    # one same-file call-hop: counts
+
+            def spawn(ext):
+                threading.Thread(
+                    target=worker, name="modin-tpu-w", daemon=True,
+                    args=(graftscope.snapshot_stack(),
+                          graftmeter.snapshot_scopes()),
+                ).start()
+                threading.Thread(
+                    target=hopper, name="modin-tpu-h", daemon=True
+                ).start()
+                threading.Thread(     # cross-module callable: exempt from
+                    target=ext.run, name="modin-tpu-x", daemon=True
+                ).start()             # the seeding leg, never guessed at
+            """,
+        },
+        select=["THREAD-HYGIENE"],
+    )
     assert not result.findings, [f.render() for f in result.findings]
 
 

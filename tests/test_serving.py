@@ -59,6 +59,20 @@ _PARAMS = (
 
 
 @pytest.fixture(autouse=True)
+def _lockdep_validated():
+    """The serving suite runs under the runtime lock-order validator
+    (the gate/tenants/breaker nesting is exactly what LOCK_ORDER
+    declares); any recorded violation fails the test that caused it."""
+    from modin_tpu.concurrency import lockdep
+
+    lockdep.enable(strict=True)
+    yield
+    recorded = lockdep.violations()
+    lockdep.disable()
+    assert not recorded, "\n".join(v.render() for v in recorded)
+
+
+@pytest.fixture(autouse=True)
 def _clean_serving_state():
     """Fresh gate/tenants/breakers, zero backoff, restored knobs per test."""
     saved = [(p, p.get()) for p in _PARAMS]

@@ -508,8 +508,12 @@ class TestConfigureLoggingRace:
     def test_reconfigure_is_noop_and_keeps_sampler_handle(self):
         import modin_tpu.logging.config as cfg
 
+        from modin_tpu.concurrency.lockdep import DepLock
+
         lock = cfg._configure_lock
-        assert isinstance(lock, type(threading.Lock()))
+        # a registry-named non-reentrant mutex (graftdep wraps the raw lock)
+        assert isinstance(lock, DepLock) and not lock.reentrant
+        assert lock.name == "logging.configure"
         # simulate "already configured": the body must not run again
         saved = cfg.__LOGGER_CONFIGURED__
         cfg.__LOGGER_CONFIGURED__ = True
