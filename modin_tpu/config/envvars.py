@@ -1307,6 +1307,127 @@ class ViewsMaxGroups(EnvironmentVariable, type=int):
         super().put(value)
 
 
+class ViewsMaxChain(EnvironmentVariable, type=int):
+    """Append-link chain bound for the graftview registry: a fold lookup
+    walks at most this many parent links, and ``note_append`` compacts a
+    column's chain (re-anchoring its link past artifact-less intermediate
+    tokens, ``view.chain_compact``) once its depth crosses the bound.
+    Thousands of micro-batch appends (graftfeed) would otherwise make the
+    chain walk O(appends) per lookup — or, at the old hardcoded 8-hop cap,
+    silently lose foldability after eight un-queried appends."""
+
+    varname = "MODIN_TPU_VIEWS_MAX_CHAIN"
+    default = 64
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Views chain bound should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class IngestEnabled(EnvironmentVariable, type=bool):
+    """graftfeed continuous ingestion (modin_tpu/ingest/): named ``Feed``
+    objects accepting append/upsert micro-batches with schema validation,
+    registered live views maintained incrementally on every ingest, and
+    staleness-bounded reads (``fresh_within_ms``) wired through the
+    serving admission gate.
+
+    Off by default: no feed or view object exists and nothing on any hot
+    path allocates (``modin_tpu.ingest.ingest_alloc_count()`` asserts it,
+    graftscope-style) — bit-for-bit the pre-graftfeed behavior.
+    """
+
+    varname = "MODIN_TPU_INGEST"
+    default = False
+
+    @classmethod
+    def enable(cls):
+        cls.put(True)
+
+    @classmethod
+    def disable(cls):
+        cls.put(False)
+
+
+class IngestFoldEvery(EnvironmentVariable, type=int):
+    """Fold registered live views every N accepted micro-batches (1, the
+    default, maintains every view synchronously on every ingest).  Larger
+    values trade freshness for ingest throughput: pending batches
+    accumulate fold lag, which staleness-bounded reads observe — a read
+    whose ``fresh_within_ms`` bound the lag exceeds forces a synchronous
+    fold of the backlog."""
+
+    varname = "MODIN_TPU_INGEST_FOLD_EVERY"
+    default = 1
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Ingest fold cadence should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class IngestRetentionRows(EnvironmentVariable, type=int):
+    """Default per-feed retention bound, in rows (0 = unbounded).  When a
+    feed crosses it, whole oldest micro-batches are trimmed off the frame
+    prefix (``ingest.trim.rows``) and every live view refolds from its
+    retained per-batch partials — no full recompute, and still-foldable
+    graftview artifacts on the retained frame stay valid.  ``create_feed``
+    accepts a per-feed override."""
+
+    varname = "MODIN_TPU_INGEST_RETENTION_ROWS"
+    default = 0
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Ingest retention rows should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class IngestRetentionAgeS(EnvironmentVariable, type=float):
+    """Default per-feed retention age bound, in seconds (0 = unbounded):
+    micro-batches whose arrival time is older than this are trimmed off
+    the feed's prefix on the next ingest, same trim path as the row
+    bound.  ``create_feed`` accepts a per-feed override."""
+
+    varname = "MODIN_TPU_INGEST_RETENTION_AGE_S"
+    default = 0.0
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Ingest retention age should be >= 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class IngestFoldLagMs(EnvironmentVariable, type=float):
+    """graftwatch ``fold_lag`` tripwire threshold, milliseconds: the rule
+    fires (and captures a rate-limited evidence bundle) when any live
+    view's fold lag — the age of its oldest unfolded micro-batch —
+    exceeds this while the watch sampler is running."""
+
+    varname = "MODIN_TPU_INGEST_FOLD_LAG_MS"
+    default = 1000.0
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"Ingest fold-lag threshold should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

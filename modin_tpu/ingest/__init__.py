@@ -1,0 +1,74 @@
+"""graftfeed — continuous ingestion & registered live views.
+
+The feature-store serving scenario (ROADMAP item 4, arXiv 2001.00888's
+incremental-view-maintenance gap): named :class:`~modin_tpu.ingest.feed.
+Feed`\\ s accept append/upsert micro-batches with schema validation,
+grow one modin frame through the ordinary ``concat`` path (graftplan
+pushdown on the delta, graftview append links on the frame), and
+maintain **registered live views** — filtered / top-k / windowed /
+scalar / groupby aggregates — incrementally on every ingest via the fold
+algebra in live.py.  Reads are staleness-bounded (``fresh_within_ms``)
+and admitted, like the ingest itself, through graftgate's one admission
+gate; freshness feeds per-view SLO burn in graftwatch plus the
+``fold_lag`` tripwire.
+
+``MODIN_TPU_INGEST=0`` (the default) is bit-for-bit pre-graftfeed:
+:func:`create_feed` refuses, no hot path consults this package, and
+:func:`ingest_alloc_count` stays 0 over any non-ingest workload — the
+same zero-overhead-when-off contract as graftscope/graftwatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+#: Module-level fast path: True while MODIN_TPU_INGEST=1.  The ONE
+#: attribute anything ingest-adjacent checks before doing work.
+INGEST_ON: bool = False
+
+
+def _on_ingest_enabled(param: Any) -> None:
+    global INGEST_ON
+    INGEST_ON = bool(param.get())
+
+
+from modin_tpu.config import IngestEnabled as _IngestEnabled  # noqa: E402
+
+_IngestEnabled.subscribe(_on_ingest_enabled)
+
+from modin_tpu.ingest.errors import (  # noqa: E402,F401
+    IngestError,
+    IngestRejected,
+    ViewNotIncrementalizable,
+)
+from modin_tpu.ingest.feed import (  # noqa: E402,F401
+    Feed,
+    ViewRead,
+    create_feed,
+    drop_feed,
+    feeds,
+    get_feed,
+    max_fold_lag_ms,
+    reset,
+)
+from modin_tpu.ingest.live import (  # noqa: E402,F401
+    LiveView,
+    ingest_alloc_count,
+)
+
+__all__ = [
+    "Feed",
+    "INGEST_ON",
+    "IngestError",
+    "IngestRejected",
+    "LiveView",
+    "ViewNotIncrementalizable",
+    "ViewRead",
+    "create_feed",
+    "drop_feed",
+    "feeds",
+    "get_feed",
+    "ingest_alloc_count",
+    "max_fold_lag_ms",
+    "reset",
+]

@@ -588,6 +588,86 @@ METRICS = (
         "the public readers (io lineage / spans / cost accounting all see "
         "the replay)",
     ),
+    (
+        "ingest.batch",
+        "counter",
+        "graftfeed micro-batches admitted (append or the appending half "
+        "of an upsert) after schema validation",
+    ),
+    (
+        "ingest.rows",
+        "counter",
+        "graftfeed rows admitted per micro-batch (value = batch row count)",
+    ),
+    (
+        "ingest.reject",
+        "counter",
+        "graftfeed micro-batches rejected with a typed IngestRejected "
+        "(schema/dtype mismatch, malformed payload, key violation)",
+    ),
+    (
+        "ingest.upsert",
+        "counter",
+        "graftfeed keyed rows updated in place by an upsert batch (value "
+        "= updated row count; each upsert also rebuilds the views)",
+    ),
+    (
+        "ingest.trim.rows",
+        "counter",
+        "graftfeed rows trimmed off a feed's prefix by retention bounds "
+        "(row-count / age); views refold from retained partials",
+    ),
+    (
+        "ingest.fold",
+        "counter",
+        "graftfeed pending micro-batches folded into every registered "
+        "view's running state (value = batches folded in the pass)",
+    ),
+    (
+        "ingest.rebuild",
+        "counter",
+        "graftfeed exact view rebuilds (value = views rebuilt): upserts "
+        "and bootstrap-intersecting trims collapse the partial log to one "
+        "bootstrap partial over the retained frame",
+    ),
+    (
+        "ingest.view.refused",
+        "counter",
+        "graftfeed view registrations refused with a typed "
+        "ViewNotIncrementalizable (never silently recomputed)",
+    ),
+    (
+        "ingest.read.served",
+        "counter",
+        "graftfeed staleness-bounded reads served straight off the "
+        "maintained view state (fold lag inside the freshness bound)",
+    ),
+    (
+        "ingest.read.forced_fold",
+        "counter",
+        "graftfeed reads whose freshness bound forced a synchronous fold "
+        "of the pending batches before serving",
+    ),
+    (
+        "view.lag_ms",
+        "histogram",
+        "fold lag observed at each graftfeed view read (ms): age of the "
+        "oldest unfolded batch at serve time (0 after a forced fold)",
+    ),
+    (
+        "view.chain_compact",
+        "counter",
+        "graftview append-link chains compacted past "
+        "MODIN_TPU_VIEWS_MAX_CHAIN (note_append re-anchoring plus lookup "
+        "path compression) — keeps micro-batch fold walks O(1)",
+    ),
+    (
+        "structural.append_fastpath",
+        "counter",
+        "concat_rows micro-batch fast path taken: the small tail was "
+        "placed into the grown prefix buffer instead of re-gathering "
+        "every row",
+    ),
 )
 
 

@@ -161,6 +161,23 @@ SPANS = (
         "context (tenant / label / degraded in attributes); everything "
         "the query dispatched nests under it",
     ),
+    (
+        "ingest.append",
+        "one graftfeed micro-batch admitted into a feed: schema-validated "
+        "rows concatenated onto the frame, views folded per policy, "
+        "retention applied (feed / row count in attributes)",
+    ),
+    (
+        "ingest.fold",
+        "one pending micro-batch folded into every registered live view's "
+        "running state (feed / batch seq in attributes)",
+    ),
+    (
+        "ingest.read",
+        "one staleness-bounded live-view read: fold-lag check, optional "
+        "forced synchronous fold, state snapshot (feed / view in "
+        "attributes)",
+    ),
 )
 
 _EPOCH_PERF = time.perf_counter()

@@ -357,6 +357,21 @@ def observe_query(
         pass
 
 
+def observe_view_read(view_key: str, lag_s: float) -> None:
+    """One graftfeed live-view read: feed the view's freshness into the
+    SLO burn machinery under a synthetic ``view:<feed>/<view>`` tenant, so
+    per-view staleness burn surfaces in ``/statusz`` and the ``slo_burn``
+    verdicts exactly like per-tenant latency does.  Callers check
+    :data:`WATCH_ON` first (the zero-overhead contract)."""
+    service = _service
+    if service is None or not WATCH_ON:
+        return
+    try:
+        service.slo.observe(f"view:{view_key}", lag_s)
+    except Exception:
+        pass
+
+
 def slo_health() -> Dict[str, dict]:
     """Per-tenant burn verdicts ({} while off/untracked) — the advisory
     signal graftgate surfaces next to its breakers."""

@@ -26,7 +26,10 @@ The default catalog (docs/observability.md holds the operator table):
   trying to use;
 - ``shed_spike`` — >= :data:`SHED_MIN_EVENTS` typed sheds in the window;
 - ``slo_burn`` — some tenant's multi-window SLO burn verdict is
-  breaching (slo.py).
+  breaching (slo.py);
+- ``fold_lag`` — graftfeed's worst live-view fold lag exceeds
+  ``MODIN_TPU_INGEST_FOLD_LAG_MS``: ingest is outrunning view
+  maintenance and staleness-bounded reads are paying forced folds.
 
 Every evaluation is exception-isolated: a broken rule logs nothing and
 trips nothing, it never reaches the sampler loop.
@@ -193,6 +196,26 @@ def _slo_burn(service, now: float) -> Optional[str]:
     return f"SLO error budget burning faster than sustainable for: {parts}"
 
 
+def _fold_lag(service, now: float) -> Optional[str]:
+    import sys
+
+    ingest_mod = sys.modules.get("modin_tpu.ingest")
+    if ingest_mod is None or not ingest_mod.INGEST_ON:
+        return None
+    from modin_tpu.config import IngestFoldLagMs
+
+    bound_ms = float(IngestFoldLagMs.get())
+    lag_ms = ingest_mod.max_fold_lag_ms()
+    if lag_ms > bound_ms:
+        return (
+            f"live-view fold lag {lag_ms:.0f}ms exceeds the "
+            f"{bound_ms:g}ms bound (MODIN_TPU_INGEST_FOLD_LAG_MS) — "
+            "ingest is outrunning view maintenance; staleness-bounded "
+            "reads are paying forced synchronous folds"
+        )
+    return None
+
+
 def default_rules() -> List[Tripwire]:
     return [
         Tripwire(
@@ -219,6 +242,11 @@ def default_rules() -> List[Tripwire]:
             "slo_burn",
             "a tenant's multi-window SLO burn rate is breaching",
             _slo_burn,
+        ),
+        Tripwire(
+            "fold_lag",
+            "graftfeed live-view fold lag exceeds the configured bound",
+            _fold_lag,
         ),
     ]
 

@@ -188,12 +188,71 @@ def _jit_concat(n_parts: int, n_cols: int, lengths: Tuple[int, ...], p_out: int)
     return jax.jit(fn)
 
 
+#: tail appends at least this many times smaller than the prefix take the
+#: micro-batch fast path (graftfeed ingest: a 1k-row batch onto a 10M-row
+#: feed must not re-gather all 10M rows).  Module-level so the ingest bench
+#: can disable the fast path to measure the win honestly.
+_APPEND_FASTPATH_RATIO = 8
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_tail_append(n_cols: int, p_out: int):
+    """Append a small tail onto a large prefix WITHOUT the gather re-layout
+    of ``_jit_concat``: the prefix is copied once into the grown buffer
+    (a contiguous memcpy XLA fuses, not an O(p_out) dynamic-index take) and
+    the tail rows are placed at ``[start, start + tail_n)`` via roll+where.
+    ``start``/``tail_n`` are dynamic scalars, so the compiled program is
+    keyed only on the padded shapes — consecutive micro-batch appends that
+    land inside the same pad bucket reuse it."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(prefix: Tuple, tail: Tuple, start, tail_n):
+        idx = jnp.arange(p_out, dtype=jnp.int64)
+        in_tail = (idx >= start) & (idx < start + tail_n)
+        out = []
+        for ci in range(n_cols):
+            big = prefix[ci]
+            grown = jnp.zeros((p_out,), big.dtype).at[: big.shape[0]].set(big)
+            t = tail[ci]
+            tpad = jnp.zeros((p_out,), t.dtype).at[: t.shape[0]].set(t)
+            # no wrap in the selected region: start + tail_n <= p_out
+            rolled = jnp.roll(tpad, start, axis=0)
+            out.append(jnp.where(in_tail, rolled, grown))
+        return tuple(out)
+
+    return jax.jit(fn)
+
+
 def concat_columns(parts: List[List[Any]], lengths: List[int]) -> Tuple[List[Any], int]:
     """Row-concat column sets (each padded), producing padded outputs."""
+    from modin_tpu.logging.metrics import emit_metric
     from modin_tpu.parallel.engine import JaxWrapper
 
     n_out = sum(lengths)
     p_out = pad_len(n_out)
+    if (
+        len(parts) == 2
+        and lengths[1] > 0
+        and lengths[1] * _APPEND_FASTPATH_RATIO <= lengths[0]
+        and all(getattr(c, "ndim", 0) == 1 for p in parts for c in p)
+        # physical sizes may exceed the minimal pad (graftfuse pad buckets)
+        and all(c.shape[0] <= p_out for p in parts for c in p)
+    ):
+        fn = _jit_tail_append(len(parts[0]), p_out)
+        out = list(
+            JaxWrapper.deploy(
+                fn,
+                (
+                    tuple(parts[0]),
+                    tuple(parts[1]),
+                    np.int64(lengths[0]),
+                    np.int64(lengths[1]),
+                ),
+            )
+        )
+        emit_metric("structural.append_fastpath", 1)
+        return out, n_out
     fn = _jit_concat(len(parts), len(parts[0]), tuple(lengths), p_out)
     return list(JaxWrapper.deploy(fn, (tuple(tuple(p) for p in parts),))), n_out
 

@@ -87,6 +87,15 @@ _entries: "OrderedDict[Tuple[int, str, Any], Any]" = OrderedDict()
 _by_token: Dict[int, set] = {}
 _host_bytes_total = 0
 
+#: token -> its append-chain depth (number of parent links below it);
+#: maintained by note_append, consulted to trigger chain compaction
+_link_depth: Dict[int, int] = {}
+#: chain-walk accounting: the satellite regression test proves lookup cost
+#: stays flat across 1k appends by watching hops-per-lookup through these
+_chain_compactions = 0
+_walk_hops_total = 0
+_walk_lookups = 0
+
 
 def ensure_token(col: Any) -> int:
     """``col``'s view token, allocating one on first use (lock held or not —
@@ -104,20 +113,62 @@ def ensure_token(col: Any) -> int:
     return tok
 
 
+def _max_chain() -> int:
+    from modin_tpu.config import ViewsMaxChain
+
+    return int(ViewsMaxChain.get())
+
+
+def _compact_link_locked(link: Tuple[int, int]) -> Tuple[Tuple[int, int], int]:
+    """Follow ``link`` past artifact-less tokens, returning the first link
+    whose token holds ANY artifact (or the deepest reachable link) plus the
+    number of links skipped.  Sound because prefix-of-prefix is a prefix:
+    re-anchoring to a transitive ancestor loses nothing when every skipped
+    intermediate token has nothing to serve."""
+    skipped = 0
+    bound = _max_chain()
+    while skipped < bound:
+        ptok, _plen = link
+        if ptok in _by_token:
+            break  # this ancestor holds artifacts: stay reachable
+        nxt = _parent_links.get(ptok)
+        if nxt is None:
+            break
+        link = nxt
+        skipped += 1
+    return link, skipped
+
+
 def note_append(child: Any, parent: Any) -> None:
     """Record that ``child``'s first ``parent.length`` rows ARE ``parent``'s
     rows (concat_rows).  The child gets its own fresh token; the parent link
-    is what fold lookups walk."""
+    is what fold lookups walk.  Chains deeper than MODIN_TPU_VIEWS_MAX_CHAIN
+    are compacted: the child's link re-anchors past artifact-less
+    intermediate tokens, so sustained micro-batch ingest (graftfeed) keeps
+    the walk O(1) instead of O(appends)."""
+    global _chain_compactions
+    compacted = 0
     with LOCK:
         ptok = ensure_token(parent)
         ctok = ensure_token(child)
-        child._view_parent = (ptok, int(parent.length))
-        # record the link by token too, so fold lookups can walk chains
-        # whose intermediate column objects have been collected
-        _note_link_locked(ctok, child._view_parent)
+        link = (ptok, int(parent.length))
+        depth = _link_depth.get(ptok, 0) + 1
         plink = getattr(parent, "_view_parent", None)
         if plink is not None:
             _note_link_locked(ptok, plink)
+        if depth > _max_chain():
+            link, skipped = _compact_link_locked(link)
+            if skipped:
+                depth = _link_depth.get(link[0], 0) + 1
+                compacted = 1
+                _chain_compactions += 1
+        child._view_parent = link
+        # record the link by token too, so fold lookups can walk chains
+        # whose intermediate column objects have been collected
+        _note_link_locked(ctok, link)
+        _link_depth[ctok] = depth
+    if compacted:
+        emit_metric("view.chain_compact", 1)
 
 
 def _current_epoch() -> int:
@@ -305,6 +356,7 @@ def lookup(
     mutate it — folds build a fresh state dict and commit it with
     :func:`store`.
     """
+    global _walk_hops_total, _walk_lookups, _chain_compactions
     tok = getattr(col, "_view_token", None)
     if tok is None or col._data is None or getattr(col, "is_lazy", False):
         return ("miss", None, 0)
@@ -328,7 +380,9 @@ def lookup(
             # walk the parent chain for a foldable ancestor artifact
             link = getattr(col, "_view_parent", None)
             hops = 0
-            while link is not None and hops < 8:
+            bound = _max_chain()
+            passed_clean = True  # every skipped token artifact-free?
+            while link is not None and hops < bound:
                 ptok, plen = link
                 art = _entries.get((ptok, kind, params))
                 if art is not None and art.live:
@@ -340,6 +394,18 @@ def lookup(
                         if art.can_fold:
                             _entries.move_to_end((ptok, kind, params))
                             outcome = ("fold", art.state, plen)
+                            if hops > 0 and passed_clean:
+                                # path compression: every token walked
+                                # through holds nothing for ANY kind, so
+                                # re-anchoring the column straight to this
+                                # ancestor loses no other lookup — the next
+                                # walk is one hop
+                                col._view_parent = (ptok, plen)
+                                _note_link_locked(tok, (ptok, plen))
+                                _link_depth[tok] = (
+                                    _link_depth.get(ptok, 0) + 1
+                                )
+                                _chain_compactions += 1
                         else:
                             # honest invalidation: this artifact cannot
                             # absorb an append — name the reason.  Drop it
@@ -353,8 +419,12 @@ def lookup(
                 # follow the chain through columns the registry has seen;
                 # parent links of dead intermediate columns are
                 # unreachable, which is fine — deeper folds save less
+                if ptok in _by_token:
+                    passed_clean = False
                 link = _parent_links.get(ptok)
                 hops += 1
+            _walk_hops_total += hops
+            _walk_lookups += 1
     # metric fan-out OUTSIDE the lock (user metric handlers can be slow or
     # raise; neither may stall or break other threads' consults)
     _emit_dropped(pending)
@@ -395,7 +465,8 @@ _PARENT_LINKS_MAX = 65536
 def _note_link_locked(token: int, link: Tuple[int, int]) -> None:
     _parent_links[token] = link
     while len(_parent_links) > _PARENT_LINKS_MAX:
-        _parent_links.popitem(last=False)
+        old_tok, _ = _parent_links.popitem(last=False)
+        _link_depth.pop(old_tok, None)
 
 
 def store(
@@ -456,7 +527,8 @@ def invalidate_ancestor(col: Any, kind: str, params: Any, reason: str) -> None:
     pending: List[str] = []
     with LOCK:
         hops = 0
-        while link is not None and hops < 8:
+        bound = _max_chain()
+        while link is not None and hops < bound:
             ptok, _plen = link
             art = _entries.get((ptok, kind, params))
             if art is not None and art.live:
@@ -480,7 +552,8 @@ def amend_ancestor_state(
     link = getattr(col, "_view_parent", None)
     with LOCK:
         hops = 0
-        while link is not None and hops < 8:
+        bound = _max_chain()
+        while link is not None and hops < bound:
             ptok, plen = link
             art = _entries.get((ptok, kind, params))
             if art is not None and art.live and art.length == base_len:
@@ -520,6 +593,19 @@ def stats() -> dict:
         }
 
 
+def walk_stats() -> dict:
+    """Chain-walk accounting: total lookups that walked the parent chain,
+    total hops spent, and chain compactions performed (note_append bound
+    + lookup path compression).  The satellite regression test asserts
+    hops-per-lookup stays flat across 1k micro-batch appends."""
+    with LOCK:
+        return {
+            "lookups": _walk_lookups,
+            "hops": _walk_hops_total,
+            "compactions": _chain_compactions,
+        }
+
+
 def live_artifacts() -> List[Any]:
     with LOCK:
         return list(_entries.values())
@@ -527,9 +613,14 @@ def live_artifacts() -> List[Any]:
 
 def reset() -> None:
     """Drop every artifact (tests)."""
+    global _chain_compactions, _walk_hops_total, _walk_lookups
     with LOCK:
         for art in list(_entries.values()):
             art.drop()
         _entries.clear()
         _by_token.clear()
         _parent_links.clear()
+        _link_depth.clear()
+        _chain_compactions = 0
+        _walk_hops_total = 0
+        _walk_lookups = 0

@@ -41,6 +41,7 @@ EXPECTED_SECTIONS = (
     "shuffle_apply_virtual_mesh",
     "oocore",
     "fleet",
+    "ingest",
 )
 
 SMOKE_ENV = {
@@ -70,6 +71,11 @@ SMOKE_ENV = {
     # (routing, kill, MTTR) it exists to time
     "BENCH_FLEET_ROWS": "60000",
     "BENCH_FLEET_QUERIES": "10",
+    # sustained ingest at smoke scale: enough micro-batches for the fast
+    # path to fire (tail << prefix after ~8 batches) and for concurrent
+    # readers to land several bounded reads, small enough to stay quick
+    "BENCH_INGEST_BATCHES": "60",
+    "BENCH_INGEST_BATCH_ROWS": "64",
     # same reasoning as the recovery overhead: the 5% graftwatch telemetry
     # budget belongs to full-scale runs, a ~5ms admitted p50 flakes on noise
     "BENCH_WATCH_OVERHEAD_PCT": "100",

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all eighteen gates, fail on any red
+#   ./scripts/check_all.sh            # all nineteen gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -73,6 +73,13 @@
 #       deliberately seeded gate-under-dispatch inversion must raise
 #       LockdepViolation AND flight-dump the witness — the tripwire is
 #       proven live, not just quiet
+#   0n. graftfeed ingest smoke: >= 200 micro-batches streamed through the
+#       admission gate under lockdep strict while 4 concurrent sessions
+#       issue staleness-bounded reads against registered live views —
+#       every read bit-exact vs pandas over exactly its covered rows,
+#       freshness bounds honored, retention-trim + mid-ingest DeviceLost
+#       bit-exact, the fold_lag tripwire fires with exactly ONE evidence
+#       bundle, and maintained reads beat recompute >= 3x
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -110,6 +117,7 @@ run_gate "graftview"       python scripts/views_smoke.py
 run_gate "graftwatch"      python scripts/watch_smoke.py
 run_gate "graftfleet"      python scripts/fleet_smoke.py
 run_gate "graftdep"        python scripts/lockdep_smoke.py
+run_gate "graftfeed"       python scripts/ingest_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -119,4 +127,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL EIGHTEEN GATES GREEN"
+echo "ALL NINETEEN GATES GREEN"

@@ -1,0 +1,355 @@
+"""graftfeed smoke gate: sustained ingestion with live views under load.
+
+Run by scripts/check_all.sh (the nineteenth gate).  On the 8-device
+virtual CPU mesh, under MODIN_TPU_LOCKDEP strict the whole way, it
+asserts the continuous-ingestion contract end to end:
+
+1. **sustained ingest + concurrent staleness-bounded reads** — one
+   writer streams >= 200 micro-batches through the serving admission
+   gate while four reader sessions issue ``fresh_within_ms``-bounded
+   reads against four registered view kinds (scalar / filtered / top-k /
+   windowed); EVERY read must be bit-exact vs pandas over exactly the
+   rows its fold coverage claims (``covered_rows``), the freshness bound
+   must be honored (a zero-bound read either forced a fold or observed
+   zero lag), both tenants must land in the gate snapshot, and the
+   ``concat_rows`` micro-batch fast path must have fired;
+2. **retention-trim + mid-fold DeviceLost** — a row-bounded feed trims
+   whole oldest batches mid-stream and one append's concat dispatch dies
+   to an injected DeviceLost: filtered, top-k, and windowed views must
+   all answer bit-exact over the retained suffix with ZERO
+   ``recovery.unrecoverable``;
+3. **the fold_lag tripwire** — with folding deferred and an injected
+   slow fold, the graftwatch sampler must trip ``fold_lag`` and land
+   exactly ONE rate-limited evidence bundle (``watchtrip_fold_lag_*``)
+   in MODIN_TPU_TRACE_DIR; the backlog then folds down bit-exact;
+4. **maintained beats recompute** — reading the maintained artifact must
+   be >= 3x faster than ``recompute()`` from scratch;
+5. **zero hangs, zero lockdep violations** — every thread joins inside
+   the budget and the strict validator recorded nothing.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import glob
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_LOCKDEP"] = "1"
+os.environ["MODIN_TPU_INGEST"] = "1"
+_TRACE_DIR = tempfile.mkdtemp(prefix="ingest_smoke_traces_")
+os.environ["MODIN_TPU_TRACE_DIR"] = _TRACE_DIR
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pandas  # noqa: E402
+
+BATCHES = 220
+BATCH_ROWS = 32
+READERS = 4
+JOIN_BUDGET_S = 180.0
+K = 7
+BUCKET_S = 5.0
+
+_SCHEMA = {"i": "int64", "x": "float64", "g": "int64", "ts": "float64"}
+
+_PLANS = {
+    "running_sum": {"kind": "scalar", "column": "i", "agg": "sum"},
+    "hot_rows": {
+        "kind": "filtered", "column": "i", "agg": "sum",
+        "predicate": ("x", ">", 0.0),
+    },
+    "leaders": {"kind": "topk", "column": "x", "k": K},
+    "by_minute": {
+        "kind": "windowed", "column": "i", "time_column": "ts",
+        "agg": "sum", "bucket_s": BUCKET_S,
+    },
+}
+
+
+def _mk_batch(rng, n=BATCH_ROWS):
+    return pandas.DataFrame(
+        {
+            "i": rng.integers(-1000, 1000, n),
+            "x": rng.normal(size=n),
+            "g": rng.integers(0, 8, n),
+            "ts": rng.uniform(0.0, 120.0, n),
+        }
+    )
+
+
+def _truth(view, pdf, base=0):
+    if view == "running_sum":
+        return pdf["i"].sum()
+    if view == "hot_rows":
+        return pdf["i"][pdf["x"] > 0.0].sum()
+    if view == "leaders":
+        s = pdf["x"].copy()
+        s.index = np.arange(base, base + len(pdf), dtype=np.int64)
+        return s.nlargest(K, keep="first")
+    keys = np.floor(pdf["ts"].to_numpy(dtype=np.float64) / BUCKET_S).astype(
+        np.int64
+    )
+    return pdf["i"].groupby(keys).sum()
+
+
+def _same(view, got, want):
+    if isinstance(want, pandas.Series):
+        got = pandas.Series(got)
+        assert len(got) == len(want), (view, got, want)
+        assert list(got.index) == list(want.index), (view, got, want)
+        assert np.array_equal(
+            got.to_numpy(), want.to_numpy(dtype=got.dtype)
+        ), (view, got, want)
+    else:
+        assert got == want, (view, got, want)
+
+
+def main() -> int:
+    import modin_tpu.ingest as ingest
+    from modin_tpu.concurrency import lockdep
+    from modin_tpu.config import (
+        IngestFoldEvery,
+        IngestFoldLagMs,
+        IngestRetentionRows,
+        ResilienceBackoffS,
+        ServingEnabled,
+        ServingMaxConcurrent,
+        ServingQueueDepth,
+        WatchEnabled,
+        WatchIntervalS,
+        WatchPort,
+    )
+    from modin_tpu.logging import add_metric_handler
+    from modin_tpu.serving.gate import serving_snapshot
+    from modin_tpu.testing import midquery_device_loss
+
+    assert lockdep.enabled(), "MODIN_TPU_LOCKDEP=1 did not enable lockdep"
+    lockdep.enable(strict=True)
+    assert ingest.INGEST_ON, "MODIN_TPU_INGEST=1 did not enable graftfeed"
+
+    seen = []
+    add_metric_handler(lambda name, value: seen.append(name))
+    ResilienceBackoffS.put(0.0)
+    ServingEnabled.put(True)
+    ServingMaxConcurrent.put(8)
+    ServingQueueDepth.put(256)
+    IngestFoldEvery.put(3)  # real fold lag between appends
+
+    # ---- leg 1: sustained ingest + 4 concurrent bounded readers ------- #
+    feed = ingest.create_feed("events", _SCHEMA)
+    for name, plan in _PLANS.items():
+        feed.register_view(name, plan)
+
+    batches = [_mk_batch(np.random.default_rng(1000 + b)) for b in range(BATCHES)]
+    full_pdf = pandas.concat(batches, ignore_index=True).astype(_SCHEMA)
+
+    reads = []  # (view, bound, ViewRead)
+    failures = []
+    done = threading.Event()
+    lock = threading.Lock()
+
+    def reader(tid: int) -> None:
+        rng = np.random.default_rng(tid)
+        views = list(_PLANS)
+        k = 0
+        try:
+            while not done.is_set():
+                view = views[(tid + k) % len(views)]
+                bound = (None, 0.0, 1e9)[k % 3]
+                r = feed.read(view, fresh_within_ms=bound,
+                              tenant=f"reader{tid}")
+                with lock:
+                    reads.append((view, bound, r))
+                k += 1
+                time.sleep(0.002 + rng.uniform(0, 0.002))
+        except BaseException as err:  # noqa: BLE001 - the assertion
+            with lock:
+                failures.append(f"reader {tid}: {type(err).__name__}: {err}")
+
+    threads = [
+        threading.Thread(target=reader, args=(tid,), daemon=True)
+        for tid in range(READERS)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for b, batch in enumerate(batches):
+        feed.append(batch, tenant="ingestor")
+    ingest_wall = time.monotonic() - t0
+    done.set()
+    for t in threads:
+        t.join(timeout=max(JOIN_BUDGET_S - (time.monotonic() - t0), 1.0))
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"GLOBAL WATCHDOG: {len(hung)} reader(s) still alive"
+    assert not failures, "\n".join(failures[:10])
+
+    # every read bit-exact vs pandas over exactly the rows it covered
+    forced_seen = served_seen = 0
+    for view, bound, r in reads:
+        assert r.covered_rows % BATCH_ROWS == 0, (view, r.covered_rows)
+        _same(view, r.value, _truth(view, full_pdf.iloc[: r.covered_rows]))
+        if bound == 0.0:
+            # the freshness bound was honored: the read either forced the
+            # backlog down or there was no backlog to begin with
+            assert r.forced or r.lag_ms == 0.0, (view, r.lag_ms)
+        if r.forced:
+            forced_seen += 1
+        else:
+            served_seen += 1
+    assert forced_seen > 0, "no read ever forced a fold (bound 0.0)"
+    assert served_seen > 0, "no read ever served the maintained artifact"
+    assert feed.rows == BATCHES * BATCH_ROWS
+
+    tenants = serving_snapshot()["tenants"]
+    for tenant in ["ingestor"] + [f"reader{t}" for t in range(READERS)]:
+        assert tenant in tenants, f"tenant {tenant} never hit the gate"
+    fastpath = seen.count("modin_tpu.structural.append_fastpath")
+    assert fastpath > 0, "micro-batch concat fast path never fired"
+    print(
+        f"ingest_smoke: sustained OK ({BATCHES} micro-batches in "
+        f"{ingest_wall:.1f}s, {len(reads)} bounded reads across {READERS} "
+        f"sessions all bit-exact, {forced_seen} forced folds, "
+        f"{fastpath} fast-path concats)"
+    )
+
+    # ---- leg 4 (cheap, uses leg 1's feed): maintained >= 3x recompute - #
+    feed.fold_now()
+    for _ in range(3):  # warm both paths
+        feed.read("running_sum")
+        feed.recompute("running_sum")
+    t0 = time.monotonic()
+    for _ in range(20):
+        feed.read("running_sum")
+    maintained_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    for _ in range(20):
+        feed.recompute("running_sum")
+    recompute_s = time.monotonic() - t0
+    speedup = recompute_s / max(maintained_s, 1e-9)
+    assert speedup >= 3.0, (
+        f"maintained read only {speedup:.1f}x faster than recompute "
+        f"({maintained_s:.4f}s vs {recompute_s:.4f}s over 20 reads)"
+    )
+    print(f"ingest_smoke: maintained-vs-recompute OK ({speedup:.0f}x)")
+
+    # ---- leg 2: retention-trim + mid-fold DeviceLost ------------------ #
+    IngestFoldEvery.put(1)
+    IngestRetentionRows.put(10 * BATCH_ROWS)
+    trimmed = ingest.create_feed("trimmed", _SCHEMA)
+    for name in ("hot_rows", "leaders", "by_minute"):
+        trimmed.register_view(name, _PLANS[name])
+    mirror = pandas.DataFrame(
+        {c: pandas.Series(dtype=d) for c, d in _SCHEMA.items()}
+    )
+    dropped_rows = 0
+    unrecoverable_before = seen.count("modin_tpu.recovery.unrecoverable")
+    for b in range(30):
+        batch = _mk_batch(np.random.default_rng(5000 + b))
+        if b == 17:
+            # this append's concat dispatch dies mid-flight; recovery
+            # re-seats and the retry lands the batch exactly once
+            with midquery_device_loss(after_deploys=0, times=1):
+                trimmed.append(batch, tenant="ingestor")
+        else:
+            trimmed.append(batch, tenant="ingestor")
+        mirror = pandas.concat([mirror, batch], ignore_index=True)
+        while len(mirror) > 10 * BATCH_ROWS:  # reference batch-granular trim
+            mirror = mirror.iloc[BATCH_ROWS:].reset_index(drop=True)
+            dropped_rows += BATCH_ROWS
+    mirror = mirror.astype(_SCHEMA)
+    assert trimmed.rows == len(mirror), (trimmed.rows, len(mirror))
+    for name in ("hot_rows", "leaders", "by_minute"):
+        _same(name, trimmed.read(name).value, _truth(name, mirror))
+        _same(name, trimmed.recompute(name), _truth(name, mirror))
+    assert seen.count("modin_tpu.ingest.trim.rows") > 0, "no trim happened"
+    assert (
+        seen.count("modin_tpu.recovery.unrecoverable") == unrecoverable_before
+    ), "an entry was counted unrecoverable during mid-ingest recovery"
+    assert seen.count("modin_tpu.recovery.device_lost") > 0, (
+        "the injected loss never reached recovery"
+    )
+    print(
+        f"ingest_smoke: retention+DeviceLost OK ({dropped_rows} rows "
+        f"trimmed, retained suffix bit-exact across 3 view kinds)"
+    )
+
+    # ---- leg 3: the fold_lag tripwire + exactly one evidence bundle --- #
+    from modin_tpu.ingest import feed as feed_mod
+    from modin_tpu.observability import watch
+
+    IngestRetentionRows.put(0)
+    IngestFoldEvery.put(10**6)  # ingest outruns view maintenance
+    IngestFoldLagMs.put(50.0)
+    feed_mod._FOLD_DELAY_S = 0.02  # the eventual catch-up fold is slow too
+    lagged = ingest.create_feed("lagged", _SCHEMA)
+    lagged.register_view("running_sum", _PLANS["running_sum"])
+    WatchIntervalS.put(0.05)
+    WatchPort.put(0)
+    WatchEnabled.put(True)
+    try:
+        lag_pdf = pandas.DataFrame()
+        deadline = time.monotonic() + 30.0
+        tripped = []
+        b = 0
+        while time.monotonic() < deadline and not tripped:
+            batch = _mk_batch(np.random.default_rng(9000 + b))
+            lagged.append(batch, tenant="ingestor")
+            lag_pdf = pandas.concat([lag_pdf, batch], ignore_index=True)
+            b += 1
+            time.sleep(0.05)
+            tripped = [
+                t for t in watch.recent_trips() if t["rule"] == "fold_lag"
+            ]
+        assert tripped, (
+            f"fold_lag never tripped; lag={ingest.max_fold_lag_ms():.0f}ms "
+            f"recent={watch.recent_trips()}"
+        )
+        assert "modin_tpu.watch.trip.fold_lag" in seen
+        # keep the lag high across a few more ticks: the claim window +
+        # rule cooldown must still mint exactly ONE bundle
+        time.sleep(0.3)
+    finally:
+        WatchEnabled.put(False)
+        feed_mod._FOLD_DELAY_S = 0.0
+    bundles = glob.glob(os.path.join(_TRACE_DIR, "watchtrip_fold_lag_*.json"))
+    assert len(bundles) == 1, (
+        f"expected exactly one rate-limited fold_lag evidence bundle, "
+        f"found {len(bundles)}: {bundles}"
+    )
+    # the backlog folds down bit-exact once a bounded read demands it
+    forced = lagged.read("running_sum", fresh_within_ms=0.0)
+    assert forced.covered_rows == len(lag_pdf)
+    _same("running_sum", forced.value,
+          _truth("running_sum", lag_pdf.astype(_SCHEMA)))
+    print(
+        f"ingest_smoke: fold_lag tripwire OK (tripped after {b} deferred "
+        f"batches, 1 evidence bundle at {os.path.basename(bundles[0])})"
+    )
+
+    # ---- leg 5: zero lockdep violations anywhere above ---------------- #
+    recorded = lockdep.violations()
+    assert not recorded, "lockdep violations:\n" + "\n".join(
+        str(v) for v in recorded[:5]
+    )
+    print("ingest_smoke: lockdep strict OK (zero violations)")
+    print("ingest_smoke: ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(f"ingest_smoke: FAILED — {err}", file=sys.stderr)
+        sys.exit(1)

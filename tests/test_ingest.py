@@ -1,0 +1,575 @@
+"""graftfeed acceptance suite: continuous ingestion & registered live views.
+
+Covers the tentpole contract:
+
+- the differential grid: every registered-view kind (scalar / groupby /
+  filtered / top-k / windowed) x append / upsert / retention-trim,
+  asserting maintained == recompute-from-scratch == pandas.  Integer,
+  count/min/max, and top-k folds are bit-exact; the float mean fold
+  re-associates the fp accumulation (fold order is batch order) and
+  compares at the differential tolerance — the same documented contract
+  as views/incremental.py;
+- typed refusals: non-incrementalizable registrations raise
+  ``ViewNotIncrementalizable`` with a stable reason, never silently
+  recompute;
+- schema validation: dtype/column/key violations raise typed
+  ``IngestRejected`` and leave no partial state behind;
+- staleness-bounded reads: deferred folding creates real lag; a read
+  inside the bound serves the maintained state, outside it forces a
+  synchronous fold; both reads and ingest ride the serving admission
+  gate under their tenants;
+- chaos: DeviceLost under the ingest concat dispatch and ledger-pressure
+  artifact drops leave every view bit-exact (testing/faults.py);
+- the append-link chain bound (MODIN_TPU_VIEWS_MAX_CHAIN): lookup cost
+  stays flat across 1k appends and folds keep resolving past the old
+  8-hop horizon;
+- the MODIN_TPU_INGEST=0 zero-alloc contract over a real workload.
+"""
+
+import numpy as np
+import pandas
+import pytest
+
+import modin_tpu.pandas as pd
+from modin_tpu import ingest
+from modin_tpu.config import (
+    IngestEnabled,
+    IngestFoldEvery,
+    IngestRetentionAgeS,
+    IngestRetentionRows,
+    ViewsMaxChain,
+)
+from modin_tpu.logging.metrics import add_metric_handler, clear_metric_handler
+from modin_tpu.views import registry
+
+from tests.utils import df_equals, require_tpu_execution
+
+
+@pytest.fixture(autouse=True)
+def _ingest_env():
+    require_tpu_execution()
+    registry.reset()
+    ingest.reset()
+    IngestEnabled.enable()
+    yield
+    ingest.reset()
+    registry.reset()
+    IngestEnabled.disable()
+    IngestFoldEvery.put(1)
+    IngestRetentionRows.put(0)
+    IngestRetentionAgeS.put(0.0)
+
+
+@pytest.fixture
+def metric_log():
+    events = []
+
+    def handler(name, value):
+        events.append((name, value))
+
+    add_metric_handler(handler)
+    yield events
+    clear_metric_handler(handler)
+
+
+def _count(events, name):
+    return sum(1 for n, _ in events if n == f"modin_tpu.{name}")
+
+
+_SCHEMA = {"k": "int64", "i": "int64", "x": "float64", "g": "int64",
+           "ts": "float64"}
+
+#: every view kind under test, with its registration plan
+_PLANS = {
+    "scalar": {"kind": "scalar", "column": "i", "agg": "sum"},
+    "scalar_mean": {"kind": "scalar", "column": "x", "agg": "mean"},
+    "groupby": {"kind": "groupby", "by": "g", "column": "i", "agg": "sum"},
+    "filtered": {
+        "kind": "filtered", "column": "i", "agg": "sum",
+        "predicate": ("x", ">", 0.0),
+    },
+    "topk": {"kind": "topk", "column": "x", "k": 7},
+    "windowed": {
+        "kind": "windowed", "column": "i", "time_column": "ts",
+        "agg": "sum", "bucket_s": 5.0,
+    },
+}
+
+#: integer folds are bit-exact; scalar_mean re-associates fp sums
+_BIT_EXACT = {"scalar", "groupby", "filtered", "windowed", "topk"}
+
+
+def _truth(name, pdf):
+    """The pandas ground truth for each registered plan over ``pdf``."""
+    if name == "scalar":
+        return pdf["i"].sum()
+    if name == "scalar_mean":
+        return pdf["x"].mean()
+    if name == "groupby":
+        return pdf.groupby("g")["i"].sum()
+    if name == "filtered":
+        return pdf["i"][pdf["x"] > 0.0].sum()
+    if name == "topk":
+        return pdf["x"].nlargest(7, keep="first")
+    keys = np.floor(pdf["ts"].to_numpy(dtype=np.float64) / 5.0).astype(
+        np.int64
+    )
+    return pdf["i"].groupby(keys).sum()
+
+
+def _assert_answer(name, got, want):
+    if isinstance(want, pandas.Series):
+        got = pandas.Series(got)
+        if name in _BIT_EXACT:
+            pandas.testing.assert_series_equal(
+                got, want, check_names=False, check_index_type=False
+            )
+        else:
+            df_equals(got, want)
+    elif name in _BIT_EXACT:
+        assert got == want, (name, got, want)
+    else:
+        assert got == pytest.approx(want, rel=1e-12, abs=1e-12)
+
+
+def _batch(rng, n, key_start=0):
+    return pandas.DataFrame(
+        {
+            "k": np.arange(key_start, key_start + n, dtype=np.int64),
+            "i": rng.integers(-1000, 1000, n),
+            "x": rng.normal(size=n),
+            "g": rng.integers(0, 5, n),
+            "ts": rng.uniform(0.0, 60.0, n),
+        }
+    )
+
+
+def _make_feed(name="events", key=None):
+    return ingest.create_feed(name, _SCHEMA, key=key)
+
+
+def _apply_upsert(mirror, up, key="k"):
+    """Reference upsert semantics: in-batch last-wins dedup, in-place
+    update of stored keys, append of new keys in batch order."""
+    up = up.drop_duplicates(subset=[key], keep="last")
+    up_map = up.set_index(key)
+    out = mirror.copy()
+    for pos in out.index[out[key].isin(up_map.index)]:
+        k = out.loc[pos, key]
+        for col in out.columns:
+            if col != key:
+                out.loc[pos, col] = up_map.loc[k, col]
+    new = up[~up[key].isin(mirror[key])]
+    out = pandas.concat([out, new], ignore_index=True)
+    return out.astype({c: d for c, d in _SCHEMA.items()})
+
+
+class TestDifferentialGrid:
+    """maintained == recompute-from-scratch == pandas, per kind x mode."""
+
+    @pytest.mark.parametrize("name", sorted(_PLANS))
+    @pytest.mark.parametrize("mode", ["append", "upsert", "trim"])
+    def test_grid(self, name, mode):
+        if mode == "trim":
+            IngestRetentionRows.put(70)
+        rng = np.random.default_rng(abs(hash((name, mode))) % 2**32)
+        feed = _make_feed(key="k" if mode == "upsert" else None)
+        feed.register_view(name, _PLANS[name])
+        mirror = pandas.DataFrame(
+            {c: pandas.Series(dtype=d) for c, d in feed.schema.items()}
+        )
+        for b in range(5):
+            batch = _batch(rng, 24, key_start=b * 24)
+            feed.append(batch)
+            mirror = pandas.concat([mirror, batch], ignore_index=True)
+            if mode == "trim":
+                # reference trim: whole oldest 24-row batches drop until
+                # the retained row count is back under the bound
+                while len(mirror) > 70:
+                    mirror = mirror.iloc[24:].reset_index(drop=True)
+        if mode == "upsert":
+            up = _batch(rng, 30, key_start=96)  # 24 updates + 6 new keys
+            feed.upsert(up)
+            mirror = _apply_upsert(mirror, up)
+        df_equals(feed.frame._to_pandas().reset_index(drop=True), mirror)
+        maintained = feed.read(name).value
+        _assert_answer(name, maintained, _truth(name, mirror))
+        _assert_answer(name, feed.recompute(name), _truth(name, mirror))
+
+    def test_upsert_semantics_exact(self):
+        """In-place update (in-batch last-wins) + append of new keys;
+        every view kind exact against a hand-built expected frame."""
+        feed = _make_feed(key="k")
+        kinds = ("scalar", "groupby", "topk", "windowed", "filtered")
+        for v in kinds:
+            feed.register_view(v, _PLANS[v])
+        rng = np.random.default_rng(11)
+        b0 = _batch(rng, 40)
+        feed.append(b0)
+        up = _batch(rng, 20, key_start=30)  # keys 30..39 update, 40..49 new
+        up = pandas.concat(
+            [up, up.iloc[:1].assign(i=np.int64(999))], ignore_index=True
+        )  # duplicate key 30 in-batch: last occurrence wins
+        feed.upsert(up)
+        expect = _apply_upsert(b0.astype(_SCHEMA), up.astype(_SCHEMA))
+        assert expect.loc[30, "i"] == 999
+        df_equals(feed.frame._to_pandas().reset_index(drop=True), expect)
+        for v in kinds:
+            _assert_answer(v, feed.read(v).value, _truth(v, expect))
+            _assert_answer(v, feed.recompute(v), _truth(v, expect))
+
+    def test_keyed_append_rejects_duplicates(self, metric_log):
+        feed = _make_feed(key="k")
+        feed.append(_batch(np.random.default_rng(0), 10))
+        dup_in_batch = _batch(np.random.default_rng(1), 4, key_start=100)
+        dup_in_batch.loc[3, "k"] = 100
+        with pytest.raises(ingest.IngestRejected) as err:
+            feed.append(dup_in_batch)
+        assert err.value.reason == "duplicate_key"
+        with pytest.raises(ingest.IngestRejected) as err:
+            feed.append(_batch(np.random.default_rng(2), 4, key_start=8))
+        assert err.value.reason == "key_exists"
+        assert feed.rows == 10  # rejected batches left no trace
+        assert _count(metric_log, "ingest.reject") == 2
+
+    def test_trim_by_age(self):
+        IngestRetentionAgeS.put(1e-9)  # everything but the newest expires
+        feed = _make_feed()
+        feed.register_view("scalar", _PLANS["scalar"])
+        rng = np.random.default_rng(3)
+        last = None
+        for _ in range(4):
+            last = _batch(rng, 10)
+            feed.append(last)
+        # batch-granular age trim keeps only the newest batch
+        assert feed.rows == 10
+        _assert_answer("scalar", feed.read("scalar").value,
+                       _truth("scalar", last.astype(_SCHEMA)))
+
+    def test_trim_survives_deferred_folds(self):
+        """Trim racing a fold backlog: pending batches trim away before
+        they ever folded; the refold over retained partials stays exact."""
+        IngestFoldEvery.put(3)
+        IngestRetentionRows.put(40)
+        feed = _make_feed()
+        for v in ("filtered", "topk", "windowed"):
+            feed.register_view(v, _PLANS[v])
+        rng = np.random.default_rng(4)
+        mirror = pandas.DataFrame(
+            {c: pandas.Series(dtype=d) for c, d in feed.schema.items()}
+        )
+        for b in range(8):
+            batch = _batch(rng, 16, key_start=b * 16)
+            feed.append(batch)
+            mirror = pandas.concat([mirror, batch], ignore_index=True)
+            while len(mirror) > 40:
+                mirror = mirror.iloc[16:].reset_index(drop=True)
+        for v in ("filtered", "topk", "windowed"):
+            got = feed.read(v, fresh_within_ms=0.0)  # force the backlog
+            _assert_answer(v, got.value, _truth(v, mirror))
+
+    def test_late_rows_fold_into_closed_buckets(self):
+        feed = _make_feed()
+        view = feed.register_view("windowed", _PLANS["windowed"])
+        early = pandas.DataFrame(
+            {"k": [0], "i": [5], "x": [0.0], "g": [0], "ts": [3.0]}
+        )
+        late_bucket = pandas.DataFrame(
+            {"k": [1], "i": [7], "x": [0.0], "g": [0], "ts": [55.0]}
+        )
+        straggler = pandas.DataFrame(
+            {"k": [2], "i": [11], "x": [0.0], "g": [0], "ts": [4.0]}
+        )
+        for b in (early, late_bucket, straggler):
+            feed.append(b.astype(_SCHEMA))
+        got = feed.read("windowed").value
+        full = pandas.concat(
+            [early, late_bucket, straggler], ignore_index=True
+        ).astype(_SCHEMA)
+        _assert_answer("windowed", got, _truth("windowed", full))
+        assert view.late_buckets >= 1  # the straggler hit a closed bucket
+
+
+class TestRefusalsAndSchema:
+    @pytest.mark.parametrize(
+        "plan,reason",
+        [
+            ({"kind": "scalar", "column": "x", "agg": "median"},
+             "non_foldable_agg"),
+            ({"kind": "scalar", "column": "x", "agg": "var"},
+             "non_foldable_agg"),
+            ({"kind": "groupby", "by": "g", "column": "x", "agg": "nunique"},
+             "non_foldable_agg"),
+            ({"kind": "filtered", "column": "x",
+              "predicate": ("g", ">", 0)}, "row_view_unbounded"),
+            ({"kind": "filtered", "column": "x", "agg": "sum",
+              "predicate": ("g", "~", 0)}, "bad_predicate"),
+            ({"kind": "topk", "column": "x", "k": 0}, "bad_k"),
+            ({"kind": "windowed", "column": "x", "agg": "sum",
+              "bucket_s": 0, "time_column": "ts"}, "bad_window"),
+            ({"kind": "windowed", "column": "x", "agg": "sum",
+              "bucket_s": 5.0}, "bad_window"),
+            ({"kind": "sorted", "column": "x"}, "unknown_kind"),
+            ({"kind": "scalar", "column": "zz", "agg": "sum"},
+             "unknown_column"),
+        ],
+    )
+    def test_typed_refusals(self, plan, reason, metric_log):
+        feed = _make_feed()
+        with pytest.raises(ingest.ViewNotIncrementalizable) as err:
+            feed.register_view("bad", plan)
+        assert err.value.reason == reason
+        assert _count(metric_log, "ingest.view.refused") == 1
+        assert feed.views() == []  # nothing half-registered
+
+    def test_schema_rejections(self, metric_log):
+        feed = _make_feed()
+        ok = _batch(np.random.default_rng(0), 4)
+        feed.append(ok)
+        cases = [
+            (ok.drop(columns=["x"]), "missing_column"),
+            (ok.assign(extra=1), "extra_column"),
+            (ok.assign(i=["a", "b", "c", "d"]), "dtype"),
+            (object(), "unsupported_type"),
+            ("", "malformed"),  # EmptyDataError from the CSV parser
+            ({"k": [1, 2], "i": [0]}, "malformed"),  # ragged dict
+        ]
+        for bad, reason in cases:
+            with pytest.raises(ingest.IngestRejected) as err:
+                feed.append(bad)
+            assert err.value.reason == reason, (reason, err.value)
+        assert _count(metric_log, "ingest.reject") == len(cases)
+        assert feed.rows == 4  # rejected batches left no trace
+
+    def test_safe_casts_accepted(self):
+        feed = _make_feed()
+        batch = _batch(np.random.default_rng(0), 3)
+        batch["x"] = batch["x"].astype(np.float32)  # float32 -> float64
+        batch["g"] = batch["g"].astype(np.int32)  # int32 -> int64
+        feed.append(batch)
+        assert feed.frame._to_pandas()["x"].dtype == np.float64
+
+    def test_csv_and_dict_batches(self):
+        feed = _make_feed()
+        feed.register_view("s", _PLANS["scalar"])
+        feed.append("k,i,x,g,ts\n1,10,0.5,2,3.0\n2,-4,1.5,0,8.0\n")
+        feed.append({"k": [3], "i": [7], "x": [2.5], "g": [1], "ts": [11.0]})
+        assert feed.rows == 3
+        assert feed.read("s").value == 10 - 4 + 7
+
+    def test_create_feed_duplicate_and_lookup(self):
+        feed = _make_feed()
+        with pytest.raises(ingest.IngestError):
+            _make_feed()
+        assert ingest.get_feed("events") is feed
+        assert ingest.feeds() == ["events"]
+        ingest.drop_feed("events")
+        assert ingest.feeds() == []
+
+
+class TestStaleness:
+    def test_deferred_fold_creates_lag_and_bound_forces_fold(
+        self, metric_log
+    ):
+        IngestFoldEvery.put(1000)  # never fold on append
+        feed = _make_feed()
+        feed.register_view("s", _PLANS["scalar"])
+        rng = np.random.default_rng(5)
+        full = pandas.DataFrame()
+        for _ in range(3):
+            b = _batch(rng, 8)
+            feed.append(b)
+            full = pandas.concat([full, b], ignore_index=True)
+        assert feed.fold_lag_ms() > 0.0
+        # inside an infinite bound: serve the (empty) maintained state
+        served = feed.read("s", fresh_within_ms=1e12)
+        assert not served.forced and served.covered_rows == 0
+        # a zero bound forces the synchronous fold of the backlog
+        forced = feed.read("s", fresh_within_ms=0.0)
+        assert forced.forced and forced.covered_rows == len(full)
+        _assert_answer("scalar", forced.value, _truth("scalar", full))
+        assert feed.fold_lag_ms() == 0.0
+        assert _count(metric_log, "ingest.read.forced_fold") == 1
+        assert _count(metric_log, "ingest.read.served") == 1
+
+    def test_reads_and_ingest_ride_the_admission_gate(self):
+        from modin_tpu.config import ServingEnabled
+        from modin_tpu.serving.gate import serving_snapshot
+
+        ServingEnabled.put(True)
+        try:
+            feed = _make_feed()
+            feed.register_view("s", _PLANS["scalar"])
+            b = _batch(np.random.default_rng(6), 12)
+            feed.append(b, tenant="ingestor")
+            read = feed.read("s", tenant="reader")
+            _assert_answer(
+                "scalar", read.value, _truth("scalar", b.astype(_SCHEMA))
+            )
+            tenants = serving_snapshot()["tenants"]
+            assert "ingestor" in tenants and "reader" in tenants
+        finally:
+            ServingEnabled.put(False)
+
+
+class TestChaos:
+    def test_device_lost_during_ingest_concat(self, metric_log):
+        from modin_tpu.testing.faults import midquery_device_loss
+
+        feed = _make_feed()
+        for v in ("filtered", "topk", "windowed"):
+            feed.register_view(v, _PLANS[v])
+        rng = np.random.default_rng(7)
+        b = _batch(rng, 16)
+        feed.append(b)
+        full = b.astype(_SCHEMA)
+        tail = _batch(rng, 16, key_start=16)
+        # the append's concat dispatch dies mid-flight; recovery re-seats
+        # and the retry lands the batch exactly once
+        with midquery_device_loss(after_deploys=0, times=1):
+            feed.append(tail)
+        full = pandas.concat(
+            [full, tail.astype(_SCHEMA)], ignore_index=True
+        )
+        for v in ("filtered", "topk", "windowed"):
+            _assert_answer(v, feed.read(v).value, _truth(v, full))
+        df_equals(feed.frame._to_pandas().reset_index(drop=True), full)
+        assert _count(metric_log, "recovery.unrecoverable") == 0
+
+    def test_ledger_pressure_drop_leaves_views_exact(self):
+        from modin_tpu.core.memory import device_ledger
+
+        feed = _make_feed()
+        for v in ("filtered", "topk", "windowed"):
+            feed.register_view(v, _PLANS[v])
+        rng = np.random.default_rng(8)
+        full = pandas.DataFrame()
+        for _ in range(3):
+            b = _batch(rng, 16)
+            feed.append(b)
+            full = pandas.concat([full, b], ignore_index=True)
+        feed.frame.sum()  # seed graftview artifacts on the frame
+        device_ledger.spill_lru(1)  # pressure: derived artifacts drop first
+        for v in ("filtered", "topk", "windowed"):
+            _assert_answer(v, feed.read(v).value, _truth(v, full))
+        df_equals(feed.frame.sum(), full.sum())
+
+
+class _FakeCol:
+    """Registry-protocol column stub: drives 1k-append chain mechanics
+    without paying 1k device concats."""
+
+    def __init__(self, length):
+        self._view_token = None
+        self._view_parent = None
+        self._data = object()
+        self.length = length
+        self.is_lazy = False
+
+
+class TestChainBound:
+    def test_lookup_cost_flat_across_1k_appends(self):
+        """1k micro-batch appends with a query every 10th: hops-per-lookup
+        in the last hundred appends is no worse than in the first — the
+        walk is bounded by the query interval, not by total appends."""
+        col = _FakeCol(10)
+        registry.store(col, "reduce", ("sum",), {"v": 0}, can_fold=True)
+        per_block = []
+        for block in range(10):
+            before = registry.walk_stats()
+            for a in range(100):
+                child = _FakeCol(col.length + 1)
+                registry.note_append(child, col)
+                col = child
+                if a % 10 == 9:
+                    outcome, state, base = registry.lookup(
+                        col, "reduce", ("sum",)
+                    )
+                    assert outcome == "fold", (block, a, outcome)
+                    registry.store(
+                        col, "reduce", ("sum",), {"v": 0},
+                        can_fold=True, folded=True,
+                    )
+            after = registry.walk_stats()
+            per_block.append(
+                (after["hops"] - before["hops"])
+                / (after["lookups"] - before["lookups"])
+            )
+        assert per_block[-1] <= per_block[0] + 1.0, per_block
+        # bounded by the query interval: <= 10 hops per lookup, always
+        assert max(per_block) <= 10.0, per_block
+
+    def test_fold_resolves_past_old_eight_hop_horizon(self):
+        """30 artifact-less links deep still folds (the pre-graftfeed
+        hardcoded 8-hop walk would have returned miss)."""
+        root = _FakeCol(10)
+        registry.store(root, "reduce", ("sum",), {"v": 1}, can_fold=True)
+        col = root
+        for _ in range(30):
+            child = _FakeCol(col.length + 1)
+            registry.note_append(child, col)
+            col = child
+        outcome, state, base = registry.lookup(col, "reduce", ("sum",))
+        assert outcome == "fold"
+        assert base == root.length and state == {"v": 1}
+
+    def test_compaction_respects_max_chain(self, metric_log):
+        before = ViewsMaxChain.get()
+        ViewsMaxChain.put(4)
+        try:
+            col = _FakeCol(10)
+            for _ in range(12):
+                child = _FakeCol(col.length + 1)
+                registry.note_append(child, col)
+                col = child
+            assert _count(metric_log, "view.chain_compact") >= 1
+            assert registry.walk_stats()["compactions"] >= 1
+        finally:
+            ViewsMaxChain.put(before)
+
+    def test_real_frame_appends_stay_foldable(self):
+        """Small real-frame leg: periodic queries keep folding (and keep
+        the walk bounded) across many concats."""
+        pdf = pandas.DataFrame({"a": np.arange(64, dtype=np.int64)})
+        mdf = pd.DataFrame(pdf)
+        mdf.sum()
+        for i in range(30):
+            tail = pandas.DataFrame(
+                {"a": np.arange(4, dtype=np.int64) + i}
+            )
+            mdf = pd.concat([mdf, pd.DataFrame(tail)], ignore_index=True)
+            pdf = pandas.concat([pdf, tail], ignore_index=True)
+            got = mdf.sum()
+            assert got["a"] == pdf["a"].sum()
+        stats = registry.walk_stats()
+        assert stats["hops"] <= stats["lookups"] * 3
+
+
+class TestOffContract:
+    def test_ingest_off_zero_alloc_over_real_workload(self):
+        """MODIN_TPU_INGEST=0: a real (non-ingest) workload allocates
+        nothing from graftfeed and create_feed refuses."""
+        IngestEnabled.disable()
+        before = ingest.ingest_alloc_count()
+        pdf = pandas.DataFrame(
+            {"a": np.arange(200, dtype=np.int64),
+             "b": np.random.default_rng(0).normal(size=200)}
+        )
+        mdf = pd.DataFrame(pdf)
+        df_equals(mdf.sum(), pdf.sum())
+        mdf2 = pd.concat([mdf, pd.DataFrame(pdf)], ignore_index=True)
+        df_equals(
+            mdf2.sum(), pandas.concat([pdf, pdf], ignore_index=True).sum()
+        )
+        assert ingest.ingest_alloc_count() == before
+        with pytest.raises(ingest.IngestError):
+            ingest.create_feed("nope", {"a": "int64"})
+        assert ingest.ingest_alloc_count() == before
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError):
+            IngestFoldEvery.put(0)
+        with pytest.raises(ValueError):
+            IngestRetentionRows.put(-1)
+        with pytest.raises(ValueError):
+            ViewsMaxChain.put(0)
