@@ -17,8 +17,8 @@ class IngestRejected(IngestError):
     """A micro-batch failed feed admission: schema/dtype validation, a
     malformed payload, or a key violation.  ``reason`` is a stable slug
     (``missing_column`` / ``extra_column`` / ``dtype`` / ``malformed`` /
-    ``unsupported_type`` / ``duplicate_key`` / ``key_exists``) so callers
-    can branch without parsing the message."""
+    ``unsupported_type`` / ``duplicate_key`` / ``key_exists`` /
+    ``not_keyed``) so callers can branch without parsing the message."""
 
     def __init__(
         self,
@@ -51,9 +51,9 @@ class ViewNotIncrementalizable(IngestError):
     has no exact fold.  Never silently recomputed — the caller either
     changes the plan or runs the query ad hoc.  ``reason`` is a stable
     slug (``unknown_kind`` / ``unknown_column`` / ``non_foldable_agg`` /
-    ``row_view_unbounded`` / ``bad_predicate`` / ``bad_k`` /
-    ``bad_column_dtype`` / ``bad_window``); docs/architecture.md carries
-    the decision table."""
+    ``unknown_agg`` / ``row_view_unbounded`` / ``bad_predicate`` /
+    ``bad_k`` / ``bad_column_dtype`` / ``bad_window``);
+    docs/architecture.md carries the decision table."""
 
     def __init__(self, name: str, reason: str, detail: str = "") -> None:
         self.name = name

@@ -92,7 +92,9 @@ class Feed:
     """One named ingestion target.  Constructed via :func:`create_feed`."""
 
     def __init__(self, name: str, schema: Dict[str, Any],
-                 key: Optional[str] = None) -> None:
+                 key: Optional[str] = None,
+                 retention_rows: Optional[int] = None,
+                 retention_age_s: Optional[float] = None) -> None:
         import pandas
 
         import modin_tpu.pandas as mpd
@@ -106,7 +108,21 @@ class Feed:
             raise IngestError(
                 f"feed {name!r}: key column {key!r} is not in the schema"
             )
+        if retention_rows is not None and retention_rows < 0:
+            raise IngestError(
+                f"feed {name!r}: retention_rows must be >= 0, "
+                f"got {retention_rows}"
+            )
+        if retention_age_s is not None and retention_age_s < 0:
+            raise IngestError(
+                f"feed {name!r}: retention_age_s must be >= 0, "
+                f"got {retention_age_s}"
+            )
         self.key = key
+        #: per-feed retention overrides; None falls back to the
+        #: MODIN_TPU_INGEST_RETENTION_ROWS / _AGE_S defaults at trim time
+        self.retention_rows = retention_rows
+        self.retention_age_s = retention_age_s
         self._lock = named_rlock("ingest.feed")
         self._mirror = pandas.DataFrame(
             {c: pandas.Series(dtype=d) for c, d in self.schema.items()}
@@ -136,7 +152,7 @@ class Feed:
         count.  Raises :class:`IngestRejected` on schema mismatch (and,
         on a keyed feed, when the batch repeats an existing key — that is
         :meth:`upsert`'s job)."""
-        pdf = self._normalize(batch)
+        pdf = self._admit(batch)
         from modin_tpu import serving
 
         return serving.submit(
@@ -148,8 +164,9 @@ class Feed:
         """Admit one upsert micro-batch (keyed feeds): rows whose key
         exists update in place (batch last-wins), the rest append."""
         if self.key is None:
-            self._reject("key_exists", detail="feed has no key column")
-        pdf = self._normalize(batch)
+            emit_metric("ingest.reject", 1)
+            self._reject("not_keyed", detail="feed has no key column")
+        pdf = self._admit(batch)
         from modin_tpu import serving
 
         return serving.submit(
@@ -259,8 +276,20 @@ class Feed:
     # -- internals ----------------------------------------------------- #
 
     def _reject(self, reason: str, **kwargs) -> None:
-        emit_metric("ingest.reject", 1)
+        """Raise the typed rejection.  Raise-only on purpose: callers emit
+        the ``ingest.reject`` counter AFTER any held locks release (the
+        PR 9 gate-lock lesson — a slow metric handler must never stall
+        appends/reads/trims holding the feed rlock)."""
         raise IngestRejected(self.name, reason, **kwargs)
+
+    def _admit(self, batch: Any) -> Any:
+        """Normalize an incoming batch outside any lock, counting
+        rejections here where no lock is held."""
+        try:
+            return self._normalize(batch)
+        except IngestRejected:
+            emit_metric("ingest.reject", 1)
+            raise
 
     def _normalize(self, batch: Any) -> Any:
         """Coerce an incoming batch (pandas / dict / CSV text) to a
@@ -307,6 +336,27 @@ class Feed:
         return pdf
 
     def _append_sync(self, pdf: Any, is_upsert: bool) -> int:
+        try:
+            rows, upserted, appended, folded, trimmed = self._append_locked(
+                pdf, is_upsert
+            )
+        except IngestRejected:
+            # key-violation rejects raise under the feed rlock; the
+            # counter fans out here, after it released
+            emit_metric("ingest.reject", 1)
+            raise
+        if appended:
+            emit_metric("ingest.batch", 1)
+            emit_metric("ingest.rows", appended)
+        if upserted:
+            emit_metric("ingest.upsert", upserted)
+        if folded:
+            emit_metric("ingest.fold", folded)
+        if trimmed:
+            emit_metric("ingest.trim.rows", trimmed)
+        return rows
+
+    def _append_locked(self, pdf: Any, is_upsert: bool):
         import pandas
 
         import modin_tpu.pandas as mpd
@@ -377,16 +427,7 @@ class Feed:
                         folded = self._fold_pending_locked()
                 trimmed = self._trim_locked()
                 rows = self._rows
-        if appended:
-            emit_metric("ingest.batch", 1)
-            emit_metric("ingest.rows", appended)
-        if upserted:
-            emit_metric("ingest.upsert", upserted)
-        if folded:
-            emit_metric("ingest.fold", folded)
-        if trimmed:
-            emit_metric("ingest.trim.rows", trimmed)
-        return rows
+        return rows, upserted, appended, folded, trimmed
 
     def _rebuild_frame_locked(self, mpd) -> None:
         self._frame = mpd.DataFrame(self._mirror)
@@ -430,8 +471,14 @@ class Feed:
         host-side combines only, no recompute (unless the trim reaches
         into a view's bootstrap span, which forces its exact rebuild)."""
         config = _config()
-        max_rows = int(config.IngestRetentionRows.get())
-        max_age = float(config.IngestRetentionAgeS.get())
+        max_rows = (
+            int(self.retention_rows) if self.retention_rows is not None
+            else int(config.IngestRetentionRows.get())
+        )
+        max_age = (
+            float(self.retention_age_s) if self.retention_age_s is not None
+            else float(config.IngestRetentionAgeS.get())
+        )
         now = time.monotonic()
         dropped: List[_BatchRecord] = []
         remaining = self._rows
@@ -520,9 +567,14 @@ _feeds: Dict[str, Feed] = {}
 
 
 def create_feed(name: str, schema: Dict[str, Any],
-                key: Optional[str] = None) -> Feed:
+                key: Optional[str] = None,
+                retention_rows: Optional[int] = None,
+                retention_age_s: Optional[float] = None) -> Feed:
     """Create and register a named feed.  Requires ``MODIN_TPU_INGEST=1``
-    (the subsystem is off by default — the zero-overhead contract)."""
+    (the subsystem is off by default — the zero-overhead contract).
+    ``retention_rows`` / ``retention_age_s`` override the
+    ``MODIN_TPU_INGEST_RETENTION_ROWS`` / ``_AGE_S`` defaults for this
+    feed (0 = unbounded, None = inherit the knob)."""
     from modin_tpu import ingest as _ingest
 
     if not _ingest.INGEST_ON:
@@ -530,7 +582,8 @@ def create_feed(name: str, schema: Dict[str, Any],
             "continuous ingestion is disabled; set MODIN_TPU_INGEST=1 "
             "(config.IngestEnabled.enable())"
         )
-    feed = Feed(name, schema, key=key)
+    feed = Feed(name, schema, key=key, retention_rows=retention_rows,
+                retention_age_s=retention_age_s)
     with _FEEDS_LOCK:
         if name in _feeds:
             raise IngestError(f"feed {name!r} already exists")

@@ -97,13 +97,26 @@ _EMPTY_SCALAR = {
 
 def _scalar_partial(series: Any, agg: str) -> Any:
     """One batch's contribution for a scalar aggregate: the pandas result
-    itself, except mean which carries its (mean, valid-count) pair."""
+    itself, except mean which carries its (mean, valid-count) pair.
+
+    An empty series (a filtered batch matching zero predicate rows) has
+    no min/max contribution: pandas answers NaN there, which is NOT the
+    fold identity — folded into an int-dtyped running state it would
+    poison the state and the next fmin/fmax fold would drop all history.
+    ``None`` is the skip sentinel :func:`_scalar_fold` understands;
+    :func:`_scalar_value` still answers the pandas empty reduction for an
+    all-empty view.
+    """
     if agg == "mean":
         return (series.mean(), int(series.count()))
+    if len(series) == 0 and agg in ("min", "max"):
+        return None
     return getattr(series, agg)()
 
 
 def _scalar_fold(agg: str, state: Any, part: Any) -> Any:
+    if part is None:  # empty-batch partial: the fold identity, skip
+        return state
     if state is None:
         return part
     if agg == "mean":
@@ -185,11 +198,18 @@ class LiveView:
                         "filtered views need an aggregate; bare row sets "
                         "grow without bound under continuous ingest",
                     )
-                reason = (
-                    "non_foldable_agg" if agg in NON_FOLDABLE_AGGS
-                    else "non_foldable_agg"
+                known = (
+                    agg in NON_FOLDABLE_AGGS or agg in SCALAR_AGGS
+                    or agg in GROUPBY_AGGS
                 )
-                self._refuse(reason, f"agg={agg!r} has no exact fold")
+                if known:
+                    self._refuse(
+                        "non_foldable_agg",
+                        f"agg={agg!r} has no exact fold for kind={kind!r}",
+                    )
+                self._refuse(
+                    "unknown_agg", f"agg={agg!r} is not a recognized aggregate"
+                )
         if kind == "filtered":
             pred = plan.get("predicate")
             if (

@@ -218,6 +218,81 @@ class TestDifferentialGrid:
             _assert_answer(v, feed.read(v).value, _truth(v, expect))
             _assert_answer(v, feed.recompute(v), _truth(v, expect))
 
+    @pytest.mark.parametrize("agg", ["min", "max", "mean", "sum", "count"])
+    def test_filtered_agg_survives_empty_match_batches(self, agg):
+        """Regression: a micro-batch matching zero predicate rows folds as
+        the identity.  pandas' empty-min/max is NaN, which once poisoned
+        the int-dtyped running state and dropped all history before the
+        empty batch (batches [3,5] / [] / [9] maintained min=9)."""
+        feed = ingest.create_feed(f"empty_{agg}", _SCHEMA)
+        feed.register_view("v", {
+            "kind": "filtered", "column": "i", "agg": agg,
+            "predicate": ("x", ">", 0.0),
+        })
+        frames = []
+        for rows in ([(0, 3, 1.0), (1, 5, 1.0)],   # both match
+                     [(2, -7, -1.0)],              # matches nothing
+                     [(3, 9, 1.0)]):               # matches
+            b = pandas.DataFrame(
+                {"k": [r[0] for r in rows], "i": [r[1] for r in rows],
+                 "x": [r[2] for r in rows], "g": 0, "ts": 0.0}
+            ).astype(_SCHEMA)
+            feed.append(b)
+            frames.append(b)
+        full = pandas.concat(frames, ignore_index=True)
+        want = getattr(full["i"][full["x"] > 0.0], agg)()
+        assert feed.read("v").value == want  # e.g. min == 3, not 9
+        _assert_answer("filtered", feed.recompute("v"), want)
+
+    def test_filtered_minmax_refold_skips_empty_partials(self):
+        """The retention refold walks retained partials including the
+        empty-batch sentinel; and an all-empty view answers pandas'
+        empty-reduction NaN."""
+        feed = ingest.create_feed("empty_refold", _SCHEMA,
+                                  retention_rows=2)
+        feed.register_view("v", {
+            "kind": "filtered", "column": "i", "agg": "min",
+            "predicate": ("x", ">", 0.0),
+        })
+        for i, x in [(3, 1.0), (-7, -1.0), (9, 1.0)]:
+            feed.append(pandas.DataFrame(
+                {"k": [i], "i": [i], "x": [x], "g": [0], "ts": [0.0]}
+            ).astype(_SCHEMA))
+        # retention (2 rows) trimmed the first batch: retained rows are
+        # the non-matching -7 and the matching 9
+        assert feed.rows == 2
+        assert feed.read("v").value == 9
+        none_feed = ingest.create_feed("all_empty", _SCHEMA)
+        none_feed.register_view("v", {
+            "kind": "filtered", "column": "i", "agg": "min",
+            "predicate": ("x", ">", 0.0),
+        })
+        none_feed.append(pandas.DataFrame(
+            {"k": [0], "i": [1], "x": [-1.0], "g": [0], "ts": [0.0]}
+        ).astype(_SCHEMA))
+        assert np.isnan(none_feed.read("v").value)
+
+    def test_keyless_upsert_rejected_not_keyed(self, metric_log):
+        feed = _make_feed()
+        with pytest.raises(ingest.IngestRejected) as err:
+            feed.upsert(_batch(np.random.default_rng(0), 3))
+        assert err.value.reason == "not_keyed"
+        assert _count(metric_log, "ingest.reject") == 1
+        assert feed.rows == 0
+
+    def test_per_feed_retention_override(self):
+        """create_feed(retention_rows=...) bounds one feed while the
+        global knob (0 = unbounded) leaves its sibling untouched."""
+        bounded = ingest.create_feed("bounded", _SCHEMA, retention_rows=20)
+        unbounded = ingest.create_feed("unbounded", _SCHEMA)
+        rng = np.random.default_rng(7)
+        for b in range(4):
+            batch = _batch(rng, 10, key_start=b * 10)
+            bounded.append(batch)
+            unbounded.append(batch)
+        assert bounded.rows == 20  # oldest whole batches trimmed
+        assert unbounded.rows == 40
+
     def test_keyed_append_rejects_duplicates(self, metric_log):
         feed = _make_feed(key="k")
         feed.append(_batch(np.random.default_rng(0), 10))
@@ -300,6 +375,10 @@ class TestRefusalsAndSchema:
              "non_foldable_agg"),
             ({"kind": "groupby", "by": "g", "column": "x", "agg": "nunique"},
              "non_foldable_agg"),
+            ({"kind": "scalar", "column": "x", "agg": "summ"},
+             "unknown_agg"),
+            ({"kind": "windowed", "column": "x", "agg": "prod",
+              "bucket_s": 5.0, "time_column": "ts"}, "non_foldable_agg"),
             ({"kind": "filtered", "column": "x",
               "predicate": ("g", ">", 0)}, "row_view_unbounded"),
             ({"kind": "filtered", "column": "x", "agg": "sum",
