@@ -207,6 +207,12 @@ INGEST_BATCHES = int(os.environ.get("BENCH_INGEST_BATCHES", 200))
 INGEST_BATCH_ROWS = int(os.environ.get("BENCH_INGEST_BATCH_ROWS", 256))
 INGEST_READERS = int(os.environ.get("BENCH_INGEST_READERS", 4))
 
+# graftwal: durable-ingest tax per fsync policy (Off / GroupCommit /
+# PerBatch, each vs the memory-only baseline of the same stream) and the
+# crash-recovery wall (full WAL-tail replay of that stream).
+DURABILITY_BATCHES = int(os.environ.get("BENCH_DURABILITY_BATCHES", 200))
+DURABILITY_BATCH_ROWS = int(os.environ.get("BENCH_DURABILITY_BATCH_ROWS", 256))
+
 
 class SectionTimeout(BaseException):
     """A benchmark section overran its wall-clock budget.
@@ -275,6 +281,8 @@ def _run_provenance(platform: str) -> dict:
             "ingest_rows": INGEST_BATCHES * INGEST_BATCH_ROWS,
             "ingest_batches": INGEST_BATCHES,
             "ingest_readers": INGEST_READERS,
+            "durability_rows": DURABILITY_BATCHES * DURABILITY_BATCH_ROWS,
+            "durability_batches": DURABILITY_BATCHES,
             "spmd_rows": SPMD_ROWS,
             "spmd_mesh": SPMD_MESHES,
             "oocore_rows": OOCORE_ROWS,
@@ -2250,6 +2258,150 @@ def main() -> None:
         }
         return sections["ingest"]
 
+    def durability_section():
+        """graftwal: the durable-ingest tax per fsync policy + the
+        crash-recovery wall.  Legs: (1) the same deterministic micro-batch
+        stream appended memory-only (baseline), then WAL-logged under
+        ``Off`` / ``GroupCommit`` / ``PerBatch`` — each leg
+        correctness-checked against pandas; (2) reopening the PerBatch
+        directory, timing full recovery (WAL-tail replay through the
+        ordinary ingest path) and checking the recovered view bit-exact.
+        Ops are scale-keyed @fsync=<leg> so policies never cross-gate."""
+        import shutil
+        import tempfile
+
+        import modin_tpu.ingest as ingest_mod
+        from modin_tpu.config import (
+            IngestEnabled,
+            WalFsync,
+            WalGroupCommitMs,
+            WalMaxReplayBatches,
+        )
+        from modin_tpu.views import registry as _view_registry
+
+        schema = {"i": "int64", "x": "float64", "g": "int64"}
+        batches = [
+            pandas.DataFrame(
+                {
+                    "i": rng.integers(-1000, 1000, DURABILITY_BATCH_ROWS),
+                    "x": rng.normal(size=DURABILITY_BATCH_ROWS),
+                    "g": rng.integers(0, 8, DURABILITY_BATCH_ROWS),
+                }
+            )
+            for _ in range(DURABILITY_BATCHES)
+        ]
+        want_sum = int(
+            sum(int(b["i"].sum()) for b in batches)
+        )
+        n = DURABILITY_BATCHES * DURABILITY_BATCH_ROWS
+        plan = {"kind": "scalar", "column": "i", "agg": "sum"}
+
+        ingest_before = IngestEnabled.get()
+        IngestEnabled.put(True)
+        root = tempfile.mkdtemp(prefix="bench_durability_")
+        walls = {}
+        try:
+            _view_registry.reset()
+            ingest_mod.reset()
+
+            def stream(feed):
+                t0 = time.perf_counter()
+                for b in batches:
+                    feed.append(b)
+                wall = time.perf_counter() - t0
+                assert feed.read("running_sum").value == want_sum
+                return wall
+
+            # warm-up: the first pass over the stream pays a JIT compile
+            # per grown frame shape; run the FULL stream once unmeasured
+            # or the memory baseline (which runs first) absorbs every
+            # compile and the tax ratios lie
+            warm = ingest_mod.create_feed("bench_dur_warm", schema)
+            warm.register_view("running_sum", plan)
+            for b in batches:
+                warm.append(b)
+            warm.read("running_sum")
+            ingest_mod.reset()
+
+            # memory-only baseline: the exact stream, no WAL
+            feed = ingest_mod.create_feed("bench_dur_mem", schema)
+            feed.register_view("running_sum", plan)
+            walls["memory"] = stream(feed)
+            ingest_mod.reset()
+
+            # recovery must replay the WHOLE stream (an honest replay
+            # wall, not a checkpoint restore): keep checkpoints out
+            with WalMaxReplayBatches.context(DURABILITY_BATCHES * 2 + 8):
+                for mode, policy in (
+                    ("off", "Off"),
+                    ("group", "GroupCommit"),
+                    ("perbatch", "PerBatch"),
+                ):
+                    WalFsync.put(policy)
+                    WalGroupCommitMs.put(25.0)
+                    feed = ingest_mod.open_feed(
+                        f"bench_dur_{mode}", schema=schema, durable=True,
+                        durability_dir=root,
+                    )
+                    feed.register_view("running_sum", plan)
+                    walls[mode] = stream(feed)
+                    ingest_mod.reset()  # clean close (final flush + join)
+
+                # crash-recovery wall: reopen the PerBatch feed and replay
+                t0 = time.perf_counter()
+                feed = ingest_mod.open_feed(
+                    "bench_dur_perbatch", durable=True, durability_dir=root,
+                )
+                walls["recovery"] = time.perf_counter() - t0
+                assert feed.rows == n, (feed.rows, n)
+                assert feed.read("running_sum").value == want_sum
+                ingest_mod.reset()
+        finally:
+            WalFsync.put("PerBatch")
+            ingest_mod.reset()
+            IngestEnabled.put(ingest_before)
+            shutil.rmtree(root, ignore_errors=True)
+
+        detail["durability_ingest_off"] = {
+            "modin_tpu_s": round(walls["off"], 4)
+        }
+        detail["durability_ingest_group"] = {
+            "modin_tpu_s": round(walls["group"], 4)
+        }
+        detail["durability_ingest_perbatch"] = {
+            "modin_tpu_s": round(walls["perbatch"], 4)
+        }
+        detail["durability_recovery"] = {
+            "modin_tpu_s": round(walls["recovery"], 4)
+        }
+        sections["durability"] = {
+            "rows": n,
+            "batches": DURABILITY_BATCHES,
+            "batch_rows": DURABILITY_BATCH_ROWS,
+            "memory_s": round(walls["memory"], 4),
+            "wal_off_s": round(walls["off"], 4),
+            "wal_group_s": round(walls["group"], 4),
+            "wal_perbatch_s": round(walls["perbatch"], 4),
+            "recovery_s": round(walls["recovery"], 4),
+            "rate_off_rows_per_s": round(n / max(walls["off"], 1e-9)),
+            "rate_group_rows_per_s": round(n / max(walls["group"], 1e-9)),
+            "rate_perbatch_rows_per_s": round(
+                n / max(walls["perbatch"], 1e-9)
+            ),
+            # the durable tax per policy vs the memory-only baseline
+            "tax_off_x": round(
+                walls["off"] / max(walls["memory"], 1e-9), 2
+            ),
+            "tax_group_x": round(
+                walls["group"] / max(walls["memory"], 1e-9), 2
+            ),
+            "tax_perbatch_x": round(
+                walls["perbatch"] / max(walls["memory"], 1e-9), 2
+            ),
+            "recovery_rows_per_s": round(n / max(walls["recovery"], 1e-9)),
+        }
+        return sections["durability"]
+
     # ---- the run: every section under the global BENCH_DEADLINE ---- #
     # (subprocess timeouts inside shuffle_apply already bound it; the
     # per-section alarm is a backstop there)
@@ -2269,6 +2421,7 @@ def main() -> None:
         ("oocore", oocore_section),
         ("fleet", fleet_section),
         ("ingest", ingest_section),
+        ("durability", durability_section),
     ]
     for name, fn in section_list:
         if SECTION_FILTER and name not in SECTION_FILTER:

@@ -72,6 +72,7 @@ LOCKS: Tuple[Tuple[str, str, str], ...] = (
     # -- ingest (graftfeed) -------------------------------------------- #
     ("ingest.feeds", "lock", "the named-feed table: create/get/drop"),
     ("ingest.feed", "rlock", "one feed's frame, batch log, key index, and registered-view state (folds re-enter via forced reads)"),
+    ("durability.wal", "lock", "one durable feed's WAL segment file, fsync-policy dirty flag, and checkpoint claim"),
     ("parallel.mesh", "lock", "global mesh build-once"),
     ("io.chunker", "lock", "chunker native-library build-once"),
     # -- observability ------------------------------------------------- #
@@ -165,6 +166,7 @@ LOCK_ORDER: Tuple[Tuple[str, str, str], ...] = (
     ("ingest.feeds", "ingest.feed", "the fold-lag probe walks each feed under the table lock; feed code never re-enters the table"),
     ("ingest.feed", "views.registry", "an append under the feed serialization runs concat_rows, which records its append link in the artifact registry"),
     ("ingest.feed", "resilience.dispatch", "appends/trims under the feed serialization dispatch device concats through the engine seam; seam code never re-enters a feed"),
+    ("ingest.feed", "durability.wal", "a durable append logs its pre-encoded WAL record under the feed serialization BEFORE mutating feed state; WAL code never re-enters a feed"),
 )
 
 

@@ -1428,6 +1428,103 @@ class IngestFoldLagMs(EnvironmentVariable, type=float):
         super().put(value)
 
 
+class WalDir(EnvironmentVariable, type=ExactStr):
+    """Root directory for graftwal durability state (per-feed WAL
+    segments, checkpoints, meta.json).  '' (the default) resolves to
+    ``<MODIN_TPU_CACHE_DIR>/wal``.  ``open_feed(..., durability_dir=...)``
+    overrides per call."""
+
+    varname = "MODIN_TPU_WAL_DIR"
+    default = ""
+
+
+class WalFsync(EnvironmentVariable, type=ExactStr):
+    """graftwal fsync policy for WAL record writes:
+
+    - ``PerBatch`` (default): fsync after every accepted micro-batch —
+      an acked batch survives power loss;
+    - ``GroupCommit``: a flusher thread fsyncs dirty segments every
+      ``MODIN_TPU_WAL_GROUP_COMMIT_MS`` — bounded loss window, near-Off
+      ingest rate;
+    - ``Off``: no explicit fsync — survives process crash (the page
+      cache persists), not power loss.
+    """
+
+    varname = "MODIN_TPU_WAL_FSYNC"
+    # ExactStr: the plain str type title-cases ("GroupCommit" ->
+    # "Groupcommit"), so the policy names validate here, not via `choices`
+    default = "PerBatch"
+
+    @classmethod
+    def put(cls, value: str) -> None:
+        if value not in ("PerBatch", "GroupCommit", "Off"):
+            raise ValueError(
+                f"Unsupported value {value!r} for WalFsync; choose one "
+                "of ('PerBatch', 'GroupCommit', 'Off')"
+            )
+        super().put(value)
+
+
+class WalGroupCommitMs(EnvironmentVariable, type=float):
+    """Group-commit flush interval, milliseconds — the loss window under
+    ``MODIN_TPU_WAL_FSYNC=GroupCommit`` (ignored by the other policies)."""
+
+    varname = "MODIN_TPU_WAL_GROUP_COMMIT_MS"
+    default = 25.0
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"WAL group-commit interval should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class WalSegmentBytes(EnvironmentVariable, type=int):
+    """WAL segment roll threshold, bytes: the writer starts a new
+    ``wal_<first_seq>.seg`` file past this size, and checkpoint
+    truncation deletes whole covered segments (reclaim granularity)."""
+
+    varname = "MODIN_TPU_WAL_SEGMENT_BYTES"
+    default = 4_194_304
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"WAL segment size should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class WalMaxReplayBatches(EnvironmentVariable, type=int):
+    """Replay-time bound: a checkpoint is taken once the WAL tail past
+    the newest checkpoint exceeds this many records, so crash recovery
+    never replays more than ~this many batches."""
+
+    varname = "MODIN_TPU_WAL_MAX_REPLAY_BATCHES"
+    default = 256
+
+    @classmethod
+    def put(cls, value: int) -> None:
+        if value <= 0:
+            raise ValueError(
+                f"WAL replay bound should be > 0, passed value {value}"
+            )
+        super().put(value)
+
+
+class FleetDurabilityDir(EnvironmentVariable, type=ExactStr):
+    """INTERNAL: graftwal root a fleet replica recovers durable feeds
+    from on warm-up.  Set by the coordinator in a replica's spawn
+    environment when the fleet is constructed with a durability dir;
+    never set by hand."""
+
+    varname = "MODIN_TPU_FLEET_DURABILITY_DIR"
+    default = ""
+
+
 class TraceEnabled(EnvironmentVariable, type=bool):
     """graftscope structured tracing: spans at the API / query-compiler /
     engine-seam / shuffle-IO layers, the compile ledger's hit accounting,

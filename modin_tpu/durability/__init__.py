@@ -1,0 +1,57 @@
+"""graftwal — durable ingestion for graftfeed.
+
+Write-ahead log + crash-consistent checkpoints + bit-exact replay
+recovery.  Entry points:
+
+- ``modin_tpu.ingest.open_feed(name, ..., durable=True)`` — the public
+  door; it lazy-imports this package, so a process that never opens a
+  durable feed never pays a byte for it (the zero-overhead contract,
+  asserted via :data:`DURABILITY_ON` + :func:`durability_alloc_count`
+  exactly like the graftscope contract);
+- :func:`recover_feeds` — the graftfleet replica warm path: open every
+  durable feed found under a root directory;
+- :class:`DurabilityError` — the one typed refusal.
+"""
+
+from __future__ import annotations
+
+#: flips True on the first durable-feed open; the zero-overhead assert
+#: for non-durable workloads checks this stays False.
+DURABILITY_ON = False
+
+_alloc_count = 0
+
+
+def _note_alloc() -> None:
+    """Count durability-object constructions — the zero-overhead proof
+    hook (mirrors ingest.live.note_alloc / the graftscope contract)."""
+    global _alloc_count
+    _alloc_count += 1
+
+
+def durability_alloc_count() -> int:
+    return _alloc_count
+
+
+def _mark_active() -> None:
+    global DURABILITY_ON
+    DURABILITY_ON = True
+
+
+from modin_tpu.durability.errors import DurabilityError  # noqa: E402
+from modin_tpu.durability.manager import (  # noqa: E402
+    FeedDurability,
+    open_durable_feed,
+    recover_feeds,
+    resolve_root_dir,
+)
+
+__all__ = [
+    "DURABILITY_ON",
+    "DurabilityError",
+    "FeedDurability",
+    "durability_alloc_count",
+    "open_durable_feed",
+    "recover_feeds",
+    "resolve_root_dir",
+]

@@ -1,0 +1,97 @@
+"""graftwal checkpoints: crash-consistent snapshots of a feed + its views.
+
+A checkpoint file ``ckpt_<wal_seq>.ckpt`` holds one pickled snapshot of
+everything a feed would lose in a crash: the retained mirror frame, the
+key index, the batch log spine (seq / rows / abs_start — the row data is
+already in the mirror), and every registered view's complete fold state
+(bootstrap partial, per-batch partials, running state — the same
+foldable state graftview/live.py maintains).  ``wal_seq`` in the name is
+the newest WAL record the snapshot covers: recovery loads the newest
+valid checkpoint and replays only records past it, which is what bounds
+replay time by ``MODIN_TPU_WAL_MAX_REPLAY_BATCHES``.
+
+File format: ``[u32 crc32(payload)][payload]`` written through the
+shared atomic helper (temp file + fsync + rename + directory fsync), so
+a reader sees an old complete checkpoint or a new complete one — never a
+prefix.  A CRC or unpickle failure at load time returns None
+(``checkpoint.invalid``) and recovery falls back to the next-older file
+instead of crashing.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from modin_tpu.durability import wal as _wal
+from modin_tpu.utils.atomic_io import atomic_write_bytes
+
+CKPT_PREFIX = "ckpt_"
+CKPT_SUFFIX = ".ckpt"
+
+_CKPT_HEADER = struct.Struct("<I")  # crc32(payload)
+
+
+def checkpoint_path(feed_dir: str, wal_seq: int) -> str:
+    return os.path.join(feed_dir, f"{CKPT_PREFIX}{wal_seq:016d}{CKPT_SUFFIX}")
+
+
+def list_checkpoints(feed_dir: str) -> List[Tuple[int, str]]:
+    """``[(wal_seq, path)]`` ascending; ignores foreign files."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(feed_dir)
+    except OSError:
+        return out
+    for fname in names:
+        if not (fname.startswith(CKPT_PREFIX) and fname.endswith(CKPT_SUFFIX)):
+            continue
+        digits = fname[len(CKPT_PREFIX):-len(CKPT_SUFFIX)]
+        try:
+            seq = int(digits)
+        except ValueError:
+            continue
+        out.append((seq, os.path.join(feed_dir, fname)))
+    out.sort()
+    return out
+
+
+def serialize_snapshot(snapshot: Dict[str, Any]) -> bytes:
+    """Pickle OUTSIDE any registry lock (graftdep LOCK-BLOCKING)."""
+    return pickle.dumps(snapshot, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def write_checkpoint(feed_dir: str, wal_seq: int, payload: bytes) -> str:
+    """Atomically write one checkpoint; returns its path.  Raises OSError
+    on disk failure (the caller decides: reclaim-and-retry or give up —
+    the WAL still holds every record, so a failed checkpoint loses
+    nothing but replay time)."""
+    _wal.disk_op("checkpoint.write")
+    path = checkpoint_path(feed_dir, wal_seq)
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    atomic_write_bytes(
+        path, _CKPT_HEADER.pack(crc) + payload, durable_rename=True
+    )
+    return path
+
+
+def load_checkpoint(path: str) -> Optional[Dict[str, Any]]:
+    """The snapshot dict, or None when the file is unreadable, fails its
+    CRC, or does not unpickle — recovery treats None as 'try the next
+    older checkpoint', never a crash."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        if len(data) < _CKPT_HEADER.size:
+            return None
+        (crc,) = _CKPT_HEADER.unpack_from(data, 0)
+        payload = data[_CKPT_HEADER.size:]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            return None
+        snapshot = pickle.loads(payload)
+    except (OSError, ValueError, EOFError, pickle.UnpicklingError, AttributeError, ImportError, IndexError):
+        return None
+    return snapshot if isinstance(snapshot, dict) else None

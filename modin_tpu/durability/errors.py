@@ -1,0 +1,40 @@
+"""graftwal typed errors.
+
+Deliberate near-leaf module: only the (leaf) ingest error types are
+imported, so serving / fleet / test code may reference the durability
+error without pulling the WAL machinery in.
+"""
+
+from __future__ import annotations
+
+from modin_tpu.ingest.errors import IngestError
+
+
+class DurabilityError(IngestError):
+    """A durability operation failed in a way the subsystem will not
+    paper over.  ``reason`` is a stable slug so callers can branch
+    without parsing the message:
+
+    - ``enospc`` — the WAL write hit ENOSPC and a retention-driven
+      segment reclaim did not free enough space; the batch was REFUSED
+      before any in-memory mutation (retry after freeing disk);
+    - ``schema_mismatch`` — ``open_feed`` was given a schema that
+      contradicts the on-disk ``meta.json`` (or a WAL record's schema
+      tag contradicts the feed it replays into);
+    - ``corrupt_meta`` — the feed's ``meta.json`` is unreadable, so the
+      feed cannot be reconstructed without an explicit schema;
+    - ``not_durable`` — a durability operation was requested on a feed
+      that has no WAL attached.
+
+    EIO-class write failures do NOT raise this: they trip the per-feed
+    breaker into memory-only degraded mode (``wal.degraded``) because
+    refusing ingestion would turn a lost disk into a lost service.
+    """
+
+    def __init__(self, feed: str, reason: str, detail: str = "") -> None:
+        self.feed = feed
+        self.reason = reason
+        msg = f"feed {feed!r} durability failure: {reason}"
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)

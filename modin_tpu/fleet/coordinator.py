@@ -120,12 +120,19 @@ class _Replica:
 class Coordinator:
     """The fleet control plane (see module docstring)."""
 
-    def __init__(self, replicas: Optional[int] = None):
+    def __init__(self, replicas: Optional[int] = None,
+                 durability_dir: Optional[str] = None):
         from modin_tpu import fleet as _fleet
-        from modin_tpu.config import FleetReplicas
+        from modin_tpu.config import FleetDurabilityDir, FleetReplicas
 
         _fleet._note_alloc()
         count = int(replicas if replicas is not None else FleetReplicas.get())
+        #: graftwal root the replicas recover durable feeds from on warm-up
+        #: (spawn env + respawn) — '' disables durability in the fleet
+        self._durability_dir = str(
+            durability_dir if durability_dir is not None
+            else FleetDurabilityDir.get()
+        )
         self._lock = named_rlock("fleet.coordinator")
         self._replicas = [_Replica(i) for i in range(count)]
         self._assignments: Dict[str, int] = {}  # tenant -> replica index
@@ -228,6 +235,13 @@ class Coordinator:
         # both sides must agree on the heartbeat cadence even when it was
         # configured by put() rather than the environment
         env["MODIN_TPU_FLEET_HEARTBEAT_S"] = str(self._heartbeat_s())
+        if self._durability_dir:
+            # graftwal: the replica recovers its durable feeds (checkpoint
+            # + WAL-tail replay) from this root during warm-up
+            env["MODIN_TPU_FLEET_DURABILITY_DIR"] = self._durability_dir
+            env["MODIN_TPU_INGEST"] = "1"
+        else:
+            env.pop("MODIN_TPU_FLEET_DURABILITY_DIR", None)
         if self._test_crash_next_respawn:
             env["MODIN_TPU_FLEET_TEST_CRASH"] = "warm"
             self._test_crash_next_respawn = False

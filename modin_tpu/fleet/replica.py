@@ -166,10 +166,20 @@ def _handle_request(req: dict) -> dict:
         ingested = view_exporter.ingest_datasets(
             _frames, req.get("views") or {}
         )
+        feeds_recovered = 0
+        dur_dir = os.environ.get("MODIN_TPU_FLEET_DURABILITY_DIR")
+        if dur_dir:
+            # graftwal: a respawned replica comes back with its durable
+            # feeds and live views intact (checkpoint + WAL-tail replay),
+            # not just whatever the manifest/exporter captured
+            from modin_tpu import durability
+
+            feeds_recovered = durability.recover_feeds(dur_dir)
         return {
             "ok": True,
             "datasets": sorted(_frames),
             "views_ingested": ingested,
+            "feeds_recovered": feeds_recovered,
         }
     if kind == "query":
         return _run_query(req)

@@ -49,6 +49,7 @@ from modin_tpu.ingest.feed import (  # noqa: E402,F401
     feeds,
     get_feed,
     max_fold_lag_ms,
+    open_feed,
     reset,
 )
 from modin_tpu.ingest.live import (  # noqa: E402,F401
@@ -70,5 +71,6 @@ __all__ = [
     "get_feed",
     "ingest_alloc_count",
     "max_fold_lag_ms",
+    "open_feed",
     "reset",
 ]

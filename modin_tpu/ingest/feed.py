@@ -135,6 +135,10 @@ class Feed:
         self._seq = -1
         self._rows = 0
         self._base_offset = 0  # absolute id of the first retained row
+        #: graftwal manager when the feed was opened durable=True; None is
+        #: the whole durability cost for ordinary feeds (the zero-overhead
+        #: contract — one attribute check on the hot paths)
+        self._wal = None
 
     # -- public surface (admitted through the serving gate) ------------ #
 
@@ -184,18 +188,30 @@ class Feed:
         except Exception:
             emit_metric("ingest.view.refused", 1)
             raise
-        with self._lock:
-            if name in self._views:
-                raise IngestError(
-                    f"feed {self.name!r}: view {name!r} already registered"
-                )
-            # graftlint: disable=LOCK-BLOCKING -- _FOLD_DELAY_S is a test-only fault hook (default 0.0); folding under the feed lock IS the contract: views advance atomically w.r.t. appends and trims
-            self._fold_pending_locked()
-            if self._rows:
-                view.rebuild(self._mirror, self._base_offset, self._seq)
-            else:
-                view.folded_seq = self._seq
-            self._views[name] = view
+        dur = self._wal
+        # refused plans raise above, so a registration only reaches the
+        # WAL once it validated; pickling happens here, outside the lock
+        encoded = dur.encode_register(name, plan) if dur is not None else None
+        dur_events = [] if dur is not None else None
+        try:
+            with self._lock:
+                if name in self._views:
+                    raise IngestError(
+                        f"feed {self.name!r}: view {name!r} already registered"
+                    )
+                if encoded is not None:
+                    # on disk BEFORE the view exists in memory
+                    dur.log_encoded(encoded, dur_events)
+                # graftlint: disable=LOCK-BLOCKING -- _FOLD_DELAY_S is a test-only fault hook (default 0.0); folding under the feed lock IS the contract: views advance atomically w.r.t. appends and trims
+                self._fold_pending_locked()
+                if self._rows:
+                    view.rebuild(self._mirror, self._base_offset, self._seq)
+                else:
+                    view.folded_seq = self._seq
+                self._views[name] = view
+        finally:
+            if dur is not None:
+                dur.fanout(dur_events)
         return view
 
     def read(self, view_name: str, fresh_within_ms: Optional[float] = None,
@@ -336,15 +352,27 @@ class Feed:
         return pdf
 
     def _append_sync(self, pdf: Any, is_upsert: bool) -> int:
+        dur = self._wal
+        # serialize the batch for the WAL outside every lock (pickle is a
+        # LOCK-BLOCKING operation); None = nothing to log (non-durable
+        # feed, degraded breaker, or this call IS the replay)
+        encoded = dur.encode_batch(pdf, is_upsert) if dur is not None else None
+        dur_events = [] if dur is not None else None
         try:
-            rows, upserted, appended, folded, trimmed = self._append_locked(
-                pdf, is_upsert
-            )
-        except IngestRejected:
-            # key-violation rejects raise under the feed rlock; the
-            # counter fans out here, after it released
-            emit_metric("ingest.reject", 1)
-            raise
+            try:
+                rows, upserted, appended, folded, trimmed = (
+                    self._append_locked(pdf, is_upsert, encoded, dur_events)
+                )
+            except IngestRejected:
+                # key-violation rejects raise under the feed rlock; the
+                # counter fans out here, after it released
+                emit_metric("ingest.reject", 1)
+                raise
+        finally:
+            # wal.* events (including those of a refusing DurabilityError
+            # path, e.g. an exhausted ENOSPC reclaim) fan out lock-free
+            if dur is not None:
+                dur.fanout(dur_events)
         if appended:
             emit_metric("ingest.batch", 1)
             emit_metric("ingest.rows", appended)
@@ -354,9 +382,12 @@ class Feed:
             emit_metric("ingest.fold", folded)
         if trimmed:
             emit_metric("ingest.trim.rows", trimmed)
+        if dur is not None:
+            dur.maybe_checkpoint()
         return rows
 
-    def _append_locked(self, pdf: Any, is_upsert: bool):
+    def _append_locked(self, pdf: Any, is_upsert: bool,
+                       encoded=None, dur_events=None):
         import pandas
 
         import modin_tpu.pandas as mpd
@@ -365,6 +396,31 @@ class Feed:
         with span("ingest.append", layer="APP", feed=self.name,
                   rows=len(pdf)):
             with self._lock:
+                if not is_upsert and self.key is not None and len(pdf):
+                    # key violations reject BEFORE the WAL sees the batch
+                    # (rejects are never logged); moved ahead of the log
+                    # call from the elif below for exactly that ordering
+                    dup = pdf[self.key].duplicated(keep=False)
+                    if bool(dup.any()):
+                        self._reject(
+                            "duplicate_key",
+                            column=self.key,
+                            detail="batch repeats a key; keys must be "
+                            "unique within an append",
+                        )
+                    for k in pdf[self.key]:
+                        if k in self._key_index:
+                            self._reject(
+                                "key_exists", column=self.key, got=k,
+                                detail="append repeats a stored key — use "
+                                "upsert",
+                            )
+                if encoded is not None and len(pdf):
+                    # write-ahead: the record is on disk (per the fsync
+                    # policy) before ANY in-memory mutation below; an
+                    # exhausted-ENOSPC DurabilityError refuses the batch
+                    # here with the feed state untouched
+                    self._wal.log_encoded(encoded, dur_events)
                 if is_upsert and len(pdf):
                     # batch last-wins among duplicate keys
                     pdf = pdf.drop_duplicates(
@@ -385,22 +441,6 @@ class Feed:
                         self._rebuild_frame_locked(mpd)
                         self._rebuild_views_locked()
                         upserted = len(updates)
-                elif self.key is not None and len(pdf):
-                    dup = pdf[self.key].duplicated(keep=False)
-                    if bool(dup.any()):
-                        self._reject(
-                            "duplicate_key",
-                            column=self.key,
-                            detail="batch repeats a key; keys must be "
-                            "unique within an append",
-                        )
-                    for k in pdf[self.key]:
-                        if k in self._key_index:
-                            self._reject(
-                                "key_exists", column=self.key, got=k,
-                                detail="append repeats a stored key — use "
-                                "upsert",
-                            )
                 if len(pdf):
                     self._seq += 1
                     rec = _BatchRecord(
@@ -591,6 +631,56 @@ def create_feed(name: str, schema: Dict[str, Any],
     return feed
 
 
+def open_feed(name: str, schema: Optional[Dict[str, Any]] = None,
+              key: Optional[str] = None,
+              retention_rows: Optional[int] = None,
+              retention_age_s: Optional[float] = None,
+              durable: bool = False,
+              durability_dir: Optional[str] = None) -> Feed:
+    """:func:`create_feed`, plus the graftwal door.  ``durable=False``
+    (the default) is exactly ``create_feed`` — the durability package is
+    not even imported, so ordinary feeds stay bit-for-bit unchanged.
+
+    ``durable=True`` lazy-imports ``modin_tpu.durability`` and opens a
+    write-ahead-logged feed under ``durability_dir`` (default:
+    ``MODIN_TPU_WAL_DIR``, else ``<MODIN_TPU_CACHE_DIR>/wal``).  A fresh
+    feed needs a ``schema``; an existing durability directory is
+    RECOVERED — newest valid checkpoint plus WAL-tail replay through the
+    ordinary ingest path, run under the serving gate as a maintenance
+    query — and ``schema`` may then be omitted (it is read from the
+    feed's ``meta.json``; supplying a contradicting one is a typed
+    ``DurabilityError``)."""
+    if not durable:
+        if schema is None:
+            raise IngestError(
+                f"feed {name!r}: a non-durable open_feed needs a schema"
+            )
+        return create_feed(name, schema, key=key,
+                           retention_rows=retention_rows,
+                           retention_age_s=retention_age_s)
+    from modin_tpu import ingest as _ingest
+
+    if not _ingest.INGEST_ON:
+        raise IngestError(
+            "continuous ingestion is disabled; set MODIN_TPU_INGEST=1 "
+            "(config.IngestEnabled.enable())"
+        )
+    from modin_tpu import durability as _durability
+
+    feed = _durability.open_durable_feed(
+        name, schema, key=key, retention_rows=retention_rows,
+        retention_age_s=retention_age_s, root_dir=durability_dir,
+    )
+    with _FEEDS_LOCK:
+        conflict = name in _feeds
+        if not conflict:
+            _feeds[name] = feed
+    if conflict:
+        feed._wal.close()  # outside the table lock (join is blocking)
+        raise IngestError(f"feed {name!r} already exists")
+    return feed
+
+
 def get_feed(name: str) -> Feed:
     with _FEEDS_LOCK:
         feed = _feeds.get(name)
@@ -601,7 +691,10 @@ def get_feed(name: str) -> Feed:
 
 def drop_feed(name: str) -> None:
     with _FEEDS_LOCK:
-        _feeds.pop(name, None)
+        feed = _feeds.pop(name, None)
+    if feed is not None and feed._wal is not None:
+        # final fsync + flusher join happen OUTSIDE the table lock
+        feed._wal.close()
 
 
 def feeds() -> List[str]:
@@ -623,4 +716,8 @@ def max_fold_lag_ms() -> float:
 def reset() -> None:
     """Drop every feed (tests)."""
     with _FEEDS_LOCK:
+        snapshot = list(_feeds.values())
         _feeds.clear()
+    for feed in snapshot:
+        if feed._wal is not None:
+            feed._wal.close()

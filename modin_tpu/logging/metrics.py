@@ -668,6 +668,98 @@ METRICS = (
         "placed into the grown prefix buffer instead of re-gathering "
         "every row",
     ),
+    (
+        "wal.append",
+        "counter",
+        "graftwal records appended (accepted micro-batches + view "
+        "registrations) — each lands on disk BEFORE the in-memory mutation",
+    ),
+    (
+        "wal.append.bytes",
+        "counter",
+        "graftwal bytes appended to segment files (value = record size "
+        "including header)",
+    ),
+    (
+        "wal.fsync",
+        "counter",
+        "graftwal fsync calls issued (per batch under PerBatch, per "
+        "flusher tick under GroupCommit)",
+    ),
+    (
+        "wal.segment.roll",
+        "counter",
+        "graftwal segment files rolled past MODIN_TPU_WAL_SEGMENT_BYTES",
+    ),
+    (
+        "wal.truncate.segments",
+        "counter",
+        "graftwal segment files deleted (value = files): checkpoint "
+        "truncation of fully-covered segments, ENOSPC reclaim, or "
+        "unreachable segments past a torn tail",
+    ),
+    (
+        "wal.torn_tail",
+        "counter",
+        "graftwal torn tails truncated during recovery: the segment ended "
+        "in a short header/body or CRC mismatch and everything past the "
+        "last intact record was discarded with accounting, never a crash",
+    ),
+    (
+        "wal.degraded",
+        "counter",
+        "graftwal per-feed breakers tripped into memory-only degraded "
+        "mode by an EIO-class write/fsync failure — ingestion keeps "
+        "working, durability honestly reports itself lost",
+    ),
+    (
+        "wal.enospc.reclaim",
+        "counter",
+        "graftwal ENOSPC reclaim passes: checkpoint-covered segments and "
+        "stale checkpoints deleted before retrying the refused write",
+    ),
+    (
+        "wal.replay.batches",
+        "counter",
+        "graftwal records replayed through the ordinary ingest path "
+        "during crash recovery (value = records past the checkpoint)",
+    ),
+    (
+        "wal.replay.skipped",
+        "counter",
+        "graftwal records skipped as already applied during replay "
+        "(covered by the checkpoint — the idempotence accounting)",
+    ),
+    (
+        "checkpoint.write",
+        "counter",
+        "graftwal checkpoints written (temp-file + fsync + atomic rename "
+        "of the feed frame plus every view's fold state)",
+    ),
+    (
+        "checkpoint.bytes",
+        "counter",
+        "graftwal checkpoint payload bytes written (value = serialized "
+        "snapshot size)",
+    ),
+    (
+        "checkpoint.load",
+        "counter",
+        "graftwal checkpoints loaded successfully at recovery",
+    ),
+    (
+        "checkpoint.invalid",
+        "counter",
+        "graftwal checkpoint files refused at recovery (CRC/unpickle "
+        "failure or foreign schema tag) — recovery falls back to the "
+        "next-older checkpoint instead of crashing",
+    ),
+    (
+        "recovery.feed",
+        "counter",
+        "graftwal feed recoveries completed (checkpoint restore + WAL "
+        "tail replay, run under the serving gate as a maintenance query)",
+    ),
 )
 
 

@@ -724,9 +724,10 @@ def _store_cached_peaks(platform: str, peaks: dict) -> None:
     try:
         import os
 
+        from modin_tpu.utils.atomic_io import atomic_write_json
+
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(peaks, f)
+        atomic_write_json(path, peaks)
     except Exception:
         pass
 

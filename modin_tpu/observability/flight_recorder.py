@@ -139,13 +139,18 @@ def dump_flight_record(reason: str, detail: str = "") -> Optional[str]:
             },
             counters=counters,
         )
-        path.write_text(json.dumps(trace))
+        # atomic: a dump that dies mid-write (ENOSPC, crash) must leave NO
+        # truncated trace file — forensics tooling loads whatever it finds
+        from modin_tpu.utils.atomic_io import atomic_write_json
+
+        atomic_write_json(str(path), trace)
         return str(path)
     except Exception:
         # best-effort by contract: a failed dump must not worsen the fault —
         # and must not consume the rate-limit window (a transiently
         # unwritable TraceDir would otherwise suppress the next, possibly
-        # successful, dump of the real fault).  Only release OUR claim:
+        # successful, dump of the real fault; partial-WRITE failures release
+        # it too, not just open/serialize ones).  Only release OUR claim:
         # under simultaneous breaker-opens (graftgate: many threads, one
         # incident) another thread may have claimed a newer window and be
         # writing its dump right now — unconditionally zeroing the limiter

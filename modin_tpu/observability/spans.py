@@ -178,6 +178,19 @@ SPANS = (
         "forced synchronous fold, state snapshot (feed / view in "
         "attributes)",
     ),
+    (
+        "checkpoint.write",
+        "one graftwal checkpoint: pending folds drained, feed frame + "
+        "every view's fold state snapshotted under the feed lock, "
+        "serialized and atomically written outside it, covered WAL "
+        "segments truncated (feed in attributes)",
+    ),
+    (
+        "recovery.replay",
+        "one graftwal crash recovery: newest valid checkpoint restored, "
+        "WAL tail replayed through the ordinary ingest path, torn tail "
+        "truncated with accounting (feed in attributes)",
+    ),
 )
 
 _EPOCH_PERF = time.perf_counter()

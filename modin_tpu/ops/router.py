@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional
 from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import spans as graftscope
+from modin_tpu.utils.atomic_io import atomic_write_json
 
 #: column strategies a sort-shaped plan may carry (see plan_strategies in
 #: ops/reductions.py): "dict" costs ~0 (host categories already known),
@@ -294,10 +295,7 @@ def get_calibration() -> Optional[Dict[str, float]]:
         _calibration_mesh = mesh_key
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(table, f)
-            os.replace(tmp, path)
+            atomic_write_json(path, table)
         except OSError:
             pass  # unwritable CacheDir: recalibrate next process
         return table

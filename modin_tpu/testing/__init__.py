@@ -1,12 +1,14 @@
 """Test-support utilities shipped with the library (fault injection, ...)."""
 
 from modin_tpu.testing.faults import (  # noqa: F401
+    DiskFaultInjector,
     FaultInjector,
     MixedFaultInjector,
     OomBurstInjector,
     ReplicaFaultInjector,
     SequencedFaultInjector,
     concurrent_chaos,
+    inject_disk_faults,
     inject_faults,
     make_device_error,
     midquery_device_loss,

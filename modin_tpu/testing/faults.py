@@ -443,3 +443,132 @@ class ReplicaFaultInjector:
     def crash_next_respawn(self) -> None:
         """Arm a one-shot crash in the next respawn's warm RPC."""
         self.coordinator._test_crash_next_respawn = True
+
+
+# ---------------------------------------------------------------------- #
+# disk injectors (the graftwal durability suite)
+# ---------------------------------------------------------------------- #
+
+_DISK_OPS = (
+    "wal.write",
+    "wal.fsync",
+    "wal.truncate",
+    "checkpoint.write",
+    "checkpoint.truncate",
+)
+
+
+class DiskFaultInjector:
+    """Deterministic disk faults at the graftwal seam
+    (``modin_tpu.durability.wal._disk_fault_hook``).
+
+    Every WAL/checkpoint disk operation consults the hook first, so the
+    schedule decides exactly WHICH write/fsync/truncate fails and how:
+
+    - ``'enospc'`` — ``OSError(ENOSPC)``: exercises the reclaim-then-
+      retry path and the typed ``DurabilityError`` refusal;
+    - ``'eio'`` — ``OSError(EIO)``: trips the per-feed breaker into
+      memory-only degraded mode (``wal.degraded``);
+    - ``'fsync_fail'`` — ``OSError(EIO)`` aimed at fsync ops (an fsync
+      that fails is durability already lost: the writer degrades);
+    - ``'torn_write'`` — valid for ``wal.write`` only: the first
+      ``torn_bytes`` bytes of the record land on disk and the process
+      SIGKILLs itself — a REAL torn tail for recovery to truncate;
+    - ``'kill'`` — SIGKILL immediately *before* the matching operation:
+      mid-batch (``wal.write``), mid-checkpoint (``checkpoint.write``),
+      mid-truncate (``wal.truncate`` / ``checkpoint.truncate``) crash
+      points for the differential recovery grid.
+
+    Same determinism contract as the engine-seam injectors: faults fire
+    on the first ``times`` matching calls after ``skip`` clean ones, one
+    injector active at a time.
+
+        with DiskFaultInjector("enospc", ops=("wal.write",)) as inj:
+            feed.append(batch)       # reclaim runs, then the retry lands
+        assert inj.injected == 1
+    """
+
+    def __init__(
+        self,
+        kind: str = "eio",
+        ops: Iterable[str] = ("wal.write",),
+        times: Optional[int] = 1,
+        skip: int = 0,
+        torn_bytes: int = 5,
+    ):
+        if kind not in ("enospc", "eio", "fsync_fail", "torn_write", "kill"):
+            raise ValueError(f"unknown disk fault kind {kind!r}")
+        unknown = set(ops) - set(_DISK_OPS)
+        if unknown:
+            raise ValueError(f"unknown disk ops {sorted(unknown)}")
+        if kind == "torn_write" and set(ops) != {"wal.write"}:
+            raise ValueError(
+                "torn_write is only meaningful for ops=('wal.write',)"
+            )
+        self.kind = kind
+        self.ops = frozenset(ops)
+        self.times = times
+        self.skip = skip
+        self.torn_bytes = int(torn_bytes)
+        self.injected = 0
+        self.calls = 0
+        self._lock = named_lock("testing.faults")
+
+    def _hook(self, op: str) -> Optional[int]:
+        if op not in self.ops:
+            return None
+        with self._lock:
+            self.calls += 1
+            if self.calls <= self.skip:
+                return None
+            if self.times is not None and self.injected >= self.times:
+                return None
+            self.injected += 1
+        if self.kind == "enospc":
+            import errno
+
+            raise OSError(
+                errno.ENOSPC,
+                "No space left on device [injected by modin_tpu.testing.faults]",
+            )
+        if self.kind in ("eio", "fsync_fail"):
+            import errno
+
+            raise OSError(
+                errno.EIO,
+                "Input/output error [injected by modin_tpu.testing.faults]",
+            )
+        if self.kind == "torn_write":
+            return self.torn_bytes  # the writer lands a prefix + SIGKILLs
+        # 'kill': die before the operation — nothing of it reaches disk
+        import os as _os
+        import signal as _signal
+
+        _os.kill(_os.getpid(), _signal.SIGKILL)
+        return None  # pragma: no cover - unreachable
+
+    def __enter__(self) -> "DiskFaultInjector":
+        from modin_tpu.durability import wal as _wal
+
+        if _wal._disk_fault_hook is not None:
+            raise RuntimeError("another DiskFaultInjector is already active")
+        _wal._disk_fault_hook = self._hook
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        from modin_tpu.durability import wal as _wal
+
+        _wal._disk_fault_hook = None
+
+
+def inject_disk_faults(
+    kind: str = "eio",
+    ops: Iterable[str] = ("wal.write",),
+    times: Optional[int] = 1,
+    skip: int = 0,
+    torn_bytes: int = 5,
+) -> DiskFaultInjector:
+    """Sugar for ``DiskFaultInjector(...)`` — see its docstring."""
+    return DiskFaultInjector(
+        kind=kind, ops=ops, times=times, skip=skip, torn_bytes=torn_bytes
+    )

@@ -42,6 +42,7 @@ EXPECTED_SECTIONS = (
     "oocore",
     "fleet",
     "ingest",
+    "durability",
 )
 
 SMOKE_ENV = {
@@ -76,6 +77,10 @@ SMOKE_ENV = {
     # readers to land several bounded reads, small enough to stay quick
     "BENCH_INGEST_BATCHES": "60",
     "BENCH_INGEST_BATCH_ROWS": "64",
+    # durable ingest at smoke scale: enough batches for the fsync-policy
+    # walls to separate and the recovery replay to be non-trivial
+    "BENCH_DURABILITY_BATCHES": "40",
+    "BENCH_DURABILITY_BATCH_ROWS": "64",
     # same reasoning as the recovery overhead: the 5% graftwatch telemetry
     # budget belongs to full-scale runs, a ~5ms admitted p50 flakes on noise
     "BENCH_WATCH_OVERHEAD_PCT": "100",

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all nineteen gates, fail on any red
+#   ./scripts/check_all.sh            # all twenty gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -80,6 +80,12 @@
 #       freshness bounds honored, retention-trim + mid-ingest DeviceLost
 #       bit-exact, the fold_lag tripwire fires with exactly ONE evidence
 #       bundle, and maintained reads beat recompute >= 3x
+#   0o. graftwal durability smoke: a child process ingesting a durable
+#       feed is SIGKILLed by an injected torn record write; reopening the
+#       directory must load a checkpoint, truncate the torn tail, replay
+#       the WAL tail (wal.replay.batches > 0), and serve the frame + both
+#       views bit-exact vs pandas at the recovered batch count — then
+#       keep ingesting durably
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -118,6 +124,7 @@ run_gate "graftwatch"      python scripts/watch_smoke.py
 run_gate "graftfleet"      python scripts/fleet_smoke.py
 run_gate "graftdep"        python scripts/lockdep_smoke.py
 run_gate "graftfeed"       python scripts/ingest_smoke.py
+run_gate "graftwal"        python scripts/durability_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -127,4 +134,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL NINETEEN GATES GREEN"
+echo "ALL TWENTY GATES GREEN"

@@ -1,0 +1,214 @@
+"""graftwal smoke gate: kill -9 mid-ingest, recover, bit-exact vs pandas.
+
+Run by scripts/check_all.sh (the twentieth gate).  Under
+MODIN_TPU_LOCKDEP strict, it proves the durability contract the way it
+is meant to be used — across a real process death:
+
+1. a CHILD process opens a durable feed (PerBatch fsync, small segments,
+   a checkpoint cadence that fires mid-stream), registers two live views,
+   streams deterministic micro-batches, and is SIGKILLed by an injected
+   torn record write (testing/faults.DiskFaultInjector) — a real crash
+   with a partial record on disk, acked batches printed as they land;
+2. THIS process reopens the durability directory: recovery must load a
+   checkpoint, truncate the torn tail, and replay the WAL tail through
+   the ordinary ingest path with ``wal.replay.batches > 0``;
+3. the recovered frame and BOTH views must be bit-exact vs a pandas
+   control built from exactly the recovered batch count R, with
+   acked <= R <= acked + 1 — no acked batch lost, none invented;
+4. the recovered feed keeps ingesting, and a second (clean) reopen is
+   bit-exact again — recovery leaves a feed that is still durable;
+5. zero lockdep violations the whole way.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_LOCKDEP"] = "1"
+os.environ["MODIN_TPU_INGEST"] = "1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pandas  # noqa: E402
+
+TOTAL = 24
+BATCH_ROWS = 16
+TORN_AT = 20  # wal.write ops: 2 view registrations + one per batch
+
+_SCHEMA = {"k": "int64", "i": "int64", "x": "float64", "g": "int64"}
+
+_CHILD = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_INGEST"] = "1"
+os.environ["MODIN_TPU_LOCKDEP"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import pandas
+from modin_tpu import ingest
+from modin_tpu.config import WalFsync, WalMaxReplayBatches, WalSegmentBytes
+from modin_tpu.testing import DiskFaultInjector
+
+WalFsync.put("PerBatch")
+WalMaxReplayBatches.put(8)
+WalSegmentBytes.put(4096)
+feed = ingest.open_feed(
+    "smoke",
+    schema={"k": "int64", "i": "int64", "x": "float64", "g": "int64"},
+    durable=True, durability_dir=os.environ["DUR_DIR"],
+)
+feed.register_view("total", {"kind": "scalar", "column": "i", "agg": "sum"})
+feed.register_view(
+    "by_group", {"kind": "groupby", "by": "g", "column": "i", "agg": "sum"}
+)
+inj = DiskFaultInjector(
+    kind="torn_write", ops=("wal.write",), times=1,
+    skip=int(os.environ["DUR_TORN_AT"]), torn_bytes=11,
+)
+inj.__enter__()  # never exits: the torn write SIGKILLs this process
+for b in range(int(os.environ["DUR_TOTAL"])):
+    rng = np.random.default_rng(4000 + b)
+    n = int(os.environ["DUR_ROWS"])
+    feed.append(pandas.DataFrame({
+        "k": np.arange(b * n, b * n + n, dtype=np.int64),
+        "i": rng.integers(-1000, 1000, n),
+        "x": rng.normal(size=n),
+        "g": rng.integers(0, 5, n),
+    }))
+    print("ACKED", b + 1, flush=True)
+print("SURVIVED", flush=True)
+"""
+
+
+def _batch(b, n=BATCH_ROWS):
+    rng = np.random.default_rng(4000 + b)
+    return pandas.DataFrame(
+        {
+            "k": np.arange(b * n, b * n + n, dtype=np.int64),
+            "i": rng.integers(-1000, 1000, n),
+            "x": rng.normal(size=n),
+            "g": rng.integers(0, 5, n),
+        }
+    )
+
+
+def _control(nbatches):
+    pdf = pandas.concat(
+        [_batch(b) for b in range(nbatches)], ignore_index=True
+    )
+    return pdf.astype(_SCHEMA)
+
+
+def _assert_views(feed, control):
+    assert feed.read("total").value == control["i"].sum(), (
+        feed.read("total").value, control["i"].sum()
+    )
+    got = pandas.Series(feed.read("by_group").value)
+    want = control.groupby("g")["i"].sum()
+    assert list(got.index) == list(want.index), (got, want)
+    assert np.array_equal(got.to_numpy(), want.to_numpy()), (got, want)
+
+
+def main() -> int:
+    from modin_tpu import ingest
+    from modin_tpu.concurrency import lockdep
+    from modin_tpu.logging import add_metric_handler
+
+    assert lockdep.enabled(), "MODIN_TPU_LOCKDEP=1 did not enable lockdep"
+    lockdep.enable(strict=True)
+
+    seen = []
+    add_metric_handler(lambda name, value: seen.append((name, value)))
+
+    dur_dir = tempfile.mkdtemp(prefix="durability_smoke_")
+
+    # ---- leg 1: the child ingests and dies to a torn record write ----- #
+    env = dict(
+        os.environ,
+        DUR_DIR=dur_dir,
+        DUR_TOTAL=str(TOTAL),
+        DUR_ROWS=str(BATCH_ROWS),
+        DUR_TORN_AT=str(TORN_AT),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert "SURVIVED" not in proc.stdout, (
+        f"the injected torn write never fired:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stdout, proc.stderr
+    )
+    acked = sum(
+        1 for line in proc.stdout.splitlines() if line.startswith("ACKED")
+    )
+    assert acked >= TORN_AT - 3, (acked, proc.stdout, proc.stderr)
+    print(f"durability_smoke: child SIGKILLed mid-record ({acked} acked)")
+
+    # ---- leg 2: recover in THIS process -------------------------------- #
+    feed = ingest.open_feed("smoke", durable=True, durability_dir=dur_dir)
+    replayed = sum(
+        v for n, v in seen if n == "modin_tpu.wal.replay.batches"
+    )
+    assert replayed > 0, "recovery replayed nothing"
+    assert any(n == "modin_tpu.recovery.feed" for n, _ in seen), (
+        "recovery.feed never emitted"
+    )
+    assert any(n == "modin_tpu.checkpoint.load" for n, _ in seen), (
+        "no checkpoint was loaded (cadence 8 over 20+ batches)"
+    )
+    assert any(n == "modin_tpu.wal.torn_tail" for n, _ in seen), (
+        "the torn record was never truncated"
+    )
+
+    # ---- leg 3: bit-exact vs pandas at the recovered batch count ------- #
+    assert feed.rows % BATCH_ROWS == 0, (
+        f"recovery surfaced a partial batch: {feed.rows} rows"
+    )
+    recovered = feed.rows // BATCH_ROWS
+    assert acked <= recovered <= min(acked + 1, TOTAL), (acked, recovered)
+    control = _control(recovered)
+    got = feed.frame._to_pandas().reset_index(drop=True)
+    pandas.testing.assert_frame_equal(got, control.reset_index(drop=True))
+    _assert_views(feed, control)
+    print(
+        f"durability_smoke: recovered {recovered}/{TOTAL} batches "
+        f"({replayed} replayed past the checkpoint), frame + 2 views "
+        f"bit-exact vs pandas"
+    )
+
+    # ---- leg 4: still durable after recovery --------------------------- #
+    for b in range(recovered, recovered + 3):
+        feed.append(_batch(b))
+    control = _control(recovered + 3)
+    _assert_views(feed, control)
+    ingest.reset()  # clean close
+    feed = ingest.open_feed("smoke", durable=True, durability_dir=dur_dir)
+    got = feed.frame._to_pandas().reset_index(drop=True)
+    pandas.testing.assert_frame_equal(got, control.reset_index(drop=True))
+    _assert_views(feed, control)
+    ingest.reset()
+    print("durability_smoke: post-recovery ingest + clean reopen bit-exact")
+
+    assert not lockdep.violations(), lockdep.violations()
+    print("durability_smoke: OK (zero lockdep violations)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,674 @@
+"""graftwal acceptance: WAL, crash-consistent checkpoints, bit-exact replay.
+
+Covers the durability contract end to end:
+
+- round-trip recovery under every fsync policy (PerBatch / GroupCommit /
+  Off): a durable feed with registered views closes, reopens, and every
+  row, key-index entry, and view answer is bit-exact vs pandas;
+- checkpoints bound replay: past ``MODIN_TPU_WAL_MAX_REPLAY_BATCHES``
+  a checkpoint lands (temp-file + fsync + atomic rename), covered WAL
+  segments are truncated, and recovery replays at most the tail;
+- the differential kill -9 grid: a child process ingests a deterministic
+  stream and is SIGKILLed at injected points (mid-record torn write,
+  mid-checkpoint, mid-truncate — testing/faults.DiskFaultInjector); the
+  parent reopens the directory and the recovered state must be bit-exact
+  to an uninterrupted control at SOME batch count R with
+  acked <= R <= acked+1 — durability never loses an acked batch and
+  never invents one;
+- torn tails and flipped bytes: garbage or a single flipped bit in a
+  segment truncates to the last intact record with ``wal.torn_tail``
+  accounting, never a crash;
+- disk-fault policy: ENOSPC triggers one retention-driven reclaim then a
+  typed ``DurabilityError`` refusal BEFORE any in-memory mutation; EIO
+  trips the per-feed breaker into memory-only degraded mode
+  (``wal.degraded``) and ingestion keeps working;
+- the zero-overhead contract: a non-durable feed never imports the
+  durability package (subprocess), allocates nothing
+  (``durability_alloc_count``), and carries exactly one ``_wal is None``
+  check on the hot path;
+- satellite regressions: fleet coordinators export the durability root
+  to replica spawn environments, and a flight-recorder dump that dies
+  mid-write releases the shared claim window (the next dump of the real
+  fault must not be rate-limited away).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pandas
+import pytest
+
+from modin_tpu import ingest
+from modin_tpu.config import (
+    IngestEnabled,
+    WalFsync,
+    WalGroupCommitMs,
+    WalMaxReplayBatches,
+    WalSegmentBytes,
+)
+from modin_tpu.logging.metrics import add_metric_handler, clear_metric_handler
+from modin_tpu.views import registry
+
+from tests.utils import df_equals, require_tpu_execution
+
+_SCHEMA = {"k": "int64", "i": "int64", "x": "float64", "g": "int64"}
+_BATCH_ROWS = 16
+
+_PLANS = {
+    "total": {"kind": "scalar", "column": "i", "agg": "sum"},
+    "by_group": {"kind": "groupby", "by": "g", "column": "i", "agg": "sum"},
+}
+
+
+@pytest.fixture(autouse=True)
+def _durability_env(tmp_path):
+    require_tpu_execution()
+    registry.reset()
+    ingest.reset()
+    IngestEnabled.enable()
+    yield
+    ingest.reset()
+    registry.reset()
+    IngestEnabled.disable()
+    WalFsync.put("PerBatch")
+    WalGroupCommitMs.put(25.0)
+    WalMaxReplayBatches.put(256)
+    WalSegmentBytes.put(4_194_304)
+    # a test that died inside a DiskFaultInjector context must not leak
+    # its hook into the next test
+    from modin_tpu.durability import wal
+
+    wal._disk_fault_hook = None
+
+
+@pytest.fixture
+def metric_log():
+    events = []
+
+    def handler(name, value):
+        events.append((name, value))
+
+    add_metric_handler(handler)
+    yield events
+    clear_metric_handler(handler)
+
+
+def _count(events, name):
+    return sum(1 for n, _ in events if n == f"modin_tpu.{name}")
+
+
+def _total(events, name):
+    return sum(v for n, v in events if n == f"modin_tpu.{name}")
+
+
+def _batch(b, n=_BATCH_ROWS, key_start=None):
+    rng = np.random.default_rng(7000 + b)
+    start = b * n if key_start is None else key_start
+    return pandas.DataFrame(
+        {
+            "k": np.arange(start, start + n, dtype=np.int64),
+            "i": rng.integers(-1000, 1000, n),
+            "x": rng.normal(size=n),
+            "g": rng.integers(0, 5, n),
+        }
+    )
+
+
+def _control(nbatches):
+    if nbatches == 0:
+        return pandas.DataFrame(
+            {c: pandas.Series(dtype=d) for c, d in _SCHEMA.items()}
+        )
+    pdf = pandas.concat(
+        [_batch(b) for b in range(nbatches)], ignore_index=True
+    )
+    return pdf.astype(_SCHEMA)
+
+
+def _assert_feed_equals(feed, control):
+    df_equals(
+        feed.frame._to_pandas().reset_index(drop=True),
+        control.reset_index(drop=True),
+    )
+
+
+def _assert_views(feed, control):
+    assert feed.read("total").value == control["i"].sum()
+    got = pandas.Series(feed.read("by_group").value)
+    want = control.groupby("g")["i"].sum()
+    pandas.testing.assert_series_equal(
+        got, want, check_names=False, check_index_type=False
+    )
+
+
+# ====================================================================== #
+# round-trip recovery
+# ====================================================================== #
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("policy", ["PerBatch", "GroupCommit", "Off"])
+    def test_recover_bit_exact(self, tmp_path, policy, metric_log):
+        WalFsync.put(policy)
+        WalGroupCommitMs.put(5.0)
+        feed = ingest.open_feed(
+            "events", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        for name, plan in _PLANS.items():
+            feed.register_view(name, plan)
+        for b in range(6):
+            feed.append(_batch(b))
+        control = _control(6)
+        _assert_feed_equals(feed, control)
+        ingest.reset()  # clean close: final flush + flusher join
+
+        feed = ingest.open_feed(
+            "events", durable=True, durability_dir=str(tmp_path)
+        )
+        assert feed.rows == 6 * _BATCH_ROWS
+        # no checkpoint was due (bound 256), so the whole log replayed:
+        # 2 registrations + 6 batches
+        assert feed._wal.replayed_batches == 8
+        assert _total(metric_log, "wal.replay.batches") == 8
+        assert _count(metric_log, "recovery.feed") == 1
+        _assert_feed_equals(feed, control)
+        _assert_views(feed, control)
+        # the recovered feed keeps ingesting — and THAT survives too
+        feed.append(_batch(6))
+        control = _control(7)
+        _assert_views(feed, control)
+        ingest.reset()
+        feed = ingest.open_feed(
+            "events", durable=True, durability_dir=str(tmp_path)
+        )
+        _assert_feed_equals(feed, control)
+        _assert_views(feed, control)
+
+    def test_upsert_key_index_recovered(self, tmp_path):
+        feed = ingest.open_feed(
+            "keyed", schema=_SCHEMA, key="k", durable=True,
+            durability_dir=str(tmp_path),
+        )
+        for b in range(4):
+            feed.append(_batch(b))
+        up = _batch(9, n=20, key_start=50)  # 14 updates + 6 new keys
+        feed.upsert(up)
+        want = feed.frame._to_pandas().reset_index(drop=True)
+        ingest.reset()
+
+        feed = ingest.open_feed(
+            "keyed", durable=True, durability_dir=str(tmp_path)
+        )
+        assert feed.key == "k"  # inherited from meta.json
+        df_equals(feed.frame._to_pandas().reset_index(drop=True), want)
+        # the key index came back: upserting the same keys again updates
+        # in place instead of appending
+        rows_before = feed.rows
+        feed.upsert(up)
+        assert feed.rows == rows_before
+
+    def test_checkpoint_bounds_replay(self, tmp_path, metric_log):
+        WalMaxReplayBatches.put(4)
+        WalSegmentBytes.put(1024)  # force several segments
+        feed = ingest.open_feed(
+            "ckpt", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        feed.register_view("total", _PLANS["total"])
+        for b in range(12):
+            feed.append(_batch(b))
+        assert _count(metric_log, "checkpoint.write") >= 2
+        assert _total(metric_log, "wal.truncate.segments") > 0
+        ingest.reset()
+
+        feed = ingest.open_feed(
+            "ckpt", durable=True, durability_dir=str(tmp_path)
+        )
+        assert _count(metric_log, "checkpoint.load") == 1
+        # replay is bounded by the checkpoint cadence, not log length;
+        # records in the retained active segment already covered by the
+        # checkpoint are SKIPPED by sequence number, not re-applied
+        assert feed._wal.replayed_batches <= 4
+        control = _control(12)
+        _assert_feed_equals(feed, control)
+        assert feed.read("total").value == control["i"].sum()
+
+    def test_schema_mismatch_refused(self, tmp_path):
+        from modin_tpu.durability import DurabilityError
+
+        ingest.open_feed(
+            "strict", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        ingest.reset()
+        with pytest.raises(DurabilityError) as err:
+            ingest.open_feed(
+                "strict", schema={"other": "float64"}, durable=True,
+                durability_dir=str(tmp_path),
+            )
+        assert err.value.reason == "schema_mismatch"
+
+    def test_recover_feeds_scans_root(self, tmp_path):
+        from modin_tpu import durability
+
+        for name in ("alpha", "beta"):
+            feed = ingest.open_feed(
+                name, schema=_SCHEMA, durable=True,
+                durability_dir=str(tmp_path),
+            )
+            feed.register_view("total", _PLANS["total"])
+            for b in range(3):
+                feed.append(_batch(b))
+        ingest.reset()
+
+        assert durability.recover_feeds(str(tmp_path)) == 2
+        assert set(ingest.feeds()) == {"alpha", "beta"}
+        control = _control(3)
+        for name in ("alpha", "beta"):
+            feed = ingest.get_feed(name)
+            _assert_feed_equals(feed, control)
+            assert feed.read("total").value == control["i"].sum()
+        # idempotent: already-registered feeds are left alone
+        assert durability.recover_feeds(str(tmp_path)) == 0
+
+
+# ====================================================================== #
+# torn tails & corruption
+# ====================================================================== #
+
+
+def _segments(feed_dir):
+    return sorted(
+        os.path.join(feed_dir, f)
+        for f in os.listdir(feed_dir)
+        if f.startswith("wal_") and f.endswith(".seg")
+    )
+
+
+class TestTornAndCorrupt:
+    def test_torn_tail_truncated(self, tmp_path, metric_log):
+        feed = ingest.open_feed(
+            "torn", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        feed.register_view("total", _PLANS["total"])
+        for b in range(5):
+            feed.append(_batch(b))
+        ingest.reset()
+
+        # a crash mid-write: garbage shorter than a record header
+        segs = _segments(str(tmp_path / "torn"))
+        with open(segs[-1], "ab") as fh:
+            fh.write(b"\x07torn")
+        feed = ingest.open_feed(
+            "torn", durable=True, durability_dir=str(tmp_path)
+        )
+        assert _count(metric_log, "wal.torn_tail") == 1
+        control = _control(5)
+        _assert_feed_equals(feed, control)
+        assert feed.read("total").value == control["i"].sum()
+        # the truncated segment is adopted and appending continues
+        feed.append(_batch(5))
+        ingest.reset()
+        feed = ingest.open_feed(
+            "torn", durable=True, durability_dir=str(tmp_path)
+        )
+        control = _control(6)
+        _assert_feed_equals(feed, control)
+
+    def test_flipped_byte_prefix_recovery(self, tmp_path, metric_log):
+        feed = ingest.open_feed(
+            "flip", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        for b in range(5):
+            feed.append(_batch(b))
+        ingest.reset()
+
+        # flip one byte inside the LAST record's payload: its CRC fails,
+        # the prefix up to it replays intact
+        segs = _segments(str(tmp_path / "flip"))
+        data = bytearray(open(segs[-1], "rb").read())
+        data[-10] ^= 0xFF
+        with open(segs[-1], "wb") as fh:
+            fh.write(bytes(data))
+        feed = ingest.open_feed(
+            "flip", durable=True, durability_dir=str(tmp_path)
+        )
+        assert _count(metric_log, "wal.torn_tail") == 1
+        assert feed.rows == 4 * _BATCH_ROWS  # last batch discarded
+        _assert_feed_equals(feed, _control(4))
+
+
+# ====================================================================== #
+# disk-fault policy (ENOSPC / EIO)
+# ====================================================================== #
+
+
+class TestDiskFaults:
+    def test_enospc_reclaims_then_succeeds(self, tmp_path, metric_log):
+        from modin_tpu.testing import inject_disk_faults
+
+        feed = ingest.open_feed(
+            "nospc", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        feed.append(_batch(0))
+        with inject_disk_faults("enospc", ops=("wal.write",), times=1):
+            feed.append(_batch(1))  # reclaim pass, then the retry lands
+        assert _count(metric_log, "wal.enospc.reclaim") == 1
+        assert not feed._wal.degraded
+        _assert_feed_equals(feed, _control(2))
+        ingest.reset()
+        feed = ingest.open_feed(
+            "nospc", durable=True, durability_dir=str(tmp_path)
+        )
+        _assert_feed_equals(feed, _control(2))
+
+    def test_enospc_exhausted_is_typed_refusal(self, tmp_path, metric_log):
+        from modin_tpu.durability import DurabilityError
+        from modin_tpu.testing import inject_disk_faults
+
+        feed = ingest.open_feed(
+            "full", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        feed.append(_batch(0))
+        with inject_disk_faults("enospc", ops=("wal.write",), times=2):
+            with pytest.raises(DurabilityError) as err:
+                feed.append(_batch(1))
+        assert err.value.reason == "enospc"
+        # refused BEFORE any in-memory mutation — and not degraded: a
+        # later append (space freed) goes straight back to being durable
+        assert feed.rows == _BATCH_ROWS
+        assert not feed._wal.degraded
+        feed.append(_batch(1))
+        _assert_feed_equals(feed, _control(2))
+        ingest.reset()
+        feed = ingest.open_feed(
+            "full", durable=True, durability_dir=str(tmp_path)
+        )
+        _assert_feed_equals(feed, _control(2))
+
+    def test_eio_degrades_to_memory_only(self, tmp_path, metric_log):
+        from modin_tpu.testing import inject_disk_faults
+
+        feed = ingest.open_feed(
+            "sick", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        feed.register_view("total", _PLANS["total"])
+        feed.append(_batch(0))
+        with inject_disk_faults("eio", ops=("wal.write",), times=1):
+            feed.append(_batch(1))  # the write dies; ingestion must not
+        assert feed._wal.degraded
+        assert _count(metric_log, "wal.degraded") == 1
+        feed.append(_batch(2))  # memory-only from here on
+        control = _control(3)
+        _assert_feed_equals(feed, control)
+        assert feed.read("total").value == control["i"].sum()
+        # the breaker trips ONCE, not per batch
+        assert _count(metric_log, "wal.degraded") == 1
+        ingest.reset()
+        # durability was honestly lost at the breaker: recovery serves
+        # exactly the pre-degrade prefix
+        feed = ingest.open_feed(
+            "sick", durable=True, durability_dir=str(tmp_path)
+        )
+        _assert_feed_equals(feed, _control(1))
+
+    def test_fsync_failure_degrades(self, tmp_path, metric_log):
+        from modin_tpu.testing import inject_disk_faults
+
+        feed = ingest.open_feed(
+            "nosync", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        with inject_disk_faults("fsync_fail", ops=("wal.fsync",), times=1):
+            feed.append(_batch(0))
+        assert feed._wal.degraded
+        assert _count(metric_log, "wal.degraded") == 1
+        assert feed.rows == _BATCH_ROWS
+
+
+# ====================================================================== #
+# the differential kill -9 grid
+# ====================================================================== #
+
+_CHILD = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_INGEST"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import pandas
+from modin_tpu import ingest
+from modin_tpu.config import WalFsync, WalMaxReplayBatches, WalSegmentBytes
+from modin_tpu.testing import DiskFaultInjector
+
+WalFsync.put(os.environ["DUR_FSYNC"])
+WalMaxReplayBatches.put(int(os.environ["DUR_MAX_REPLAY"]))
+WalSegmentBytes.put(int(os.environ["DUR_SEG_BYTES"]))
+feed = ingest.open_feed(
+    "grid", schema={"k": "int64", "i": "int64", "x": "float64",
+                    "g": "int64"},
+    durable=True, durability_dir=os.environ["DUR_DIR"],
+)
+feed.register_view("total", {"kind": "scalar", "column": "i", "agg": "sum"})
+inj = DiskFaultInjector(
+    kind=os.environ["DUR_KIND"], ops=(os.environ["DUR_OP"],),
+    times=1, skip=int(os.environ["DUR_SKIP"]),
+)
+inj.__enter__()  # never exits: the injected fault SIGKILLs this process
+for b in range(int(os.environ["DUR_TOTAL"])):
+    rng = np.random.default_rng(7000 + b)
+    n = 16
+    feed.append(pandas.DataFrame({
+        "k": np.arange(b * n, b * n + n, dtype=np.int64),
+        "i": rng.integers(-1000, 1000, n),
+        "x": rng.normal(size=n),
+        "g": rng.integers(0, 5, n),
+    }))
+    print("ACKED", b + 1, flush=True)
+print("SURVIVED", flush=True)
+"""
+
+#: (label, fault kind, faulted op, skip count, fsync policy, max replay)
+_KILL_GRID = [
+    ("mid_record", "torn_write", "wal.write", 5, "PerBatch", 256),
+    ("mid_checkpoint", "kill", "checkpoint.write", 0, "PerBatch", 3),
+    ("mid_truncate", "kill", "checkpoint.truncate", 0, "PerBatch", 3),
+    ("mid_stream_groupcommit", "kill", "wal.write", 6, "GroupCommit", 256),
+]
+
+
+class TestKillGrid:
+    @pytest.mark.parametrize(
+        "label,kind,op,skip,fsync,max_replay", _KILL_GRID,
+        ids=[row[0] for row in _KILL_GRID],
+    )
+    def test_kill_recover_bit_exact(
+        self, tmp_path, metric_log, label, kind, op, skip, fsync, max_replay
+    ):
+        total = 10
+        env = dict(
+            os.environ,
+            DUR_DIR=str(tmp_path),
+            DUR_FSYNC=fsync,
+            DUR_MAX_REPLAY=str(max_replay),
+            DUR_SEG_BYTES="1024",
+            DUR_KIND=kind,
+            DUR_OP=op,
+            DUR_SKIP=str(skip),
+            DUR_TOTAL=str(total),
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD], env=env,
+            capture_output=True, text=True, timeout=180,
+        )
+        assert "SURVIVED" not in proc.stdout, (
+            f"the injected {kind}@{op} never fired:\n{proc.stdout}"
+            f"\n{proc.stderr}"
+        )
+        assert proc.returncode == -signal.SIGKILL, (
+            proc.returncode, proc.stdout, proc.stderr
+        )
+        acked = sum(
+            1 for line in proc.stdout.splitlines()
+            if line.startswith("ACKED")
+        )
+        assert acked > 0, (proc.stdout, proc.stderr)
+
+        feed = ingest.open_feed(
+            "grid", durable=True, durability_dir=str(tmp_path)
+        )
+        assert feed.rows % _BATCH_ROWS == 0, (
+            f"recovery surfaced a partial batch: {feed.rows} rows"
+        )
+        recovered = feed.rows // _BATCH_ROWS
+        # never lose an acked batch, never invent one: the only ambiguity
+        # is the single batch in flight at the kill
+        assert acked <= recovered <= min(acked + 1, total), (
+            label, acked, recovered
+        )
+        control = _control(recovered)
+        _assert_feed_equals(feed, control)
+        assert feed.read("total").value == control["i"].sum()
+        assert _count(metric_log, "recovery.feed") == 1
+
+
+# ====================================================================== #
+# zero overhead for non-durable feeds
+# ====================================================================== #
+
+_PLAIN_CHILD = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODIN_TPU_INGEST"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+import numpy as np
+import pandas
+from modin_tpu import ingest
+
+feed = ingest.open_feed("plain", schema={"i": "int64"})
+feed.register_view("total", {"kind": "scalar", "column": "i", "agg": "sum"})
+for b in range(3):
+    feed.append(pandas.DataFrame({"i": np.arange(8, dtype=np.int64)}))
+assert feed.rows == 24
+assert feed.read("total").value == 3 * 28
+assert feed._wal is None
+assert "modin_tpu.durability" not in sys.modules, (
+    "the durability package was imported on the non-durable path"
+)
+print("CLEAN")
+"""
+
+
+class TestZeroOverhead:
+    def test_non_durable_never_imports_durability(self):
+        proc = subprocess.run(
+            [sys.executable, "-c", _PLAIN_CHILD], env=dict(os.environ),
+            capture_output=True, text=True, timeout=180,
+        )
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "CLEAN" in proc.stdout
+
+    def test_alloc_counter_contract(self, tmp_path):
+        from modin_tpu import durability
+
+        before = durability.durability_alloc_count()
+        plain = ingest.create_feed("plain", _SCHEMA)
+        for b in range(4):
+            plain.append(_batch(b))
+        assert plain._wal is None
+        assert durability.durability_alloc_count() == before, (
+            "a non-durable feed allocated durability machinery"
+        )
+        # a durable feed allocates exactly its manager + segment writer
+        ingest.open_feed(
+            "heavy", schema=_SCHEMA, durable=True,
+            durability_dir=str(tmp_path),
+        )
+        assert durability.durability_alloc_count() == before + 2
+        assert durability.DURABILITY_ON
+
+
+# ====================================================================== #
+# satellites: fleet env wiring, flight-recorder claim release
+# ====================================================================== #
+
+
+class TestFleetWiring:
+    def test_spawn_exports_durability_root(self, monkeypatch, tmp_path):
+        from modin_tpu.fleet import coordinator as coord_mod
+
+        captured = {}
+
+        class _FakeProc:
+            pid = 12345
+
+        def fake_popen(cmd, env=None, **kwargs):
+            captured["env"] = env
+            return _FakeProc()
+
+        monkeypatch.setattr(coord_mod.subprocess, "Popen", fake_popen)
+        coord = coord_mod.Coordinator(
+            replicas=1, durability_dir=str(tmp_path)
+        )
+        coord._control_port = 0
+        coord._spawn(coord._replicas[0])
+        env = captured["env"]
+        assert env["MODIN_TPU_FLEET_DURABILITY_DIR"] == str(tmp_path)
+        assert env["MODIN_TPU_INGEST"] == "1"
+
+        # without a durability root the replica env must NOT carry one
+        # (even when the coordinator's own environment does)
+        monkeypatch.setenv("MODIN_TPU_FLEET_DURABILITY_DIR", "/stale")
+        coord = coord_mod.Coordinator(replicas=1, durability_dir="")
+        coord._control_port = 0
+        coord._spawn(coord._replicas[0])
+        assert "MODIN_TPU_FLEET_DURABILITY_DIR" not in captured["env"]
+
+
+class TestFlightRecorderClaim:
+    def test_partial_write_releases_claim(self, monkeypatch, tmp_path):
+        """A dump whose WRITE dies must release the shared claim window:
+        the next dump (of the real fault) goes through immediately
+        instead of being rate-limited away."""
+        import modin_tpu.observability as graftscope
+        from modin_tpu.config import TraceDir, TraceEnabled
+        from modin_tpu.observability import flight_recorder
+        from modin_tpu.utils import atomic_io
+
+        monkeypatch.setattr(
+            flight_recorder, "MIN_DUMP_INTERVAL_S", 3600.0
+        )
+        real = atomic_io.atomic_write_json
+        fails = {"n": 1}
+
+        def flaky(path, obj, **kwargs):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError(5, "injected mid-write failure")
+            return real(path, obj, **kwargs)
+
+        monkeypatch.setattr(atomic_io, "atomic_write_json", flaky)
+        with TraceDir.context(str(tmp_path)), TraceEnabled.context(True):
+            flight_recorder.reset_for_tests()
+            with graftscope.layer_span("TestDur.claim", "QUERY-COMPILER"):
+                pass
+            assert flight_recorder.dump_flight_record("dur_fault") is None
+            assert not list(tmp_path.glob("*.trace.json")), (
+                "a failed dump left a partial artifact"
+            )
+            # claim released: the retry is NOT rate-limited
+            path = flight_recorder.dump_flight_record("dur_fault")
+            assert path is not None and os.path.exists(path)
