@@ -212,6 +212,13 @@ INGEST_READERS = int(os.environ.get("BENCH_INGEST_READERS", 4))
 # crash-recovery wall (full WAL-tail replay of that stream).
 DURABILITY_BATCHES = int(os.environ.get("BENCH_DURABILITY_BATCHES", 200))
 DURABILITY_BATCH_ROWS = int(os.environ.get("BENCH_DURABILITY_BATCH_ROWS", 256))
+# graftopt optimizer section: ONE plan-shaped pipeline (scan -> filter ->
+# project -> sort-shaped reduce) under adaptive Auto vs independent-router
+# Off vs every forced single-strategy leg vs an adversarial
+# forced-wrong-calibration leg where mid-query re-planning must recover.
+# Ops fold into PERF_HISTORY.json keyed rows=N@opt=<mode> so an
+# adversarial-recovery wall never gates against an Auto wall.
+OPTIMIZER_ROWS = int(os.environ.get("BENCH_OPTIMIZER_ROWS", 2_000_000))
 
 
 class SectionTimeout(BaseException):
@@ -286,6 +293,7 @@ def _run_provenance(platform: str) -> dict:
             "spmd_rows": SPMD_ROWS,
             "spmd_mesh": SPMD_MESHES,
             "oocore_rows": OOCORE_ROWS,
+            "optimizer_rows": OPTIMIZER_ROWS,
             "oocore_window": OOCORE_WINDOWS,
             "repeats": REPEATS,
             "meters": METERS,
@@ -2402,6 +2410,181 @@ def main() -> None:
         }
         return sections["durability"]
 
+    # ---- graftopt: adaptive Auto vs Off vs forced legs vs adversarial ---- #
+    def optimizer_section():
+        """ONE plan-shaped pipeline (scan -> filter -> project ->
+        sort-shaped reduce) under every strategy regime: adaptive Auto
+        (graftopt chooses jointly), Off (the five routers decide
+        independently), every forced single-strategy leg (kernel pinned
+        device/host, compile pinned fused/staged, residency pinned
+        resident), and an ADVERSARIAL leg where the cost model is seeded
+        with absurd priors plus a forced-wrong calibration table — the
+        mid-query re-planner must fire (metered) and the final wall must
+        land within 1.5x of correctly-calibrated Auto.  The headline
+        claims: Auto never >10% slower than the best forced leg, and
+        re-planning recovers from miscalibration."""
+        import tempfile as _tempfile
+
+        from modin_tpu.config import (
+            FuseMode,
+            KernelRouterMode,
+            MetersEnabled,
+            OptMode,
+            PlanMode,
+            StreamMode,
+        )
+        from modin_tpu.observability import meters as _graftmeter
+        from modin_tpu.ops import router as _router
+        from modin_tpu.plan import optimizer as _graftopt
+
+        n = OPTIMIZER_ROWS
+        csv_path = os.path.join(
+            _tempfile.mkdtemp(prefix="graftopt_bench_"), "opt.csv"
+        )
+        pandas.DataFrame(
+            {
+                "a": rng.integers(-50, 50, n),
+                "b": rng.uniform(0, 1, n),
+                "c": rng.uniform(-1, 1, n),
+            }
+        ).to_csv(csv_path, index=False)
+
+        def pipeline_modin():
+            out = pd.read_csv(csv_path).query("a > -100")[["b", "c"]].median()
+            execute_modin(out)
+
+        # (opt_mode, kernel, fuse, stream) per leg; None keeps Auto
+        legs = {
+            "auto": ("Auto", None, None, None),
+            "off": ("Off", None, None, None),
+            "kernel_device": ("Off", "Device", None, None),
+            "kernel_host": ("Off", "Host", None, None),
+            "fuse_fused": ("Off", None, "Fused", None),
+            "fuse_staged": ("Off", None, "Staged", None),
+            "stream_resident": ("Off", None, None, "Resident"),
+        }
+        saved = (
+            OptMode.get(),
+            KernelRouterMode.get(),
+            FuseMode.get(),
+            StreamMode.get(),
+            PlanMode.get(),
+            MetersEnabled.get(),
+        )
+        timings: dict = {}
+        replans = 0
+        try:
+            PlanMode.put("Auto")
+            for leg, (opt, kernel, fuse, stream) in legs.items():
+                OptMode.put(opt)
+                KernelRouterMode.put(kernel or "Auto")
+                FuseMode.put(fuse or "Auto")
+                StreamMode.put(stream or "Auto")
+                pipeline_modin()  # warm compiles/scan cache outside timers
+                best = float("inf")
+                for _ in range(max(repeats, 2)):
+                    t0 = time.perf_counter()
+                    pipeline_modin()
+                    best = min(best, time.perf_counter() - t0)
+                timings[leg] = best
+            # the adversarial leg: absurd priors (everything estimates as
+            # ~free) plus a forced calibration table claiming both sides
+            # cost nothing — wall divergence on the scan must re-plan the
+            # tail with the measured correction folded in
+            OptMode.put("Auto")
+            KernelRouterMode.put("Auto")
+            FuseMode.put("Auto")
+            StreamMode.put("Auto")
+            MetersEnabled.put(True)
+            bad_table = {"rows": 1024, "device_consume_s": 1e-9,
+                         "device_hist_s": 1e-9, "device_sort_s": 1e-9}
+            for fam in ("median", "quantile", "nunique", "mode"):
+                bad_table[f"host_{fam}_low_s"] = 1e-9
+                bad_table[f"host_{fam}_high_s"] = 1e-9
+            _graftopt.set_priors({
+                **_graftopt.DEFAULT_PRIORS,
+                "scan_s_per_row": 1e-12,
+                "reduce_s_per_row": 1e-12,
+                "sortred_s_per_row": 1e-12,
+                "parse_bytes_per_s": 1e15,
+                "mem_bytes_per_s": 1e15,
+                "s_per_row": {},
+            })
+            _router.set_calibration(bad_table)
+            try:
+                pipeline_modin()  # warm: compiles out of the timed laps
+
+                def _replan_count():
+                    series = _graftmeter.snapshot().get("series", {})
+                    return sum(
+                        int(v.get("total", 0))
+                        for k, v in series.items()
+                        if k.startswith("opt.replan.")
+                    )
+
+                r0 = _replan_count()
+                best = float("inf")
+                for _ in range(max(repeats, 2)):
+                    t0 = time.perf_counter()
+                    pipeline_modin()
+                    best = min(best, time.perf_counter() - t0)
+                timings["adversarial"] = best
+                replans = _replan_count() - r0
+            finally:
+                _graftopt.set_priors(None)
+                _router.set_calibration(None)
+        finally:
+            OptMode.put(saved[0])
+            KernelRouterMode.put(saved[1])
+            FuseMode.put(saved[2])
+            StreamMode.put(saved[3])
+            PlanMode.put(saved[4])
+            MetersEnabled.put(saved[5])
+
+        best_pandas = float("inf")
+        for _ in range(max(repeats, 2)):
+            t0 = time.perf_counter()
+            pandas.read_csv(csv_path).query("a > -100")[["b", "c"]].median()
+            best_pandas = min(best_pandas, time.perf_counter() - t0)
+
+        import shutil
+
+        shutil.rmtree(os.path.dirname(csv_path), ignore_errors=True)
+        for leg, wall in timings.items():
+            detail[f"optimizer_{leg}"] = {
+                "modin_tpu_s": round(wall, 4),
+                "pandas_s": round(best_pandas, 4),
+                "speedup": round(best_pandas / max(wall, 1e-9), 2),
+            }
+        forced = [
+            timings[leg]
+            for leg in (
+                "kernel_device", "kernel_host", "fuse_fused",
+                "fuse_staged", "stream_resident",
+            )
+        ]
+        sections["optimizer"] = {
+            "rows": n,
+            "auto_s": round(timings["auto"], 4),
+            "off_s": round(timings["off"], 4),
+            "best_forced_s": round(min(forced), 4),
+            "adversarial_s": round(timings["adversarial"], 4),
+            "pandas_s": round(best_pandas, 4),
+            "adversarial_replans": replans,
+            "auto_vs_best_forced_x": round(
+                timings["auto"] / max(min(forced), 1e-9), 3
+            ),
+            "auto_never_worse_ok": timings["auto"] <= min(forced) * 1.10,
+            "adversarial_recovered_ok": (
+                replans >= 1
+                and timings["adversarial"] <= timings["auto"] * 1.5
+            ),
+            "speedup_vs_pandas": round(
+                best_pandas / max(timings["auto"], 1e-9), 2
+            ),
+        }
+        return sections["optimizer"]
+
     # ---- the run: every section under the global BENCH_DEADLINE ---- #
     # (subprocess timeouts inside shuffle_apply already bound it; the
     # per-section alarm is a backstop there)
@@ -2422,6 +2605,7 @@ def main() -> None:
         ("fleet", fleet_section),
         ("ingest", ingest_section),
         ("durability", durability_section),
+        ("optimizer", optimizer_section),
     ]
     for name, fn in section_list:
         if SECTION_FILTER and name not in SECTION_FILTER:

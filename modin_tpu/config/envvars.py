@@ -877,6 +877,47 @@ class PlanMaxPasses(EnvironmentVariable, type=int):
         super().put(value)
 
 
+class OptMode(EnvironmentVariable, type=str):
+    """graftopt unified cost-based optimization (plan/optimizer.py).
+
+    Auto (default): each plan materialization runs one joint ``choose()``
+    pass over the optimized plan — a cost model seeded from the kernel
+    router's calibration table, the graftcost substrate peaks, and
+    PERF_HISTORY priors annotates every node with its execution-strategy
+    legs (device/host, local/sharded, fused/staged, resident/windowed),
+    the rewrite engine gates rules on modeled cost, and lowering re-plans
+    the remaining segment mid-query when measured walls, ledger pressure,
+    or compile-storm level diverge from the estimates.  Off: the five
+    routers decide independently at their own layers — bit-for-bit the
+    pre-graftopt behavior, with zero optimizer allocations.
+    """
+
+    varname = "MODIN_TPU_OPT"
+    choices = ("Auto", "Off")
+    default = "Auto"
+
+
+class OptReplanFactor(EnvironmentVariable, type=float):
+    """Mid-query re-plan threshold for graftopt (plan/optimizer.py).
+
+    A lowered node whose measured wall exceeds its plan-time estimate by
+    more than this factor (and clears the absolute noise floor) triggers a
+    re-optimization of the not-yet-lowered plan segment through the same
+    ``choose()`` pass, with the measured/estimated ratio folded in as a
+    correction on the calibrated device-side coefficients."""
+
+    varname = "MODIN_TPU_OPT_REPLAN_FACTOR"
+    default = 4.0
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value <= 1.0:
+            raise ValueError(
+                f"Re-plan factor should be > 1, passed value {value}"
+            )
+        super().put(value)
+
+
 class FusedCacheSize(EnvironmentVariable, type=int):
     """Bound on the fused-executable cache in ops/lazy.py (entries, LRU).
 
@@ -1010,6 +1051,26 @@ class PerfGateTolerance(EnvironmentVariable, type=float):
         if value < 1.0:
             raise ValueError(
                 f"Perf gate tolerance should be >= 1.0, passed value {value}"
+            )
+        super().put(value)
+
+
+class PerfGateNoiseFloorS(EnvironmentVariable, type=float):
+    """Absolute noise floor (seconds) for the perf-history gate: a wall
+    within this many seconds of the best recorded wall never fails the
+    gate, regardless of the ratio.  Sub-millisecond op walls on a shared
+    CPU substrate are timer-jitter-dominated — a 0.8ms-vs-1.4ms delta is
+    scheduler noise, not a regression — so the ratio tolerance only
+    applies once the absolute delta clears this floor."""
+
+    varname = "MODIN_TPU_PERF_GATE_NOISE_FLOOR_S"
+    default = 0.005
+
+    @classmethod
+    def put(cls, value: float) -> None:
+        if value < 0.0:
+            raise ValueError(
+                f"Perf gate noise floor should be >= 0, passed value {value}"
             )
         super().put(value)
 

@@ -309,6 +309,25 @@ METRICS = (
         "fuse-map-reduce)",
     ),
     (
+        "plan.rule_rejected.*",
+        "counter",
+        "graftplan rewrite applications rejected by graftopt's cost gate "
+        "per rule (modeled cost rose beyond the tolerance)",
+    ),
+    (
+        "opt.choose",
+        "counter",
+        "graftopt joint strategy passes (one per plan materialization "
+        "under MODIN_TPU_OPT=Auto; re-plans count again)",
+    ),
+    (
+        "opt.replan.*",
+        "counter",
+        "graftopt mid-query re-plans per trigger (wall_divergence / "
+        "ledger_pressure / compile_storm): the remaining plan segment was "
+        "re-optimized against live evidence",
+    ),
+    (
         "plan.lower.nodes",
         "histogram",
         "distinct plan nodes lowered per materialization (shared subtrees "

@@ -42,7 +42,6 @@ attribute and allocates nothing while it is False.
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 from typing import Any, Dict, Optional, Tuple
@@ -686,50 +685,31 @@ def substrate_peaks() -> Optional[dict]:
 
 
 def _peaks_path(platform: str) -> Optional[str]:
-    try:
-        import os
+    # persistence rides the consolidated calibration store (ops/
+    # calibration.py); the name stays byte-compatible with the
+    # pre-consolidation layout so warmed caches survive the refactor
+    from modin_tpu.ops import calibration as calstore
 
-        from modin_tpu.config import CacheDir
-
-        cache_dir = CacheDir.get()
-        if not cache_dir:
-            return None
-        return os.path.join(str(cache_dir), f"roofline_{platform}.json")
-    except Exception:
-        return None
+    return calstore.table_path("roofline", platform)
 
 
 def _load_cached_peaks(platform: str) -> Optional[dict]:
-    path = _peaks_path(platform)
-    if path is None:
-        return None
-    try:
-        with open(path) as f:
-            peaks = json.load(f)
-        if (
-            isinstance(peaks, dict)
-            and peaks.get("flops_per_s", 0) > 0
-            and peaks.get("bytes_per_s", 0) > 0
-        ):
-            return peaks
-    except Exception:
-        pass
+    from modin_tpu.ops import calibration as calstore
+
+    peaks = calstore.load_table(_peaks_path(platform))
+    if (
+        isinstance(peaks, dict)
+        and peaks.get("flops_per_s", 0) > 0
+        and peaks.get("bytes_per_s", 0) > 0
+    ):
+        return peaks
     return None
 
 
 def _store_cached_peaks(platform: str, peaks: dict) -> None:
-    path = _peaks_path(platform)
-    if path is None:
-        return
-    try:
-        import os
+    from modin_tpu.ops import calibration as calstore
 
-        from modin_tpu.utils.atomic_io import atomic_write_json
-
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        atomic_write_json(path, peaks)
-    except Exception:
-        pass
+    calstore.store_table(_peaks_path(platform), peaks)
 
 
 def roofline_fraction(

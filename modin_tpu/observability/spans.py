@@ -132,6 +132,19 @@ SPANS = (
         "(node count in attributes)",
     ),
     (
+        "opt.choose",
+        "one graftopt joint strategy pass over an optimized plan: every "
+        "node annotated with estimated rows/bytes/seconds and its chosen "
+        "kernel/layout/compile/residency legs (replanning flag and "
+        "correction factor in attributes)",
+    ),
+    (
+        "opt.replan",
+        "one graftopt mid-query re-plan: the not-yet-lowered segment "
+        "re-chosen against live evidence (trigger, remaining node count, "
+        "divergence evidence, re-plan wall in attributes)",
+    ),
+    (
         "fuse.lower",
         "one graftfuse whole-plan fused lowering: the post-scan segment "
         "(filter/map/project chain plus its reduce or groupby tail) "

@@ -1,0 +1,119 @@
+"""One versioned calibration store for every measured-coefficient table.
+
+Before graftopt, three subsystems each hand-rolled the same persistence
+idiom — measure once, validate a cached JSON against (version, platform,
+mesh shape), atomically rewrite it under ``CacheDir``:
+
+- the kernel-router calibration table (sorted-reduce device/host
+  coefficients plus the graftmesh collective entries), ``ops/router.py``;
+- the graftcost substrate roofline peaks, ``observability/costs.py``;
+- and two copies of the n·log n crossover scaling inside ``ops/router.py``
+  itself (``predicted_costs`` vs ``decide_layout``) that had started to
+  drift.
+
+This module is that idiom, once.  It is deliberately thin: callers keep
+their own table *contents* and in-memory resolve-once state (each already
+guards it with its registered lock); the store owns only the naming,
+validation, and atomic persistence.  File names are kept byte-compatible
+with the pre-consolidation layout (``kernel_router_{platform}_mesh{mesh}_
+v{N}.json``, ``roofline_{platform}.json``) so existing caches stay warm
+across the refactor.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, Optional
+
+
+def nlogn_scale(n: int, cal_rows: int) -> float:
+    """The n·log n crossover scale from a calibration row count to ``n``.
+
+    THE shared helper for every sort-shaped cost extrapolation (kernel
+    router, layout router, graftopt's plan-time model): a measured wall at
+    ``cal_rows`` rows scales to ``n`` rows by the ratio of n·log2(n)
+    terms.  Both operands are floored at 2 so tiny frames never divide by
+    zero or go negative through log2.
+    """
+    cal_rows = max(int(cal_rows), 2)
+    n = max(int(n), 2)
+    return (n * math.log2(n)) / (cal_rows * math.log2(cal_rows))
+
+
+def linear_scale(n: int, cal_rows: int) -> float:
+    """The linear per-row scale from a calibration row count to ``n``."""
+    return max(int(n), 0) / max(int(cal_rows), 2)
+
+
+def table_path(
+    kind: str,
+    platform: str,
+    mesh_key: Optional[str] = None,
+    version: Optional[int] = None,
+) -> Optional[str]:
+    """The CacheDir path for one calibration table, or None (no CacheDir).
+
+    ``kind`` names the table family (``kernel_router``, ``roofline``);
+    ``mesh_key`` and ``version`` fold into the name exactly as the
+    pre-consolidation callers spelled them, so existing caches validate.
+    """
+    try:
+        from modin_tpu.config import CacheDir
+
+        cache_dir = CacheDir.get()
+        if not cache_dir:
+            return None
+    except Exception:  # graftlint: disable=EXC-HYGIENE -- an unconfigured CacheDir means "no persistence", never a failed query
+        return None
+    name = f"{kind}_{platform}"
+    if mesh_key is not None:
+        name += f"_mesh{mesh_key}"
+    if version is not None:
+        name += f"_v{version}"
+    return os.path.join(str(cache_dir), f"{name}.json")
+
+
+def load_table(
+    path: Optional[str],
+    version: Optional[int] = None,
+    platform: Optional[str] = None,
+    mesh_key: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """A cached table when it exists AND matches every given key, else None.
+
+    Each non-None keyword is validated against the table's own recorded
+    field — a table measured on another substrate, mesh topology, or
+    schema version never leaks into this process's cost model.
+    """
+    if path is None:
+        return None
+    try:
+        with open(path) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(table, dict):
+        return None
+    if version is not None and table.get("version") != version:
+        return None
+    if platform is not None and table.get("platform") != platform:
+        return None
+    if mesh_key is not None and table.get("mesh") != mesh_key:
+        return None
+    return table
+
+
+def store_table(path: Optional[str], table: Dict[str, Any]) -> None:
+    """Atomically persist one table; an unwritable CacheDir is a no-op
+    (the owner simply re-measures next process)."""
+    if path is None:
+        return
+    try:
+        from modin_tpu.utils.atomic_io import atomic_write_json
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        atomic_write_json(path, table)
+    except OSError:
+        pass

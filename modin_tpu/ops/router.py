@@ -32,17 +32,13 @@ calibration never runs — unit-test frames stay on device, deterministic),
 
 from __future__ import annotations
 
-import json
-import math
-import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from modin_tpu.concurrency import named_lock
 from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.observability import spans as graftscope
-from modin_tpu.utils.atomic_io import atomic_write_json
+from modin_tpu.ops import calibration as calstore
 
 #: column strategies a sort-shaped plan may carry (see plan_strategies in
 #: ops/reductions.py): "dict" costs ~0 (host categories already known),
@@ -59,6 +55,21 @@ STRATEGIES = ("dict", "view", "cached", "hist", "sort")
 MIN_SAVINGS_S = 0.05
 
 _CAL_VERSION = 3
+
+#: graftopt consult hook.  ``plan/optimizer.py`` installs a callable here
+#: while ``MODIN_TPU_OPT=Auto`` (and clears it for Off): each ``decide_*``
+#: offers its live verdict — ``(leg, choice, reason, **ctx)`` — and the
+#: optimizer answers a replacement ``(choice, reason)`` from the current
+#: node's plan-time strategy annotation, or None to keep the router's own.
+#: A module attribute rather than an import so the Off mode costs exactly
+#: one ``is not None`` check per decision and allocates nothing.
+_opt_consult = None
+
+#: baseline reasons the optimizer may override: forced modes and the
+#: deterministic row floors stay authoritative (tests and bench legs pin
+#: sides; tiny frames never consult plan-time state), as do the
+#: degenerate single_shard / no_budget / uncalibrated outcomes.
+_OPT_REASONS = frozenset({"auto", "cost_model", "fits", "over_headroom"})
 
 _lock = named_lock("ops.router_calibration")
 #: None = not yet resolved; False = calibration failed (route device);
@@ -99,12 +110,9 @@ def _mesh_key() -> str:
         return "unknown"
 
 
-def _cache_path(platform: str, mesh_key: str) -> str:
-    from modin_tpu.config import CacheDir
-
-    return os.path.join(
-        CacheDir.get(),
-        f"kernel_router_{platform}_mesh{mesh_key}_v{_CAL_VERSION}.json",
+def _cache_path(platform: str, mesh_key: str) -> Optional[str]:
+    return calstore.table_path(
+        "kernel_router", platform, mesh_key=mesh_key, version=_CAL_VERSION
     )
 
 
@@ -250,6 +258,19 @@ def _measure_sharded(table: Dict[str, Any], rows: int, wide: Any) -> None:
         pass
 
 
+def calibration_peek() -> Optional[Dict[str, float]]:
+    """The calibration table if ALREADY resolved, never measuring.
+
+    graftopt's plan-time cost model reads coefficients through this —
+    planning must never trigger the one-shot device measurement (a
+    dispatch storm inside someone's measured region); the runtime
+    ``decide()`` keeps paying for resolution at its existing points.
+    """
+    with _lock:
+        table = _calibration
+    return table if isinstance(table, dict) else None
+
+
 def get_calibration() -> Optional[Dict[str, float]]:
     """The calibration table: memory -> CacheDir -> one-shot measurement.
 
@@ -268,19 +289,13 @@ def get_calibration() -> Optional[Dict[str, float]]:
         platform = _platform()
         mesh_key = _mesh_key()
         path = _cache_path(platform, mesh_key)
-        try:
-            with open(path) as f:
-                table = json.load(f)
-            if (
-                table.get("version") == _CAL_VERSION
-                and table.get("platform") == platform
-                and table.get("mesh") == mesh_key
-            ):
-                _calibration = table
-                _calibration_mesh = mesh_key
-                return table
-        except (OSError, ValueError):
-            pass
+        table = calstore.load_table(
+            path, version=_CAL_VERSION, platform=platform, mesh_key=mesh_key
+        )
+        if table is not None:
+            _calibration = table
+            _calibration_mesh = mesh_key
+            return table
         try:
             with graftscope.span(
                 "router.calibrate", layer="QUERY-COMPILER", platform=platform
@@ -293,11 +308,7 @@ def get_calibration() -> Optional[Dict[str, float]]:
             return None
         _calibration = table
         _calibration_mesh = mesh_key
-        try:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            atomic_write_json(path, table)
-        except OSError:
-            pass  # unwritable CacheDir: recalibrate next process
+        calstore.store_table(path, table)
         return table
 
 
@@ -308,8 +319,8 @@ def predicted_costs(
     given per-column strategies.  Linear scaling for everything except the
     sort term, which grows n*log2(n)."""
     cal_rows = max(int(table["rows"]), 2)
-    scale = n / cal_rows
-    logscale = (n * math.log2(max(n, 2))) / (cal_rows * math.log2(cal_rows))
+    scale = calstore.linear_scale(n, cal_rows)
+    logscale = calstore.nlogn_scale(n, cal_rows)
     consume = table["device_consume_s"] * scale
     per_strategy = {
         "dict": 0.0,
@@ -373,10 +384,7 @@ def decide_layout(
         if table is None or "device_shuffle_s" not in table:
             choice, reason = "local", "uncalibrated"
         else:
-            cal_rows = max(int(table["rows"]), 2)
-            logscale = (n * math.log2(max(n, 2))) / (
-                cal_rows * math.log2(cal_rows)
-            )
+            logscale = calstore.nlogn_scale(n, int(table["rows"]))
             local_s = table["device_sort_s"] * logscale
             sharded_s = table["device_shuffle_s"] * logscale
             bw = float(table.get("collective_bytes_per_s") or 0.0)
@@ -387,6 +395,10 @@ def decide_layout(
             costs = {"local_s": local_s, "sharded_s": sharded_s}
             choice = "sharded" if sharded_s < local_s else "local"
             reason = "cost_model"
+    if _opt_consult is not None and reason in _OPT_REASONS:
+        planned = _opt_consult("layout", choice, reason, op=op, n=n)
+        if planned is not None:
+            choice, reason = planned
     emit_metric(f"router.spmd_{op}.{choice}", 1)
     if graftscope.TRACE_ON:
         graftscope.finish_span(
@@ -444,6 +456,12 @@ def decide_residency(op: str, est_bytes: int, self_bytes: int = 0) -> str:
                 choice, reason = "windowed", "over_headroom"
             else:
                 choice, reason = "resident", "fits"
+    if _opt_consult is not None and reason in _OPT_REASONS:
+        planned = _opt_consult(
+            "residency", choice, reason, op=op, est_bytes=int(est_bytes)
+        )
+        if planned is not None:
+            choice, reason = planned
     emit_metric(f"router.residency_{op}.{choice}", 1)
     if graftscope.TRACE_ON:
         graftscope.finish_span(
@@ -490,6 +508,10 @@ def decide_compile(plan_sig: Any, n: int) -> str:
         choice, reason = "staged", "below_min_rows"
     else:
         choice, reason = "fused", "auto"
+    if _opt_consult is not None and reason in _OPT_REASONS:
+        planned = _opt_consult("compile", choice, reason, sig=plan_sig, n=n)
+        if planned is not None:
+            choice, reason = planned
     emit_metric(f"router.fuse.{choice}", 1)
     if graftscope.TRACE_ON:
         graftscope.finish_span(
@@ -548,6 +570,12 @@ def decide(op: str, n: int, strategies: List[str]) -> str:
                 choice, reason = "host", "cost_model"
             else:
                 choice, reason = "device", "cost_model"
+    if _opt_consult is not None and reason in _OPT_REASONS:
+        planned = _opt_consult(
+            "kernel", choice, reason, op=op, n=n, strategies=strategies
+        )
+        if planned is not None:
+            choice, reason = planned
     emit_metric(f"router.{op}.{choice}", 1)
     if graftscope.TRACE_ON:
         graftscope.finish_span(

@@ -81,12 +81,41 @@ def _actual_suffix(measured: Optional[dict]) -> str:
     )
 
 
-def render(root: PlanNode, actuals: Optional[dict] = None) -> str:
+def _strategy_suffix(st: Any) -> str:
+    """graftopt annotation for one node: the chosen strategy legs (``!``
+    marks a firm leg the live router will be overridden with), the modeled
+    cost, and — once the node lowered — the measured wall beside it."""
+    if st is None:
+        return ""
+    parts = [
+        f"{leg}={choice}" + ("!" if leg in st.firm else "")
+        for leg, choice in sorted(st.legs.items())
+    ]
+    if st.est_s > 0.0:
+        cost = f"est={st.est_s * 1e3:.3f}ms"
+        if st.measured_s is not None:
+            cost += f" meas={st.measured_s * 1e3:.3f}ms"
+        parts.append(cost)
+    if st.measured_bytes is not None:
+        parts.append(f"stream_bytes={_fmt_bytes(st.measured_bytes)}")
+    if not parts:
+        return ""
+    return "  [strategy: " + " ".join(parts) + "]"
+
+
+def render(
+    root: PlanNode,
+    actuals: Optional[dict] = None,
+    strategies: Any = None,
+) -> str:
     """ASCII tree of a plan; shared (diamond) nodes render once and are
     referenced as ``^N`` afterwards.  ``actuals`` (EXPLAIN ANALYZE) maps
-    ``id(node)`` to its measured entry from the instrumented lowering."""
+    ``id(node)`` to its measured entry from the instrumented lowering;
+    ``strategies`` (graftopt) annotates each node's chosen execution
+    strategy and estimated-vs-measured cost."""
     lines: List[str] = []
     ids: dict = {}
+    by_node = strategies.by_node if strategies is not None else {}
 
     def visit(node: PlanNode, depth: int) -> None:
         indent = "  " * depth
@@ -96,11 +125,29 @@ def render(root: PlanNode, actuals: Optional[dict] = None) -> str:
             return
         ids[id(node)] = len(ids) + 1
         suffix = _actual_suffix(actuals.get(id(node))) if actuals else ""
+        suffix += _strategy_suffix(by_node.get(id(node)))
         lines.append(f"{indent}#{ids[id(node)]} {node.label()}{suffix}")
         for child in node.children:
             visit(child, depth + 1)
 
     visit(root, 0)
+    return "\n".join(lines)
+
+
+def render_replans(strategies: Any) -> str:
+    """The graftopt re-plan events of one materialization, with trigger
+    reason and the evidence that fired each."""
+    if strategies is None or not strategies.replans:
+        return "re-plans: none"
+    lines = [
+        f"re-plans: {len(strategies.replans)} "
+        f"(correction x{strategies.correction:.2f})"
+    ]
+    for event in strategies.replans:
+        detail = " ".join(
+            f"{k}={v}" for k, v in event.items() if k != "trigger"
+        )
+        lines.append(f"  - {event['trigger']}: {detail}")
     return "\n".join(lines)
 
 
@@ -121,6 +168,7 @@ def explain_plan(
     root: PlanNode,
     optimized: Optional[PlanNode] = None,
     applied: Optional[List[Tuple[str, int]]] = None,
+    strategies: Any = None,
 ) -> str:
     if optimized is None:
         optimized, applied = optimize(root)
@@ -129,10 +177,12 @@ def explain_plan(
         render(root),
         "",
         "== logical plan (after rewrite) ==",
-        render(optimized),
+        render(optimized, strategies=strategies),
         "",
         render_attribution(applied or []),
     ]
+    if strategies is not None:
+        parts += ["", render_replans(strategies)]
     return "\n".join(parts)
 
 
@@ -155,15 +205,20 @@ def explain_analyze_qc(qc: Any) -> str:
             "start from a deferrable read, or use modin_tpu.plan.defer_frame)"
         )
     stats, actuals, (root, optimized, applied) = analyzed
+    strategies = getattr(qc, "_plan_strategies", None)
     parts = [
         "status: analyzed (plan executed with per-node measurement)",
         "== logical plan (before rewrite) ==",
         render(root),
         "",
         "== logical plan (after rewrite, with actuals) ==",
-        render(optimized, actuals=actuals),
+        render(optimized, actuals=actuals, strategies=strategies),
         "",
         render_attribution(applied or []),
+    ]
+    if strategies is not None:
+        parts += ["", render_replans(strategies)]
+    parts += [
         "",
         "== query rollup ==",
         stats.summary(),
@@ -183,7 +238,9 @@ def explain_qc(qc: Any, analyze: bool = False) -> str:
     history = getattr(qc, "_plan_explain", None)
     if history is not None:
         root, optimized, applied = history
-        return "status: materialized\n" + explain_plan(root, optimized, applied)
+        return "status: materialized\n" + explain_plan(
+            root, optimized, applied, getattr(qc, "_plan_strategies", None)
+        )
     return (
         "status: eager (no deferred plan; set MODIN_TPU_PLAN=Auto and start "
         "from a deferrable read, or use modin_tpu.plan.defer_frame)"

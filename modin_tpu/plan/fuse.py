@@ -414,6 +414,14 @@ def _maybe_fuse(node: PlanNode, memo: Dict[int, Any], groupby: bool) -> Optional
         default=0,
     )
     donate_cols = _donation_candidates(frame)
+    if donate_cols:
+        # graftopt joint constraint: a plan the optimizer marked
+        # memory-pressured (windowed tail, re-planned segment) must not
+        # donate — the window loop / re-lowering still owns those buffers
+        from modin_tpu.plan import optimizer as graftopt
+
+        if not graftopt.donate_ok():
+            donate_cols = []
     compiles_before = compiles_on_this_thread()
     with graftscope.span(
         "fuse.lower",

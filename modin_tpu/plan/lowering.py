@@ -25,6 +25,7 @@ from modin_tpu.observability import costs as graftcost
 from modin_tpu.observability import meters as graftmeter
 from modin_tpu.observability import spans as graftscope
 from modin_tpu.serving import context as serving_context
+from modin_tpu.plan import optimizer
 from modin_tpu.plan.ir import (
     Filter,
     GroupbyAgg,
@@ -80,7 +81,9 @@ def lower(root: PlanNode) -> Any:
 
 
 def lower_traced(
-    root: PlanNode, instrument: Optional[Dict[int, dict]] = None
+    root: PlanNode,
+    instrument: Optional[Dict[int, dict]] = None,
+    strategies: Any = None,
 ) -> Tuple[Any, Dict[int, Any]]:
     """Lower a plan; also returns the node-id -> lowered-compiler memo
     (the materialization path uses it to adopt a reduction's input).
@@ -90,6 +93,13 @@ def lower_traced(
     dispatches attributed to the node, and the lowered result's rows/bytes.
     Shared (memoized) subtrees bill their cost to the first consumer, which
     is also how the work actually happened.
+
+    ``strategies`` (a graftopt :class:`~..optimizer.PlanStrategies`) arms
+    the adaptive loop for this pass: each node's wall is measured (cheap
+    perf_counter pair, no dispatch attribution) and fed back through
+    ``optimizer.observe`` so estimate divergence can re-plan the remaining
+    segment mid-query.  None (``MODIN_TPU_OPT=Off``) keeps the historical
+    fast path untouched.
     """
     memo: Dict[int, Any] = {}
     was_lowering = in_lowering()
@@ -97,6 +107,9 @@ def lower_traced(
     if instrument is not None:
         _tls.instrument = instrument
         _tls.inst_stack = []
+    if strategies is not None:
+        optimizer.begin(strategies, root, memo)
+        _tls.opt_active = True
     try:
         with graftscope.span(
             "plan.lower", layer="QUERY-COMPILER", nodes=count_nodes(root)
@@ -107,6 +120,9 @@ def lower_traced(
         if instrument is not None:
             _tls.instrument = None
             _tls.inst_stack = None
+        if strategies is not None:
+            _tls.opt_active = False
+            optimizer.end()
     emit_metric("plan.lower.nodes", len(memo))
     return result, memo
 
@@ -121,10 +137,26 @@ def _lower(node: PlanNode, memo: Dict[int, Any]) -> Any:
         serving_context.check_deadline("plan.lower")
     instrument = getattr(_tls, "instrument", None)
     if instrument is None:
-        return _lower_node(node, memo)
+        if not getattr(_tls, "opt_active", False):
+            return _lower_node(node, memo)
+        # graftopt adaptive path: the cheapest timing that can still catch
+        # estimate divergence — one perf_counter pair per node, observed
+        # AFTER the node scope pops so a re-plan runs over a consistent
+        # done-set (this node already in the memo)
+        optimizer.push_node(node)
+        t0 = time.perf_counter()
+        try:
+            result = _lower_node(node, memo)
+        finally:
+            optimizer.pop_node()
+        optimizer.observe(node, time.perf_counter() - t0)
+        return result
     # EXPLAIN ANALYZE: time the node's lowering and attribute engine
     # dispatches; parent frames accumulate child totals so self = total -
     # children even though each lowerer recurses internally
+    opt_active = getattr(_tls, "opt_active", False)
+    if opt_active:
+        optimizer.push_node(node)
     stack = _tls.inst_stack
     frame = {"child_s": 0.0, "child_disp": 0}
     stack.append(frame)
@@ -139,12 +171,16 @@ def _lower(node: PlanNode, memo: Dict[int, Any]) -> Any:
         result = _lower_node(node, memo)
     finally:
         stack.pop()
+        if opt_active:
+            optimizer.pop_node()
         total_s = time.perf_counter() - t0
         total_disp = graftmeter.thread_dispatches() - d0
         if stack:
             parent = stack[-1]
             parent["child_s"] += total_s
             parent["child_disp"] += total_disp
+    if opt_active:
+        optimizer.observe(node, total_s)
     entry = {
         "total_s": total_s,
         "self_s": max(total_s - frame["child_s"], 0.0),

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
+from modin_tpu.logging.metrics import emit_metric
 from modin_tpu.plan.ir import (
     Filter,
     Map,
@@ -231,14 +232,66 @@ RULES: Tuple[Tuple[str, Any], ...] = (
 )
 
 
+#: Cost-gate tolerance: a rewrite is rejected only when the modeled cost
+#: RISES by more than this factor.  Generous on purpose — the catalog's
+#: rules are all structurally profitable (pushdown / CSE / pruning reduce
+#: bytes or work by construction) and the plan-time model is coarse; the
+#: gate exists to stop a future rule (or a miscalibrated model) from
+#: pessimizing a plan, not to second-guess clear wins.
+COST_GATE_TOLERANCE = 1.05
+
+#: The rules whose relative ORDER the cost model may rearrange within a
+#: pass: filter pushdown and CSE both reshape the same spine, and which
+#: one should see the plan first depends on estimated selectivity (a
+#: near-no-op filter is better merged than pushed).  The rest of the
+#: catalog keeps its fixed position — ordering is only sound between
+#: rules that commute on every plan, which these two do (both are
+#: applied to fixpoint anyway; the order decides which shape the OTHER
+#: one gets to see first each pass).
+_COST_ORDERED = frozenset({"pushdown-filter", "cse"})
+
+
+def _cost_ordered(
+    root: PlanNode, rules: Tuple[Tuple[str, Any], ...], cost_model: Any
+) -> List[Tuple[str, Any]]:
+    """The rule catalog for one pass, with the ``_COST_ORDERED`` block
+    sorted by modeled benefit (descending) on the current plan."""
+    block = [(name, rule) for name, rule in rules if name in _COST_ORDERED]
+    if len(block) < 2:
+        return list(rules)
+    base = cost_model(root)
+    benefit: dict = {}
+    for name, rule in block:
+        try:
+            candidate = rule(root)
+        except Exception:  # benefit probing must not mask the real application's error path below
+            candidate = None
+        benefit[name] = base - cost_model(candidate) if candidate is not None else 0.0
+    block.sort(key=lambda item: benefit[item[0]], reverse=True)
+    ordered: List[Tuple[str, Any]] = []
+    block_iter = iter(block)
+    for name, rule in rules:
+        ordered.append(next(block_iter) if name in _COST_ORDERED else (name, rule))
+    return ordered
+
+
 def optimize(
-    root: PlanNode, max_passes: Optional[int] = None
+    root: PlanNode,
+    max_passes: Optional[int] = None,
+    cost_model: Any = None,
 ) -> Tuple[PlanNode, List[Tuple[str, int]]]:
     """Apply the rule catalog to fixpoint under the pass budget.
 
     Returns ``(optimized_root, applied)`` where ``applied`` lists
     ``(rule_name, pass_index)`` in application order — the per-rule
     attribution EXPLAIN renders.
+
+    ``cost_model`` (graftopt's ``plan_cost``: plan -> estimated seconds)
+    arms cost-gated rewriting: a rule application is kept only while the
+    modeled cost does not rise beyond :data:`COST_GATE_TOLERANCE`, and the
+    pushdown-filter/CSE pair is re-ordered each pass by modeled benefit.
+    None (``MODIN_TPU_OPT=Off``) is byte-identical to the historical
+    fixed-order, always-accept behavior.
     """
     if max_passes is None:
         from modin_tpu.config import PlanMaxPasses
@@ -247,9 +300,20 @@ def optimize(
     applied: List[Tuple[str, int]] = []
     for pass_index in range(max(int(max_passes), 1)):
         changed = False
-        for name, rule in RULES:
+        rules = (
+            _cost_ordered(root, RULES, cost_model)
+            if cost_model is not None
+            else RULES
+        )
+        for name, rule in rules:
             new_root = rule(root)
             if new_root is not None:
+                if cost_model is not None:
+                    before = cost_model(root)
+                    after = cost_model(new_root)
+                    if after > before * COST_GATE_TOLERANCE + 1e-9:
+                        emit_metric(f"plan.rule_rejected.{name}", 1)
+                        continue
                 root = new_root
                 applied.append((name, pass_index))
                 changed = True

@@ -434,18 +434,24 @@ def _optimize_and_lower(
     qc: Any, root: PlanNode, instrument: Optional[dict] = None
 ) -> Tuple[Any, dict]:
     """One optimize+lower pass; records EXPLAIN attribution on ``qc``."""
+    from modin_tpu.plan import optimizer
     from modin_tpu.plan.ir import count_nodes
 
+    cost_model = optimizer.plan_cost if optimizer.OPT_ON else None
     with graftscope.span(
         "plan.optimize", layer="QUERY-COMPILER", nodes=count_nodes(root)
     ):
-        optimized, applied = optimize(root)
+        optimized, applied = optimize(root, cost_model=cost_model)
     passes = (applied[-1][1] + 1) if applied else 1
     emit_metric("plan.optimize.passes", passes)
     for name, _pass_index in applied:
         emit_metric(f"plan.rule.{name}", 1)
-    result, memo = lowering.lower_traced(optimized, instrument=instrument)
+    strategies = optimizer.choose(optimized) if optimizer.OPT_ON else None
+    result, memo = lowering.lower_traced(
+        optimized, instrument=instrument, strategies=strategies
+    )
     qc._plan_explain = (root, optimized, applied)
+    qc._plan_strategies = strategies
     return result, memo
 
 

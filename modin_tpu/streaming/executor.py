@@ -99,6 +99,9 @@ def _stream_source(node: Any, memo: dict, op_tag: str):
         return None
     if router.decide_residency(op_tag, est) != "windowed":
         return None
+    from modin_tpu.plan import optimizer as graftopt
+
+    graftopt.note_stream_bytes(est)
     return scan, kwargs
 
 

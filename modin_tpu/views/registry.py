@@ -333,6 +333,26 @@ def _valid_locked(art: Any, col: Any) -> Optional[str]:
     return None
 
 
+def column_artifact_kinds(col: Any) -> List[str]:
+    """Artifact kinds live RIGHT NOW for ``col``'s exact token.
+
+    graftopt's planning probe: no metrics, no LRU touch, no parent-chain
+    walk — the plan-time cost model only wants to annotate "a registered
+    view already answers this" legs, and a foldable ancestor is not a
+    free answer.  Stale entries are left for :func:`lookup` to reap.
+    """
+    tok = getattr(col, "_view_token", None)
+    if tok is None or col._data is None or getattr(col, "is_lazy", False):
+        return []
+    kinds: List[str] = []
+    with LOCK:
+        for key in _by_token.get(tok, ()):
+            art = _entries.get(key)
+            if art is not None and _valid_locked(art, col) is None:
+                kinds.append(key[1])
+    return kinds
+
+
 def lookup(
     col: Any, kind: str, params: Any, consume: bool = True
 ) -> Tuple[str, Optional[dict], int]:

@@ -2,7 +2,7 @@
 # One-command multi-execution verification (VERDICT r4 item 6; mirrors the
 # reference CI's one-run-per-engine matrix, .github/workflows/ci.yml:369-399):
 #
-#   ./scripts/check_all.sh            # all twenty gates, fail on any red
+#   ./scripts/check_all.sh            # all twenty-one gates, fail on any red
 #   FAST=1 ./scripts/check_all.sh     # -x (stop at first failure) per gate
 #
 # Gates:
@@ -86,6 +86,13 @@
 #       the WAL tail (wal.replay.batches > 0), and serve the frame + both
 #       views bit-exact vs pandas at the recovered batch count — then
 #       keep ingesting durably
+#   0p. graftopt optimizer smoke: MODIN_TPU_OPT=Auto must be bit-exact vs
+#       MODIN_TPU_OPT=Off and plain pandas on the plan_smoke pipeline,
+#       EXPLAIN/EXPLAIN ANALYZE must render chosen strategy legs with
+#       estimated-vs-measured walls plus the re-plan section, absurd
+#       injected priors must fire >= 1 opt.replan.* metric while staying
+#       bit-exact, Off mode must allocate zero PlanStrategies, and the
+#       whole workload must record zero lockdep violations
 #   1. full suite under TpuOnJax (default execution, 8-device virtual mesh)
 #   2. suite under PandasOnPython
 #   3. suite under NativeOnNative
@@ -125,6 +132,7 @@ run_gate "graftfleet"      python scripts/fleet_smoke.py
 run_gate "graftdep"        python scripts/lockdep_smoke.py
 run_gate "graftfeed"       python scripts/ingest_smoke.py
 run_gate "graftwal"        python scripts/durability_smoke.py
+run_gate "graftopt"        python scripts/optimizer_smoke.py
 run_gate "TpuOnJax"        python -m pytest tests/ -q $EXTRA --execution TpuOnJax
 run_gate "PandasOnPython"  python -m pytest tests/ -q $EXTRA --execution PandasOnPython
 run_gate "NativeOnNative"  python -m pytest tests/ -q $EXTRA --execution NativeOnNative
@@ -134,4 +142,4 @@ if [ "${#fails[@]}" -ne 0 ]; then
   echo "RED gates: ${fails[*]}"
   exit 1
 fi
-echo "ALL TWENTY GATES GREEN"
+echo "ALL TWENTY-ONE GATES GREEN"

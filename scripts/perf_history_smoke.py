@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """perf_history_smoke — the check_all.sh gate for the perf-history ledger.
 
-Four legs, mirroring what the other smokes prove for their subsystems:
+Five legs, mirroring what the other smokes prove for their subsystems:
 
 1. **Seed determinism**: the committed ledger's *seeded* entries (the runs
    carrying a ``source`` round file; folded runs carry none) must be
@@ -16,9 +16,14 @@ Four legs, mirroring what the other smokes prove for their subsystems:
    scale) folds into a working copy of the ledger with the regression gate
    green, provenance (git SHA / substrate / jax / pandas) present on its
    streamed lines, and the working PERF.md regenerating cleanly.
-4. **Gate sensitivity**: the same run with every op wall inflated 2x must
-   be REJECTED by the gate against the ledger that now holds the honest
-   numbers — a perf regression cannot fold in silently.
+4. **Gate sensitivity**: the same run with every op wall inflated 2x plus
+   the absolute noise floor must be REJECTED by the gate against the
+   ledger that now holds the honest numbers — a perf regression cannot
+   fold in silently.
+5. **Gate specificity**: a bump smaller than the absolute noise floor
+   (MODIN_TPU_PERF_GATE_NOISE_FLOOR_S) must be ACCEPTED even when the
+   ratio exceeds the tolerance — sub-millisecond walls are timer-jitter
+   dominated, and jitter is not a regression.
 
 Exit 0 on success; any failed leg prints a diagnostic and exits 1.
 """
@@ -139,19 +144,39 @@ def main() -> int:
         # regen is idempotent on the folded ledger too
         assert ph.regenerate_perf_md(ledger, regenerated) == regenerated
 
-        # ---- leg 4: a 2x wall regression is rejected ------------------ #
+        # ---- leg 4: a real wall regression is rejected ----------------- #
+        # 2x the wall AND past the absolute noise floor, so the inflation
+        # is unambiguously a regression even for sub-millisecond walls.
+        floor = ph._gate_noise_floor_s()
         inflated = copy.deepcopy(run)
         for entry in inflated["ops"].values():
-            entry["modin_tpu_s"] = round(entry["modin_tpu_s"] * 2.0, 6)
+            entry["modin_tpu_s"] = round(
+                entry["modin_tpu_s"] * 2.0 + floor, 6
+            )
         failures = ph.check_regression(ledger, inflated)
         assert failures, (
-            "the gate accepted a 2x wall regression vs the just-recorded "
-            "honest run"
+            "the gate accepted a 2x+floor wall regression vs the "
+            "just-recorded honest run"
         )
         rejected = {f.split()[2] for f in failures}
         assert rejected == set(inflated["ops"]), (
             f"gate rejected {rejected}, expected every inflated op "
             f"{set(inflated['ops'])}"
+        )
+
+        # ---- leg 5: sub-floor jitter is NOT a regression --------------- #
+        # A bump smaller than the absolute noise floor must pass even when
+        # the ratio blows through the tolerance (timer jitter on sub-ms
+        # walls is not signal).
+        jittered = copy.deepcopy(run)
+        for entry in jittered["ops"].values():
+            entry["modin_tpu_s"] = round(
+                entry["modin_tpu_s"] + floor * 0.5, 6
+            )
+        failures = ph.check_regression(ledger, jittered)
+        assert not failures, (
+            "the gate flagged a sub-noise-floor jitter bump as a "
+            "regression: " + "; ".join(failures)
         )
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -159,8 +184,8 @@ def main() -> int:
     print(
         "perf_history_smoke: OK — seed + regen byte-identical, honest run "
         f"folded green ({sorted(run['ops'])}, substrate="
-        f"{ph.run_substrate(run)}, sha={provenance['git_sha']}), 2x "
-        "regression rejected on every op"
+        f"{ph.run_substrate(run)}, sha={provenance['git_sha']}), 2x+floor "
+        "regression rejected on every op, sub-floor jitter accepted"
     )
     return 0
 

@@ -13,7 +13,7 @@ import os
 
 import pytest
 
-from modin_tpu.config import PerfGateTolerance
+from modin_tpu.config import PerfGateNoiseFloorS, PerfGateTolerance
 from modin_tpu.observability import perf_history as ph
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -149,6 +149,35 @@ class TestGate:
     def test_tolerance_below_one_rejected(self):
         with pytest.raises(ValueError):
             PerfGateTolerance.put(0.5)
+
+    def test_sub_floor_jitter_is_not_a_regression(self):
+        # 1.75x ratio on a sub-millisecond wall is timer jitter: the
+        # absolute delta (0.6ms) is below the 5ms noise floor, so the
+        # gate must stay green.
+        ledger = self._ledger_with({"gs_median": 0.0008})
+        jittered = ph.parse_bench_stream(_stream({"gs_median": 0.0014}))
+        assert ph.check_regression(ledger, jittered) == []
+
+    def test_noise_floor_knob_is_respected(self):
+        ledger = self._ledger_with({"gs_median": 0.0008})
+        jittered = ph.parse_bench_stream(_stream({"gs_median": 0.0014}))
+        prev = PerfGateNoiseFloorS.get()
+        PerfGateNoiseFloorS.put(0.0)
+        try:
+            # with the floor disabled the pure ratio check fires again
+            assert ph.check_regression(ledger, jittered)
+        finally:
+            PerfGateNoiseFloorS.put(prev)
+
+    def test_noise_floor_negative_rejected(self):
+        with pytest.raises(ValueError):
+            PerfGateNoiseFloorS.put(-0.001)
+
+    def test_regression_past_floor_still_fails(self):
+        # a real regression clears both the ratio and the absolute floor
+        ledger = self._ledger_with({"gs_median": 0.0008})
+        slow = ph.parse_bench_stream(_stream({"gs_median": 0.02}))
+        assert ph.check_regression(ledger, slow)
 
     def test_no_cross_scale_comparison(self):
         ledger = self._ledger_with({"gs_median": 0.5}, rows=120000)
